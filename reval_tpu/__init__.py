@@ -1,0 +1,22 @@
+"""reval_tpu — a TPU-native framework for evaluating LLMs on program
+runtime-behavior reasoning (the DREval benchmark family).
+
+Capabilities mirror the reference REval harness (see SURVEY.md): four tasks
+(coverage / path / state / output) plus a cross-task consistency score, with
+ground truth obtained by tracing real CPython execution.  Inference runs
+in-tree on TPUs via JAX/XLA (pjit-sharded models over an ICI mesh, Pallas
+attention kernels, paged KV cache) instead of the reference's vLLM/CUDA path.
+
+Layout:
+    dynamics/   ground-truth execution tracing (host CPU, pure Python)
+    datasets/   DREval benchmark data loaders and constants
+    prompting/  byte-compatible few-shot prompt templates
+    tasks/      the four tasks + consistency scoring engine
+    inference/  backends: tpu (in-tree JAX engine), openai, replay, mock
+    models/     JAX model definitions (llama-family, gemma, starcoder2)
+    ops/        Pallas TPU kernels and their XLA fallbacks
+    parallel/   mesh construction, sharding rules, ring attention
+    runtime/    scheduling / paged-KV bookkeeping (C++ with Python fallback)
+"""
+
+__version__ = "0.1.0"

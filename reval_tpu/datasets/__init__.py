@@ -1,0 +1,23 @@
+"""DREval benchmark datasets: constants, loaders, ClassEval hooks."""
+
+from .dreval import (
+    ClassEvalHooks,
+    DREvalDataset,
+    Families,
+    MAX_INPUTS,
+    SPLIT_FILES,
+    data_dir,
+    family_of,
+    resolve_split,
+)
+
+__all__ = [
+    "ClassEvalHooks",
+    "DREvalDataset",
+    "Families",
+    "MAX_INPUTS",
+    "SPLIT_FILES",
+    "data_dir",
+    "family_of",
+    "resolve_split",
+]

@@ -1,0 +1,81 @@
+"""Ground-truth runtime dynamics: tracing real CPython execution.
+
+This package is deliberately host-CPU pure Python — tracing the interpreter
+is not accelerator work.  The TPU engine lives in ``reval_tpu.inference``.
+
+Reference-API compatibility: users of the reference harness can keep writing
+``FunctionFactory.create`` / ``ClassFactory.create`` / ``Sandbox`` /
+``States`` / ``Nil``; they are thin wrappers over :class:`CodeSpace` and
+:class:`ExecutionTrace`.
+"""
+
+from .factory import TRACE_FILENAME, CodeSpace
+from .guards import ExecTimeout, swallow_io, time_limit
+from .nil import Nil, NilType, is_nil
+from .sandbox import Sandbox, snapshot_locals
+from .states import ExecutionTrace, LineState, VarInterpreter
+
+# Reference-familiar alias.
+States = ExecutionTrace
+
+__all__ = [
+    "CodeSpace",
+    "ExecutionTrace",
+    "ExecTimeout",
+    "FunctionFactory",
+    "ClassFactory",
+    "LineState",
+    "Nil",
+    "NilType",
+    "Sandbox",
+    "States",
+    "TRACE_FILENAME",
+    "VarInterpreter",
+    "is_nil",
+    "snapshot_locals",
+    "swallow_io",
+    "time_limit",
+]
+
+
+class FunctionFactory:
+    """Reference-compatible facade over :class:`CodeSpace` for functions.
+
+    Each call uses a fresh namespace; helper functions defined in the same
+    ``code`` blob resolve through the function's ``__globals__``.
+    """
+
+    @staticmethod
+    def create(fn_name: str, code: str):
+        return CodeSpace().load_function(fn_name, code)
+
+    @staticmethod
+    def create_from_answer(generated: str, test_cls):
+        # The predictor must compile in the namespace that holds the code
+        # under test or its name references cannot resolve.
+        space = getattr(test_cls, "__reval_space__", None) or CodeSpace()
+        return space.attach_output_predictor(generated, test_cls)
+
+
+class ClassFactory:
+    """Reference-compatible facade over :class:`CodeSpace` for classes.
+
+    Note: unlike :class:`FunctionFactory`, ClassEval flows need the class
+    under test visible to its test code — use one :class:`CodeSpace` for
+    both (`create` returns the class; pass the same space to
+    ``load_test_classes``), or use these statics which share one space per
+    call chain via the returned class's ``__reval_space__`` attribute.
+    """
+
+    @staticmethod
+    def create(cls_name: str, code: str):
+        space = CodeSpace()
+        cls = space.load_class(cls_name, code)
+        cls.__reval_space__ = space
+        return cls
+
+    @staticmethod
+    def create_test_classes(cls_name, code, test_code, name_pattern, validation, postprocess=None):
+        space = CodeSpace()
+        space.load_class(cls_name, code)
+        return space.load_test_classes(cls_name, code, test_code, name_pattern, validation, postprocess)

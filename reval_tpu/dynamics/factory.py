@@ -1,0 +1,138 @@
+"""Materialising benchmark code into traceable Python objects.
+
+A :class:`CodeSpace` is an isolated namespace for one benchmark item: the
+function or class under test, its helpers, and (for ClassEval) the unittest
+test classes that drive it.  Code under test is compiled with the sentinel
+:data:`TRACE_FILENAME` so the sandbox tracer knows which frames to record;
+test-driver code is compiled under a distinct filename so only the code
+under test is traced (capability parity with the reference factories at
+dynamics.py:15-92, without its module-global namespace pollution).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Type
+
+__all__ = ["TRACE_FILENAME", "CodeSpace"]
+
+# co_filename sentinel marking frames the tracer records.
+TRACE_FILENAME = "<reval:sandbox>"
+# co_filename for driver/test code that must NOT be traced.
+DRIVER_FILENAME = "<reval:driver>"
+
+
+class CodeSpace:
+    """One namespace holding a benchmark item's executable objects."""
+
+    def __init__(self):
+        self.ns: dict = {"__name__": "__reval_sandbox__", "__builtins__": __builtins__}
+
+    # -- loading code under test (traced) ---------------------------------
+    def _exec_traced(self, code: str) -> None:
+        exec(compile(code, TRACE_FILENAME, "exec"), self.ns)
+
+    def load_function(self, fn_name: str, code: str) -> Callable:
+        """Compile ``code`` and return the named function.
+
+        The full source is attached as ``__doc__`` (and ``__source__``) —
+        the sandbox maps trace linenos back to source lines through it.
+        """
+        self._exec_traced(code)
+        fn = self.ns[fn_name]
+        assert callable(fn), f"{fn_name!r} is not callable"
+        fn.__doc__ = code
+        fn.__source__ = code
+        return fn
+
+    def load_class(self, cls_name: str, code: str) -> Type:
+        """Compile ``code`` and return the named class (no instantiation)."""
+        self._exec_traced(code)
+        cls = self.ns[cls_name]
+        assert isinstance(cls, type), f"{cls_name!r} is not a class"
+        cls.__doc__ = code
+        return cls
+
+    # -- loading test-driver code (not traced) -----------------------------
+    def load_test_classes(
+        self,
+        cls_name: str,
+        code: str,
+        test_code: str,
+        name_pattern: Callable[[str, str], bool],
+        validation: Callable[[Type], bool],
+        postprocess: Callable[[Type, str], Type] | None = None,
+    ) -> list[Type]:
+        """Compile unittest driver code and return its matching test classes.
+
+        ``name_pattern(test_cls_name, cls_name)`` selects classes by name,
+        ``validation(cls)`` filters (e.g. unittest.TestCase subclasses), and
+        ``postprocess(cls, test_code)`` may rewrite each class — it receives
+        the raw test source so method sources can be extracted via AST
+        without tempfile/inspect machinery.  Matching classes get the code
+        under test as ``__doc__`` so sandboxes can index its source lines.
+        """
+        before = set(self.ns)
+        exec(compile(test_code, DRIVER_FILENAME, "exec"), self.ns)
+        found = []
+        # Iterate in definition order; include pre-existing names too in case
+        # the driver re-binds them (mirrors the reference's global scan).
+        for name, obj in list(self.ns.items()):
+            if name.startswith("__") and name not in before:
+                continue
+            if not isinstance(obj, type):
+                continue
+            if not name_pattern(name, cls_name) or not validation(obj):
+                continue
+            obj.__doc__ = code
+            # Remember which namespace holds the code under test so later
+            # phases (e.g. output-prediction scoring) can compile model
+            # answers where the tested names resolve.
+            obj.__reval_space__ = self
+            if postprocess is not None:
+                postprocess(obj, test_code)
+            found.append(obj)
+        return found
+
+    def attach_output_predictor(self, generated: str, test_cls: Type) -> Callable:
+        """Wrap a model-completed assertion block as a bound test method.
+
+        The generated snippet uses bare ``assertEqual(...)`` style (per the
+        output-task prompt); it is indented into a ``dreval_output_pred``
+        method body (triple-quoted blocks keep their indentation) and the
+        ``assert`` prefix is rewritten to ``self.assert`` so unittest
+        helpers resolve.  The method is attached to ``test_cls`` and
+        returned; calling it raises iff the model's assertions fail.
+        """
+        lines = ["def dreval_output_pred(self):"]
+        in_string_block = False
+        for line in generated.split("\n"):
+            lines.append(line if in_string_block else "\t" + line)
+            if "'''" in line or '"""' in line:
+                in_string_block = not in_string_block
+        method_src = "\n".join(lines).replace("assert", "self.assert")
+        fn = self.load_function("dreval_output_pred", method_src)
+        fn.__doc__ = test_cls.__doc__
+        setattr(test_cls, "dreval_output_pred", fn)
+        return fn
+
+    # -- helpers -----------------------------------------------------------
+    def eval_invocation(self, expr: str):
+        """Evaluate an input/invocation expression inside this namespace."""
+        return eval(compile(expr, DRIVER_FILENAME, "eval"), self.ns)
+
+    def exec_driver(self, code: str) -> None:
+        """Execute arbitrary driver code (e.g. a completed assert block)."""
+        exec(compile(code, DRIVER_FILENAME, "exec"), self.ns)
+
+
+def method_source_segment(test_code: str, cls_name_pattern: Callable[[str], bool], method_name: str) -> str | None:
+    """Return the source of ``method_name`` inside the first class of
+    ``test_code`` whose name matches, using AST only (no temp files)."""
+    tree = ast.parse(test_code)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and cls_name_pattern(node.name):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name == method_name:
+                    return ast.get_source_segment(test_code, item)
+    return None

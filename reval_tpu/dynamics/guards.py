@@ -1,0 +1,82 @@
+"""Execution guards for running untrusted benchmark code.
+
+Wall-clock timeout via ``SIGALRM`` and stdio capture with a write-only
+buffer, in the spirit of the classic HumanEval harness (capability parity
+with the reference guards at execution.py:1-49).  There is intentionally no
+filesystem or network isolation here: ground truth requires executing the
+benchmark programs in-process so the tracer can observe them.  Callers that
+need stronger isolation should run the whole sandbox in a subprocess (see
+``reval_tpu.tasks``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import signal
+
+__all__ = ["ExecTimeout", "time_limit", "swallow_io"]
+
+
+class ExecTimeout(Exception):
+    """Raised inside the guarded region when the time budget is exhausted."""
+
+
+@contextlib.contextmanager
+def time_limit(seconds: float):
+    """Raise :class:`ExecTimeout` in the calling thread after ``seconds``.
+
+    Uses ``signal.setitimer`` so fractional budgets work.  Main-thread only
+    (a CPython ``signal`` restriction) — which is fine: ground-truth tracing
+    must run on the main thread anyway for ``sys.settrace``.
+
+    The timer is *periodic*, not one-shot: the exception raised by the
+    handler can land in a context that swallows it — observed in practice
+    with JAX's gc callback (``_xla_gc_callback``), where CPython treats the
+    exception as unraisable and drops it.  A periodic timer retries until
+    one raise lands in interruptible code; the finally-clause disarms it.
+    """
+
+    def _on_alarm(signum, frame):
+        raise ExecTimeout(f"execution exceeded {seconds}s")
+
+    previous = signal.signal(signal.SIGALRM, _on_alarm)
+    retry = min(seconds, 1.0)
+    signal.setitimer(signal.ITIMER_REAL, seconds, retry)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, previous)
+
+
+class _WriteOnlyBuffer(io.StringIO):
+    """A StringIO that refuses to be read while attached as stdin.
+
+    Benchmark programs occasionally call ``input()``; letting that block or
+    read captured output would corrupt the trace, so reads fail fast.
+    """
+
+    def read(self, *args, **kwargs):
+        raise IOError("stdin is closed inside the sandbox")
+
+    def readline(self, *args, **kwargs):
+        raise IOError("stdin is closed inside the sandbox")
+
+    def readlines(self, *args, **kwargs):
+        raise IOError("stdin is closed inside the sandbox")
+
+    def readable(self) -> bool:
+        return False
+
+
+class _redirect_stdin(contextlib._RedirectStream):
+    _stream = "stdin"
+
+
+@contextlib.contextmanager
+def swallow_io():
+    """Silence stdout/stderr and disconnect stdin for the guarded region."""
+    sink = _WriteOnlyBuffer()
+    with contextlib.redirect_stdout(sink), contextlib.redirect_stderr(sink), _redirect_stdin(sink):
+        yield sink

@@ -1,0 +1,50 @@
+"""The ``Nil`` sentinel.
+
+``Nil`` means "no value was observed" — a line never executed, a variable
+undefined at a probe point — and is distinct from ``None``, which programs
+under test may legitimately produce.  (Capability parity with the reference
+sentinel at dynamics.py:137-162.)
+
+The singleton survives ``copy``, ``deepcopy`` and ``pickle`` round-trips:
+all of them return the same object, so ``is Nil`` checks stay valid across
+the deep-copied locals snapshots taken by the tracer.
+"""
+
+__all__ = ["Nil", "NilType", "is_nil"]
+
+
+class NilType:
+    """Singleton class for :data:`Nil`.  Do not instantiate elsewhere."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    # Keep the singleton a singleton under every duplication protocol.
+    def __reduce__(self):
+        return (NilType, ())
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def __repr__(self):
+        return "Nil"
+
+    def __str__(self):
+        return "Nil"
+
+    def __bool__(self):
+        return False
+
+
+Nil = NilType()
+
+
+def is_nil(value) -> bool:
+    return value is Nil

@@ -1,0 +1,118 @@
+"""The sandbox: traced execution of one callable under guards.
+
+Installs a ``sys.settrace`` hook that records, for every frame compiled with
+the :data:`~reval_tpu.dynamics.factory.TRACE_FILENAME` sentinel, a snapshot
+of the frame's locals on each ``line`` event plus ``return``/``exception``
+events, into an :class:`~reval_tpu.dynamics.states.ExecutionTrace`.
+Capability parity with the reference sandbox/tracer (dynamics.py:94-135,
+406-446), instance-based instead of module-global so sandboxes are
+re-entrant-safe and unit-testable in isolation.
+"""
+
+from __future__ import annotations
+
+import sys
+from copy import deepcopy
+from time import monotonic
+from types import BuiltinFunctionType, FrameType, FunctionType, ModuleType
+from typing import Callable, Iterator
+
+from .factory import TRACE_FILENAME
+from .guards import ExecTimeout, swallow_io, time_limit
+from .nil import Nil
+from .states import ExecutionTrace
+
+__all__ = ["Sandbox", "snapshot_locals"]
+
+# Local values of these kinds are not snapshotted: they are either
+# unserialisable or meaningless to compare (reference filter,
+# dynamics.py:107-118).
+_SKIPPED_TYPES = (ModuleType, FunctionType, BuiltinFunctionType)
+
+
+def snapshot_locals(frame_locals: dict) -> dict:
+    """Deep-copy the serialisable subset of a frame's locals."""
+    snap = {}
+    for name, value in frame_locals.items():
+        if isinstance(value, _SKIPPED_TYPES) or isinstance(value, Iterator):
+            continue
+        try:
+            snap[name] = deepcopy(value)
+        except ExecTimeout:
+            # The SIGALRM timeout may land while we are inside deepcopy;
+            # it must propagate or the one-shot itimer never fires again
+            # and the sandbox hangs forever.
+            raise
+        except Exception:
+            # Un-deep-copyable values (open files, locks, …) are skipped
+            # rather than crashing the trace.
+            continue
+    return snap
+
+
+class Sandbox:
+    """Runs one callable under tracing + io/time guards.
+
+    ``fn.__doc__`` must hold the source of the code under test (the
+    factories guarantee this); trace linenos are 0-indexed into it.
+
+    After :meth:`run`, ``status`` is ``'ok'``, ``'timed out'`` or
+    ``'exception: <msg>'`` and ``states`` holds the recorded trace.
+    """
+
+    def __init__(self, fn: Callable, timeout: float = 120.0):
+        self.fn = fn
+        self.timeout = timeout
+        self.result = Nil
+        self.status = ""
+        self.states = ExecutionTrace()
+        self._codelines = (fn.__doc__ or "").split("\n")
+        self._deadline = float("inf")
+
+    # -- trace hooks -------------------------------------------------------
+    def _global_hook(self, frame: FrameType, event: str, arg):
+        if event == "call" and frame.f_code.co_filename == TRACE_FILENAME:
+            return self._local_hook
+        return None
+
+    def _local_hook(self, frame: FrameType, event: str, arg):
+        lineno = frame.f_lineno - 1  # 0-indexed trace linenos
+        if event == "line":
+            # Second timeout layer: the SIGALRM raise can be swallowed if it
+            # lands in an unraisable context (gc callbacks); the hook runs on
+            # every traced line, which is a context the raise always escapes.
+            if monotonic() > self._deadline:
+                raise ExecTimeout(f"execution exceeded {self.timeout}s")
+            self._record(lineno, "locals", snapshot_locals(frame.f_locals))
+        elif event == "return":
+            self._record(lineno, "return", arg)
+        elif event == "exception":
+            self._record(lineno, "exception", arg[0])
+        return self._local_hook
+
+    def _record(self, lineno: int, event: str, value):
+        codeline = self._codelines[lineno] if 0 <= lineno < len(self._codelines) else ""
+        self.states.record(lineno, event, value, codeline)
+
+    # -- execution ---------------------------------------------------------
+    def run(self, *args, **kwargs):
+        """Execute ``fn(*args, **kwargs)`` traced; return (result, states)."""
+        self.result = Nil
+        self.status = ""
+        self.states = ExecutionTrace()
+        self._deadline = monotonic() + self.timeout
+
+        try:
+            with swallow_io():
+                with time_limit(self.timeout):
+                    sys.settrace(self._global_hook)
+                    try:
+                        self.result = self.fn(*args, **kwargs)
+                    finally:
+                        sys.settrace(None)
+            self.status = "ok"
+        except ExecTimeout:
+            self.status = "timed out"
+        except BaseException as exc:  # noqa: BLE001 — benchmark code may raise anything
+            self.status = f"exception: {exc}"
+        return self.result, self.states

@@ -1,0 +1,264 @@
+"""Recorded execution states and queries over them.
+
+A :class:`LineState` is the snapshot taken when the tracer reached one source
+line (0-indexed); an :class:`ExecutionTrace` is the ordered sequence of those
+snapshots for one sandboxed call, with the query API the task layer scores
+against.  Capability parity with the reference state model (dynamics.py:225-404)
+including its *after* semantics: ``sys.settrace`` fires **before** a line runs,
+so the values produced *by* line L are read from the trace entry that follows
+each occurrence of L.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import Any, Iterable
+
+from .nil import Nil
+
+__all__ = ["LineState", "ExecutionTrace", "VarInterpreter"]
+
+
+class LineState:
+    """Snapshot of one visit to one (0-indexed) source line."""
+
+    __slots__ = ("lineno", "code", "locals", "return_value", "exception")
+
+    def __init__(self, lineno: int, code: str):
+        self.lineno = lineno
+        self.code = code
+        self.locals: dict[str, Any] = {}
+        self.return_value = Nil
+        self.exception = Nil
+
+    def merge_event(self, event: str, value) -> None:
+        """Fold a tracer event ('locals' | 'return' | 'exception') in."""
+        if event == "locals":
+            self.locals = value
+        elif event == "return":
+            self.return_value = value
+        elif event == "exception":
+            self.exception = value
+        else:
+            raise ValueError(f"unknown trace event {event!r}")
+
+    def get_local(self, var: str):
+        return self.locals.get(var, Nil)
+
+    def get_attr(self, var: str, attr: str):
+        obj = self.locals.get(var, Nil)
+        if obj is Nil or not hasattr(obj, attr):
+            return Nil
+        return getattr(obj, attr)
+
+    def get_subscript(self, var: str, key_expr: str):
+        obj = self.locals.get(var, Nil)
+        if obj is Nil:
+            return Nil
+        try:
+            return obj[ast.literal_eval(key_expr)]
+        except (TypeError, KeyError, IndexError, ValueError, SyntaxError):
+            return Nil
+
+    def to_json(self) -> dict:
+        doc: dict[str, Any] = {"lineno": self.lineno, "locals": {}}
+        for name, value in self.locals.items():
+            doc["locals"][name] = list(value) if isinstance(value, set) else value
+        if self.return_value is not Nil:
+            doc["return"] = self.return_value
+        if self.exception is not Nil:
+            exc = self.exception
+            doc["exception"] = exc.__name__ if isinstance(exc, type) else exc.__class__.__name__
+        return doc
+
+    def __repr__(self):
+        return (
+            f"LineState(lineno={self.lineno}, locals={self.locals!r}, "
+            f"return={self.return_value!r}, exception={self.exception!r})"
+        )
+
+
+class ExecutionTrace:
+    """Ordered line-state sequence for one sandboxed call, plus queries.
+
+    Also exported as ``States`` for users coming from the reference API.
+    """
+
+    def __init__(self):
+        self._states: list[LineState] = []
+        # lineno -> positions in self._states, kept in order.  The reference
+        # linear-scans per query (dynamics.py:325,343); an index keeps query
+        # cost O(visits) instead of O(trace length).
+        self._by_line: dict[int, list[int]] = {}
+
+    # -- construction -----------------------------------------------------
+    def record(self, lineno: int, event: str, value, codeline: str) -> None:
+        """Append an event, merging consecutive events on the same line.
+
+        The tracer emits 'locals' then possibly 'return'/'exception' for the
+        same visit; those belong to one :class:`LineState`.
+        """
+        if self._states and self._states[-1].lineno == lineno:
+            self._states[-1].merge_event(event, value)
+            return
+        state = LineState(lineno, codeline)
+        state.merge_event(event, value)
+        self._by_line.setdefault(lineno, []).append(len(self._states))
+        self._states.append(state)
+
+    # -- container protocol ----------------------------------------------
+    def __len__(self):
+        return len(self._states)
+
+    def __getitem__(self, i: int) -> LineState:
+        return self._states[i]
+
+    def __iter__(self):
+        return iter(self._states)
+
+    def __repr__(self):
+        return f"ExecutionTrace({self._states!r})"
+
+    # -- queries (linenos are 0-indexed throughout) -----------------------
+    @property
+    def trace(self) -> list[int]:
+        """The executed line sequence."""
+        return [s.lineno for s in self._states]
+
+    def get_coverage(self, lineno: int) -> bool:
+        return lineno in self._by_line
+
+    def get_next_line(self, lineno: int) -> set[int]:
+        """All observed successor lines of ``lineno``; -1 marks trace end.
+
+        Returns ``{-1}`` when the line was never executed (reference
+        convention, dynamics.py:322-323).
+        """
+        positions = self._by_line.get(lineno)
+        if not positions:
+            return {-1}
+        succ: set[int] = set()
+        for i in positions:
+            succ.add(self._states[i + 1].lineno if i + 1 < len(self._states) else -1)
+        return succ
+
+    def states_before(self, lineno: int) -> list[LineState]:
+        """Snapshots taken on arrival at ``lineno`` (pre-execution values)."""
+        return [self._states[i] for i in self._by_line.get(lineno, [])]
+
+    def states_after(self, lineno: int) -> list[LineState]:
+        """Snapshots reflecting the world *after* each visit to ``lineno``.
+
+        Because the tracer fires before a line executes, that is the next
+        trace entry — except when the visit is the final entry (a return or
+        exception), whose own snapshot already holds the post-line values.
+        """
+        out = []
+        for i in self._by_line.get(lineno, []):
+            out.append(self._states[i + 1] if i + 1 < len(self._states) else self._states[i])
+        return out
+
+    def _collect_after(self, lineno: int, getter) -> list | type(Nil):
+        found = []
+        for state in self.states_after(lineno):
+            value = getter(state)
+            if value is not Nil:
+                found.append(value)
+        return found if found else Nil
+
+    def get_local(self, lineno: int, var: str):
+        """Values of ``var`` after each visit to ``lineno`` (a list across
+        loop iterations), or ``Nil`` if never executed / never defined."""
+        return self._collect_after(lineno, lambda s: s.get_local(var))
+
+    def get_attr(self, lineno: int, var: str, attr: str):
+        return self._collect_after(lineno, lambda s: s.get_attr(var, attr))
+
+    def get_subscript(self, lineno: int, var: str, key_expr: str):
+        return self._collect_after(lineno, lambda s: s.get_subscript(var, key_expr))
+
+    def interpret_var(self, lineno: int, expr: str):
+        """Evaluate a probe expression (``x``, ``self.a``, ``arr[0]``, …)
+        against the recorded states.  See :class:`VarInterpreter`."""
+        return VarInterpreter(lineno, expr, self).get()
+
+    def get_return(self, lineno: int):
+        values = [
+            s.return_value
+            for s in (self._states[i] for i in self._by_line.get(lineno, []))
+            if s.return_value is not Nil
+        ]
+        assert len(values) <= 1, f"multiple return values recorded for line {lineno}: {values}"
+        return values[0] if values else Nil
+
+    def get_exception(self, lineno: int):
+        values = [
+            s.exception
+            for s in (self._states[i] for i in self._by_line.get(lineno, []))
+            if s.exception is not Nil
+        ]
+        assert len(values) <= 1, f"multiple exceptions recorded for line {lineno}: {values}"
+        return values[0] if values else Nil
+
+    def to_json(self) -> list[dict]:
+        return [s.to_json() for s in self._states]
+
+
+class VarInterpreter:
+    """Evaluates a restricted expression grammar against a trace.
+
+    Supported AST shapes: constants, names, attribute access, subscripts and
+    tuples (reference grammar, dynamics.py:170-207).  Because a line may be
+    visited many times, every sub-expression evaluates to a *list* of
+    candidate values; subscripts/tuples take cartesian products across their
+    operands' candidates.  ``Nil`` propagates, and any internal error
+    collapses to ``Nil``.
+    """
+
+    def __init__(self, lineno: int, expr: str, trace: ExecutionTrace):
+        self.lineno = lineno
+        self.expr = expr
+        self.trace = trace
+
+    def get(self):
+        try:
+            return self._analyze()
+        except Exception:
+            return Nil
+
+    def _analyze(self):
+        if not self.trace.get_coverage(self.lineno):
+            return Nil
+        tree = ast.parse(self.expr, mode="eval")
+        return self._eval(tree.body)
+
+    def _eval(self, node: ast.expr):
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, ast.Name):
+            return self.trace.get_local(self.lineno, node.id)
+        if isinstance(node, ast.Attribute):
+            candidates = self._eval(node.value)
+            if candidates is Nil:
+                return Nil
+            found = [getattr(obj, node.attr) for obj in candidates if hasattr(obj, node.attr)]
+            return found if found else Nil
+        if isinstance(node, ast.Subscript):
+            containers = self._eval(node.value)
+            keys = self._eval(node.slice)
+            if containers is Nil or keys is Nil:
+                return Nil
+            found = []
+            for container, key in itertools.product(containers, keys):
+                try:
+                    found.append(container[key])
+                except (TypeError, KeyError, IndexError):
+                    pass
+            return found if found else Nil
+        if isinstance(node, ast.Tuple):
+            parts = [self._eval(elt) for elt in node.elts]
+            if any(p is Nil for p in parts):
+                return Nil
+            return list(itertools.product(*parts))
+        raise ValueError(f"unsupported probe expression node: {ast.dump(node)}")

@@ -1,0 +1,45 @@
+"""Prompt construction from the byte-compatible few-shot templates.
+
+Templates live in ``templates/`` (4 tasks × {direct, cot}); rendering is
+plain ``str.format`` over the fields ``{code} {invocation}
+{invocation_abbr} {line} {codeline} {var}`` (reference prompt.py:1-9).
+Templates are cached after first read.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from pathlib import Path
+
+__all__ = ["build_prompt", "build_direct_prompt", "build_cot_prompt", "template_path", "STOP_STRING"]
+
+# The universal generation stop sequence (reference inference.py:65,97,123).
+STOP_STRING = "[/ANSWER]"
+
+_TEMPLATE_DIR = Path(__file__).resolve().parent / "templates"
+
+VALID_TASKS = ("coverage", "path", "state", "output")
+VALID_STYLES = ("direct", "cot")
+
+
+def template_path(task: str, style: str) -> Path:
+    assert task in VALID_TASKS, f"unknown task {task!r}"
+    assert style in VALID_STYLES, f"unknown prompt style {style!r}"
+    return _TEMPLATE_DIR / f"{style}_{task}.txt"
+
+
+@lru_cache(maxsize=None)
+def _template(task: str, style: str) -> str:
+    return template_path(task, style).read_text()
+
+
+def build_prompt(task: str, style: str, **fields) -> str:
+    return _template(task, style).format(**fields)
+
+
+def build_direct_prompt(task: str, **fields) -> str:
+    return build_prompt(task, "direct", **fields)
+
+
+def build_cot_prompt(task: str, **fields) -> str:
+    return build_prompt(task, "cot", **fields)

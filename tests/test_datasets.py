@@ -1,0 +1,132 @@
+"""Dataset loaders, ClassEval hooks, prompting — incl. reference-data fixtures."""
+
+import pytest
+
+from reval_tpu.datasets import DREvalDataset, Families, family_of, resolve_split
+from reval_tpu.dynamics import CodeSpace, Sandbox
+from reval_tpu.datasets.dreval import ClassEvalHooks
+from reval_tpu.prompting import STOP_STRING, build_direct_prompt, build_cot_prompt
+
+
+class TestConstants:
+    def test_family_ranges(self):
+        assert family_of(0) == "humaneval"
+        assert family_of(84) == "humaneval"
+        assert family_of(85) == "classeval"
+        assert family_of(154) == "mbpp"
+        assert family_of(655) == "mathqa"
+        with pytest.raises(ValueError):
+            family_of(9999)
+
+    def test_resolve_split(self):
+        data, tasks = resolve_split("humaneval")
+        assert data.name == "DREval_data.jsonl"
+        data, tasks = resolve_split("mbpp")
+        assert "black" in data.name
+        data, tasks = resolve_split("mbpp", "mbpp_raw")
+        assert data.name == "DREval_data_mbpp.jsonl"
+
+
+class TestLoading:
+    @pytest.fixture(scope="class")
+    def main_ds(self):
+        return DREvalDataset.load("humaneval")
+
+    def test_indexed_access(self, main_ds):
+        assert main_ds.entry_point(0) == "has_close_elements"
+        assert "def has_close_elements" in main_ds.code(0)
+        assert isinstance(main_ds.inputs(0), list)
+
+    def test_task_iteration_filters_by_family(self, main_ds):
+        idxs = [int(r["idx"]) for r in main_ds.iter_tasks("humaneval")]
+        assert idxs and all(i <= Families.HUMANEVAL_END for i in idxs)
+        c_idxs = [int(r["idx"]) for r in main_ds.iter_tasks("classeval")]
+        assert c_idxs and all(Families.CLASSEVAL_START <= i <= Families.CLASSEVAL_END for i in c_idxs)
+
+
+class TestDatasetFixtures:
+    """Reference test.py's dataset-driven sandbox checks (test_sandbox_2/5)."""
+
+    def test_humaneval_idx5_trace(self):
+        ds = DREvalDataset.load("humaneval")
+        space = CodeSpace()
+        fn = space.load_function(ds.entry_point(5), ds.code(5))
+        result, states = Sandbox(fn).run([1, 2, 3, 4])
+        assert result == (10, 24)
+        assert 0 in states.get_local(14, "sum_value")
+        assert 6 in states.get_local(15, "sum_value")
+        assert 6 in states.get_local(16, "prod_value")
+
+    def test_classeval_idx85_trace(self):
+        import inspect
+
+        ds = DREvalDataset.load("classeval")
+        idx = 85
+        space = CodeSpace()
+        space.load_class(ds.entry_point(idx), ds.code(idx))
+        classes = space.load_test_classes(
+            ds.entry_point(idx),
+            ds.code(idx),
+            ds.test_code(idx),
+            ClassEvalHooks.name_pattern,
+            ClassEvalHooks.validation,
+            ClassEvalHooks.postprocess,
+        )
+        # NOTE: the reference's test_sandbox_5 expectations target upstream
+        # ClassEval ordering; in this snapshot idx 85 is AreaCalculator
+        # (reference test.py:100-119 would fail here).  Assert the same
+        # *kinds* of facts against the actual data.
+        assert len(classes) >= 1
+        tcls = classes[0]
+        obj = tcls()
+        sandbox = Sandbox(obj.dreval_test)
+        _, states = sandbox.run()
+        assert sandbox.status == "ok"
+        # __init__ body: line 6 = `self.radius = radius`
+        assert states.get_coverage(6)
+        # pre-execution snapshot at line 6 holds the ctor argument
+        assert 2 in [s.get_local("radius") for s in states.states_before(6)]
+        # after-semantics: self.radius is set once line 6 has run
+        assert 2 in states.get_attr(6, "self", "radius")
+        assert 2 in states.interpret_var(6, "self.radius")
+        # calculate_circle_area body: line 9 returns pi * r**2
+        assert states.get_coverage(9)
+        assert abs(states.get_return(9) - 12.566370614359172) < 1e-9
+        assert inspect.isroutine(states.get_attr(6, "self", "calculate_circle_area")[0])
+        assert -1 in states.get_next_line(9) or 9 in states.trace
+
+
+class TestPrompting:
+    def test_direct_coverage_prompt_renders(self):
+        p = build_direct_prompt(
+            "coverage",
+            code="def f(x):\n    return x",
+            invocation="f(1)",
+            invocation_abbr="f(1)",
+            line=2,
+            codeline="    return x",
+        )
+        assert p.endswith("[ANSWER]")
+        assert "Is Line 2 (    return x) executed when f(1) is called?" in p
+        assert STOP_STRING == "[/ANSWER]"
+
+    def test_all_eight_templates_render(self):
+        import string
+
+        from reval_tpu.prompting import build_prompt, template_path
+
+        supplied = dict(
+            code="def f():\n    pass",
+            invocation="f()",
+            invocation_abbr="f()",
+            line=1,
+            codeline="def f():",
+            var="x",
+        )
+        for task in ("coverage", "path", "state", "output"):
+            for style in ("direct", "cot"):
+                template = template_path(task, style).read_text()
+                needed = {f for _, f, _, _ in string.Formatter().parse(template) if f}
+                assert needed <= set(supplied), f"{task}/{style} needs unknown fields {needed}"
+                rendered = build_prompt(task, style, **{k: supplied[k] for k in needed})
+                assert len(rendered) > 100

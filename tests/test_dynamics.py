@@ -1,0 +1,244 @@
+"""Dynamics-layer tests, modeled on the reference test strategy (SURVEY §4)."""
+
+import pickle
+
+import pytest
+
+from reval_tpu.dynamics import (
+    ClassFactory,
+    CodeSpace,
+    ExecutionTrace,
+    FunctionFactory,
+    Nil,
+    Sandbox,
+)
+
+
+class TestNil:
+    def test_identity_and_inequality(self):
+        assert Nil != None  # noqa: E711
+        assert Nil != 0
+        assert Nil != False  # noqa: E712
+        a = Nil
+        assert a is Nil
+        assert a == Nil
+
+    def test_pickle_roundtrip(self):
+        assert pickle.loads(pickle.dumps(Nil)) is Nil
+
+    def test_copy_roundtrip(self):
+        import copy
+
+        assert copy.copy(Nil) is Nil
+        assert copy.deepcopy(Nil) is Nil
+
+    def test_falsy_repr(self):
+        assert not Nil
+        assert repr(Nil) == "Nil"
+
+
+class TestFactories:
+    def test_function_factory(self):
+        code = "def f(x):\n\treturn x**2"
+        fn = FunctionFactory.create("f", code)
+        assert fn(2) == 4
+        assert fn.__doc__ == code
+
+    def test_class_factory(self):
+        code = "class A:\n\tdef __init__(self, x):\n\t\tself.x = x\n\tdef f(self):\n\t\treturn self.x**2"
+        cls = ClassFactory.create("A", code)
+        assert cls(2).f() == 4
+        assert cls.__doc__ == code
+
+    def test_namespace_isolation(self):
+        FunctionFactory.create("f", "def f():\n\treturn 1")
+        g = FunctionFactory.create("g", "def g():\n\treturn 'f' in dir()")
+        # separate CodeSpaces: the second blob does not see the first's f
+        space = CodeSpace()
+        space.load_function("h", "def h():\n\treturn 2")
+        assert "f" not in space.ns
+
+
+class TestSandboxBasics:
+    def test_square(self):
+        fn = FunctionFactory.create("f", "def f(x):\n\treturn x**2")
+        sandbox = Sandbox(fn)
+        result, states = sandbox.run(2)
+        assert result == 4
+        assert states.get_return(1) == 4
+        assert states.get_local(1, "x") == [2]
+        assert states.get_exception(1) is Nil
+        assert not states.get_coverage(0)
+        assert states.get_coverage(1)
+        assert -1 in states.get_next_line(1)
+        assert sandbox.status == "ok"
+
+    def test_uncovered_next_line_is_minus_one(self):
+        fn = FunctionFactory.create("f", "def f(x):\n\tif x > 0:\n\t\treturn 1\n\telse:\n\t\treturn 2")
+        _, states = Sandbox(fn).run(5)
+        assert states.get_next_line(4) == {-1}  # else branch not taken
+        assert states.get_coverage(2)
+        assert not states.get_coverage(4)
+
+    def test_loop_collects_values_across_iterations(self):
+        code = "def f(n):\n\ts = 0\n\tfor i in range(n):\n\t\ts = s + i\n\treturn s"
+        fn = FunctionFactory.create("f", code)
+        result, states = Sandbox(fn).run(3)
+        assert result == 3
+        # after-semantics: values of s after line 3 executes each time
+        assert states.get_local(3, "s") == [0, 1, 3]
+        # successors of the loop body line include the loop header
+        assert 2 in states.get_next_line(3)
+
+    def test_helper_function_traced(self):
+        code = "def f(x):\n\treturn x**2\ndef g(x):\n\ta = f(x)\n\treturn a"
+        fn = FunctionFactory.create("g", code)
+        result, states = Sandbox(fn).run(2)
+        assert result == 4
+        assert states.get_return(1) == 4
+        assert states.get_return(4) == 4
+        assert states.get_coverage(1)
+
+    def test_nested_function_traced(self):
+        code = "def g(x):\n\tdef f(x):\n\t\ty = x**2\n\t\treturn y\n\ta = f(x)\n\treturn a"
+        fn = FunctionFactory.create("g", code)
+        result, states = Sandbox(fn).run(2)
+        assert result == 4
+        assert 4 in states.get_local(2, "y")
+
+    def test_exception_recorded_and_status(self):
+        fn = FunctionFactory.create("f", "def f(x):\n\treturn 1 // x")
+        sandbox = Sandbox(fn)
+        result, states = sandbox.run(0)
+        assert sandbox.status.startswith("exception:")
+        assert states.get_exception(1) is ZeroDivisionError
+
+    def test_timeout(self):
+        fn = FunctionFactory.create("f", "def f():\n\twhile True:\n\t\tpass")
+        sandbox = Sandbox(fn, timeout=0.2)
+        sandbox.run()
+        assert sandbox.status == "timed out"
+
+    def test_io_swallowed(self, capsys):
+        fn = FunctionFactory.create("f", "def f():\n\tprint('loud')\n\treturn 1")
+        result, _ = Sandbox(fn).run()
+        assert result == 1
+        assert "loud" not in capsys.readouterr().out
+
+    def test_rerun_resets_state(self):
+        fn = FunctionFactory.create("f", "def f(x):\n\treturn x + 1")
+        sandbox = Sandbox(fn)
+        sandbox.run(1)
+        result, states = sandbox.run(10)
+        assert result == 11
+        assert states.get_local(1, "x") == [10]
+
+
+CLASS_CODE = """class Greeter:
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+
+    def greet(self, request):
+        method = request["method"]
+        self.count = self.count + 1
+        if method == "GET":
+            return "hello " + self.name
+        return "bye"
+"""
+
+TEST_CODE = """import unittest
+
+class GreeterTestGreet(unittest.TestCase):
+    def test_greet(self):
+        g = Greeter("ada")
+        request = {"method": "GET"}
+        out = g.greet(request)
+        self.assertEqual(out, "hello ada")
+"""
+
+
+class TestClassEvalFlow:
+    def _make_test_class(self):
+        from reval_tpu.datasets.dreval import ClassEvalHooks
+
+        space = CodeSpace()
+        space.load_class("Greeter", CLASS_CODE)
+        classes = space.load_test_classes(
+            "Greeter",
+            CLASS_CODE,
+            TEST_CODE,
+            ClassEvalHooks.name_pattern,
+            ClassEvalHooks.validation,
+            ClassEvalHooks.postprocess,
+        )
+        assert len(classes) == 1
+        return classes[0]
+
+    def test_traced_class_under_test(self):
+        tcls = self._make_test_class()
+        obj = tcls()
+        sandbox = Sandbox(obj.dreval_test)
+        _, states = sandbox.run()
+        assert sandbox.status == "ok"
+        # linenos are 0-indexed into CLASS_CODE
+        assert states.get_coverage(6)  # method = request["method"]
+        assert 7 in states.get_next_line(6)
+        assert "GET" in states.get_local(6, "method")
+        assert "GET" in states.get_subscript(6, "request", '"method"')
+        assert states.get_attr(6, "self", "name")[0] == "ada"
+        assert "GET" in states.interpret_var(6, "method")
+        assert "GET" in states.interpret_var(6, 'request["method"]')
+        assert "ada" in states.interpret_var(9, "self.name")
+
+    def test_interpret_var_shapes(self):
+        tcls = self._make_test_class()
+        obj = tcls()
+        _, states = Sandbox(obj.dreval_test).run()
+        assert states.interpret_var(6, "self.count") == [0]
+        assert states.interpret_var(7, "self.count") == [1]
+        assert states.interpret_var(6, "(method, self.count)") == [("GET", 0)]
+        assert states.interpret_var(99, "method") is Nil
+        assert states.interpret_var(6, "nonexistent") is Nil
+
+    def test_output_predictor_resolves_class_under_test(self):
+        from reval_tpu.dynamics import FunctionFactory
+
+        tcls = self._make_test_class()
+        generated = 'g = Greeter("ada")\nassertEqual(g.greet({"method": "GET"}), "hello ada")'
+        FunctionFactory.create_from_answer(generated, tcls)
+        obj = tcls()
+        obj.dreval_output_pred()  # must not raise: names resolve, assertion holds
+
+        bad = 'g = Greeter("ada")\nassertEqual(g.greet({"method": "GET"}), "WRONG")'
+        FunctionFactory.create_from_answer(bad, tcls)
+        obj = tcls()
+        with pytest.raises(AssertionError):
+            obj.dreval_output_pred()
+
+    def test_test_method_not_traced(self):
+        tcls = self._make_test_class()
+        obj = tcls()
+        _, states = Sandbox(obj.dreval_test).run()
+        # trace must only contain linenos that exist within CLASS_CODE body
+        assert max(states.trace) < len(CLASS_CODE.split("\n"))
+        # local 'g' lives in the (untraced) test frame, not the trace
+        assert states.get_local(4, "g") is Nil
+
+
+class TestExecutionTrace:
+    def test_merge_same_line_events(self):
+        tr = ExecutionTrace()
+        tr.record(3, "locals", {"x": 1}, "line3")
+        tr.record(3, "return", 7, "line3")
+        assert len(tr) == 1
+        assert tr.get_return(3) == 7
+        assert tr.get_local(3, "x") == [{"x": 1}["x"]]
+
+    def test_to_json(self):
+        tr = ExecutionTrace()
+        tr.record(0, "locals", {"s": {2, 1}}, "l0")
+        tr.record(1, "exception", ValueError, "l1")
+        docs = tr.to_json()
+        assert set(docs[0]["locals"]["s"]) == {1, 2}
+        assert docs[1]["exception"] == "ValueError"
