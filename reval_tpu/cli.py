@@ -1,0 +1,153 @@
+"""Command-line interface: config wizard + run dispatch.
+
+Capability parity with the reference CLI (evaluation.py:1065-1189):
+``config`` interactively builds a JSON run-config; ``run`` loads it and
+dispatches a task.  Differences by design: stdlib prompts instead of the
+``bullet`` dependency, TPU knobs (mesh shape, chip count) instead of
+``num_gpus``/``CUDA_VISIBLE_DEVICES``, and ``dataset``/``split`` are
+explicit config (SURVEY §2.10 fix).
+
+Usage:
+    python -m reval_tpu config [-o .eval_config]
+    python -m reval_tpu run    [-i .eval_config] [--mock]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["main", "run_with_config", "build_config_interactively"]
+
+DEFAULT_CONFIG = ".eval_config"
+
+TASK_CHOICES = ("coverage", "path", "state", "output", "consistency")
+PROMPT_CHOICES = ("direct", "cot")
+DATASET_CHOICES = ("humaneval", "classeval", "mbpp", "mathqa")
+BACKEND_CHOICES = ("tpu", "openai", "server", "replay", "mock")
+
+
+def _choose(prompt: str, choices: tuple[str, ...], default: str | None = None) -> str:
+    default = default or choices[0]
+    menu = ", ".join(choices)
+    while True:
+        raw = input(f"{prompt} [{menu}] (default {default}): ").strip()
+        if not raw:
+            return default
+        if raw in choices:
+            return raw
+        print(f"  invalid choice {raw!r}")
+
+
+def _ask(prompt: str, default, cast=str):
+    raw = input(f"{prompt} (default {default}): ").strip()
+    if not raw:
+        return default
+    return cast(raw)
+
+
+def build_config_interactively() -> dict:
+    cfg: dict = {}
+    cfg["task"] = _choose("Select a task", TASK_CHOICES)
+    cfg["prompt_type"] = _choose("Select prompt type", PROMPT_CHOICES)
+    cfg["dataset"] = _choose("Select dataset", DATASET_CHOICES)
+    backend = _choose("Select backend", BACKEND_CHOICES, default="tpu")
+    if backend == "openai":
+        cfg["model_id"] = _choose("Select a model", ("gpt-3.5", "gpt-4"))
+    else:
+        cfg["model_id"] = _ask("Enter model name", "deepseek-coder-1.3b")
+        if backend == "tpu":
+            cfg["model_path"] = _ask("Enter model path (HF checkpoint dir)", "")
+            cfg["num_chips"] = _ask("Number of TPU chips (tensor-parallel)", 1, int)
+            cfg["dp_size"] = _ask("Data-parallel degree", 1, int)
+        elif backend == "server":
+            cfg["port"] = _ask("Enter port number", 3000, int)
+        elif backend == "replay":
+            cfg["replay_task"] = cfg["task"]
+    cfg["backend"] = backend
+    cfg["temp"] = _ask("Set temperature", 0.8, float)
+    return cfg
+
+
+def write_config(path: str = DEFAULT_CONFIG) -> None:
+    cfg = build_config_interactively()
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    print(f"Configuration saved to {path}")
+
+
+def run_with_config(load_path: str = DEFAULT_CONFIG, mock: bool = False,
+                    overrides: dict | None = None) -> dict | float:
+    """Load a config file and execute the selected task.  Returns the
+    metrics dict (tasks) or score (consistency)."""
+    if not os.path.exists(load_path):
+        print(f"Error: {load_path} not found — run `python -m reval_tpu config` first")
+        sys.exit(1)
+    with open(load_path) as f:
+        cfg = json.load(f)
+    cfg.update(overrides or {})
+    return run_config(cfg, mock=mock)
+
+
+def run_config(cfg: dict, mock: bool = False) -> dict | float:
+    from .inference import create_backend
+    from .tasks import TASKS, ConsistencyScorer
+
+    print(f"The arguments for this run: {cfg}")
+    task_name = cfg["task"]
+    if task_name == "consistency":
+        from .inference.base import model_info_from_config
+
+        if mock:
+            cfg = {**cfg, "custom_mock": True}
+        model_info = model_info_from_config(cfg)
+        scorer = ConsistencyScorer(model_info, cfg["dataset"],
+                                   results_dir=cfg.get("results_dir", "model_generations"))
+        return scorer.run()
+
+    if mock or cfg.get("custom_mock"):
+        backend = None
+        cfg["custom_mock"] = True
+    else:
+        backend = create_backend(**{k: v for k, v in cfg.items() if k != "task"},
+                                 mock=cfg.get("backend") == "mock")
+    task_cls = TASKS[task_name]
+    task = task_cls(model=backend,
+                    **{k: v for k, v in cfg.items() if k not in ("task", "model_id", "backend")})
+    try:
+        return task.run()
+    finally:
+        if backend is not None:
+            backend.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="reval_tpu",
+                                     description="Run DREval tasks with TPU-native inference")
+    parser.add_argument("command", nargs="?", default="run", choices=["config", "run"])
+    parser.add_argument("-i", "--input", default=DEFAULT_CONFIG, help="config file to load")
+    parser.add_argument("-o", "--output", default=DEFAULT_CONFIG, help="config file to save")
+    parser.add_argument("--mock", action="store_true", help="run without any model")
+    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                        help="override a config key (repeatable; JSON values accepted)")
+    args = parser.parse_args(argv)
+
+    if args.command == "config":
+        write_config(args.output)
+        return 0
+
+    overrides = {}
+    for item in args.set:
+        key, _, value = item.partition("=")
+        try:
+            overrides[key] = json.loads(value)
+        except json.JSONDecodeError:
+            overrides[key] = value
+    run_with_config(args.input, mock=args.mock, overrides=overrides)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Inference backends: TPU engine, OpenAI, HTTP client, replay, mock."""
+
+from .base import InferenceBackend, GenerationConfig, create_backend, STOP_STRING
+from .mock import MockBackend, ScriptedBackend
+from .replay import ReplayBackend
+
+__all__ = [
+    "InferenceBackend",
+    "GenerationConfig",
+    "MockBackend",
+    "ReplayBackend",
+    "ScriptedBackend",
+    "STOP_STRING",
+    "create_backend",
+]

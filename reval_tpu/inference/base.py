@@ -1,0 +1,119 @@
+"""Inference backend abstraction.
+
+A backend turns prompts into completions.  Beyond the reference's
+single-prompt ``infer`` (inference.py:31), backends here expose
+``infer_many`` so the task engine can hand the TPU engine whole batches —
+the serial one-prompt-at-a-time harness is what throttles accelerators
+(SURVEY §7 hard part 5).  Backends that are inherently serial (replay,
+HTTP) just loop.
+
+Dispatch (``create_backend``) mirrors the reference factory
+(inference.py:34-44) with the vLLM arms replaced by the in-tree TPU engine:
+``replay_task`` → replay; ``'gpt' in model_id`` → OpenAI; ``port`` → HTTP
+client; otherwise → TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+__all__ = ["InferenceBackend", "GenerationConfig", "create_backend"]
+
+# Generation budget per prompt style (reference inference.py:25).
+MAX_NEW_TOKENS = {"direct": 256, "cot": 1024}
+
+# The universal stop sequence (reference inference.py:65,97,123).
+STOP_STRING = "[/ANSWER]"
+
+# Short OpenAI aliases → full model ids (reference inference.py:49-52).
+OPENAI_FULL_IDS = {"gpt-3.5": "gpt-3.5-turbo-0125", "gpt-4": "gpt-4-turbo-preview"}
+
+
+def model_info_from_config(cfg: dict) -> str:
+    """The results-directory identity a run with this config would write to.
+
+    Must stay in lockstep with :attr:`InferenceBackend.info` and the mock
+    naming in ``TaskRunner`` — consistency/replay lookups depend on it.
+    """
+    if cfg.get("mock") or cfg.get("custom_mock") or cfg.get("backend") == "mock":
+        return f"mock_model_{cfg.get('prompt_type', 'direct')}"
+    model_id = OPENAI_FULL_IDS.get(cfg["model_id"], cfg["model_id"])
+    return f"{model_id}_{cfg.get('prompt_type', 'direct')}_temp{float(cfg.get('temp', 0.8))}"
+
+
+class GenerationConfig:
+    """Sampling/stopping knobs shared by all backends."""
+
+    def __init__(self, temp: float = 0.8, prompt_type: str = "direct", max_new_tokens: int | None = None):
+        self.temp = float(temp)
+        self.prompt_type = prompt_type
+        self.max_new_tokens = max_new_tokens or MAX_NEW_TOKENS.get(prompt_type, 256)
+        self.stop = [STOP_STRING]
+
+
+class InferenceBackend:
+    """Base class: identity + generation config + the infer API."""
+
+    def __init__(self, model_id: str, temp: float = 0.8, prompt_type: str = "direct",
+                 max_new_tokens: int | None = None, **_ignored):
+        self.model_id = model_id
+        self.config = GenerationConfig(temp, prompt_type, max_new_tokens)
+
+    @property
+    def temp(self) -> float:
+        return self.config.temp
+
+    @property
+    def prompt_type(self) -> str:
+        return self.config.prompt_type
+
+    @property
+    def info(self) -> str:
+        """Results-directory identity (reference inference.py:27-29)."""
+        return f"{self.model_id}_{self.prompt_type}_temp{self.temp}"
+
+    # -- generation -------------------------------------------------------
+    def infer(self, prompt: str) -> str:
+        return self.infer_many([prompt])[0]
+
+    def infer_many(self, prompts: Sequence[str]) -> list[str]:
+        """Batched generation.  Default: serial loop over :meth:`infer_one`;
+        the TPU engine overrides this with true batched decode."""
+        return [self.infer_one(p) for p in prompts]
+
+    def infer_one(self, prompt: str) -> str:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release device/network resources (no-op by default)."""
+
+
+def create_backend(**kwargs) -> InferenceBackend:
+    """Build a backend from config kwargs (the run-config dict).
+
+    Recognised shapes, in priority order:
+    - ``replay_task=…``            → :class:`~reval_tpu.inference.replay.ReplayBackend`
+    - ``mock=True``/``custom_mock``→ :class:`~reval_tpu.inference.mock.MockBackend`
+    - ``model_id`` contains 'gpt'  → :class:`~reval_tpu.inference.openai_backend.OpenAIBackend`
+    - ``port=…``                   → :class:`~reval_tpu.inference.client.HTTPClientBackend`
+    - otherwise                    → :class:`~reval_tpu.inference.tpu.TPUBackend`
+    """
+    if kwargs.get("replay_task"):
+        from .replay import ReplayBackend
+
+        return ReplayBackend(**kwargs)
+    if kwargs.get("mock") or kwargs.get("custom_mock"):
+        from .mock import MockBackend
+
+        return MockBackend(**kwargs)
+    if "gpt" in kwargs.get("model_id", ""):
+        from .openai_backend import OpenAIBackend
+
+        return OpenAIBackend(**kwargs)
+    if kwargs.get("port"):
+        from .client import HTTPClientBackend
+
+        return HTTPClientBackend(**kwargs)
+    from .tpu import TPUBackend
+
+    return TPUBackend(**kwargs)

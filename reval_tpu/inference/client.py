@@ -1,0 +1,69 @@
+"""HTTP client backend: OpenAI-compatible /v1/completions against a local
+server (reference inference.py:106-131's vLLM-server client, rebuilt on
+stdlib urllib so no SDK is required).
+
+Pairs with ``reval_tpu.serving.server``, which serves the in-process TPU
+engine over the same protocol — the split exists so one resident sharded
+model can serve many sequential task runs (reference start_server.sh
+topology, SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from .base import InferenceBackend
+
+__all__ = ["HTTPClientBackend"]
+
+
+class HTTPClientBackend(InferenceBackend):
+    def __init__(self, model_id: str, port: int = 3000, host: str = "localhost",
+                 mock: bool = False, temp: float = 0.8, prompt_type: str = "direct", **kwargs):
+        super().__init__(model_id, temp=temp, prompt_type=prompt_type)
+        self.base_url = f"http://{host}:{port}/v1"
+        self._server_model = model_id
+        if not mock:
+            models = self._get("/models")
+            self._server_model = models["data"][0]["id"]
+            print(f"user-side model_id: {model_id}, server-side model_id: {self._server_model}")
+
+    def _get(self, route: str) -> dict:
+        with urllib.request.urlopen(self.base_url + route, timeout=30) as resp:
+            return json.load(resp)
+
+    def _post(self, route: str, payload: dict, timeout: float = 600) -> dict:
+        req = urllib.request.Request(
+            self.base_url + route,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.load(resp)
+
+    def infer_one(self, prompt: str) -> str:
+        out = self._post("/completions", {
+            "model": self._server_model,
+            "prompt": prompt,
+            "temperature": self.temp,
+            "stop": self.config.stop,
+            "max_tokens": self.config.max_new_tokens,
+        })
+        return out["choices"][0]["text"]
+
+    def infer_many(self, prompts) -> list[str]:
+        """The server accepts list prompts (OpenAI protocol) so whole
+        batches ride one request and the engine schedules them together."""
+        if not prompts:
+            return []
+        out = self._post("/completions", {
+            "model": self._server_model,
+            "prompt": list(prompts),
+            "temperature": self.temp,
+            "stop": self.config.stop,
+            "max_tokens": self.config.max_new_tokens,
+        })
+        choices = sorted(out["choices"], key=lambda c: c.get("index", 0))
+        return [c["text"] for c in choices]

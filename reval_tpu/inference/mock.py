@@ -1,0 +1,51 @@
+"""Mock + scripted backends: model-free runs of the whole eval loop.
+
+``MockBackend`` answers every prompt with a fixed string (the reference's
+``--mock`` flag, evaluation.py:45-47); ``ScriptedBackend`` serves a given
+response list in order — the unit-test workhorse for scoring logic.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .base import InferenceBackend
+
+__all__ = ["MockBackend", "ScriptedBackend"]
+
+
+class MockBackend(InferenceBackend):
+    def __init__(self, model_id: str = "mock_model", response: str = "mock_model_gen", **kwargs):
+        kwargs.setdefault("prompt_type", "direct")
+        super().__init__(model_id, **{k: v for k, v in kwargs.items() if k in ("temp", "prompt_type", "max_new_tokens")})
+        self.response = response
+        self.calls = 0
+
+    @property
+    def info(self) -> str:
+        # Mock runs are stored under a model-independent name
+        # (reference evaluation.py:125-126).
+        return f"mock_model_{self.prompt_type}"
+
+    def infer_one(self, prompt: str) -> str:
+        self.calls += 1
+        return self.response
+
+
+class ScriptedBackend(InferenceBackend):
+    """Serves ``responses`` in order; 'EOF' when exhausted."""
+
+    def __init__(self, responses: Sequence[str], model_id: str = "scripted", **kwargs):
+        kwargs.setdefault("prompt_type", "direct")
+        super().__init__(model_id, **{k: v for k, v in kwargs.items() if k in ("temp", "prompt_type", "max_new_tokens")})
+        self.responses = list(responses)
+        self.ptr = 0
+        self.prompts_seen: list[str] = []
+
+    def infer_one(self, prompt: str) -> str:
+        self.prompts_seen.append(prompt)
+        if self.ptr >= len(self.responses):
+            return "EOF"
+        resp = self.responses[self.ptr]
+        self.ptr += 1
+        return resp

@@ -1,0 +1,70 @@
+"""OpenAI chat-completions backend (reference inference.py:46-73).
+
+Optional dependency: ``openai`` (and ``backoff`` if present for rate-limit
+retry; otherwise a small built-in exponential backoff is used).  Reads
+``OPENAI_API_KEY`` / ``OPENAI_BASE_URL`` from the environment, honouring a
+``.env`` file when python-dotenv is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+from .base import InferenceBackend, OPENAI_FULL_IDS as _FULL_IDS
+
+__all__ = ["OpenAIBackend"]
+
+SYSTEM_PROMPT = (
+    "You are an expert at Python programming, code execution, test case generation, and fuzzing."
+)
+
+
+class OpenAIBackend(InferenceBackend):
+    def __init__(self, model_id: str = "gpt-3.5", temp: float = 0.8, prompt_type: str = "direct", **kwargs):
+        assert model_id in _FULL_IDS, f"use a valid OpenAI model id: {sorted(_FULL_IDS)}"
+        super().__init__(_FULL_IDS[model_id], temp=temp, prompt_type=prompt_type)
+        if os.path.exists(".env"):
+            try:
+                from dotenv import load_dotenv
+
+                load_dotenv(".env", override=True)
+            except ImportError:
+                pass
+        from openai import OpenAI  # optional dep; error here is actionable
+
+        self._client = OpenAI(
+            api_key=os.environ["OPENAI_API_KEY"],
+            base_url=os.environ.get("OPENAI_BASE_URL"),
+        )
+
+    def infer_one(self, prompt: str) -> str:
+        from openai import RateLimitError
+
+        delay = 1.0
+        while True:
+            try:
+                return self._request(prompt)
+            except RateLimitError:
+                time.sleep(delay + random.random())
+                delay = min(delay * 2, 60.0)
+
+    def _request(self, prompt: str) -> str:
+        stream = self._client.chat.completions.create(
+            model=self.model_id,
+            messages=[
+                {"role": "system", "content": SYSTEM_PROMPT},
+                {"role": "user", "content": prompt},
+            ],
+            stream=True,
+            temperature=self.temp,
+            stop=self.config.stop,
+            max_tokens=self.config.max_new_tokens,
+        )
+        chunks = []
+        for chunk in stream:
+            content = chunk.choices[0].delta.content
+            if content is not None:
+                chunks.append(content)
+        return "".join(chunks)

@@ -1,0 +1,56 @@
+"""Replay backend: re-serve generations from a prior run's results log.
+
+Deterministic re-scoring without any model — the framework's regression
+oracle (reference inference.py:133-168).  Reads the results-store JSONL
+(last row is the metrics trailer and is skipped), flattens every
+``generation[*].results[*].generated`` string in order, and serves them
+one per ``infer`` call; ``'EOF'`` when exhausted.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from .base import InferenceBackend, OPENAI_FULL_IDS
+
+__all__ = ["ReplayBackend"]
+
+
+class ReplayBackend(InferenceBackend):
+    def __init__(self, replay_task: str, model_id: str, temp: float = 0.8,
+                 prompt_type: str = "direct", replay_time: str | None = None,
+                 results_dir: str = "model_generations", **kwargs):
+        model_id = OPENAI_FULL_IDS.get(model_id, model_id)
+        super().__init__(model_id, temp=temp, prompt_type=prompt_type)
+        base = os.path.join(results_dir, f"{replay_task}@{self.info}")
+        # Fallback: reference logs use unsanitised model ids with '/' in the
+        # directory name; our writer sanitises.  Accept both.
+        candidates = [base, os.path.join(results_dir, f"{replay_task}@{self.info}".replace("/", "_"))]
+        path = next((c for c in candidates if glob.glob(f"{c}/*.jsonl")), None)
+        if path is None:
+            raise FileNotFoundError(f"no replay logs under {candidates}")
+        if replay_time is None:
+            file = max(glob.glob(f"{path}/*.jsonl"), key=os.path.getctime)
+        else:
+            matches = glob.glob(f"{path}/{replay_time}.*jsonl") + [f"{path}/{replay_time}.jsonl"]
+            file = next((f for f in matches if os.path.exists(f)), None)
+            if file is None:
+                raise FileNotFoundError(f"no replay log for timestamp {replay_time!r} under {path}")
+        self.source_file = file
+        self.generations: list[str] = []
+        with open(file) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        for row in rows[:-1]:  # last row is the metrics trailer
+            for gen in row.get("generation", []):
+                for rec in gen.get("results", []):
+                    self.generations.append(rec.get("generated", ""))
+        self.ptr = 0
+
+    def infer_one(self, prompt: str) -> str:
+        if self.ptr >= len(self.generations):
+            return "EOF"
+        resp = self.generations[self.ptr]
+        self.ptr += 1
+        return resp

@@ -1,0 +1,11 @@
+"""The in-tree TPU inference engine (JAX/XLA/Pallas).
+
+Replaces the reference's vLLM/CUDA arms (inference.py:75-131) with
+in-process generation: HF safetensors checkpoints loaded into pjit-sharded
+JAX pytrees, jitted prefill + decode with an on-device KV cache, and
+batched scheduling of whole prompt sets.
+"""
+
+from .backend import TPUBackend
+
+__all__ = ["TPUBackend"]

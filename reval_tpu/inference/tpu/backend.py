@@ -1,0 +1,44 @@
+"""TPUBackend: the InferenceBackend facade over the JAX engine.
+
+Construction wires tokenizer + model + engine; ``infer_many`` feeds the
+whole prompt set through batched generation.
+"""
+
+from __future__ import annotations
+
+from ..base import InferenceBackend
+
+__all__ = ["TPUBackend"]
+
+
+class TPUBackend(InferenceBackend):
+    def __init__(self, model_id: str, model_path: str | None = None, temp: float = 0.8,
+                 prompt_type: str = "direct", dtype: str = "bfloat16",
+                 num_chips: int = 1, dp_size: int = 1, batch_size: int = 8,
+                 max_seq_len: int = 8192, **kwargs):
+        super().__init__(model_id, temp=temp, prompt_type=prompt_type)
+        if not model_path:
+            raise ValueError(
+                "TPU backend needs model_path (a HuggingFace checkpoint directory "
+                "containing config.json + *.safetensors)"
+            )
+        from .engine import TPUEngine
+
+        self.engine = TPUEngine.from_pretrained(
+            model_path, dtype=dtype, tp_size=num_chips, dp_size=dp_size,
+            batch_size=batch_size, max_seq_len=max_seq_len,
+        )
+
+    def infer_one(self, prompt: str) -> str:
+        return self.infer_many([prompt])[0]
+
+    def infer_many(self, prompts) -> list[str]:
+        return self.engine.generate(
+            list(prompts),
+            max_new_tokens=self.config.max_new_tokens,
+            temperature=self.temp,
+            stop=self.config.stop,
+        )
+
+    def close(self) -> None:
+        self.engine = None

@@ -1,0 +1,29 @@
+"""The DREval task suite: coverage, path, state, output + consistency."""
+
+from .base import ProbeJob, ProbeTask, TaskRunner
+from .consistency import ConsistencyScorer
+from .coverage import CoverageTask
+from .output import OutputTask
+from .path import PathTask
+from .results import ResultsStore
+from .state import StateTask
+
+TASKS = {
+    "coverage": CoverageTask,
+    "path": PathTask,
+    "state": StateTask,
+    "output": OutputTask,
+}
+
+__all__ = [
+    "TASKS",
+    "ConsistencyScorer",
+    "CoverageTask",
+    "OutputTask",
+    "PathTask",
+    "ProbeJob",
+    "ProbeTask",
+    "ResultsStore",
+    "StateTask",
+    "TaskRunner",
+]

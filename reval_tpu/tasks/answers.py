@@ -1,0 +1,249 @@
+"""Answer post-processing: model text → task-typed answers, and equality.
+
+Pure functions, golden-tested in tests/test_answers.py.  Semantics match
+the reference postprocessors branch-for-branch (evaluation.py:263-290
+coverage, 434-453 path, 684-770 state, 940-968 output, 645-682 state
+equality) — these rules directly determine reported accuracies, so they are
+part of the benchmark definition, not incidental code.
+"""
+
+from __future__ import annotations
+
+import re
+from pydoc import locate
+
+import numpy as np
+
+from ..dynamics import Nil
+
+__all__ = [
+    "strip_answer_tags",
+    "parse_coverage_answer",
+    "parse_path_answer",
+    "path_answer_to_lines",
+    "parse_state_answer",
+    "state_answers_equal",
+    "parse_output_answer",
+    "pad_output_answer",
+    "output_penalty",
+]
+
+COT_CLOSE = "[/THOUGHT]"
+
+
+def strip_answer_tags(resp: str) -> str:
+    """Cut the text between ``[ANSWER]`` and ``[/ANSWER]`` (tolerating a
+    truncated closing tag, which local models emit when stop sequences
+    misfire)."""
+    idx = resp.find("[ANSWER]")
+    if idx != -1:
+        resp = resp[idx + len("[ANSWER]"):].strip()
+    idx = resp.find("[/ANSWER]")
+    if idx != -1:
+        resp = resp[:idx].strip()
+    idx = resp.find("[/ANSWER")
+    if idx != -1:
+        resp = resp[:idx].strip()
+    return resp
+
+
+def _cot_incomplete(resp: str, prompt_type: str) -> bool:
+    """CoT generations that never closed their [THOUGHT] ran out of budget;
+    they are scored as failures with task-specific sentinels."""
+    return prompt_type == "cot" and COT_CLOSE not in resp
+
+
+# -- coverage -------------------------------------------------------------
+def parse_coverage_answer(resp: str, prompt_type: str = "direct") -> bool:
+    """YES/NO from the first 3 characters of the stripped answer; anything
+    empty or ambiguous scores NO."""
+    ans = resp.upper().strip()
+    if _cot_incomplete(ans, prompt_type):
+        return False
+    ans = strip_answer_tags(ans)
+    if ans == "":
+        return False
+    head = ans[:3]
+    has_yes = "YES" in head
+    has_no = "NO" in head
+    if has_yes == has_no:  # both or neither → ambiguous
+        return False
+    return has_yes
+
+
+# -- path -----------------------------------------------------------------
+def parse_path_answer(resp: str, prompt_type: str = "direct") -> int | str:
+    """First line of the stripped answer: ``-1`` (trace ends), an int -2
+    sentinel for empty/incomplete, or the raw code-line string."""
+    if _cot_incomplete(resp, prompt_type):
+        return -2
+    ans = strip_answer_tags(resp)
+    ans = ans.split("\n")[0].strip()
+    if ans == "":
+        return -2
+    if ans == "-1":
+        return -1
+    return ans
+
+
+def path_answer_to_lines(ans: int | str, codelines: list[str]) -> list[int]:
+    """Map a parsed path answer onto 1-indexed line numbers.
+
+    A code-line string maps to *every* source line whose stripped text
+    matches; no match → ``[-2]`` (never correct)."""
+    if isinstance(ans, int):
+        return [ans]
+    matches = [i + 1 for i, line in enumerate(codelines) if ans == line.strip()]
+    return matches if matches else [-2]
+
+
+# -- state ----------------------------------------------------------------
+_UNICODE_QUOTES = {"‘": "'", "’": "'", "“": '"', "”": '"'}
+
+
+def _is_builtin_type(cls) -> bool:
+    return cls is not None and isinstance(cls, type) and cls.__module__ == "builtins"
+
+
+def parse_state_answer(resp: str, prompt_type: str = "direct"):
+    """Parse ``value; type`` into a concrete ``(value, type)`` pair.
+
+    Applies the benchmark's repair chain: unicode quotes, ``<class '…'>``
+    unwrapping, generics stripping, common type-name aliases, str/datetime/
+    ndarray special cases, then ``pydoc.locate`` with eval-vs-constructor
+    fallback.  Returns ``Nil`` when the model says Nil, ``'ERROR'`` when
+    unparseable.
+    """
+    if _cot_incomplete(resp, prompt_type):
+        return "ERROR"
+    for u, a in _UNICODE_QUOTES.items():
+        resp = resp.replace(u, a)
+    resp = strip_answer_tags(resp.strip())
+    if resp.capitalize() == "Nil" or resp == "[Nil]":
+        return Nil
+    semicolon = resp.rfind(";")
+    if semicolon == -1:
+        return "ERROR"
+    value_text = resp[:semicolon].strip()
+    type_text = resp[semicolon + 1:].strip().lower()
+    if value_text.capitalize() == "Nil":
+        return Nil
+
+    m = re.match(r"<class '(.*)'>", type_text)
+    if m:
+        type_text = m.group(1)
+    m = re.match(r"(.*)\[.*\]", type_text)
+    if m:
+        type_text = m.group(1)
+    if type_text == "string":
+        type_text = "str"
+    if type_text == "integer":
+        type_text = "int"
+    if "," in type_text or "tuple" in type_text:
+        type_text = "tuple"
+
+    if type_text == "str":
+        try:
+            return eval(value_text), str  # noqa: S307 — benchmark-defined parsing
+        except Exception:
+            return value_text, str
+    if type_text in ("datetime.datetime", "datetime"):
+        from dateutil.parser import parse as parse_dt
+
+        try:
+            return parse_dt(value_text), locate(type_text)
+        except Exception:
+            return "ERROR"
+    if type_text in ("numpy.ndarray", "np.ndarray"):
+        try:
+            return np.array(eval(value_text)), locate(type_text)  # noqa: S307
+        except Exception:
+            return "ERROR"
+    if value_text == "None" or type_text == "NoneType":
+        return None, type(None)
+    try:
+        _type = locate(type_text)
+        if _is_builtin_type(_type):
+            _val = eval(value_text)  # noqa: S307
+        else:
+            try:
+                _val = _type(eval(value_text))  # noqa: S307
+            except Exception:
+                _val = _type(value_text)
+        return _val, _type
+    except Exception:
+        return "ERROR"
+
+
+def state_answers_equal(ans, actual) -> bool:
+    """Type-aware equality between a parsed (value, type) answer and the
+    list of ground-truth candidate values (float ε=1e-6; np.allclose for
+    arrays; membership otherwise)."""
+    if ans is Nil and actual is Nil:
+        return True
+    if ans is Nil or actual is Nil:
+        return False
+    ans_val, ans_type = ans
+    if all(ans_type != type(a) for a in actual):
+        return False
+    if type(ans_val) != ans_type:
+        return False
+    if ans_type == float:
+        for a in actual:
+            try:
+                if abs(ans_val - a) < 1e-6:
+                    return True
+            except Exception:
+                continue
+        return False
+    try:
+        return ans_val in actual
+    except ValueError:
+        # numpy arrays make `in` ambiguous; compare elementwise
+        for a in actual:
+            try:
+                if isinstance(ans_val, np.ndarray) and isinstance(a, np.ndarray):
+                    if np.allclose(ans_val, a):
+                        return True
+                elif ans_val == a:
+                    return True
+            except Exception:
+                continue
+        return False
+
+
+# -- output ---------------------------------------------------------------
+def parse_output_answer(resp: str, prompt_type: str = "direct") -> str:
+    if _cot_incomplete(resp, prompt_type):
+        return "ERROR"
+    return strip_answer_tags(resp)
+
+
+def pad_output_answer(ans: str, given_input: str) -> str:
+    """Ensure the answer has at least as many lines as the given test code,
+    padding missing leading lines from the input (models often echo only
+    the lines they changed)."""
+    if ans == "ERROR":
+        return "assert False"
+    in_lines = given_input.strip().split("\n")
+    res_lines = ans.strip().split("\n")
+    if len(res_lines) >= len(in_lines):
+        return ans
+    diff = len(in_lines) - len(res_lines)
+    return "\n".join(in_lines[:diff] + res_lines)
+
+
+def output_penalty(code: str, given_input: str) -> bool:
+    """Anti-cheat: trivial self-satisfying asserts or fewer asserts than the
+    question asked for mark the answer failed outright."""
+    trivial = (
+        "assertTrue(True)" in code
+        or "assertFalse(False)" in code
+        or "assert True" in code
+        or "assert False" in code
+    )
+    if trivial:
+        return True
+    given = given_input.count("assert")
+    assert given > 0, "output task input must contain an assert"
+    return code.count("assert") < given

@@ -1,0 +1,305 @@
+"""The task engine: plan → batched inference → score.
+
+The reference interleaves prompting with scoring, one model call per probe
+(evaluation.py:105-107) — which serialises the accelerator.  This engine
+splits a run into three phases:
+
+1. **plan**: walk the benchmark rows for the chosen dataset family, run the
+   ground-truth sandboxes, precompute expected answers, and emit one
+   :class:`ProbeJob` per model call (prompt + scoring context);
+2. **infer**: hand *all* prompts to the backend's ``infer_many`` — the TPU
+   engine batches/schedules them freely;
+3. **score**: post-process responses in plan order, accumulate metrics, and
+   assemble records byte-compatible with the reference results schema.
+
+Family branching (HumanEval/MBPP/MathQA functions vs ClassEval classes)
+mirrors evaluation.py:135-218 with the §2.10 bugs fixed: kwargs plumb
+through every task, no double-appended path records, MathQA list-typed
+inputs handled, and split selection is explicit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..datasets import DREvalDataset, Families, family_of
+from ..datasets.dreval import ClassEvalHooks
+from ..dynamics import CodeSpace, Sandbox
+from ..prompting import build_prompt
+from .results import ResultsStore
+
+__all__ = ["TaskRunner", "ProbeTask", "ProbeJob"]
+
+VALID_PROMPT_TYPES = ("direct", "cot", "tot")
+
+
+@dataclass
+class ProbeJob:
+    """One model call: its prompt plus everything scoring needs."""
+
+    record: dict            # the {'task_id', 'generation'} row this feeds
+    gen_entry: dict         # the {'input_idx', 'results'} entry within it
+    prompt: str
+    expected: Any = None    # precomputed ground truth (task-specific shape)
+    lineno: int | None = None   # 1-indexed probe line
+    var: str | None = None
+    context: dict = field(default_factory=dict)
+
+
+class TaskRunner:
+    """Base engine; concrete tasks fill in planning/scoring hooks."""
+
+    name: str = ""
+
+    def __init__(self, model=None, prompt_type: str = "direct", dataset: str = None,
+                 split: str | None = None, mock: bool = False, custom_mock: bool = False,
+                 results_dir: str = "model_generations", valid_test_cases_path: str | None = None,
+                 sandbox_timeout: float = 120.0, progress: bool = True,
+                 max_items: int | None = None, **kwargs):
+        assert prompt_type in VALID_PROMPT_TYPES, f"prompt_type must be one of {VALID_PROMPT_TYPES}"
+        self.backend = model
+        self.prompt_type = prompt_type
+        self.mock = bool(mock or custom_mock)
+        if self.mock and self.backend is None:
+            from ..inference.mock import MockBackend
+
+            self.backend = MockBackend(prompt_type=prompt_type)
+        self.kwargs = kwargs
+        assert dataset is not None, "dataset is required (humaneval|classeval|mbpp|mathqa)"
+        self.dataset = dataset
+        if not self.mock and self.backend is not None and prompt_type != "tot":
+            assert self.backend.prompt_type == prompt_type, \
+                "backend prompt type must match task prompt type"
+        self.data = DREvalDataset.load(dataset, split)
+        self.sandbox_timeout = sandbox_timeout
+        self.progress = progress
+        self.max_items = max_items  # smoke runs: only the first N benchmark rows
+        self._no_skip: set[tuple] | None = None
+        if valid_test_cases_path:
+            import json
+
+            with open(valid_test_cases_path) as f:
+                self._no_skip = {tuple(t) for t in json.load(f)}
+        model_info = "mock_model_" + prompt_type if self.mock else self.backend.info
+        self.store = ResultsStore(self.name, model_info, results_dir)
+        self.metrics_trailer: dict = {}
+
+    # ---- per-task hooks (implemented by subclasses) ----------------------
+    def plan_function_pair(self, *, idx, fam, pair, space, entry, code, codelines,
+                           sandbox, invocation, task_idx, gen_entry, jobs):
+        raise NotImplementedError
+
+    def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
+                        setup, gen_entry, jobs):
+        raise NotImplementedError
+
+    def score_job(self, job: ProbeJob, response: str) -> dict:
+        """Post-process one response, update metrics, return the record."""
+        raise NotImplementedError
+
+    @property
+    def metrics(self) -> dict:
+        raise NotImplementedError
+
+    # ---- skip-list support (tot-validated test cases) --------------------
+    def _skipped(self, key: tuple) -> bool:
+        return self._no_skip is not None and key not in self._no_skip
+
+    # ---- planning --------------------------------------------------------
+    @staticmethod
+    def _family_task_idx(idx: int, fam: str) -> int | None:
+        """The per-family task index used in skip-list tuples: MBPP's test
+        split starts at upstream id 11 (evaluation.py:179); MathQA is
+        0-based; HumanEval/ClassEval don't use skip lists."""
+        if fam == "mbpp":
+            return (idx - Families.MBPP_START) + Families.MBPP_TASK_ID_OFFSET
+        if fam == "mathqa":
+            return idx - Families.MATHQA_START
+        return None
+
+    def _resolve_args(self, space: CodeSpace, _input):
+        """Benchmark inputs are arg-tuple reprs for HumanEval/MBPP but JSON
+        lists for MathQA; both become positional args."""
+        if isinstance(_input, (list, tuple)):
+            return tuple(_input)
+        return space.eval_invocation(_input)
+
+    def _plan(self) -> tuple[list[dict], list[ProbeJob]]:
+        records: list[dict] = []
+        jobs: list[ProbeJob] = []
+        rows = list(self.data.iter_tasks(self.dataset))
+        if self.max_items is not None:
+            rows = rows[: self.max_items]
+        for n, row in enumerate(rows):
+            idx = int(row["idx"])
+            record = {"task_id": f"DREval/{idx}", "generation": []}
+            records.append(record)
+            fam = family_of(idx)
+            if fam == "classeval":
+                self._plan_class_item(idx, row["tasks"], record, jobs)
+            else:
+                self._plan_function_item(idx, fam, row["tasks"], record, jobs)
+            if self.progress and (n + 1) % 25 == 0:
+                print(f"[{self.name}] planned {n + 1}/{len(rows)} items, {len(jobs)} prompts")
+        return records, jobs
+
+    def _plan_function_item(self, idx: int, fam: str, pairs: list, record: dict, jobs: list):
+        code = self.data.code(idx)
+        entry = self.data.entry_point(idx)
+        codelines = code.split("\n")
+        space = CodeSpace()
+        space.load_function(entry, code)
+        sandbox = Sandbox(space.ns[entry], timeout=self.sandbox_timeout)
+        inputs = self.data.inputs(idx)
+        invocations = self.data.invocations(idx) if fam in ("mbpp", "mathqa") else None
+        task_idx = self._family_task_idx(idx, fam)
+        for pair in pairs:
+            gen_entry = {"input_idx": pair["input_idx"], "results": []}
+            record["generation"].append(gen_entry)
+            _input = pair["output_pred"] if self.name == "output" else inputs[pair["input_idx"]]
+            if invocations is not None:
+                invocation = invocations[pair["input_idx"]].strip()
+            elif isinstance(_input, str) and self.name != "output":
+                # "(args,)" repr → "entry(args)" call syntax
+                invocation = f"{entry}{_input[:-2]})"
+            else:
+                invocation = f"{entry}(…)"
+            self.plan_function_pair(
+                idx=idx, fam=fam, pair=pair, space=space, entry=entry, code=code,
+                codelines=codelines, sandbox=sandbox, invocation=invocation,
+                task_idx=task_idx, gen_entry=gen_entry, jobs=jobs,
+            )
+
+    def _plan_class_item(self, idx: int, pairs: list, record: dict, jobs: list):
+        code = self.data.code(idx)
+        cls_name = self.data.entry_point(idx)
+        test_code = self.data.test_code(idx)
+        space = CodeSpace()
+        space.load_class(cls_name, code)
+        test_classes = space.load_test_classes(
+            cls_name, code, test_code,
+            ClassEvalHooks.name_pattern, ClassEvalHooks.validation, ClassEvalHooks.postprocess,
+        )
+        codelines = code.split("\n")
+        inputs = self.data.inputs(idx)
+        for pair in pairs:
+            gen_entry = {"input_idx": pair["input_idx"], "results": []}
+            record["generation"].append(gen_entry)
+            test_cls = test_classes[pair["input_idx"]]
+            _input = pair["output_pred"] if self.name == "output" else inputs[pair["input_idx"]]
+            setup = self._setup_comment(test_cls)
+            self.plan_class_pair(
+                idx=idx, pair=pair, test_cls=test_cls, code=code, codelines=codelines,
+                _input=_input, setup=setup, gen_entry=gen_entry, jobs=jobs,
+            )
+
+    @staticmethod
+    def _setup_comment(test_cls) -> str:
+        """Render the class's own setUp body as a commented preamble for
+        prompts (inherited unittest stubs contribute nothing)."""
+        setup_src = getattr(test_cls, "__setup__", None)
+        if not setup_src or "Hook method for setting up the test fixture" in setup_src:
+            return ""
+        body = setup_src.split("\n")[1:]
+        return "\n# setup code executed previously\n# " + "\n# ".join(body)
+
+    @staticmethod
+    def run_class_sandbox(test_cls, timeout: float):
+        """Instantiate, setUp, and trace the pair's dreval_test."""
+        obj = test_cls()
+        if hasattr(obj, "setUp"):
+            obj.setUp()
+        sandbox = Sandbox(obj.dreval_test, timeout=timeout)
+        _, states = sandbox.run()
+        assert sandbox.status == "ok", f"{sandbox.status} tracing {test_cls.__name__}.dreval_test"
+        return states
+
+    # ---- the run ---------------------------------------------------------
+    def run(self) -> dict:
+        records, jobs = self._plan()
+        prompts = [j.prompt for j in jobs]
+        if self.progress:
+            print(f"[{self.name}] {len(prompts)} prompts → backend {'(mock)' if self.mock else ''}")
+        responses = self.backend.infer_many(prompts) if jobs else []
+        assert len(responses) == len(jobs)
+        for job, resp in zip(jobs, responses):
+            job.gen_entry["results"].append(self.score_job(job, resp))
+        self.metrics_trailer = self.metrics
+        records.append(self.metrics_trailer)
+        path = self.store.write(records, self.dataset)
+        if self.progress:
+            print(f"[{self.name}] metrics: {self.metrics_trailer}")
+            print(f"[{self.name}] wrote {path}")
+        return self.metrics_trailer
+
+
+class ProbeTask(TaskRunner):
+    """Shared planning for per-line probe tasks (coverage, path, state)."""
+
+    uses_var = False          # state sets True (probes carry a variable)
+    numbered_code = False     # path sets True (prompt shows numbered lines)
+
+    # -- hooks for concrete probe tasks -----------------------------------
+    def ground_truth(self, states, lineno0: int, var: str | None):
+        raise NotImplementedError
+
+    def probe_record(self, job: ProbeJob, response: str):
+        raise NotImplementedError
+
+    def score_job(self, job: ProbeJob, response: str) -> dict:
+        return self.probe_record(job, response)
+
+    # -- planning ----------------------------------------------------------
+    def _prompt_code(self, code: str, codelines: list[str]) -> str:
+        if self.numbered_code:
+            return "".join(f"{i + 1}\t{line}\n" for i, line in enumerate(codelines))
+        return code
+
+    def _probe_key(self, task_idx, input_idx, probe) -> tuple:
+        if self.uses_var:
+            return (task_idx, input_idx, probe.get("var"), probe["lineno"])
+        return (task_idx, input_idx, probe["lineno"])
+
+    def plan_function_pair(self, *, idx, fam, pair, space, entry, code, codelines,
+                           sandbox, invocation, task_idx, gen_entry, jobs):
+        args = self._resolve_args(space, self.data.inputs(idx)[pair["input_idx"]])
+        _, states = sandbox.run(*args)
+        assert sandbox.status == "ok", f"{sandbox.status} running {entry} on DREval/{idx}"
+        for probe in pair["task"]:
+            if self._skipped(self._probe_key(task_idx, pair["input_idx"], probe)):
+                continue
+            self._append_probe_job(jobs, gen_entry,
+                                   record=None, states=states, probe=probe,
+                                   code=code, codelines=codelines,
+                                   invocation=invocation, invocation_abbr=invocation)
+
+    def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
+                        setup, gen_entry, jobs):
+        states = self.run_class_sandbox(test_cls, self.sandbox_timeout)
+        invocation = setup + "\n" + str(_input).rstrip()
+        for probe in pair["task"]:
+            self._append_probe_job(jobs, gen_entry,
+                                   record=None, states=states, probe=probe,
+                                   code=code, codelines=codelines,
+                                   invocation=invocation,
+                                   invocation_abbr="the above test code")
+
+    def _append_probe_job(self, jobs, gen_entry, *, record, states, probe, code,
+                          codelines, invocation, invocation_abbr):
+        lineno = probe["lineno"]
+        var = probe.get("var") if self.uses_var else None
+        expected = self.ground_truth(states, lineno - 1, var)
+        fields = dict(
+            code=self._prompt_code(code, codelines),
+            invocation=invocation,
+            invocation_abbr=invocation_abbr,
+            line=lineno,
+            codeline=codelines[lineno - 1],
+        )
+        if self.uses_var:
+            fields["var"] = var
+        prompt = build_prompt(self.name, self.prompt_type, **fields)
+        jobs.append(ProbeJob(record=record, gen_entry=gen_entry, prompt=prompt,
+                             expected=expected, lineno=lineno, var=var,
+                             context={"codelines": codelines}))

@@ -1,0 +1,78 @@
+"""Cross-task consistency score (reference evaluation.py:1014-1063).
+
+Aligns the latest coverage/state/path/output logs for one model and scores
+each aligned test case on the weighted ladder: all four correct → 1,
+coverage+state+path → 0.5, coverage+state → 0.25, coverage only → 0.125;
+reported as ``100 * score / total``.
+
+Output logs hold one record per input pair, so each verdict is expanded by
+that pair's probe count (taken from the coverage log) to align with the
+per-probe tasks.
+"""
+
+from __future__ import annotations
+
+from .results import ResultsStore
+
+__all__ = ["ConsistencyScorer"]
+
+LADDER = ("coverage", "state", "path", "output")
+
+
+class ConsistencyScorer:
+    def __init__(self, model_info: str, dataset: str,
+                 results_dir: str = "model_generations", progress: bool = True):
+        self.model_info = model_info
+        self.dataset = dataset
+        self.progress = progress
+        self.logs = {}
+        for task in LADDER:
+            store = ResultsStore(task, model_info, results_dir)
+            path = store.latest(dataset)
+            if progress:
+                print(f"[consistency] load {path}")
+            self.logs[task] = ResultsStore.read(path)
+
+    @staticmethod
+    def _flatten(rows: list[dict], rule) -> list[bool]:
+        verdicts = []
+        for row in rows[:-1]:  # last row is the metrics trailer
+            for gen in row["generation"]:
+                for atomic in gen["results"]:
+                    verdict = rule(atomic)
+                    assert isinstance(verdict, bool)
+                    verdicts.append(verdict)
+        return verdicts
+
+    def run(self) -> float:
+        coverage = self._flatten(self.logs["coverage"], lambda r: r["response"] == r["expected"])
+        state = self._flatten(self.logs["state"], lambda r: bool(r["eq"]))
+        path = self._flatten(self.logs["path"], lambda r: any(y in r["expected"] for y in r["response"]))
+        output: list[bool] = []
+        coverage_rows = self.logs["coverage"]
+        for i, row in enumerate(self.logs["output"][:-1]):
+            for j, gen in enumerate(row["generation"]):
+                verdict = bool(gen["results"][0]["pass"]) if gen["results"] else False
+                repeats = len(coverage_rows[i]["generation"][j]["results"])
+                output.extend([verdict] * repeats)
+        assert len(coverage) == len(state) == len(path) == len(output), (
+            f"task logs misaligned: cov={len(coverage)} state={len(state)} "
+            f"path={len(path)} out={len(output)}"
+        )
+        total = len(coverage)
+        score = 0.0
+        # Exclusive rungs (reference evaluation.py:1055-1062): partial credit
+        # only when every rung *above* is correct and every rung below wrong.
+        for c, s, p, o in zip(coverage, state, path, output):
+            if c and s and p and o:
+                score += 1
+            elif c and s and p and not o:
+                score += 0.5
+            elif c and s and not p and not o:
+                score += 0.25
+            elif c and not s and not p and not o:
+                score += 0.125
+        final = 100.0 * score / total if total else 0.0
+        if self.progress:
+            print(f"Consistency score: {final}")
+        return final

@@ -1,0 +1,57 @@
+"""Coverage task: "Is line L executed?" (reference evaluation.py:230-413)."""
+
+from __future__ import annotations
+
+from .answers import parse_coverage_answer
+from .base import ProbeJob, ProbeTask
+
+__all__ = ["CoverageTask"]
+
+
+class CoverageTask(ProbeTask):
+    name = "coverage"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.tp = self.tn = self.fp = self.fn = 0
+        self._total = 0
+
+    # -- metrics -----------------------------------------------------------
+    def _acc(self):
+        denom = self.tp + self.tn + self.fp + self.fn
+        return (self.tp + self.tn) / denom if denom else 0.0
+
+    def _prec(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def _rec(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def _f1(self):
+        p, r = self._prec(), self._rec()
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    @property
+    def metrics(self) -> dict:
+        return {"total": self._total, "acc": self._acc(), "prec": self._prec(),
+                "rec": self._rec(), "f1": self._f1()}
+
+    # -- ground truth + scoring -------------------------------------------
+    def ground_truth(self, states, lineno0: int, var):
+        return states.get_coverage(lineno0)
+
+    def probe_record(self, job: ProbeJob, response: str) -> dict:
+        ans = parse_coverage_answer(response, self.prompt_type)
+        actual = job.expected
+        self._total += 1
+        if ans and actual:
+            self.tp += 1
+        elif ans and not actual:
+            self.fp += 1
+        elif not ans and actual:
+            self.fn += 1
+        else:
+            self.tn += 1
+        return {"generated": response, "response": ans, "expected": actual}

@@ -1,0 +1,87 @@
+"""Output task: complete ``assert f(x) == ??`` (reference
+evaluation.py:908-1012).  One prompt per input pair (no per-line probes);
+the verdict is whether the completed assertion executes cleanly in the
+item's namespace, after the anti-cheat penalty screen.
+
+Divergence from the reference (documented): for MBPP/MathQA the reference
+filled the prompt's invocation slot with the call expression instead of the
+``?? `` assert (evaluation.py:187-194 + 973-974), producing prompts without
+a question; here the output prediction always goes in the prompt.
+"""
+
+from __future__ import annotations
+
+from ..prompting import build_prompt
+from .answers import output_penalty, pad_output_answer, parse_output_answer
+from .base import ProbeJob, TaskRunner
+
+__all__ = ["OutputTask"]
+
+CLASSEVAL_PRELUDE = "\n# Test code starts here. Only write the completed test code in your answer.\n"
+
+
+class OutputTask(TaskRunner):
+    name = "output"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._total = 0
+        self._pass = 0
+
+    @property
+    def metrics(self) -> dict:
+        return {"acc": self._pass / self._total if self._total else 0.0}
+
+    # -- planning ----------------------------------------------------------
+    def plan_function_pair(self, *, idx, fam, pair, space, entry, code, codelines,
+                           sandbox, invocation, task_idx, gen_entry, jobs):
+        _input = pair["output_pred"]
+        prompt = build_prompt("output", self.prompt_type, code=code, invocation="\n" + _input)
+        jobs.append(ProbeJob(record=None, gen_entry=gen_entry, prompt=prompt,
+                             context={"space": space, "_input": _input, "kind": "function"}))
+
+    def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
+                        setup, gen_entry, jobs):
+        prompt = build_prompt("output", self.prompt_type, code=test_cls.__doc__,
+                              invocation=setup + CLASSEVAL_PRELUDE + _input)
+        jobs.append(ProbeJob(record=None, gen_entry=gen_entry, prompt=prompt,
+                             context={"test_cls": test_cls, "_input": _input, "kind": "class"}))
+
+    # -- scoring -----------------------------------------------------------
+    def score_job(self, job: ProbeJob, response: str) -> dict:
+        ans = parse_output_answer(response, self.prompt_type)
+        ans = pad_output_answer(ans, job.context["_input"])
+        status = False
+        if not output_penalty(ans, job.context["_input"]):
+            if job.context["kind"] == "function":
+                status = self._exec_function_answer(job, ans)
+            else:
+                status = self._exec_class_answer(job, ans)
+        self._total += 1
+        if status:
+            self._pass += 1
+        return {"generated": response, "pass": status}
+
+    @staticmethod
+    def _exec_function_answer(job: ProbeJob, ans: str) -> bool:
+        try:
+            job.context["space"].exec_driver(ans)
+            return True
+        except Exception:
+            return False
+
+    @staticmethod
+    def _exec_class_answer(job: ProbeJob, ans: str) -> bool:
+        test_cls = job.context["test_cls"]
+        space = getattr(test_cls, "__reval_space__", None)
+        if space is None:
+            return False
+        try:
+            space.attach_output_predictor(ans, test_cls)
+            obj = test_cls()
+            if hasattr(obj, "setUp"):
+                obj.setUp()
+            obj.dreval_output_pred()
+            return True
+        except Exception:
+            return False

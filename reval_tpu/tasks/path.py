@@ -1,0 +1,43 @@
+"""Path task: "Which line executes next after L?" (reference
+evaluation.py:415-602).  The prompt shows the function-family code with
+1-indexed line-number prefixes; the model may answer a line's text, which
+maps to *all* matching source lines.  One record per probe (the reference's
+double-append, evaluation.py:549-552, is not reproduced)."""
+
+from __future__ import annotations
+
+from .answers import parse_path_answer, path_answer_to_lines
+from .base import ProbeJob, ProbeTask
+
+__all__ = ["PathTask"]
+
+
+class PathTask(ProbeTask):
+    name = "path"
+    numbered_code = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._correct = 0
+        self._total = 0
+
+    @property
+    def metrics(self) -> dict:
+        return {"acc": self._correct / self._total if self._total else 0.0,
+                "correct": self._correct, "total": self._total}
+
+    def ground_truth(self, states, lineno0: int, var):
+        """Successor set, converted to 1-indexed; -1 (trace end / uncovered)
+        passes through (reference evaluation.py:520-526)."""
+        return [a if a == -1 else a + 1 for a in states.get_next_line(lineno0)]
+
+    def probe_record(self, job: ProbeJob, response: str) -> dict:
+        ans = parse_path_answer(response, self.prompt_type)
+        ans_lines = path_answer_to_lines(ans, job.context["codelines"])
+        actual = job.expected
+        result = any(a in actual for a in ans_lines)
+        self._total += 1
+        if result:
+            self._correct += 1
+        return {"generated": response, "response": ans_lines, "expected": actual,
+                "line": job.lineno, "prompt": job.prompt, "result": result}

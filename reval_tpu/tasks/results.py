@@ -1,0 +1,65 @@
+"""Results store: timestamped JSONL logs per (task, model, prompt, temp).
+
+Layout (byte-compatible with the reference consumer contract,
+evaluation.py:122-133,220-221):
+
+    <results_dir>/<task>@<model_info>/<YY-MM-DD-HH-MM>.<dataset>.jsonl
+
+where each row is ``{"task_id": …, "generation": [{"input_idx": …,
+"results": […]}]}`` and the final row is the metrics trailer.  Divergence
+from the reference (SURVEY §2.10): ``/`` in model ids is sanitised to ``_``
+so model names don't create nested directories; readers accept both.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from datetime import datetime, timezone
+
+__all__ = ["ResultsStore"]
+
+
+class ResultsStore:
+    def __init__(self, task_name: str, model_info: str, results_dir: str = "model_generations"):
+        self.task_name = task_name
+        self.model_info = model_info
+        self.results_dir = results_dir
+
+    @property
+    def save_dir(self) -> str:
+        return os.path.join(self.results_dir, f"{self.task_name}@{self.model_info}".replace("/", "_"))
+
+    def _candidate_dirs(self) -> list[str]:
+        raw = os.path.join(self.results_dir, f"{self.task_name}@{self.model_info}")
+        return [self.save_dir, raw]
+
+    @staticmethod
+    def timestamp(now: datetime | None = None) -> str:
+        return (now or datetime.now(timezone.utc)).strftime("%y-%m-%d-%H-%M")
+
+    def write(self, records: list[dict], dataset: str, now: datetime | None = None) -> str:
+        os.makedirs(self.save_dir, exist_ok=True)
+        path = os.path.join(self.save_dir, f"{self.timestamp(now)}.{dataset}.jsonl")
+        with open(path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+    def latest(self, dataset: str | None = None) -> str:
+        """Newest results file (both sanitised and raw-layout dirs searched)."""
+        pattern = f"*.{dataset}.jsonl" if dataset else "*.jsonl"
+        files: list[str] = []
+        for d in self._candidate_dirs():
+            files.extend(glob.glob(os.path.join(d, pattern)))
+        if not files:
+            raise FileNotFoundError(
+                f"no results for task={self.task_name} model={self.model_info} under {self._candidate_dirs()}"
+            )
+        return max(files, key=os.path.getctime)
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]

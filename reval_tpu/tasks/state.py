@@ -1,0 +1,53 @@
+"""State task: "Value and type of V after line L?" (reference
+evaluation.py:610-906).  Ground truth comes from the variable interpreter
+over the trace; the answer parser and type-aware equality live in
+``answers.py``."""
+
+from __future__ import annotations
+
+import json
+
+from ..dynamics import Nil
+from .answers import parse_state_answer, state_answers_equal
+from .base import ProbeJob, ProbeTask
+
+__all__ = ["StateTask"]
+
+
+class StateTask(ProbeTask):
+    name = "state"
+    uses_var = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._correct = 0
+        self._total = 0
+
+    @property
+    def metrics(self) -> dict:
+        return {"acc": self._correct / self._total if self._total else 0.0,
+                "correct": self._correct, "total": self._total}
+
+    def ground_truth(self, states, lineno0: int, var: str):
+        return states.interpret_var(lineno0, var)
+
+    def probe_record(self, job: ProbeJob, response: str) -> dict:
+        ans = parse_state_answer(response, self.prompt_type)
+        actual = job.expected
+        self._total += 1
+        if ans == "ERROR":
+            eq = False
+        else:
+            eq = state_answers_equal(ans, actual)
+        if eq:
+            self._correct += 1
+        record = {"generated": response, "eq": eq, "line": job.lineno, "var": job.var,
+                  "prompt": job.prompt, "ans": ans if ans is not Nil else "Nil",
+                  "actual": actual if actual is not Nil else "Nil"}
+        # values may be arbitrary Python objects; stringify what JSON can't hold
+        for key, value in record.items():
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                record[key] = f"STRINGIFIED, {value}"
+        return record

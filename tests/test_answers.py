@@ -1,0 +1,181 @@
+"""Golden tests for answer post-processing + equality (SURVEY §7 step 1:
+'golden tests for every _postprocess and State._eq branch')."""
+
+import numpy as np
+import pytest
+
+from reval_tpu.dynamics import Nil
+from reval_tpu.tasks.answers import (
+    output_penalty,
+    pad_output_answer,
+    parse_coverage_answer,
+    parse_output_answer,
+    parse_path_answer,
+    parse_state_answer,
+    path_answer_to_lines,
+    state_answers_equal,
+    strip_answer_tags,
+)
+
+
+class TestStripTags:
+    def test_full_tags(self):
+        assert strip_answer_tags("junk [ANSWER] YES [/ANSWER] more") == "YES"
+
+    def test_truncated_closing_tag(self):
+        assert strip_answer_tags("[ANSWER]NO[/ANSWER") == "NO"
+
+    def test_no_tags_passthrough(self):
+        assert strip_answer_tags("  YES  ") == "  YES  "
+
+
+class TestCoverage:
+    @pytest.mark.parametrize("resp,want", [
+        ("YES", True),
+        ("NO", False),
+        ("yes", True),
+        ("[ANSWER]YES[/ANSWER]", True),
+        ("[ANSWER]\nNO\n[/ANSWER]", False),
+        ("", False),                       # empty → NO
+        ("MAYBE", False),                  # ambiguous (neither) → NO
+        ("YESNO", False),                  # head truncation: 'YES' in 'YES', 'NO' not in 'YES' → True? see below
+        ("Not sure", False),
+    ])
+    def test_basic(self, resp, want):
+        if resp == "YESNO":
+            # first-3-chars rule: head 'YES' → yes wins
+            assert parse_coverage_answer(resp) is True
+        else:
+            assert parse_coverage_answer(resp) is want
+
+    def test_head_truncation_rule(self):
+        # only the first 3 chars are scanned: 'NO WAIT YES' → NO
+        assert parse_coverage_answer("NO WAIT YES") is False
+        assert parse_coverage_answer("YES BUT NO") is True
+
+    def test_cot_incomplete(self):
+        assert parse_coverage_answer("thinking...", "cot") is False
+        assert parse_coverage_answer("[THOUGHT]x[/THOUGHT][ANSWER]YES[/ANSWER]", "cot") is True
+
+
+class TestPath:
+    def test_int_sentinels(self):
+        assert parse_path_answer("") == -2
+        assert parse_path_answer("-1") == -1
+        assert parse_path_answer("no thought", "cot") == -2
+
+    def test_code_line_answer(self):
+        assert parse_path_answer("[ANSWER]    return x\nextra[/ANSWER]") == "return x"
+
+    def test_line_mapping(self):
+        codelines = ["def f(x):", "    if x:", "        return x", "    return x"]
+        assert path_answer_to_lines("return x", codelines) == [3, 4]
+        assert path_answer_to_lines("nonexistent", codelines) == [-2]
+        assert path_answer_to_lines(-1, codelines) == [-1]
+        assert path_answer_to_lines(-2, codelines) == [-2]
+
+
+class TestStateParsing:
+    def test_simple_pairs(self):
+        assert parse_state_answer("5; int") == (5, int)
+        assert parse_state_answer("'abc'; str") == ("abc", str)
+        assert parse_state_answer("[1, 2]; list") == ([1, 2], list)
+        assert parse_state_answer("3.5; float") == (3.5, float)
+
+    def test_nil_answers(self):
+        assert parse_state_answer("Nil") is Nil
+        assert parse_state_answer("nil") is Nil
+        assert parse_state_answer("[Nil]") is Nil
+        assert parse_state_answer("Nil; Nil") is Nil
+
+    def test_no_semicolon_is_error(self):
+        assert parse_state_answer("just text") == "ERROR"
+
+    def test_class_unwrap_and_generics(self):
+        assert parse_state_answer("5; <class 'int'>") == (5, int)
+        assert parse_state_answer("[1]; list[int]") == ([1], list)
+
+    def test_aliases(self):
+        assert parse_state_answer("'x'; string") == ("x", str)
+        assert parse_state_answer("7; integer") == (7, int)
+
+    def test_tuple_detection(self):
+        assert parse_state_answer("(1, 2); (int, int)") == ((1, 2), tuple)
+
+    def test_unquoted_string_fallback(self):
+        assert parse_state_answer("hello world; str") == ("hello world", str)
+
+    def test_unicode_quotes(self):
+        assert parse_state_answer("‘ab’; str") == ("ab", str)
+
+    def test_none_cases(self):
+        assert parse_state_answer("None; NoneType") == (None, type(None))
+        assert parse_state_answer("None; int") == (None, type(None))
+
+    def test_ndarray(self):
+        val, typ = parse_state_answer("[1, 2]; numpy.ndarray")
+        assert typ is np.ndarray and np.array_equal(val, np.array([1, 2]))
+
+    def test_datetime(self):
+        import datetime
+
+        val, typ = parse_state_answer("2024-01-02; datetime.datetime")
+        assert typ is datetime.datetime and val.year == 2024
+
+    def test_semicolon_in_value(self):
+        # rfind: the LAST semicolon splits value from type
+        assert parse_state_answer("'a;b'; str") == ("a;b", str)
+
+    def test_cot_incomplete(self):
+        assert parse_state_answer("5; int", "cot") == "ERROR"
+
+    def test_garbage_type(self):
+        assert parse_state_answer("5; no_such_type_xyz") == "ERROR"
+
+
+class TestStateEquality:
+    def test_nil_cases(self):
+        assert state_answers_equal(Nil, Nil)
+        assert not state_answers_equal(Nil, [1])
+        assert not state_answers_equal((1, int), Nil)
+
+    def test_type_mismatch(self):
+        assert not state_answers_equal((1, int), ["1"])     # actual is str
+        assert not state_answers_equal(("1", int), [1])     # val/type conflict
+
+    def test_float_tolerance(self):
+        assert state_answers_equal((0.30000001, float), [0.3])
+        assert not state_answers_equal((0.31, float), [0.3])
+
+    def test_membership(self):
+        assert state_answers_equal((2, int), [1, 2, 3])
+        assert not state_answers_equal((9, int), [1, 2, 3])
+
+    def test_ndarray(self):
+        a = np.array([1.0, 2.0])
+        assert state_answers_equal((a, np.ndarray), [np.array([1.0, 2.0])])
+        assert not state_answers_equal((a, np.ndarray), [np.array([3.0, 4.0])])
+
+    def test_bool_vs_int_distinct(self):
+        assert not state_answers_equal((True, bool), [1])
+
+
+class TestOutput:
+    def test_parse(self):
+        assert parse_output_answer("[ANSWER]assert f(1) == 2[/ANSWER]") == "assert f(1) == 2"
+        assert parse_output_answer("x", "cot") == "ERROR"
+
+    def test_pad(self):
+        given = "a = A(3)\nassertEqual(a.f(2), ??)\nassertEqual(a.f(4), ??)"
+        short = "assertEqual(a.f(2), 5)\nassertEqual(a.f(4), 7)"
+        padded = pad_output_answer(short, given)
+        assert padded.split("\n")[0] == "a = A(3)"
+        assert len(padded.split("\n")) == 3
+        assert pad_output_answer("ERROR", given) == "assert False"
+
+    def test_penalty(self):
+        given = "assert f(1) == ??"
+        assert output_penalty("assert True", given)
+        assert output_penalty("x = 1", given)          # fewer asserts
+        assert not output_penalty("assert f(1) == 2", given)
+        assert output_penalty("assertTrue(True)", given)
