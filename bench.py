@@ -1,0 +1,81 @@
+"""Throughput benchmark: DREval probes/sec/chip with the in-tree TPU engine.
+
+Runs the *real* evaluation pipeline — coverage-task planning over HumanEval
+builds genuine few-shot prompts, the TPU engine generates with the
+benchmark's stop string — on a deepseek-coder-1.3b-shaped model with random
+bf16 weights (this host has no checkpoint egress; throughput does not
+depend on weight values).
+
+Baseline for ``vs_baseline``: the reference harness prompts serially, one
+``Model.infer`` per probe (reference evaluation.py:105-107) — we measure
+that same engine forced to batch_size=1 serial decode and report the
+speedup of the batched path.  Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def build_prompts(n_items: int = 3) -> list[str]:
+    from reval_tpu.tasks import CoverageTask
+
+    task = CoverageTask(model=None, prompt_type="direct", dataset="humaneval",
+                        mock=True, max_items=n_items, progress=False)
+    _, jobs = task._plan()
+    return [j.prompt for j in jobs]
+
+
+def make_engine(batch_size: int):
+    from reval_tpu.inference.tpu.engine import TPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+
+    cfg = ModelConfig(
+        vocab_size=32256, hidden_size=2048, intermediate_size=5504,
+        num_layers=24, num_heads=16, num_kv_heads=16, head_dim=128,
+        rope_theta=100000.0,
+    )
+    params = init_random_params(cfg, seed=0, dtype="bfloat16")
+    return TPUEngine(params, cfg, ByteTokenizer(), batch_size=batch_size,
+                     max_seq_len=4096)
+
+
+def timed_run(engine, prompts: list[str], max_new_tokens: int) -> float:
+    t0 = time.perf_counter()
+    outs = engine.generate(prompts, max_new_tokens=max_new_tokens,
+                           temperature=0.0, stop=["[/ANSWER]"])
+    assert len(outs) == len(prompts)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    import jax
+
+    max_new = 32
+    prompts = build_prompts()
+    n = len(prompts)
+
+    batched = make_engine(batch_size=8)
+    timed_run(batched, prompts[:8], max_new)      # warmup: compile prefill+decode
+    batched_s = timed_run(batched, prompts, max_new)
+
+    serial = make_engine(batch_size=1)
+    timed_run(serial, prompts[:1], max_new)       # warmup
+    serial_s = timed_run(serial, prompts[: max(4, n // 8)], max_new)
+    serial_per = serial_s / max(4, n // 8)
+
+    n_chips = max(1, len(jax.devices()))
+    probes_per_sec = n / batched_s / n_chips
+    baseline_per_sec = 1.0 / serial_per / n_chips
+    print(json.dumps({
+        "metric": "DREval coverage probes/sec/chip (deepseek-1.3b-shape bf16, direct, 32 new tok)",
+        "value": round(probes_per_sec, 3),
+        "unit": "probes/s/chip",
+        "vs_baseline": round(probes_per_sec / baseline_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -111,8 +111,9 @@ def run_config(cfg: dict, mock: bool = False) -> dict | float:
         backend = None
         cfg["custom_mock"] = True
     else:
-        backend = create_backend(**{k: v for k, v in cfg.items() if k != "task"},
-                                 mock=cfg.get("backend") == "mock")
+        backend = create_backend(
+            **{k: v for k, v in cfg.items() if k not in ("task", "mock")},
+            mock=bool(cfg.get("mock")) or cfg.get("backend") == "mock")
     task_cls = TASKS[task_name]
     task = task_cls(model=backend,
                     **{k: v for k, v in cfg.items() if k not in ("task", "model_id", "backend")})

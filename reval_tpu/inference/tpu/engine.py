@@ -1,0 +1,233 @@
+"""TPUEngine: batched prefill + chunked decode with stop-string handling.
+
+The generation loop that replaces vLLM for this framework (SURVEY §7 step
+4).  Shape discipline (hard part 4) and stop-string semantics (hard part 1)
+drive the design:
+
+- **Length bucketing.** Prompts are sorted by token length and packed into
+  fixed-size batches; each batch left-pads to a power-of-two bucket, so XLA
+  compiles one prefill/decode pair per bucket instead of per shape.
+- **Chunked decode.** The token loop runs as a jitted ``lax.scan`` of
+  ``CHUNK`` steps; the host only syncs between chunks.  Stop sequences are
+  *strings*, not token ids — after each chunk the generated ids are
+  detokenised and scanned for the stop string (and EOS), reproducing
+  vLLM's post-detokenisation stop semantics without a per-token host
+  round-trip.
+- **Left-padding** makes every sequence's decode write position identical,
+  so KV-cache updates are dynamic slices, not scatters (see models/model.py).
+- Finished sequences keep decoding into masked positions until the whole
+  batch stops; their text is truncated at the stop match afterwards.
+
+Sharding: params/caches are placed with NamedSharding over a (dp, tp) mesh
+when one is provided (see reval_tpu.parallel); jit then partitions the
+same functions — there is no separate multi-chip code path.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import (
+    KVCache,
+    ModelConfig,
+    decode_step,
+    init_kv_cache,
+    load_checkpoint,
+    prefill,
+)
+from .sampling import sample_token
+from .tokenizer import ByteTokenizer, HFTokenizer
+
+__all__ = ["TPUEngine"]
+
+CHUNK = 8            # decode steps per host sync
+MIN_BUCKET = 64
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def truncate_at_stop(text: str, stop: list[str]) -> str:
+    """Cut at the earliest stop-string occurrence (stop excluded) —
+    vLLM-compatible post-detokenisation stop semantics."""
+    positions = [text.find(s) for s in stop if s in text]
+    return text[: min(positions)] if positions else text
+
+
+@dataclass
+class EngineStats:
+    prompts: int = 0
+    generated_tokens: int = 0
+    prefill_tokens: int = 0
+    decode_seconds: float = 0.0
+    prefill_seconds: float = 0.0
+
+
+class TPUEngine:
+    def __init__(self, params, cfg: ModelConfig, tokenizer, *, batch_size: int = 8,
+                 max_seq_len: int = 8192, mesh=None, seed: int = 0):
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.max_seq_len = max_seq_len
+        self.mesh = mesh
+        self.stats = EngineStats()
+        self._key = jax.random.PRNGKey(seed)
+        self.params = params
+        self._input_sharding = None
+        self._cache_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel import shard_params
+            from ...parallel.sharding import kv_cache_spec
+
+            dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+            if batch_size % dp:
+                raise ValueError(f"batch_size {batch_size} must divide by dp={dp}")
+            self.params = shard_params(params, cfg, mesh)
+            self._input_sharding = NamedSharding(mesh, P("dp"))
+            self._cache_sharding = NamedSharding(mesh, kv_cache_spec(cfg, mesh))
+        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg))
+        self._jit_decode_chunk = jax.jit(
+            partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
+            donate_argnames=("cache",),
+        )
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16", tp_size: int = 1,
+                        dp_size: int = 1, batch_size: int = 8, max_seq_len: int = 8192,
+                        tokenizer=None, seed: int = 0) -> "TPUEngine":
+        params, cfg = load_checkpoint(model_path, dtype=dtype)
+        if tokenizer is None:
+            tokenizer = HFTokenizer(model_path)
+        mesh = None
+        if tp_size * dp_size > 1:
+            from ...parallel import make_mesh
+
+            mesh = make_mesh(tp=tp_size, dp=dp_size)
+        return cls(params, cfg, tokenizer, batch_size=batch_size,
+                   max_seq_len=max_seq_len, mesh=mesh, seed=seed)
+
+    # -- jitted pieces -----------------------------------------------------
+    @staticmethod
+    def _decode_chunk(params, first_token, pad_len, cache: KVCache, start_pos,
+                      temperature, key, *, cfg: ModelConfig, steps: int):
+        """Run ``steps`` decode iterations; returns sampled tokens [B, steps]."""
+
+        def body(carry, _):
+            token, cache, pos, key = carry
+            logits, cache = decode_step(params, cfg, token, pad_len, cache, pos)
+            key, sub = jax.random.split(key)
+            nxt = sample_token(logits, temperature, sub)
+            return (nxt[:, None], cache, pos + 1, key), nxt
+
+        (last, cache, _, _), toks = jax.lax.scan(
+            body, (first_token, cache, start_pos, key), None, length=steps)
+        return toks.T, cache, last
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- generation --------------------------------------------------------
+    def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
+                 temperature: float = 0.0, stop: list[str] | None = None) -> list[str]:
+        """Generate completions for every prompt (any count); order preserved."""
+        if not prompts:
+            return []
+        stop = stop or []
+        ids = [self.tokenizer.encode(p) for p in prompts]
+        order = sorted(range(len(ids)), key=lambda i: len(ids[i]), reverse=True)
+        out: list[str | None] = [None] * len(prompts)
+        for start in range(0, len(order), self.batch_size):
+            batch_idx = order[start:start + self.batch_size]
+            batch_ids = [ids[i] for i in batch_idx]
+            texts = self._generate_batch(batch_ids, max_new_tokens, temperature, stop)
+            for i, text in zip(batch_idx, texts):
+                out[i] = text
+        return out  # type: ignore[return-value]
+
+    def _generate_batch(self, batch_ids: list[list[int]], max_new_tokens: int,
+                        temperature: float, stop: list[str]) -> list[str]:
+        n_real = len(batch_ids)
+        b = self.batch_size
+        pad_id = self.tokenizer.pad_id
+        # clip overlong prompts from the left, keeping room to generate
+        limit = self.max_seq_len - max_new_tokens - 1
+        batch_ids = [seq[-limit:] if len(seq) > limit else seq for seq in batch_ids]
+        t = _bucket(max(len(s) for s in batch_ids))
+        while len(batch_ids) < b:
+            batch_ids.append([pad_id])  # dummy rows pad the batch
+        tokens = np.full((b, t), pad_id, dtype=np.int32)
+        pad_len = np.zeros(b, dtype=np.int32)
+        for row, seq in enumerate(batch_ids):
+            tokens[row, t - len(seq):] = seq
+            pad_len[row] = t - len(seq)
+
+        cache = init_kv_cache(self.cfg, b, t + max_new_tokens,
+                              dtype=self.params["embed"].dtype)
+        dev_tokens, dev_pad = jnp.asarray(tokens), jnp.asarray(pad_len)
+        if self._input_sharding is not None:
+            dev_tokens = jax.device_put(dev_tokens, self._input_sharding)
+            dev_pad = jax.device_put(dev_pad, self._input_sharding)
+            cache = KVCache(*(jax.device_put(c, self._cache_sharding) for c in cache))
+        t0 = time.perf_counter()
+        logits, cache = self._jit_prefill(
+            self.params, tokens=dev_tokens, pad_len=dev_pad, cache=cache)
+        first = sample_token(logits[:, -1, :], jnp.float32(temperature), self._next_key())
+        jax.block_until_ready(first)
+        self.stats.prefill_seconds += time.perf_counter() - t0
+        self.stats.prefill_tokens += int((t - pad_len).sum())
+
+        generated = np.zeros((b, 0), dtype=np.int32)
+        first_host = np.asarray(first)[:, None]
+        generated = np.concatenate([generated, first_host], axis=1)
+        token = first[:, None]
+        pos = jnp.int32(t)
+        # dummy rows (batch padding) are born finished or they would pin
+        # the whole batch to the full token budget
+        finished = [False] * n_real + [True] * (b - n_real)
+
+        t0 = time.perf_counter()
+        while generated.shape[1] < max_new_tokens and not all(finished):
+            steps = min(CHUNK, max_new_tokens - generated.shape[1])
+            toks, cache, token = self._jit_decode_chunk(
+                self.params, token, dev_pad, cache, pos,
+                jnp.float32(temperature), self._next_key(), steps=steps)
+            pos = pos + steps
+            generated = np.concatenate([generated, np.asarray(toks)], axis=1)
+            for row in range(n_real):
+                if not finished[row]:
+                    finished[row] = self._find_stop(generated[row], stop)
+        self.stats.decode_seconds += time.perf_counter() - t0
+        self.stats.generated_tokens += int(generated[:n_real].size)
+        self.stats.prompts += n_real
+
+        texts = []
+        for row in range(n_real):
+            ids = generated[row].tolist()
+            if self.tokenizer.eos_id in ids:
+                ids = ids[: ids.index(self.tokenizer.eos_id)]
+            texts.append(truncate_at_stop(self.tokenizer.decode(ids), stop))
+        return texts
+
+    def _find_stop(self, row_ids: np.ndarray, stop: list[str]) -> bool:
+        ids = row_ids.tolist()
+        if self.tokenizer.eos_id in ids:
+            return True
+        if not stop:
+            return False
+        text = self.tokenizer.decode(ids)
+        return any(s in text for s in stop)

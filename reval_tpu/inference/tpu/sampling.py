@@ -1,0 +1,27 @@
+"""Token sampling under jit.
+
+Greedy and temperature sampling are computed unconditionally and selected
+with ``where`` — both are trivial next to the model step, and it keeps the
+decode step free of data-dependent control flow (XLA requirement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_token"]
+
+
+def sample_token(logits: jnp.ndarray, temperature: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """logits [B, V] float32 → token ids [B].
+
+    ``temperature <= 0`` means greedy (argmax); otherwise categorical over
+    ``logits / temperature`` via the Gumbel trick.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, logits.shape, minval=1e-20, maxval=1.0)))
+    sampled = jnp.argmax(logits / temp + gumbel, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -1,0 +1,44 @@
+"""Tokenizer adapters.
+
+The engine only needs ``encode``/``decode``/``eos_id``/``pad_id``.
+:class:`HFTokenizer` wraps a HuggingFace checkpoint's tokenizer;
+:class:`ByteTokenizer` is a dependency-free byte-level fallback used by
+tests and random-weight benches (no tokenizer files required).
+"""
+
+from __future__ import annotations
+
+__all__ = ["HFTokenizer", "ByteTokenizer"]
+
+
+class HFTokenizer:
+    def __init__(self, model_path: str):
+        from transformers import AutoTokenizer
+
+        self.tk = AutoTokenizer.from_pretrained(model_path)
+        self.eos_id = self.tk.eos_token_id
+        self.pad_id = self.tk.pad_token_id if self.tk.pad_token_id is not None else (self.eos_id or 0)
+
+    def encode(self, text: str) -> list[int]:
+        return self.tk.encode(text)
+
+    def decode(self, ids: list[int]) -> str:
+        return self.tk.decode(ids, skip_special_tokens=True)
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as tokens; ids 0-255 are bytes, 256 BOS, 257 EOS."""
+
+    vocab_size = 258
+
+    def __init__(self):
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 0
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="ignore")

@@ -1,0 +1,21 @@
+"""JAX model zoo: one functional decoder, per-family configs + loaders.
+
+Covers the reference model zoo (model_list.txt): llama family (CodeLlama,
+DeepSeek-Coder, Mistral, Magicoder), Gemma, StarCoder2."""
+
+from .configs import ModelConfig, load_hf_config
+from .loader import init_random_params, load_checkpoint, param_template
+from .model import KVCache, decode_step, init_kv_cache, logits_for_tokens, prefill
+
+__all__ = [
+    "KVCache",
+    "ModelConfig",
+    "decode_step",
+    "init_kv_cache",
+    "init_random_params",
+    "load_checkpoint",
+    "load_hf_config",
+    "logits_for_tokens",
+    "param_template",
+    "prefill",
+]

@@ -1,0 +1,90 @@
+"""Model architecture configs + HF ``config.json`` parsing.
+
+One dataclass covers the decoder-only families in the reference model zoo
+(model_list.txt): the llama family (Llama/CodeLlama, DeepSeek-Coder,
+Mistral, Magicoder), Gemma, and StarCoder2.  Family-specific behaviour is
+explicit flags, not subclasses — the forward pass branches on them
+statically so jit sees fixed control flow.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+__all__ = ["ModelConfig", "load_hf_config"]
+
+
+@dataclass
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    max_position_embeddings: int = 16384
+    tie_word_embeddings: bool = False
+    family: str = "llama"          # llama | gemma | starcoder2
+    # family flags
+    norm_offset: float = 0.0        # gemma: weights stored as (w - 1)
+    embed_scale: float | None = None  # gemma: embeddings scaled by sqrt(hidden)
+    use_layernorm: bool = False     # starcoder2: LayerNorm (with bias) not RMSNorm
+    mlp_gated: bool = True          # starcoder2: plain GELU MLP (c_fc/c_proj)
+    attention_bias: bool = False    # starcoder2 uses biases on qkv/o
+    mlp_bias: bool = False
+    sliding_window: int | None = None  # mistral/starcoder2 (ignored ≤4k ctx)
+    hidden_act: str = "silu"
+    dtype: str = "bfloat16"
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+def load_hf_config(model_path: str | Path) -> ModelConfig:
+    """Parse a HuggingFace ``config.json`` into a :class:`ModelConfig`."""
+    with open(Path(model_path) / "config.json") as f:
+        hf = json.load(f)
+    model_type = hf.get("model_type", "llama")
+    common = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        # some configs carry an explicit null head_dim
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        max_position_embeddings=hf.get("max_position_embeddings", 16384),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        hidden_act=hf.get("hidden_act", hf.get("hidden_activation", "silu")),
+        sliding_window=hf.get("sliding_window"),
+    )
+    if model_type in ("llama", "mistral", "deepseek", "mixtral"):
+        return ModelConfig(family="llama", rms_norm_eps=hf.get("rms_norm_eps", 1e-6), **common)
+    if model_type in ("gemma", "gemma2"):
+        return ModelConfig(
+            family="gemma",
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            norm_offset=1.0,
+            embed_scale=float(hf["hidden_size"]) ** 0.5,
+            **{**common, "tie_word_embeddings": True},
+        )
+    if model_type == "starcoder2":
+        return ModelConfig(
+            family="starcoder2",
+            rms_norm_eps=hf.get("norm_epsilon", 1e-5),
+            use_layernorm=True,
+            mlp_gated=False,
+            attention_bias=hf.get("use_bias", True),
+            mlp_bias=hf.get("use_bias", True),
+            **common,
+        )
+    raise ValueError(f"unsupported model_type {model_type!r} "
+                     f"(supported: llama/mistral/deepseek, gemma, starcoder2)")

@@ -1,0 +1,169 @@
+"""Functional decoder-only transformer (llama / gemma / starcoder2 families).
+
+Design (TPU-first, not a port):
+- **Params are a flat pytree of stacked arrays**: every per-layer weight is
+  stored as ``[L, ...]`` and the layer loop is a single ``lax.scan`` — one
+  layer gets traced/compiled once regardless of depth, and sharding rules
+  are written once per weight name.
+- **Static family flags** (``ModelConfig``) select norm/MLP/bias variants at
+  trace time; there is no Python-level polymorphism inside jit.
+- **Left-padded batches** throughout (see ops/attention.py): the KV cache
+  decode write position is uniform across the batch, so cache updates are
+  ``dynamic_update_slice`` (no scatter).
+- Matmuls run in the params' dtype (bf16 on TPU) on the MXU; norms, RoPE
+  and attention softmax accumulate in float32.
+
+Weight layout: projections are stored ``[in, out]`` (``x @ w``); the HF
+loader transposes torch's ``[out, in]``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply_rope, decode_attention, prefill_attention, rope_angles, rms_norm
+from .configs import ModelConfig
+
+__all__ = ["KVCache", "init_kv_cache", "prefill", "decode_step", "logits_for_tokens"]
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S, H_kv, D]
+    v: jnp.ndarray  # [L, B, S, H_kv, D]
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def _norm(x, w, b, cfg: ModelConfig):
+    if cfg.use_layernorm:
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=-1, keepdims=True)
+        var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+        out = out * w.astype(jnp.float32) + b.astype(jnp.float32)
+        return out.astype(x.dtype)
+    return rms_norm(x, w, cfg.rms_norm_eps, offset=cfg.norm_offset)
+
+
+def _act(x, cfg: ModelConfig):
+    if cfg.hidden_act in ("gelu", "gelu_pytorch_tanh", "gelu_tanh"):
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _mlp(x, layer, cfg: ModelConfig):
+    if cfg.mlp_gated:
+        gate = x @ layer["gate_w"]
+        up = x @ layer["up_w"]
+        return (_act(gate, cfg) * up) @ layer["down_w"]
+    h = x @ layer["fc_w"]
+    if cfg.mlp_bias:
+        h = h + layer["fc_b"]
+    h = _act(h, cfg)
+    out = h @ layer["proj_w"]
+    if cfg.mlp_bias:
+        out = out + layer["proj_b"]
+    return out
+
+
+def _qkv(x, layer, cfg: ModelConfig):
+    b, t, _ = x.shape
+    q = x @ layer["q_w"]
+    k = x @ layer["k_w"]
+    v = x @ layer["v_w"]
+    if cfg.attention_bias:
+        q, k, v = q + layer["q_b"], k + layer["k_b"], v + layer["v_b"]
+    q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _out_proj(attn_out, layer, cfg: ModelConfig):
+    b, t = attn_out.shape[:2]
+    out = attn_out.reshape(b, t, cfg.num_heads * cfg.head_dim) @ layer["o_w"]
+    if cfg.attention_bias:
+        out = out + layer["o_b"]
+    return out
+
+
+def _embed(params, cfg: ModelConfig, tokens):
+    h = params["embed"][tokens]
+    if cfg.embed_scale is not None:
+        h = (h.astype(jnp.float32) * cfg.embed_scale).astype(h.dtype)
+    return h
+
+
+def _unembed(params, cfg: ModelConfig, h):
+    w = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (h @ w).astype(jnp.float32)
+
+
+def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
+            cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+    """Process a left-padded prompt block [B, T]; fill cache positions
+    [0, T); return logits [B, T, V] and the updated cache."""
+    b, t = tokens.shape
+    h = _embed(params, cfg, tokens)
+    positions = jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0)
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(h, xs):
+        layer, k_slot, v_slot = xs
+        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
+        q, k, v = _qkv(normed, layer, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
+        attn = prefill_attention(q, k, v, pad_len)
+        h = h + _out_proj(attn, layer, cfg)
+        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
+        h = h + _mlp(normed, layer, cfg)
+        return h, (new_k, new_v)
+
+    h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    return _unembed(params, cfg, h), KVCache(new_k, new_v)
+
+
+def decode_step(params, cfg: ModelConfig, token: jnp.ndarray, pad_len: jnp.ndarray,
+                cache: KVCache, cur_pos: jnp.ndarray) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step: token [B, 1] at shared position ``cur_pos``; write
+    cache at cur_pos, attend over [pad_len, cur_pos]; logits [B, V]."""
+    b = token.shape[0]
+    h = _embed(params, cfg, token)
+    positions = jnp.maximum(cur_pos - pad_len, 0)[:, None]
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(h, xs):
+        layer, k_slot, v_slot = xs
+        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
+        q, k, v = _qkv(normed, layer, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, cur_pos, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, cur_pos, 0, 0))
+        attn = decode_attention(q, new_k, new_v, pad_len, cur_pos)
+        h = h + _out_proj(attn, layer, cfg)
+        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
+        h = h + _mlp(normed, layer, cfg)
+        return h, (new_k, new_v)
+
+    h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    return _unembed(params, cfg, h)[:, 0, :], KVCache(new_k, new_v)
+
+
+def logits_for_tokens(params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Convenience full-sequence forward (no cache) for parity tests."""
+    b, t = tokens.shape
+    cache = init_kv_cache(cfg, b, t, dtype=params["embed"].dtype)
+    logits, _ = prefill(params, cfg, tokens, jnp.zeros(b, jnp.int32), cache)
+    return logits

@@ -1,0 +1,17 @@
+"""TPU compute ops: XLA-fused implementations + Pallas kernels.
+
+Every op has an XLA (pure jax.numpy/lax) implementation that runs anywhere
+(CPU tests, TPU fallback); hot ops additionally ship a Pallas TPU kernel
+selected at runtime (see ``attention.py``)."""
+
+from .norms import rms_norm
+from .rope import apply_rope, rope_angles
+from .attention import prefill_attention, decode_attention
+
+__all__ = [
+    "apply_rope",
+    "decode_attention",
+    "prefill_attention",
+    "rms_norm",
+    "rope_angles",
+]

@@ -1,0 +1,84 @@
+"""Attention for prefill and decode against a left-padded KV cache.
+
+Layout contract (the whole engine is built around left-padding):
+- Sequences are left-padded to the bucket length ``T``; ``pad_len[b]`` is
+  the number of pad positions at the front of sequence ``b``.
+- The KV cache is ``[B, S_max, H_kv, D]``; prefill writes positions
+  ``[0, T)`` (pads included but masked), decode appends at a single shared
+  position ``T + step`` for every sequence — left-padding is what makes the
+  decode write position uniform, so no scatter is needed.
+
+GQA: query heads are grouped over ``H_kv`` KV heads; scores are computed in
+float32 and the softmax is masked before normalisation.
+
+The XLA implementations below compile to fused MXU matmuls and are the
+portable path (CPU tests + TPU).  The Pallas ragged/paged decode kernel
+(``reval_tpu.ops.pallas_attention``) plugs in behind the same signatures.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["prefill_attention", "decode_attention"]
+
+_NEG_INF = -1e30
+
+
+def _group_queries(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
+    """[B, T, H, D] → [B, T, H_kv, G, D] grouping query heads per KV head."""
+    b, t, h, d = q.shape
+    return q.reshape(b, t, n_kv_heads, h // n_kv_heads, d)
+
+
+def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      pad_len: jnp.ndarray, scale: float | None = None) -> jnp.ndarray:
+    """Causal self-attention over one left-padded prefill block.
+
+    q: [B, T, H, D]; k, v: [B, T, H_kv, D]; pad_len: [B] int32.
+    Returns [B, T, H, D].
+    """
+    b, t, h, d = q.shape
+    n_kv = k.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+    qg = _group_queries(q, n_kv).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # scores: [B, H_kv, G, T_q, T_k]
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
+    rows = jnp.arange(t)[:, None]       # query positions
+    cols = jnp.arange(t)[None, :]       # key positions
+    causal = rows >= cols
+    valid_key = cols >= pad_len[:, None, None, None, None]
+    mask = causal[None, None, None, :, :] & valid_key
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, vf)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                     pad_len: jnp.ndarray, cur_pos: jnp.ndarray,
+                     scale: float | None = None) -> jnp.ndarray:
+    """One-token attention against the cache.
+
+    q: [B, 1, H, D]; caches: [B, S, H_kv, D]; pad_len: [B]; cur_pos: scalar
+    (the position just written, shared across the batch).  Keys are valid in
+    ``[pad_len[b], cur_pos]``.  Returns [B, 1, H, D].
+    """
+    b, _, h, d = q.shape
+    s = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+    qg = _group_queries(q, n_kv).astype(jnp.float32)          # [B, 1, N, G, D]
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    scores = jnp.einsum("bqngd,bsnd->bngqs", qg, kf) * scale  # [B, N, G, 1, S]
+    cols = jnp.arange(s)
+    valid = (cols[None, :] >= pad_len[:, None]) & (cols[None, :] <= cur_pos)
+    scores = jnp.where(valid[:, None, None, None, :], scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bngqs,bsnd->bqngd", probs, vf)
+    return out.reshape(b, 1, h, d).astype(q.dtype)

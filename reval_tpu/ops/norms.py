@@ -1,0 +1,27 @@
+"""Normalisation ops.
+
+RMSNorm is the llama-family workhorse; computed in float32 regardless of
+activation dtype (bf16 accumulation visibly drifts logits over 30+ layers)
+and cast back, which XLA fuses into neighbouring ops on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["rms_norm"]
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6,
+             offset: float = 0.0) -> jnp.ndarray:
+    """``x * w / rms(x)`` with float32 internals.
+
+    ``offset`` supports Gemma's ``(1 + w)`` parameterisation.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    variance = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(variance + eps)
+    out = normed * (offset + weight.astype(jnp.float32))
+    return out.astype(dtype)

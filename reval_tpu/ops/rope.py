@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE).
+
+Half-split layout (HF llama convention: rotate_half over the feature dim),
+angles precomputed per call from positions — positions are data (they
+depend on per-sequence padding), so there is no cached table to go stale.
+Float32 throughout; bf16 angles noticeably hurt long-context parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["rope_angles", "apply_rope"]
+
+
+def rope_angles(positions: jnp.ndarray, head_dim: int, theta: float = 10000.0):
+    """cos/sin tables for integer ``positions`` [..., T] → ([..., T, D/2])."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [B, T, H, D] by per-position angles [B, T, D/2].
+
+    Uses the half-split convention: pairs are (x[..., :D/2], x[..., D/2:]).
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    half = xf.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(dtype)

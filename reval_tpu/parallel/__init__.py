@@ -1,0 +1,21 @@
+"""Parallelism: device meshes, sharding rules, multi-host init, ring attention.
+
+The TPU analogue of the reference's delegated tensor parallelism
+(``tensor_parallel_size`` handed to vLLM/NCCL, SURVEY §2.8): here sharding
+is first-class — a ``Mesh`` over ICI with named axes ``('dp', 'tp')``
+(+ ``'sp'`` for sequence parallelism), ``NamedSharding`` rules per weight,
+and XLA-inserted collectives.  No NCCL analogue exists to manage: pjit
+compiles the communication.
+"""
+
+from .mesh import make_mesh, init_distributed, mesh_axis_sizes
+from .sharding import param_specs, shard_params, batch_sharding
+
+__all__ = [
+    "batch_sharding",
+    "init_distributed",
+    "make_mesh",
+    "mesh_axis_sizes",
+    "param_specs",
+    "shard_params",
+]

@@ -1,0 +1,54 @@
+"""Device mesh construction + multi-host initialisation.
+
+Axes: ``dp`` (data parallel — prompt batches), ``tp`` (tensor parallel —
+heads/ffn), optional ``sp`` (sequence parallel — ring attention).  On a
+TPU slice the mesh should be built so ``tp`` rides the fastest ICI links;
+``jax.devices()`` order already follows the physical torus for v4/v5 — we
+keep device order and reshape, which maps tp to adjacent chips.
+
+Multi-host (pods / multi-slice): call :func:`init_distributed` once per
+process before any other JAX call; ``jax.devices()`` then spans the whole
+pod and the same mesh construction works unchanged — DCN-crossing axes
+should be the outermost (dp) axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "init_distributed", "mesh_axis_sizes"]
+
+
+def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, devices=None) -> Mesh:
+    """Build a ``(dp, sp, tp)`` mesh (singleton axes are kept — named axes
+    must exist for the sharding rules to reference them)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = tp * dp * sp
+    if len(devices) < need:
+        raise ValueError(f"mesh needs {need} devices (tp={tp} dp={dp} sp={sp}), "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(arr, ("dp", "sp", "tp"))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Initialise multi-host JAX (pods, multi-slice over DCN).
+
+    With TPU metadata available all arguments are auto-detected; explicit
+    values support manual rigs.  Safe to call once per process, before any
+    other JAX API touches a backend.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,119 @@
+"""Sharding rules: params pytree leaf name → PartitionSpec.
+
+Megatron-style tensor parallelism expressed declaratively: attention and
+MLP input projections shard their *output* features over ``tp``; output
+projections shard their *input* features (so each chip computes a partial
+sum and XLA inserts one psum per block); vocab-dimension weights shard over
+``tp`` so the logits matmul is parallel too.  Norms and small biases
+replicate.  Activations shard batch over ``dp``; XLA propagates everything
+else from the parameter shardings.
+
+The KV cache shards batch over ``dp`` and KV heads over ``tp`` (when
+divisible), keeping decode attention collective-free.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+
+__all__ = ["param_specs", "shard_params", "batch_sharding", "kv_cache_spec"]
+
+# leaf name → spec for stacked [L, ...] layer weights
+_LAYER_RULES = {
+    "q_w": P(None, None, "tp"),
+    "k_w": P(None, None, "tp"),
+    "v_w": P(None, None, "tp"),
+    "o_w": P(None, "tp", None),
+    "gate_w": P(None, None, "tp"),
+    "up_w": P(None, None, "tp"),
+    "down_w": P(None, "tp", None),
+    "fc_w": P(None, None, "tp"),
+    "proj_w": P(None, "tp", None),
+    "q_b": P(None, "tp"),
+    "k_b": P(None, "tp"),
+    "v_b": P(None, "tp"),
+    "fc_b": P(None, "tp"),
+    # replicated small leaves
+    "o_b": P(),
+    "proj_b": P(),
+    "attn_norm_w": P(),
+    "attn_norm_b": P(),
+    "mlp_norm_w": P(),
+    "mlp_norm_b": P(),
+}
+
+_TOP_RULES = {
+    "embed": P("tp", None),       # vocab-sharded; also the tied lm head
+    "lm_head": P(None, "tp"),
+    "final_norm_w": P(),
+    "final_norm_b": P(),
+}
+
+
+def _divisible(cfg: ModelConfig, mesh: Mesh) -> dict[str, bool]:
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+    return {
+        "heads": cfg.num_heads % tp == 0,
+        "kv_heads": cfg.num_kv_heads % tp == 0,
+        "ffn": cfg.intermediate_size % tp == 0,
+        "vocab": cfg.vocab_size % tp == 0,
+    }
+
+
+def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    """PartitionSpec tree mirroring ``params``.
+
+    Falls back to replication for any dimension the mesh doesn't divide
+    (correctness first; the loader warns so mis-sized meshes are visible).
+    """
+    div = _divisible(cfg, mesh)
+
+    def top_spec(name):
+        spec = _TOP_RULES.get(name, P())
+        if name == "embed" and not div["vocab"]:
+            return P()
+        if name == "lm_head" and not div["vocab"]:
+            return P()
+        return spec
+
+    def layer_spec(name):
+        spec = _LAYER_RULES.get(name, P())
+        if name in ("k_w", "v_w", "k_b", "v_b") and not div["kv_heads"]:
+            return P()
+        if name in ("q_w", "o_w", "q_b") and not div["heads"]:
+            return P()
+        if name in ("gate_w", "up_w", "down_w", "fc_w", "proj_w", "fc_b") and not div["ffn"]:
+            return P()
+        return spec
+
+    specs: dict = {}
+    for name, value in params.items():
+        if name == "layers":
+            specs["layers"] = {k: layer_spec(k) for k in value}
+        else:
+            specs[name] = top_spec(name)
+    return specs
+
+
+def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    """Place a params pytree onto the mesh per the rules above."""
+    specs = param_specs(params, cfg, mesh)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [B, ...] host arrays (tokens, pad lengths)."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
+    """[L, B, S, H_kv, D] — batch over dp, kv heads over tp if divisible."""
+    div = _divisible(cfg, mesh)
+    return P(None, "dp", None, "tp" if div["kv_heads"] else None, None)

@@ -38,8 +38,7 @@ VALID_PROMPT_TYPES = ("direct", "cot", "tot")
 class ProbeJob:
     """One model call: its prompt plus everything scoring needs."""
 
-    record: dict            # the {'task_id', 'generation'} row this feeds
-    gen_entry: dict         # the {'input_idx', 'results'} entry within it
+    gen_entry: dict         # the {'input_idx', 'results'} entry this feeds
     prompt: str
     expected: Any = None    # precomputed ground truth (task-specific shape)
     lineno: int | None = None   # 1-indexed probe line
@@ -251,8 +250,9 @@ class ProbeTask(TaskRunner):
         return self.probe_record(job, response)
 
     # -- planning ----------------------------------------------------------
-    def _prompt_code(self, code: str, codelines: list[str]) -> str:
-        if self.numbered_code:
+    @staticmethod
+    def _prompt_code(code: str, codelines: list[str], numbered: bool) -> str:
+        if numbered:
             return "".join(f"{i + 1}\t{line}\n" for i, line in enumerate(codelines))
         return code
 
@@ -269,29 +269,31 @@ class ProbeTask(TaskRunner):
         for probe in pair["task"]:
             if self._skipped(self._probe_key(task_idx, pair["input_idx"], probe)):
                 continue
-            self._append_probe_job(jobs, gen_entry,
-                                   record=None, states=states, probe=probe,
+            self._append_probe_job(jobs, gen_entry, states=states, probe=probe,
                                    code=code, codelines=codelines,
-                                   invocation=invocation, invocation_abbr=invocation)
+                                   invocation=invocation, invocation_abbr=invocation,
+                                   numbered=self.numbered_code)
 
     def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
                         setup, gen_entry, jobs):
         states = self.run_class_sandbox(test_cls, self.sandbox_timeout)
         invocation = setup + "\n" + str(_input).rstrip()
         for probe in pair["task"]:
-            self._append_probe_job(jobs, gen_entry,
-                                   record=None, states=states, probe=probe,
+            # NOTE: ClassEval path prompts show un-numbered code (reference
+            # evaluation.py:574-582 numbers only the function families).
+            self._append_probe_job(jobs, gen_entry, states=states, probe=probe,
                                    code=code, codelines=codelines,
                                    invocation=invocation,
-                                   invocation_abbr="the above test code")
+                                   invocation_abbr="the above test code",
+                                   numbered=False)
 
-    def _append_probe_job(self, jobs, gen_entry, *, record, states, probe, code,
-                          codelines, invocation, invocation_abbr):
+    def _append_probe_job(self, jobs, gen_entry, *, states, probe, code,
+                          codelines, invocation, invocation_abbr, numbered):
         lineno = probe["lineno"]
         var = probe.get("var") if self.uses_var else None
         expected = self.ground_truth(states, lineno - 1, var)
         fields = dict(
-            code=self._prompt_code(code, codelines),
+            code=self._prompt_code(code, codelines, numbered),
             invocation=invocation,
             invocation_abbr=invocation_abbr,
             line=lineno,
@@ -300,6 +302,6 @@ class ProbeTask(TaskRunner):
         if self.uses_var:
             fields["var"] = var
         prompt = build_prompt(self.name, self.prompt_type, **fields)
-        jobs.append(ProbeJob(record=record, gen_entry=gen_entry, prompt=prompt,
+        jobs.append(ProbeJob(gen_entry=gen_entry, prompt=prompt,
                              expected=expected, lineno=lineno, var=var,
                              context={"codelines": codelines}))

@@ -37,14 +37,14 @@ class OutputTask(TaskRunner):
                            sandbox, invocation, task_idx, gen_entry, jobs):
         _input = pair["output_pred"]
         prompt = build_prompt("output", self.prompt_type, code=code, invocation="\n" + _input)
-        jobs.append(ProbeJob(record=None, gen_entry=gen_entry, prompt=prompt,
+        jobs.append(ProbeJob(gen_entry=gen_entry, prompt=prompt,
                              context={"space": space, "_input": _input, "kind": "function"}))
 
     def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
                         setup, gen_entry, jobs):
         prompt = build_prompt("output", self.prompt_type, code=test_cls.__doc__,
                               invocation=setup + CLASSEVAL_PRELUDE + _input)
-        jobs.append(ProbeJob(record=None, gen_entry=gen_entry, prompt=prompt,
+        jobs.append(ProbeJob(gen_entry=gen_entry, prompt=prompt,
                              context={"test_cls": test_cls, "_input": _input, "kind": "class"}))
 
     # -- scoring -----------------------------------------------------------

@@ -8,10 +8,19 @@ collection time ahead of us.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the host may pin JAX_PLATFORMS to the TPU
+# platform, where float32 matmuls take bf16 passes and parity tests drift.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A site hook imports jax at interpreter start, before this conftest runs —
+# the env vars above are then too late for jax's config, so set it directly
+# (safe as long as no backend has been initialised yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Repo root on sys.path so `import reval_tpu` works without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

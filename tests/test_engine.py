@@ -1,0 +1,96 @@
+"""TPUEngine generation-loop tests on a tiny random model (CPU, float32)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from reval_tpu.inference.tpu.engine import TPUEngine, _bucket, truncate_at_stop
+from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+from reval_tpu.models import ModelConfig, init_random_params
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = ModelConfig(
+        vocab_size=ByteTokenizer.vocab_size, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+    )
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    return TPUEngine(params, cfg, ByteTokenizer(), batch_size=4, max_seq_len=512)
+
+
+class TestBucketing:
+    def test_bucket_sizes(self):
+        assert _bucket(1) == 64
+        assert _bucket(64) == 64
+        assert _bucket(65) == 128
+        assert _bucket(1000) == 1024
+
+
+class TestTruncate:
+    def test_earliest_stop_wins(self):
+        assert truncate_at_stop("abc[/ANSWER]def", ["[/ANSWER]"]) == "abc"
+        assert truncate_at_stop("a STOP b HALT", ["HALT", "STOP"]) == "a "
+        assert truncate_at_stop("no stops here", ["[/ANSWER]"]) == "no stops here"
+
+
+class TestGeneration:
+    def test_counts_and_budget(self, engine):
+        outs = engine.generate(["hello", "world!"], max_new_tokens=12)
+        assert len(outs) == 2
+        assert all(isinstance(o, str) for o in outs)
+        # byte tokenizer: ≤ 1 char per token
+        assert all(len(o) <= 12 for o in outs)
+
+    def test_order_preserved_across_batches(self, engine):
+        # 6 prompts over batch_size=4 → two batches, sorted by length inside
+        prompts = ["a" * n for n in (5, 90, 17, 33, 2, 70)]
+        outs = engine.generate(prompts, max_new_tokens=4)
+        assert len(outs) == 6
+        # regenerate one-by-one; greedy must match the batched run
+        for i in (0, 1, 4):
+            solo = engine.generate([prompts[i]], max_new_tokens=4)[0]
+            assert solo == outs[i], f"prompt {i} differs batched vs solo"
+
+    def test_greedy_deterministic(self, engine):
+        a = engine.generate(["determinism"], max_new_tokens=8)
+        b = engine.generate(["determinism"], max_new_tokens=8)
+        assert a == b
+
+    def test_sampling_respects_seed_stream(self, engine):
+        outs = engine.generate(["x"], max_new_tokens=8, temperature=1.0)
+        assert len(outs[0]) <= 8
+
+    def test_stats_accumulate(self, engine):
+        before = engine.stats.prompts
+        engine.generate(["count me"], max_new_tokens=2)
+        assert engine.stats.prompts == before + 1
+        assert engine.stats.generated_tokens > 0
+
+    def test_empty_prompt_list(self, engine):
+        assert engine.generate([], max_new_tokens=4) == []
+
+    def test_long_prompt_clipped(self, engine):
+        long_prompt = "y" * 600  # > max_seq_len - max_new_tokens
+        outs = engine.generate([long_prompt], max_new_tokens=8)
+        assert len(outs) == 1
+
+
+class TestStopStrings:
+    def test_stop_string_truncates(self, engine):
+        """Force the stop text into the decode stream via a tokenizer shim."""
+
+        class EchoTokenizer(ByteTokenizer):
+            def decode(self, ids) -> str:
+                # pretend the model emitted the stop string after 3 tokens
+                base = super().decode(ids)
+                return base[:3] + "[/ANSWER]" + base[3:] if len(base) > 3 else base
+
+        shim = TPUEngine(engine.params, engine.cfg, EchoTokenizer(), batch_size=4,
+                         max_seq_len=512)
+        outs = shim.generate(["q"], max_new_tokens=64, stop=["[/ANSWER]"])
+        assert outs[0].endswith("") and "[/ANSWER]" not in outs[0]
+        assert len(outs[0]) == 3
+        # early stop: far fewer than 64 tokens were generated
+        assert shim.stats.generated_tokens < 64

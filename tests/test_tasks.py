@@ -95,6 +95,14 @@ class TestPathE2E:
         _, jobs = planner._plan()
         assert "1\t" in jobs[0].prompt  # line-number prefixes present
 
+    def test_classeval_code_not_numbered(self, tmp_path):
+        # reference evaluation.py:574-582: ClassEval path prompts are raw code
+        planner = PathTask(model=None, prompt_type="direct", dataset="classeval",
+                           mock=True, results_dir=str(tmp_path), max_items=1, progress=False)
+        _, jobs = planner._plan()
+        assert jobs and "1\timport" not in jobs[0].prompt
+        assert "2\t" not in jobs[0].prompt
+
 
 class TestStateE2E:
     def test_oracle_scores_high(self, tmp_path):
