@@ -124,7 +124,54 @@ def run_config(cfg: dict, mock: bool = False) -> dict | float:
             backend.close()
 
 
+def run_taskgen(argv: list[str]) -> int:
+    """Regenerate DREval task/data JSONL (reference taskgen.py __main__)."""
+    from .datasets import Families, DREvalDataset
+    from .datasets.dreval import data_dir
+    from . import taskgen as tg
+
+    parser = argparse.ArgumentParser(prog="reval_tpu taskgen",
+                                     description="(Re)generate DREval task/data files")
+    parser.add_argument("--dataset", default="humaneval_classeval",
+                        choices=["humaneval", "classeval", "humaneval_classeval",
+                                 "mbpp", "mathqa"])
+    parser.add_argument("--out", default=str(data_dir()), help="output directory")
+    args = parser.parse_args(argv)
+    out_dir = args.out
+
+    if args.dataset in ("humaneval", "classeval", "humaneval_classeval"):
+        ds = DREvalDataset.load("humaneval", "main")
+        indices = sorted(i for i in ds.by_idx if i <= Families.CLASSEVAL_END)
+        if args.dataset == "humaneval":
+            indices = [i for i in indices if i <= Families.HUMANEVAL_END]
+        elif args.dataset == "classeval":
+            indices = [i for i in indices if i >= Families.CLASSEVAL_START]
+        rows, stats = tg.generate_humaneval_classeval(ds, indices=indices)
+        path = tg.write_jsonl(f"{out_dir}/DREval_tasks.{args.dataset}.regen.jsonl", rows)
+        print(f"wrote {path}  stats={stats.summary()}")
+    elif args.dataset == "mbpp":
+        rows = tg.load_mbpp_rows()
+        tasks, data, stats = tg.generate_mbpp(rows)
+        print(f"wrote {tg.write_jsonl(f'{out_dir}/DREval_tasks_mbpp.regen.jsonl', tasks)}")
+        print(f"wrote {tg.write_jsonl(f'{out_dir}/DREval_data_mbpp.regen.jsonl', data)}")
+        print(f"stats={stats.summary()}")
+    else:
+        rows = tg.load_mathqa_rows()
+        tasks, data, stats = tg.generate_mathqa(rows)
+        print(f"wrote {tg.write_jsonl(f'{out_dir}/DREval_tasks_mathqa.regen.jsonl', tasks)}")
+        print(f"wrote {tg.write_jsonl(f'{out_dir}/DREval_data_mathqa.regen.jsonl', data)}")
+        print(f"stats={stats.summary()}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "taskgen":
+        # taskgen has its own flag namespace (keeps -o/--output semantics of
+        # config/run intact)
+        return run_taskgen(argv[1:])
+
     parser = argparse.ArgumentParser(prog="reval_tpu",
                                      description="Run DREval tasks with TPU-native inference")
     parser.add_argument("command", nargs="?", default="run", choices=["config", "run"])

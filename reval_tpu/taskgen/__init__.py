@@ -1,0 +1,51 @@
+"""Offline task generation: build DREval task/data JSONL from raw benchmarks.
+
+The pipeline replicates the reference generator's semantics
+(reference taskgen.py:1-613) with an in-tree control-flow partitioner
+(the reference leans on the external ``staticfg`` package plus a monkey
+patch, taskgen.py:33-60) and no interactive debugger or per-row prints.
+
+Stages per program:
+1. :func:`select_probe_lines` — basic-block analysis picks the lines used by
+   the coverage/path tasks (reference ``inspect_execution``, taskgen.py:111-132);
+2. ground-truth execution of the program in a :class:`~reval_tpu.dynamics.Sandbox`;
+3. :func:`select_state_probes` — static LHS extraction + dynamic trace-diff
+   picks ``(line, var)`` probes (reference ``inspect_variable``, taskgen.py:145-240);
+4. intersection: only lines that both analyses recommend become tasks
+   (reference taskgen.py:334-336,479-481,569-571);
+5. an ``output_pred`` assert with the expected value masked to ``??``.
+"""
+
+from .blocks import BasicBlock, partition_blocks, select_probe_lines
+from .variables import select_state_probes
+from .classeval import mask_first_assert
+from .asserts import parse_assert_statement
+from .pipeline import (
+    TaskGenStats,
+    format_code,
+    generate_humaneval_classeval,
+    generate_mbpp,
+    generate_mathqa,
+    load_mbpp_rows,
+    load_mathqa_rows,
+    probes_for_function,
+    write_jsonl,
+)
+
+__all__ = [
+    "BasicBlock",
+    "partition_blocks",
+    "select_probe_lines",
+    "select_state_probes",
+    "mask_first_assert",
+    "parse_assert_statement",
+    "TaskGenStats",
+    "format_code",
+    "generate_humaneval_classeval",
+    "generate_mbpp",
+    "generate_mathqa",
+    "load_mbpp_rows",
+    "load_mathqa_rows",
+    "probes_for_function",
+    "write_jsonl",
+]

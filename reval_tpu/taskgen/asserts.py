@@ -1,0 +1,41 @@
+"""MBPP test-assert parsing: ``assert f(args) == expected`` → parts.
+
+MBPP ships its test cases as assert statement strings; the generator needs
+the callee, the argument tuple text, and the expected-value text
+(reference ``parse_assert_statement``, taskgen.py:19,265-278 — a single
+regex there; we parse the AST instead so nested parens/strings in the
+arguments cannot break the split).
+"""
+
+from __future__ import annotations
+
+import ast
+
+__all__ = ["parse_assert_statement"]
+
+
+def parse_assert_statement(statement: str) -> tuple[str, str, str]:
+    """Split one ``assert fn(<args>) == <expected>`` statement.
+
+    Returns ``(fn_name, "(<args>)", "<expected>")``; raises ``ValueError``
+    for anything that is not a simple equality assert on a call.
+    """
+    try:
+        tree = ast.parse(statement.strip())
+    except SyntaxError as e:
+        raise ValueError(f"unparsable assert statement: {statement!r}") from e
+    if len(tree.body) != 1 or not isinstance(tree.body[0], ast.Assert):
+        raise ValueError(f"not a single assert statement: {statement!r}")
+    test = tree.body[0].test
+    if not (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Eq)
+        and isinstance(test.left, ast.Call)
+        and isinstance(test.left.func, ast.Name)
+    ):
+        raise ValueError(f"not an `assert fn(...) == expected` form: {statement!r}")
+    call = test.left
+    args = ", ".join(ast.unparse(a) for a in call.args)
+    expected = ast.unparse(test.comparators[0])
+    return call.func.id, f"({args})", expected

@@ -1,0 +1,201 @@
+"""Mini control-flow graph over Python source + probe-line selection.
+
+The reference derives coverage/path probe lines from a CFG built by the
+external ``staticfg`` package (reference taskgen.py:62-75, 111-132).  We
+build an equivalent graph directly from the ``ast``.  Two properties of
+that builder are load-bearing and reproduced here:
+
+- **Block membership.**  Simple statements accumulate into the current
+  block; an ``if`` is appended to its predecessor block before branching
+  (so the block's last *interesting* statement is the one before the
+  test); a loop head sits alone in a guard block; ``return``/``raise``/
+  ``break``/``continue`` terminate a block; ``def`` statements are
+  appended to the enclosing block and their bodies become separate
+  sub-graphs; ``try``/``with``/``class`` bodies flatten into the current
+  stream (the reference CFG builder traverses those nodes generically).
+- **Iteration order.**  Blocks are yielded in BFS order from the entry,
+  with branch/loop *successor* blocks enqueued the moment their parent is
+  visited — so an after-loop block is visited before the loop body's inner
+  blocks, and unreachable blocks (code after a ``return``) are never
+  yielded.  The variable analysis's nearest-previous-variable fallback
+  (variables.py) depends on exactly this order.
+
+Selection keeps, per block, the **last** statement of an "interesting"
+kind — assignments, returns, non-constant expressions (reference
+taskgen.py:22-27, 119-132) — because last-in-block statements make the
+next-line task non-trivial (the successor is in another block).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+__all__ = ["BasicBlock", "partition_blocks", "select_probe_lines", "is_interesting_stmt"]
+
+# Statement kinds eligible as probe lines (reference taskgen.py:23-24).
+WANTED_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Return, ast.Expr)
+# Bare-expression statements of these kinds are noise, e.g. docstrings
+# (reference taskgen.py:27).
+EXCLUDED_EXPRS = (ast.Constant,)
+
+
+def is_interesting_stmt(stmt: ast.stmt) -> bool:
+    if not isinstance(stmt, WANTED_STMTS):
+        return False
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, EXCLUDED_EXPRS):
+        return False
+    return True
+
+
+@dataclass
+class BasicBlock:
+    statements: list[ast.stmt] = field(default_factory=list)
+    exits: list["BasicBlock"] = field(default_factory=list)
+
+    def last_interesting(self) -> ast.stmt | None:
+        for stmt in reversed(self.statements):
+            if is_interesting_stmt(stmt):
+                return stmt
+        return None
+
+
+class _GraphBuilder:
+    """Builds one block graph; function bodies become child builders."""
+
+    def __init__(self):
+        self.entry = BasicBlock()
+        self.current: BasicBlock = self.entry
+        self.children: list[_GraphBuilder] = []
+        self._loop_after: list[BasicBlock] = []
+        self._loop_guard: list[BasicBlock] = []
+
+    # -- graph bookkeeping -------------------------------------------------
+    def _edge(self, src: BasicBlock, dst: BasicBlock) -> None:
+        src.exits.append(dst)
+
+    def _start_block(self) -> BasicBlock:
+        self.current = BasicBlock()
+        return self.current
+
+    # -- traversal ---------------------------------------------------------
+    def feed(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._feed_stmt(stmt)
+
+    def _feed_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.current.statements.append(stmt)
+            child = _GraphBuilder()
+            child.feed(stmt.body)
+            self.children.append(child)
+        elif isinstance(stmt, ast.ClassDef):
+            # class bodies flatten into the enclosing stream (methods still
+            # get their own sub-graphs via the branch above)
+            self.feed(stmt.body)
+        elif isinstance(stmt, ast.If):
+            self._feed_if(stmt)
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            self._feed_loop(stmt)
+        elif isinstance(stmt, ast.Try):
+            # flattened: body, handler bodies, orelse, finalbody in order
+            self.feed(stmt.body)
+            for handler in stmt.handlers:
+                self.feed(handler.body)
+            self.feed(stmt.orelse)
+            self.feed(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.feed(stmt.body)
+        elif isinstance(stmt, (ast.Return, ast.Raise)):
+            self.current.statements.append(stmt)
+            self._start_block()  # unreachable until linked (dead code stays dead)
+        elif isinstance(stmt, ast.Break):
+            self.current.statements.append(stmt)
+            if self._loop_after:
+                self._edge(self.current, self._loop_after[-1])
+            self._start_block()
+        elif isinstance(stmt, ast.Continue):
+            self.current.statements.append(stmt)
+            if self._loop_guard:
+                self._edge(self.current, self._loop_guard[-1])
+            self._start_block()
+        else:
+            # Assign/AugAssign/AnnAssign/Expr/Assert/Import/Global/Pass/...
+            self.current.statements.append(stmt)
+
+    def _feed_if(self, stmt: ast.If) -> None:
+        self.current.statements.append(stmt)
+        head = self.current
+        body_entry = BasicBlock()
+        after = BasicBlock()
+        self._edge(head, body_entry)           # branch target enqueued first
+        if stmt.orelse:
+            else_entry = BasicBlock()
+            self._edge(head, else_entry)
+            self.current = else_entry
+            self.feed(stmt.orelse)
+            if not self.current.exits:
+                self._edge(self.current, after)
+        else:
+            self._edge(head, after)
+        self.current = body_entry
+        self.feed(stmt.body)
+        if not self.current.exits:
+            self._edge(self.current, after)
+        self.current = after
+
+    def _feed_loop(self, stmt: ast.While | ast.For | ast.AsyncFor) -> None:
+        guard = BasicBlock([stmt])
+        self._edge(self.current, guard)
+        body_entry = BasicBlock()
+        after = BasicBlock()
+        self._edge(guard, body_entry)          # body first, then after-loop
+        self._edge(guard, after)
+        # NOTE: loop `else` bodies are deliberately NOT traversed — the
+        # reference's CFG builder ignores them, so their lines never become
+        # probes in the shipped datasets; regeneration must match.
+        self._loop_guard.append(guard)
+        self._loop_after.append(after)
+        self.current = body_entry
+        self.feed(stmt.body)
+        if not self.current.exits:
+            self._edge(self.current, guard)    # loop back
+        self._loop_guard.pop()
+        self._loop_after.pop()
+        self.current = after
+
+    # -- ordered iteration -------------------------------------------------
+    def ordered_blocks(self) -> list[BasicBlock]:
+        """BFS from entry (unreachable blocks pruned), then sub-graphs."""
+        out: list[BasicBlock] = []
+        seen = {id(self.entry)}
+        queue = [self.entry]
+        while queue:
+            block = queue.pop(0)
+            out.append(block)
+            for nxt in block.exits:
+                if id(nxt) not in seen:
+                    seen.add(id(nxt))
+                    queue.append(nxt)
+        for child in self.children:
+            out.extend(child.ordered_blocks())
+        return out
+
+
+def partition_blocks(code: str) -> list[BasicBlock]:
+    """Basic blocks of ``code`` in analysis order (empty blocks pruned)."""
+    tree = ast.parse(code)
+    builder = _GraphBuilder()
+    builder.feed(tree.body)
+    return [b for b in builder.ordered_blocks() if b.statements]
+
+
+def select_probe_lines(code: str) -> set[int]:
+    """1-indexed lines recommended for the coverage and path tasks: the
+    last interesting statement of every basic block."""
+    lines: set[int] = set()
+    for block in partition_blocks(code):
+        stmt = block.last_interesting()
+        if stmt is not None:
+            lines.add(stmt.lineno)
+    return lines

@@ -1,0 +1,52 @@
+"""ClassEval output-task construction: mask an assertion's expected value.
+
+Given a ClassEval per-input test snippet (straight-line unittest assert
+calls), pick the assertion whose kind is most informative and replace its
+expected-value argument with the placeholder ``??`` (reference
+``inspect_test``, taskgen.py:242-262).  The model is later asked to fill
+the ``??`` back in, and the completed statement is executed as the verdict.
+"""
+
+from __future__ import annotations
+
+import ast
+
+__all__ = ["mask_first_assert", "ASSERT_PREFERENCE"]
+
+# Preference order over unittest assert kinds (reference taskgen.py:29-31):
+# value-comparing asserts are the most informative output probes.
+ASSERT_PREFERENCE = [
+    "assertEqual",
+    "assertNotEqual",
+    "assertAlmostEqual",
+    "assertTrue",
+    "assertFalse",
+    "assertIsNone",
+    "assertIsNotNone",
+    "assertIn",
+    "assertNotIn",
+]
+
+
+def mask_first_assert(test_code: str) -> str | None:
+    """Mask the expected value of every recognised assert call with ``??``.
+
+    Returns the transformed source, or ``None`` when the snippet contains
+    no recognised assertion (such inputs are skipped by the generator,
+    reference taskgen.py:588-590).
+    """
+    tree = ast.parse(test_code)
+    calls: list[ast.Call] = []
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            func = stmt.value.func
+            if isinstance(func, ast.Name) and func.id in ASSERT_PREFERENCE:
+                calls.append(stmt.value)
+    if not calls:
+        return None
+    for call in sorted(calls, key=lambda c: ASSERT_PREFERENCE.index(c.func.id)):
+        # two-arg asserts compare (actual, expected): mask the expected side;
+        # one-arg asserts (assertTrue/...) mask their only argument
+        idx = 1 if len(call.args) >= 2 else 0
+        call.args[idx] = ast.Name(id="??")
+    return ast.unparse(tree)

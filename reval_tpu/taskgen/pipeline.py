@@ -1,0 +1,387 @@
+"""End-to-end task-generation pipelines per dataset family.
+
+Each generator runs the program under the tracer, combines the static
+line analysis with the dynamic variable analysis, and emits rows in the
+shipped ``DREval_tasks*.jsonl`` / ``DREval_data*.jsonl`` schemas
+(reference taskgen.py:290-613; schema documented in SURVEY §2.23).
+
+A probe line must be recommended by **both** analyses: the control-flow
+selection (:func:`~reval_tpu.taskgen.blocks.select_probe_lines`) and the
+variable selection (:func:`~reval_tpu.taskgen.variables.select_state_probes`)
+— reference taskgen.py:334-336,479-481,569-571.  Each selected line carries
+the first variable recommended for it, in program order (deterministic,
+unlike the reference's set iteration — taskgen.py:547-548).
+
+External dataset loads (HF ``datasets``) and source formatting (``black``)
+are optional: the loaders raise a clear error when the package is absent,
+and formatting falls back to an AST round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..datasets import MAX_INPUTS, Families
+from ..dynamics import CodeSpace, ExecutionTrace, Sandbox
+from ..datasets.dreval import ClassEvalHooks, DREvalDataset
+from .asserts import parse_assert_statement
+from .blocks import select_probe_lines
+from .classeval import mask_first_assert
+from .variables import select_state_probes
+
+__all__ = [
+    "TaskGenStats",
+    "format_code",
+    "probes_for_function",
+    "generate_humaneval_classeval",
+    "generate_mbpp",
+    "generate_mathqa",
+    "load_mbpp_rows",
+    "load_mathqa_rows",
+    "write_jsonl",
+]
+
+# MBPP rows whose programs hang, exhaust memory, or need test setup —
+# the reference's skip list (taskgen.py:422-424) expressed in DREval ids.
+MBPP_SKIP_IDS = frozenset({210, 265, 266, 272, 276, 285, 438, 475, 483, 541, 562})
+
+
+@dataclass
+class TaskGenStats:
+    valid: list[tuple[int, int]] = field(default_factory=list)
+    empty: list[tuple[int, int]] = field(default_factory=list)
+    invalid: list[tuple[int, int]] = field(default_factory=list)
+
+    def summary(self) -> dict:
+        return {
+            "valid": len(self.valid),
+            "empty": len(self.empty),
+            "invalid": len(self.invalid),
+            "valid_items": len({i for i, _ in self.valid}),
+        }
+
+
+def format_code(code: str, line_length: int = 120) -> str:
+    """``black``-format when available, else normalise via AST round-trip."""
+    try:
+        import black  # type: ignore
+
+        return black.format_str(code, mode=black.Mode(line_length=line_length))
+    except ImportError:
+        import ast
+
+        return ast.unparse(ast.parse(code)) + "\n"
+
+
+def probes_for_function(code: str, trace: ExecutionTrace) -> list[dict]:
+    """Intersect line and variable analyses into ``{'lineno', 'var'}`` probes."""
+    exec_lines = select_probe_lines(code)
+    var_probes = select_state_probes(code, trace)
+    first_var: dict[int, str] = {}
+    for lineno, var in var_probes:
+        first_var.setdefault(lineno, var)
+    return [
+        {"lineno": lineno, "var": first_var[lineno]}
+        for lineno in sorted(exec_lines & first_var.keys())
+    ]
+
+
+def _call_repr(entry: str, input_repr: str) -> str:
+    """``"(a, b,)"`` input repr → ``entry(a, b)`` call text
+    (reference taskgen.py:573 strips the trailing ``,)``)."""
+    inner = input_repr.strip()
+    if inner.endswith(",)"):
+        inner = inner[:-2] + ")"
+    return f"{entry}{inner}"
+
+
+# ---------------------------------------------------------------------------
+# HumanEval + ClassEval (regeneration from the shipped data files)
+# ---------------------------------------------------------------------------
+
+def generate_humaneval_classeval(
+    dataset: DREvalDataset,
+    indices: list[int] | None = None,
+    *,
+    max_inputs: int = MAX_INPUTS,
+    sandbox_timeout: float = 120.0,
+) -> tuple[list[dict], TaskGenStats]:
+    """Rebuild task rows for the HumanEval/ClassEval families from a loaded
+    data split (reference ``process_dataset``, taskgen.py:549-608)."""
+    stats = TaskGenStats()
+    rows: list[dict] = []
+    if indices is None:
+        indices = sorted(i for i in dataset.by_idx if i <= Families.CLASSEVAL_END)
+    for idx in indices:
+        item = {"task_id": f"DREval/{idx}", "idx": idx, "tasks": []}
+        try:
+            if idx <= Families.HUMANEVAL_END:
+                _gen_function_item(dataset, idx, item, stats, max_inputs, sandbox_timeout)
+            else:
+                _gen_class_item(dataset, idx, item, stats, max_inputs, sandbox_timeout)
+        except Exception:
+            # e.g. programs importing packages absent from this machine;
+            # the item is kept with whatever inputs succeeded
+            stats.invalid.append((idx, -1))
+        rows.append(item)
+    return rows, stats
+
+
+def _gen_function_item(dataset, idx, item, stats, max_inputs, timeout):
+    code = dataset.code(idx)
+    entry = dataset.entry_point(idx)
+    space = CodeSpace()
+    space.load_function(entry, code)
+    sandbox = Sandbox(space.ns[entry], timeout=timeout)
+    for input_idx, input_repr in enumerate(dataset.inputs(idx)):
+        if len(item["tasks"]) >= max_inputs:
+            break
+        args = space.eval_invocation(input_repr)
+        _, trace = sandbox.run(*args)
+        assert sandbox.status == "ok", f"{sandbox.status} on DREval/{idx} input {input_idx}"
+        task = probes_for_function(code, trace)
+        if task:
+            item["tasks"].append({
+                "input_idx": input_idx,
+                "task": task,
+                "output_pred": f"assert {_call_repr(entry, input_repr)} == ??",
+            })
+            stats.valid.append((idx, input_idx))
+        else:
+            stats.empty.append((idx, input_idx))
+
+
+def _gen_class_item(dataset, idx, item, stats, max_inputs, timeout):
+    code = dataset.code(idx)
+    cls_name = dataset.entry_point(idx)
+    space = CodeSpace()
+    space.load_class(cls_name, code)
+    test_classes = space.load_test_classes(
+        cls_name, code, dataset.test_code(idx),
+        ClassEvalHooks.name_pattern, ClassEvalHooks.validation, ClassEvalHooks.postprocess,
+    )
+    inputs = dataset.inputs(idx)
+    assert len(test_classes) == len(inputs), f"test class/input mismatch on DREval/{idx}"
+    for input_idx, test_cls in enumerate(test_classes):
+        if len(item["tasks"]) >= max_inputs:
+            break
+        output_pred = mask_first_assert(inputs[input_idx])
+        if output_pred is None:
+            stats.empty.append((idx, input_idx))
+            continue
+        obj = test_cls()
+        if hasattr(obj, "setUp"):
+            obj.setUp()
+        sandbox = Sandbox(obj.dreval_test, timeout=timeout)
+        _, trace = sandbox.run()
+        assert sandbox.status == "ok", f"{sandbox.status} on DREval/{idx} input {input_idx}"
+        task = probes_for_function(code, trace)
+        if task:
+            item["tasks"].append(
+                {"input_idx": input_idx, "task": task, "output_pred": output_pred})
+            stats.valid.append((idx, input_idx))
+        else:
+            stats.empty.append((idx, input_idx))
+
+
+# ---------------------------------------------------------------------------
+# MBPP (from raw upstream rows)
+# ---------------------------------------------------------------------------
+
+def _repair_and_run(sandbox: Sandbox, space: CodeSpace, input_repr: str):
+    """Run with input auto-repair (reference taskgen.py:456-470): a
+    ``TypeError`` retries with a 1-tuple'd argument string; an in-program
+    exception retries with the whole input wrapped in a list."""
+    for attempt in range(3):
+        try:
+            args = space.eval_invocation(input_repr)
+            result, trace = sandbox.run(*args)
+        except TypeError:
+            input_repr = input_repr.replace(")", ",)")
+            continue
+        if "exception" in sandbox.status and attempt == 0:
+            input_repr = f"[{input_repr},]"
+            continue
+        return result, trace, input_repr
+    return None, None, input_repr
+
+
+def generate_mbpp(
+    raw_rows: list[dict],
+    *,
+    start_idx: int = Families.MBPP_START,
+    skip_ids: frozenset[int] = MBPP_SKIP_IDS,
+    max_inputs: int = MAX_INPUTS,
+    sandbox_timeout: float = 120.0,
+    fmt: bool = True,
+) -> tuple[list[dict], list[dict], TaskGenStats]:
+    """Build (tasks_rows, data_rows) from upstream MBPP test-split rows
+    (reference ``process_mbpp_dataset``, taskgen.py:413-544)."""
+    stats = TaskGenStats()
+    tasks_rows: list[dict] = []
+    data_rows: list[dict] = []
+    for offset, row in enumerate(raw_rows):
+        idx = start_idx + offset
+        if idx in skip_ids:
+            continue
+        if row.get("test_setup_code", "").strip():
+            continue  # programs needing setup code are out of scope
+        code = row["code"].replace("\r\n", "\n")
+        if fmt:
+            code = format_code(code)
+        item = {"task_id": f"DREval/{idx}", "idx": idx, "tasks": []}
+        inputs, invocations, outputs, fn_names = [], [], [], []
+        for test_idx, assert_stmt in enumerate(row["test_list"]):
+            if len(item["tasks"]) >= max_inputs:
+                break
+            try:
+                fn_name, input_repr, _ = parse_assert_statement(assert_stmt)
+                invocation = format_code(f"{fn_name}{input_repr}") if fmt else f"{fn_name}{input_repr}"
+                space = CodeSpace()
+                fn = space.load_function(fn_name, code)
+                sandbox = Sandbox(fn, timeout=sandbox_timeout)
+                result, trace, input_repr = _repair_and_run(sandbox, space, input_repr)
+                assert sandbox.status == "ok", f"{sandbox.status} on DREval/{idx}: {fn_name}{input_repr}"
+                # input_idx indexes the *recorded* inputs list so the task
+                # engine's inputs[input_idx] lookup always aligns, even when
+                # an earlier test case was dropped (the reference keeps the
+                # raw test-list index, which can misalign after a skip —
+                # taskgen.py:441,473)
+                input_idx = len(inputs)
+                inputs.append(input_repr)
+                fn_names.append(fn_name)
+                outputs.append(result)
+                invocations.append(invocation)
+                task = probes_for_function(code, trace)
+                if task:
+                    item["tasks"].append({
+                        "input_idx": input_idx,
+                        "task": task,
+                        "output_pred": f"assert {invocation}) == ??",
+                    })
+                    stats.valid.append((idx, input_idx))
+                else:
+                    stats.empty.append((idx, input_idx))
+            except Exception:
+                stats.invalid.append((idx, test_idx))
+        if not item["tasks"] or len(set(fn_names)) != 1:
+            continue
+        data_entry = {
+            "task_id": item["task_id"],
+            "code": code,
+            "entry_point": fn_names[0],
+            "inputs": inputs,
+            "outputs": outputs,
+            "innvocations": invocations,  # (sic) upstream schema, SURVEY §2.23
+        }
+        try:
+            json.dumps(data_entry)
+        except (TypeError, ValueError):
+            continue  # non-JSON-serialisable outputs
+        data_rows.append(data_entry)
+        tasks_rows.append(item)
+    return tasks_rows, data_rows, stats
+
+
+# ---------------------------------------------------------------------------
+# MathQA (from raw upstream rows)
+# ---------------------------------------------------------------------------
+
+def _wrap_mathqa(code: str) -> str:
+    """Wrap straight-line MathQA code in ``def main(): …; return answer``
+    (reference taskgen.py:283-288)."""
+    indented = "\n".join(f"    {line}" for line in code.splitlines())
+    return f"def main():\n{indented}\n    return answer\n\nmain()"
+
+
+def generate_mathqa(
+    raw_rows: list[dict],
+    *,
+    start_idx: int = Families.MATHQA_START,
+    sandbox_timeout: float = 120.0,
+    fmt: bool = True,
+) -> tuple[list[dict], list[dict], TaskGenStats]:
+    """Build (tasks_rows, data_rows) from upstream MathQA-Python rows
+    (reference ``process_mathqa_dataset``, taskgen.py:290-409).  Each row
+    has exactly one input: the nullary ``main()`` invocation."""
+    stats = TaskGenStats()
+    tasks_rows: list[dict] = []
+    data_rows: list[dict] = []
+    for row in raw_rows:
+        idx = int(row["task_id"]) + start_idx
+        code = _wrap_mathqa(row["code"].replace("\r\n", "\n"))
+        if fmt:
+            code = format_code(code)
+        item = {"task_id": f"DREval/{idx}", "idx": idx, "tasks": []}
+        try:
+            invocation = format_code("main()") if fmt else "main()"
+            space = CodeSpace()
+            fn = space.load_function("main", code)
+            sandbox = Sandbox(fn, timeout=sandbox_timeout)
+            result, trace = sandbox.run()
+            assert sandbox.status == "ok", f"{sandbox.status} on DREval/{idx}"
+            task = probes_for_function(code, trace)
+        except Exception:
+            stats.invalid.append((idx, 0))
+            continue
+        if not task:
+            stats.empty.append((idx, 0))
+            continue
+        item["tasks"].append({
+            "input_idx": 0,
+            "task": task,
+            "output_pred": f"assert {invocation}) == ??",
+        })
+        stats.valid.append((idx, 0))
+        data_entry = {
+            "task_id": item["task_id"],
+            "code": code,
+            "entry_point": "main",
+            "inputs": [[]],
+            "outputs": [result],
+            "innvocations": [invocation],
+        }
+        try:
+            json.dumps(data_entry)
+        except (TypeError, ValueError):
+            continue
+        data_rows.append(data_entry)
+        tasks_rows.append(item)
+    return tasks_rows, data_rows, stats
+
+
+# ---------------------------------------------------------------------------
+# upstream loaders / IO
+# ---------------------------------------------------------------------------
+
+def load_mbpp_rows():
+    """MBPP test split via HF ``datasets`` (reference taskgen.py:419)."""
+    try:
+        from datasets import load_dataset  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            "the `datasets` package is required to fetch MBPP; "
+            "pass pre-downloaded rows instead") from e
+    return list(load_dataset("google-research-datasets/mbpp", "full")["test"])
+
+
+def load_mathqa_rows():
+    """MathQA-Python test split via HF ``datasets`` (reference taskgen.py:296)."""
+    try:
+        from datasets import load_dataset  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            "the `datasets` package is required to fetch MathQA; "
+            "pass pre-downloaded rows instead") from e
+    return list(load_dataset("dtruong46me/mathqa-python")["test"])
+
+
+def write_jsonl(path: str | Path, rows: list[dict]) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    return path

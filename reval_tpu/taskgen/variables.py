@@ -1,0 +1,125 @@
+"""(line, var) probe selection for the state task.
+
+Static + dynamic analysis over one traced execution (reference
+``inspect_variable``, taskgen.py:145-240):
+
+- **assignments** contribute their LHS targets, skipping trivially-constant
+  RHS values (``a = 0``, ``xs = []`` — reference taskgen.py:77-97) and the
+  ``_`` placeholder; augmented assignments always count;
+- **returns** contribute returned names (or, for ``return <constant>``, the
+  nearest previously-selected variable — reference taskgen.py:194-198);
+- **bare expressions** (mutating calls like ``xs.append(1)``) are probed
+  dynamically: diff the tracer snapshots before vs after each visit to the
+  line — new locals, changed locals, and changed ``self.*`` attributes
+  (reference taskgen.py:201-236).
+
+Returns an ordered, de-duplicated list so downstream "first var for a line"
+selection is deterministic (the reference iterates a ``set`` and documents
+that its output can reshuffle between runs, taskgen.py:547-548).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..dynamics import ExecutionTrace
+from .blocks import is_interesting_stmt, partition_blocks
+
+__all__ = ["select_state_probes"]
+
+
+def _constant_ish(value: ast.expr | None) -> bool:
+    """RHS values too trivial to ask about (reference taskgen.py:77-97)."""
+    if isinstance(value, ast.Constant):
+        return True
+    if isinstance(value, (ast.List, ast.Tuple, ast.Set)):
+        return all(isinstance(e, ast.Constant) for e in value.elts)
+    if isinstance(value, ast.Dict):
+        return len(value.keys) == 0
+    return False
+
+
+def _diff_names(before, after) -> set[str]:
+    """Variables that a line's execution created or changed."""
+    names: set[str] = set()
+    for s1, s2 in zip(before, after):
+        l1, l2 = s1.locals, s2.locals
+        names |= l2.keys() - l1.keys()
+        for name in l1.keys() & l2.keys():
+            try:
+                if l1[name] != l2[name]:
+                    names.add(name)
+            except ValueError:
+                pass  # ambiguous truthiness (numpy arrays)
+        if "self" in l1 and "self" in l2:
+            d1 = getattr(l1["self"], "__dict__", {})
+            d2 = getattr(l2["self"], "__dict__", {})
+            for attr in d1.keys() & d2.keys():
+                try:
+                    if d1[attr] != d2[attr]:
+                        names.add(f"self.{attr}")
+                except ValueError:
+                    pass
+    return names
+
+
+def _subscript_adhoc(var: str) -> str:
+    """Subscripts keyed by a call are unanswerable for the model; probe the
+    container instead (reference taskgen.py:134-143 hard-codes the one
+    ClassEval instance; we generalise by pattern)."""
+    try:
+        node = ast.parse(var, mode="eval").body
+    except SyntaxError:
+        return var
+    if isinstance(node, ast.Subscript) and any(
+        isinstance(n, ast.Call) for n in ast.walk(node.slice)
+    ):
+        return ast.unparse(node.value)
+    return var
+
+
+def select_state_probes(code: str, trace: ExecutionTrace) -> list[tuple[int, str]]:
+    """Ordered unique ``(1-indexed lineno, var expression)`` probes."""
+    probes: list[tuple[int, str]] = []
+    seen: set[tuple[int, str]] = set()
+
+    def add(lineno: int, var: str) -> None:
+        var = _subscript_adhoc(var)
+        if var != "_" and (lineno, var) not in seen:
+            seen.add((lineno, var))
+            probes.append((lineno, var))
+
+    for block in partition_blocks(code):
+        for stmt in block.statements:
+            if not is_interesting_stmt(stmt):
+                continue
+            if isinstance(stmt, ast.Assign):
+                if _constant_ish(stmt.value):
+                    continue
+                for target in stmt.targets:
+                    add(stmt.lineno, ast.unparse(target).strip())
+            elif isinstance(stmt, ast.AugAssign):
+                add(stmt.lineno, ast.unparse(stmt.target).strip())
+            elif isinstance(stmt, ast.AnnAssign):
+                if _constant_ish(stmt.value):
+                    continue
+                add(stmt.lineno, ast.unparse(stmt.target).strip())
+            elif isinstance(stmt, ast.Return):
+                if isinstance(stmt.value, ast.Name):
+                    add(stmt.lineno, stmt.value.id)
+                elif isinstance(stmt.value, ast.Tuple):
+                    for elt in stmt.value.elts:
+                        if isinstance(elt, ast.Name):
+                            add(stmt.lineno, elt.id)
+                elif isinstance(stmt.value, ast.Constant):
+                    for lineno, name in reversed(probes):
+                        if lineno < stmt.lineno:
+                            add(stmt.lineno, name)
+                            break
+            elif isinstance(stmt, ast.Expr):
+                before = trace.states_before(stmt.lineno - 1)
+                after = trace.states_after(stmt.lineno - 1)
+                for name in sorted(_diff_names(before, after)):
+                    if name != "self":
+                        add(stmt.lineno, name)
+    return probes
