@@ -107,7 +107,10 @@ def run_config(cfg: dict, mock: bool = False) -> dict | float:
                                    results_dir=cfg.get("results_dir", "model_generations"))
         return scorer.run()
 
-    if mock or cfg.get("custom_mock"):
+    if cfg.get("prompt_type") == "tot":
+        # trace-of-thoughts runs score trace dumps; no model backend exists
+        backend = None
+    elif mock or cfg.get("custom_mock"):
         backend = None
         cfg["custom_mock"] = True
     else:
@@ -115,8 +118,9 @@ def run_config(cfg: dict, mock: bool = False) -> dict | float:
             **{k: v for k, v in cfg.items() if k not in ("task", "mock")},
             mock=bool(cfg.get("mock")) or cfg.get("backend") == "mock")
     task_cls = TASKS[task_name]
+    # model_id stays in the kwargs: tot runs use it for the results-dir name
     task = task_cls(model=backend,
-                    **{k: v for k, v in cfg.items() if k not in ("task", "model_id", "backend")})
+                    **{k: v for k, v in cfg.items() if k not in ("task", "backend")})
     try:
         return task.run()
     finally:
@@ -164,6 +168,24 @@ def run_taskgen(argv: list[str]) -> int:
     return 0
 
 
+def run_tot_oracle(argv: list[str]) -> int:
+    """Write ground-truth trace-of-thoughts dumps for a dataset slice."""
+    from .tot import write_oracle_dumps
+
+    parser = argparse.ArgumentParser(prog="reval_tpu tot-oracle",
+                                     description="Generate oracle ToT trace dumps")
+    parser.add_argument("--dataset", default="humaneval",
+                        choices=["humaneval", "classeval", "mbpp", "mathqa"])
+    parser.add_argument("--base-dir", required=True)
+    parser.add_argument("--run-name", default="oracle")
+    parser.add_argument("--max-items", type=int, default=None)
+    args = parser.parse_args(argv)
+    n = write_oracle_dumps(args.dataset, args.base_dir, args.run_name,
+                           max_items=args.max_items)
+    print(f"wrote {n} trace dumps under {args.base_dir}/{args.run_name}/{args.dataset}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -171,6 +193,8 @@ def main(argv: list[str] | None = None) -> int:
         # taskgen has its own flag namespace (keeps -o/--output semantics of
         # config/run intact)
         return run_taskgen(argv[1:])
+    if argv and argv[0] == "tot-oracle":
+        return run_tot_oracle(argv[1:])
 
     parser = argparse.ArgumentParser(prog="reval_tpu",
                                      description="Run DREval tasks with TPU-native inference")

@@ -18,7 +18,7 @@ Stages per program:
 
 from .blocks import BasicBlock, partition_blocks, select_probe_lines
 from .variables import select_state_probes
-from .classeval import mask_first_assert
+from .classeval import mask_asserts
 from .asserts import parse_assert_statement
 from .pipeline import (
     TaskGenStats,
@@ -37,7 +37,7 @@ __all__ = [
     "partition_blocks",
     "select_probe_lines",
     "select_state_probes",
-    "mask_first_assert",
+    "mask_asserts",
     "parse_assert_statement",
     "TaskGenStats",
     "format_code",

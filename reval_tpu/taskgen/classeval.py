@@ -1,21 +1,21 @@
-"""ClassEval output-task construction: mask an assertion's expected value.
+"""ClassEval output-task construction: mask assertions' expected values.
 
 Given a ClassEval per-input test snippet (straight-line unittest assert
-calls), pick the assertion whose kind is most informative and replace its
-expected-value argument with the placeholder ``??`` (reference
-``inspect_test``, taskgen.py:242-262).  The model is later asked to fill
-the ``??`` back in, and the completed statement is executed as the verdict.
+calls), replace the expected-value argument of **every** recognised
+assertion with the placeholder ``??`` (reference ``inspect_test``,
+taskgen.py:242-262 — the shipped data confirms all asserts are masked).
+The model is later asked to fill the ``??`` back in, and the completed
+statement is executed as the verdict.
 """
 
 from __future__ import annotations
 
 import ast
 
-__all__ = ["mask_first_assert", "ASSERT_PREFERENCE"]
+__all__ = ["mask_asserts", "RECOGNISED_ASSERTS"]
 
-# Preference order over unittest assert kinds (reference taskgen.py:29-31):
-# value-comparing asserts are the most informative output probes.
-ASSERT_PREFERENCE = [
+# unittest assert kinds treated as output probes (reference taskgen.py:29-31)
+RECOGNISED_ASSERTS = frozenset({
     "assertEqual",
     "assertNotEqual",
     "assertAlmostEqual",
@@ -25,10 +25,10 @@ ASSERT_PREFERENCE = [
     "assertIsNotNone",
     "assertIn",
     "assertNotIn",
-]
+})
 
 
-def mask_first_assert(test_code: str) -> str | None:
+def mask_asserts(test_code: str) -> str | None:
     """Mask the expected value of every recognised assert call with ``??``.
 
     Returns the transformed source, or ``None`` when the snippet contains
@@ -40,11 +40,11 @@ def mask_first_assert(test_code: str) -> str | None:
     for stmt in tree.body:
         if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
             func = stmt.value.func
-            if isinstance(func, ast.Name) and func.id in ASSERT_PREFERENCE:
+            if isinstance(func, ast.Name) and func.id in RECOGNISED_ASSERTS:
                 calls.append(stmt.value)
     if not calls:
         return None
-    for call in sorted(calls, key=lambda c: ASSERT_PREFERENCE.index(c.func.id)):
+    for call in calls:
         # two-arg asserts compare (actual, expected): mask the expected side;
         # one-arg asserts (assertTrue/...) mask their only argument
         idx = 1 if len(call.args) >= 2 else 0

@@ -28,7 +28,7 @@ from ..dynamics import CodeSpace, ExecutionTrace, Sandbox
 from ..datasets.dreval import ClassEvalHooks, DREvalDataset
 from .asserts import parse_assert_statement
 from .blocks import select_probe_lines
-from .classeval import mask_first_assert
+from .classeval import mask_asserts
 from .variables import select_state_probes
 
 __all__ = [
@@ -167,16 +167,13 @@ def _gen_class_item(dataset, idx, item, stats, max_inputs, timeout):
     for input_idx, test_cls in enumerate(test_classes):
         if len(item["tasks"]) >= max_inputs:
             break
-        output_pred = mask_first_assert(inputs[input_idx])
+        output_pred = mask_asserts(inputs[input_idx])
         if output_pred is None:
             stats.empty.append((idx, input_idx))
             continue
-        obj = test_cls()
-        if hasattr(obj, "setUp"):
-            obj.setUp()
-        sandbox = Sandbox(obj.dreval_test, timeout=timeout)
-        _, trace = sandbox.run()
-        assert sandbox.status == "ok", f"{sandbox.status} on DREval/{idx} input {input_idx}"
+        from ..tasks.base import TaskRunner
+
+        trace = TaskRunner.run_class_sandbox(test_cls, timeout)
         task = probes_for_function(code, trace)
         if task:
             item["tasks"].append(
@@ -199,7 +196,10 @@ def _repair_and_run(sandbox: Sandbox, space: CodeSpace, input_repr: str):
             args = space.eval_invocation(input_repr)
             result, trace = sandbox.run(*args)
         except TypeError:
-            input_repr = input_repr.replace(")", ",)")
+            # single non-iterable arg: tuple-ify by appending at the END
+            # only (the reference rewrites every ')', which corrupts parens
+            # inside string literals — taskgen.py:461)
+            input_repr = input_repr[:-1] + ",)" if input_repr.endswith(")") else input_repr
             continue
         if "exception" in sandbox.status and attempt == 0:
             input_repr = f"[{input_repr},]"

@@ -20,6 +20,9 @@ inputs handled, and split selection is explicit.
 
 from __future__ import annotations
 
+import json
+import os
+import traceback
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -50,6 +53,27 @@ class TaskRunner:
     """Base engine; concrete tasks fill in planning/scoring hooks."""
 
     name: str = ""
+    supports_tot = False      # probe tasks (coverage/path/state) set True
+
+    @staticmethod
+    def _build_tot_parser(kwargs: dict, dataset: str):
+        """Construct the trace-dump parser from ``tot_*`` kwargs or a
+        ``.tot_config`` JSON (reference evaluation.py:54-59; key names
+        kept: ``base_dir``, ``inference_output_dir``)."""
+        from ..tot import TraceOfThoughtsParser
+
+        cfg = {}
+        cfg_path = kwargs.get("tot_config", ".tot_config")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+        base_dir = kwargs.get("tot_base_dir") or cfg.get("base_dir")
+        run_name = (kwargs.get("tot_run_name") or cfg.get("inference_output_dir")
+                    or cfg.get("run_name"))
+        assert base_dir and run_name, (
+            "trace-of-thoughts mode needs tot_base_dir/tot_run_name kwargs "
+            "or a .tot_config with base_dir + inference_output_dir")
+        return TraceOfThoughtsParser(base_dir, dataset, run_name)
 
     def __init__(self, model=None, prompt_type: str = "direct", dataset: str = None,
                  split: str | None = None, mock: bool = False, custom_mock: bool = False,
@@ -76,11 +100,17 @@ class TaskRunner:
         self.max_items = max_items  # smoke runs: only the first N benchmark rows
         self._no_skip: set[tuple] | None = None
         if valid_test_cases_path:
-            import json
-
             with open(valid_test_cases_path) as f:
                 self._no_skip = {tuple(t) for t in json.load(f)}
-        model_info = "mock_model_" + prompt_type if self.mock else self.backend.info
+        self.tot_parser = None
+        if prompt_type == "tot":
+            assert self.supports_tot, f"task {self.name!r} has no trace-of-thoughts mode"
+            self.tot_parser = self._build_tot_parser(kwargs, dataset)
+            model_info = f"{kwargs.get('model_id', 'tot_model')}_tot"
+        elif self.mock:
+            model_info = "mock_model_" + prompt_type
+        else:
+            model_info = self.backend.info
         self.store = ResultsStore(self.name, model_info, results_dir)
         self.metrics_trailer: dict = {}
 
@@ -214,8 +244,82 @@ class TaskRunner:
         assert sandbox.status == "ok", f"{sandbox.status} tracing {test_cls.__name__}.dreval_test"
         return states
 
+    # ---- trace-of-thoughts hooks (probe tasks implement) -----------------
+    def tot_matches(self, job: "ProbeJob", ans) -> bool:
+        """Does a parsed answer agree with the probe's ground truth?"""
+        raise NotImplementedError
+
+    def tot_record(self, job: "ProbeJob", ans, gen: str, error: str | None) -> dict:
+        """Score one phase-2 answer and build its result record."""
+        raise NotImplementedError
+
+    # ---- trace-of-thoughts run (reference evaluation.py:303-351 et al) ---
+    def run_tot(self) -> dict:
+        records, jobs = self._plan()
+        valid_cases: list[tuple] = []
+        scored = 0
+        for job in jobs:
+            result = self._tot_probe(job, valid_cases)
+            if result is not None:
+                job.gen_entry["results"].append(result)
+                scored += 1
+        if self.progress:
+            print(f"[{self.name}] tot: {len(valid_cases)} valid test cases, "
+                  f"{scored} scored of {len(jobs)} probes")
+        self.metrics_trailer = self.metrics
+        records.append(self.metrics_trailer)
+        from datetime import datetime, timezone
+
+        now = datetime.now(timezone.utc)  # one stamp pairs both artifacts
+        path = self.store.write(records, self.dataset, now=now)
+        valid_path = os.path.join(
+            self.store.save_dir,
+            f"{self.store.timestamp(now)}.valid_test_cases.{self.dataset}.json")
+        with open(valid_path, "w") as f:
+            json.dump([list(k) for k in valid_cases], f)
+        if self.progress:
+            print(f"[{self.name}] metrics: {self.metrics_trailer}")
+            print(f"[{self.name}] wrote {path}\n[{self.name}] wrote {valid_path}")
+        return self.metrics_trailer
+
+    def _tot_probe(self, job: "ProbeJob", valid_cases: list[tuple]) -> dict | None:
+        """Two-phase protocol per probe: (1) parse *with* ground-truth labels
+        and keep the test case only if that reproduces the known answer;
+        (2) re-parse the model channel for the scored answer, mapping
+        failures to the reference error taxonomy."""
+        from ..tot import EmptyAnswerError, ValidationError
+
+        t_idx, i_idx = job.context["tot_key"]
+        probe_kwargs = dict(lineno=job.lineno, var=job.var)
+        try:
+            self.tot_parser.validate_task(
+                t_idx, i_idx, code=job.context["code"],
+                invocation=job.context["invocation"])
+            ans, _ = self.tot_parser.process_task(
+                t_idx, i_idx, self.name, use_labels=True, **probe_kwargs)
+            if not self.tot_matches(job, ans):
+                return None
+        except Exception:
+            return None  # invalid test case: silently skipped (ref :317-327)
+        valid_cases.append(
+            self._probe_key(t_idx, i_idx, {"lineno": job.lineno, "var": job.var}))
+        error = None
+        try:
+            ans, gen = self.tot_parser.process_task(
+                t_idx, i_idx, self.name, use_labels=False, **probe_kwargs)
+        except ValidationError as e:
+            error, ans, gen = "VALIDATION_ERROR", None, str(e)
+        except EmptyAnswerError as e:
+            error, ans, gen = "EMPTY_ANSWER_ERROR", None, str(e)
+        except Exception as e:
+            error, ans, gen = "GENERAL_ERROR", None, "".join(
+                traceback.format_exception(type(e), e, e.__traceback__))
+        return self.tot_record(job, ans, gen, error)
+
     # ---- the run ---------------------------------------------------------
     def run(self) -> dict:
+        if self.prompt_type == "tot":
+            return self.run_tot()
         records, jobs = self._plan()
         prompts = [j.prompt for j in jobs]
         if self.progress:
@@ -238,6 +342,7 @@ class ProbeTask(TaskRunner):
 
     uses_var = False          # state sets True (probes carry a variable)
     numbered_code = False     # path sets True (prompt shows numbered lines)
+    supports_tot = True       # answers extractable from a trace dump
 
     # -- hooks for concrete probe tasks -----------------------------------
     def ground_truth(self, states, lineno0: int, var: str | None):
@@ -272,7 +377,9 @@ class ProbeTask(TaskRunner):
             self._append_probe_job(jobs, gen_entry, states=states, probe=probe,
                                    code=code, codelines=codelines,
                                    invocation=invocation, invocation_abbr=invocation,
-                                   numbered=self.numbered_code)
+                                   numbered=self.numbered_code,
+                                   tot_key=(task_idx if task_idx is not None else idx,
+                                            pair["input_idx"]))
 
     def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
                         setup, gen_entry, jobs):
@@ -285,13 +392,23 @@ class ProbeTask(TaskRunner):
                                    code=code, codelines=codelines,
                                    invocation=invocation,
                                    invocation_abbr="the above test code",
-                                   numbered=False)
+                                   numbered=False,
+                                   tot_key=(idx, pair["input_idx"]))
 
     def _append_probe_job(self, jobs, gen_entry, *, states, probe, code,
-                          codelines, invocation, invocation_abbr, numbered):
+                          codelines, invocation, invocation_abbr, numbered,
+                          tot_key=None):
         lineno = probe["lineno"]
         var = probe.get("var") if self.uses_var else None
         expected = self.ground_truth(states, lineno - 1, var)
+        context = {"codelines": codelines, "code": code,
+                   "invocation": invocation, "tot_key": tot_key}
+        if self.prompt_type == "tot":
+            # no prompt is rendered: answers come from trace dumps
+            jobs.append(ProbeJob(gen_entry=gen_entry, prompt="",
+                                 expected=expected, lineno=lineno, var=var,
+                                 context=context))
+            return
         fields = dict(
             code=self._prompt_code(code, codelines, numbered),
             invocation=invocation,
@@ -304,4 +421,4 @@ class ProbeTask(TaskRunner):
         prompt = build_prompt(self.name, self.prompt_type, **fields)
         jobs.append(ProbeJob(gen_entry=gen_entry, prompt=prompt,
                              expected=expected, lineno=lineno, var=var,
-                             context={"codelines": codelines}))
+                             context=context))

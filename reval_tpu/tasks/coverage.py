@@ -42,9 +42,7 @@ class CoverageTask(ProbeTask):
     def ground_truth(self, states, lineno0: int, var):
         return states.get_coverage(lineno0)
 
-    def probe_record(self, job: ProbeJob, response: str) -> dict:
-        ans = parse_coverage_answer(response, self.prompt_type)
-        actual = job.expected
+    def _update(self, ans: bool, actual: bool) -> None:
         self._total += 1
         if ans and actual:
             self.tp += 1
@@ -54,4 +52,19 @@ class CoverageTask(ProbeTask):
             self.fn += 1
         else:
             self.tn += 1
+
+    def probe_record(self, job: ProbeJob, response: str) -> dict:
+        ans = parse_coverage_answer(response, self.prompt_type)
+        actual = job.expected
+        self._update(ans, actual)
         return {"generated": response, "response": ans, "expected": actual}
+
+    # -- trace-of-thoughts -------------------------------------------------
+    def tot_matches(self, job: ProbeJob, ans) -> bool:
+        return bool(ans) == bool(job.expected)
+
+    def tot_record(self, job: ProbeJob, ans, gen: str, error: str | None) -> dict:
+        ans = False if error else bool(ans)
+        self._update(ans, job.expected)
+        return {"generated": gen, "response": ans, "expected": job.expected,
+                "line": job.lineno, "error": error}

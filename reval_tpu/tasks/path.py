@@ -41,3 +41,18 @@ class PathTask(ProbeTask):
             self._correct += 1
         return {"generated": response, "response": ans_lines, "expected": actual,
                 "line": job.lineno, "prompt": job.prompt, "result": result}
+
+    # -- trace-of-thoughts -------------------------------------------------
+    def tot_matches(self, job: ProbeJob, ans) -> bool:
+        return ans in job.expected
+
+    def tot_record(self, job: ProbeJob, ans, gen: str, error: str | None) -> dict:
+        # the parser answers a 1-indexed line (or -1); -2 marks errors, the
+        # unmatched-answer sentinel of the text path
+        ans = -2 if error else ans
+        result = ans in job.expected
+        self._total += 1
+        if result:
+            self._correct += 1
+        return {"generated": gen, "response": [ans], "expected": job.expected,
+                "line": job.lineno, "result": result, "error": error}

@@ -31,6 +31,27 @@ class StateTask(ProbeTask):
     def ground_truth(self, states, lineno0: int, var: str):
         return states.interpret_var(lineno0, var)
 
+    # -- trace-of-thoughts -------------------------------------------------
+    def tot_matches(self, job: ProbeJob, ans) -> bool:
+        parsed = parse_state_answer(ans, "direct")
+        return parsed != "ERROR" and state_answers_equal(parsed, job.expected)
+
+    def tot_record(self, job: ProbeJob, ans, gen: str, error: str | None) -> dict:
+        eq = False if error else self.tot_matches(job, ans)
+        self._total += 1
+        if eq:
+            self._correct += 1
+        record = {"generated": gen, "eq": eq, "line": job.lineno, "var": job.var,
+                  "ans": ans if not error else error,
+                  "actual": job.expected if job.expected is not Nil else "Nil",
+                  "error": error}
+        for key, value in record.items():
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                record[key] = f"STRINGIFIED, {value}"
+        return record
+
     def probe_record(self, job: ProbeJob, response: str) -> dict:
         ans = parse_state_answer(response, self.prompt_type)
         actual = job.expected

@@ -1,0 +1,42 @@
+"""Trace-of-thoughts (ToT) evaluation mode.
+
+Instead of asking the model a question *about* one probe, ToT mode has the
+model (or an external tracing harness) produce a full simulated execution
+trace — its "trace of thoughts" — per (task, input).  Answers for the
+coverage/path/state tasks are then *extracted* from that one dump and
+scored against the tracer ground truth.
+
+The reference gates this mode on an external package that is absent from
+its snapshot (``trace_of_thoughts_parser``, imported at reference
+evaluation.py:26, expected from a separate checkout per
+cmdlines/evaluation_sbatch.sh:10-11) — only the driver side survives
+(reference evaluation.py:303-351,455-504,772-828).  This package supplies
+the missing half in-tree: a documented dump format (:mod:`.format`), the
+parser with the reference's error taxonomy (:mod:`.parser`), and the
+two-phase validate-then-answer protocol driven by the task engine
+(tasks/base.py: ``TaskRunner.run_tot``).
+"""
+
+from .format import (
+    format_value,
+    read_dump,
+    trace_dump_path,
+    write_trace_dump,
+)
+from .oracle import write_oracle_dumps
+from .parser import (
+    EmptyAnswerError,
+    TraceOfThoughtsParser,
+    ValidationError,
+)
+
+__all__ = [
+    "EmptyAnswerError",
+    "TraceOfThoughtsParser",
+    "ValidationError",
+    "format_value",
+    "read_dump",
+    "trace_dump_path",
+    "write_oracle_dumps",
+    "write_trace_dump",
+]

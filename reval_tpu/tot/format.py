@@ -1,0 +1,139 @@
+"""Trace-of-thoughts dump format: layout, reader, writer.
+
+One dump file per (dataset, task_idx, input_idx):
+
+    <base_dir>/<run_name>/<dataset>/task_<task_idx>_input_<input_idx>.trace.jsonl
+
+JSONL records, in order:
+
+- header   ``{"kind": "header", "code_sha256": …, "invocation": …}`` —
+  identifies the exact program+input the trace claims to simulate; the
+  parser's validation phase checks it against the benchmark row.
+- step     ``{"kind": "step", "step": n, "lineno": L, "values": {var: "repr; type"}}``
+  — the model's simulated visit to 1-indexed line ``L`` with its belief
+  about variable values *on arrival* (same pre-line semantics as the
+  ground-truth tracer).  A labeled dump adds ``"label": {"lineno": …,
+  "values": …}`` carrying the ground truth for the same step.
+- end      ``{"kind": "end", "return": "repr; type" | null}``.
+
+Values are rendered ``"repr; typename"`` — the state task's answer grammar
+— so state answers lift straight out of the dump.
+
+:func:`write_trace_dump` can build a dump from a ground-truth
+:class:`~reval_tpu.dynamics.ExecutionTrace` (labels == steps), which both
+documents the format and gives tests a perfect-oracle fixture; real model
+dumps come from an external tracing harness writing the same schema.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+__all__ = ["trace_dump_path", "write_trace_dump", "read_dump", "format_value", "code_digest"]
+
+
+def code_digest(code: str) -> str:
+    return hashlib.sha256(code.encode()).hexdigest()[:16]
+
+
+def format_value(value) -> str:
+    """Render one runtime value in the state-answer grammar ``repr; type``."""
+    return f"{value!r}; {type(value).__name__}"
+
+
+def trace_dump_path(base_dir: str | Path, run_name: str, dataset: str,
+                    task_idx: int, input_idx: int) -> Path:
+    return (Path(base_dir) / run_name / dataset /
+            f"task_{task_idx}_input_{input_idx}.trace.jsonl")
+
+
+def write_trace_dump(
+    base_dir: str | Path,
+    run_name: str,
+    dataset: str,
+    task_idx: int,
+    input_idx: int,
+    *,
+    code: str,
+    invocation: str,
+    trace=None,
+    steps: list[dict] | None = None,
+    with_labels: bool = True,
+) -> Path:
+    """Write one dump.  ``trace`` (an ExecutionTrace) supplies ground-truth
+    steps/labels; ``steps`` overrides the model-side steps (tests use this
+    to simulate an imperfect model while keeping truthful labels)."""
+    path = trace_dump_path(base_dir, run_name, dataset, task_idx, input_idx)
+    path.parent.mkdir(parents=True, exist_ok=True)
+
+    truth_steps: list[dict] = []
+    ret_value = None
+    if trace is not None:
+        for n, state in enumerate(trace):
+            values = {}
+            for name, value in state.locals.items():
+                try:
+                    values[name] = format_value(value)
+                except Exception:
+                    continue  # unrepr-able values stay out of the dump
+                # flatten object attributes so `self.attr` probes resolve
+                if name == "self":
+                    for attr, attr_value in getattr(value, "__dict__", {}).items():
+                        try:
+                            values[f"self.{attr}"] = format_value(attr_value)
+                        except Exception:
+                            continue
+            truth_steps.append({"step": n, "lineno": state.lineno + 1, "values": values})
+        from ..dynamics import Nil
+
+        for state in trace:
+            if state.return_value is not Nil:
+                try:
+                    ret_value = format_value(state.return_value)
+                except Exception:
+                    ret_value = None
+    model_steps = steps if steps is not None else truth_steps
+
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "header",
+            "code_sha256": code_digest(code),
+            "invocation": invocation.strip(),
+        }) + "\n")
+        for n, step in enumerate(model_steps):
+            rec = {"kind": "step", "step": n,
+                   "lineno": step["lineno"], "values": step.get("values", {})}
+            if with_labels and n < len(truth_steps):
+                rec["label"] = {"lineno": truth_steps[n]["lineno"],
+                                "values": truth_steps[n]["values"]}
+            f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps({"kind": "end", "return": ret_value}) + "\n")
+    return path
+
+
+def read_dump(path: str | Path) -> tuple[dict, list[dict], dict | None]:
+    """Parse a dump into (header, steps, end) with schema checks."""
+    header = None
+    steps: list[dict] = []
+    end = None
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind == "step":
+                if not isinstance(rec.get("lineno"), int):
+                    raise ValueError(f"step record without integer lineno: {rec}")
+                steps.append(rec)
+            elif kind == "end":
+                end = rec
+            else:
+                raise ValueError(f"unknown record kind {kind!r} in {path}")
+    if header is None:
+        raise ValueError(f"dump {path} has no header record")
+    return header, steps, end

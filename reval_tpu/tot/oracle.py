@@ -1,0 +1,46 @@
+"""Oracle trace dumps: ground-truth ToT dumps for a dataset slice.
+
+An external tracing harness normally produces the dumps; this module
+generates *perfect* ones from the tracer ground truth instead.  Useful as
+(a) executable documentation of the dump format, (b) an upper-bound
+baseline run (every test case validates, every answer correct), and
+(c) the fixture generator for tests.
+
+It reuses the real task planner so dump keys, invocation strings, and code
+bodies match what ``TaskRunner.run_tot`` will look up exactly.
+"""
+
+from __future__ import annotations
+
+from .format import write_trace_dump
+
+__all__ = ["write_oracle_dumps"]
+
+
+def write_oracle_dumps(dataset: str, base_dir: str, run_name: str, *,
+                       split: str | None = None, max_items: int | None = None,
+                       sandbox_timeout: float = 120.0) -> int:
+    """Write one dump per (task, input) pair of ``dataset``; returns count."""
+    from ..tasks.coverage import CoverageTask
+
+    class _DumpPlanner(CoverageTask):
+        """Planner that captures (key, code, invocation, trace) per pair."""
+
+        def __init__(self):
+            super().__init__(prompt_type="direct", dataset=dataset, split=split,
+                             mock=True, progress=False, max_items=max_items,
+                             sandbox_timeout=sandbox_timeout)
+            self.captured: dict[tuple, tuple] = {}
+
+        def _append_probe_job(self, jobs, gen_entry, *, states, probe, code,
+                              codelines, invocation, invocation_abbr,
+                              numbered, tot_key=None):
+            self.captured[tot_key] = (code, invocation, states)
+
+    planner = _DumpPlanner()
+    planner._plan()
+    for (task_idx, input_idx), (code, invocation, trace) in planner.captured.items():
+        write_trace_dump(base_dir, run_name, dataset, task_idx, input_idx,
+                         code=code, invocation=invocation, trace=trace,
+                         with_labels=True)
+    return len(planner.captured)

@@ -1,0 +1,195 @@
+"""TraceOfThoughtsParser: extract task answers from trace dumps.
+
+In-tree replacement for the reference's absent external parser (import at
+reference evaluation.py:26).  The driver-side protocol it must serve
+(reference evaluation.py:303-351,455-504,772-828):
+
+- ``validate_task(...)`` — raise :class:`ValidationError` unless the dump
+  exists, parses, and matches the benchmark program + invocation;
+- ``process_task(..., use_labels)`` — return ``(answer, rendered_trace)``;
+  with ``use_labels=True`` answers come from the ground-truth label
+  channel (the validation pass: a correct parse over labels must
+  reproduce the known ground truth, or the test case is discarded);
+  with ``use_labels=False`` answers come from the model's own steps;
+- raise :class:`EmptyAnswerError` when the dump holds no usable answer —
+  the driver maps the taxonomy to VALIDATION_ERROR / EMPTY_ANSWER_ERROR /
+  GENERAL_ERROR records (reference evaluation.py:333-350).
+
+Answer spaces: coverage → bool; path → 1-indexed successor line or -1
+(trace end); state → ``"repr; type"`` string for the probed variable
+*after* the line (last visit wins, pre-line semantics ⇒ read from the
+following step, falling back to the final step for a trace-ending line).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .format import code_digest, format_value, read_dump, trace_dump_path
+
+__all__ = ["TraceOfThoughtsParser", "ValidationError", "EmptyAnswerError"]
+
+
+class ValidationError(Exception):
+    """Dump missing/malformed or inconsistent with the benchmark row."""
+
+
+class EmptyAnswerError(Exception):
+    """Dump parsed fine but contains no answer for this probe."""
+
+
+class TraceOfThoughtsParser:
+    def __init__(self, base_dir: str | Path, dataset: str, run_name: str):
+        self.base_dir = Path(base_dir)
+        self.dataset = dataset
+        self.run_name = run_name
+        self._cache: dict[tuple[int, int], tuple[dict, list[dict], dict | None]] = {}
+        self._render_cache: dict[tuple[int, int, bool], str] = {}
+
+    # -- dump access -------------------------------------------------------
+    def dump_path(self, task_idx: int, input_idx: int) -> Path:
+        return trace_dump_path(self.base_dir, self.run_name, self.dataset,
+                               task_idx, input_idx)
+
+    def _load(self, task_idx: int, input_idx: int):
+        key = (task_idx, input_idx)
+        if key not in self._cache:
+            path = self.dump_path(task_idx, input_idx)
+            if not path.exists():
+                raise ValidationError(f"trace dump not found: {path}")
+            try:
+                self._cache[key] = read_dump(path)
+            except (ValueError, OSError) as e:
+                raise ValidationError(f"malformed trace dump {path}: {e}") from e
+        return self._cache[key]
+
+    # -- protocol ----------------------------------------------------------
+    def validate_task(self, task_idx: int, input_idx: int, *, code: str,
+                      invocation: str) -> None:
+        header, steps, _ = self._load(task_idx, input_idx)
+        if header.get("code_sha256") != code_digest(code):
+            raise ValidationError(
+                f"dump {task_idx}:{input_idx} was produced for different code "
+                f"(digest {header.get('code_sha256')!r})")
+        if header.get("invocation", "").strip() != invocation.strip():
+            raise ValidationError(
+                f"dump {task_idx}:{input_idx} invocation mismatch: "
+                f"{header.get('invocation')!r} != {invocation!r}")
+
+    def process_task(self, task_idx: int, input_idx: int, task_name: str,
+                     *, lineno: int, var: str | None = None,
+                     use_labels: bool) -> tuple[object, str]:
+        """Extract the ``task_name`` answer for probe line ``lineno``
+        (1-indexed) — and ``var`` for state — from the dump."""
+        _, steps, end = self._load(task_idx, input_idx)
+        seq = self._line_sequence(steps, use_labels)
+        if not seq:
+            raise EmptyAnswerError(f"dump {task_idx}:{input_idx} has no steps")
+        rendered = self.render(task_idx, input_idx, use_labels)
+        if task_name == "coverage":
+            return lineno in seq, rendered
+        if task_name == "path":
+            return self._next_line(seq, lineno), rendered
+        if task_name == "state":
+            assert var is not None, "state probes carry a variable"
+            return self._state_answer(steps, lineno, var, use_labels), rendered
+        raise ValueError(f"trace-of-thoughts does not cover task {task_name!r}")
+
+    # -- extraction --------------------------------------------------------
+    @staticmethod
+    def _channel(step: dict, use_labels: bool) -> dict | None:
+        if use_labels:
+            return step.get("label")
+        return step
+
+    def _line_sequence(self, steps: list[dict], use_labels: bool) -> list[int]:
+        seq = []
+        for step in steps:
+            chan = self._channel(step, use_labels)
+            if chan is not None and isinstance(chan.get("lineno"), int):
+                seq.append(chan["lineno"])
+        return seq
+
+    @staticmethod
+    def _next_line(seq: list[int], lineno: int) -> int:
+        """First successor of ``lineno`` in the simulated trace, -1 when the
+        trace ends there (or the line never executes — the uncovered
+        convention, reference dynamics.py:322-323)."""
+        for i, line in enumerate(seq):
+            if line == lineno:
+                return seq[i + 1] if i + 1 < len(seq) else -1
+        return -1
+
+    def _state_answer(self, steps: list[dict], lineno: int, var: str,
+                      use_labels: bool) -> str:
+        """``repr; type`` of ``var`` after the last visit to ``lineno``.
+
+        ``var`` may be a compound probe expression — ``self.attr`` (dumps
+        carry flattened dotted keys), ``(i, j)``, ``arr[k]`` — evaluated
+        over the step's recorded values (same expression space as the
+        ground-truth VarInterpreter, reference dynamics.py:164-223)."""
+        answer = None
+        chans = [c for c in (self._channel(s, use_labels) for s in steps) if c is not None]
+        for i, chan in enumerate(chans):
+            if chan.get("lineno") != lineno:
+                continue
+            after = chans[i + 1] if i + 1 < len(chans) else chan
+            value = self._lookup_var(after.get("values", {}), var)
+            if value is not None:
+                answer = value
+        if answer is None:
+            raise EmptyAnswerError(f"variable {var!r} never recorded after line {lineno}")
+        return answer
+
+    @staticmethod
+    def _lookup_var(values: dict[str, str], var: str) -> str | None:
+        """Resolve a probe expression against one step's value map."""
+        if var in values:          # plain name or flattened self.attr
+            return values[var]
+        try:
+            node = ast.parse(var, mode="eval").body
+        except SyntaxError:
+            return None
+
+        def ev(n):
+            if isinstance(n, ast.Constant):
+                return n.value
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                key = ast.unparse(n)
+                if key not in values:
+                    raise KeyError(key)
+                return ast.literal_eval(values[key].rsplit(";", 1)[0].strip())
+            if isinstance(n, ast.Tuple):
+                return tuple(ev(e) for e in n.elts)
+            if isinstance(n, ast.Subscript):
+                return ev(n.value)[ev(n.slice)]
+            if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub):
+                return -ev(n.operand)
+            raise KeyError(ast.dump(n))
+
+        try:
+            return format_value(ev(node))
+        except Exception:
+            return None
+
+    def render(self, task_idx: int, input_idx: int, use_labels: bool = False) -> str:
+        """Human-readable form of the simulated trace (stored as the
+        ``generated`` field of result records).  Cached per dump+channel —
+        the two-phase protocol renders each dump many times."""
+        cache_key = (task_idx, input_idx, use_labels)
+        if cache_key in self._render_cache:
+            return self._render_cache[cache_key]
+        _, steps, end = self._load(task_idx, input_idx)
+        lines = []
+        for step in steps:
+            chan = self._channel(step, use_labels)
+            if chan is None:
+                continue
+            vals = ", ".join(f"{k}={v}" for k, v in chan.get("values", {}).items())
+            lines.append(f"[{step['step']}] line {chan.get('lineno')}: {vals}")
+        if end is not None and end.get("return") is not None:
+            lines.append(f"return {end['return']}")
+        rendered = "\n".join(lines)
+        self._render_cache[cache_key] = rendered
+        return rendered

@@ -13,7 +13,7 @@ from reval_tpu.taskgen import (
     generate_humaneval_classeval,
     generate_mbpp,
     generate_mathqa,
-    mask_first_assert,
+    mask_asserts,
     parse_assert_statement,
     probes_for_function,
     select_probe_lines,
@@ -174,16 +174,16 @@ def test_parse_assert_rejects_non_eq():
         parse_assert_statement("x = 1")
 
 
-def test_mask_first_assert_prefers_assert_equal():
+def test_mask_asserts_masks_every_recognised_assert():
     code = "assertTrue(obj.flag)\nassertEqual(obj.get(), 42)\n"
-    masked = mask_first_assert(code)
-    assert "??" in masked
-    # assertEqual outranks assertTrue; its expected arg is masked
+    masked = mask_asserts(code)
+    # two-arg asserts mask the expected side, one-arg asserts their argument
     assert "assertEqual(obj.get(), ??)" in masked
+    assert "assertTrue(??)" in masked
 
 
-def test_mask_first_assert_none_when_no_asserts():
-    assert mask_first_assert("x = compute()\n") is None
+def test_mask_asserts_none_when_no_asserts():
+    assert mask_asserts("x = compute()\n") is None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,251 @@
+"""Trace-of-thoughts mode: dump format, parser taxonomy, two-phase task run
+(reference evaluation.py:303-351,455-504,772-828; the parser itself is
+in-tree — the reference's external module is absent from its snapshot)."""
+
+import json
+import os
+
+import pytest
+
+from reval_tpu.dynamics import CodeSpace, Sandbox
+from reval_tpu.tot import (
+    EmptyAnswerError,
+    TraceOfThoughtsParser,
+    ValidationError,
+    read_dump,
+    trace_dump_path,
+    write_oracle_dumps,
+    write_trace_dump,
+)
+
+CODE = (
+    "def f(x):\n"          # 1
+    "    y = x + 1\n"      # 2
+    "    if y > 2:\n"      # 3
+    "        y = y * 10\n" # 4
+    "    return y\n"       # 5
+)
+
+
+def _trace(*args):
+    space = CodeSpace()
+    fn = space.load_function("f", CODE)
+    sandbox = Sandbox(fn, timeout=10)
+    _, trace = sandbox.run(*args)
+    assert sandbox.status == "ok"
+    return trace
+
+
+@pytest.fixture
+def dump_dir(tmp_path):
+    trace = _trace(5)
+    write_trace_dump(tmp_path, "run1", "humaneval", 0, 0,
+                     code=CODE, invocation="f(5)", trace=trace)
+    return tmp_path
+
+
+def _parser(base) -> TraceOfThoughtsParser:
+    return TraceOfThoughtsParser(base, "humaneval", "run1")
+
+
+# ---------------------------------------------------------------------------
+# format
+# ---------------------------------------------------------------------------
+
+def test_dump_roundtrip(dump_dir):
+    path = trace_dump_path(dump_dir, "run1", "humaneval", 0, 0)
+    header, steps, end = read_dump(path)
+    assert header["invocation"] == "f(5)"
+    # executed lines (1-indexed): 2, 3, 4, 5
+    assert [s["lineno"] for s in steps] == [2, 3, 4, 5]
+    # labels mirror the truth channel in an oracle dump
+    assert all(s["label"]["lineno"] == s["lineno"] for s in steps)
+    assert end["return"] == "60; int"
+
+
+def test_dump_values_state_grammar(dump_dir):
+    path = trace_dump_path(dump_dir, "run1", "humaneval", 0, 0)
+    _, steps, _ = read_dump(path)
+    # at line 5 (arrival), y has been multiplied
+    assert steps[-1]["values"]["y"] == "60; int"
+
+
+# ---------------------------------------------------------------------------
+# parser answers
+# ---------------------------------------------------------------------------
+
+def test_parser_coverage(dump_dir):
+    p = _parser(dump_dir)
+    p.validate_task(0, 0, code=CODE, invocation="f(5)")
+    ans, gen = p.process_task(0, 0, "coverage", lineno=4, use_labels=False)
+    assert ans is True and "line 4" in gen
+    ans, _ = p.process_task(0, 0, "coverage", lineno=99, use_labels=False)
+    assert ans is False
+
+
+def test_parser_path(dump_dir):
+    p = _parser(dump_dir)
+    ans, _ = p.process_task(0, 0, "path", lineno=3, use_labels=False)
+    assert ans == 4
+    ans, _ = p.process_task(0, 0, "path", lineno=5, use_labels=False)
+    assert ans == -1  # trace ends at the return line
+    ans, _ = p.process_task(0, 0, "path", lineno=42, use_labels=False)
+    assert ans == -1  # never executed
+
+
+def test_parser_state_after_semantics(dump_dir):
+    p = _parser(dump_dir)
+    ans, _ = p.process_task(0, 0, "state", lineno=4, var="y", use_labels=False)
+    assert ans == "60; int"  # value *after* line 4 executes
+    with pytest.raises(EmptyAnswerError):
+        p.process_task(0, 0, "state", lineno=4, var="nope", use_labels=False)
+
+
+def test_parser_validation_errors(dump_dir):
+    p = _parser(dump_dir)
+    with pytest.raises(ValidationError):
+        p.validate_task(0, 0, code=CODE + "# changed\n", invocation="f(5)")
+    with pytest.raises(ValidationError):
+        p.validate_task(0, 0, code=CODE, invocation="f(6)")
+    with pytest.raises(ValidationError):
+        p.validate_task(7, 7, code=CODE, invocation="f(5)")  # missing dump
+
+
+def test_label_channel_independent_of_model_steps(tmp_path):
+    # model simulates the wrong branch; labels still carry ground truth
+    trace = _trace(5)
+    wrong_steps = [{"lineno": 2, "values": {"y": "6; int"}},
+                   {"lineno": 3, "values": {"y": "6; int"}},
+                   {"lineno": 5, "values": {"y": "6; int"}}]
+    write_trace_dump(tmp_path, "run1", "humaneval", 0, 0,
+                     code=CODE, invocation="f(5)", trace=trace, steps=wrong_steps)
+    p = _parser(tmp_path)
+    labeled, _ = p.process_task(0, 0, "coverage", lineno=4, use_labels=True)
+    raw, _ = p.process_task(0, 0, "coverage", lineno=4, use_labels=False)
+    assert labeled is True and raw is False
+
+
+def test_parser_compound_state_vars(tmp_path):
+    # probe expressions beyond plain names: tuples, subscripts, self.attr
+    code = (
+        "def g(xs):\n"
+        "    i = 1\n"
+        "    j = xs[i]\n"
+        "    return (i, j)\n"
+    )
+    space = CodeSpace()
+    fn = space.load_function("g", code)
+    sandbox = Sandbox(fn, timeout=10)
+    _, trace = sandbox.run([10, 20, 30])
+    write_trace_dump(tmp_path, "run1", "humaneval", 1, 0,
+                     code=code, invocation="g([10, 20, 30])", trace=trace)
+    p = _parser(tmp_path)
+    ans, _ = p.process_task(1, 0, "state", lineno=3, var="(i, j)", use_labels=False)
+    assert ans == "(1, 20); tuple"
+    ans, _ = p.process_task(1, 0, "state", lineno=2, var="xs[0]", use_labels=False)
+    assert ans == "10; int"
+
+
+def test_dump_flattens_self_attributes(tmp_path):
+    code = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        self.total = 5\n"
+        "        self.total += 2\n"
+        "        return self.total\n"
+    )
+    space = CodeSpace()
+    space.load_class("C", code)
+    obj = space.ns["C"]()
+    sandbox = Sandbox(obj.run, timeout=10)
+    _, trace = sandbox.run()
+    write_trace_dump(tmp_path, "run1", "humaneval", 2, 0,
+                     code=code, invocation="C().run()", trace=trace)
+    p = _parser(tmp_path)
+    ans, _ = p.process_task(2, 0, "state", lineno=4, var="self.total",
+                            use_labels=False)
+    assert ans == "7; int"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: two-phase run over oracle dumps
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("task_name,perfect", [
+    ("coverage", True), ("path", True), ("state", True)])
+def test_run_tot_oracle_perfect_scores(tmp_path, task_name, perfect):
+    from reval_tpu.tasks import TASKS
+
+    n = write_oracle_dumps("humaneval", str(tmp_path / "dumps"), "oracle",
+                           max_items=2)
+    assert n > 0
+    task = TASKS[task_name](
+        prompt_type="tot", dataset="humaneval", max_items=2, progress=False,
+        model_id="oracle_model", results_dir=str(tmp_path / "gen"),
+        tot_base_dir=str(tmp_path / "dumps"), tot_run_name="oracle")
+    metrics = task.run()
+    assert metrics["total"] > 0
+    assert metrics["acc"] == pytest.approx(1.0)
+    # valid-test-cases artifact written next to the generation log
+    files = os.listdir(task.store.save_dir)
+    valid = [f for f in files if "valid_test_cases" in f]
+    assert len(valid) == 1
+    cases = json.load(open(os.path.join(task.store.save_dir, valid[0])))
+    assert len(cases) == metrics["total"]
+    # state keys are 4-tuples (task, input, var, line); others 3-tuples
+    expected_len = 4 if task_name == "state" else 3
+    assert all(len(c) == expected_len for c in cases)
+
+
+def test_run_tot_invalid_cases_skipped(tmp_path):
+    """Dumps for a different invocation fail validation → no valid cases."""
+    from reval_tpu.tasks import TASKS
+
+    write_oracle_dumps("humaneval", str(tmp_path / "dumps"), "oracle", max_items=1)
+    # corrupt every dump header
+    root = tmp_path / "dumps" / "oracle" / "humaneval"
+    for f in root.iterdir():
+        lines = f.read_text().splitlines()
+        header = json.loads(lines[0])
+        header["code_sha256"] = "feedfacefeedface"
+        f.write_text("\n".join([json.dumps(header)] + lines[1:]) + "\n")
+    task = TASKS["coverage"](
+        prompt_type="tot", dataset="humaneval", max_items=1, progress=False,
+        model_id="m", results_dir=str(tmp_path / "gen"),
+        tot_base_dir=str(tmp_path / "dumps"), tot_run_name="oracle")
+    metrics = task.run()
+    assert metrics["total"] == 0
+
+
+def test_run_tot_empty_answer_taxonomy(tmp_path):
+    """A valid dump whose model channel lacks the probed variable scores as
+    EMPTY_ANSWER_ERROR (phase 2), while labels keep the case valid."""
+    from reval_tpu.tasks import TASKS
+    from reval_tpu.tot.format import read_dump, trace_dump_path
+
+    write_oracle_dumps("humaneval", str(tmp_path / "dumps"), "oracle", max_items=1)
+    root = tmp_path / "dumps" / "oracle" / "humaneval"
+    for f in root.iterdir():
+        lines = [json.loads(l) for l in f.read_text().splitlines()]
+        for rec in lines:
+            if rec.get("kind") == "step":
+                rec["values"] = {}  # model channel forgets all values
+        f.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    task = TASKS["state"](
+        prompt_type="tot", dataset="humaneval", max_items=1, progress=False,
+        model_id="m", results_dir=str(tmp_path / "gen"),
+        tot_base_dir=str(tmp_path / "dumps"), tot_run_name="oracle")
+    metrics = task.run()
+    assert metrics["total"] > 0 and metrics["acc"] == 0.0
+    rows = [json.loads(l) for l in open(task.store.latest("humaneval"))]
+    errors = [r.get("error") for row in rows[:-1] for g in row.get("generation", [])
+              for r in g.get("results", [])]
+    assert errors and all(e == "EMPTY_ANSWER_ERROR" for e in errors)
+
+
+def test_output_task_rejects_tot(tmp_path):
+    from reval_tpu.tasks import TASKS
+
+    with pytest.raises(AssertionError):
+        TASKS["output"](prompt_type="tot", dataset="humaneval",
+                        model_id="m", tot_base_dir=str(tmp_path), tot_run_name="x")
