@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Full task fleet on the local TPU chip(s).
+# Usage: launchers/local_fleet.sh [config_file] [repeats]
+set -euo pipefail
+
+CONFIG="${1:-.eval_config}"
+REPEATS="${2:-5}"
+
+cd "$(dirname "$0")/.."
+exec python -m reval_tpu fleet -i "$CONFIG" --repeats "$REPEATS"

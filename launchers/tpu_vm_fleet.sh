@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Run the task fleet on a Cloud TPU VM (single- or multi-host slice).
+#
+# Usage:
+#   TPU_NAME=my-v5p-16 ZONE=us-east5-a launchers/tpu_vm_fleet.sh [config] [repeats]
+#
+# Every worker runs the same command; reval_tpu.parallel.distributed picks
+# up the TPU runtime metadata and joins the jax.distributed mesh, so this
+# one invocation covers the multi-host case (e.g. CodeLlama-70B on v5p-16).
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the TPU VM name}"
+: "${ZONE:?set ZONE to the TPU VM zone}"
+CONFIG="${1:-.eval_config}"
+REPEATS="${2:-5}"
+REPO_DIR="${REPO_DIR:-\$HOME/reval_tpu}"
+# "global": one model sharded over every host's chips (70B-class);
+# "replicate": a full engine per host with the prompt list sharded
+MULTIHOST="${MULTIHOST:-global}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd ${REPO_DIR} && python -m reval_tpu fleet -i ${CONFIG} --repeats ${REPEATS} --multihost ${MULTIHOST}"

@@ -1,0 +1,39 @@
+"""Valid-test-case statistics (reference analyze_testcases.py:1-48).
+
+Summarises a ``*.valid_test_cases.*.json`` artifact written by a
+trace-of-thoughts run: how many benchmark tasks survived validation, how
+many inputs per task, how many probe samples per task and per
+(task, input).  Entries are the probe keys — 3-tuples
+``(task, input, line)`` for coverage/path, 4-tuples
+``(task, input, var, line)`` for state.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+
+__all__ = ["analyze_valid_test_cases"]
+
+
+def analyze_valid_test_cases(path: str) -> dict:
+    with open(path) as f:
+        entries = [tuple(e) for e in json.load(f)]
+    per_task: dict = defaultdict(lambda: {"inputs": set(), "samples": set()})
+    per_pair: dict = defaultdict(set)
+    for entry in entries:
+        task_idx, input_idx, *probe = entry
+        per_task[task_idx]["inputs"].add(input_idx)
+        per_task[task_idx]["samples"].add((input_idx, *probe))
+        per_pair[(task_idx, input_idx)].add(tuple(probe))
+    num_tasks = len(per_task)
+    total_samples = sum(len(v["samples"]) for v in per_task.values())
+    return {
+        "num_tasks": num_tasks,
+        "avg_input_idxs_per_task":
+            sum(len(v["inputs"]) for v in per_task.values()) / num_tasks if num_tasks else 0.0,
+        "avg_sample_per_task": total_samples / num_tasks if num_tasks else 0.0,
+        "avg_sample_per_task_idx":
+            sum(len(s) for s in per_pair.values()) / len(per_pair) if per_pair else 0.0,
+        "total_samples": total_samples,
+    }

@@ -186,9 +186,96 @@ def run_tot_oracle(argv: list[str]) -> int:
     return 0
 
 
+def run_fleet(argv: list[str]) -> int:
+    """All four tasks × repeats on one resident model, then consistency
+    (replaces the reference's subprocess fleet, batch_run.py)."""
+    from .fleet import FleetRunner
+    from .inference import create_backend
+
+    parser = argparse.ArgumentParser(prog="reval_tpu fleet",
+                                     description="Run the full task fleet on one model")
+    parser.add_argument("-i", "--input", default=DEFAULT_CONFIG,
+                        help="run-config JSON (model/backend/dataset settings)")
+    parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument("--mock", action="store_true")
+    parser.add_argument("--max-items", type=int, default=None)
+    parser.add_argument("--multihost", choices=["replicate", "global"], default=None,
+                        help="multi-host mode: engine replica per host with "
+                             "sharded prompts, or one globally-sharded model")
+    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                        help="override a config key (repeatable; JSON values accepted)")
+    args = parser.parse_args(argv)
+    if args.repeats < 1:
+        print("Error: --repeats must be >= 1")
+        return 1
+    cfg = {}
+    if os.path.exists(args.input):
+        with open(args.input) as f:
+            cfg = json.load(f)
+    elif not args.mock:
+        print(f"Error: {args.input} not found — run `python -m reval_tpu config` first")
+        return 1
+    for item in args.set:
+        key, _, value = item.partition("=")
+        try:
+            cfg[key] = json.loads(value)
+        except json.JSONDecodeError:
+            cfg[key] = value
+    if cfg.get("replay_task") or cfg.get("backend") == "replay":
+        # a replay backend serves ONE task's recorded generations in order;
+        # the fleet's fused batch would hand them to the wrong tasks
+        print("Error: replay backends replay a single task's log — "
+              "use `reval_tpu run` per task instead of `fleet`")
+        return 1
+    if args.multihost:
+        from .parallel.distributed import ensure_initialized
+
+        ensure_initialized()  # must precede backend/device construction
+    backend = None
+    if not args.mock:
+        backend = create_backend(
+            **{k: v for k, v in cfg.items() if k not in ("task", "mock", "backend")},
+            mock=cfg.get("backend") == "mock")
+    # every other config key (split, sandbox_timeout, valid_test_cases_path,
+    # model_id, …) flows through to the tasks, same as `reval_tpu run`
+    consumed = {"task", "backend", "mock", "dataset", "prompt_type",
+                "results_dir", "repeats", "progress", "tasks", "multihost",
+                "run_consistency", "max_items"}
+    task_kwargs = {k: v for k, v in cfg.items() if k not in consumed}
+    fleet = FleetRunner(
+        dataset=cfg.get("dataset", "humaneval"),
+        prompt_type=cfg.get("prompt_type", "direct"),
+        repeats=args.repeats, backend=backend, mock=args.mock,
+        results_dir=cfg.get("results_dir", "model_generations"),
+        multihost=args.multihost, max_items=args.max_items, **task_kwargs)
+    try:
+        result = fleet.run()
+    finally:
+        if backend is not None:
+            backend.close()
+    print(json.dumps({"consistency": result.get("consistency"),
+                      "final_repeat": result["repeats"][-1]}))
+    return 0
+
+
+def run_analyze(argv: list[str]) -> int:
+    """Valid-test-case statistics (reference analyze_testcases.py)."""
+    from .analyze import analyze_valid_test_cases
+
+    parser = argparse.ArgumentParser(prog="reval_tpu analyze")
+    parser.add_argument("path", help="a *.valid_test_cases.*.json artifact")
+    args = parser.parse_args(argv)
+    print(json.dumps(analyze_valid_test_cases(args.path), indent=4))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "fleet":
+        return run_fleet(argv[1:])
+    if argv and argv[0] == "analyze":
+        return run_analyze(argv[1:])
     if argv and argv[0] == "taskgen":
         # taskgen has its own flag namespace (keeps -o/--output semantics of
         # config/run intact)

@@ -1,0 +1,123 @@
+"""Fleet runner: all four tasks × N repeats on one resident model.
+
+The reference drives a fleet by spawning the four task evaluations as
+concurrent OS processes, each re-connecting to a separately-launched vLLM
+server, five times over (reference batch_run.py:20-32 + start_server.sh).
+On TPU the right shape is the opposite: **one** resident sharded model and
+one process.  Per repeat, the fleet
+
+1. plans all four tasks up front (ground-truth sandboxes, prompt
+   rendering);
+2. when the tasks share one backend, concatenates every prompt into a
+   single ``infer_many`` call — the engine length-buckets and batches
+   across task boundaries, keeping the chips saturated where four
+   processes would each trickle single prompts;
+3. scores and writes each task's log (the per-task JSONL contract is
+   unchanged), then runs the consistency scorer over the latest logs.
+
+Mock/replay fleets (per-task backends) fall back to per-task inference.
+"""
+
+from __future__ import annotations
+
+from .tasks import TASKS, ConsistencyScorer
+
+__all__ = ["FleetRunner", "FLEET_TASKS"]
+
+FLEET_TASKS = ("coverage", "path", "state", "output")
+
+
+class FleetRunner:
+    def __init__(self, *, dataset: str, prompt_type: str = "direct",
+                 repeats: int = 5, backend=None, mock: bool = False,
+                 results_dir: str = "model_generations",
+                 run_consistency: bool = True, progress: bool = True,
+                 tasks: tuple[str, ...] = FLEET_TASKS,
+                 multihost: str | None = None, **task_kwargs):
+        assert backend is not None or mock, "fleet needs a backend (or mock=True)"
+        assert multihost in (None, "replicate", "global"), multihost
+        self.dataset = dataset
+        self.prompt_type = prompt_type
+        self.repeats = repeats
+        self.backend = backend
+        self.mock = mock
+        self.results_dir = results_dir
+        self.run_consistency = run_consistency
+        self.progress = progress
+        self.task_names = tasks
+        # multi-host: "replicate" = engine replica per host, prompts sharded
+        # over DCN; "global" = one model sharded across all hosts, identical
+        # prompts everywhere (70B-class); None = single host
+        self.multihost = multihost
+        self.task_kwargs = task_kwargs
+
+    def _make_tasks(self):
+        return [
+            TASKS[name](model=self.backend, prompt_type=self.prompt_type,
+                        dataset=self.dataset, mock=self.mock,
+                        results_dir=self.results_dir, progress=self.progress,
+                        **self.task_kwargs)
+            for name in self.task_names
+        ]
+
+    def run_repeat(self) -> dict[str, dict]:
+        """One pass over all tasks with fused batched inference."""
+        tasks = self._make_tasks()
+        planned = [(task, *task._plan()) for task in tasks]
+        shared = self.backend is not None and all(
+            t.backend is self.backend for t in tasks)
+        metrics: dict[str, dict] = {}
+        if shared:
+            all_jobs = [(task, job) for task, _, jobs in planned for job in jobs]
+            if self.progress:
+                print(f"[fleet] {len(all_jobs)} prompts across "
+                      f"{len(tasks)} tasks → one batched pass")
+            prompts = [job.prompt for _, job in all_jobs]
+            responses = self._infer(prompts)
+            if not self._should_write():
+                return {t.name: {} for t, _, _ in planned}
+            cursor = 0
+            for task, records, jobs in planned:
+                chunk = responses[cursor:cursor + len(jobs)]
+                cursor += len(jobs)
+                metrics[task.name] = task.score_and_write(records, jobs, chunk)
+        else:
+            for task, records, jobs in planned:
+                responses = task.backend.infer_many([j.prompt for j in jobs])
+                metrics[task.name] = task.score_and_write(records, jobs, responses)
+        return metrics
+
+    def _infer(self, prompts: list[str]) -> list[str]:
+        """Batched inference, sharded across hosts when configured."""
+        if self.multihost == "replicate":
+            from .parallel.distributed import gather_strings, shard_for_host
+
+            local, _ = shard_for_host(prompts)
+            return gather_strings(self.backend.infer_many(local))
+        return self.backend.infer_many(prompts)
+
+    def _should_write(self) -> bool:
+        """In multi-host runs only the primary host scores + writes logs."""
+        if self.multihost is None:
+            return True
+        from .parallel.distributed import is_primary_host
+
+        return is_primary_host()
+
+    def run(self) -> dict:
+        """All repeats + the consistency score (reference batch_run.py:20-32)."""
+        all_metrics: list[dict[str, dict]] = []
+        for rep in range(self.repeats):
+            if self.progress:
+                print(f"[fleet] repeat {rep + 1}/{self.repeats}")
+            all_metrics.append(self.run_repeat())
+        result: dict = {"repeats": all_metrics}
+        if (self.run_consistency and set(FLEET_TASKS) <= set(self.task_names)
+                and self._should_write()):
+            model_info = ("mock_model_" + self.prompt_type if self.mock
+                          else self.backend.info)
+            scorer = ConsistencyScorer(model_info, self.dataset,
+                                       results_dir=self.results_dir,
+                                       progress=self.progress)
+            result["consistency"] = scorer.run()
+        return result

@@ -6,10 +6,13 @@ DeepSeek-Coder, Mistral, Magicoder), Gemma, StarCoder2."""
 from .configs import ModelConfig, load_hf_config
 from .loader import init_random_params, load_checkpoint, param_template
 from .model import KVCache, decode_step, init_kv_cache, logits_for_tokens, prefill
+from .zoo import MODEL_ZOO, ZooEntry, zoo_config, zoo_entry
 
 __all__ = [
     "KVCache",
+    "MODEL_ZOO",
     "ModelConfig",
+    "ZooEntry",
     "decode_step",
     "init_kv_cache",
     "init_random_params",
@@ -18,4 +21,6 @@ __all__ = [
     "logits_for_tokens",
     "param_template",
     "prefill",
+    "zoo_config",
+    "zoo_entry",
 ]

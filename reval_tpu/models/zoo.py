@@ -1,0 +1,121 @@
+"""Model zoo: the benchmark's evaluated models + flagship targets.
+
+The reference ships a flat list of HF ids (model_list.txt:1-13); here each
+entry also carries the architecture family (all are covered by
+:class:`~reval_tpu.models.configs.ModelConfig` flags) and the known model
+dimensions, so shape-only work — benchmarking, sharding dry-runs,
+compile-cache warming — needs no checkpoint download.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .configs import ModelConfig
+
+__all__ = ["ZooEntry", "MODEL_ZOO", "zoo_entry", "zoo_config"]
+
+
+@dataclass(frozen=True)
+class ZooEntry:
+    hf_id: str
+    family: str                 # llama | gemma | starcoder2  (configs.py)
+    n_params: str
+    dims: dict                  # ModelConfig kwargs (architecture shape)
+
+
+def _llama(vocab, hidden, inter, layers, heads, kv_heads=None, head_dim=None,
+           rope_theta=10000.0, **extra) -> dict:
+    return dict(vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+                num_layers=layers, num_heads=heads,
+                num_kv_heads=kv_heads or heads,
+                head_dim=head_dim or hidden // heads,
+                rope_theta=rope_theta, **extra)
+
+
+MODEL_ZOO: dict[str, ZooEntry] = {
+    # -- the reference's evaluated models (model_list.txt) ----------------
+    "google/gemma-2b-it": ZooEntry(
+        "google/gemma-2b-it", "gemma", "2B",
+        _llama(256000, 2048, 16384, 18, 8, kv_heads=1, head_dim=256,
+               family="gemma", norm_offset=1.0, embed_scale=2048 ** 0.5,
+               tie_word_embeddings=True, hidden_act="gelu_pytorch_tanh")),
+    "google/gemma-7b-it": ZooEntry(
+        "google/gemma-7b-it", "gemma", "7B",
+        _llama(256000, 3072, 24576, 28, 16, head_dim=256,
+               family="gemma", norm_offset=1.0, embed_scale=3072 ** 0.5,
+               tie_word_embeddings=True, hidden_act="gelu_pytorch_tanh")),
+    "mistralai/Mistral-7B-Instruct-v0.2": ZooEntry(
+        "mistralai/Mistral-7B-Instruct-v0.2", "llama", "7B",
+        _llama(32000, 4096, 14336, 32, 32, kv_heads=8, rope_theta=1000000.0)),
+    "codellama/CodeLlama-7b-hf": ZooEntry(
+        "codellama/CodeLlama-7b-hf", "llama", "7B",
+        _llama(32016, 4096, 11008, 32, 32, rope_theta=1000000.0)),
+    "codellama/CodeLlama-7b-Instruct-hf": ZooEntry(
+        "codellama/CodeLlama-7b-Instruct-hf", "llama", "7B",
+        _llama(32016, 4096, 11008, 32, 32, rope_theta=1000000.0)),
+    "codellama/CodeLlama-7b-Python-hf": ZooEntry(
+        "codellama/CodeLlama-7b-Python-hf", "llama", "7B",
+        _llama(32000, 4096, 11008, 32, 32, rope_theta=1000000.0)),
+    "codellama/CodeLlama-13b-Instruct-hf": ZooEntry(
+        "codellama/CodeLlama-13b-Instruct-hf", "llama", "13B",
+        _llama(32016, 5120, 13824, 40, 40, rope_theta=1000000.0)),
+    "codellama/CodeLlama-34b-Instruct-hf": ZooEntry(
+        "codellama/CodeLlama-34b-Instruct-hf", "llama", "34B",
+        _llama(32000, 8192, 22016, 48, 64, kv_heads=8, rope_theta=1000000.0)),
+    "bigcode/starcoder2-3b": ZooEntry(
+        "bigcode/starcoder2-3b", "starcoder2", "3B",
+        _llama(49152, 3072, 12288, 30, 24, kv_heads=2, rope_theta=999999.4,
+               family="starcoder2", use_layernorm=True, mlp_gated=False,
+               attention_bias=True, mlp_bias=True, hidden_act="gelu_pytorch_tanh",
+               rms_norm_eps=1e-5)),
+    "bigcode/starcoder2-7b": ZooEntry(
+        "bigcode/starcoder2-7b", "starcoder2", "7B",
+        _llama(49152, 4608, 18432, 32, 36, kv_heads=4, rope_theta=1000000.0,
+               family="starcoder2", use_layernorm=True, mlp_gated=False,
+               attention_bias=True, mlp_bias=True, hidden_act="gelu_pytorch_tanh",
+               rms_norm_eps=1e-5)),
+    "bigcode/starcoder2-15b": ZooEntry(
+        "bigcode/starcoder2-15b", "starcoder2", "15B",
+        _llama(49152, 6144, 24576, 40, 48, kv_heads=4, rope_theta=100000.0,
+               family="starcoder2", use_layernorm=True, mlp_gated=False,
+               attention_bias=True, mlp_bias=True, hidden_act="gelu_pytorch_tanh",
+               rms_norm_eps=1e-5)),
+    "ise-uiuc/Magicoder-CL-7B": ZooEntry(
+        "ise-uiuc/Magicoder-CL-7B", "llama", "7B",
+        _llama(32001, 4096, 11008, 32, 32, rope_theta=1000000.0)),
+    "ise-uiuc/Magicoder-S-CL-7B": ZooEntry(
+        "ise-uiuc/Magicoder-S-CL-7B", "llama", "7B",
+        _llama(32001, 4096, 11008, 32, 32, rope_theta=1000000.0)),
+    # -- flagship/benchmark targets (BASELINE.json configs) ---------------
+    "deepseek-ai/deepseek-coder-1.3b-base": ZooEntry(
+        "deepseek-ai/deepseek-coder-1.3b-base", "llama", "1.3B",
+        _llama(32256, 2048, 5504, 24, 16, rope_theta=100000.0)),
+    "deepseek-ai/deepseek-coder-6.7b-base": ZooEntry(
+        "deepseek-ai/deepseek-coder-6.7b-base", "llama", "6.7B",
+        _llama(32256, 4096, 11008, 32, 32, rope_theta=100000.0)),
+    "codellama/CodeLlama-70b-Instruct-hf": ZooEntry(
+        "codellama/CodeLlama-70b-Instruct-hf", "llama", "70B",
+        _llama(32016, 8192, 28672, 80, 64, kv_heads=8, rope_theta=1000000.0)),
+}
+
+# short aliases (config files accept either)
+_ALIASES = {
+    "deepseek-coder-1.3b": "deepseek-ai/deepseek-coder-1.3b-base",
+    "deepseek-coder-6.7b": "deepseek-ai/deepseek-coder-6.7b-base",
+    "codellama-34b": "codellama/CodeLlama-34b-Instruct-hf",
+    "codellama-70b": "codellama/CodeLlama-70b-Instruct-hf",
+}
+
+
+def zoo_entry(name: str) -> ZooEntry:
+    name = _ALIASES.get(name, name)
+    if name not in MODEL_ZOO:
+        raise KeyError(f"unknown zoo model {name!r}; known: {sorted(MODEL_ZOO)}")
+    return MODEL_ZOO[name]
+
+
+def zoo_config(name: str, dtype: str = "bfloat16") -> ModelConfig:
+    """Architecture config for a zoo model (no checkpoint needed)."""
+    entry = zoo_entry(name)
+    return ModelConfig(dtype=dtype, **entry.dims)

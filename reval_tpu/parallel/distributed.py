@@ -1,0 +1,112 @@
+"""Multi-host runtime helpers: topology, prompt sharding, result gathering.
+
+Two multi-host shapes exist (SURVEY §5.8's TPU-native answer to the
+reference's NCCL-inside-vLLM + subprocess fleet):
+
+- **replicated engines** — each host owns a full model replica on its
+  local chips; the fleet shards the prompt list across hosts
+  (:func:`shard_for_host`), every host decodes its shard, and
+  :func:`gather_strings` reassembles the full response list everywhere.
+  This is data parallelism over DCN with zero inter-host traffic during
+  decode.
+- **one global sharded model** (70B-class) — every host executes the same
+  ``infer_many`` on the same prompts; XLA shards the computation over the
+  global mesh (params over ICI/DCN per parallel/sharding.py) and each
+  host sees identical results.  Only the primary host should write logs
+  (:func:`is_primary_host`).
+
+All helpers degrade to no-ops in a single-process run, so the same fleet
+code runs unchanged on one chip, one host, or a pod.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = [
+    "ensure_initialized",
+    "process_topology",
+    "is_primary_host",
+    "shard_for_host",
+    "gather_strings",
+]
+
+_initialized = False
+
+
+def ensure_initialized(coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None) -> None:
+    """Idempotent :func:`jax.distributed.initialize` (auto-detects TPU
+    runtime metadata when no arguments are given).  Call before any other
+    JAX API in multi-host launches; harmless in single-process runs."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if num_processes == 1:
+        _initialized = True
+        return
+    try:
+        # must run before anything touches a JAX backend (so no
+        # jax.process_count() probing here); on a plain single-process
+        # machine the no-arg call has no coordinator to find and raises —
+        # that is the signal to proceed single-process
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (ValueError, RuntimeError):
+        if coordinator_address is not None or num_processes is not None:
+            raise  # explicit multi-host args that fail are a real error
+    _initialized = True
+
+
+def process_topology() -> tuple[int, int]:
+    """(process_index, process_count) — (0, 1) when JAX is single-process."""
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+def is_primary_host() -> bool:
+    return process_topology()[0] == 0
+
+
+def shard_for_host(items: list, index: int | None = None,
+                   count: int | None = None) -> tuple[list, int]:
+    """Contiguous shard of ``items`` for this host plus its start offset.
+
+    Contiguous (not round-robin) so concatenating the per-host results in
+    process order restores the original order exactly.
+    """
+    if index is None or count is None:
+        index, count = process_topology()
+    base, extra = divmod(len(items), count)
+    start = index * base + min(index, extra)
+    size = base + (1 if index < extra else 0)
+    return items[start:start + size], start
+
+
+def gather_strings(local: list[str]) -> list[str]:
+    """All-gather variable-length strings across hosts, concatenated in
+    process order.  Identity in single-process runs."""
+    index, count = process_topology()
+    if count == 1:
+        return list(local)
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(local).encode()
+    # equal shapes are required: gather lengths first, then padded bytes
+    lengths = multihost_utils.process_allgather(np.array([len(payload)], np.int64))
+    max_len = int(np.max(lengths))
+    buf = np.zeros(max_len, np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    out: list[str] = []
+    for i in range(count):
+        raw = bytes(gathered[i][: int(lengths.reshape(-1)[i])])
+        out.extend(json.loads(raw.decode()))
+    return out

@@ -317,14 +317,11 @@ class TaskRunner:
         return self.tot_record(job, ans, gen, error)
 
     # ---- the run ---------------------------------------------------------
-    def run(self) -> dict:
-        if self.prompt_type == "tot":
-            return self.run_tot()
-        records, jobs = self._plan()
-        prompts = [j.prompt for j in jobs]
-        if self.progress:
-            print(f"[{self.name}] {len(prompts)} prompts → backend {'(mock)' if self.mock else ''}")
-        responses = self.backend.infer_many(prompts) if jobs else []
+    def score_and_write(self, records: list[dict], jobs: list["ProbeJob"],
+                        responses: list[str]) -> dict:
+        """Score planned jobs against their responses and persist the log.
+        Split out of :meth:`run` so the fleet runner can batch inference
+        across several tasks before scoring each."""
         assert len(responses) == len(jobs)
         for job, resp in zip(jobs, responses):
             job.gen_entry["results"].append(self.score_job(job, resp))
@@ -335,6 +332,16 @@ class TaskRunner:
             print(f"[{self.name}] metrics: {self.metrics_trailer}")
             print(f"[{self.name}] wrote {path}")
         return self.metrics_trailer
+
+    def run(self) -> dict:
+        if self.prompt_type == "tot":
+            return self.run_tot()
+        records, jobs = self._plan()
+        prompts = [j.prompt for j in jobs]
+        if self.progress:
+            print(f"[{self.name}] {len(prompts)} prompts → backend {'(mock)' if self.mock else ''}")
+        responses = self.backend.infer_many(prompts) if jobs else []
+        return self.score_and_write(records, jobs, responses)
 
 
 class ProbeTask(TaskRunner):

@@ -41,7 +41,13 @@ class ResultsStore:
 
     def write(self, records: list[dict], dataset: str, now: datetime | None = None) -> str:
         os.makedirs(self.save_dir, exist_ok=True)
-        path = os.path.join(self.save_dir, f"{self.timestamp(now)}.{dataset}.jsonl")
+        ts = self.timestamp(now)
+        path = os.path.join(self.save_dir, f"{ts}.{dataset}.jsonl")
+        # repeats within one minute (fleet runs) must not overwrite a log
+        n = 1
+        while os.path.exists(path):
+            path = os.path.join(self.save_dir, f"{ts}-{n}.{dataset}.jsonl")
+            n += 1
         with open(path, "w") as f:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")

@@ -1,0 +1,146 @@
+"""Fleet runner, analyzer, model zoo, and host-distribution helpers."""
+
+import json
+import os
+
+import pytest
+
+from reval_tpu.analyze import analyze_valid_test_cases
+from reval_tpu.fleet import FleetRunner
+from reval_tpu.models import MODEL_ZOO, zoo_config, zoo_entry
+from reval_tpu.parallel.distributed import gather_strings, shard_for_host
+
+
+# ---------------------------------------------------------------------------
+# fleet
+# ---------------------------------------------------------------------------
+
+def test_fleet_mock_end_to_end(tmp_path):
+    fleet = FleetRunner(dataset="humaneval", prompt_type="direct", repeats=2,
+                        mock=True, results_dir=str(tmp_path), progress=False,
+                        max_items=2)
+    result = fleet.run()
+    assert len(result["repeats"]) == 2
+    for metrics in result["repeats"]:
+        assert set(metrics) == {"coverage", "path", "state", "output"}
+    assert "consistency" in result
+    # every task wrote one log per repeat, none overwritten
+    for task in ("coverage", "path", "state", "output"):
+        d = os.path.join(tmp_path, f"{task}@mock_model_direct")
+        assert len(os.listdir(d)) == 2
+
+
+def test_fleet_shared_backend_single_batched_pass(tmp_path):
+    """With one shared backend the fleet issues exactly one infer_many per
+    repeat, covering all four tasks."""
+
+    class CountingBackend:
+        info = "counting_model_direct_temp0.0"
+        prompt_type = "direct"
+
+        def __init__(self):
+            self.calls = []
+
+        def infer_many(self, prompts):
+            self.calls.append(len(prompts))
+            return ["[ANSWER]x[/ANSWER]"] * len(prompts)
+
+    backend = CountingBackend()
+    fleet = FleetRunner(dataset="humaneval", repeats=1, backend=backend,
+                        results_dir=str(tmp_path), progress=False,
+                        run_consistency=False, max_items=2)
+    result = fleet.run()
+    assert len(backend.calls) == 1, "expected one fused inference pass"
+    total_jobs = backend.calls[0]
+    assert total_jobs > 0
+    assert set(result["repeats"][0]) == {"coverage", "path", "state", "output"}
+
+
+def test_fleet_metrics_match_individual_runs(tmp_path):
+    """Fused fleet scoring must equal running each task alone."""
+    from reval_tpu.tasks import TASKS
+
+    fleet = FleetRunner(dataset="humaneval", repeats=1, mock=True,
+                        results_dir=str(tmp_path / "fleet"), progress=False,
+                        run_consistency=False, max_items=2)
+    fleet_metrics = fleet.run()["repeats"][0]
+    for name in ("coverage", "path", "state", "output"):
+        solo = TASKS[name](prompt_type="direct", dataset="humaneval", mock=True,
+                           progress=False, max_items=2,
+                           results_dir=str(tmp_path / "solo"))
+        assert solo.run() == fleet_metrics[name], name
+
+
+# ---------------------------------------------------------------------------
+# analyzer
+# ---------------------------------------------------------------------------
+
+def test_analyze_valid_test_cases(tmp_path):
+    cases = [[11, 0, 3], [11, 0, 5], [11, 1, 3], [12, 0, 7]]
+    p = tmp_path / "v.json"
+    p.write_text(json.dumps(cases))
+    stats = analyze_valid_test_cases(str(p))
+    assert stats["num_tasks"] == 2
+    assert stats["total_samples"] == 4
+    assert stats["avg_input_idxs_per_task"] == pytest.approx(1.5)
+    assert stats["avg_sample_per_task"] == pytest.approx(2.0)
+    assert stats["avg_sample_per_task_idx"] == pytest.approx(4 / 3)
+
+
+def test_analyze_state_4tuples(tmp_path):
+    cases = [[11, 0, "x", 3], [11, 0, "y", 3]]
+    p = tmp_path / "v.json"
+    p.write_text(json.dumps(cases))
+    stats = analyze_valid_test_cases(str(p))
+    assert stats["num_tasks"] == 1 and stats["total_samples"] == 2
+
+
+# ---------------------------------------------------------------------------
+# model zoo
+# ---------------------------------------------------------------------------
+
+def test_zoo_covers_reference_model_list():
+    # the 13 models of the reference's model_list.txt
+    expected = {
+        "google/gemma-2b-it", "google/gemma-7b-it",
+        "mistralai/Mistral-7B-Instruct-v0.2",
+        "codellama/CodeLlama-7b-hf", "codellama/CodeLlama-7b-Instruct-hf",
+        "codellama/CodeLlama-7b-Python-hf", "codellama/CodeLlama-13b-Instruct-hf",
+        "codellama/CodeLlama-34b-Instruct-hf",
+        "bigcode/starcoder2-3b", "bigcode/starcoder2-7b", "bigcode/starcoder2-15b",
+        "ise-uiuc/Magicoder-CL-7B", "ise-uiuc/Magicoder-S-CL-7B",
+    }
+    assert expected <= set(MODEL_ZOO)
+
+
+def test_zoo_configs_construct():
+    for name in MODEL_ZOO:
+        cfg = zoo_config(name)
+        assert cfg.num_heads % cfg.num_kv_heads == 0, name
+        assert cfg.family in ("llama", "gemma", "starcoder2"), name
+
+
+def test_zoo_aliases():
+    assert zoo_entry("deepseek-coder-1.3b").hf_id == "deepseek-ai/deepseek-coder-1.3b-base"
+    cfg = zoo_config("codellama-70b")
+    assert cfg.num_layers == 80 and cfg.num_kv_heads == 8
+
+
+# ---------------------------------------------------------------------------
+# host distribution
+# ---------------------------------------------------------------------------
+
+def test_shard_for_host_partitions_exactly():
+    items = list(range(10))
+    shards = [shard_for_host(items, i, 3) for i in range(3)]
+    # contiguous, ordered, exact cover
+    rebuilt = []
+    for shard, start in shards:
+        assert items[start:start + len(shard)] == shard
+        rebuilt.extend(shard)
+    assert rebuilt == items
+    assert [len(s) for s, _ in shards] == [4, 3, 3]
+
+
+def test_gather_strings_single_process_identity():
+    assert gather_strings(["a", "b"]) == ["a", "b"]
