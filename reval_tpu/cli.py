@@ -189,14 +189,15 @@ def run_tot_oracle(argv: list[str]) -> int:
 def run_fleet(argv: list[str]) -> int:
     """All four tasks × repeats on one resident model, then consistency
     (replaces the reference's subprocess fleet, batch_run.py)."""
-    from .fleet import FleetRunner
+    from .fleet import FLEET_TASKS, FleetRunner
     from .inference import create_backend
 
     parser = argparse.ArgumentParser(prog="reval_tpu fleet",
                                      description="Run the full task fleet on one model")
     parser.add_argument("-i", "--input", default=DEFAULT_CONFIG,
                         help="run-config JSON (model/backend/dataset settings)")
-    parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument("--repeats", type=int, default=None,
+                        help="repeat count (default: config 'repeats' or 5)")
     parser.add_argument("--mock", action="store_true")
     parser.add_argument("--max-items", type=int, default=None)
     parser.add_argument("--multihost", choices=["replicate", "global"], default=None,
@@ -205,9 +206,6 @@ def run_fleet(argv: list[str]) -> int:
     parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                         help="override a config key (repeatable; JSON values accepted)")
     args = parser.parse_args(argv)
-    if args.repeats < 1:
-        print("Error: --repeats must be >= 1")
-        return 1
     cfg = {}
     if os.path.exists(args.input):
         with open(args.input) as f:
@@ -221,33 +219,51 @@ def run_fleet(argv: list[str]) -> int:
             cfg[key] = json.loads(value)
         except json.JSONDecodeError:
             cfg[key] = value
+    # CLI flags win over config keys; config keys win over defaults
+    repeats = args.repeats if args.repeats is not None else cfg.get("repeats", 5)
+    max_items = args.max_items if args.max_items is not None else cfg.get("max_items")
+    multihost = args.multihost or cfg.get("multihost")
+    use_mock = (args.mock or bool(cfg.get("mock")) or bool(cfg.get("custom_mock")))
+    if repeats < 1:
+        print("Error: repeats must be >= 1")
+        return 1
     if cfg.get("replay_task") or cfg.get("backend") == "replay":
         # a replay backend serves ONE task's recorded generations in order;
         # the fleet's fused batch would hand them to the wrong tasks
         print("Error: replay backends replay a single task's log — "
               "use `reval_tpu run` per task instead of `fleet`")
         return 1
-    if args.multihost:
+    if multihost:
         from .parallel.distributed import ensure_initialized
 
-        ensure_initialized()  # must precede backend/device construction
+        # must precede backend/device construction; an explicit multihost
+        # request that cannot come up is fatal (N duplicate runs otherwise)
+        ensure_initialized(strict=True)
     backend = None
-    if not args.mock:
-        backend = create_backend(
-            **{k: v for k, v in cfg.items() if k not in ("task", "mock", "backend")},
-            mock=cfg.get("backend") == "mock")
+    if not use_mock:
+        backend_kwargs = {k: v for k, v in cfg.items()
+                          if k not in ("task", "mock", "backend")}
+        if multihost == "replicate":
+            # each host runs a full replica on its OWN chips; without this
+            # the engine would build its mesh over the global pod devices
+            backend_kwargs["local_devices_only"] = True
+        backend = create_backend(**backend_kwargs,
+                                 mock=cfg.get("backend") == "mock")
     # every other config key (split, sandbox_timeout, valid_test_cases_path,
     # model_id, …) flows through to the tasks, same as `reval_tpu run`
-    consumed = {"task", "backend", "mock", "dataset", "prompt_type",
-                "results_dir", "repeats", "progress", "tasks", "multihost",
-                "run_consistency", "max_items"}
+    consumed = {"task", "backend", "mock", "custom_mock", "dataset",
+                "prompt_type", "results_dir", "repeats", "progress", "tasks",
+                "multihost", "run_consistency", "max_items"}
     task_kwargs = {k: v for k, v in cfg.items() if k not in consumed}
     fleet = FleetRunner(
         dataset=cfg.get("dataset", "humaneval"),
         prompt_type=cfg.get("prompt_type", "direct"),
-        repeats=args.repeats, backend=backend, mock=args.mock,
+        repeats=repeats, backend=backend, mock=use_mock,
         results_dir=cfg.get("results_dir", "model_generations"),
-        multihost=args.multihost, max_items=args.max_items, **task_kwargs)
+        run_consistency=cfg.get("run_consistency", True),
+        progress=cfg.get("progress", True),
+        tasks=tuple(cfg.get("tasks", FLEET_TASKS)),
+        multihost=multihost, max_items=max_items, **task_kwargs)
     try:
         result = fleet.run()
     finally:

@@ -15,7 +15,8 @@ class TPUBackend(InferenceBackend):
     def __init__(self, model_id: str, model_path: str | None = None, temp: float = 0.8,
                  prompt_type: str = "direct", dtype: str = "bfloat16",
                  num_chips: int = 1, dp_size: int = 1, batch_size: int = 8,
-                 max_seq_len: int = 8192, **kwargs):
+                 max_seq_len: int = 8192, local_devices_only: bool = False,
+                 **kwargs):
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         if not model_path:
             raise ValueError(
@@ -27,6 +28,7 @@ class TPUBackend(InferenceBackend):
         self.engine = TPUEngine.from_pretrained(
             model_path, dtype=dtype, tp_size=num_chips, dp_size=dp_size,
             batch_size=batch_size, max_seq_len=max_seq_len,
+            local_devices_only=local_devices_only,
         )
 
     def infer_one(self, prompt: str) -> str:

@@ -108,7 +108,11 @@ class TPUEngine:
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16", tp_size: int = 1,
                         dp_size: int = 1, batch_size: int = 8, max_seq_len: int = 8192,
-                        tokenizer=None, seed: int = 0) -> "TPUEngine":
+                        tokenizer=None, seed: int = 0,
+                        local_devices_only: bool = False) -> "TPUEngine":
+        """``local_devices_only`` confines the mesh to this host's chips —
+        the replicated-engines multihost mode (one full replica per host,
+        prompts sharded over DCN by the fleet)."""
         params, cfg = load_checkpoint(model_path, dtype=dtype)
         if tokenizer is None:
             tokenizer = HFTokenizer(model_path)
@@ -116,7 +120,8 @@ class TPUEngine:
         if tp_size * dp_size > 1:
             from ...parallel import make_mesh
 
-            mesh = make_mesh(tp=tp_size, dp=dp_size)
+            devices = jax.local_devices() if local_devices_only else None
+            mesh = make_mesh(tp=tp_size, dp=dp_size, devices=devices)
         return cls(params, cfg, tokenizer, batch_size=batch_size,
                    max_seq_len=max_seq_len, mesh=mesh, seed=seed)
 

@@ -1,0 +1,123 @@
+"""Paged KV cache forward passes (continuous-batching path).
+
+Contrast with the contiguous cache in ``model.py``: there, one batch shares
+a rectangular ``[B, S, H, D]`` buffer and every sequence decodes at the same
+position (left-padding makes that possible).  Continuous batching breaks
+that invariant — each slot holds a different sequence at a different
+length — so the cache becomes a pool of fixed-size pages in HBM addressed
+through per-sequence block tables (see ``ops/pallas_attention.py`` for the
+kernel and layout rationale; SURVEY.md §7 step 4 / hard part 2 for why this
+is the throughput lever that replaces vLLM's paged allocator).
+
+Page 0 is reserved as the **trash page**: table slots past a sequence's
+allocation and idle batch slots all point at it, so out-of-range writes
+land somewhere harmless and masked reads never see them.  The native
+allocator (reval_tpu.runtime) never hands out page 0.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply_rope, rope_angles
+from ..ops.pallas_attention import paged_decode_attention
+from .configs import ModelConfig
+from .model import _embed, _mlp, _norm, _out_proj, _qkv, _unembed
+
+__all__ = [
+    "PagedKVCache",
+    "init_paged_cache",
+    "paged_decode_step",
+    "commit_prefill",
+]
+
+
+class PagedKVCache(NamedTuple):
+    k: jnp.ndarray  # [L, H_kv, N_pages, P, D]
+    v: jnp.ndarray  # [L, H_kv, N_pages, P, D]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def init_paged_cache(cfg: ModelConfig, num_pages: int, page_size: int = 128,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
+    return PagedKVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                      block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+                      cache: PagedKVCache) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One decode step at per-sequence positions.
+
+    tokens: [B, 1] — next input token per slot; its position is
+    ``seq_lens[b]`` (the current length, 0-indexed), so the caller advances
+    ``seq_lens`` by one *after* the step.  block_tables: [B, max_pages];
+    idle slots should point at the trash page with ``seq_lens == 1``.
+    Returns (logits [B, V], updated cache).
+    """
+    page = cache.page_size
+    h = _embed(params, cfg, tokens)
+    positions = seq_lens[:, None]                       # [B, 1]
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    write_page = jnp.take_along_axis(
+        block_tables, (seq_lens // page)[:, None], axis=1)[:, 0]   # [B]
+    write_off = seq_lens % page                                     # [B]
+    attn_lens = seq_lens + 1                    # new token attends to itself
+
+    def layer_step(h, xs):
+        layer, k_slot, v_slot = xs              # slots: [H_kv, N, P, D]
+        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
+        q, k, v = _qkv(normed, layer, cfg)      # q: [B, 1, H, D]
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_new = k[:, 0].astype(k_slot.dtype).transpose(1, 0, 2)  # [H_kv, B, D]
+        v_new = v[:, 0].astype(v_slot.dtype).transpose(1, 0, 2)
+        k_slot = k_slot.at[:, write_page, write_off].set(k_new)
+        v_slot = v_slot.at[:, write_page, write_off].set(v_new)
+        attn = paged_decode_attention(
+            q[:, 0], k_slot, v_slot, block_tables, attn_lens, page_size=page)
+        h = h + _out_proj(attn[:, None], layer, cfg)
+        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
+        h = h + _mlp(normed, layer, cfg)
+        return h, (k_slot, v_slot)
+
+    h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    return _unembed(params, cfg, h)[:, 0, :], PagedKVCache(new_k, new_v)
+
+
+def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,
+                   prefill_tables: jnp.ndarray) -> PagedKVCache:
+    """Copy a left-padded contiguous prefill cache into pages.
+
+    kv: contiguous :class:`~reval_tpu.models.model.KVCache` of shape
+    [L, B, T, H_kv, D] (T a multiple of the page size); pad_len: [B];
+    prefill_tables: [B, T // P] destination page ids — slots past
+    ``ceil(len/P)`` should be the trash page.
+
+    Prefill itself runs through the existing left-padded ``prefill`` (its
+    attention is already MXU-shaped); paging only changes where the KV
+    lands, so commit is a roll (left-align) + reshape + one scatter.
+    """
+    l, b, t, h_kv, d = kv.k.shape
+    p = cache.page_size
+    assert t % p == 0, f"prefill bucket {t} not a multiple of page size {p}"
+    n_pg = t // p
+
+    def align(x, shift):            # [L, T, H_kv, D] rolled left by pad_len
+        return jnp.roll(x, -shift, axis=1)
+
+    k_aligned = jax.vmap(align, in_axes=(1, 0), out_axes=1)(kv.k, pad_len)
+    v_aligned = jax.vmap(align, in_axes=(1, 0), out_axes=1)(kv.v, pad_len)
+    # [L, B, n_pg, P, H_kv, D] → [L, H_kv, B, n_pg, P, D]
+    k_paged = k_aligned.reshape(l, b, n_pg, p, h_kv, d).transpose(0, 4, 1, 2, 3, 5)
+    v_paged = v_aligned.reshape(l, b, n_pg, p, h_kv, d).transpose(0, 4, 1, 2, 3, 5)
+    new_k = cache.k.at[:, :, prefill_tables].set(k_paged.astype(cache.k.dtype))
+    new_v = cache.v.at[:, :, prefill_tables].set(v_paged.astype(cache.v.dtype))
+    return PagedKVCache(new_k, new_v)

@@ -1,0 +1,180 @@
+"""Pallas TPU kernel: ragged paged-attention for the decode step.
+
+TPU-native replacement for the paged-attention CUDA kernels vLLM supplies
+to the reference (reference inference.py:90-95 constructs ``vllm.LLM``;
+its CUDA kernels are the vendored-native dependency catalogued in
+SURVEY.md §2.9).  Here the KV cache lives in HBM as fixed-size pages and a
+block table maps each sequence to its pages, so sequences of wildly
+different lengths share one cache pool with no per-sequence reallocation —
+the layout continuous batching needs.
+
+Layout (chosen for TPU tiling, not copied from anywhere):
+- ``k_pages``/``v_pages``: ``[H_kv, N_pages, P, D]`` — the minor-most two
+  dims ``(P, D)`` are exactly the (sublane, lane) tile, so one page for one
+  head is a contiguous, perfectly-tiled VMEM block.
+- ``block_tables``: ``[B, max_pages]`` int32 page ids (0-padded past the
+  end; padding is masked, never read as data).
+- ``seq_lens``: ``[B]`` int32 — tokens currently valid per sequence.
+
+Kernel shape: grid ``(B, H_kv, max_pages)`` with the page dimension
+innermost and *arbitrary* (sequential), so flash-style online-softmax
+accumulators in VMEM scratch carry across pages.  The block table and
+sequence lengths ride in scalar-prefetch SMEM: Pallas reads
+``block_tables[b, p]`` inside the BlockSpec index_map to schedule the
+HBM→VMEM DMA of the right page ahead of compute — the pipelining the CUDA
+kernel does by hand falls out of the grid spec.
+
+Everything compiles with ``interpret=True`` on CPU, which is how the unit
+tests validate the kernel bit-for-bit against the XLA reference below.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "paged_decode_attention",
+    "paged_decode_attention_xla",
+    "paged_decode_attention_pallas",
+]
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
+                   scale: float, max_pages: int):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    seq_len = seq_lens_ref[b]
+
+    @pl.when(p * page_size < seq_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [P, D]
+        v = v_ref[0, 0].astype(jnp.float32)          # [P, D]
+        s = jax.lax.dot_general(                      # [G, P]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(p * page_size + cols < seq_len, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                         # [G, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)    # [G, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)               # rescale old sums
+        probs = jnp.exp(s - m_new)                    # [G, P]
+        l_new = alpha * l_ref[:, :1] + probs.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            probs, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == max_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "scale", "interpret"))
+def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                                  *, page_size: int, scale: float | None = None,
+                                  interpret: bool = False):
+    """One-token attention against a paged KV cache (Pallas TPU kernel).
+
+    q: [B, H, D]; k_pages/v_pages: [H_kv, N_pages, P, D];
+    block_tables: [B, max_pages] int32; seq_lens: [B] int32 (≥1).
+    Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    h_kv = k_pages.shape[0]
+    g = h // h_kv
+    max_pages = block_tables.shape[1]
+    scale = float(scale if scale is not None else d ** -0.5)
+    qg = q.reshape(b, h_kv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b_, h_, p_, bt, sl: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b_, h_, p_, bt, sl: (h_, bt[b_, p_], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b_, h_, p_, bt, sl: (h_, bt[b_, p_], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda b_, h_, p_, bt, sl: (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),   # running max (lane-replicated)
+            pltpu.VMEM((g, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((g, d), jnp.float32),     # output accumulator
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, page_size=page_size,
+                               scale=scale, max_pages=max_pages)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, g, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables, seq_lens, qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
+                               *, page_size: int, scale: float | None = None):
+    """Portable XLA reference for :func:`paged_decode_attention_pallas`.
+
+    Gathers each sequence's pages into a contiguous view and runs masked
+    attention; the unit-test oracle and the CPU execution path.
+    """
+    b, h, d = q.shape
+    h_kv, _, p, _ = k_pages.shape
+    g = h // h_kv
+    max_pages = block_tables.shape[1]
+    s_max = max_pages * p
+    scale = scale if scale is not None else d ** -0.5
+
+    # [H_kv, B, max_pages, P, D] → [B, S, H_kv, D]
+    k_seq = k_pages[:, block_tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
+    v_seq = v_pages[:, block_tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
+
+    qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    kf = k_seq.astype(jnp.float32)
+    vf = v_seq.astype(jnp.float32)
+    scores = jnp.einsum("bngd,bsnd->bngs", qg, kf) * scale
+    valid = jnp.arange(s_max)[None, :] < seq_lens[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bngs,bsnd->bngd", probs, vf)
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           *, page_size: int, scale: float | None = None):
+    """Backend-dispatching paged decode attention: Pallas on TPU, XLA
+    elsewhere (same numerics; the kernel is tested against the XLA path)."""
+    if jax.default_backend() == "tpu":
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, block_tables, seq_lens,
+            page_size=page_size, scale=scale)
+    return paged_decode_attention_xla(
+        q, k_pages, v_pages, block_tables, seq_lens,
+        page_size=page_size, scale=scale)

@@ -38,10 +38,16 @@ _initialized = False
 
 def ensure_initialized(coordinator_address: str | None = None,
                        num_processes: int | None = None,
-                       process_id: int | None = None) -> None:
+                       process_id: int | None = None,
+                       strict: bool = False) -> None:
     """Idempotent :func:`jax.distributed.initialize` (auto-detects TPU
     runtime metadata when no arguments are given).  Call before any other
-    JAX API in multi-host launches; harmless in single-process runs."""
+    JAX API in multi-host launches; harmless in single-process runs.
+
+    ``strict=True`` makes initialisation failure fatal — pass it whenever
+    the caller *explicitly* asked for multi-host execution (otherwise every
+    host silently degrades to an independent single-process run, and a pod
+    writes N duplicate result logs)."""
     global _initialized
     if _initialized:
         return
@@ -59,8 +65,8 @@ def ensure_initialized(coordinator_address: str | None = None,
                                    num_processes=num_processes,
                                    process_id=process_id)
     except (ValueError, RuntimeError):
-        if coordinator_address is not None or num_processes is not None:
-            raise  # explicit multi-host args that fail are a real error
+        if strict or coordinator_address is not None or num_processes is not None:
+            raise  # requested multi-host could not come up: a real error
     _initialized = True
 
 

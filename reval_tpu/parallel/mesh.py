@@ -44,11 +44,14 @@ def init_distributed(coordinator_address: str | None = None,
     """Initialise multi-host JAX (pods, multi-slice over DCN).
 
     With TPU metadata available all arguments are auto-detected; explicit
-    values support manual rigs.  Safe to call once per process, before any
-    other JAX API touches a backend.
+    values support manual rigs.  Safe to call more than once per process —
+    this is the same idempotent entry point as
+    :func:`reval_tpu.parallel.distributed.ensure_initialized`, in strict
+    mode: calling it is an explicit request for multi-host, so failure to
+    bring up the coordinator raises instead of silently degrading.
     """
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    from .distributed import ensure_initialized
+
+    ensure_initialized(coordinator_address=coordinator_address,
+                       num_processes=num_processes,
+                       process_id=process_id, strict=True)

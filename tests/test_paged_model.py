@@ -1,0 +1,84 @@
+"""Paged-cache forward parity: prefill → commit → paged decode must produce
+the same logits as the contiguous left-padded cache path (test_models'
+oracle), for sequences of different lengths sharing one page pool."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from reval_tpu.models import ModelConfig, decode_step, init_kv_cache, init_random_params, prefill
+from reval_tpu.models.paged import commit_prefill, init_paged_cache, paged_decode_step
+
+PAGE = 128
+
+
+def small_cfg():
+    return ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=128)
+
+
+def test_paged_decode_matches_contiguous():
+    cfg = small_cfg()
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    b, t = 2, PAGE  # one-page prefill bucket
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, t)), jnp.int32)
+    pad_len = jnp.asarray([5, 100], jnp.int32)   # lengths 123 and 28
+
+    # contiguous reference
+    cache = init_kv_cache(cfg, b, t + 8, dtype=jnp.float32)
+    logits_ref, cache = prefill(params, cfg, tokens, pad_len, cache)
+
+    # paged: commit the prefill, then decode step by step
+    max_pages = 3
+    pcache = init_paged_cache(cfg, num_pages=1 + b * max_pages, page_size=PAGE,
+                              dtype=jnp.float32)
+    # seq 0 → pages [1, 2], seq 1 → pages [3, 4]; slot for the prefill
+    # bucket (1 page) is the first column; the rest pad with trash page 0
+    tables = jnp.asarray([[1, 2, 0], [3, 4, 0]], jnp.int32)
+    prefill_kv = type(cache)(cache.k[:, :, :t], cache.v[:, :, :t])
+    pcache = commit_prefill(pcache, prefill_kv, pad_len, tables[:, :1])
+    seq_lens = t - pad_len
+
+    nxt = jnp.argmax(logits_ref[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+    cur_pos = jnp.int32(t)
+    for _ in range(4):
+        ref_logits, cache = decode_step(params, cfg, nxt, pad_len, cache, cur_pos)
+        paged_logits, pcache = paged_decode_step(params, cfg, nxt, tables,
+                                                 seq_lens, pcache)
+        np.testing.assert_allclose(np.asarray(paged_logits),
+                                   np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+        nxt = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)[:, None]
+        cur_pos = cur_pos + 1
+        seq_lens = seq_lens + 1
+
+
+def test_idle_slot_is_harmless():
+    """An idle slot (trash table, len 1) must not perturb active slots."""
+    cfg = small_cfg()
+    params = init_random_params(cfg, seed=1, dtype="float32")
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, PAGE)), jnp.int32)
+    pad_len = jnp.zeros(1, jnp.int32)
+    cache = init_kv_cache(cfg, 1, PAGE, dtype=jnp.float32)
+    _, cache = prefill(params, cfg, tokens, pad_len, cache)
+
+    pcache = init_paged_cache(cfg, num_pages=4, page_size=PAGE, dtype=jnp.float32)
+    tables1 = jnp.asarray([[1, 2]], jnp.int32)
+    pcache1 = commit_prefill(pcache, cache, pad_len, tables1[:, :1])
+    solo, _ = paged_decode_step(
+        params, cfg, jnp.asarray([[7]], jnp.int32), tables1,
+        jnp.asarray([PAGE], jnp.int32), pcache1)
+
+    # same sequence in slot 0 + an idle slot 1
+    tables2 = jnp.asarray([[1, 2], [0, 0]], jnp.int32)
+    kv2 = type(cache)(jnp.tile(cache.k, (1, 2, 1, 1, 1)),
+                      jnp.tile(cache.v, (1, 2, 1, 1, 1)))
+    pcache2 = commit_prefill(
+        init_paged_cache(cfg, num_pages=4, page_size=PAGE, dtype=jnp.float32),
+        type(cache)(kv2.k.at[:, 1].set(0), kv2.v.at[:, 1].set(0)),
+        jnp.asarray([0, 0], jnp.int32), jnp.asarray([[1], [0]], jnp.int32))
+    duo, _ = paged_decode_step(
+        params, cfg, jnp.asarray([[7], [3]], jnp.int32), tables2,
+        jnp.asarray([PAGE, 1], jnp.int32), pcache2)
+    np.testing.assert_allclose(np.asarray(duo[0]), np.asarray(solo[0]),
+                               rtol=2e-4, atol=2e-4)
