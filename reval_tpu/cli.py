@@ -255,6 +255,8 @@ def run_fleet(argv: list[str]) -> int:
                 "prompt_type", "results_dir", "repeats", "progress", "tasks",
                 "multihost", "run_consistency", "max_items"}
     task_kwargs = {k: v for k, v in cfg.items() if k not in consumed}
+    cfg_tasks = cfg.get("tasks", FLEET_TASKS)
+    cfg_tasks = (cfg_tasks,) if isinstance(cfg_tasks, str) else tuple(cfg_tasks)
     fleet = FleetRunner(
         dataset=cfg.get("dataset", "humaneval"),
         prompt_type=cfg.get("prompt_type", "direct"),
@@ -262,7 +264,7 @@ def run_fleet(argv: list[str]) -> int:
         results_dir=cfg.get("results_dir", "model_generations"),
         run_consistency=cfg.get("run_consistency", True),
         progress=cfg.get("progress", True),
-        tasks=tuple(cfg.get("tasks", FLEET_TASKS)),
+        tasks=cfg_tasks,
         multihost=multihost, max_items=max_items, **task_kwargs)
     try:
         result = fleet.run()

@@ -16,20 +16,34 @@ class TPUBackend(InferenceBackend):
                  prompt_type: str = "direct", dtype: str = "bfloat16",
                  num_chips: int = 1, dp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
-                 **kwargs):
+                 engine: str = "paged", **kwargs):
+        """``engine``: "paged" (default — continuous batching over the
+        paged KV cache + native scheduler) or "static" (rectangular
+        batches; the dp>1 prompt-sharding path lives here)."""
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         if not model_path:
             raise ValueError(
                 "TPU backend needs model_path (a HuggingFace checkpoint directory "
                 "containing config.json + *.safetensors)"
             )
-        from .engine import TPUEngine
+        if engine == "paged" and dp_size == 1:
+            from .paged_engine import PagedTPUEngine
 
-        self.engine = TPUEngine.from_pretrained(
-            model_path, dtype=dtype, tp_size=num_chips, dp_size=dp_size,
-            batch_size=batch_size, max_seq_len=max_seq_len,
-            local_devices_only=local_devices_only,
-        )
+            self.engine = PagedTPUEngine.from_pretrained(
+                model_path, dtype=dtype, tp_size=num_chips,
+                max_slots=batch_size, max_seq_len=max_seq_len,
+                local_devices_only=local_devices_only,
+            )
+        else:
+            # dp>1 shards the batch axis across chips — the static engine's
+            # rectangular batches are what makes that sharding well-formed
+            from .engine import TPUEngine
+
+            self.engine = TPUEngine.from_pretrained(
+                model_path, dtype=dtype, tp_size=num_chips, dp_size=dp_size,
+                batch_size=batch_size, max_seq_len=max_seq_len,
+                local_devices_only=local_devices_only,
+            )
 
     def infer_one(self, prompt: str) -> str:
         return self.infer_many([prompt])[0]
@@ -43,4 +57,6 @@ class TPUBackend(InferenceBackend):
         )
 
     def close(self) -> None:
+        if self.engine is not None and hasattr(self.engine, "close"):
+            self.engine.close()
         self.engine = None

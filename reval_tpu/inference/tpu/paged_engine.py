@@ -1,0 +1,290 @@
+"""PagedTPUEngine: continuous batching over a paged KV cache.
+
+The throughput engine (SURVEY.md §7 steps 4-5).  Where ``TPUEngine`` runs
+static batches — every sequence in a batch prefills together and the batch
+ends when its *slowest* member stops — this engine keeps a fixed set of
+decode slots fed from an admission queue:
+
+- the **native scheduler** (reval_tpu.runtime, C++) owns pages and slots:
+  FCFS admission with a one-page decode watermark, lazy page allocation as
+  sequences grow, recompute-style preemption on pool exhaustion;
+- **prefill** runs per admitted sequence through the contiguous
+  left-padded path (already MXU-shaped), bucketed to a power-of-two page
+  count, then commits its KV into the allocated pages (models/paged.py);
+- **decode** runs all slots every step through the Pallas paged-attention
+  kernel, a jitted ``lax.scan`` chunk at a time; finished sequences free
+  their slot at the next chunk boundary and a waiting request takes it.
+
+The result: short answers ([ANSWER] NO, 2 tokens) stop occupying a slot
+the moment they finish instead of padding out to the batch's longest
+member — exactly the fan-out shape of DREval probe prompts.
+
+Sharding: tensor parallelism only (params + KV heads over ``tp``); data
+parallelism for paged decode is one engine replica per host/dp-group
+(fleet replicate mode), because the page pool is batch-global state.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import ModelConfig, init_kv_cache, load_checkpoint, prefill
+from ...models.paged import commit_prefill, init_paged_cache, paged_decode_step
+from ...runtime import PagedRuntime
+from .engine import EngineStats, truncate_at_stop
+from .sampling import sample_token
+from .tokenizer import HFTokenizer
+
+__all__ = ["PagedTPUEngine"]
+
+CHUNK = 8  # decode steps per host sync (stop-string check cadence)
+
+
+def _pow2_pages(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Request:
+    index: int                   # position in the caller's prompt list
+    ids: list[int]
+    max_new: int
+    generated: list[int] = field(default_factory=list)
+    done: bool = False
+
+
+class PagedTPUEngine:
+    def __init__(self, params, cfg: ModelConfig, tokenizer, *,
+                 max_slots: int = 8, page_size: int = 128,
+                 max_seq_len: int = 8192, num_pages: int | None = None,
+                 mesh=None, seed: int = 0):
+        assert max_seq_len % page_size == 0
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.max_pages_per_seq = max_seq_len // page_size
+        # default pool: every slot can reach max_seq_len (no oversubscription;
+        # pass a smaller num_pages to trade HBM for preemption risk)
+        self.num_pages = (num_pages if num_pages is not None
+                          else 1 + max_slots * self.max_pages_per_seq)
+        self.mesh = mesh
+        self.stats = EngineStats()
+        self._key = jax.random.PRNGKey(seed)
+        self.params = params
+        dtype = params["embed"].dtype
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel import shard_params
+            from ...parallel.sharding import paged_cache_spec
+
+            self.params = shard_params(params, cfg, mesh)
+            self._cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
+            self._replicated = NamedSharding(mesh, P())
+        else:
+            self._cache_sharding = None
+            self._replicated = None
+        self.rt = PagedRuntime(self.num_pages, page_size, max_slots,
+                               self.max_pages_per_seq)
+        self.cache = init_paged_cache(cfg, self.num_pages, page_size, dtype=dtype)
+        if self._cache_sharding is not None:
+            self.cache = type(self.cache)(
+                *(jax.device_put(c, self._cache_sharding) for c in self.cache))
+        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg))
+        self._jit_commit = jax.jit(commit_prefill, donate_argnums=(0,))
+        self._jit_chunk = jax.jit(
+            partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
+            donate_argnames=("cache",))
+
+    @classmethod
+    def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
+                        tp_size: int = 1, max_slots: int = 8,
+                        page_size: int = 128, max_seq_len: int = 8192,
+                        num_pages: int | None = None, tokenizer=None,
+                        seed: int = 0,
+                        local_devices_only: bool = False) -> "PagedTPUEngine":
+        params, cfg = load_checkpoint(model_path, dtype=dtype)
+        if tokenizer is None:
+            tokenizer = HFTokenizer(model_path)
+        mesh = None
+        if tp_size > 1:
+            from ...parallel import make_mesh
+
+            devices = jax.local_devices() if local_devices_only else None
+            mesh = make_mesh(tp=tp_size, devices=devices)
+        return cls(params, cfg, tokenizer, max_slots=max_slots,
+                   page_size=page_size, max_seq_len=max_seq_len,
+                   num_pages=num_pages, mesh=mesh, seed=seed)
+
+    def close(self) -> None:
+        if self.rt is not None:
+            self.rt.close()
+            self.rt = None
+
+    # -- jitted pieces -----------------------------------------------------
+    @staticmethod
+    def _decode_chunk(params, first_token, block_tables, seq_lens, cache,
+                      temperature, key, *, cfg: ModelConfig, steps: int):
+        """``steps`` paged decode iterations for the whole slot batch."""
+
+        def body(carry, _):
+            token, cache, lens, key = carry
+            logits, cache = paged_decode_step(params, cfg, token, block_tables,
+                                              lens, cache)
+            key, sub = jax.random.split(key)
+            nxt = sample_token(logits, temperature, sub)
+            return (nxt[:, None], cache, lens + 1, key), nxt
+
+        (last, cache, _, _), toks = jax.lax.scan(
+            body, (first_token, cache, seq_lens, key), None, length=steps)
+        return toks.T, cache, last
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- generation --------------------------------------------------------
+    def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
+                 temperature: float = 0.0, stop: list[str] | None = None) -> list[str]:
+        if not prompts:
+            return []
+        stop = stop or []
+        max_len = self.max_pages_per_seq * self.page_size
+        limit = max_len - max_new_tokens - 1
+        reqs: dict[int, _Request] = {}
+        for i, prompt in enumerate(prompts):
+            ids = self.tokenizer.encode(prompt)
+            if len(ids) > limit:
+                ids = ids[-limit:]      # clip from the left, keep the tail
+            seq_id = self.rt.submit(len(ids), max_new_tokens)
+            reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens)
+
+        active: dict[int, int] = {}          # slot -> seq_id
+        slot_token = np.zeros((self.max_slots, 1), np.int32)
+        temp = jnp.float32(temperature)
+        while True:
+            for seq_id, slot in self.rt.admit():
+                req = reqs[seq_id]
+                req.generated = []           # recompute after preemption too
+                first = self._prefill_into_pages(req, seq_id, temp)
+                req.generated.append(first)
+                slot_token[slot] = first
+                active[slot] = seq_id
+                if self._finished(req, stop):
+                    self._retire(req, seq_id, slot, active)
+            if not active:
+                if any(not r.done for r in reqs.values()):
+                    raise RuntimeError(
+                        "paged scheduler deadlock: nothing running or admissible")
+                break
+
+            # every active sequence must have pages for the whole chunk
+            # BEFORE the decode writes into them
+            steps = min(CHUNK, min(reqs[s].max_new - len(reqs[s].generated)
+                                   for s in active.values()))
+            self._reserve_chunk(active, reqs, steps)
+            if not active:
+                continue                     # everyone got preempted
+
+            tables = np.zeros((self.max_slots, self.max_pages_per_seq), np.int32)
+            lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
+            for slot, seq_id in active.items():
+                tables[slot] = self.rt.block_table(seq_id)
+                req = reqs[seq_id]
+                # materialised tokens = prompt + generated minus the pending
+                # input token (written during the chunk's first step)
+                lens[slot] = len(req.ids) + len(req.generated) - 1
+            t0 = time.perf_counter()
+            toks, self.cache, last = self._jit_chunk(
+                self.params, self._dev(jnp.asarray(slot_token)),
+                self._dev(jnp.asarray(tables)), self._dev(jnp.asarray(lens)),
+                self.cache, temp, self._next_key(), steps=steps)
+            toks_host = np.asarray(toks)
+            slot_token = np.array(last)      # copy: host-mutated on admission
+            self.stats.decode_seconds += time.perf_counter() - t0
+            self.stats.generated_tokens += steps * len(active)
+
+            for slot, seq_id in list(active.items()):
+                req = reqs[seq_id]
+                req.generated.extend(int(t) for t in toks_host[slot])
+                if self._finished(req, stop):
+                    self._retire(req, seq_id, slot, active)
+
+        out: list[str] = [""] * len(prompts)
+        for req in reqs.values():
+            ids = req.generated
+            if self.tokenizer.eos_id in ids:
+                ids = ids[: ids.index(self.tokenizer.eos_id)]
+            out[req.index] = truncate_at_stop(self.tokenizer.decode(ids), stop)
+        self.stats.prompts += len(prompts)
+        return out
+
+    # -- host-side helpers -------------------------------------------------
+    def _dev(self, arr):
+        if self._replicated is not None:
+            return jax.device_put(arr, self._replicated)
+        return arr
+
+    def _finished(self, req: _Request, stop: list[str]) -> bool:
+        if len(req.generated) >= req.max_new:
+            return True
+        if self.tokenizer.eos_id in req.generated:
+            return True
+        if not stop:
+            return False
+        text = self.tokenizer.decode(req.generated)
+        return any(s in text for s in stop)
+
+    def _retire(self, req: _Request, seq_id: int, slot: int,
+                active: dict[int, int]) -> None:
+        req.done = True
+        self.rt.release(seq_id)
+        active.pop(slot, None)
+
+    def _reserve_chunk(self, active: dict[int, int],
+                       reqs: dict[int, _Request], steps: int) -> None:
+        """Pre-allocate pages so a chunk of ``steps`` writes cannot land
+        outside a sequence's block table; preempt on pool exhaustion."""
+        for slot, seq_id in list(active.items()):
+            while slot in active:            # we may become a victim ourselves
+                if self.rt.advance(seq_id, steps) is not None:
+                    break
+                victim = self.rt.preempt_last()
+                if victim is None:
+                    raise RuntimeError("page pool exhausted with nothing to preempt")
+                reqs[victim].generated = []  # recompute on re-admission
+                vslot = next(s for s, q in active.items() if q == victim)
+                active.pop(vslot)
+
+    def _prefill_into_pages(self, req: _Request, seq_id: int,
+                            temperature: jnp.ndarray) -> int:
+        """Prefill one admitted sequence, commit its KV into its pages,
+        return the first sampled token."""
+        n_pages_bucket = _pow2_pages(
+            (len(req.ids) + self.page_size - 1) // self.page_size)
+        t = n_pages_bucket * self.page_size
+        tokens = np.full((1, t), self.tokenizer.pad_id, np.int32)
+        tokens[0, t - len(req.ids):] = req.ids
+        pad_len = jnp.asarray([t - len(req.ids)], jnp.int32)
+        table = self.rt.block_table(seq_id)[:n_pages_bucket][None, :]
+        t0 = time.perf_counter()
+        kv = init_kv_cache(self.cfg, 1, t, dtype=self.params["embed"].dtype)
+        logits, kv = self._jit_prefill(self.params, tokens=self._dev(jnp.asarray(tokens)),
+                                       pad_len=self._dev(pad_len), cache=kv)
+        self.cache = self._jit_commit(self.cache, kv, self._dev(pad_len),
+                                      self._dev(jnp.asarray(table)))
+        first = sample_token(logits[:, -1, :], temperature, self._next_key())
+        first_host = int(np.asarray(first)[0])
+        self.stats.prefill_seconds += time.perf_counter() - t0
+        self.stats.prefill_tokens += len(req.ids)
+        return first_host

@@ -9,13 +9,17 @@ compiles the communication.
 """
 
 from .mesh import make_mesh, init_distributed, mesh_axis_sizes
-from .sharding import param_specs, shard_params, batch_sharding
+from .sharding import param_specs, shard_params, batch_sharding, paged_cache_spec
+from .ring_attention import ring_self_attention, ring_attention_sharded
 
 __all__ = [
     "batch_sharding",
     "init_distributed",
     "make_mesh",
     "mesh_axis_sizes",
+    "paged_cache_spec",
     "param_specs",
+    "ring_attention_sharded",
+    "ring_self_attention",
     "shard_params",
 ]

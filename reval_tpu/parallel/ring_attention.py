@@ -1,0 +1,124 @@
+"""Ring attention: sequence-parallel causal attention over an ``sp`` mesh axis.
+
+Long-context prefill support (the reference has no long-context machinery —
+SURVEY.md §5.7 — but this framework treats it as first-class): when one
+sequence's KV does not fit a single chip's HBM, shard the *sequence*
+dimension over the mesh and pass KV blocks around the ring, overlapping
+each hop with the attention compute for the block already in hand.
+
+Design (blockwise/ring formulation, written for XLA collectives):
+- runs inside :func:`jax.shard_map` over the ``sp`` axis; every device
+  holds ``[B, T/sp, H, D]`` of q, k, v;
+- ``sp`` static steps: compute online-softmax partial attention of the
+  local q block against the currently-held KV block, then rotate the KV
+  block to the next device with ``lax.ppermute`` (XLA schedules the
+  permute on ICI concurrently with the next block's compute);
+- causality is enforced with *global* positions (block index × block
+  length + local offset), so each step is one uniform masked matmul — no
+  per-device control flow, fully MXU-shaped.
+
+The same kernel body also runs un-sharded (``axis_name=None``) which is
+what the parity tests compare against ``prefill_attention``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_self_attention", "ring_attention_sharded"]
+
+_NEG_INF = -1e30
+
+
+def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale):
+    """One online-softmax accumulation of q against a KV block.
+
+    q: [B, Tq, H_kv, G, D]; k/v: [B, Tk, H_kv, D]; positions: [Tq]/[Tk];
+    m/l: [B, H_kv, G, Tq, 1]; acc: [B, Tq, H_kv, G, D].
+    """
+    scores = jnp.einsum("bqngd,bknd->bngqk", q, k) * scale
+    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    m_cur = scores.max(axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # m stays -inf for fully-masked rows (no valid key yet): keep exp at 0
+    alpha = jnp.exp(jnp.where(m == _NEG_INF, _NEG_INF, m - m_new))
+    probs = jnp.exp(scores - m_new)
+    l_new = alpha * l + probs.sum(axis=-1, keepdims=True)
+    upd = jnp.einsum("bngqk,bknd->bqngd", probs, v)
+    acc_new = acc * alpha.transpose(0, 3, 1, 2, 4) + upd
+    return m_new, l_new, acc_new
+
+
+def _ring_body(q, k, v, *, axis_name: str | None, axis_size: int, scale):
+    """Local ring-attention body.  q: [B, Tl, H, D]; k/v: [B, Tl, H_kv, D]."""
+    b, t_loc, h, d = q.shape
+    n_kv = k.shape[2]
+    g = h // n_kv
+    idx = jax.lax.axis_index(axis_name) if axis_name else 0
+
+    qg = q.reshape(b, t_loc, n_kv, g, d).astype(jnp.float32)
+    offs = jnp.arange(t_loc)
+    q_pos = idx * t_loc + offs
+
+    m = jnp.full((b, n_kv, g, t_loc, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, n_kv, g, t_loc, 1), jnp.float32)
+    acc = jnp.zeros((b, t_loc, n_kv, g, d), jnp.float32)
+
+    for step in range(axis_size):
+        # after `step` rotations we hold the block that started on idx-step
+        block = (idx - step) % axis_size
+        k_pos = block * t_loc + offs
+        # cast per block at compute time: KV rotates in its source dtype so
+        # bf16 caches move half the bytes per ICI hop
+        m, l, acc = _block_update(qg, k.astype(jnp.float32),
+                                  v.astype(jnp.float32), q_pos, k_pos,
+                                  m, l, acc, scale)
+        if axis_name is not None and step + 1 < axis_size:
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    # rows with no valid key (impossible for causal q_pos>=0) guard anyway
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, t_loc, h, d).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, *, axis_name: str | None = None,
+                        axis_size: int = 1, scale: float | None = None):
+    """Causal self-attention with ring-rotated KV blocks.
+
+    Call inside ``shard_map`` with ``axis_name`` set (q/k/v are the local
+    sequence shards), or stand-alone with ``axis_name=None`` for the
+    single-device reference semantics.  Sequences are unpadded; shard
+    layout is contiguous (device i holds positions [i·Tl, (i+1)·Tl)).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    return _ring_body(q, k, v, axis_name=axis_name, axis_size=axis_size,
+                      scale=scale)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
+                           scale: float | None = None):
+    """Shard ``q, k, v`` ([B, T, H, D], T divisible by the ``sp`` axis
+    size) over the sequence dimension and run ring attention.
+
+    The returned array is sequence-sharded on the same axis; callers
+    under ``jit`` can keep computing on it shard-local (norms/MLPs are
+    elementwise over T) so the full sequence never materialises on one
+    device.
+    """
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[sp_axis]
+    t = q.shape[1]
+    if t % axis_size:
+        raise ValueError(f"sequence length {t} not divisible by sp={axis_size}")
+    body = partial(ring_self_attention, axis_name=sp_axis,
+                   axis_size=axis_size, scale=scale)
+    spec = P(None, sp_axis, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

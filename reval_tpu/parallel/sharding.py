@@ -19,7 +19,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import ModelConfig
 
-__all__ = ["param_specs", "shard_params", "batch_sharding", "kv_cache_spec"]
+__all__ = ["param_specs", "shard_params", "batch_sharding", "kv_cache_spec",
+           "paged_cache_spec"]
 
 # leaf name → spec for stacked [L, ...] layer weights
 _LAYER_RULES = {
@@ -117,3 +118,12 @@ def kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
     """[L, B, S, H_kv, D] — batch over dp, kv heads over tp if divisible."""
     div = _divisible(cfg, mesh)
     return P(None, "dp", None, "tp" if div["kv_heads"] else None, None)
+
+
+def paged_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
+    """[L, H_kv, N_pages, P, D] — kv heads over tp if divisible.  The page
+    pool is shared across the whole decode batch, so there is no dp axis;
+    data parallelism for the paged engine is one engine replica per dp
+    group (fleet replicate mode)."""
+    div = _divisible(cfg, mesh)
+    return P(None, "tp" if div["kv_heads"] else None, None, None, None)

@@ -1,0 +1,189 @@
+"""Native runtime bindings: paged-KV allocator + continuous-batching scheduler.
+
+The C++ library (``native/runtime.cpp``) owns the host-side state of the
+paged KV cache — the free-page pool, per-sequence block tables, batch-slot
+assignment, FCFS admission with a decode watermark, recompute-style
+preemption, and refcounted prefix-sharing forks.  This package compiles it
+on first use (g++, no external deps) and wraps the C ABI with ctypes.
+
+Split of responsibilities with the JAX side:
+- this runtime decides *which pages* and *which slots* (integers only);
+- ``models/paged.py`` + the Pallas kernel move the actual KV bytes in HBM.
+The engine (inference/tpu/paged_engine.py) is the glue loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+
+__all__ = ["PagedRuntime", "load_native", "NativeBuildError"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "runtime.cpp")
+_LIB = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build(so_path: str) -> None:
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise NativeBuildError("no C++ compiler found (need g++ or c++ on PATH)")
+    # build to a temp name then rename: atomic against concurrent importers
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(so_path))
+    os.close(fd)
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        os.unlink(tmp)
+        raise NativeBuildError(f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, so_path)
+
+
+def load_native() -> ctypes.CDLL:
+    """Compile (if stale) and load the runtime library; cached per process."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    so_path = os.path.join(_NATIVE_DIR, "_reval_rt.so")
+    if (not os.path.exists(so_path)
+            or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
+        _build(so_path)
+    lib = ctypes.CDLL(so_path)
+    i32, i64, ptr = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+    p32 = ctypes.POINTER(ctypes.c_int32)
+    p64 = ctypes.POINTER(ctypes.c_int64)
+    sigs = {
+        "reval_rt_create": ([i32, i32, i32, i32], ptr),
+        "reval_rt_destroy": ([ptr], None),
+        "reval_rt_submit": ([ptr, i32, i32], i64),
+        "reval_rt_admit": ([ptr, p64, p32, i32], i32),
+        "reval_rt_block_table": ([ptr, i64, p32], i32),
+        "reval_rt_seq_len": ([ptr, i64], i32),
+        "reval_rt_slot_of": ([ptr, i64], i32),
+        "reval_rt_advance": ([ptr, i64, i32], i32),
+        "reval_rt_fork": ([ptr, i64, p32], i64),
+        "reval_rt_preempt_last": ([ptr], i64),
+        "reval_rt_release": ([ptr, i64], None),
+        "reval_rt_free_pages": ([ptr], i32),
+        "reval_rt_num_waiting": ([ptr], i32),
+        "reval_rt_num_running": ([ptr], i32),
+        "reval_rt_page_ref": ([ptr, i32], i32),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    _LIB = lib
+    return lib
+
+
+class PagedRuntime:
+    """Pythonic facade over the native scheduler/allocator.
+
+    One instance manages one paged KV cache pool (`num_pages` pages of
+    `page_size` tokens) and one decode batch of `max_slots` slots.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, max_slots: int,
+                 max_pages_per_seq: int):
+        self._lib = load_native()
+        self._h = self._lib.reval_rt_create(num_pages, page_size, max_slots,
+                                            max_pages_per_seq)
+        if not self._h:
+            raise ValueError("invalid PagedRuntime parameters")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_pages_per_seq = max_pages_per_seq
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.reval_rt_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- request lifecycle -------------------------------------------------
+    def submit(self, prompt_len: int, max_new_tokens: int) -> int:
+        seq_id = self._lib.reval_rt_submit(self._h, prompt_len, max_new_tokens)
+        if seq_id == -1:
+            raise ValueError(
+                f"request (prompt={prompt_len}, new={max_new_tokens}) exceeds "
+                f"max_pages_per_seq={self.max_pages_per_seq}")
+        return seq_id
+
+    def admit(self, max_n: int | None = None) -> list[tuple[int, int]]:
+        """Admit waiting requests FCFS → [(seq_id, slot), ...]."""
+        max_n = self.max_slots if max_n is None else max_n
+        ids = (ctypes.c_int64 * max_n)()
+        slots = (ctypes.c_int32 * max_n)()
+        n = self._lib.reval_rt_admit(self._h, ids, slots, max_n)
+        return [(int(ids[i]), int(slots[i])) for i in range(n)]
+
+    def block_table(self, seq_id: int) -> np.ndarray:
+        out = np.zeros(self.max_pages_per_seq, np.int32)
+        n = self._lib.reval_rt_block_table(
+            self._h, seq_id, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if n < 0:
+            raise KeyError(seq_id)
+        return out
+
+    def seq_len(self, seq_id: int) -> int:
+        n = self._lib.reval_rt_seq_len(self._h, seq_id)
+        if n < 0:
+            raise KeyError(seq_id)
+        return n
+
+    def slot_of(self, seq_id: int) -> int:
+        return self._lib.reval_rt_slot_of(self._h, seq_id)
+
+    def advance(self, seq_id: int, n: int) -> int | None:
+        """Extend by ``n`` tokens; None signals OOM (caller preempts)."""
+        res = self._lib.reval_rt_advance(self._h, seq_id, n)
+        return None if res == -1 else res
+
+    def fork(self, seq_id: int) -> tuple[int, int]:
+        """Prefix-sharing fork → (child_id, fresh_tail_page).  The caller
+        must copy the parent's partial tail page into fresh_tail_page on
+        device when it is non-zero."""
+        fresh = ctypes.c_int32(0)
+        child = self._lib.reval_rt_fork(self._h, seq_id, ctypes.byref(fresh))
+        if child == -1:
+            raise RuntimeError(f"fork of seq {seq_id} failed (unknown id or OOM)")
+        return int(child), int(fresh.value)
+
+    def preempt_last(self) -> int | None:
+        victim = self._lib.reval_rt_preempt_last(self._h)
+        return None if victim == -1 else int(victim)
+
+    def release(self, seq_id: int) -> None:
+        self._lib.reval_rt_release(self._h, seq_id)
+
+    # -- stats -------------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return self._lib.reval_rt_free_pages(self._h)
+
+    @property
+    def num_waiting(self) -> int:
+        return self._lib.reval_rt_num_waiting(self._h)
+
+    @property
+    def num_running(self) -> int:
+        return self._lib.reval_rt_num_running(self._h)
+
+    def page_ref(self, page: int) -> int:
+        return self._lib.reval_rt_page_ref(self._h, page)

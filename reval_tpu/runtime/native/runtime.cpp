@@ -1,0 +1,286 @@
+// Paged-KV block allocator + continuous-batching scheduler.
+//
+// TPU-native equivalent of the native scheduling/allocation machinery the
+// reference gets through vLLM (reference inference.py:90-95 constructs
+// vllm.LLM, whose C++/CUDA core owns the paged KV block pool and the
+// continuous-batching scheduler; SURVEY.md §2.9 catalogues that vendored
+// dependency).  The accelerator side of paging lives in JAX/Pallas
+// (reval_tpu/ops/pallas_attention.py); this library owns the host-side
+// bookkeeping: which HBM pages belong to which sequence, which requests
+// run in which batch slots, admission control, and prefix-sharing forks.
+//
+// Exposed as a plain C ABI consumed via ctypes (reval_tpu/runtime) — no
+// pybind11 in the image, and the call rate (one advance per decode chunk)
+// is far below where binding overhead matters.
+//
+// Concurrency: single-owner.  The engine drives one runtime from one
+// thread; no locks inside.
+//
+// Page 0 is the trash page (see models/paged.py): never allocated, used to
+// pad block tables, so a stale table slot can never alias live data.
+
+#include <cstdint>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum class SeqState { kWaiting, kRunning };
+
+struct Seq {
+  int64_t id = -1;
+  std::vector<int32_t> pages;  // owned (or shared, see ref_counts) page ids
+  int32_t len = 0;             // tokens currently materialised in the cache
+  int32_t prompt_len = 0;
+  int32_t max_new = 0;
+  int32_t slot = -1;           // batch slot while running, -1 otherwise
+  SeqState state = SeqState::kWaiting;
+};
+
+struct Runtime {
+  int32_t num_pages = 0;
+  int32_t page_size = 0;
+  int32_t max_slots = 0;
+  int32_t max_pages_per_seq = 0;
+
+  std::vector<int32_t> free_list;       // LIFO for locality
+  std::vector<int32_t> ref_counts;      // per page; >1 under prefix sharing
+  std::vector<int64_t> slots;           // slot -> seq id (-1 = idle)
+  std::deque<int64_t> waiting;          // FCFS admission queue
+  std::unordered_map<int64_t, Seq> seqs;
+  int64_t next_id = 1;
+
+  int32_t pages_needed(int32_t tokens) const {
+    return (tokens + page_size - 1) / page_size;
+  }
+  int32_t alloc_page() {
+    if (free_list.empty()) return -1;
+    int32_t p = free_list.back();
+    free_list.pop_back();
+    ref_counts[p] = 1;
+    return p;
+  }
+  void drop_page(int32_t p) {
+    if (--ref_counts[p] == 0) free_list.push_back(p);
+  }
+};
+
+Runtime* as_rt(void* h) { return static_cast<Runtime*>(h); }
+
+}  // namespace
+
+extern "C" {
+
+void* reval_rt_create(int32_t num_pages, int32_t page_size, int32_t max_slots,
+                      int32_t max_pages_per_seq) {
+  if (num_pages < 2 || page_size < 1 || max_slots < 1 || max_pages_per_seq < 1)
+    return nullptr;
+  auto* rt = new Runtime();
+  rt->num_pages = num_pages;
+  rt->page_size = page_size;
+  rt->max_slots = max_slots;
+  rt->max_pages_per_seq = max_pages_per_seq;
+  rt->ref_counts.assign(num_pages, 0);
+  rt->slots.assign(max_slots, -1);
+  rt->free_list.reserve(num_pages - 1);
+  // page 0 is the trash page: permanently "allocated", never handed out
+  rt->ref_counts[0] = 1;
+  for (int32_t p = num_pages - 1; p >= 1; --p) rt->free_list.push_back(p);
+  return rt;
+}
+
+void reval_rt_destroy(void* h) { delete as_rt(h); }
+
+// Queue a request.  Returns the sequence id, or -1 if the request can
+// never fit (more pages than max_pages_per_seq allows).
+int64_t reval_rt_submit(void* h, int32_t prompt_len, int32_t max_new_tokens) {
+  auto* rt = as_rt(h);
+  if (prompt_len < 1 || max_new_tokens < 0) return -1;
+  // must fit both the per-sequence table and the pool running solo
+  // (num_pages - 1 usable: page 0 is the trash page) — otherwise the
+  // request could never complete even with everything else preempted
+  int32_t total = rt->pages_needed(prompt_len + max_new_tokens);
+  if (total > rt->max_pages_per_seq || total > rt->num_pages - 1)
+    return -1;
+  Seq seq;
+  seq.id = rt->next_id++;
+  seq.prompt_len = prompt_len;
+  seq.max_new = max_new_tokens;
+  rt->seqs.emplace(seq.id, seq);
+  rt->waiting.push_back(seq.id);
+  return seq.id;
+}
+
+// FCFS admission: move waiting sequences into free batch slots while the
+// pool can hold their prompt pages plus a one-page decode watermark.
+// Fills seq_ids/slot_ids (each sized >= max_n); returns the count admitted.
+// Admitted sequences have their prompt pages allocated and len = prompt_len
+// — the engine prefills and commits the KV for exactly those pages.
+int32_t reval_rt_admit(void* h, int64_t* seq_ids, int32_t* slot_ids,
+                       int32_t max_n) {
+  auto* rt = as_rt(h);
+  int32_t admitted = 0;
+  while (admitted < max_n && !rt->waiting.empty()) {
+    int64_t id = rt->waiting.front();
+    Seq& seq = rt->seqs.at(id);
+    int32_t need = rt->pages_needed(seq.prompt_len);
+    // one-page decode watermark, but only when decode will ever grow the
+    // allocation — a request whose full budget fits its prompt pages may
+    // take the last free page (otherwise it can deadlock the queue)
+    int32_t grows = rt->pages_needed(seq.prompt_len + seq.max_new) > need;
+    if (static_cast<int32_t>(rt->free_list.size()) < need + grows) break;
+    int32_t slot = -1;
+    for (int32_t s = 0; s < rt->max_slots; ++s)
+      if (rt->slots[s] == -1) { slot = s; break; }
+    if (slot == -1) break;
+    rt->waiting.pop_front();
+    seq.pages.reserve(need);
+    for (int32_t i = 0; i < need; ++i) seq.pages.push_back(rt->alloc_page());
+    seq.len = seq.prompt_len;
+    seq.slot = slot;
+    seq.state = SeqState::kRunning;
+    rt->slots[slot] = id;
+    seq_ids[admitted] = id;
+    slot_ids[admitted] = slot;
+    ++admitted;
+  }
+  return admitted;
+}
+
+// Copy the sequence's block table into out (length max_pages_per_seq),
+// padding with the trash page.  Returns the number of live pages, -1 on
+// unknown id.
+int32_t reval_rt_block_table(void* h, int64_t seq_id, int32_t* out) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  if (it == rt->seqs.end()) return -1;
+  const auto& pages = it->second.pages;
+  for (int32_t i = 0; i < rt->max_pages_per_seq; ++i)
+    out[i] = i < static_cast<int32_t>(pages.size()) ? pages[i] : 0;
+  return static_cast<int32_t>(pages.size());
+}
+
+int32_t reval_rt_seq_len(void* h, int64_t seq_id) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  return it == rt->seqs.end() ? -1 : it->second.len;
+}
+
+int32_t reval_rt_slot_of(void* h, int64_t seq_id) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  return it == rt->seqs.end() ? -1 : it->second.slot;
+}
+
+// Extend a running sequence by n generated tokens, allocating pages as
+// they cross page boundaries.  Returns the new length, or -1 if the pool
+// is exhausted (caller should preempt; the sequence keeps the pages it
+// had, and its length the tokens those pages can hold).
+int32_t reval_rt_advance(void* h, int64_t seq_id, int32_t n) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  if (it == rt->seqs.end() || it->second.state != SeqState::kRunning || n < 0)
+    return -1;
+  Seq& seq = it->second;
+  int32_t target = seq.len + n;
+  int32_t need = rt->pages_needed(target);
+  if (need > rt->max_pages_per_seq) return -1;
+  while (static_cast<int32_t>(seq.pages.size()) < need) {
+    int32_t p = rt->alloc_page();
+    // OOM: leave len untouched (pages grabbed so far stay accounted to the
+    // sequence; a retry after preemption needs correspondingly fewer)
+    if (p == -1) return -1;
+    seq.pages.push_back(p);
+  }
+  seq.len = target;
+  return target;
+}
+
+// Fork for prefix sharing: the child shares every *full* page of the
+// parent by refcount and gets a fresh page for the partial tail (the
+// engine must copy the tail page's contents device-side).  Returns the
+// child id (queued as waiting with its slot/admission handled by the
+// caller via reval_rt_adopt), or -1 on failure.  Out param fresh_page
+// receives the tail page id, or the trash page if the parent's length is
+// page-aligned.
+int64_t reval_rt_fork(void* h, int64_t seq_id, int32_t* fresh_page) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  if (it == rt->seqs.end()) return -1;
+  Seq& parent = it->second;
+  int32_t full = parent.len / rt->page_size;
+  bool has_tail = parent.len % rt->page_size != 0;
+  int32_t tail = 0;
+  if (has_tail) {
+    tail = rt->alloc_page();
+    if (tail == -1) return -1;
+  }
+  Seq child;
+  child.id = rt->next_id++;
+  child.prompt_len = parent.prompt_len;
+  child.max_new = parent.max_new;
+  child.len = parent.len;
+  child.pages.assign(parent.pages.begin(), parent.pages.begin() + full);
+  for (int32_t p : child.pages) ++rt->ref_counts[p];
+  if (has_tail) child.pages.push_back(tail);
+  *fresh_page = has_tail ? tail : 0;
+  rt->seqs.emplace(child.id, child);
+  rt->waiting.push_back(child.id);
+  return child.id;
+}
+
+// Preempt the most recently admitted running sequence: frees its pages and
+// slot and requeues it at the FRONT of the waiting queue (recompute-style
+// preemption — prefill reruns when it is re-admitted).  Returns its id, or
+// -1 if nothing is running.
+int64_t reval_rt_preempt_last(void* h) {
+  auto* rt = as_rt(h);
+  int64_t victim = -1;
+  for (int32_t s = 0; s < rt->max_slots; ++s)
+    if (rt->slots[s] != -1 && rt->slots[s] > victim) victim = rt->slots[s];
+  if (victim == -1) return -1;
+  Seq& seq = rt->seqs.at(victim);
+  for (int32_t p : seq.pages) rt->drop_page(p);
+  seq.pages.clear();
+  rt->slots[seq.slot] = -1;
+  seq.slot = -1;
+  seq.len = 0;
+  seq.state = SeqState::kWaiting;
+  rt->waiting.push_front(victim);
+  return victim;
+}
+
+// Finish a sequence: free pages (refcount-aware) and its slot, forget it.
+void reval_rt_release(void* h, int64_t seq_id) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  if (it == rt->seqs.end()) return;
+  Seq& seq = it->second;
+  for (int32_t p : seq.pages) rt->drop_page(p);
+  if (seq.slot >= 0) rt->slots[seq.slot] = -1;
+  if (seq.state == SeqState::kWaiting)
+    for (auto w = rt->waiting.begin(); w != rt->waiting.end(); ++w)
+      if (*w == seq_id) { rt->waiting.erase(w); break; }
+  rt->seqs.erase(it);
+}
+
+int32_t reval_rt_free_pages(void* h) {
+  return static_cast<int32_t>(as_rt(h)->free_list.size());
+}
+int32_t reval_rt_num_waiting(void* h) {
+  return static_cast<int32_t>(as_rt(h)->waiting.size());
+}
+int32_t reval_rt_num_running(void* h) {
+  auto* rt = as_rt(h);
+  int32_t n = 0;
+  for (int64_t s : rt->slots) n += s != -1;
+  return n;
+}
+int32_t reval_rt_page_ref(void* h, int32_t page) {
+  auto* rt = as_rt(h);
+  if (page < 0 || page >= rt->num_pages) return -1;
+  return rt->ref_counts[page];
+}
+
+}  // extern "C"
