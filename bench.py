@@ -15,6 +15,7 @@ speedup of the batched path.  Prints exactly one JSON line.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 
@@ -27,8 +28,7 @@ def build_prompts(n_items: int = 3) -> list[str]:
     return [j.prompt for j in jobs]
 
 
-def make_engine(batch_size: int):
-    from reval_tpu.inference.tpu.engine import TPUEngine
+def flagship():
     from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
     from reval_tpu.models import ModelConfig, init_random_params
 
@@ -38,8 +38,26 @@ def make_engine(batch_size: int):
         rope_theta=100000.0,
     )
     params = init_random_params(cfg, seed=0, dtype="bfloat16")
-    return TPUEngine(params, cfg, ByteTokenizer(), batch_size=batch_size,
-                     max_seq_len=4096)
+    return params, cfg, ByteTokenizer()
+
+
+def make_engine(batch_size: int):
+    """The production path: continuous batching over the paged KV cache
+    (Pallas kernel on TPU) driven by the native C++ scheduler."""
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+
+    params, cfg, tok = flagship()
+    return PagedTPUEngine(params, cfg, tok, max_slots=batch_size,
+                          max_seq_len=4096)
+
+
+def make_serial_engine():
+    """The reference harness shape: one prompt at a time (reference
+    evaluation.py:105-107 infers serially), static batch of 1."""
+    from reval_tpu.inference.tpu.engine import TPUEngine
+
+    params, cfg, tok = flagship()
+    return TPUEngine(params, cfg, tok, batch_size=1, max_seq_len=4096)
 
 
 def timed_run(engine, prompts: list[str], max_new_tokens: int) -> float:
@@ -53,6 +71,12 @@ def timed_run(engine, prompts: list[str], max_new_tokens: int) -> float:
 def main() -> None:
     import jax
 
+    # persistent XLA compilation cache: decode/prefill variants compile once
+    # per machine, not once per run (jit cache is per-process otherwise)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/reval_tpu_xla"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     max_new = 32
     prompts = build_prompts()
     n = len(prompts)
@@ -60,8 +84,13 @@ def main() -> None:
     batched = make_engine(batch_size=8)
     timed_run(batched, prompts[:8], max_new)      # warmup: compile prefill+decode
     batched_s = timed_run(batched, prompts, max_new)
+    batched.close()
+    del batched                                   # free params + page pool HBM
+    import gc
 
-    serial = make_engine(batch_size=1)
+    gc.collect()
+
+    serial = make_serial_engine()
     timed_run(serial, prompts[:1], max_new)       # warmup
     serial_s = timed_run(serial, prompts[: max(4, n // 8)], max_new)
     serial_per = serial_s / max(4, n // 8)

@@ -276,6 +276,33 @@ def run_fleet(argv: list[str]) -> int:
     return 0
 
 
+def run_serve(argv: list[str]) -> int:
+    """Serve the resident TPU engine over the OpenAI completions protocol
+    (replaces the reference's vLLM api_server + start_server.sh)."""
+    from .serving import serve_config
+
+    parser = argparse.ArgumentParser(prog="reval_tpu serve",
+                                     description="Serve the TPU engine over HTTP")
+    parser.add_argument("-i", "--input", default=DEFAULT_CONFIG,
+                        help="run-config JSON (model/backend settings)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="listen port (default: config 'port' or 3000)")
+    args = parser.parse_args(argv)
+    if not os.path.exists(args.input):
+        print(f"Error: {args.input} not found — run `python -m reval_tpu config` first")
+        return 1
+    with open(args.input) as f:
+        cfg = json.load(f)
+    server = serve_config(cfg, port=args.port)
+    print(f"serving {cfg.get('model_id')} on :{server.port} "
+          f"(POST /v1/completions, GET /v1/models)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
 def run_analyze(argv: list[str]) -> int:
     """Valid-test-case statistics (reference analyze_testcases.py)."""
     from .analyze import analyze_valid_test_cases
@@ -292,6 +319,8 @@ def main(argv: list[str] | None = None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "fleet":
         return run_fleet(argv[1:])
+    if argv and argv[0] == "serve":
+        return run_serve(argv[1:])
     if argv and argv[0] == "analyze":
         return run_analyze(argv[1:])
     if argv and argv[0] == "taskgen":

@@ -50,11 +50,16 @@ CHUNK = 8            # decode steps per host sync
 MIN_BUCKET = 64
 
 
-def _bucket(n: int) -> int:
-    b = MIN_BUCKET
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power-of-two multiple of ``minimum`` that is >= n."""
+    b = minimum
     while b < n:
         b *= 2
     return b
+
+
+def _bucket(n: int) -> int:
+    return pow2_bucket(n, MIN_BUCKET)
 
 
 def truncate_at_stop(text: str, stop: list[str]) -> str:
@@ -62,6 +67,25 @@ def truncate_at_stop(text: str, stop: list[str]) -> str:
     vLLM-compatible post-detokenisation stop semantics."""
     positions = [text.find(s) for s in stop if s in text]
     return text[: min(positions)] if positions else text
+
+
+def stop_hit(tokenizer, ids: list[int], stop: list[str]) -> bool:
+    """Has this generation finished? — EOS token or any stop string in the
+    detokenised text (both engines share this one contract)."""
+    if tokenizer.eos_id in ids:
+        return True
+    if not stop:
+        return False
+    text = tokenizer.decode(ids)
+    return any(s in text for s in stop)
+
+
+def finalize_text(tokenizer, ids: list[int], stop: list[str]) -> str:
+    """Generated ids → final text: cut at EOS, then at the earliest stop
+    string (vLLM post-detokenisation semantics)."""
+    if tokenizer.eos_id in ids:
+        ids = ids[: ids.index(tokenizer.eos_id)]
+    return truncate_at_stop(tokenizer.decode(ids), stop)
 
 
 @dataclass
@@ -98,7 +122,7 @@ class TPUEngine:
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
             self._cache_sharding = NamedSharding(mesh, kv_cache_spec(cfg, mesh))
-        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg))
+        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
         self._jit_decode_chunk = jax.jit(
             partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
             donate_argnames=("cache",),
@@ -191,7 +215,7 @@ class TPUEngine:
         t0 = time.perf_counter()
         logits, cache = self._jit_prefill(
             self.params, tokens=dev_tokens, pad_len=dev_pad, cache=cache)
-        first = sample_token(logits[:, -1, :], jnp.float32(temperature), self._next_key())
+        first = sample_token(logits[:, 0, :], jnp.float32(temperature), self._next_key())
         jax.block_until_ready(first)
         self.stats.prefill_seconds += time.perf_counter() - t0
         self.stats.prefill_tokens += int((t - pad_len).sum())
@@ -220,19 +244,8 @@ class TPUEngine:
         self.stats.generated_tokens += int(generated[:n_real].size)
         self.stats.prompts += n_real
 
-        texts = []
-        for row in range(n_real):
-            ids = generated[row].tolist()
-            if self.tokenizer.eos_id in ids:
-                ids = ids[: ids.index(self.tokenizer.eos_id)]
-            texts.append(truncate_at_stop(self.tokenizer.decode(ids), stop))
-        return texts
+        return [finalize_text(self.tokenizer, generated[row].tolist(), stop)
+                for row in range(n_real)]
 
     def _find_stop(self, row_ids: np.ndarray, stop: list[str]) -> bool:
-        ids = row_ids.tolist()
-        if self.tokenizer.eos_id in ids:
-            return True
-        if not stop:
-            return False
-        text = self.tokenizer.decode(ids)
-        return any(s in text for s in stop)
+        return stop_hit(self.tokenizer, row_ids.tolist(), stop)

@@ -34,10 +34,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models import ModelConfig, init_kv_cache, load_checkpoint, prefill
+from ...models import (
+    ModelConfig,
+    init_kv_cache,
+    load_checkpoint,
+    prefill,
+    prefill_with_context,
+)
 from ...models.paged import commit_prefill, init_paged_cache, paged_decode_step
 from ...runtime import PagedRuntime
-from .engine import EngineStats, truncate_at_stop
+from .engine import EngineStats, finalize_text, pow2_bucket, stop_hit
 from .sampling import sample_token
 from .tokenizer import HFTokenizer
 
@@ -45,12 +51,11 @@ __all__ = ["PagedTPUEngine"]
 
 CHUNK = 8  # decode steps per host sync (stop-string check cadence)
 
-
-def _pow2_pages(n: int) -> int:
-    b = 1
-    while b < n:
-        b *= 2
-    return b
+# Cap on rows × bucket-tokens per prefill call.  Prefill materialises a
+# contiguous [L, rows, T, H_kv, D] KV block before committing it to pages —
+# 8 rows × 4096 tokens × 24 layers of bf16 KV is ~13 GB, which evicts the
+# page pool out of HBM.  Large admissions prefill in sub-batches instead.
+PREFILL_TOKEN_BUDGET = 8192
 
 
 @dataclass
@@ -100,8 +105,13 @@ class PagedTPUEngine:
         if self._cache_sharding is not None:
             self.cache = type(self.cache)(
                 *(jax.device_put(c, self._cache_sharding) for c in self.cache))
-        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg))
+        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
+        self._jit_prefill_ctx = jax.jit(
+            partial(prefill_with_context, cfg=cfg, logits_mode="last"))
         self._jit_commit = jax.jit(commit_prefill, donate_argnums=(0,))
+        # per-generate-call shared-prefix state (engine is single-owner)
+        self._prefix_len = 0          # tokens covered by the shared prefix
+        self._prefix_ctx = None       # its KVCache [L, 1, Tpre, H_kv, D]
         self._jit_chunk = jax.jit(
             partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
             donate_argnames=("cache",))
@@ -161,27 +171,110 @@ class PagedTPUEngine:
         stop = stop or []
         max_len = self.max_pages_per_seq * self.page_size
         limit = max_len - max_new_tokens - 1
-        reqs: dict[int, _Request] = {}
-        for i, prompt in enumerate(prompts):
+        if limit < 1:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
+                f"within max_seq_len={max_len}")
+        encoded: list[list[int]] = []
+        for prompt in prompts:
             ids = self.tokenizer.encode(prompt)
+            if not ids:
+                ids = [self.tokenizer.pad_id]   # empty prompt: one pad token
             if len(ids) > limit:
                 ids = ids[-limit:]      # clip from the left, keep the tail
-            seq_id = self.rt.submit(len(ids), max_new_tokens)
-            reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens)
+            encoded.append(ids)
 
-        active: dict[int, int] = {}          # slot -> seq_id
-        slot_token = np.zeros((self.max_slots, 1), np.int32)
-        temp = jnp.float32(temperature)
+        prefix_id = self._reserve_shared_prefix(encoded)
+        reqs: dict[int, _Request] = {}
+        try:
+            for i, ids in enumerate(encoded):
+                if prefix_id is not None:
+                    seq_id = self.rt.submit_prefixed(prefix_id, len(ids),
+                                                     max_new_tokens)
+                else:
+                    seq_id = self.rt.submit(len(ids), max_new_tokens)
+                reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens)
+
+            active: dict[int, int] = {}      # slot -> seq_id
+            slot_token = np.zeros((self.max_slots, 1), np.int32)
+            self._drive(reqs, active, slot_token, jnp.float32(temperature), stop)
+        except Exception:
+            # never leave requests queued/running in the native scheduler —
+            # the next generate() would be handed stale seq ids
+            for seq_id, req in reqs.items():
+                if not req.done:
+                    self.rt.release(seq_id)
+            raise
+        finally:
+            if prefix_id is not None:
+                self.rt.release(prefix_id)   # pages outlive us via rider refs
+            self._prefix_len, self._prefix_ctx = 0, None
+
+        out: list[str] = [""] * len(prompts)
+        for req in reqs.values():
+            out[req.index] = finalize_text(self.tokenizer, req.generated, stop)
+        self.stats.prompts += len(prompts)
+        return out
+
+    def _reserve_shared_prefix(self, encoded: list[list[int]]) -> int | None:
+        """Detect the page-aligned common prefix of the batch, prefill it
+        ONCE into reserved pages, and keep its KV as attention context.
+
+        DREval prompts share their few-shot template (50-72% of tokens per
+        SURVEY §2.8-style measurement on this repo's tasks): every other
+        row then prefills only its suffix against this context.  Returns
+        the runtime prefix id, or None when sharing isn't worth it.
+        """
+        if len(encoded) < 2:
+            return None
+        first = encoded[0]
+        lcp = min(len(ids) for ids in encoded)
+        for ids in encoded[1:]:
+            n = min(lcp, len(ids))
+            i = 0
+            while i < n and ids[i] == first[i]:
+                i += 1
+            lcp = i
+            if lcp == 0:
+                return None
+        # every rider needs >= 1 own token past the (page-aligned) prefix
+        n_pre = min(lcp, min(len(ids) for ids in encoded) - 1) // self.page_size
+        if n_pre < 1:
+            return None
+        try:
+            prefix_id = self.rt.alloc_prefix(n_pre)
+        except ValueError:
+            return None                      # pool too small: run unshared
+        t_pre = n_pre * self.page_size
+        tokens = jnp.asarray(np.asarray(first[:t_pre], np.int32)[None, :])
+        pad = jnp.zeros(1, jnp.int32)
+        t0 = time.perf_counter()
+        kv = init_kv_cache(self.cfg, 1, t_pre, dtype=self.params["embed"].dtype)
+        _, ctx = self._jit_prefill(self.params, tokens=self._dev(tokens),
+                                   pad_len=self._dev(pad), cache=kv)
+        table = self.rt.block_table(prefix_id)[:n_pre][None, :]
+        self.cache = self._jit_commit(self.cache, ctx, self._dev(pad),
+                                      self._dev(jnp.asarray(table)))
+        self.stats.prefill_seconds += time.perf_counter() - t0
+        self.stats.prefill_tokens += t_pre
+        self._prefix_len = t_pre
+        self._prefix_ctx = ctx
+        return prefix_id
+
+    def _drive(self, reqs: dict[int, _Request], active: dict[int, int],
+               slot_token: np.ndarray, temp, stop: list[str]) -> None:
+        """Admission/prefill/decode loop until every request is done."""
         while True:
-            for seq_id, slot in self.rt.admit():
-                req = reqs[seq_id]
-                req.generated = []           # recompute after preemption too
-                first = self._prefill_into_pages(req, seq_id, temp)
-                req.generated.append(first)
-                slot_token[slot] = first
-                active[slot] = seq_id
-                if self._finished(req, stop):
-                    self._retire(req, seq_id, slot, active)
+            admitted = self.rt.admit()
+            if admitted:
+                firsts = self._prefill_admitted(admitted, reqs, temp)
+                for seq_id, slot in admitted:
+                    req = reqs[seq_id]
+                    req.generated = [firsts[slot]]  # reset: recompute path too
+                    slot_token[slot] = firsts[slot]
+                    active[slot] = seq_id
+                    if self._finished(req, stop):
+                        self._retire(req, seq_id, slot, active)
             if not active:
                 if any(not r.done for r in reqs.values()):
                     raise RuntimeError(
@@ -204,10 +297,17 @@ class PagedTPUEngine:
                 # materialised tokens = prompt + generated minus the pending
                 # input token (written during the chunk's first step)
                 lens[slot] = len(req.ids) + len(req.generated) - 1
+            # the attention kernel walks every table column it is given —
+            # slice to the pages this chunk can actually touch (pow2-bucketed
+            # so the shape set stays small), not the per-seq maximum
+            span = pow2_bucket(
+                int((lens.max() + steps + self.page_size - 1) // self.page_size))
+            span = min(span, self.max_pages_per_seq)
             t0 = time.perf_counter()
             toks, self.cache, last = self._jit_chunk(
                 self.params, self._dev(jnp.asarray(slot_token)),
-                self._dev(jnp.asarray(tables)), self._dev(jnp.asarray(lens)),
+                self._dev(jnp.asarray(tables[:, :span])),
+                self._dev(jnp.asarray(lens)),
                 self.cache, temp, self._next_key(), steps=steps)
             toks_host = np.asarray(toks)
             slot_token = np.array(last)      # copy: host-mutated on admission
@@ -220,15 +320,6 @@ class PagedTPUEngine:
                 if self._finished(req, stop):
                     self._retire(req, seq_id, slot, active)
 
-        out: list[str] = [""] * len(prompts)
-        for req in reqs.values():
-            ids = req.generated
-            if self.tokenizer.eos_id in ids:
-                ids = ids[: ids.index(self.tokenizer.eos_id)]
-            out[req.index] = truncate_at_stop(self.tokenizer.decode(ids), stop)
-        self.stats.prompts += len(prompts)
-        return out
-
     # -- host-side helpers -------------------------------------------------
     def _dev(self, arr):
         if self._replicated is not None:
@@ -236,14 +327,8 @@ class PagedTPUEngine:
         return arr
 
     def _finished(self, req: _Request, stop: list[str]) -> bool:
-        if len(req.generated) >= req.max_new:
-            return True
-        if self.tokenizer.eos_id in req.generated:
-            return True
-        if not stop:
-            return False
-        text = self.tokenizer.decode(req.generated)
-        return any(s in text for s in stop)
+        return (len(req.generated) >= req.max_new
+                or stop_hit(self.tokenizer, req.generated, stop))
 
     def _retire(self, req: _Request, seq_id: int, slot: int,
                 active: dict[int, int]) -> None:
@@ -266,25 +351,66 @@ class PagedTPUEngine:
                 vslot = next(s for s, q in active.items() if q == victim)
                 active.pop(vslot)
 
-    def _prefill_into_pages(self, req: _Request, seq_id: int,
-                            temperature: jnp.ndarray) -> int:
-        """Prefill one admitted sequence, commit its KV into its pages,
-        return the first sampled token."""
-        n_pages_bucket = _pow2_pages(
-            (len(req.ids) + self.page_size - 1) // self.page_size)
-        t = n_pages_bucket * self.page_size
-        tokens = np.full((1, t), self.tokenizer.pad_id, np.int32)
-        tokens[0, t - len(req.ids):] = req.ids
-        pad_len = jnp.asarray([t - len(req.ids)], jnp.int32)
-        table = self.rt.block_table(seq_id)[:n_pages_bucket][None, :]
+    def _prefill_admitted(self, admitted: list[tuple[int, int]],
+                          reqs: dict[int, _Request],
+                          temperature: jnp.ndarray) -> dict[int, int]:
+        """Prefill all just-admitted sequences, batched by prompt bucket.
+
+        Sequences sharing a page bucket prefill as ONE left-padded batch
+        (padded to a power-of-two row count to bound compile variants;
+        dummy rows are all-padding and commit to the trash page) and their
+        KV lands in the paged cache with a single scatter.  Returns
+        slot → first sampled token.
+        """
+        by_bucket: dict[int, list[tuple[int, int]]] = {}
+        for seq_id, slot in admitted:
+            req = reqs[seq_id]
+            own = len(req.ids) - self._prefix_len   # suffix only, if shared
+            n_pg = pow2_bucket((own + self.page_size - 1) // self.page_size)
+            by_bucket.setdefault(n_pg, []).append((seq_id, slot))
+
+        firsts: dict[int, int] = {}
         t0 = time.perf_counter()
-        kv = init_kv_cache(self.cfg, 1, t, dtype=self.params["embed"].dtype)
-        logits, kv = self._jit_prefill(self.params, tokens=self._dev(jnp.asarray(tokens)),
-                                       pad_len=self._dev(pad_len), cache=kv)
-        self.cache = self._jit_commit(self.cache, kv, self._dev(pad_len),
-                                      self._dev(jnp.asarray(table)))
-        first = sample_token(logits[:, -1, :], temperature, self._next_key())
-        first_host = int(np.asarray(first)[0])
+        for n_pg, full_group in by_bucket.items():
+            t = n_pg * self.page_size
+            step = max(1, PREFILL_TOKEN_BUDGET // t)
+            for start in range(0, len(full_group), step):
+                self._prefill_group(full_group[start:start + step], n_pg, t,
+                                    reqs, temperature, firsts)
         self.stats.prefill_seconds += time.perf_counter() - t0
-        self.stats.prefill_tokens += len(req.ids)
-        return first_host
+        return firsts
+
+    def _prefill_group(self, group, n_pg: int, t: int,
+                       reqs: dict[int, _Request], temperature,
+                       firsts: dict[int, int]) -> None:
+        skip = self._prefix_len                     # tokens the prefix covers
+        pre_pages = skip // self.page_size
+        rows = pow2_bucket(len(group))
+        tokens = np.full((rows, t), self.tokenizer.pad_id, np.int32)
+        pad_len = np.full(rows, t, np.int32)        # dummy rows: all pad
+        tables = np.zeros((rows, n_pg), np.int32)   # dummy rows: trash
+        for row, (seq_id, _) in enumerate(group):
+            ids = reqs[seq_id].ids[skip:]           # own (suffix) tokens
+            tokens[row, t - len(ids):] = ids
+            pad_len[row] = t - len(ids)
+            # own pages sit after the shared-prefix pages in the table
+            own = self.rt.block_table(seq_id)[pre_pages:pre_pages + n_pg]
+            tables[row, : len(own)] = own
+            self.stats.prefill_tokens += len(ids)
+        kv = init_kv_cache(self.cfg, rows, t,
+                           dtype=self.params["embed"].dtype)
+        dev_pad = self._dev(jnp.asarray(pad_len))
+        if skip:
+            logits, kv = self._jit_prefill_ctx(
+                self.params, tokens=self._dev(jnp.asarray(tokens)),
+                pad_len=dev_pad, ctx=self._prefix_ctx, cache=kv)
+        else:
+            logits, kv = self._jit_prefill(
+                self.params, tokens=self._dev(jnp.asarray(tokens)),
+                pad_len=dev_pad, cache=kv)
+        self.cache = self._jit_commit(self.cache, kv, dev_pad,
+                                      self._dev(jnp.asarray(tables)))
+        first = sample_token(logits[:, 0, :], temperature, self._next_key())
+        first_host = np.asarray(first)
+        for row, (_, slot) in enumerate(group):
+            firsts[slot] = int(first_host[row])

@@ -5,7 +5,14 @@ DeepSeek-Coder, Mistral, Magicoder), Gemma, StarCoder2."""
 
 from .configs import ModelConfig, load_hf_config
 from .loader import init_random_params, load_checkpoint, param_template
-from .model import KVCache, decode_step, init_kv_cache, logits_for_tokens, prefill
+from .model import (
+    KVCache,
+    decode_step,
+    init_kv_cache,
+    logits_for_tokens,
+    prefill,
+    prefill_with_context,
+)
 from .zoo import MODEL_ZOO, ZooEntry, zoo_config, zoo_entry
 
 __all__ = [
@@ -21,6 +28,7 @@ __all__ = [
     "logits_for_tokens",
     "param_template",
     "prefill",
+    "prefill_with_context",
     "zoo_config",
     "zoo_entry",
 ]

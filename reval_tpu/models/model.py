@@ -25,9 +25,11 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import apply_rope, decode_attention, prefill_attention, rope_angles, rms_norm
+from ..ops.attention import context_prefill_attention
 from .configs import ModelConfig
 
-__all__ = ["KVCache", "init_kv_cache", "prefill", "decode_step", "logits_for_tokens"]
+__all__ = ["KVCache", "init_kv_cache", "prefill", "prefill_with_context",
+           "decode_step", "logits_for_tokens"]
 
 
 class KVCache(NamedTuple):
@@ -106,9 +108,15 @@ def _unembed(params, cfg: ModelConfig, h):
 
 
 def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
-            cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+            cache: KVCache, logits_mode: str = "all") -> tuple[jnp.ndarray, KVCache]:
     """Process a left-padded prompt block [B, T]; fill cache positions
-    [0, T); return logits [B, T, V] and the updated cache."""
+    [0, T); return logits and the updated cache.
+
+    ``logits_mode``: "all" → [B, T, V] (parity tests, scoring); "last" →
+    [B, 1, V] for the final position only — generation needs nothing else,
+    and skipping the [B, T, V] unembed matmul removes the single largest
+    waste in prefill (T× the needed FLOPs into the vocab dimension).
+    """
     b, t = tokens.shape
     h = _embed(params, cfg, tokens)
     positions = jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0)
@@ -130,6 +138,49 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
 
     h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    if logits_mode == "last":
+        h = h[:, -1:, :]   # left-padding puts every row's final token last
+    return _unembed(params, cfg, h), KVCache(new_k, new_v)
+
+
+def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                         pad_len: jnp.ndarray, ctx: KVCache, cache: KVCache,
+                         logits_mode: str = "last") -> tuple[jnp.ndarray, KVCache]:
+    """Prefill a left-padded suffix block [B, T] that follows a shared
+    context whose KV is already computed.
+
+    ``ctx``: KVCache of the common prompt prefix ([L, 1, Tc, H_kv, D],
+    broadcast over rows).  Suffix sequence positions start at Tc.  Returns
+    logits and the suffix KV (cache positions [0, T) = sequence positions
+    [Tc, Tc+T)) — the shared-prefix prefill path: the context is computed
+    once per batch instead of once per row (DREval few-shot templates are
+    50-72% of every prompt).
+    """
+    b, t = tokens.shape
+    tc = ctx.k.shape[2]
+    h = _embed(params, cfg, tokens)
+    positions = tc + jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0)
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(h, xs):
+        layer, ctx_k, ctx_v, k_slot, v_slot = xs
+        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
+        q, k, v = _qkv(normed, layer, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
+        attn = context_prefill_attention(q, k, v, ctx_k, ctx_v, pad_len)
+        h = h + _out_proj(attn, layer, cfg)
+        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
+        h = h + _mlp(normed, layer, cfg)
+        return h, (new_k, new_v)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer_step, h, (params["layers"], ctx.k, ctx.v, cache.k, cache.v))
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    if logits_mode == "last":
+        h = h[:, -1:, :]
     return _unembed(params, cfg, h), KVCache(new_k, new_v)
 
 

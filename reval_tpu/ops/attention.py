@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["prefill_attention", "decode_attention"]
+__all__ = ["prefill_attention", "decode_attention", "context_prefill_attention"]
 
 _NEG_INF = -1e30
 
@@ -51,6 +51,42 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal = rows >= cols
     valid_key = cols >= pad_len[:, None, None, None, None]
     mask = causal[None, None, None, :, :] & valid_key
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, vf)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                              ctx_k: jnp.ndarray, ctx_v: jnp.ndarray,
+                              pad_len: jnp.ndarray,
+                              scale: float | None = None) -> jnp.ndarray:
+    """Causal attention for a suffix block that follows a shared context.
+
+    The shared-prefix prefill path: ``ctx_k``/``ctx_v`` ([1, Tc, H_kv, D],
+    broadcast over the batch) hold the KV of a prompt prefix common to
+    every row; q/k/v ([B, T(_kv), …]) are the left-padded per-row suffixes
+    whose sequence positions start at Tc.  Every suffix query attends to
+    the whole context plus the causal/unpadded part of its own suffix.
+    """
+    b, t, h, d = q.shape
+    n_kv = k.shape[2]
+    tc = ctx_k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    qg = _group_queries(q, n_kv).astype(jnp.float32)
+    ctx_kf = jnp.broadcast_to(ctx_k, (b, tc, n_kv, d)).astype(jnp.float32)
+    ctx_vf = jnp.broadcast_to(ctx_v, (b, tc, n_kv, d)).astype(jnp.float32)
+    kf = jnp.concatenate([ctx_kf, k.astype(jnp.float32)], axis=1)
+    vf = jnp.concatenate([ctx_vf, v.astype(jnp.float32)], axis=1)
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
+    rows = jnp.arange(t)[:, None]              # suffix query buffer positions
+    cols = jnp.arange(t + tc)[None, :]         # key positions: ctx then suffix
+    in_ctx = cols < tc
+    causal = rows + tc >= cols                 # suffix key j valid if j-tc <= i
+    valid_suffix = cols - tc >= pad_len[:, None, None, None, None]
+    mask = in_ctx[None, None, None, :, :] | (
+        causal[None, None, None, :, :] & valid_suffix)
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

@@ -170,8 +170,17 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            *, page_size: int, scale: float | None = None):
     """Backend-dispatching paged decode attention: Pallas on TPU, XLA
-    elsewhere (same numerics; the kernel is tested against the XLA path)."""
-    if jax.default_backend() == "tpu":
+    elsewhere (same numerics; the kernel is tested against the XLA path).
+
+    ``REVAL_TPU_PAGED_BACKEND=pallas|xla`` overrides the choice — the XLA
+    gather formulation is sometimes preferable (and is what CPU uses).
+    """
+    import os
+
+    choice = os.environ.get("REVAL_TPU_PAGED_BACKEND")
+    use_pallas = (choice == "pallas" if choice
+                  else jax.default_backend() == "tpu")
+    if use_pallas:
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, block_tables, seq_lens,
             page_size=page_size, scale=scale)

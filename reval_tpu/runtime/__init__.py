@@ -57,7 +57,12 @@ def load_native() -> ctypes.CDLL:
     if (not os.path.exists(so_path)
             or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
         _build(so_path)
-    lib = ctypes.CDLL(so_path)
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        # stale artifact from another platform/arch: rebuild once
+        _build(so_path)
+        lib = ctypes.CDLL(so_path)
     i32, i64, ptr = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
     p32 = ctypes.POINTER(ctypes.c_int32)
     p64 = ctypes.POINTER(ctypes.c_int64)
@@ -65,6 +70,8 @@ def load_native() -> ctypes.CDLL:
         "reval_rt_create": ([i32, i32, i32, i32], ptr),
         "reval_rt_destroy": ([ptr], None),
         "reval_rt_submit": ([ptr, i32, i32], i64),
+        "reval_rt_alloc_prefix": ([ptr, i32], i64),
+        "reval_rt_submit_prefixed": ([ptr, i64, i32, i32], i64),
         "reval_rt_admit": ([ptr, p64, p32, i32], i32),
         "reval_rt_block_table": ([ptr, i64, p32], i32),
         "reval_rt_seq_len": ([ptr, i64], i32),
@@ -123,6 +130,29 @@ class PagedRuntime:
             raise ValueError(
                 f"request (prompt={prompt_len}, new={max_new_tokens}) exceeds "
                 f"max_pages_per_seq={self.max_pages_per_seq}")
+        return seq_id
+
+    def alloc_prefix(self, n_pages: int) -> int:
+        """Reserve pages for a shared prompt prefix (few-shot template);
+        submit riders with :meth:`submit_prefixed`, free the reservation
+        with :meth:`release` (pages live on until the last rider ends)."""
+        prefix_id = self._lib.reval_rt_alloc_prefix(self._h, n_pages)
+        if prefix_id == -1:
+            raise ValueError(f"cannot reserve {n_pages} prefix pages "
+                             f"({self.free_pages} free)")
+        return prefix_id
+
+    def submit_prefixed(self, prefix_id: int, prompt_len: int,
+                        max_new_tokens: int) -> int:
+        """Queue a request whose prompt starts with the shared prefix
+        (``prompt_len`` counts the TOTAL prompt, prefix included)."""
+        seq_id = self._lib.reval_rt_submit_prefixed(
+            self._h, prefix_id, prompt_len, max_new_tokens)
+        if seq_id == -1:
+            raise ValueError(
+                f"prefixed request (prefix={prefix_id}, prompt={prompt_len}, "
+                f"new={max_new_tokens}) invalid: unknown/dead prefix, prompt "
+                f"not longer than the prefix, or exceeds page limits")
         return seq_id
 
     def admit(self, max_n: int | None = None) -> list[tuple[int, int]]:

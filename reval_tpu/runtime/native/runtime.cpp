@@ -19,6 +19,7 @@
 // Page 0 is the trash page (see models/paged.py): never allocated, used to
 // pad block tables, so a stale table slot can never alias live data.
 
+#include <algorithm>
 #include <cstdint>
 #include <deque>
 #include <unordered_map>
@@ -26,7 +27,7 @@
 
 namespace {
 
-enum class SeqState { kWaiting, kRunning };
+enum class SeqState { kWaiting, kRunning, kPrefix };
 
 struct Seq {
   int64_t id = -1;
@@ -35,6 +36,7 @@ struct Seq {
   int32_t prompt_len = 0;
   int32_t max_new = 0;
   int32_t slot = -1;           // batch slot while running, -1 otherwise
+  int64_t prefix_id = -1;      // shared-prefix object this request rides on
   SeqState state = SeqState::kWaiting;
 };
 
@@ -116,7 +118,9 @@ int64_t reval_rt_submit(void* h, int32_t prompt_len, int32_t max_new_tokens) {
 // pool can hold their prompt pages plus a one-page decode watermark.
 // Fills seq_ids/slot_ids (each sized >= max_n); returns the count admitted.
 // Admitted sequences have their prompt pages allocated and len = prompt_len
-// — the engine prefills and commits the KV for exactly those pages.
+// — the engine prefills and commits the KV for exactly those pages
+// (prefix-backed requests: only the suffix pages; their prefix pages are
+// attached by refcount here).
 int32_t reval_rt_admit(void* h, int64_t* seq_ids, int32_t* slot_ids,
                        int32_t max_n) {
   auto* rt = as_rt(h);
@@ -124,20 +128,38 @@ int32_t reval_rt_admit(void* h, int64_t* seq_ids, int32_t* slot_ids,
   while (admitted < max_n && !rt->waiting.empty()) {
     int64_t id = rt->waiting.front();
     Seq& seq = rt->seqs.at(id);
-    int32_t need = rt->pages_needed(seq.prompt_len);
+    // attach the shared-prefix pages (refcounted) before counting what is
+    // missing; a preempted prefix-backed request re-attaches here too
+    if (seq.prefix_id >= 0 && seq.pages.empty()) {
+      auto pit = rt->seqs.find(seq.prefix_id);
+      if (pit != rt->seqs.end() && pit->second.state == SeqState::kPrefix) {
+        for (int32_t p : pit->second.pages) {
+          ++rt->ref_counts[p];
+          seq.pages.push_back(p);
+        }
+      }
+      // prefix gone (engine released it early): fall through — the full
+      // prompt_len still covers the whole sequence, so correctness holds,
+      // the request just pays for all its pages itself
+    }
+    // a waiting sequence may already own pages (fork children / prefix
+    // riders) — only the missing prompt pages need allocating
+    int32_t have = static_cast<int32_t>(seq.pages.size());
+    int32_t need = rt->pages_needed(std::max(seq.prompt_len, seq.len));
+    int32_t missing = need > have ? need - have : 0;
     // one-page decode watermark, but only when decode will ever grow the
     // allocation — a request whose full budget fits its prompt pages may
     // take the last free page (otherwise it can deadlock the queue)
     int32_t grows = rt->pages_needed(seq.prompt_len + seq.max_new) > need;
-    if (static_cast<int32_t>(rt->free_list.size()) < need + grows) break;
+    if (static_cast<int32_t>(rt->free_list.size()) < missing + grows) break;
     int32_t slot = -1;
     for (int32_t s = 0; s < rt->max_slots; ++s)
       if (rt->slots[s] == -1) { slot = s; break; }
     if (slot == -1) break;
     rt->waiting.pop_front();
     seq.pages.reserve(need);
-    for (int32_t i = 0; i < need; ++i) seq.pages.push_back(rt->alloc_page());
-    seq.len = seq.prompt_len;
+    for (int32_t i = 0; i < missing; ++i) seq.pages.push_back(rt->alloc_page());
+    seq.len = std::max(seq.len, seq.prompt_len);
     seq.slot = slot;
     seq.state = SeqState::kRunning;
     rt->slots[slot] = id;
@@ -146,6 +168,43 @@ int32_t reval_rt_admit(void* h, int64_t* seq_ids, int32_t* slot_ids,
     ++admitted;
   }
   return admitted;
+}
+
+// Allocate a shared-prefix object: n_pages pages holding KV that many
+// requests will reference (e.g. a few-shot prompt template).  The engine
+// prefills into these pages once; requests submitted with
+// reval_rt_submit_prefixed ride them by refcount.  Returns the prefix id
+// (release with reval_rt_release when no more requests will be submitted
+// against it — pages survive until the last rider finishes), or -1 OOM.
+int64_t reval_rt_alloc_prefix(void* h, int32_t n_pages) {
+  auto* rt = as_rt(h);
+  if (n_pages < 1 || n_pages > rt->max_pages_per_seq ||
+      static_cast<int32_t>(rt->free_list.size()) < n_pages)
+    return -1;
+  Seq prefix;
+  prefix.id = rt->next_id++;
+  prefix.len = n_pages * rt->page_size;
+  prefix.prompt_len = prefix.len;
+  prefix.state = SeqState::kPrefix;
+  for (int32_t i = 0; i < n_pages; ++i)
+    prefix.pages.push_back(rt->alloc_page());
+  rt->seqs.emplace(prefix.id, prefix);
+  return prefix.id;
+}
+
+// Queue a request whose first pages are a shared prefix.  prompt_len is
+// the TOTAL prompt length (prefix tokens included); admission attaches the
+// prefix pages by refcount and allocates only the remainder.
+int64_t reval_rt_submit_prefixed(void* h, int64_t prefix_id,
+                                 int32_t prompt_len, int32_t max_new_tokens) {
+  auto* rt = as_rt(h);
+  auto pit = rt->seqs.find(prefix_id);
+  if (pit == rt->seqs.end() || pit->second.state != SeqState::kPrefix)
+    return -1;
+  if (prompt_len <= pit->second.len) return -1;  // must extend past the prefix
+  int64_t id = reval_rt_submit(h, prompt_len, max_new_tokens);
+  if (id != -1) rt->seqs.at(id).prefix_id = prefix_id;
+  return id;
 }
 
 // Copy the sequence's block table into out (length max_pages_per_seq),
@@ -199,10 +258,11 @@ int32_t reval_rt_advance(void* h, int64_t seq_id, int32_t n) {
 
 // Fork for prefix sharing: the child shares every *full* page of the
 // parent by refcount and gets a fresh page for the partial tail (the
-// engine must copy the tail page's contents device-side).  Returns the
-// child id (queued as waiting with its slot/admission handled by the
-// caller via reval_rt_adopt), or -1 on failure.  Out param fresh_page
-// receives the tail page id, or the trash page if the parent's length is
+// engine must copy the tail page's contents device-side).  The child is
+// queued as waiting; reval_rt_admit attaches it to a slot, allocating only
+// pages it does not already hold and preserving its inherited length.
+// Returns the child id, or -1 on failure.  Out param fresh_page receives
+// the tail page id, or the trash page if the parent's length is
 // page-aligned.
 int64_t reval_rt_fork(void* h, int64_t seq_id, int32_t* fresh_page) {
   auto* rt = as_rt(h);

@@ -1,0 +1,14 @@
+"""In-tree model server: the reference's vLLM-server topology, TPU-native.
+
+The reference starts a separate GPU server process
+(``python -m vllm.entrypoints.openai.api_server``, reference
+start_server.sh:1-19) so one resident model can serve many sequential task
+runs over the OpenAI completions protocol (reference inference.py:106-131).
+Here the same topology is one in-tree module: :class:`EngineServer` holds
+the resident (sharded) TPU engine and speaks the same protocol to
+:class:`~reval_tpu.inference.client.HTTPClientBackend`.
+"""
+
+from .server import EngineServer, serve_config
+
+__all__ = ["EngineServer", "serve_config"]

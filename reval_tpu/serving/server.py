@@ -1,0 +1,136 @@
+"""OpenAI-compatible completions server over the resident TPU engine.
+
+Protocol surface (exactly what the client backend + reference harness use;
+reference inference.py:110-131, start_server.sh):
+
+- ``GET /v1/models``           → ``{"data": [{"id": <model_id>}]}``
+- ``POST /v1/completions``     → prompt (string or list), ``max_tokens``,
+  ``temperature``, ``stop`` → ``{"choices": [{"index", "text"}]}``
+
+Implementation notes:
+- stdlib ``ThreadingHTTPServer``; each request handles its own socket but
+  engine calls are serialised with a lock — the engine owns device state
+  (KV cache, scheduler) and is single-owner by design.  Batching comes
+  from *list prompts in one request* (the client backend sends whole
+  task batches), which the engine schedules together; concurrent separate
+  requests queue on the lock.
+- no streaming: the reference's client accumulates the stream and returns
+  only the final string (reference inference.py:115-131), so a buffered
+  response is observationally identical through that client.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["EngineServer", "serve_config"]
+
+
+class EngineServer:
+    """Serve ``generate_fn(prompts, max_tokens, temperature, stop) ->
+    list[str]`` over the OpenAI completions protocol."""
+
+    def __init__(self, generate_fn, model_id: str, port: int = 3000,
+                 host: str = "127.0.0.1"):
+        # loopback by default: the endpoint is unauthenticated, and the
+        # in-repo client only ever connects to localhost; pass host="0.0.0.0"
+        # deliberately to expose it
+        self.generate_fn = generate_fn
+        self.model_id = model_id
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/v1/models":
+                    self._send(200, {"object": "list",
+                                     "data": [{"id": outer.model_id,
+                                               "object": "model"}]})
+                else:
+                    self._send(404, {"error": f"unknown route {self.path}"})
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/v1/completions":
+                    self._send(404, {"error": f"unknown route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    prompts = req.get("prompt", "")
+                    single = isinstance(prompts, str)
+                    if single:
+                        prompts = [prompts]
+                    stop = req.get("stop") or []
+                    if isinstance(stop, str):
+                        stop = [stop]
+                    with outer._lock:
+                        texts = outer.generate_fn(
+                            prompts,
+                            max_tokens=int(req.get("max_tokens", 256)),
+                            temperature=float(req.get("temperature", 0.0)),
+                            stop=stop,
+                        )
+                except Exception as exc:  # protocol error -> 400, not a crash
+                    self._send(400, {"error": str(exc)})
+                    return
+                self._send(200, {
+                    "object": "text_completion",
+                    "model": outer.model_id,
+                    "choices": [{"index": i, "text": t, "finish_reason": "stop"}
+                                for i, t in enumerate(texts)],
+                })
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]   # resolved if port=0
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "EngineServer":
+        """Serve on a daemon thread (tests, co-located runs)."""
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Blocking serve (the CLI entry point)."""
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+
+def _engine_generate_fn(engine):
+    def generate(prompts, *, max_tokens, temperature, stop):
+        return engine.generate(prompts, max_new_tokens=max_tokens,
+                               temperature=temperature, stop=stop)
+    return generate
+
+
+def serve_config(cfg: dict, *, port: int | None = None) -> EngineServer:
+    """Build the TPU engine from a run config (same keys the ``tpu``
+    backend takes) and return an unstarted server bound to ``port``
+    (default: config ``port`` or 3000)."""
+    from ..inference.tpu.backend import TPUBackend
+
+    backend = TPUBackend(**{k: v for k, v in cfg.items()
+                            if k not in ("task", "backend", "port", "mock")})
+    server = EngineServer(_engine_generate_fn(backend.engine),
+                          model_id=cfg.get("model_id", "reval-tpu-model"),
+                          port=port if port is not None else cfg.get("port", 3000))
+    return server

@@ -129,3 +129,61 @@ class TestMistralParity:
         ours = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
         theirs = hf_logits(model, tokens)
         np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+class TestGemmaParity:
+    """Gemma family quirks: (1+w) RMSNorm weights, sqrt(hidden) embedding
+    scale, tied lm head, GeLU-gated MLP (reference zoo: gemma 2b/7b)."""
+
+    def test_logits_match_hf(self, tmp_path):
+        import torch
+        from transformers import GemmaConfig, GemmaForCausalLM
+
+        from reval_tpu.models import load_checkpoint, logits_for_tokens
+
+        torch.manual_seed(2)
+        cfg_hf = GemmaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+            head_dim=16, max_position_embeddings=512,
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        model = GemmaForCausalLM(cfg_hf).eval()
+        path = tmp_path / "tiny-gemma"
+        model.save_pretrained(path, safe_serialization=True)
+        params, cfg = load_checkpoint(path, dtype="float32")
+        assert cfg.family == "gemma" and cfg.tie_word_embeddings
+        assert cfg.norm_offset == 1.0 and cfg.embed_scale == 64.0 ** 0.5
+        tokens = np.random.default_rng(4).integers(0, 255, size=(2, 9))
+        ours = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+        theirs = hf_logits(model, tokens)
+        np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-3)
+
+
+class TestStarcoder2Parity:
+    """StarCoder2 quirks: LayerNorm (with biases), ungated GeLU MLP
+    (c_fc/c_proj), qkv/o biases (reference zoo: starcoder2 3b/7b/15b)."""
+
+    def test_logits_match_hf(self, tmp_path):
+        import torch
+        from transformers import Starcoder2Config, Starcoder2ForCausalLM
+
+        from reval_tpu.models import load_checkpoint, logits_for_tokens
+
+        torch.manual_seed(3)
+        cfg_hf = Starcoder2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512, use_bias=True, sliding_window=None,
+            tie_word_embeddings=False,
+        )
+        model = Starcoder2ForCausalLM(cfg_hf).eval()
+        path = tmp_path / "tiny-starcoder2"
+        model.save_pretrained(path, safe_serialization=True)
+        params, cfg = load_checkpoint(path, dtype="float32")
+        assert cfg.family == "starcoder2" and cfg.use_layernorm
+        assert not cfg.mlp_gated and cfg.attention_bias
+        tokens = np.random.default_rng(5).integers(0, 255, size=(2, 9))
+        ours = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+        theirs = hf_logits(model, tokens)
+        np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-3)

@@ -86,6 +86,49 @@ def test_tiny_pool_preempts_and_recovers(tiny):
     tight.close()
 
 
+def test_shared_prefix_outputs_match_static(tiny):
+    """Few-shot-style prompts (long common template + short unique tails)
+    must trigger prefix sharing AND produce exactly the static engine's
+    greedy outputs."""
+    cfg, params = tiny
+    template = ("You are given a Python program.\n"
+                "[PYTHON]\ndef example(a):\n    return a + 1\n[/PYTHON]\n" * 6)
+    prompts = [template + tail for tail in
+               ["def f(x):", "x = 41", "print('hello')", "assert g(2) == 4"]]
+    static = TPUEngine(params, cfg, ByteTokenizer(), batch_size=2,
+                       max_seq_len=1024)
+    want = static.generate(prompts, max_new_tokens=10, temperature=0.0)
+
+    paged = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                           page_size=PAGE, max_seq_len=1024)
+    got = paged.generate(prompts, max_new_tokens=10, temperature=0.0)
+    assert got == want
+    # the shared template really was prefilled once, not per prompt:
+    # template ≈ 56*6+32 chars -> >= 2 shared pages of 128
+    total = sum(len(paged.tokenizer.encode(p)) for p in prompts)
+    assert paged.stats.prefill_tokens < total
+    # pool fully drained afterwards (prefix + riders all released)
+    assert paged.rt.free_pages == paged.num_pages - 1
+    paged.close()
+
+
+def test_shared_prefix_with_preemption(tiny):
+    """Prefix sharing + tiny pool: riders get preempted and recomputed,
+    outputs still equal the uncontended run."""
+    cfg, params = tiny
+    template = "# shared few-shot header\n" + "# example line\n" * 20
+    prompts = [template + t for t in ["a = 1", "b = 2", "c = 3"]]
+    roomy = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                           page_size=PAGE, max_seq_len=768)
+    want = roomy.generate(prompts, max_new_tokens=8, temperature=0.0)
+    roomy.close()
+    tight = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                           page_size=PAGE, max_seq_len=768, num_pages=8)
+    got = tight.generate(prompts, max_new_tokens=8, temperature=0.0)
+    assert got == want
+    tight.close()
+
+
 def test_long_prompt_multi_page_prefill(tiny):
     cfg, params = tiny
     paged = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,

@@ -112,6 +112,22 @@ def test_fork_shares_full_pages_and_copies_tail(rt):
     assert rt.page_ref(table_a[0]) == 0
 
 
+def test_fork_child_admits_with_inherited_pages(rt):
+    """Admission of a fork child must keep its shared pages and inherited
+    length — not re-allocate prompt pages on top (review finding)."""
+    a = rt.submit(PAGE + 4, 2 * PAGE)
+    rt.admit()
+    rt.advance(a, PAGE - 4)            # a now holds 2 pages, len = 2*PAGE
+    child, fresh = rt.fork(a)
+    table_before = list(rt.block_table(child))
+    free_before = rt.free_pages
+    assert [s for s, _ in rt.admit()] == [child]
+    assert list(rt.block_table(child)) == table_before   # nothing re-allocated
+    assert rt.free_pages == free_before
+    assert rt.seq_len(child) == 2 * PAGE                 # inherited, not reset
+    assert rt.advance(child, 1) == 2 * PAGE + 1          # grows into page 3
+
+
 def test_fork_aligned_length_shares_everything(rt):
     a = rt.submit(2 * PAGE, 0)
     rt.admit()

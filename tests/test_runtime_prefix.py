@@ -1,0 +1,73 @@
+"""Shared-prefix reservations in the native runtime: refcounted attachment
+at admission, survival across release ordering, and preemption re-attach."""
+
+import pytest
+
+from reval_tpu.runtime import PagedRuntime
+
+PAGE = 16
+
+
+@pytest.fixture
+def rt():
+    r = PagedRuntime(num_pages=12, page_size=PAGE, max_slots=3,
+                     max_pages_per_seq=6)
+    yield r
+    r.close()
+
+
+def test_riders_share_prefix_pages(rt):
+    pre = rt.alloc_prefix(2)
+    pre_pages = [p for p in rt.block_table(pre) if p != 0]
+    assert len(pre_pages) == 2
+    a = rt.submit_prefixed(pre, prompt_len=2 * PAGE + 5, max_new_tokens=4)
+    b = rt.submit_prefixed(pre, prompt_len=2 * PAGE + 9, max_new_tokens=4)
+    assert len(rt.admit()) == 2
+    ta, tb = rt.block_table(a), rt.block_table(b)
+    assert list(ta[:2]) == pre_pages and list(tb[:2]) == pre_pages
+    assert ta[2] != tb[2] and ta[2] not in pre_pages   # own suffix pages
+    assert rt.page_ref(pre_pages[0]) == 3              # prefix + 2 riders
+    assert rt.seq_len(a) == 2 * PAGE + 5
+    # only 2 pages allocated beyond the prefix (1 suffix page each)
+    assert rt.free_pages == 11 - 2 - 2
+
+
+def test_prefix_survives_until_last_rider(rt):
+    pre = rt.alloc_prefix(1)
+    page = rt.block_table(pre)[0]
+    a = rt.submit_prefixed(pre, PAGE + 1, 0)
+    rt.admit()
+    rt.release(pre)                    # engine done submitting riders
+    assert rt.page_ref(page) == 1      # rider keeps it alive
+    rt.release(a)
+    assert rt.page_ref(page) == 0      # now free
+
+
+def test_preempted_rider_reattaches(rt):
+    pre = rt.alloc_prefix(1)
+    page = rt.block_table(pre)[0]
+    a = rt.submit_prefixed(pre, PAGE + 1, PAGE)
+    rt.admit()
+    assert rt.page_ref(page) == 2
+    victim = rt.preempt_last()
+    assert victim == a
+    assert rt.page_ref(page) == 1      # detached on preemption
+    assert [s for s, _ in rt.admit()] == [a]
+    assert rt.page_ref(page) == 2      # re-attached
+    assert list(rt.block_table(a))[0] == page
+
+
+def test_submit_prefixed_validations(rt):
+    pre = rt.alloc_prefix(2)
+    with pytest.raises(ValueError):    # prompt must extend past the prefix
+        rt.submit_prefixed(pre, 2 * PAGE, 4)
+    with pytest.raises(ValueError):    # unknown prefix
+        rt.submit_prefixed(12345, 3 * PAGE, 4)
+    rt.release(pre)
+    with pytest.raises(ValueError):    # dead prefix
+        rt.submit_prefixed(pre, 3 * PAGE, 4)
+
+
+def test_alloc_prefix_oom(rt):
+    with pytest.raises(ValueError):
+        rt.alloc_prefix(100)
