@@ -25,6 +25,8 @@ same functions — there is no separate multi-chip code path.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -44,10 +46,22 @@ from ...models import (
 from .sampling import sample_token
 from .tokenizer import ByteTokenizer, HFTokenizer
 
-__all__ = ["TPUEngine"]
+__all__ = ["TPUEngine", "StopScanner"]
 
 CHUNK = 8            # decode steps per host sync
 MIN_BUCKET = 64
+
+
+def profile_trace():
+    """``jax.profiler`` capture gated on ``REVAL_TPU_PROFILE=<dir>``
+    (SURVEY §5.1: profiling hooks for the decode loop).  Each generate()
+    call under the flag writes one trace into the directory; inspect with
+    TensorBoard or ``jax.profiler`` tooling.  Without the flag this is a
+    no-op nullcontext — zero cost on the hot path."""
+    trace_dir = os.environ.get("REVAL_TPU_PROFILE")
+    if not trace_dir:
+        return contextlib.nullcontext()
+    return jax.profiler.trace(trace_dir)
 
 
 def pow2_bucket(n: int, minimum: int = 1) -> int:
@@ -78,6 +92,49 @@ def stop_hit(tokenizer, ids: list[int], stop: list[str]) -> bool:
         return False
     text = tokenizer.decode(ids)
     return any(s in text for s in stop)
+
+
+class StopScanner:
+    """Incremental stop detection with O(chunk) cost per check.
+
+    ``stop_hit`` detokenises the FULL generated id list on every call; at
+    CoT budgets (1024 tokens) × 8 slots that is quadratic host work per
+    sequence (SURVEY §7 hard part 1 warns about exactly this).  The scanner
+    instead decodes only the not-yet-scanned tail plus a bounded overlap
+    window so a stop string straddling a chunk boundary is still seen:
+    the window re-covers ``max_stop_len + margin`` tokens before the new
+    chunk, and every token decodes to at least one character for the
+    byte-level/BPE vocabularies the engines use, so ``S-1`` chars of
+    straddle are always inside the window.
+
+    Detection only — final truncation still happens in ``finalize_text``
+    with one full decode, keeping vLLM post-detokenisation semantics.
+    """
+
+    #: extra overlap tokens beyond the longest stop string, absorbing
+    #: multi-char tokens at the window edge and partial-UTF8 artifacts
+    MARGIN = 8
+
+    def __init__(self, tokenizer, stop: list[str]):
+        self.tokenizer = tokenizer
+        self.stop = stop
+        self.overlap = max((len(s) for s in stop), default=0) + self.MARGIN
+        self.scanned = 0            # tokens covered by previous scans
+
+    def reset(self) -> None:
+        self.scanned = 0
+
+    def hit(self, ids: list[int]) -> bool:
+        new = len(ids) - self.scanned
+        self.scanned = len(ids)
+        if new <= 0:
+            return False
+        if self.tokenizer.eos_id in ids[-new:]:
+            return True
+        if not self.stop:
+            return False
+        text = self.tokenizer.decode(ids[-(new + self.overlap):])
+        return any(s in text for s in self.stop)
 
 
 def finalize_text(tokenizer, ids: list[int], stop: list[str]) -> str:
@@ -180,12 +237,13 @@ class TPUEngine:
         ids = [self.tokenizer.encode(p) for p in prompts]
         order = sorted(range(len(ids)), key=lambda i: len(ids[i]), reverse=True)
         out: list[str | None] = [None] * len(prompts)
-        for start in range(0, len(order), self.batch_size):
-            batch_idx = order[start:start + self.batch_size]
-            batch_ids = [ids[i] for i in batch_idx]
-            texts = self._generate_batch(batch_ids, max_new_tokens, temperature, stop)
-            for i, text in zip(batch_idx, texts):
-                out[i] = text
+        with profile_trace():
+            for start in range(0, len(order), self.batch_size):
+                batch_idx = order[start:start + self.batch_size]
+                batch_ids = [ids[i] for i in batch_idx]
+                texts = self._generate_batch(batch_ids, max_new_tokens, temperature, stop)
+                for i, text in zip(batch_idx, texts):
+                    out[i] = text
         return out  # type: ignore[return-value]
 
     def _generate_batch(self, batch_ids: list[list[int]], max_new_tokens: int,
@@ -213,9 +271,11 @@ class TPUEngine:
             dev_pad = jax.device_put(dev_pad, self._input_sharding)
             cache = KVCache(*(jax.device_put(c, self._cache_sharding) for c in cache))
         t0 = time.perf_counter()
-        logits, cache = self._jit_prefill(
-            self.params, tokens=dev_tokens, pad_len=dev_pad, cache=cache)
-        first = sample_token(logits[:, 0, :], jnp.float32(temperature), self._next_key())
+        with jax.profiler.TraceAnnotation("reval.prefill"):
+            logits, cache = self._jit_prefill(
+                self.params, tokens=dev_tokens, pad_len=dev_pad, cache=cache)
+            first = sample_token(logits[:, 0, :], jnp.float32(temperature),
+                                 self._next_key())
         jax.block_until_ready(first)
         self.stats.prefill_seconds += time.perf_counter() - t0
         self.stats.prefill_tokens += int((t - pad_len).sum())
@@ -228,24 +288,25 @@ class TPUEngine:
         # dummy rows (batch padding) are born finished or they would pin
         # the whole batch to the full token budget
         finished = [False] * n_real + [True] * (b - n_real)
+        scanners = [StopScanner(self.tokenizer, stop) for _ in range(n_real)]
+        for row in range(n_real):
+            finished[row] = scanners[row].hit(generated[row].tolist())
 
         t0 = time.perf_counter()
         while generated.shape[1] < max_new_tokens and not all(finished):
             steps = min(CHUNK, max_new_tokens - generated.shape[1])
-            toks, cache, token = self._jit_decode_chunk(
-                self.params, token, dev_pad, cache, pos,
-                jnp.float32(temperature), self._next_key(), steps=steps)
+            with jax.profiler.TraceAnnotation("reval.decode_chunk"):
+                toks, cache, token = self._jit_decode_chunk(
+                    self.params, token, dev_pad, cache, pos,
+                    jnp.float32(temperature), self._next_key(), steps=steps)
             pos = pos + steps
             generated = np.concatenate([generated, np.asarray(toks)], axis=1)
             for row in range(n_real):
                 if not finished[row]:
-                    finished[row] = self._find_stop(generated[row], stop)
+                    finished[row] = scanners[row].hit(generated[row].tolist())
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.generated_tokens += int(generated[:n_real].size)
         self.stats.prompts += n_real
 
         return [finalize_text(self.tokenizer, generated[row].tolist(), stop)
                 for row in range(n_real)]
-
-    def _find_stop(self, row_ids: np.ndarray, stop: list[str]) -> bool:
-        return stop_hit(self.tokenizer, row_ids.tolist(), stop)

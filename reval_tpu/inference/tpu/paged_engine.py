@@ -43,7 +43,13 @@ from ...models import (
 )
 from ...models.paged import commit_prefill, init_paged_cache, paged_decode_step
 from ...runtime import PagedRuntime
-from .engine import EngineStats, finalize_text, pow2_bucket, stop_hit
+from .engine import (
+    EngineStats,
+    StopScanner,
+    finalize_text,
+    pow2_bucket,
+    profile_trace,
+)
 from .sampling import sample_token
 from .tokenizer import HFTokenizer
 
@@ -63,20 +69,29 @@ class _Request:
     index: int                   # position in the caller's prompt list
     ids: list[int]
     max_new: int
+    scanner: StopScanner
     generated: list[int] = field(default_factory=list)
     done: bool = False
+
+    @property
+    def prefill_ids(self) -> list[int]:
+        """Tokens a (re-)admission prefill must cover: the prompt plus any
+        already-generated tokens (non-empty after a preemption — resume
+        semantics, so sampled tokens are never resampled)."""
+        return self.ids + self.generated
 
 
 class PagedTPUEngine:
     def __init__(self, params, cfg: ModelConfig, tokenizer, *,
                  max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,
-                 mesh=None, seed: int = 0):
+                 mesh=None, seed: int = 0, prefix_sharing: bool = True):
         assert max_seq_len % page_size == 0
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_slots = max_slots
         self.page_size = page_size
+        self.prefix_sharing = prefix_sharing
         self.max_pages_per_seq = max_seq_len // page_size
         # default pool: every slot can reach max_seq_len (no oversubscription;
         # pass a smaller num_pages to trade HBM for preemption risk)
@@ -193,11 +208,14 @@ class PagedTPUEngine:
                                                      max_new_tokens)
                 else:
                     seq_id = self.rt.submit(len(ids), max_new_tokens)
-                reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens)
+                reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens,
+                                        scanner=StopScanner(self.tokenizer, stop))
 
             active: dict[int, int] = {}      # slot -> seq_id
             slot_token = np.zeros((self.max_slots, 1), np.int32)
-            self._drive(reqs, active, slot_token, jnp.float32(temperature), stop)
+            with profile_trace():
+                self._drive(reqs, active, slot_token,
+                            jnp.float32(temperature), stop)
         except Exception:
             # never leave requests queued/running in the native scheduler —
             # the next generate() would be handed stale seq ids
@@ -225,7 +243,7 @@ class PagedTPUEngine:
         row then prefills only its suffix against this context.  Returns
         the runtime prefix id, or None when sharing isn't worth it.
         """
-        if len(encoded) < 2:
+        if not self.prefix_sharing or len(encoded) < 2:
             return None
         first = encoded[0]
         lcp = min(len(ids) for ids in encoded)
@@ -270,7 +288,9 @@ class PagedTPUEngine:
                 firsts = self._prefill_admitted(admitted, reqs, temp)
                 for seq_id, slot in admitted:
                     req = reqs[seq_id]
-                    req.generated = [firsts[slot]]  # reset: recompute path too
+                    # append, not reset: after a preemption the kept tokens
+                    # were replayed by the resume prefill and stand
+                    req.generated.append(firsts[slot])
                     slot_token[slot] = firsts[slot]
                     active[slot] = seq_id
                     if self._finished(req, stop):
@@ -304,11 +324,12 @@ class PagedTPUEngine:
                 int((lens.max() + steps + self.page_size - 1) // self.page_size))
             span = min(span, self.max_pages_per_seq)
             t0 = time.perf_counter()
-            toks, self.cache, last = self._jit_chunk(
-                self.params, self._dev(jnp.asarray(slot_token)),
-                self._dev(jnp.asarray(tables[:, :span])),
-                self._dev(jnp.asarray(lens)),
-                self.cache, temp, self._next_key(), steps=steps)
+            with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
+                toks, self.cache, last = self._jit_chunk(
+                    self.params, self._dev(jnp.asarray(slot_token)),
+                    self._dev(jnp.asarray(tables[:, :span])),
+                    self._dev(jnp.asarray(lens)),
+                    self.cache, temp, self._next_key(), steps=steps)
             toks_host = np.asarray(toks)
             slot_token = np.array(last)      # copy: host-mutated on admission
             self.stats.decode_seconds += time.perf_counter() - t0
@@ -328,7 +349,7 @@ class PagedTPUEngine:
 
     def _finished(self, req: _Request, stop: list[str]) -> bool:
         return (len(req.generated) >= req.max_new
-                or stop_hit(self.tokenizer, req.generated, stop))
+                or req.scanner.hit(req.generated))
 
     def _retire(self, req: _Request, seq_id: int, slot: int,
                 active: dict[int, int]) -> None:
@@ -344,10 +365,16 @@ class PagedTPUEngine:
             while slot in active:            # we may become a victim ourselves
                 if self.rt.advance(seq_id, steps) is not None:
                     break
-                victim = self.rt.preempt_last()
-                if victim is None:
-                    raise RuntimeError("page pool exhausted with nothing to preempt")
-                reqs[victim].generated = []  # recompute on re-admission
+                # youngest running sequence is the victim; WE report how many
+                # tokens its pages really hold — a victim whose advance()
+                # already reserved this chunk must not fold those phantom
+                # (never-executed) steps into its resume prompt
+                victim = max(active.values())
+                vreq = reqs[victim]
+                self.rt.preempt(victim, len(vreq.ids) + len(vreq.generated) - 1)
+                # generated tokens are KEPT: the runtime folded them into the
+                # victim's prompt_len, so re-admission prefills prompt+generated
+                # and decoding resumes (no resampling at temperature > 0)
                 vslot = next(s for s, q in active.items() if q == victim)
                 active.pop(vslot)
 
@@ -362,35 +389,41 @@ class PagedTPUEngine:
         KV lands in the paged cache with a single scatter.  Returns
         slot → first sampled token.
         """
-        by_bucket: dict[int, list[tuple[int, int]]] = {}
+        # group by (prefix-skip, page bucket): skip is per-sequence — a rider
+        # whose shared prefix died before admission (detached by the runtime)
+        # must prefill its FULL prompt, and a resumed preemption victim
+        # prefills prompt+generated, which may land in a larger bucket
+        by_bucket: dict[tuple[int, int], list[tuple[int, int]]] = {}
         for seq_id, slot in admitted:
             req = reqs[seq_id]
-            own = len(req.ids) - self._prefix_len   # suffix only, if shared
+            skip = self.rt.prefix_pages(seq_id) * self.page_size
+            own = len(req.prefill_ids) - skip
             n_pg = pow2_bucket((own + self.page_size - 1) // self.page_size)
-            by_bucket.setdefault(n_pg, []).append((seq_id, slot))
+            by_bucket.setdefault((skip, n_pg), []).append((seq_id, slot))
 
         firsts: dict[int, int] = {}
         t0 = time.perf_counter()
-        for n_pg, full_group in by_bucket.items():
+        for (skip, n_pg), full_group in by_bucket.items():
             t = n_pg * self.page_size
             step = max(1, PREFILL_TOKEN_BUDGET // t)
             for start in range(0, len(full_group), step):
-                self._prefill_group(full_group[start:start + step], n_pg, t,
-                                    reqs, temperature, firsts)
+                self._prefill_group(full_group[start:start + step], skip, n_pg,
+                                    t, reqs, temperature, firsts)
         self.stats.prefill_seconds += time.perf_counter() - t0
         return firsts
 
-    def _prefill_group(self, group, n_pg: int, t: int,
+    def _prefill_group(self, group, skip: int, n_pg: int, t: int,
                        reqs: dict[int, _Request], temperature,
                        firsts: dict[int, int]) -> None:
-        skip = self._prefix_len                     # tokens the prefix covers
+        assert skip in (0, self._prefix_len), \
+            "prefix skip must match the one live prefix of this generate call"
         pre_pages = skip // self.page_size
         rows = pow2_bucket(len(group))
         tokens = np.full((rows, t), self.tokenizer.pad_id, np.int32)
         pad_len = np.full(rows, t, np.int32)        # dummy rows: all pad
         tables = np.zeros((rows, n_pg), np.int32)   # dummy rows: trash
         for row, (seq_id, _) in enumerate(group):
-            ids = reqs[seq_id].ids[skip:]           # own (suffix) tokens
+            ids = reqs[seq_id].prefill_ids[skip:]   # own (suffix) tokens
             tokens[row, t - len(ids):] = ids
             pad_len[row] = t - len(ids)
             # own pages sit after the shared-prefix pages in the table
@@ -400,16 +433,17 @@ class PagedTPUEngine:
         kv = init_kv_cache(self.cfg, rows, t,
                            dtype=self.params["embed"].dtype)
         dev_pad = self._dev(jnp.asarray(pad_len))
-        if skip:
-            logits, kv = self._jit_prefill_ctx(
-                self.params, tokens=self._dev(jnp.asarray(tokens)),
-                pad_len=dev_pad, ctx=self._prefix_ctx, cache=kv)
-        else:
-            logits, kv = self._jit_prefill(
-                self.params, tokens=self._dev(jnp.asarray(tokens)),
-                pad_len=dev_pad, cache=kv)
-        self.cache = self._jit_commit(self.cache, kv, dev_pad,
-                                      self._dev(jnp.asarray(tables)))
+        with jax.profiler.TraceAnnotation("reval.paged_prefill"):
+            if skip:
+                logits, kv = self._jit_prefill_ctx(
+                    self.params, tokens=self._dev(jnp.asarray(tokens)),
+                    pad_len=dev_pad, ctx=self._prefix_ctx, cache=kv)
+            else:
+                logits, kv = self._jit_prefill(
+                    self.params, tokens=self._dev(jnp.asarray(tokens)),
+                    pad_len=dev_pad, cache=kv)
+            self.cache = self._jit_commit(self.cache, kv, dev_pad,
+                                          self._dev(jnp.asarray(tables)))
         first = sample_token(logits[:, 0, :], temperature, self._next_key())
         first_host = np.asarray(first)
         for row, (_, slot) in enumerate(group):

@@ -78,12 +78,14 @@ def load_native() -> ctypes.CDLL:
         "reval_rt_slot_of": ([ptr, i64], i32),
         "reval_rt_advance": ([ptr, i64, i32], i32),
         "reval_rt_fork": ([ptr, i64, p32], i64),
+        "reval_rt_preempt": ([ptr, i64, i32], i32),
         "reval_rt_preempt_last": ([ptr], i64),
         "reval_rt_release": ([ptr, i64], None),
         "reval_rt_free_pages": ([ptr], i32),
         "reval_rt_num_waiting": ([ptr], i32),
         "reval_rt_num_running": ([ptr], i32),
         "reval_rt_page_ref": ([ptr, i32], i32),
+        "reval_rt_prefix_pages": ([ptr, i64], i32),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -195,7 +197,19 @@ class PagedRuntime:
             raise RuntimeError(f"fork of seq {seq_id} failed (unknown id or OOM)")
         return int(child), int(fresh.value)
 
+    def preempt(self, seq_id: int, materialized_len: int) -> None:
+        """Preempt a specific running sequence, giving the runtime the
+        caller's count of tokens actually materialised in its pages —
+        ``advance`` reservations for a not-yet-run chunk must NOT be
+        folded into the resume prompt (they would become phantom tokens)."""
+        if self._lib.reval_rt_preempt(self._h, seq_id, materialized_len) != 0:
+            raise ValueError(
+                f"cannot preempt seq {seq_id} at len {materialized_len}: "
+                f"not running, or length outside its valid range")
+
     def preempt_last(self) -> int | None:
+        """Preempt the youngest running sequence, trusting the runtime's
+        own length (only sound with no outstanding chunk reservation)."""
         victim = self._lib.reval_rt_preempt_last(self._h)
         return None if victim == -1 else int(victim)
 
@@ -217,3 +231,11 @@ class PagedRuntime:
 
     def page_ref(self, page: int) -> int:
         return self._lib.reval_rt_page_ref(self._h, page)
+
+    def prefix_pages(self, seq_id: int) -> int:
+        """Shared-prefix pages attached to this sequence's block table
+        (0 = the engine's prefill must cover the full prompt itself)."""
+        n = self._lib.reval_rt_prefix_pages(self._h, seq_id)
+        if n < 0:
+            raise KeyError(seq_id)
+        return n

@@ -37,6 +37,7 @@ struct Seq {
   int32_t max_new = 0;
   int32_t slot = -1;           // batch slot while running, -1 otherwise
   int64_t prefix_id = -1;      // shared-prefix object this request rides on
+  int32_t prefix_pages = 0;    // prefix pages attached to the table (0 = none)
   SeqState state = SeqState::kWaiting;
 };
 
@@ -137,10 +138,15 @@ int32_t reval_rt_admit(void* h, int64_t* seq_ids, int32_t* slot_ids,
           ++rt->ref_counts[p];
           seq.pages.push_back(p);
         }
+        seq.prefix_pages = static_cast<int32_t>(pit->second.pages.size());
+      } else {
+        // prefix gone (released before this rider was admitted): detach
+        // explicitly.  reval_rt_prefix_pages now reports 0, telling the
+        // engine its prefill must cover the FULL prompt itself — the
+        // freshly allocated prefix-region pages hold no KV until it does.
+        seq.prefix_id = -1;
+        seq.prefix_pages = 0;
       }
-      // prefix gone (engine released it early): fall through — the full
-      // prompt_len still covers the whole sequence, so correctness holds,
-      // the request just pays for all its pages itself
     }
     // a waiting sequence may already own pages (fork children / prefix
     // riders) — only the missing prompt pages need allocating
@@ -290,24 +296,64 @@ int64_t reval_rt_fork(void* h, int64_t seq_id, int32_t* fresh_page) {
   return child.id;
 }
 
-// Preempt the most recently admitted running sequence: frees its pages and
-// slot and requeues it at the FRONT of the waiting queue (recompute-style
-// preemption — prefill reruns when it is re-admitted).  Returns its id, or
-// -1 if nothing is running.
+namespace {
+
+// Shared preemption core.  Recompute is RESUME-style (vLLM recompute
+// semantics): everything materialised plus the one sampled-but-unwritten
+// token is folded into prompt_len, so the re-admission prefill replays
+// prompt+generated and decoding continues where it left off —
+// already-sampled tokens are never resampled (which would silently change
+// results at temperature > 0).
+void do_preempt(Runtime* rt, int64_t victim, int32_t materialized) {
+  Seq& seq = rt->seqs.at(victim);
+  for (int32_t p : seq.pages) rt->drop_page(p);
+  seq.pages.clear();
+  rt->slots[seq.slot] = -1;
+  seq.slot = -1;
+  int32_t resumed = materialized + 1;  // +1: the pending sampled token
+  seq.max_new -= resumed - seq.prompt_len;
+  seq.prompt_len = resumed;
+  seq.len = 0;
+  seq.prefix_pages = 0;  // re-attached (if the prefix lives) at re-admission
+  seq.state = SeqState::kWaiting;
+  rt->waiting.push_front(victim);
+}
+
+}  // namespace
+
+// Preempt a specific running sequence, with the CALLER's count of tokens
+// actually materialised in its pages.  The runtime's own seq.len cannot be
+// trusted here: reval_rt_advance reserves pages for a decode chunk BEFORE
+// it executes, so a victim picked mid-reservation carries up-to-chunk-size
+// phantom tokens in len — folding those into prompt_len would permanently
+// inflate its accounting (early OOMs, spurious re-preemption, possible
+// deadlock of a feasible workload).  Returns 0, or -1 if the sequence is
+// not running or materialized_len is outside [prompt_len-1 .. len].
+int32_t reval_rt_preempt(void* h, int64_t seq_id, int32_t materialized_len) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  if (it == rt->seqs.end() || it->second.state != SeqState::kRunning)
+    return -1;
+  // prompt_len-1: a resumed victim preempted again before any new decode
+  // (its pending token is counted by the +1 fold, not by materialized)
+  if (materialized_len < it->second.prompt_len - 1 ||
+      materialized_len > it->second.len)
+    return -1;
+  do_preempt(rt, seq_id, materialized_len);
+  return 0;
+}
+
+// Preempt the most recently admitted running sequence, trusting seq.len as
+// the materialised count.  ONLY sound when no advance() reservation is
+// outstanding (the engine uses reval_rt_preempt with its own count
+// instead).  Returns the victim id, or -1 if nothing is running.
 int64_t reval_rt_preempt_last(void* h) {
   auto* rt = as_rt(h);
   int64_t victim = -1;
   for (int32_t s = 0; s < rt->max_slots; ++s)
     if (rt->slots[s] != -1 && rt->slots[s] > victim) victim = rt->slots[s];
   if (victim == -1) return -1;
-  Seq& seq = rt->seqs.at(victim);
-  for (int32_t p : seq.pages) rt->drop_page(p);
-  seq.pages.clear();
-  rt->slots[seq.slot] = -1;
-  seq.slot = -1;
-  seq.len = 0;
-  seq.state = SeqState::kWaiting;
-  rt->waiting.push_front(victim);
+  do_preempt(rt, victim, rt->seqs.at(victim).len);
   return victim;
 }
 
@@ -341,6 +387,16 @@ int32_t reval_rt_page_ref(void* h, int32_t page) {
   auto* rt = as_rt(h);
   if (page < 0 || page >= rt->num_pages) return -1;
   return rt->ref_counts[page];
+}
+
+// Shared-prefix pages currently attached to this sequence's block table
+// (0 when it rides no prefix, was detached because the prefix died before
+// admission, or is waiting un-admitted).  The engine's prefill must cover
+// prompt_len - prefix_pages*page_size tokens itself.
+int32_t reval_rt_prefix_pages(void* h, int64_t seq_id) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  return it == rt->seqs.end() ? -1 : it->second.prefix_pages;
 }
 
 }  // extern "C"

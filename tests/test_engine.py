@@ -94,3 +94,78 @@ class TestStopStrings:
         assert len(outs[0]) == 3
         # early stop: far fewer than 64 tokens were generated
         assert shim.stats.generated_tokens < 64
+
+
+class TestStopScanner:
+    """Incremental stop detection must see exactly what a full decode sees,
+    at O(chunk) cost — including stop strings straddling chunk boundaries."""
+
+    def _scan_chunked(self, text: str, stop: list[str], chunk: int) -> bool:
+        from reval_tpu.inference.tpu.engine import StopScanner
+
+        tok = ByteTokenizer()
+        ids = [i for i in tok.encode(text) if i != tok.bos_id]
+        sc = StopScanner(tok, stop)
+        hit = False
+        for i in range(0, len(ids), chunk):
+            hit = hit or sc.hit(ids[: i + chunk])
+        return hit
+
+    def test_straddle_across_chunk_boundary(self):
+        # "[/ANSWER]" split across every possible chunk-edge offset
+        stop = "[/ANSWER]"
+        for pad in range(1, 17):
+            text = "x" * pad + stop + "tail"
+            assert self._scan_chunked(text, [stop], chunk=8), pad
+
+    def test_no_false_positive(self):
+        assert not self._scan_chunked("[/ANSWE" + "R" * 0 + " nope]", ["[/ANSWER]"], 8)
+        assert not self._scan_chunked("plain text " * 20, ["[/ANSWER]"], 8)
+
+    def test_matches_full_rescan_on_random_splits(self):
+        from reval_tpu.inference.tpu.engine import StopScanner, stop_hit
+
+        tok = ByteTokenizer()
+        rng = np.random.RandomState(0)
+        for trial in range(50):
+            n = int(rng.randint(5, 120))
+            body = "".join(chr(int(c)) for c in rng.randint(97, 123, n))
+            if trial % 3 == 0:
+                pos = int(rng.randint(0, n))
+                body = body[:pos] + "[/ANSWER]" + body[pos:]
+            ids = [i for i in tok.encode(body) if i != tok.bos_id]
+            sc = StopScanner(tok, ["[/ANSWER]"])
+            hit = False
+            i = 0
+            while i < len(ids):
+                i += int(rng.randint(1, 12))
+                hit = hit or sc.hit(ids[:i])
+            assert hit == stop_hit(tok, ids, ["[/ANSWER]"]), body
+
+    def test_eos_only_in_new_tail(self):
+        from reval_tpu.inference.tpu.engine import StopScanner
+
+        tok = ByteTokenizer()
+        sc = StopScanner(tok, [])
+        assert not sc.hit([65, 66, 67])
+        assert sc.hit([65, 66, 67, tok.eos_id])
+
+    def test_scan_cost_is_bounded(self):
+        """The scanner must not re-decode the whole history every chunk."""
+        from reval_tpu.inference.tpu.engine import StopScanner
+
+        class CountingTok(ByteTokenizer):
+            decoded_tokens = 0
+
+            def decode(self, ids):
+                CountingTok.decoded_tokens += len(ids)
+                return super().decode(ids)
+
+        tok = CountingTok()
+        sc = StopScanner(tok, ["[/ANSWER]"])
+        ids: list[int] = []
+        for _ in range(128):                     # 128 chunks of 8 tokens
+            ids.extend([120] * 8)
+            sc.hit(ids)
+        # full-rescan cost would be ~128*129/2*8 ≈ 66k; windowed is ~128*(8+17)
+        assert CountingTok.decoded_tokens < 5000

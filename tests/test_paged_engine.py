@@ -140,3 +140,47 @@ def test_long_prompt_multi_page_prefill(tiny):
     got = paged.generate([long_prompt], max_new_tokens=8, temperature=0.0)
     assert got == want
     paged.close()
+
+
+def test_preemption_resumes_generated_tokens(tiny):
+    """At temperature>0 a preempted request must NOT resample its
+    already-generated tokens: re-admission prefills prompt+generated and
+    continues (vLLM recompute semantics).  We spy on re-admissions and
+    assert every resumed token prefix survives into the final output."""
+    import types
+
+    cfg, params = tiny
+
+    class NoEosTok(ByteTokenizer):
+        """EOS outside the vocab: random sampling can never end a sequence
+        early, so every request runs its full budget and must grow pages."""
+        def __init__(self):
+            super().__init__()
+            self.eos_id = 10 ** 6
+
+    # 4 usable pages, 2 slots; the two sequences together want 5 pages
+    # (3 + 2: prompt page + 240 generated tokens each) → guaranteed
+    # preemption when the larger one crosses into its 3rd page
+    tight = PagedTPUEngine(params, cfg, NoEosTok(), max_slots=2,
+                           page_size=PAGE, max_seq_len=512, num_pages=5,
+                           seed=3)
+    resumed: list[tuple[int, list[int]]] = []
+    reqs_seen = {}
+    orig = tight._prefill_admitted
+
+    def spy(self, admitted, reqs, temperature):
+        reqs_seen.update(reqs)
+        for seq_id, _slot in admitted:
+            if reqs[seq_id].generated:          # re-admission after preempt
+                resumed.append((seq_id, list(reqs[seq_id].generated)))
+        return orig(admitted, reqs, temperature)
+
+    tight._prefill_admitted = types.MethodType(spy, tight)
+    outs = tight.generate(PROMPTS[:2], max_new_tokens=240, temperature=0.8)
+    assert len(outs) == 2
+    assert resumed, "tiny pool should have preempted at least one request"
+    for seq_id, prefix in resumed:
+        final = reqs_seen[seq_id].generated
+        assert final[: len(prefix)] == prefix, (
+            "preemption discarded/resampled already-generated tokens")
+    tight.close()

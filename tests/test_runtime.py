@@ -77,9 +77,11 @@ def test_oom_advance_then_preempt_recovers():
     # only 1 page free: the watermark (prompt pages + 1) blocks re-admission
     assert rt.admit() == []
     rt.release(a)                      # a finishes → pool drains
-    # b re-admits from the queue FRONT with its prefill page
+    # b re-admits from the queue FRONT; resume semantics folded everything
+    # materialised plus the pending sampled token into its prompt, so the
+    # re-prefill replays PAGE+1 tokens instead of restarting from PAGE
     assert [s for s, _ in rt.admit()] == [b]
-    assert rt.seq_len(b) == PAGE
+    assert rt.seq_len(b) == PAGE + 1
     rt.close()
 
 
@@ -172,4 +174,36 @@ def test_failed_advance_keeps_length_honest():
     assert rt.seq_len(a) == before       # unchanged, not snapped to PAGE
     rt.release(b)
     assert rt.advance(a, PAGE) == before + PAGE
+    rt.close()
+
+
+def test_preempt_ignores_unexecuted_reservation():
+    """advance() reserves chunk pages BEFORE the decode runs; preempting a
+    victim mid-reservation must fold only the tokens the caller reports as
+    materialised — not the phantom reserved steps (review finding: the
+    drift compounds per preemption and can deadlock a feasible workload)."""
+    rt = PagedRuntime(num_pages=8, page_size=PAGE, max_slots=2,
+                      max_pages_per_seq=4)
+    a = rt.submit(PAGE, 2 * PAGE)
+    assert len(rt.admit()) == 1
+    # engine view: prefill done, one pending token → materialized == PAGE
+    assert rt.advance(a, 8) == PAGE + 8      # chunk reserved, never executed
+    rt.preempt(a, PAGE)                      # caller's true count
+    assert [s for s, _ in rt.admit()] == [a]
+    assert rt.seq_len(a) == PAGE + 1         # not PAGE + 9
+    rt.close()
+
+
+def test_preempt_validates_range():
+    rt = PagedRuntime(num_pages=8, page_size=PAGE, max_slots=2,
+                      max_pages_per_seq=4)
+    a = rt.submit(PAGE, PAGE)
+    with pytest.raises(ValueError):
+        rt.preempt(a, PAGE)                  # waiting, not running
+    rt.admit()
+    with pytest.raises(ValueError):
+        rt.preempt(a, PAGE + 5)              # beyond runtime len
+    with pytest.raises(ValueError):
+        rt.preempt(a, PAGE - 2)              # below prompt_len - 1
+    rt.preempt(a, PAGE)
     rt.close()

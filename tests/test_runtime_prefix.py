@@ -71,3 +71,32 @@ def test_submit_prefixed_validations(rt):
 def test_alloc_prefix_oom(rt):
     with pytest.raises(ValueError):
         rt.alloc_prefix(100)
+
+
+def test_prefix_pages_query(rt):
+    pre = rt.alloc_prefix(2)
+    a = rt.submit_prefixed(pre, prompt_len=2 * PAGE + 5, max_new_tokens=4)
+    assert rt.prefix_pages(a) == 0          # waiting: nothing attached yet
+    rt.admit()
+    assert rt.prefix_pages(a) == 2
+    assert rt.preempt_last() == a
+    assert rt.prefix_pages(a) == 0          # detached with its pages
+    rt.admit()
+    assert rt.prefix_pages(a) == 2          # re-attached
+    with pytest.raises(KeyError):
+        rt.prefix_pages(99999)
+
+
+def test_dead_prefix_detaches_rider_for_full_prefill(rt):
+    """A rider admitted after its prefix died must be told to prefill its
+    whole prompt (prefix_pages == 0) and must own ALL its pages — the
+    prefix-region pages hold no KV, so attention over them would read
+    garbage if the engine skipped them (advisor finding)."""
+    pre = rt.alloc_prefix(2)
+    a = rt.submit_prefixed(pre, prompt_len=2 * PAGE + 5, max_new_tokens=4)
+    rt.release(pre)                          # prefix gone before admission
+    assert [s for s, _ in rt.admit()] == [a]
+    assert rt.prefix_pages(a) == 0
+    own = [p for p in rt.block_table(a) if p != 0]
+    assert len(own) == 3                     # pages for the FULL prompt
+    assert all(rt.page_ref(p) == 1 for p in own)
