@@ -261,7 +261,9 @@ def main() -> None:
                          "bench harness itself, NOT a performance number")
     args = ap.parse_args()
 
-    max_new = 1024 if args.mode == "cot" else 256
+    from reval_tpu.inference.base import MAX_NEW_TOKENS
+
+    max_new = MAX_NEW_TOKENS[args.mode]   # the budgets the eval path uses
     if args.tiny:
         max_new = 16
         args.prompts = min(args.prompts, 6)

@@ -21,9 +21,14 @@ __all__ = ["ReplayBackend"]
 class ReplayBackend(InferenceBackend):
     def __init__(self, replay_task: str, model_id: str, temp: float = 0.8,
                  prompt_type: str = "direct", replay_time: str | None = None,
-                 results_dir: str = "model_generations", **kwargs):
+                 results_dir: str = "model_generations",
+                 replay_results_dir: str | None = None, **kwargs):
+        """``replay_results_dir`` reads logs from a different tree than the
+        one this run writes to — e.g. re-scoring the reference repo's
+        committed logs (read-only) into a local results dir."""
         model_id = OPENAI_FULL_IDS.get(model_id, model_id)
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
+        results_dir = replay_results_dir or results_dir
         base = os.path.join(results_dir, f"{replay_task}@{self.info}")
         # Fallback: reference logs use unsanitised model ids with '/' in the
         # directory name; our writer sanitises.  Accept both.
@@ -44,9 +49,28 @@ class ReplayBackend(InferenceBackend):
             rows = [json.loads(line) for line in f if line.strip()]
         for row in rows[:-1]:  # last row is the metrics trailer
             for gen in row.get("generation", []):
-                for rec in gen.get("results", []):
-                    self.generations.append(rec.get("generated", ""))
+                recs = self._dedup(gen.get("results", []))
+                self.generations.extend(rec.get("generated", "") for rec in recs)
         self.ptr = 0
+
+    @staticmethod
+    def _dedup(recs: list[dict]) -> list[dict]:
+        """Drop the reference path task's double-appended records: it logs
+        each probe twice, a bare {generated,response,expected} then the same
+        probe enriched with line/prompt (reference evaluation.py:549,552).
+        A bare record whose successor carries the same generation plus a
+        strict superset of keys is that duplicate.  Logs written by this
+        framework (and the reference's other tasks) have uniform key sets
+        per task, so the strict-subset test never fires on them."""
+        out = []
+        for i, rec in enumerate(recs):
+            nxt = recs[i + 1] if i + 1 < len(recs) else None
+            if (nxt is not None
+                    and rec.get("generated") == nxt.get("generated")
+                    and set(rec) < set(nxt)):
+                continue
+            out.append(rec)
+        return out
 
     def infer_one(self, prompt: str) -> str:
         if self.ptr >= len(self.generations):

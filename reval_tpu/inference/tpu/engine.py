@@ -99,41 +99,43 @@ class StopScanner:
 
     ``stop_hit`` detokenises the FULL generated id list on every call; at
     CoT budgets (1024 tokens) × 8 slots that is quadratic host work per
-    sequence (SURVEY §7 hard part 1 warns about exactly this).  The scanner
-    instead decodes only the not-yet-scanned tail plus a bounded overlap
-    window so a stop string straddling a chunk boundary is still seen:
-    the window re-covers ``max_stop_len + margin`` tokens before the new
-    chunk, and every token decodes to at least one character for the
-    byte-level/BPE vocabularies the engines use, so ``S-1`` chars of
-    straddle are always inside the window.
+    sequence (SURVEY §7 hard part 1 warns about exactly this).  The
+    scanner is push-style: callers feed each chunk's NEW token ids and it
+    keeps a bounded tail of previous tokens, so a stop string straddling
+    a chunk boundary is still seen.  The tail is sized by the longest
+    stop string's UTF-8 *byte* length — every BPE/byte-level token
+    carries at least one byte, so ``S-1`` bytes of straddle always fit —
+    plus a margin for window-edge artifacts.
 
     Detection only — final truncation still happens in ``finalize_text``
     with one full decode, keeping vLLM post-detokenisation semantics.
     """
 
     #: extra overlap tokens beyond the longest stop string, absorbing
-    #: multi-char tokens at the window edge and partial-UTF8 artifacts
+    #: multi-byte tokens at the window edge and partial-UTF8 artifacts
     MARGIN = 8
 
     def __init__(self, tokenizer, stop: list[str]):
         self.tokenizer = tokenizer
         self.stop = stop
-        self.overlap = max((len(s) for s in stop), default=0) + self.MARGIN
-        self.scanned = 0            # tokens covered by previous scans
+        self.overlap = (max((len(s.encode("utf-8")) for s in stop), default=0)
+                        + self.MARGIN)
+        self._tail: list[int] = []
 
     def reset(self) -> None:
-        self.scanned = 0
+        self._tail = []
 
-    def hit(self, ids: list[int]) -> bool:
-        new = len(ids) - self.scanned
-        self.scanned = len(ids)
-        if new <= 0:
+    def hit_new(self, new_ids: list[int]) -> bool:
+        """Feed the tokens generated since the last call; True = finished."""
+        if not new_ids:
             return False
-        if self.tokenizer.eos_id in ids[-new:]:
+        if self.tokenizer.eos_id in new_ids:
             return True
         if not self.stop:
             return False
-        text = self.tokenizer.decode(ids[-(new + self.overlap):])
+        window = self._tail + list(new_ids)
+        self._tail = window[-self.overlap:]
+        text = self.tokenizer.decode(window)
         return any(s in text for s in self.stop)
 
 
@@ -290,7 +292,7 @@ class TPUEngine:
         finished = [False] * n_real + [True] * (b - n_real)
         scanners = [StopScanner(self.tokenizer, stop) for _ in range(n_real)]
         for row in range(n_real):
-            finished[row] = scanners[row].hit(generated[row].tolist())
+            finished[row] = scanners[row].hit_new([int(first_host[row, 0])])
 
         t0 = time.perf_counter()
         while generated.shape[1] < max_new_tokens and not all(finished):
@@ -300,10 +302,11 @@ class TPUEngine:
                     self.params, token, dev_pad, cache, pos,
                     jnp.float32(temperature), self._next_key(), steps=steps)
             pos = pos + steps
-            generated = np.concatenate([generated, np.asarray(toks)], axis=1)
+            chunk_host = np.asarray(toks)
+            generated = np.concatenate([generated, chunk_host], axis=1)
             for row in range(n_real):
                 if not finished[row]:
-                    finished[row] = scanners[row].hit(generated[row].tolist())
+                    finished[row] = scanners[row].hit_new(chunk_host[row].tolist())
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.generated_tokens += int(generated[:n_real].size)
         self.stats.prompts += n_real

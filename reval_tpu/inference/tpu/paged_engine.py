@@ -214,8 +214,7 @@ class PagedTPUEngine:
             active: dict[int, int] = {}      # slot -> seq_id
             slot_token = np.zeros((self.max_slots, 1), np.int32)
             with profile_trace():
-                self._drive(reqs, active, slot_token,
-                            jnp.float32(temperature), stop)
+                self._drive(reqs, active, slot_token, jnp.float32(temperature))
         except Exception:
             # never leave requests queued/running in the native scheduler —
             # the next generate() would be handed stale seq ids
@@ -280,7 +279,7 @@ class PagedTPUEngine:
         return prefix_id
 
     def _drive(self, reqs: dict[int, _Request], active: dict[int, int],
-               slot_token: np.ndarray, temp, stop: list[str]) -> None:
+               slot_token: np.ndarray, temp) -> None:
         """Admission/prefill/decode loop until every request is done."""
         while True:
             admitted = self.rt.admit()
@@ -293,7 +292,7 @@ class PagedTPUEngine:
                     req.generated.append(firsts[slot])
                     slot_token[slot] = firsts[slot]
                     active[slot] = seq_id
-                    if self._finished(req, stop):
+                    if self._finished(req, [firsts[slot]]):
                         self._retire(req, seq_id, slot, active)
             if not active:
                 if any(not r.done for r in reqs.values()):
@@ -337,8 +336,9 @@ class PagedTPUEngine:
 
             for slot, seq_id in list(active.items()):
                 req = reqs[seq_id]
-                req.generated.extend(int(t) for t in toks_host[slot])
-                if self._finished(req, stop):
+                chunk_ids = [int(t) for t in toks_host[slot]]
+                req.generated.extend(chunk_ids)
+                if self._finished(req, chunk_ids):
                     self._retire(req, seq_id, slot, active)
 
     # -- host-side helpers -------------------------------------------------
@@ -347,9 +347,9 @@ class PagedTPUEngine:
             return jax.device_put(arr, self._replicated)
         return arr
 
-    def _finished(self, req: _Request, stop: list[str]) -> bool:
+    def _finished(self, req: _Request, new_ids: list[int]) -> bool:
         return (len(req.generated) >= req.max_new
-                or req.scanner.hit(req.generated))
+                or req.scanner.hit_new(new_ids))
 
     def _retire(self, req: _Request, seq_id: int, slot: int,
                 active: dict[int, int]) -> None:

@@ -108,7 +108,7 @@ class TestStopScanner:
         sc = StopScanner(tok, stop)
         hit = False
         for i in range(0, len(ids), chunk):
-            hit = hit or sc.hit(ids[: i + chunk])
+            hit = hit or sc.hit_new(ids[i: i + chunk])
         return hit
 
     def test_straddle_across_chunk_boundary(self):
@@ -138,17 +138,26 @@ class TestStopScanner:
             hit = False
             i = 0
             while i < len(ids):
-                i += int(rng.randint(1, 12))
-                hit = hit or sc.hit(ids[:i])
+                step = int(rng.randint(1, 12))
+                hit = hit or sc.hit_new(ids[i:i + step])
+                i += step
             assert hit == stop_hit(tok, ids, ["[/ANSWER]"]), body
 
-    def test_eos_only_in_new_tail(self):
+    def test_eos_detected_in_chunk(self):
         from reval_tpu.inference.tpu.engine import StopScanner
 
         tok = ByteTokenizer()
         sc = StopScanner(tok, [])
-        assert not sc.hit([65, 66, 67])
-        assert sc.hit([65, 66, 67, tok.eos_id])
+        assert not sc.hit_new([65, 66, 67])
+        assert sc.hit_new([68, tok.eos_id])
+
+    def test_multibyte_stop_straddles_window(self):
+        """The overlap window is sized in stop-string BYTES: a multi-byte
+        (e.g. Cyrillic) stop split one byte before its end must still hit."""
+        stop = "СТОПСТОП"                        # 8 chars, 16 UTF-8 bytes
+        for pad in range(1, 20):
+            text = "x" * pad + stop + "tail"
+            assert self._scan_chunked(text, [stop], chunk=8), pad
 
     def test_scan_cost_is_bounded(self):
         """The scanner must not re-decode the whole history every chunk."""
@@ -163,9 +172,7 @@ class TestStopScanner:
 
         tok = CountingTok()
         sc = StopScanner(tok, ["[/ANSWER]"])
-        ids: list[int] = []
         for _ in range(128):                     # 128 chunks of 8 tokens
-            ids.extend([120] * 8)
-            sc.hit(ids)
+            sc.hit_new([120] * 8)
         # full-rescan cost would be ~128*129/2*8 ≈ 66k; windowed is ~128*(8+17)
         assert CountingTok.decoded_tokens < 5000

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Scoring-parity oracle: replay the reference's committed run logs through
+this framework's pipeline and check every metric reproduces.
+
+The reference repo ships gemma-1-2b-it MBPP logs for coverage/path/state at
+direct/cot x temp {0.0, 0.8} (see BASELINE.md; the metrics trailer is each
+log's last JSONL row).  Those generations were produced by the reference's
+harness (reference evaluation.py run loop + inference.py vLLM backend);
+re-serving them via ReplayBackend and re-scoring with THIS pipeline tests,
+end to end: prompt planning order and probe counts, answer postprocessing,
+ground-truth execution (tracer + queries), and the metric math.  Any
+mismatch to 4 decimals is a scoring-parity bug.
+
+Usage:
+    python tools/parity_replay.py [--reference DIR] [--dataset mbpp]
+Exit code 0 = all rows reproduce.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# every committed (task, prompt_type, temp) combination in the reference
+REFERENCE_RUNS = [
+    ("coverage", "direct", 0.0), ("coverage", "direct", 0.8),
+    ("coverage", "cot", 0.0), ("coverage", "cot", 0.8),
+    ("path", "direct", 0.0), ("path", "direct", 0.8),
+    ("path", "cot", 0.0), ("path", "cot", 0.8),
+    ("state", "direct", 0.0), ("state", "direct", 0.8),
+]
+MODEL_ID = "google/gemma-1-2b-it"
+# reference state logs also exist for cot; include them
+REFERENCE_RUNS += [("state", "cot", 0.0), ("state", "cot", 0.8)]
+
+
+def reference_trailer(source_file: str) -> dict:
+    with open(source_file) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    return rows[-1]
+
+
+def valid_cases_file(task: str, reference_dir: str, dataset: str) -> str | None:
+    """The reference's committed MBPP runs score ONLY tot-validated test
+    cases (coverage 1009 / path 414 / state 469 of the full probe set);
+    the case lists live next to the tot logs (reference
+    evaluation.py:1153-1160's hard-coded paths point at these files)."""
+    hits = glob.glob(os.path.join(
+        reference_dir, f"{task}@{MODEL_ID}_tot",
+        f"*.valid_test_cases.{dataset}.json"))
+    return hits[0] if hits else None
+
+
+def replay_one(task: str, prompt_type: str, temp: float, reference_dir: str,
+               dataset: str, out_dir: str) -> tuple[dict, dict] | None:
+    """(our metrics, reference trailer), or None if the log is absent."""
+    from reval_tpu.inference.replay import ReplayBackend
+    from reval_tpu.tasks import TASKS
+
+    try:
+        backend = ReplayBackend(replay_task=task, model_id=MODEL_ID,
+                                temp=temp, prompt_type=prompt_type,
+                                results_dir=reference_dir)
+    except FileNotFoundError:
+        return None
+    runner = TASKS[task](model=backend, prompt_type=prompt_type,
+                         dataset=dataset, results_dir=out_dir,
+                         progress=False,
+                         valid_test_cases_path=valid_cases_file(
+                             task, reference_dir, dataset))
+    ours = runner.run()
+    return ours, reference_trailer(backend.source_file)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference",
+                    default="/root/reference/model_generations")
+    ap.add_argument("--dataset", default="mbpp")
+    ap.add_argument("--places", type=int, default=4)
+    args = ap.parse_args()
+
+    if not glob.glob(os.path.join(args.reference, "*@*")):
+        print(f"no reference logs under {args.reference}")
+        return 2
+
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for task, prompt_type, temp in REFERENCE_RUNS:
+            got = replay_one(task, prompt_type, temp, args.reference,
+                             args.dataset, tmp)
+            if got is None:
+                print(f"SKIP  {task:<9} {prompt_type:<6} t={temp}: no log")
+                continue
+            ours, ref = got
+            keys = sorted(set(ours) & set(ref))
+            bad = [k for k in keys
+                   if round(float(ours[k]), args.places)
+                   != round(float(ref[k]), args.places)]
+            status = "FAIL" if bad else "ok"
+            failures += bool(bad)
+            detail = " ".join(f"{k}={ours[k]:{'.4f' if isinstance(ours[k], float) else ''}}"
+                              for k in keys)
+            print(f"{status:<5} {task:<9} {prompt_type:<6} t={temp}: {detail}"
+                  + (f"   MISMATCH on {bad}: ref "
+                     + " ".join(f"{k}={ref[k]}" for k in bad) if bad else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
