@@ -34,9 +34,20 @@ class TPUBackend(InferenceBackend):
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only,
             )
+        elif engine == "paged":
+            # dp>1 with continuous batching: one paged replica per device
+            # group (v5e-8 flagship shape: dp=2 × tp=4), prompts sharded
+            # round-robin across replicas in this process
+            from .dp_paged import DataParallelPagedEngine
+
+            self.engine = DataParallelPagedEngine.from_pretrained(
+                model_path, dtype=dtype, dp_size=dp_size, tp_size=num_chips,
+                max_slots=batch_size, max_seq_len=max_seq_len,
+                local_devices_only=local_devices_only,
+            )
         else:
-            # dp>1 shards the batch axis across chips — the static engine's
-            # rectangular batches are what makes that sharding well-formed
+            # the static engine shards one rectangular batch over a dp×tp
+            # mesh — one jit program over all chips, no replica threads
             from .engine import TPUEngine
 
             self.engine = TPUEngine.from_pretrained(

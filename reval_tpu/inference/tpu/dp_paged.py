@@ -1,0 +1,118 @@
+"""Data-parallel continuous batching: N paged-engine replicas, one process.
+
+The paged engine's page pool and native scheduler are deliberately
+per-replica state (a global pool would serialise every replica's admission
+on one lock and put all block tables behind one host thread), so data
+parallelism for continuous batching is replica-per-device-group: a v5e-8
+runs the flagship models as ``dp=2 × tp=4`` — two independent paged
+engines, each sharded over its own 4 chips, fed disjoint prompt shards.
+
+This mirrors how the reference scales: vLLM's continuous batching is
+per-process, and ``batch_run.py`` runs several GPU processes side by side
+(reference batch_run.py:20-28).  Here the replicas share one Python
+process — JAX dispatch releases the GIL while device work runs, so a
+thread per replica keeps every device group busy concurrently — and one
+model load (weights are device_put per replica group).
+
+Prompts shard round-robin so few-shot batches stay balanced; outputs
+reassemble into caller order.  Prefix sharing happens per replica on its
+own shard (round-robin preserves the common template in every shard).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+
+from ...models import load_checkpoint
+from ...parallel import make_mesh
+from .engine import EngineStats
+from .paged_engine import PagedTPUEngine
+from .tokenizer import HFTokenizer
+
+__all__ = ["DataParallelPagedEngine"]
+
+
+class DataParallelPagedEngine:
+    def __init__(self, params, cfg, tokenizer, *, dp_size: int,
+                 tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
+                 max_seq_len: int = 8192, num_pages: int | None = None,
+                 seed: int = 0, prefix_sharing: bool = True, devices=None):
+        devices = list(devices if devices is not None else jax.devices())
+        need = dp_size * tp_size
+        if len(devices) < need:
+            raise ValueError(f"dp={dp_size} × tp={tp_size} needs {need} "
+                             f"devices, have {len(devices)}")
+        self.dp_size = dp_size
+        self.tokenizer = tokenizer
+        self.replicas: list[PagedTPUEngine] = []
+        for r in range(dp_size):
+            group = devices[r * tp_size:(r + 1) * tp_size]
+            # a tp=1 mesh still pins the replica's params/cache to its device
+            mesh = make_mesh(tp=tp_size, devices=group)
+            self.replicas.append(PagedTPUEngine(
+                params, cfg, tokenizer, max_slots=max_slots,
+                page_size=page_size, max_seq_len=max_seq_len,
+                num_pages=num_pages, mesh=mesh, seed=seed + r,
+                prefix_sharing=prefix_sharing))
+        self._pool = ThreadPoolExecutor(max_workers=dp_size,
+                                        thread_name_prefix="dp-paged")
+
+    @classmethod
+    def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
+                        dp_size: int = 2, tp_size: int = 1,
+                        max_slots: int = 8, page_size: int = 128,
+                        max_seq_len: int = 8192, num_pages: int | None = None,
+                        tokenizer=None, seed: int = 0,
+                        local_devices_only: bool = False
+                        ) -> "DataParallelPagedEngine":
+        params, cfg = load_checkpoint(model_path, dtype=dtype)
+        if tokenizer is None:
+            tokenizer = HFTokenizer(model_path)
+        devices = jax.local_devices() if local_devices_only else None
+        return cls(params, cfg, tokenizer, dp_size=dp_size, tp_size=tp_size,
+                   max_slots=max_slots, page_size=page_size,
+                   max_seq_len=max_seq_len, num_pages=num_pages, seed=seed,
+                   devices=devices)
+
+    @property
+    def stats(self) -> EngineStats:
+        """Aggregated over replicas (seconds are summed device-time, not
+        wall-clock — divide by dp for a wall estimate under full overlap)."""
+        agg = EngineStats()
+        for rep in self.replicas:
+            s = rep.stats
+            agg.prompts += s.prompts
+            agg.generated_tokens += s.generated_tokens
+            agg.prefill_tokens += s.prefill_tokens
+            agg.decode_seconds += s.decode_seconds
+            agg.prefill_seconds += s.prefill_seconds
+        return agg
+
+    def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
+                 temperature: float = 0.0,
+                 stop: list[str] | None = None) -> list[str]:
+        if not prompts:
+            return []
+        shards = [prompts[r::self.dp_size] for r in range(self.dp_size)]
+
+        def run(arg):
+            replica, shard = arg
+            if not shard:
+                return []
+            return replica.generate(shard, max_new_tokens=max_new_tokens,
+                                    temperature=temperature, stop=stop)
+
+        results = list(self._pool.map(run, zip(self.replicas, shards)))
+        out: list[str] = [""] * len(prompts)
+        for r, shard_out in enumerate(results):
+            for j, text in enumerate(shard_out):
+                out[r + j * self.dp_size] = text
+        return out
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        for rep in self.replicas:
+            rep.close()
+        self.replicas = []

@@ -37,7 +37,7 @@ class ModelConfig:
     mlp_gated: bool = True          # starcoder2: plain GELU MLP (c_fc/c_proj)
     attention_bias: bool = False    # starcoder2 uses biases on qkv/o
     mlp_bias: bool = False
-    sliding_window: int | None = None  # mistral/starcoder2 (ignored ≤4k ctx)
+    sliding_window: int | None = None  # mistral/starcoder2: attend last W keys
     hidden_act: str = "silu"
     dtype: str = "bfloat16"
 

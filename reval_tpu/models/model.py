@@ -130,7 +130,7 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
         k = apply_rope(k, cos, sin)
         new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
-        attn = prefill_attention(q, k, v, pad_len)
+        attn = prefill_attention(q, k, v, pad_len, window=cfg.sliding_window)
         h = h + _out_proj(attn, layer, cfg)
         normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
         h = h + _mlp(normed, layer, cfg)
@@ -170,7 +170,8 @@ def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
         k = apply_rope(k, cos, sin)
         new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
-        attn = context_prefill_attention(q, k, v, ctx_k, ctx_v, pad_len)
+        attn = context_prefill_attention(q, k, v, ctx_k, ctx_v, pad_len,
+                                         window=cfg.sliding_window)
         h = h + _out_proj(attn, layer, cfg)
         normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
         h = h + _mlp(normed, layer, cfg)
@@ -201,7 +202,8 @@ def decode_step(params, cfg: ModelConfig, token: jnp.ndarray, pad_len: jnp.ndarr
         k = apply_rope(k, cos, sin)
         new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, cur_pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, cur_pos, 0, 0))
-        attn = decode_attention(q, new_k, new_v, pad_len, cur_pos)
+        attn = decode_attention(q, new_k, new_v, pad_len, cur_pos,
+                                window=cfg.sliding_window)
         h = h + _out_proj(attn, layer, cfg)
         normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
         h = h + _mlp(normed, layer, cfg)

@@ -81,7 +81,8 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
         k_slot = k_slot.at[:, write_page, write_off].set(k_new)
         v_slot = v_slot.at[:, write_page, write_off].set(v_new)
         attn = paged_decode_attention(
-            q[:, 0], k_slot, v_slot, block_tables, attn_lens, page_size=page)
+            q[:, 0], k_slot, v_slot, block_tables, attn_lens, page_size=page,
+            window=cfg.sliding_window)
         h = h + _out_proj(attn[:, None], layer, cfg)
         normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
         h = h + _mlp(normed, layer, cfg)

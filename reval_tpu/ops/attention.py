@@ -32,11 +32,15 @@ def _group_queries(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
 
 
 def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      pad_len: jnp.ndarray, scale: float | None = None) -> jnp.ndarray:
+                      pad_len: jnp.ndarray, scale: float | None = None,
+                      window: int | None = None) -> jnp.ndarray:
     """Causal self-attention over one left-padded prefill block.
 
     q: [B, T, H, D]; k, v: [B, T, H_kv, D]; pad_len: [B] int32.
-    Returns [B, T, H, D].
+    ``window``: sliding-window size (Mistral/StarCoder2) — a query attends
+    only the most recent ``window`` keys, itself included; None = full
+    causal.  Buffer-position distance equals logical distance because both
+    ends share the row's pad offset.  Returns [B, T, H, D].
     """
     b, t, h, d = q.shape
     n_kv = k.shape[2]
@@ -49,6 +53,8 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     rows = jnp.arange(t)[:, None]       # query positions
     cols = jnp.arange(t)[None, :]       # key positions
     causal = rows >= cols
+    if window is not None:
+        causal = causal & (rows - cols < window)
     valid_key = cols >= pad_len[:, None, None, None, None]
     mask = causal[None, None, None, :, :] & valid_key
     scores = jnp.where(mask, scores, _NEG_INF)
@@ -61,7 +67,8 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               ctx_k: jnp.ndarray, ctx_v: jnp.ndarray,
                               pad_len: jnp.ndarray,
-                              scale: float | None = None) -> jnp.ndarray:
+                              scale: float | None = None,
+                              window: int | None = None) -> jnp.ndarray:
     """Causal attention for a suffix block that follows a shared context.
 
     The shared-prefix prefill path: ``ctx_k``/``ctx_v`` ([1, Tc, H_kv, D],
@@ -69,6 +76,8 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     every row; q/k/v ([B, T(_kv), …]) are the left-padded per-row suffixes
     whose sequence positions start at Tc.  Every suffix query attends to
     the whole context plus the causal/unpadded part of its own suffix.
+    ``window`` masks keys more than ``window-1`` logical positions behind
+    the query (suffix queries sit at logical ``Tc + i - pad``).
     """
     b, t, h, d = q.shape
     n_kv = k.shape[2]
@@ -85,8 +94,15 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     in_ctx = cols < tc
     causal = rows + tc >= cols                 # suffix key j valid if j-tc <= i
     valid_suffix = cols - tc >= pad_len[:, None, None, None, None]
-    mask = in_ctx[None, None, None, :, :] | (
-        causal[None, None, None, :, :] & valid_suffix)
+    in_ctx = in_ctx[None, None, None, :, :]
+    causal = causal[None, None, None, :, :]
+    if window is not None:
+        # suffix↔suffix distance is pad-invariant (rows - (cols - tc));
+        # ctx keys sit at logical cols, queries at tc + (rows - pad)
+        causal = causal & (rows - (cols - tc) < window)[None, None, None, :, :]
+        q_logical = tc + rows - pad_len[:, None, None, None, None]
+        in_ctx = in_ctx & (q_logical - cols < window)
+    mask = in_ctx | (causal & valid_suffix)
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
@@ -96,12 +112,14 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                      pad_len: jnp.ndarray, cur_pos: jnp.ndarray,
-                     scale: float | None = None) -> jnp.ndarray:
+                     scale: float | None = None,
+                     window: int | None = None) -> jnp.ndarray:
     """One-token attention against the cache.
 
     q: [B, 1, H, D]; caches: [B, S, H_kv, D]; pad_len: [B]; cur_pos: scalar
     (the position just written, shared across the batch).  Keys are valid in
-    ``[pad_len[b], cur_pos]``.  Returns [B, 1, H, D].
+    ``[pad_len[b], cur_pos]``, windowed to the most recent ``window`` when
+    set.  Returns [B, 1, H, D].
     """
     b, _, h, d = q.shape
     s = k_cache.shape[1]
@@ -113,6 +131,8 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     scores = jnp.einsum("bqngd,bsnd->bngqs", qg, kf) * scale  # [B, N, G, 1, S]
     cols = jnp.arange(s)
     valid = (cols[None, :] >= pad_len[:, None]) & (cols[None, :] <= cur_pos)
+    if window is not None:
+        valid = valid & (cur_pos - cols[None, :] < window)
     scores = jnp.where(valid[:, None, None, None, :], scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

@@ -48,7 +48,7 @@ _NEG_INF = -1e30
 
 def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
                    o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                   scale: float, max_pages: int):
+                   scale: float, max_pages: int, window: int | None):
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -60,7 +60,15 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
 
     seq_len = seq_lens_ref[b]
 
-    @pl.when(p * page_size < seq_len)
+    # sliding window: the query (logical position seq_len-1) sees keys in
+    # [seq_len - window, seq_len); pages wholly before that are skipped —
+    # compute for old pages costs nothing extra, and the window page set
+    # is what bounds effective attention length for Mistral/StarCoder2
+    live = p * page_size < seq_len
+    if window is not None:
+        live = live & ((p + 1) * page_size > seq_len - window)
+
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
         k = k_ref[0, 0].astype(jnp.float32)          # [P, D]
@@ -70,7 +78,11 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(p * page_size + cols < seq_len, s, _NEG_INF)
+        pos = p * page_size + cols
+        valid = pos < seq_len
+        if window is not None:
+            valid = valid & (pos >= seq_len - window)
+        s = jnp.where(valid, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                         # [G, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)    # [G, 1]
@@ -89,15 +101,17 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("page_size", "scale", "interpret"))
+    jax.jit, static_argnames=("page_size", "scale", "interpret", "window"))
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                                   *, page_size: int, scale: float | None = None,
-                                  interpret: bool = False):
+                                  interpret: bool = False,
+                                  window: int | None = None):
     """One-token attention against a paged KV cache (Pallas TPU kernel).
 
     q: [B, H, D]; k_pages/v_pages: [H_kv, N_pages, P, D];
     block_tables: [B, max_pages] int32; seq_lens: [B] int32 (≥1).
-    Returns [B, H, D].
+    ``window``: sliding-window size (static; per-model constant) — only
+    the most recent ``window`` keys participate.  Returns [B, H, D].
     """
     b, h, d = q.shape
     h_kv = k_pages.shape[0]
@@ -125,7 +139,8 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
         ],
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
-                               scale=scale, max_pages=max_pages)
+                               scale=scale, max_pages=max_pages,
+                               window=window)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -138,7 +153,8 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
 
 
 def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
-                               *, page_size: int, scale: float | None = None):
+                               *, page_size: int, scale: float | None = None,
+                               window: int | None = None):
     """Portable XLA reference for :func:`paged_decode_attention_pallas`.
 
     Gathers each sequence's pages into a contiguous view and runs masked
@@ -159,7 +175,10 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
     kf = k_seq.astype(jnp.float32)
     vf = v_seq.astype(jnp.float32)
     scores = jnp.einsum("bngd,bsnd->bngs", qg, kf) * scale
-    valid = jnp.arange(s_max)[None, :] < seq_lens[:, None]
+    pos = jnp.arange(s_max)[None, :]
+    valid = pos < seq_lens[:, None]
+    if window is not None:
+        valid = valid & (pos >= seq_lens[:, None] - window)
     scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
@@ -168,7 +187,8 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                           *, page_size: int, scale: float | None = None):
+                           *, page_size: int, scale: float | None = None,
+                           window: int | None = None):
     """Backend-dispatching paged decode attention: Pallas on TPU, XLA
     elsewhere (same numerics; the kernel is tested against the XLA path).
 
@@ -183,7 +203,7 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     if use_pallas:
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, block_tables, seq_lens,
-            page_size=page_size, scale=scale)
+            page_size=page_size, scale=scale, window=window)
     return paged_decode_attention_xla(
         q, k_pages, v_pages, block_tables, seq_lens,
-        page_size=page_size, scale=scale)
+        page_size=page_size, scale=scale, window=window)

@@ -187,3 +187,80 @@ class TestStarcoder2Parity:
         ours = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
         theirs = hf_logits(model, tokens)
         np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-3)
+
+
+class TestSlidingWindowParity:
+    """Sliding-window attention (Mistral/StarCoder2): with window < seq_len
+    our logits must match HF's, which masks keys older than the window
+    (verdict round-1 item 6: the config flag was parsed but ignored)."""
+
+    def _mistral(self, tmp_path, window):
+        import torch
+        from transformers import MistralConfig, MistralForCausalLM
+
+        from reval_tpu.models import load_checkpoint
+
+        torch.manual_seed(5)
+        cfg_hf = MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512, sliding_window=window,
+            attn_implementation="eager",
+        )
+        model = MistralForCausalLM(cfg_hf).eval()
+        path = tmp_path / f"tiny-mistral-swa{window}"
+        model.save_pretrained(path, safe_serialization=True)
+        params, cfg = load_checkpoint(path, dtype="float32")
+        assert cfg.sliding_window == window
+        return model, params, cfg
+
+    def test_prefill_logits_match_hf(self, tmp_path):
+        from reval_tpu.models import logits_for_tokens
+
+        model, params, cfg = self._mistral(tmp_path, window=8)
+        tokens = np.random.default_rng(7).integers(0, 255, size=(2, 24))
+        ours = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+        theirs = hf_logits(model, tokens)
+        np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+    def test_window_actually_masks(self, tmp_path):
+        """Same prompt, window on vs off, seq_len > window → logits differ
+        (guards against the flag silently reverting to full attention)."""
+        from reval_tpu.models import load_checkpoint, logits_for_tokens
+
+        model, params, cfg = self._mistral(tmp_path, window=8)
+        tokens = np.random.default_rng(9).integers(0, 255, size=(1, 24))
+        with_window = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+        import dataclasses
+
+        cfg_full = dataclasses.replace(cfg, sliding_window=None)
+        full = np.asarray(logits_for_tokens(params, cfg_full, jnp.asarray(tokens)))
+        # early positions (inside the window) identical, late ones differ
+        np.testing.assert_allclose(with_window[:, :8], full[:, :8], atol=1e-5)
+        assert not np.allclose(with_window[:, -1], full[:, -1], atol=1e-4)
+
+    def test_decode_matches_prefill_with_window(self, tmp_path):
+        """Token-by-token decode through the windowed cache must agree with
+        the windowed prefill logits at every position."""
+        import jax
+
+        from reval_tpu.models import (
+            decode_step, init_kv_cache, logits_for_tokens, prefill,
+        )
+
+        _, params, cfg = self._mistral(tmp_path, window=8)
+        tokens = np.random.default_rng(11).integers(0, 255, size=(1, 20))
+        ref = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+
+        t0 = 4                                    # prefill 4, decode the rest
+        cache = init_kv_cache(cfg, 1, 32, dtype=params["embed"].dtype)
+        pad = jnp.zeros(1, jnp.int32)
+        logits, cache = prefill(params, cfg, jnp.asarray(tokens[:, :t0]), pad, cache)
+        got = [np.asarray(logits)[:, -1]]
+        for pos in range(t0, tokens.shape[1]):
+            step_logits, cache = decode_step(
+                params, cfg, jnp.asarray(tokens[:, pos:pos + 1]), pad,
+                cache, jnp.int32(pos))
+            got.append(np.asarray(step_logits))
+        for i, g in enumerate(got[:-1]):          # got[i] predicts pos t0+i
+            np.testing.assert_allclose(g, ref[:, t0 - 1 + i], atol=2e-4, rtol=2e-3)

@@ -92,3 +92,59 @@ def test_padding_pages_never_leak():
                                           page_size=PAGE, interpret=True)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(base),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [1, 64, 200, 1000])
+def test_windowed_pallas_matches_xla(window):
+    q, kp, vp, tables, lens = make_paged(seed=4)
+    ref = paged_decode_attention_xla(q, kp, vp, tables, lens,
+                                     page_size=PAGE, window=window)
+    out = paged_decode_attention_pallas(q, kp, vp, tables, lens,
+                                        page_size=PAGE, interpret=True,
+                                        window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_windowed_xla_matches_contiguous_decode():
+    """Windowed paged attention vs the contiguous-cache decode_attention
+    (itself HF-parity-tested): gather each sequence into a dense cache and
+    compare, window smaller than the live length."""
+    window = 100
+    q, kp, vp, tables, lens = make_paged(seed=5)
+    got = paged_decode_attention_xla(q, kp, vp, tables, lens,
+                                     page_size=PAGE, window=window)
+    b, h, d = q.shape
+    h_kv = kp.shape[0]
+    s_max = tables.shape[1] * PAGE
+    k_seq = kp[:, tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
+    v_seq = vp[:, tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
+    for row in range(b):
+        cur = int(lens[row]) - 1                  # query's own position
+        ref = decode_attention(
+            q[row:row + 1, None], k_seq[row:row + 1], v_seq[row:row + 1],
+            pad_len=jnp.zeros(1, jnp.int32), cur_pos=jnp.int32(cur),
+            window=window)
+        np.testing.assert_allclose(np.asarray(got[row]),
+                                   np.asarray(ref[0, 0]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_window_excludes_old_keys():
+    """Corrupting keys OUTSIDE the window must not change the output;
+    corrupting keys INSIDE it must."""
+    window = 96
+    q, kp, vp, tables, lens = make_paged(seed=6, b=1, max_pages=3)
+    lens = jnp.asarray([3 * PAGE - 5], jnp.int32)   # long seq, window ≪ len
+    base = paged_decode_attention_xla(q, kp, vp, tables, lens,
+                                      page_size=PAGE, window=window)
+    first_page = int(tables[0, 0])
+    kp_bad = kp.at[:, first_page].set(1e3)          # far outside the window
+    out = paged_decode_attention_xla(q, kp_bad, vp, tables, lens,
+                                     page_size=PAGE, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base))
+    last_page = int(tables[0, 2])
+    kp_bad = kp.at[:, last_page].set(1e3)           # inside the window
+    out = paged_decode_attention_xla(q, kp_bad, vp, tables, lens,
+                                     page_size=PAGE, window=window)
+    assert not np.allclose(np.asarray(out), np.asarray(base))
