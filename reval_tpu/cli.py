@@ -186,6 +186,37 @@ def run_tot_oracle(argv: list[str]) -> int:
     return 0
 
 
+def run_tot_generate(argv: list[str]) -> int:
+    """Model-driven trace dumps: the backend simulates execution in the
+    trace grammar; generations become dumps the tot scoring run consumes
+    (the loop the reference left to an external harness)."""
+    from .inference.base import create_backend
+    from .tot import generate_trace_dumps
+
+    parser = argparse.ArgumentParser(
+        prog="reval_tpu tot-generate",
+        description="Generate ToT trace dumps from a model")
+    parser.add_argument("-i", "--input", default=DEFAULT_CONFIG,
+                        help="backend config file (model_id/model_path/…)")
+    parser.add_argument("--dataset", default="humaneval",
+                        choices=["humaneval", "classeval", "mbpp", "mathqa"])
+    parser.add_argument("--base-dir", required=True)
+    parser.add_argument("--run-name", default=None,
+                        help="default: <model_id>_trace")
+    parser.add_argument("--max-items", type=int, default=None)
+    args = parser.parse_args(argv)
+    with open(args.input) as f:
+        cfg = json.load(f)
+    # traces are long: use the CoT budget unless the config overrides it
+    cfg.setdefault("max_new_tokens", 1024)
+    backend = create_backend(**cfg)
+    run_name = args.run_name or f"{cfg.get('model_id', 'model')}_trace".replace("/", "_")
+    n = generate_trace_dumps(backend, args.dataset, args.base_dir, run_name,
+                             max_items=args.max_items)
+    print(f"wrote {n} model trace dumps under {args.base_dir}/{run_name}/{args.dataset}")
+    return 0
+
+
 def run_fleet(argv: list[str]) -> int:
     """All four tasks × repeats on one resident model, then consistency
     (replaces the reference's subprocess fleet, batch_run.py)."""
@@ -329,6 +360,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_taskgen(argv[1:])
     if argv and argv[0] == "tot-oracle":
         return run_tot_oracle(argv[1:])
+    if argv and argv[0] == "tot-generate":
+        return run_tot_generate(argv[1:])
 
     parser = argparse.ArgumentParser(prog="reval_tpu",
                                      description="Run DREval tasks with TPU-native inference")

@@ -76,15 +76,18 @@ class EngineServer:
                     stop = req.get("stop") or []
                     if isinstance(stop, str):
                         stop = [stop]
+                    max_tokens = int(req.get("max_tokens", 256))
+                    temperature = float(req.get("temperature", 0.0))
+                except Exception as exc:        # malformed request → client error
+                    self._send(400, {"error": str(exc)})
+                    return
+                try:
                     with outer._lock:
                         texts = outer.generate_fn(
-                            prompts,
-                            max_tokens=int(req.get("max_tokens", 256)),
-                            temperature=float(req.get("temperature", 0.0)),
-                            stop=stop,
-                        )
-                except Exception as exc:  # protocol error -> 400, not a crash
-                    self._send(400, {"error": str(exc)})
+                            prompts, max_tokens=max_tokens,
+                            temperature=temperature, stop=stop)
+                except Exception as exc:        # engine/device fault → server error
+                    self._send(500, {"error": str(exc)})
                     return
                 self._send(200, {
                     "object": "text_completion",

@@ -6,7 +6,10 @@ item's namespace, after the anti-cheat penalty screen.
 Divergence from the reference (documented): for MBPP/MathQA the reference
 filled the prompt's invocation slot with the call expression instead of the
 ``?? `` assert (evaluation.py:187-194 + 973-974), producing prompts without
-a question; here the output prediction always goes in the prompt.
+a question; here the output prediction always goes in the prompt.  Pass
+``reference_compat=True`` (config key) to restore the reference's prompts
+byte-for-byte on those splits — required when comparing output-task
+accuracies against reference-produced numbers.
 """
 
 from __future__ import annotations
@@ -23,8 +26,9 @@ CLASSEVAL_PRELUDE = "\n# Test code starts here. Only write the completed test co
 class OutputTask(TaskRunner):
     name = "output"
 
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, reference_compat: bool = False, **kwargs):
         super().__init__(*args, **kwargs)
+        self.reference_compat = bool(reference_compat)
         self._total = 0
         self._pass = 0
 
@@ -36,7 +40,13 @@ class OutputTask(TaskRunner):
     def plan_function_pair(self, *, idx, fam, pair, space, entry, code, codelines,
                            sandbox, invocation, task_idx, gen_entry, jobs):
         _input = pair["output_pred"]
-        prompt = build_prompt("output", self.prompt_type, code=code, invocation="\n" + _input)
+        shown = _input
+        if self.reference_compat and fam in ("mbpp", "mathqa"):
+            # reference prompts on these splits carry the bare call
+            # expression, not the ??-assert (question-free, but what the
+            # reference's committed accuracies were measured on)
+            shown = invocation
+        prompt = build_prompt("output", self.prompt_type, code=code, invocation="\n" + shown)
         jobs.append(ProbeJob(gen_entry=gen_entry, prompt=prompt,
                              context={"space": space, "_input": _input, "kind": "function"}))
 

@@ -23,7 +23,12 @@ from .format import (
     trace_dump_path,
     write_trace_dump,
 )
-from .oracle import write_oracle_dumps
+from .generate import (
+    build_trace_prompt,
+    generate_trace_dumps,
+    parse_trace_generation,
+)
+from .oracle import capture_pairs, write_oracle_dumps
 from .parser import (
     EmptyAnswerError,
     TraceOfThoughtsParser,
@@ -34,7 +39,11 @@ __all__ = [
     "EmptyAnswerError",
     "TraceOfThoughtsParser",
     "ValidationError",
+    "build_trace_prompt",
+    "capture_pairs",
     "format_value",
+    "generate_trace_dumps",
+    "parse_trace_generation",
     "read_dump",
     "trace_dump_path",
     "write_oracle_dumps",

@@ -61,10 +61,14 @@ def write_trace_dump(
     trace=None,
     steps: list[dict] | None = None,
     with_labels: bool = True,
+    end_return: object = ...,
 ) -> Path:
     """Write one dump.  ``trace`` (an ExecutionTrace) supplies ground-truth
     steps/labels; ``steps`` overrides the model-side steps (tests use this
-    to simulate an imperfect model while keeping truthful labels)."""
+    to simulate an imperfect model while keeping truthful labels);
+    ``end_return`` overrides the end record's return value (model dumps
+    record the MODEL's claimed return, not the truth's) — default keeps
+    the trace-derived value."""
     path = trace_dump_path(base_dir, run_name, dataset, task_idx, input_idx)
     path.parent.mkdir(parents=True, exist_ok=True)
 
@@ -95,6 +99,8 @@ def write_trace_dump(
                 except Exception:
                     ret_value = None
     model_steps = steps if steps is not None else truth_steps
+    if end_return is not ...:
+        ret_value = end_return
 
     with open(path, "w") as f:
         f.write(json.dumps({

@@ -1,0 +1,172 @@
+"""Model-driven trace dumps: prompt → generation → parsed dump → scoring.
+
+Closes the loop the reference never shipped.  Its trace-of-thoughts mode
+expects dumps from an external tracing harness (a `custom-trepan` checkout
+on PYTHONPATH, reference cmdlines/evaluation_sbatch.sh:10-11, with the
+parser module absent from the snapshot — SURVEY §2.25).  Here the model
+ITSELF produces the trace: a constrained prompt asks it to simulate
+execution step by step in a line grammar, the generation is parsed into
+the dump schema (tot/format.py), ground-truth labels are attached from the
+tracer, and the standard two-phase tot scoring (``TaskRunner.run_tot``)
+consumes the dumps — engine output to tot metrics with no oracle anywhere.
+
+The grammar (one line per executed source line, `` || ``-separated values
+so reprs may contain commas):
+
+    step <n>: line <L> || <var> = <repr>; <type> || ...
+    ...
+    return <repr>; <type>
+    [/TRACE]
+
+Values follow pre-line semantics — the variable bindings on ARRIVAL at
+the line — matching the ground-truth tracer (reference dynamics.py:94-135).
+"""
+
+from __future__ import annotations
+
+import re
+
+from .format import write_trace_dump
+from .oracle import capture_pairs
+
+__all__ = ["build_trace_prompt", "parse_trace_generation",
+           "generate_trace_dumps", "render_trace_text"]
+
+TRACE_STOP = "[/TRACE]"
+
+_INSTRUCTIONS = """\
+You are an expert at Python programming. Simulate the execution of the \
+program below on the given invocation, step by step. Emit one line per \
+executed source line, IN EXECUTION ORDER, using exactly this format:
+
+step <n>: line <lineno> || <name> = <repr>; <type> || ...
+
+where <lineno> is the 1-indexed source line about to execute and the \
+value list shows every local variable ON ARRIVAL at that line (before it \
+runs). Render values as Python reprs followed by `; ` and the type name. \
+After the last step, emit `return <repr>; <type>` with the function's \
+return value, then `[/TRACE]`.
+
+Example:
+[PYTHON]
+1\tdef add_one(x):
+2\t    y = x + 1
+3\t    return y
+[/PYTHON]
+The invocation: add_one(4)
+[TRACE]
+step 0: line 2 || x = 4; int
+step 1: line 3 || x = 4; int || y = 5; int
+return 5; int
+[/TRACE]
+
+Now simulate this program:
+[PYTHON]
+{code}[/PYTHON]
+The invocation: {invocation}
+[TRACE]
+"""
+
+_STEP_RE = re.compile(r"step\s+(\d+)\s*:\s*line\s+(\d+)\s*(.*)")
+
+
+def build_trace_prompt(code: str, invocation: str) -> str:
+    numbered = "".join(f"{i + 1}\t{line}\n"
+                       for i, line in enumerate(code.split("\n")))
+    return _INSTRUCTIONS.format(code=numbered, invocation=invocation)
+
+
+def parse_trace_generation(text: str) -> tuple[list[dict], str | None]:
+    """Generation text → (steps, return value) in the dump step schema.
+
+    Tolerant by design: unparseable lines are skipped (a malformed trace
+    becomes a short/empty dump, which the two-phase protocol then scores
+    as invalid/empty — the reference's error taxonomy, not a crash)."""
+    if "[TRACE]" in text:
+        text = text.split("[TRACE]", 1)[1]
+    text = text.split(TRACE_STOP, 1)[0]
+    steps: list[dict] = []
+    ret: str | None = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        m = _STEP_RE.match(line)
+        if m:
+            values: dict[str, str] = {}
+            for pair in m.group(3).split("||"):
+                pair = pair.strip(" |")
+                if "=" not in pair:
+                    continue
+                name, _, value = pair.partition("=")
+                if name.strip():
+                    values[name.strip()] = value.strip()
+            steps.append({"lineno": int(m.group(2)), "values": values})
+        elif line.startswith("return ") and ret is None:
+            ret = line[len("return "):].strip() or None
+    return steps, ret
+
+
+def render_trace_text(trace) -> str:
+    """ExecutionTrace → grammar text (what a perfect model would emit).
+    Used by tests to drive the FULL text path without an oracle dump."""
+    from .format import format_value
+
+    lines = []
+    ret = None
+    from ..dynamics import Nil
+
+    for n, state in enumerate(trace):
+        values = []
+        for name, value in state.locals.items():
+            try:
+                values.append(f"{name} = {format_value(value)}")
+            except Exception:
+                continue
+            if name == "self":
+                for attr, av in getattr(value, "__dict__", {}).items():
+                    try:
+                        values.append(f"self.{attr} = {format_value(av)}")
+                    except Exception:
+                        continue
+        lines.append(f"step {n}: line {state.lineno + 1} || " + " || ".join(values))
+        if state.return_value is not Nil:
+            try:
+                ret = format_value(state.return_value)
+            except Exception:
+                ret = None
+    lines.append(f"return {ret if ret is not None else 'None; NoneType'}")
+    lines.append(TRACE_STOP)
+    return "\n".join(lines)
+
+
+def generate_trace_dumps(backend, dataset: str, base_dir: str, run_name: str,
+                         *, split: str | None = None,
+                         max_items: int | None = None,
+                         sandbox_timeout: float = 120.0,
+                         progress: bool = True) -> int:
+    """Drive ``backend`` over every (task, input) pair: trace prompt →
+    generation → parsed dump with ground-truth labels.  Returns the dump
+    count; score with a ``prompt_type="tot"`` task run over the same
+    base_dir/run_name."""
+    pairs = capture_pairs(dataset, split=split, max_items=max_items,
+                          sandbox_timeout=sandbox_timeout)
+    keys = list(pairs)
+    prompts = [build_trace_prompt(pairs[k][0], pairs[k][1]) for k in keys]
+    if progress:
+        print(f"[tot-generate] {len(prompts)} trace prompts → backend")
+    # trace generations stop at [/TRACE], not the QA tasks' [/ANSWER]
+    saved_stop = backend.config.stop
+    backend.config.stop = [TRACE_STOP]
+    try:
+        gens = backend.infer_many(prompts)
+    finally:
+        backend.config.stop = saved_stop
+    for key, gen in zip(keys, gens):
+        code, invocation, trace = pairs[key]
+        steps, ret = parse_trace_generation(gen)
+        write_trace_dump(base_dir, run_name, dataset, key[0], key[1],
+                         code=code, invocation=invocation, trace=trace,
+                         steps=steps, with_labels=True, end_return=ret)
+    if progress:
+        print(f"[tot-generate] wrote {len(keys)} dumps under "
+              f"{base_dir}/{run_name}/{dataset}")
+    return len(keys)

@@ -14,18 +14,19 @@ from __future__ import annotations
 
 from .format import write_trace_dump
 
-__all__ = ["write_oracle_dumps"]
+__all__ = ["write_oracle_dumps", "capture_pairs"]
 
 
-def write_oracle_dumps(dataset: str, base_dir: str, run_name: str, *,
-                       split: str | None = None, max_items: int | None = None,
-                       sandbox_timeout: float = 120.0) -> int:
-    """Write one dump per (task, input) pair of ``dataset``; returns count."""
+def capture_pairs(dataset: str, *, split: str | None = None,
+                  max_items: int | None = None,
+                  sandbox_timeout: float = 120.0) -> dict[tuple, tuple]:
+    """{(task_idx, input_idx): (code, invocation, ExecutionTrace)} for every
+    benchmark pair — planned by the REAL task planner, so keys, invocation
+    strings, and code bodies match what ``run_tot`` will look up exactly.
+    Shared by the oracle writer and the model-driven generator."""
     from ..tasks.coverage import CoverageTask
 
     class _DumpPlanner(CoverageTask):
-        """Planner that captures (key, code, invocation, trace) per pair."""
-
         def __init__(self):
             super().__init__(prompt_type="direct", dataset=dataset, split=split,
                              mock=True, progress=False, max_items=max_items,
@@ -39,8 +40,17 @@ def write_oracle_dumps(dataset: str, base_dir: str, run_name: str, *,
 
     planner = _DumpPlanner()
     planner._plan()
-    for (task_idx, input_idx), (code, invocation, trace) in planner.captured.items():
+    return planner.captured
+
+
+def write_oracle_dumps(dataset: str, base_dir: str, run_name: str, *,
+                       split: str | None = None, max_items: int | None = None,
+                       sandbox_timeout: float = 120.0) -> int:
+    """Write one dump per (task, input) pair of ``dataset``; returns count."""
+    pairs = capture_pairs(dataset, split=split, max_items=max_items,
+                          sandbox_timeout=sandbox_timeout)
+    for (task_idx, input_idx), (code, invocation, trace) in pairs.items():
         write_trace_dump(base_dir, run_name, dataset, task_idx, input_idx,
                          code=code, invocation=invocation, trace=trace,
                          with_labels=True)
-    return len(planner.captured)
+    return len(pairs)

@@ -97,3 +97,31 @@ def test_real_engine_behind_server():
     finally:
         server.shutdown()
     assert served == direct
+
+
+def test_engine_fault_returns_500():
+    """Internal generate failures are server errors (500), not client
+    errors — only malformed requests get 400 (advisor finding)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from reval_tpu.serving.server import EngineServer
+
+    def boom(prompts, **kw):
+        raise RuntimeError("device fell over")
+
+    srv = EngineServer(boom, model_id="m", port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=_json.dumps({"prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert "device fell over" in e.read().decode()
+    finally:
+        srv.shutdown()

@@ -240,3 +240,23 @@ class TestMbppMathqa:
                          results_dir=str(tmp_path), max_items=2, progress=False)
         metrics = task.run()
         assert metrics["total"] > 0
+
+
+def test_output_reference_compat_prompts():
+    """reference_compat restores the reference's MBPP output prompts (bare
+    invocation, no ??-assert) for strict accuracy comparability."""
+    from reval_tpu.tasks import OutputTask
+
+    def question(prompt):                         # text after the few-shot
+        return prompt.rsplit("[PYTHON]", 1)[1]
+
+    ours = OutputTask(prompt_type="direct", dataset="mbpp", mock=True,
+                      max_items=1, progress=False)
+    _, jobs = ours._plan()
+    assert "??" in question(jobs[0].prompt)       # default: the real question
+
+    compat = OutputTask(prompt_type="direct", dataset="mbpp", mock=True,
+                        max_items=1, progress=False, reference_compat=True)
+    _, cjobs = compat._plan()
+    assert "??" not in question(cjobs[0].prompt)  # reference: bare invocation
+    assert len(cjobs) == len(jobs)

@@ -249,3 +249,193 @@ def test_output_task_rejects_tot(tmp_path):
     with pytest.raises(AssertionError):
         TASKS["output"](prompt_type="tot", dataset="humaneval",
                         model_id="m", tot_base_dir=str(tmp_path), tot_run_name="x")
+
+
+# ---------------------------------------------------------------------------
+# model-driven generation (tot-generate: prompt → text → dump → score)
+# ---------------------------------------------------------------------------
+
+class TestTraceGeneration:
+    def test_parse_well_formed(self):
+        from reval_tpu.tot import parse_trace_generation
+
+        text = ("step 0: line 2 || x = 5; int\n"
+                "step 1: line 3 || x = 5; int || y = [1, 2]; list\n"
+                "return 6; int\n[/TRACE]\nnoise after stop")
+        steps, ret = parse_trace_generation(text)
+        assert [s["lineno"] for s in steps] == [2, 3]
+        assert steps[1]["values"]["y"] == "[1, 2]; list"   # comma survives
+        assert ret == "6; int"
+
+    def test_parse_tolerates_garbage(self):
+        from reval_tpu.tot import parse_trace_generation
+
+        steps, ret = parse_trace_generation(
+            "I think the program runs like this:\n"
+            "step 0: line 2 || x = 5; int\n"
+            "??? nonsense line\n"
+            "step not-a-number: line 9\n"
+            "step 1: line 5 || = orphan; str || y = 6; int\n")
+        assert [s["lineno"] for s in steps] == [2, 5]
+        assert steps[1]["values"] == {"y": "6; int"}
+        assert ret is None
+
+    def test_parse_empty_generation(self):
+        from reval_tpu.tot import parse_trace_generation
+
+        steps, ret = parse_trace_generation("The answer is YES")
+        assert steps == [] and ret is None
+
+    def test_prompt_round_trip_through_grammar(self):
+        """render_trace_text (a perfect model's output) must parse back to
+        the exact ground-truth line sequence and values."""
+        from reval_tpu.tot import parse_trace_generation
+        from reval_tpu.tot.generate import render_trace_text
+
+        trace = _trace(5)
+        steps, ret = parse_trace_generation(render_trace_text(trace))
+        assert [s["lineno"] for s in steps] == [st.lineno + 1 for st in trace]
+        assert ret == "60; int"
+        assert steps[1]["values"]["y"] == "6; int"
+
+
+class _ScriptedTraceBackend:
+    """A backend whose generations are real trace-grammar TEXT (perfect or
+    corrupted) — drives the full tot-generate path without any oracle dump
+    being written directly."""
+
+    def __init__(self, pairs, corrupt=False):
+        from reval_tpu.tot.generate import render_trace_text
+
+        self._texts = {}
+        for key, (code, invocation, trace) in pairs.items():
+            text = render_trace_text(trace)
+            if corrupt:
+                # model hallucinates: shift every simulated lineno by one
+                import re as _re
+
+                text = _re.sub(r"line (\d+)",
+                               lambda m: f"line {int(m.group(1)) + 1}", text)
+            self._texts[key] = text
+        self._queue = [self._texts[k] for k in pairs]
+
+    class config:                       # duck-typed GenerationConfig bits
+        stop = ["[/ANSWER]"]
+
+    def infer_many(self, prompts):
+        assert len(prompts) == len(self._queue)
+        assert all("[TRACE]" in p and "step <n>: line <lineno>" in p
+                   for p in prompts)
+        return list(self._queue)
+
+
+def test_tot_generate_end_to_end_scores_without_oracle(tmp_path):
+    """Engine-output text → parsed dumps → two-phase tot scoring.  A
+    perfect trace-producing model must validate every case and score 100%;
+    no oracle dump writer is involved anywhere."""
+    from reval_tpu.tasks import TASKS
+    from reval_tpu.tot import capture_pairs, generate_trace_dumps
+
+    pairs = capture_pairs("humaneval", max_items=2)
+    backend = _ScriptedTraceBackend(pairs)
+    n = generate_trace_dumps(backend, "humaneval", str(tmp_path / "dumps"),
+                             "model_trace", max_items=2, progress=False)
+    assert n == len(pairs) > 0
+    task = TASKS["coverage"](
+        prompt_type="tot", dataset="humaneval", max_items=2, progress=False,
+        model_id="scripted", results_dir=str(tmp_path / "gen"),
+        tot_base_dir=str(tmp_path / "dumps"), tot_run_name="model_trace")
+    metrics = task.run()
+    assert metrics["total"] > 0
+    assert metrics["acc"] == pytest.approx(1.0)
+
+
+def test_tot_generate_corrupted_model_still_scores(tmp_path):
+    """A model that hallucinates linenos: labels (ground truth) keep test
+    cases valid, the model channel answers wrongly → acc < 1, no crash."""
+    from reval_tpu.tasks import TASKS
+    from reval_tpu.tot import capture_pairs, generate_trace_dumps
+
+    pairs = capture_pairs("humaneval", max_items=2)
+    backend = _ScriptedTraceBackend(pairs, corrupt=True)
+    generate_trace_dumps(backend, "humaneval", str(tmp_path / "dumps"),
+                         "model_trace", max_items=2, progress=False)
+    task = TASKS["coverage"](
+        prompt_type="tot", dataset="humaneval", max_items=2, progress=False,
+        model_id="scripted", results_dir=str(tmp_path / "gen"),
+        tot_base_dir=str(tmp_path / "dumps"), tot_run_name="model_trace")
+    metrics = task.run()
+    assert metrics["total"] > 0
+    assert metrics["acc"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# adversarial dump fixtures (verdict round-1 weak item 6)
+# ---------------------------------------------------------------------------
+
+class TestAdversarialDumps:
+    def _write(self, tmp_path, mutate):
+        trace = _trace(5)
+        path = write_trace_dump(tmp_path, "run1", "humaneval", 0, 0,
+                                code=CODE, invocation="f(5)", trace=trace)
+        lines = path.read_text().splitlines()
+        path.write_text(mutate(lines))
+        return _parser(tmp_path)
+
+    def test_wrong_code_digest(self, tmp_path):
+        def mutate(lines):
+            h = json.loads(lines[0]); h["code_sha256"] = "deadbeef"
+            return "\n".join([json.dumps(h)] + lines[1:]) + "\n"
+        p = self._write(tmp_path, mutate)
+        with pytest.raises(ValidationError):
+            p.validate_task(0, 0, code=CODE, invocation="f(5)")
+
+    def test_truncated_mid_record(self, tmp_path):
+        def mutate(lines):
+            # cut the file inside a JSON record
+            return "\n".join(lines[:-2]) + '\n{"kind": "step", "st'
+        p = self._write(tmp_path, mutate)
+        with pytest.raises(ValidationError):
+            p.validate_task(0, 0, code=CODE, invocation="f(5)")
+
+    def test_garbage_values_dont_crash_state(self, tmp_path):
+        def mutate(lines):
+            out = []
+            for line in lines:
+                rec = json.loads(line)
+                if rec.get("kind") == "step":
+                    rec["values"] = {"y": "<<<not a repr", "x": 12345,
+                                     "": "empty-name"}
+                out.append(json.dumps(rec))
+            return "\n".join(out) + "\n"
+        p = self._write(tmp_path, mutate)
+        # model channel: garbage string comes back verbatim (scored wrong,
+        # not crashed); compound vars fail to eval → EmptyAnswerError
+        ans, _ = p.process_task(0, 0, "state", lineno=3, var="y",
+                                use_labels=False)
+        assert ans == "<<<not a repr"
+        with pytest.raises(EmptyAnswerError):
+            p.process_task(0, 0, "state", lineno=3, var="(y, x)",
+                           use_labels=False)
+
+    def test_missing_end_record(self, tmp_path):
+        def mutate(lines):
+            return "\n".join(l for l in lines
+                             if json.loads(l).get("kind") != "end") + "\n"
+        p = self._write(tmp_path, mutate)
+        ans, _ = p.process_task(0, 0, "coverage", lineno=2, use_labels=False)
+        assert ans is True
+
+    def test_non_integer_linenos_skipped(self, tmp_path):
+        def mutate(lines):
+            out = []
+            for line in lines:
+                rec = json.loads(line)
+                if rec.get("kind") == "step":
+                    rec["lineno"] = "four"
+                out.append(json.dumps(rec))
+            return "\n".join(out) + "\n"
+        p = self._write(tmp_path, mutate)
+        # schema violation → rejected at load (reader enforces int linenos)
+        with pytest.raises(ValidationError):
+            p.process_task(0, 0, "coverage", lineno=2, use_labels=False)
