@@ -55,7 +55,19 @@ from .tokenizer import HFTokenizer
 
 __all__ = ["PagedTPUEngine"]
 
-CHUNK = 8  # decode steps per host sync (stop-string check cadence)
+CHUNK = 32  # decode steps per host sync (stop-string check cadence)
+
+# First chunk after an admission wave is short: freshly admitted DREval
+# probes often answer in a handful of tokens ([ANSWER] NO [/ANSWER]), and a
+# short first chunk retires them ~CHUNK steps earlier.  Steady-state chunks
+# run at full CHUNK — per-chunk host work (RPC dispatch + the token
+# download) measured ~100 ms on the tunneled v5e, so fine-grained chunks
+# halve decode throughput (PERF.md).
+FIRST_CHUNK = 8
+
+
+def _floor_pow2(n: int) -> int:
+    return 1 << (max(1, n).bit_length() - 1)
 
 # Cap on rows × bucket-tokens per prefill call.  Prefill materialises a
 # contiguous [L, rows, T, H_kv, D] KV block before committing it to pages —
@@ -118,8 +130,8 @@ class PagedTPUEngine:
                                self.max_pages_per_seq)
         self.cache = init_paged_cache(cfg, self.num_pages, page_size, dtype=dtype)
         if self._cache_sharding is not None:
-            self.cache = type(self.cache)(
-                *(jax.device_put(c, self._cache_sharding) for c in self.cache))
+            self.cache = jax.tree.map(
+                lambda c: jax.device_put(c, self._cache_sharding), self.cache)
         self._jit_prefill = jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
         self._jit_prefill_ctx = jax.jit(
             partial(prefill_with_context, cfg=cfg, logits_mode="last"))
@@ -158,9 +170,22 @@ class PagedTPUEngine:
 
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
-    def _decode_chunk(params, first_token, block_tables, seq_lens, cache,
-                      temperature, key, *, cfg: ModelConfig, steps: int):
-        """``steps`` paged decode iterations for the whole slot batch."""
+    def _decode_chunk(params, state, cache, temperature, key,
+                      *, cfg: ModelConfig, steps: int):
+        """``steps`` paged decode iterations for the whole slot batch.
+
+        ``state`` packs the whole per-chunk loop state into ONE int32
+        array ``[B, span + 2]`` — block tables, then seq_lens, then the
+        pending input token — so a steady-state chunk needs no host→device
+        uploads at all: the previous chunk's returned state feeds the next
+        call as a device-resident array.  Per-upload RPC latency on the
+        tunneled TPU measured ~100 ms/chunk of avoidable host work
+        (PERF.md), which is why this is packed rather than three arrays.
+        """
+        span = state.shape[1] - 2
+        block_tables = state[:, :span]
+        seq_lens = state[:, span]
+        first_token = state[:, span + 1:]
 
         def body(carry, _):
             token, cache, lens, key = carry
@@ -170,9 +195,10 @@ class PagedTPUEngine:
             nxt = sample_token(logits, temperature, sub)
             return (nxt[:, None], cache, lens + 1, key), nxt
 
-        (last, cache, _, _), toks = jax.lax.scan(
+        (last, cache, lens, _), toks = jax.lax.scan(
             body, (first_token, cache, seq_lens, key), None, length=steps)
-        return toks.T, cache, last
+        new_state = jnp.concatenate([block_tables, lens[:, None], last], axis=1)
+        return toks.T, cache, new_state
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -280,10 +306,24 @@ class PagedTPUEngine:
 
     def _drive(self, reqs: dict[int, _Request], active: dict[int, int],
                slot_token: np.ndarray, temp) -> None:
-        """Admission/prefill/decode loop until every request is done."""
+        """Admission/prefill/decode loop until every request is done.
+
+        Loop state (tables, lens, pending token) lives ON DEVICE between
+        chunks as the packed array `_decode_chunk` returns; it is rebuilt
+        and re-uploaded only when the slot population changes (admission,
+        retirement, preemption) or the table span bucket grows.  A clean
+        steady-state chunk therefore costs one jit dispatch and one token
+        download — everything else rides device-resident state.
+        """
+        dev_state = None    # packed [B, span+2] device array, current iff not dirty
+        dirty = True
+        span = 0
+        since_admit = 0
         while True:
             admitted = self.rt.admit()
             if admitted:
+                dirty = True
+                since_admit = 0
                 firsts = self._prefill_admitted(admitted, reqs, temp)
                 for seq_id, slot in admitted:
                     req = reqs[seq_id]
@@ -294,43 +334,62 @@ class PagedTPUEngine:
                     active[slot] = seq_id
                     if self._finished(req, [firsts[slot]]):
                         self._retire(req, seq_id, slot, active)
+                        dirty = True
             if not active:
                 if any(not r.done for r in reqs.values()):
                     raise RuntimeError(
                         "paged scheduler deadlock: nothing running or admissible")
                 break
 
+            budget = min(reqs[s].max_new - len(reqs[s].generated)
+                         for s in active.values())
+            cap = FIRST_CHUNK if since_admit == 0 else CHUNK
+            steps = _floor_pow2(min(cap, budget))
+            since_admit += 1
+
             # every active sequence must have pages for the whole chunk
             # BEFORE the decode writes into them
-            steps = min(CHUNK, min(reqs[s].max_new - len(reqs[s].generated)
-                                   for s in active.values()))
-            self._reserve_chunk(active, reqs, steps)
+            before = dict(active)
+            if self._reserve_chunk(active, reqs, steps):
+                dirty = True                 # a block table gained a page
+            if active != before:
+                dirty = True                 # a preemption emptied slots
             if not active:
                 continue                     # everyone got preempted
 
-            tables = np.zeros((self.max_slots, self.max_pages_per_seq), np.int32)
             lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
             for slot, seq_id in active.items():
-                tables[slot] = self.rt.block_table(seq_id)
                 req = reqs[seq_id]
                 # materialised tokens = prompt + generated minus the pending
                 # input token (written during the chunk's first step)
                 lens[slot] = len(req.ids) + len(req.generated) - 1
             # the attention kernel walks every table column it is given —
             # slice to the pages this chunk can actually touch (pow2-bucketed
-            # so the shape set stays small), not the per-seq maximum
-            span = pow2_bucket(
+            # so the shape set stays small), not the per-seq maximum.  A
+            # sequence crossing into a fresh page re-uses a table entry the
+            # runtime filled at allocation time, and every entry within the
+            # span was uploaded when the slot population last changed — the
+            # table row only needs re-uploading when the span bucket grows.
+            new_span = pow2_bucket(
                 int((lens.max() + steps + self.page_size - 1) // self.page_size))
-            span = min(span, self.max_pages_per_seq)
+            new_span = min(new_span, self.max_pages_per_seq)
+            if new_span != span:
+                span = new_span
+                dirty = True
+            if dirty or dev_state is None:
+                tables = np.zeros((self.max_slots, span), np.int32)
+                for slot, seq_id in active.items():
+                    tables[slot] = self.rt.block_table(seq_id)[:span]
+                packed = np.concatenate(
+                    [tables, lens[:, None], slot_token.astype(np.int32)], axis=1)
+                dev_state = self._dev(jnp.asarray(packed))
+                dirty = False
             t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
-                toks, self.cache, last = self._jit_chunk(
-                    self.params, self._dev(jnp.asarray(slot_token)),
-                    self._dev(jnp.asarray(tables[:, :span])),
-                    self._dev(jnp.asarray(lens)),
-                    self.cache, temp, self._next_key(), steps=steps)
+                toks, self.cache, dev_state = self._jit_chunk(
+                    self.params, dev_state, self.cache, temp,
+                    self._next_key(), steps=steps)
             toks_host = np.asarray(toks)
-            slot_token = np.array(last)      # copy: host-mutated on admission
             self.stats.decode_seconds += time.perf_counter() - t0
             self.stats.generated_tokens += steps * len(active)
 
@@ -338,8 +397,10 @@ class PagedTPUEngine:
                 req = reqs[seq_id]
                 chunk_ids = [int(t) for t in toks_host[slot]]
                 req.generated.extend(chunk_ids)
+                slot_token[slot] = chunk_ids[-1]
                 if self._finished(req, chunk_ids):
                     self._retire(req, seq_id, slot, active)
+                    dirty = True
 
     # -- host-side helpers -------------------------------------------------
     def _dev(self, arr):
@@ -358,12 +419,19 @@ class PagedTPUEngine:
         active.pop(slot, None)
 
     def _reserve_chunk(self, active: dict[int, int],
-                       reqs: dict[int, _Request], steps: int) -> None:
+                       reqs: dict[int, _Request], steps: int) -> bool:
         """Pre-allocate pages so a chunk of ``steps`` writes cannot land
-        outside a sequence's block table; preempt on pool exhaustion."""
+        outside a sequence's block table; preempt on pool exhaustion.
+        Returns True when any sequence's block table gained a page (the
+        device-resident table copy is then stale and must re-upload)."""
+        grew = False
         for slot, seq_id in list(active.items()):
             while slot in active:            # we may become a victim ourselves
-                if self.rt.advance(seq_id, steps) is not None:
+                target = self.rt.advance(seq_id, steps)
+                if target is not None:
+                    p = self.page_size
+                    if (target + p - 1) // p != (target - steps + p - 1) // p:
+                        grew = True
                     break
                 # youngest running sequence is the victim; WE report how many
                 # tokens its pages really hold — a victim whose advance()
@@ -377,6 +445,7 @@ class PagedTPUEngine:
                 # and decoding resumes (no resampling at temperature > 0)
                 vslot = next(s for s, q in active.items() if q == victim)
                 active.pop(vslot)
+        return grew
 
     def _prefill_admitted(self, admitted: list[tuple[int, int]],
                           reqs: dict[int, _Request],

@@ -3,23 +3,28 @@
 TPU-native replacement for the paged-attention CUDA kernels vLLM supplies
 to the reference (reference inference.py:90-95 constructs ``vllm.LLM``;
 its CUDA kernels are the vendored-native dependency catalogued in
-SURVEY.md §2.9).  Here the KV cache lives in HBM as fixed-size pages and a
+SURVEY.md §2.9).  The KV cache lives in HBM as fixed-size pages and a
 block table maps each sequence to its pages, so sequences of wildly
 different lengths share one cache pool with no per-sequence reallocation —
 the layout continuous batching needs.
 
-Layout (chosen for TPU tiling, not copied from anywhere):
-- ``k_pages``/``v_pages``: ``[H_kv, N_pages, P, D]`` — the minor-most two
-  dims ``(P, D)`` are exactly the (sublane, lane) tile, so one page for one
-  head is a contiguous, perfectly-tiled VMEM block.
+Layout (measured on v5e, see PERF.md and models/paged.py):
+- ``k_pages``/``v_pages``: ``[N_pages * P, H_kv, D]`` — token-major and
+  flat, the same arrays the decode scatter writes in place.  A page is
+  ``P`` consecutive rows, so the kernel views the array as
+  ``[N_pages, P, H_kv, D]`` (a free reshape) and one page for *all* kv
+  heads is a contiguous block.
 - ``block_tables``: ``[B, max_pages]`` int32 page ids (0-padded past the
   end; padding is masked, never read as data).
 - ``seq_lens``: ``[B]`` int32 — tokens currently valid per sequence.
 
-Kernel shape: grid ``(B, H_kv, max_pages)`` with the page dimension
-innermost and *arbitrary* (sequential), so flash-style online-softmax
-accumulators in VMEM scratch carry across pages.  The block table and
-sequence lengths ride in scalar-prefetch SMEM: Pallas reads
+Kernel shape: grid ``(B, max_pages)`` with the page dimension innermost
+and *arbitrary* (sequential), so flash-style online-softmax accumulators
+in VMEM scratch carry across pages.  Each grid step processes one page
+for EVERY head at once — the per-(head, page) grid of a head-split layout
+costs ~H_kv× more grid steps, and TPU grids are sequential per core, so
+grid-step overhead is what buries fine-grained kernels.  The block table
+and sequence lengths ride in scalar-prefetch SMEM: Pallas reads
 ``block_tables[b, p]`` inside the BlockSpec index_map to schedule the
 HBM→VMEM DMA of the right page ahead of compute — the pipelining the CUDA
 kernel does by hand falls out of the grid spec.
@@ -48,9 +53,10 @@ _NEG_INF = -1e30
 
 def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
                    o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                   scale: float, max_pages: int, window: int | None):
+                   scale: float, max_pages: int, window: int | None,
+                   h_kv: int, g: int):
     b = pl.program_id(0)
-    p = pl.program_id(2)
+    p = pl.program_id(1)
 
     @pl.when(p == 0)
     def _init():
@@ -70,34 +76,38 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)          # [P, D]
-        v = v_ref[0, 0].astype(jnp.float32)          # [P, D]
-        s = jax.lax.dot_general(                      # [G, P]
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        pos = p * page_size + cols
+        cols = jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        pos = p * page_size + cols                    # [1, P]
         valid = pos < seq_len
         if window is not None:
             valid = valid & (pos >= seq_len - window)
-        s = jnp.where(valid, s, _NEG_INF)
+        # one page for all heads: static loop over kv heads, each a
+        # [G, D] x [D, P] matmul (batched matvec has no 2D-matmul form)
+        for h in range(h_kv):
+            q = q_ref[0, h * g:(h + 1) * g].astype(jnp.float32)    # [G, D]
+            k = k_ref[0, :, h].astype(jnp.float32)                 # [P, D]
+            v = v_ref[0, :, h].astype(jnp.float32)                 # [P, D]
+            s = jax.lax.dot_general(                               # [G, P]
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(valid, s, _NEG_INF)
 
-        m_prev = m_ref[:, :1]                         # [G, 1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)    # [G, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)               # rescale old sums
-        probs = jnp.exp(s - m_new)                    # [G, P]
-        l_new = alpha * l_ref[:, :1] + probs.sum(axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            probs, v, preferred_element_type=jnp.float32)
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+            rows = slice(h * g, (h + 1) * g)
+            m_prev = m_ref[rows, :1]                      # [G, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)    # [G, 1]
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)               # rescale old sums
+            probs = jnp.exp(s - m_new)                    # [G, P]
+            l_new = alpha * l_ref[rows, :1] + probs.sum(axis=-1, keepdims=True)
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + jnp.dot(
+                probs, v, preferred_element_type=jnp.float32)
+            m_ref[rows, :] = jnp.broadcast_to(m_new, (g, m_ref.shape[1]))
+            l_ref[rows, :] = jnp.broadcast_to(l_new, (g, l_ref.shape[1]))
 
     @pl.when(p == max_pages - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -108,48 +118,47 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                                   window: int | None = None):
     """One-token attention against a paged KV cache (Pallas TPU kernel).
 
-    q: [B, H, D]; k_pages/v_pages: [H_kv, N_pages, P, D];
-    block_tables: [B, max_pages] int32; seq_lens: [B] int32 (≥1).
+    q: [B, H, D]; k_pages/v_pages: [N_pages * P, H_kv, D] (token-major
+    flat); block_tables: [B, max_pages] int32; seq_lens: [B] int32 (≥1).
     ``window``: sliding-window size (static; per-model constant) — only
     the most recent ``window`` keys participate.  Returns [B, H, D].
     """
     b, h, d = q.shape
-    h_kv = k_pages.shape[0]
+    h_kv = k_pages.shape[1]
     g = h // h_kv
     max_pages = block_tables.shape[1]
     scale = float(scale if scale is not None else d ** -0.5)
-    qg = q.reshape(b, h_kv, g, d)
+    kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
+    vp = v_pages.reshape(-1, page_size, h_kv, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, h_kv, max_pages),
+        grid=(b, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b_, h_, p_, bt, sl: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda b_, h_, p_, bt, sl: (h_, bt[b_, p_], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda b_, h_, p_, bt, sl: (h_, bt[b_, p_], 0, 0)),
+            pl.BlockSpec((1, h, d), lambda b_, p_, bt, sl: (b_, 0, 0)),
+            pl.BlockSpec((1, page_size, h_kv, d),
+                         lambda b_, p_, bt, sl: (bt[b_, p_], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, h_kv, d),
+                         lambda b_, p_, bt, sl: (bt[b_, p_], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, g, d),
-                               lambda b_, h_, p_, bt, sl: (b_, h_, 0, 0)),
+        out_specs=pl.BlockSpec((1, h, d), lambda b_, p_, bt, sl: (b_, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g, 128), jnp.float32),   # running max (lane-replicated)
-            pltpu.VMEM((g, 128), jnp.float32),   # running denominator
-            pltpu.VMEM((g, d), jnp.float32),     # output accumulator
+            pltpu.VMEM((h, 128), jnp.float32),   # running max (lane-replicated)
+            pltpu.VMEM((h, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((h, d), jnp.float32),     # output accumulator
         ],
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
                                scale=scale, max_pages=max_pages,
-                               window=window)
-    out = pl.pallas_call(
+                               window=window, h_kv=h_kv, g=g)
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h_kv, g, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(block_tables, seq_lens, qg, k_pages, v_pages)
-    return out.reshape(b, h, d)
+    )(block_tables, seq_lens, q, kp, vp)
 
 
 def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
@@ -157,19 +166,21 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
                                window: int | None = None):
     """Portable XLA reference for :func:`paged_decode_attention_pallas`.
 
-    Gathers each sequence's pages into a contiguous view and runs masked
-    attention; the unit-test oracle and the CPU execution path.
+    Gathers each sequence's pages (a leading-dim whole-page gather in the
+    token-major layout) into a contiguous [B, S, H_kv, D] view and runs
+    masked attention; the unit-test oracle and the CPU execution path.
     """
     b, h, d = q.shape
-    h_kv, _, p, _ = k_pages.shape
+    h_kv = k_pages.shape[1]
     g = h // h_kv
     max_pages = block_tables.shape[1]
-    s_max = max_pages * p
+    s_max = max_pages * page_size
     scale = scale if scale is not None else d ** -0.5
 
-    # [H_kv, B, max_pages, P, D] → [B, S, H_kv, D]
-    k_seq = k_pages[:, block_tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
-    v_seq = v_pages[:, block_tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
+    kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
+    vp = v_pages.reshape(-1, page_size, h_kv, d)
+    k_seq = kp[block_tables].reshape(b, s_max, h_kv, d)   # [B, S, H_kv, D]
+    v_seq = vp[block_tables].reshape(b, s_max, h_kv, d)
 
     qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
     kf = k_seq.astype(jnp.float32)

@@ -121,9 +121,9 @@ def kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
 
 
 def paged_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
-    """[L, H_kv, N_pages, P, D] — kv heads over tp if divisible.  The page
-    pool is shared across the whole decode batch, so there is no dp axis;
-    data parallelism for the paged engine is one engine replica per dp
-    group (fleet replicate mode)."""
+    """Per-layer flat pool arrays ``[N_pages * P, H_kv, D]`` — kv heads
+    over tp if divisible.  The page pool is shared across the whole decode
+    batch, so there is no dp axis; data parallelism for the paged engine
+    is one engine replica per dp group (fleet replicate mode)."""
     div = _divisible(cfg, mesh)
-    return P(None, "tp" if div["kv_heads"] else None, None, None, None)
+    return P(None, "tp" if div["kv_heads"] else None, None)

@@ -1,5 +1,9 @@
 """Paged-attention kernel: Pallas (interpret mode) vs XLA reference vs the
-contiguous-cache decode attention already validated by test_models."""
+contiguous-cache decode attention already validated by test_models.
+
+Cache layout under test is the token-major flat pool ``[N * P, H_kv, D]``
+(models/paged.py): page ``n`` is rows ``[n * P, (n + 1) * P)``.
+"""
 
 import jax
 import jax.numpy as jnp
@@ -15,13 +19,23 @@ from reval_tpu.ops.pallas_attention import (
 PAGE = 128
 
 
+def page_view(flat, n_pages):
+    """[N*P, H_kv, D] → [N, P, H_kv, D] (the indexing the helpers use)."""
+    return flat.reshape(n_pages, PAGE, *flat.shape[1:])
+
+
+def set_page(flat, page, value):
+    """Overwrite one page of a flat pool with a scalar."""
+    return flat.at[page * PAGE:(page + 1) * PAGE].set(value)
+
+
 def make_paged(seed=0, b=4, h=8, h_kv=4, d=128, n_pages=16, max_pages=3,
                dtype=jnp.float32):
     """Random q + paged cache with distinct per-sequence lengths/tables."""
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((b, h, d)), dtype)
-    k_pages = jnp.asarray(rng.standard_normal((h_kv, n_pages, PAGE, d)), dtype)
-    v_pages = jnp.asarray(rng.standard_normal((h_kv, n_pages, PAGE, d)), dtype)
+    k_pages = jnp.asarray(rng.standard_normal((n_pages * PAGE, h_kv, d)), dtype)
+    v_pages = jnp.asarray(rng.standard_normal((n_pages * PAGE, h_kv, d)), dtype)
     # unique page ids per (seq, slot) so a wrong table lookup changes numbers
     tables = jnp.asarray(
         rng.permutation(n_pages)[: b * max_pages].reshape(b, max_pages),
@@ -67,10 +81,11 @@ def test_paged_xla_matches_contiguous_decode():
             pad_len=jnp.zeros(1, jnp.int32), cur_pos=seq_lens[i] - 1))
     contiguous = jnp.concatenate(outs)[:, 0]
 
-    # paged view of the same data
+    # paged view of the same data: row b's page j is pool page b*max_pages+j,
+    # so the flat pool is just the concatenated per-row token streams
     tables = jnp.arange(b * max_pages, dtype=jnp.int32).reshape(b, max_pages)
-    k_pages = k.transpose(2, 0, 1, 3).reshape(h_kv, b * max_pages, PAGE, d)
-    v_pages = v.transpose(2, 0, 1, 3).reshape(h_kv, b * max_pages, PAGE, d)
+    k_pages = k.reshape(b * s, h_kv, d)
+    v_pages = v.reshape(b * s, h_kv, d)
     paged = paged_decode_attention_xla(
         q[:, 0], k_pages, v_pages, tables, seq_lens, page_size=PAGE)
     np.testing.assert_allclose(np.asarray(paged), np.asarray(contiguous),
@@ -83,7 +98,9 @@ def test_padding_pages_never_leak():
     q, kp, vp, tables, lens = make_paged(seed=3, max_pages=2)
     lens = jnp.minimum(lens, PAGE)          # every sequence fits in 1 page
     base = paged_decode_attention_xla(q, kp, vp, tables, lens, page_size=PAGE)
-    poisoned = kp.at[:, tables[:, 1]].set(1e9)
+    poisoned = kp
+    for page in np.asarray(tables[:, 1]):
+        poisoned = set_page(poisoned, int(page), 1e9)
     out = paged_decode_attention_xla(q, poisoned, vp, tables, lens,
                                      page_size=PAGE)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
@@ -115,10 +132,11 @@ def test_windowed_xla_matches_contiguous_decode():
     got = paged_decode_attention_xla(q, kp, vp, tables, lens,
                                      page_size=PAGE, window=window)
     b, h, d = q.shape
-    h_kv = kp.shape[0]
+    h_kv = kp.shape[1]
+    n_pages = kp.shape[0] // PAGE
     s_max = tables.shape[1] * PAGE
-    k_seq = kp[:, tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
-    v_seq = vp[:, tables].reshape(h_kv, b, s_max, d).transpose(1, 2, 0, 3)
+    k_seq = page_view(kp, n_pages)[tables].reshape(b, s_max, h_kv, d)
+    v_seq = page_view(vp, n_pages)[tables].reshape(b, s_max, h_kv, d)
     for row in range(b):
         cur = int(lens[row]) - 1                  # query's own position
         ref = decode_attention(
@@ -138,13 +156,11 @@ def test_window_excludes_old_keys():
     lens = jnp.asarray([3 * PAGE - 5], jnp.int32)   # long seq, window ≪ len
     base = paged_decode_attention_xla(q, kp, vp, tables, lens,
                                       page_size=PAGE, window=window)
-    first_page = int(tables[0, 0])
-    kp_bad = kp.at[:, first_page].set(1e3)          # far outside the window
+    kp_bad = set_page(kp, int(tables[0, 0]), 1e3)   # far outside the window
     out = paged_decode_attention_xla(q, kp_bad, vp, tables, lens,
                                      page_size=PAGE, window=window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base))
-    last_page = int(tables[0, 2])
-    kp_bad = kp.at[:, last_page].set(1e3)           # inside the window
+    kp_bad = set_page(kp, int(tables[0, 2]), 1e3)   # inside the window
     out = paged_decode_attention_xla(q, kp_bad, vp, tables, lens,
                                      page_size=PAGE, window=window)
     assert not np.allclose(np.asarray(out), np.asarray(base))
