@@ -196,12 +196,12 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 # -- timed runs ------------------------------------------------------------
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
-              max_slots=8, max_seq_len=4096):
+              max_slots=32, max_seq_len=2048, num_pages=None):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
     eng = PagedTPUEngine(params, cfg, tok, max_slots=max_slots,
-                         max_seq_len=max_seq_len,
+                         max_seq_len=max_seq_len, num_pages=num_pages,
                          prefix_sharing=prefix_sharing)
     # warmup = one full identical run: prefill buckets, decode span buckets,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
@@ -256,6 +256,15 @@ def main() -> None:
                     help="skip the serial baseline (quick iteration)")
     ap.add_argument("--skip-ab", action="store_true",
                     help="skip the prefix-sharing off run")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="paged-engine decode slots (batch width); default "
+                         "32 direct / 24 cot (the cot pool needs the HBM)")
+    ap.add_argument("--max-seq-len", type=int, default=2048)
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="page pool size; default oversubscribes to the "
+                         "measured working set (~10 pages/slot direct, "
+                         "~14/slot cot) instead of slots*max_seq_len — "
+                         "preemption handles any overflow")
     ap.add_argument("--tiny", action="store_true",
                     help="toy model + short budgets: CPU smoke test of the "
                          "bench harness itself, NOT a performance number")
@@ -302,8 +311,29 @@ def main() -> None:
         # the work, so per-chip numbers divide by 1 regardless of how many
         # chips the host exposes
         chips_used = 1
+        if args.tiny and args.slots is None:
+            args.slots = 4
+        if args.tiny and args.max_seq_len == 2048:
+            args.max_seq_len = 512
+        if args.slots is None:
+            args.slots = 32 if args.mode == "direct" else 24
+        num_pages = args.num_pages
+        if num_pages is None:
+            # size the pool to the workload's real working set (+1 page
+            # per seq and a little slack), not slots*max_seq_len — the
+            # full-coverage pool for 32 slots x 2048 would not fit next
+            # to the weights on a 16 GB chip, and preemption covers any
+            # miscount
+            from reval_tpu.inference.tpu.paged_engine import PAGE_SIZE as page
+
+            longest = max(len(tok.encode(p)) for p in prompts) + max_new
+            per_seq = (longest + page - 1) // page + 1
+            per_seq = min(per_seq, args.max_seq_len // page)
+            num_pages = 1 + args.slots * per_seq + 16
         wall, stats = run_paged(params, cfg, tok, prompts, max_new,
-                                prefix_sharing=True)
+                                prefix_sharing=True, max_slots=args.slots,
+                                max_seq_len=args.max_seq_len,
+                                num_pages=num_pages)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -329,7 +359,10 @@ def main() -> None:
 
         if not args.skip_ab:
             wall_nopre, _ = run_paged(params, cfg, tok, prompts, max_new,
-                                      prefix_sharing=False)
+                                      prefix_sharing=False,
+                                      max_slots=args.slots,
+                                      max_seq_len=args.max_seq_len,
+                                      num_pages=num_pages)
             extras["prefix_sharing_speedup"] = round(wall_nopre / wall, 3)
 
         vs_baseline = 0.0

@@ -55,6 +55,8 @@ from .tokenizer import HFTokenizer
 
 __all__ = ["PagedTPUEngine"]
 
+PAGE_SIZE = 128  # KV pool page size (tokens); the engine's default
+
 CHUNK = 32  # decode steps per host sync (stop-string check cadence)
 
 # First chunk after an admission wave is short: freshly admitted DREval
@@ -95,7 +97,7 @@ class _Request:
 
 class PagedTPUEngine:
     def __init__(self, params, cfg: ModelConfig, tokenizer, *,
-                 max_slots: int = 8, page_size: int = 128,
+                 max_slots: int = 8, page_size: int = PAGE_SIZE,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  mesh=None, seed: int = 0, prefix_sharing: bool = True):
         assert max_seq_len % page_size == 0
@@ -146,7 +148,7 @@ class PagedTPUEngine:
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
                         tp_size: int = 1, max_slots: int = 8,
-                        page_size: int = 128, max_seq_len: int = 8192,
+                        page_size: int = PAGE_SIZE, max_seq_len: int = 8192,
                         num_pages: int | None = None, tokenizer=None,
                         seed: int = 0,
                         local_devices_only: bool = False) -> "PagedTPUEngine":
@@ -167,6 +169,10 @@ class PagedTPUEngine:
         if self.rt is not None:
             self.rt.close()
             self.rt = None
+        # drop the page pool so its HBM is reclaimable immediately — a
+        # multi-GB pool lingering until GC makes the next engine's
+        # allocation fail on a 16 GB chip
+        self.cache = None
 
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
