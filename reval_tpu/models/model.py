@@ -188,30 +188,39 @@ def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
 def decode_step(params, cfg: ModelConfig, token: jnp.ndarray, pad_len: jnp.ndarray,
                 cache: KVCache, cur_pos: jnp.ndarray) -> tuple[jnp.ndarray, KVCache]:
     """One decode step: token [B, 1] at shared position ``cur_pos``; write
-    cache at cur_pos, attend over [pad_len, cur_pos]; logits [B, V]."""
-    b = token.shape[0]
+    cache at cur_pos, attend over [pad_len, cur_pos]; logits [B, V].
+
+    The layer loop is UNROLLED, unlike prefill's ``lax.scan``: scanning
+    with the cache as xs/ys stacks a fresh output cache every step — a
+    full cache copy per token (measured 31 → 17.5 ms/step at B=8, S=1024
+    on the 1.3b shape).  Unrolled, the per-layer writes are
+    ``dynamic_update_slice`` on the donated buffer and the reads fuse
+    into the attention.  Prefill keeps the scan: its whole cache is
+    freshly written each call, so the stacked ys ARE the output, and one
+    traced layer keeps compile time flat."""
     h = _embed(params, cfg, token)
     positions = jnp.maximum(cur_pos - pad_len, 0)[:, None]
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
-    def layer_step(h, xs):
-        layer, k_slot, v_slot = xs
+    ck, cv = cache.k, cache.v
+    layers = params["layers"]
+    for i in range(cfg.num_layers):
+        layer = jax.tree.map(lambda x: x[i], layers)
         normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
         q, k, v = _qkv(normed, layer, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, cur_pos, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, cur_pos, 0, 0))
-        attn = decode_attention(q, new_k, new_v, pad_len, cur_pos,
+        ck = jax.lax.dynamic_update_slice(
+            ck, k[None].astype(ck.dtype), (i, 0, cur_pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cv, v[None].astype(cv.dtype), (i, 0, cur_pos, 0, 0))
+        attn = decode_attention(q, ck[i], cv[i], pad_len, cur_pos,
                                 window=cfg.sliding_window)
         h = h + _out_proj(attn, layer, cfg)
         normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
         h = h + _mlp(normed, layer, cfg)
-        return h, (new_k, new_v)
-
-    h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
-    return _unembed(params, cfg, h)[:, 0, :], KVCache(new_k, new_v)
+    return _unembed(params, cfg, h)[:, 0, :], KVCache(ck, cv)
 
 
 def logits_for_tokens(params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
