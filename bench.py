@@ -150,22 +150,21 @@ class TrainedBPE:
         return self.tk.decode(known)
 
 
-def flagship(tiny: bool = False):
-    """deepseek-coder-1.3b shape (BASELINE.json configs[0] flagship);
-    ``tiny`` swaps in a toy config for CPU smoke tests of the harness."""
-    from reval_tpu.models import ModelConfig, init_random_params
+def flagship(tiny: bool = False, model: str = "1.3b",
+             dtype: str = "bfloat16"):
+    """Flagship shapes (BASELINE.json configs[0]: deepseek-coder-1.3b;
+    the 6.7b sibling runs single-chip via weight-only int8).  ``tiny``
+    swaps in a toy config for CPU smoke tests of the harness."""
+    from reval_tpu.models import ModelConfig, init_random_params, zoo_config
 
     if tiny:
         cfg = ModelConfig(vocab_size=8192, hidden_size=64,
                           intermediate_size=128, num_layers=2, num_heads=4,
                           num_kv_heads=2, head_dim=32)
         return init_random_params(cfg, seed=0, dtype="float32"), cfg
-    cfg = ModelConfig(
-        vocab_size=32256, hidden_size=2048, intermediate_size=5504,
-        num_layers=24, num_heads=16, num_kv_heads=16, head_dim=128,
-        rope_theta=100000.0,
-    )
-    params = init_random_params(cfg, seed=0, dtype="bfloat16")
+    cfg = zoo_config(f"deepseek-coder-{model}")
+    cfg.dtype = "bfloat16"
+    params = init_random_params(cfg, seed=0, dtype=dtype)
     return params, cfg
 
 
@@ -265,6 +264,12 @@ def main() -> None:
                          "measured working set (~10 pages/slot direct, "
                          "~14/slot cot) instead of slots*max_seq_len — "
                          "preemption handles any overflow")
+    ap.add_argument("--model", choices=["1.3b", "6.7b"], default="1.3b",
+                    help="flagship shape; 6.7b forces int8 weights (bf16 "
+                         "does not fit a 16 GB chip next to the KV pool)")
+    ap.add_argument("--dtype", choices=["bfloat16", "int8"], default=None,
+                    help="weight storage; int8 = weight-only quantization "
+                         "(models/quant.py). Default bf16 (1.3b) / int8 (6.7b)")
     ap.add_argument("--tiny", action="store_true",
                     help="toy model + short budgets: CPU smoke test of the "
                          "bench harness itself, NOT a performance number")
@@ -273,11 +278,15 @@ def main() -> None:
     from reval_tpu.inference.base import MAX_NEW_TOKENS
 
     max_new = MAX_NEW_TOKENS[args.mode]   # the budgets the eval path uses
+    if args.dtype is None:
+        args.dtype = "int8" if args.model == "6.7b" else "bfloat16"
     if args.tiny:
         max_new = 16
         args.prompts = min(args.prompts, 6)
         args.serial_prompts = min(args.serial_prompts, 4)
-    shape = "TINY-SMOKE-TEST fp32" if args.tiny else "deepseek-1.3b-shape bf16"
+    shape = ("TINY-SMOKE-TEST fp32" if args.tiny
+             else f"deepseek-{args.model}-shape "
+                  + ("int8-weights" if args.dtype == "int8" else "bf16"))
     metric = (f"DREval coverage probes/sec/chip "
               f"({shape}, {args.mode}, {max_new} new tok, "
               f"trained-BPE prompts)")
@@ -304,7 +313,8 @@ def main() -> None:
 
         prompts = build_prompts(args.prompts, args.mode)
         tok = TrainedBPE(prompts)
-        params, cfg = flagship(tiny=args.tiny)
+        params, cfg = flagship(tiny=args.tiny, model=args.model,
+                               dtype=args.dtype)
         n_matmul = count_matmul_params(params)
 
         # the bench engines run UNSHARDED (no mesh): exactly one chip does
@@ -316,7 +326,10 @@ def main() -> None:
         if args.tiny and args.max_seq_len == 2048:
             args.max_seq_len = 512
         if args.slots is None:
-            args.slots = 32 if args.mode == "direct" else 24
+            if args.model == "6.7b":
+                args.slots = 8 if args.mode == "direct" else 6
+            else:
+                args.slots = 32 if args.mode == "direct" else 24
         num_pages = args.num_pages
         if num_pages is None:
             # size the pool to the workload's real working set (+1 page

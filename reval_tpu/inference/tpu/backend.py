@@ -19,7 +19,12 @@ class TPUBackend(InferenceBackend):
                  engine: str = "paged", **kwargs):
         """``engine``: "paged" (default — continuous batching over the
         paged KV cache + native scheduler) or "static" (rectangular
-        batches; the dp>1 prompt-sharding path lives here)."""
+        batches; the dp>1 prompt-sharding path lives here).
+
+        ``dtype``: "bfloat16" (default), "float32", or "int8" —
+        weight-only int8 quantization (models/quant.py): bf16 compute,
+        halved weight HBM reads, ~2× params per chip (6.7b-class models
+        fit a single 16 GB v5e)."""
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         if not model_path:
             raise ValueError(

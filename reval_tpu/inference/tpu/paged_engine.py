@@ -71,11 +71,15 @@ FIRST_CHUNK = 8
 def _floor_pow2(n: int) -> int:
     return 1 << (max(1, n).bit_length() - 1)
 
-# Cap on rows × bucket-tokens per prefill call.  Prefill materialises a
-# contiguous [L, rows, T, H_kv, D] KV block before committing it to pages —
-# 8 rows × 4096 tokens × 24 layers of bf16 KV is ~13 GB, which evicts the
-# page pool out of HBM.  Large admissions prefill in sub-batches instead.
-PREFILL_TOKEN_BUDGET = 8192
+# Cap on the transient KV block a prefill call materialises ([L, rows, T,
+# H_kv, D] before committing to pages) — large admissions prefill in
+# sub-batches instead.  A BYTE budget, not a token count: per-token KV is
+# L × H_kv × D × 2 (k+v) × dtype bytes, which spans ~190 KB (1.3b) to
+# ~512 KB (6.7b) — a fixed token cap tuned on the small model OOMs the
+# big one next to its page pool.  768 MB leaves room for the 6.7b pool +
+# int8 weights on a 16 GB chip; prefill is MXU-bound, so the smaller row
+# batches cost little.
+PREFILL_BYTE_BUDGET = 768_000_000
 
 
 @dataclass
@@ -476,11 +480,15 @@ class PagedTPUEngine:
             n_pg = pow2_bucket((own + self.page_size - 1) // self.page_size)
             by_bucket.setdefault((skip, n_pg), []).append((seq_id, slot))
 
+        per_token_kv = (self.cfg.num_layers * self.cfg.num_kv_heads *
+                        self.cfg.head_dim * 2 *
+                        jnp.dtype(self.params["embed"].dtype).itemsize)
+        token_budget = max(self.page_size, PREFILL_BYTE_BUDGET // per_token_kv)
         firsts: dict[int, int] = {}
         t0 = time.perf_counter()
         for (skip, n_pg), full_group in by_bucket.items():
             t = n_pg * self.page_size
-            step = max(1, PREFILL_TOKEN_BUDGET // t)
+            step = max(1, token_budget // t)
             for start in range(0, len(full_group), step):
                 self._prefill_group(full_group[start:start + step], skip, n_pg,
                                     t, reqs, temperature, firsts)

@@ -13,6 +13,7 @@ from .model import (
     prefill,
     prefill_with_context,
 )
+from .quant import is_quantized, quantize_params
 from .zoo import MODEL_ZOO, ZooEntry, zoo_config, zoo_entry
 
 __all__ = [
@@ -23,12 +24,14 @@ __all__ = [
     "decode_step",
     "init_kv_cache",
     "init_random_params",
+    "is_quantized",
     "load_checkpoint",
     "load_hf_config",
     "logits_for_tokens",
     "param_template",
     "prefill",
     "prefill_with_context",
+    "quantize_params",
     "zoo_config",
     "zoo_entry",
 ]

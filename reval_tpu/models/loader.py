@@ -111,9 +111,16 @@ class _ShardedReader:
 
 def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
                     cfg: ModelConfig | None = None):
-    """Load an HF checkpoint directory into (params pytree, ModelConfig)."""
+    """Load an HF checkpoint directory into (params pytree, ModelConfig).
+
+    ``dtype="int8"``: bf16 activations with weight-only int8 matmul
+    weights (models/quant.py) — halves weight HBM reads and fits ~2×
+    the parameters per chip."""
     model_path = Path(model_path)
     cfg = cfg or load_hf_config(model_path)
+    quantize = dtype == "int8"
+    if quantize:
+        dtype = "bfloat16"
     cfg.dtype = dtype
     target = _DTYPES[dtype]
     reader = _ShardedReader(model_path)
@@ -125,6 +132,17 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
             arr = arr.T
         return arr
 
+    def place(store: dict, name: str, arr: jnp.ndarray) -> None:
+        """Store a leaf, quantizing matmul weights leaf-by-leaf — the
+        whole-tree quantize-after-load would hold bf16 AND int8 copies
+        of the model at once (20 GB for 6.7b: an OOM on a 16 GB chip)."""
+        from .quant import quantize_into
+
+        if quantize:
+            quantize_into(store, name, arr)
+        else:
+            store[name] = arr
+
     params: dict = {}
     params["embed"] = jnp.asarray(fetch(*_TOP_LEVEL["embed"]), dtype=target)
     params["final_norm_w"] = jnp.asarray(fetch(*_TOP_LEVEL["final_norm_w"]), dtype=target)
@@ -132,7 +150,8 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
         params["final_norm_b"] = jnp.asarray(fetch(*_TOP_LEVEL["final_norm_b"]), dtype=target)
     if not cfg.tie_word_embeddings:
         if _TOP_LEVEL["lm_head"][0] in reader:
-            params["lm_head"] = jnp.asarray(fetch(*_TOP_LEVEL["lm_head"]), dtype=target)
+            place(params, "lm_head",
+                  jnp.asarray(fetch(*_TOP_LEVEL["lm_head"]), dtype=target))
         else:
             cfg.tie_word_embeddings = True  # checkpoint ties implicitly
 
@@ -141,7 +160,7 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
         if template.format(i=0) not in reader:
             continue  # optional weight absent in this checkpoint
         stacked = np.stack([fetch(template, transpose, i) for i in range(cfg.num_layers)])
-        layers[our_name] = jnp.asarray(stacked, dtype=target)
+        place(layers, our_name, jnp.asarray(stacked, dtype=target))
     params["layers"] = layers
     return params, cfg
 
@@ -179,10 +198,13 @@ def param_template(cfg: ModelConfig) -> dict:
 
 def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") -> dict:
     """Random params matching the template — benches and sharding tests run
-    real architectures without real checkpoints (this host has no egress)."""
+    real architectures without real checkpoints (this host has no egress).
+    ``dtype="int8"`` quantizes matmul weights leaf-by-leaf as they are
+    drawn (models/quant.py), so the float tree is never fully resident."""
     import jax
 
-    target = _DTYPES[dtype]
+    quantize = dtype == "int8"
+    target = _DTYPES["bfloat16" if quantize else dtype]
     template = param_template(cfg)
     key = jax.random.PRNGKey(seed)
     flat: dict = {}
@@ -196,9 +218,32 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") 
             arr = jnp.ones(shape, jnp.float32)
         return arr.astype(target)
 
+    def place(store, name, shape):
+        from .quant import MATMUL_WEIGHTS, quantize_into
+
+        if quantize and name in MATMUL_WEIGHTS and len(shape) == 3:
+            # draw + quantize layer-by-layer: the stacked fp32 draw alone
+            # is multi-GB at 6.7b scale (see quant.quantize_stacked)
+            parts: dict = {name: [], name + "_scale": []}
+            tmp: dict = {}
+            for _ in range(shape[0]):
+                quantize_into(tmp, name, init_leaf(name, shape[1:]))
+                parts[name].append(tmp[name])
+                parts[name + "_scale"].append(tmp[name + "_scale"])
+            store[name] = jnp.stack(parts[name])
+            store[name + "_scale"] = jnp.stack(parts[name + "_scale"])
+            return
+        leaf = init_leaf(name, shape)
+        if quantize:
+            quantize_into(store, name, leaf)
+        else:
+            store[name] = leaf
+
     for name, value in template.items():
         if name == "layers":
-            flat["layers"] = {k: init_leaf(k, shape) for k, shape in value.items()}
+            flat["layers"] = {}
+            for k, shape in value.items():
+                place(flat["layers"], k, shape)
         else:
-            flat[name] = init_leaf(name, value)
+            place(flat, name, value)
     return flat

@@ -59,16 +59,30 @@ def _act(x, cfg: ModelConfig):
     return jax.nn.silu(x)
 
 
+def _mm(x, container, name: str):
+    """``x @ container[name]`` with transparent weight-only int8: when a
+    ``<name>_scale`` leaf rides along (models/quant.py), the int8 weight
+    converts to the activation dtype inside the dot (XLA fuses the
+    convert into the operand load) and the per-output-channel scale
+    applies to the product — exact w.r.t. the dequantised weight since
+    the scale is constant along the contraction dim."""
+    w = container[name]
+    s = container.get(name + "_scale")
+    if s is None:
+        return x @ w
+    return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
+
+
 def _mlp(x, layer, cfg: ModelConfig):
     if cfg.mlp_gated:
-        gate = x @ layer["gate_w"]
-        up = x @ layer["up_w"]
-        return (_act(gate, cfg) * up) @ layer["down_w"]
-    h = x @ layer["fc_w"]
+        gate = _mm(x, layer, "gate_w")
+        up = _mm(x, layer, "up_w")
+        return _mm(_act(gate, cfg) * up, layer, "down_w")
+    h = _mm(x, layer, "fc_w")
     if cfg.mlp_bias:
         h = h + layer["fc_b"]
     h = _act(h, cfg)
-    out = h @ layer["proj_w"]
+    out = _mm(h, layer, "proj_w")
     if cfg.mlp_bias:
         out = out + layer["proj_b"]
     return out
@@ -76,9 +90,9 @@ def _mlp(x, layer, cfg: ModelConfig):
 
 def _qkv(x, layer, cfg: ModelConfig):
     b, t, _ = x.shape
-    q = x @ layer["q_w"]
-    k = x @ layer["k_w"]
-    v = x @ layer["v_w"]
+    q = _mm(x, layer, "q_w")
+    k = _mm(x, layer, "k_w")
+    v = _mm(x, layer, "v_w")
     if cfg.attention_bias:
         q, k, v = q + layer["q_b"], k + layer["k_b"], v + layer["v_b"]
     q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -89,7 +103,7 @@ def _qkv(x, layer, cfg: ModelConfig):
 
 def _out_proj(attn_out, layer, cfg: ModelConfig):
     b, t = attn_out.shape[:2]
-    out = attn_out.reshape(b, t, cfg.num_heads * cfg.head_dim) @ layer["o_w"]
+    out = _mm(attn_out.reshape(b, t, cfg.num_heads * cfg.head_dim), layer, "o_w")
     if cfg.attention_bias:
         out = out + layer["o_b"]
     return out
@@ -103,8 +117,9 @@ def _embed(params, cfg: ModelConfig, tokens):
 
 
 def _unembed(params, cfg: ModelConfig, h):
-    w = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (h @ w).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        return (h @ params["embed"].T).astype(jnp.float32)
+    return _mm(h, params, "lm_head").astype(jnp.float32)
 
 
 def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,

@@ -142,28 +142,25 @@ def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,
 
     Prefill itself runs through the existing left-padded ``prefill`` (its
     attention is already MXU-shaped); paging only changes where the KV
-    lands.  Each row's tokens roll left (pad stripped), then one
-    leading-dim scatter per layer writes them at their flat page
-    positions.  Rows whose tables point at the trash page scatter into
-    page 0, which masked reads never see.
+    lands.  The pad shift folds into the scatter's destination indices —
+    row ``b``'s buffer column ``j`` holds sequence position ``j - pad``,
+    so it lands at ``table[b, (j-pad)//P]*P + (j-pad)%P`` and padding
+    columns land in the trash page — no left-align roll copy of the
+    multi-GB KV block first (the roll was half the commit's HBM traffic
+    and an OOM at 6.7b scale).
     """
     l, b, t, h_kv, d = kv.k.shape
     p = cache.page_size
     assert t % p == 0, f"prefill bucket {t} not a multiple of page size {p}"
-    n_pg = t // p
 
-    def align(x, shift):            # [L, T, H_kv, D] rolled left by pad_len
-        return jnp.roll(x, -shift, axis=1)
-
-    k_aligned = jax.vmap(align, in_axes=(1, 0), out_axes=1)(kv.k, pad_len)
-    v_aligned = jax.vmap(align, in_axes=(1, 0), out_axes=1)(kv.v, pad_len)
-    # flat destination of row b's j-th token: table[b, j // P] * P + j % P
     offs = jnp.arange(t, dtype=jnp.int32)
-    flat_idx = (prefill_tables[:, offs // p] * p + offs % p)        # [B, T]
+    rel = offs[None, :] - pad_len[:, None]                 # [B, T]
+    relc = jnp.clip(rel, 0, t - 1)
+    dest = (jnp.take_along_axis(prefill_tables, relc // p, axis=1) * p
+            + relc % p)
+    flat_idx = jnp.where(rel >= 0, dest, relc % p)         # pad → trash page 0
     new_k, new_v = [], []
     for i in range(l):
-        new_k.append(cache.k[i].at[flat_idx].set(
-            k_aligned[i].astype(cache.dtype)))
-        new_v.append(cache.v[i].at[flat_idx].set(
-            v_aligned[i].astype(cache.dtype)))
+        new_k.append(cache.k[i].at[flat_idx].set(kv.k[i].astype(cache.dtype)))
+        new_v.append(cache.v[i].at[flat_idx].set(kv.v[i].astype(cache.dtype)))
     return PagedKVCache(k=tuple(new_k), v=tuple(new_v), page_size=p)

@@ -1,0 +1,99 @@
+"""Weight-only int8 quantization (per-output-channel, symmetric).
+
+TPU-native memory lever: autoregressive decode re-reads every matmul
+weight each step, so HBM traffic — not FLOPs — bounds decode speed, and
+int8 storage halves it versus bf16.  More importantly it changes what
+*fits*: deepseek-coder-6.7b is 13.4 GB in bf16 — no room next to a KV
+page pool on a 16 GB v5e chip — but 6.7 GB in int8 runs single-chip
+(BASELINE.json configs[1]-[2] class models on one chip; the reference
+needed an A800 per vLLM worker for the same shapes).
+
+Scheme (the standard weight-only recipe, chosen for XLA friendliness):
+- per-output-channel symmetric scales: ``s[o] = max_abs(w[:, o]) / 127``,
+  ``w_q = round(w / s)`` stored int8, compute stays bf16 —
+  ``(x @ w_q.astype(bf16)) * s`` is exact w.r.t. the dequantised weight
+  because the scale is constant along the contraction dim, and XLA fuses
+  the int8→bf16 convert into the dot's operand load (no dequantised copy
+  is materialised in HBM).
+- quantized leaves keep their name; the scale rides next to them as
+  ``<name>_scale`` (stacked ``[L, out]`` for layer weights), so the
+  sharding rules and checkpoint plumbing see ordinary pytree leaves.
+- ``embed`` stays bf16: it is read by token *gather* (one row per token),
+  not a matmul — quantizing it saves nothing per step and would cost
+  accuracy twice when embeddings are tied.
+
+Activations are untouched (bf16): weight-only int8 on decoder LLMs is
+the regime with negligible accuracy cost, and the MXU runs bf16×bf16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MATMUL_WEIGHTS", "quantize_params", "quantize_stacked", "is_quantized"]
+
+#: matmul weights eligible for int8 storage ([..., in, out] layout)
+MATMUL_WEIGHTS = (
+    "q_w", "k_w", "v_w", "o_w",
+    "gate_w", "up_w", "down_w",
+    "fc_w", "proj_w",
+    "lm_head",
+)
+
+
+def _quantize_leaf(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., in, out] → (int8 weights, f32 scales [..., out])."""
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=-2) / 127.0          # [..., out]
+    s = jnp.where(s == 0.0, 1.0, s)                    # all-zero column
+    q = jnp.round(wf / s[..., None, :])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def quantize_stacked(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize a stacked ``[L, in, out]`` weight layer-by-layer.
+
+    ``_quantize_leaf`` on the whole stack materialises fp32 temporaries of
+    the full stacked size (5.8 GB for 6.7b's MLP weights) — several alive
+    at once under JAX's async dispatch is an instant OOM next to the
+    model.  Slicing keeps the fp32 transient to one layer."""
+    if w.ndim <= 2:
+        return _quantize_leaf(w)
+    parts = [_quantize_leaf(w[i]) for i in range(w.shape[0])]
+    return (jnp.stack([q for q, _ in parts]),
+            jnp.stack([s for _, s in parts]))
+
+
+def quantize_into(store: dict, name: str, arr: jnp.ndarray) -> None:
+    """Store ``arr`` under ``name``, quantizing it (int8 + ``<name>_scale``
+    sibling) when it is a matmul weight — the ONE place that defines the
+    storage convention ``_mm`` (models/model.py) and the sharding rules
+    (parallel/sharding.py) consume."""
+    if name in MATMUL_WEIGHTS:
+        q, s = quantize_stacked(arr)
+        store[name] = q
+        store[name + "_scale"] = s
+    else:
+        store[name] = arr
+
+
+def quantize_params(params: dict) -> dict:
+    """Return a params tree with matmul weights in int8 + ``*_scale``
+    leaves.  Norms, biases and the embedding stay in their dtype."""
+    out: dict = {}
+    for name, value in params.items():
+        if name == "layers":
+            layers: dict = {}
+            for k, v in value.items():
+                quantize_into(layers, k, v)
+            out["layers"] = layers
+        else:
+            quantize_into(out, name, value)
+    return out
+
+
+def is_quantized(params: dict) -> bool:
+    layers = params.get("layers", {})
+    return any(k.endswith("_scale") for k in layers)

@@ -37,6 +37,18 @@ _LAYER_RULES = {
     "k_b": P(None, "tp"),
     "v_b": P(None, "tp"),
     "fc_b": P(None, "tp"),
+    # weight-only int8 scales [L, out] follow their weight's OUT dim:
+    # output-feature-sharded weights shard the scale, input-feature-sharded
+    # (o_w/down_w/proj_w — partial-sum) weights replicate it
+    "q_w_scale": P(None, "tp"),
+    "k_w_scale": P(None, "tp"),
+    "v_w_scale": P(None, "tp"),
+    "gate_w_scale": P(None, "tp"),
+    "up_w_scale": P(None, "tp"),
+    "fc_w_scale": P(None, "tp"),
+    "o_w_scale": P(),
+    "down_w_scale": P(),
+    "proj_w_scale": P(),
     # replicated small leaves
     "o_b": P(),
     "proj_b": P(),
@@ -49,6 +61,7 @@ _LAYER_RULES = {
 _TOP_RULES = {
     "embed": P("tp", None),       # vocab-sharded; also the tied lm head
     "lm_head": P(None, "tp"),
+    "lm_head_scale": P("tp"),     # int8 scale follows lm_head's vocab dim
     "final_norm_w": P(),
     "final_norm_b": P(),
 }
@@ -74,19 +87,19 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
 
     def top_spec(name):
         spec = _TOP_RULES.get(name, P())
-        if name == "embed" and not div["vocab"]:
-            return P()
-        if name == "lm_head" and not div["vocab"]:
+        base = name.removesuffix("_scale")
+        if base in ("embed", "lm_head") and not div["vocab"]:
             return P()
         return spec
 
     def layer_spec(name):
         spec = _LAYER_RULES.get(name, P())
-        if name in ("k_w", "v_w", "k_b", "v_b") and not div["kv_heads"]:
+        base = name.removesuffix("_scale")   # int8 scales follow their weight
+        if base in ("k_w", "v_w", "k_b", "v_b") and not div["kv_heads"]:
             return P()
-        if name in ("q_w", "o_w", "q_b") and not div["heads"]:
+        if base in ("q_w", "o_w", "q_b") and not div["heads"]:
             return P()
-        if name in ("gate_w", "up_w", "down_w", "fc_w", "proj_w", "fc_b") and not div["ffn"]:
+        if base in ("gate_w", "up_w", "down_w", "fc_w", "proj_w", "fc_b") and not div["ffn"]:
             return P()
         return spec
 
