@@ -58,7 +58,7 @@ def peak_flops_for(device_kind: str) -> float:
 
 # -- pre-flight ------------------------------------------------------------
 
-def probe_devices(timeout_s: int = 60, retries: int = 3, wait_s: int = 20,
+def probe_devices(timeout_s: int = 60, retries: int = 6, wait_s: int = 60,
                   force_cpu: bool = False,
                   ) -> tuple[tuple[int, str, str] | None, str]:
     """(n_devices, device_kind, platform) via a KILLABLE subprocess.
@@ -195,13 +195,13 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 # -- timed runs ------------------------------------------------------------
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
-              max_slots=32, max_seq_len=2048, num_pages=None):
+              max_slots=32, max_seq_len=2048, num_pages=None, kv_dtype=""):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
     eng = PagedTPUEngine(params, cfg, tok, max_slots=max_slots,
                          max_seq_len=max_seq_len, num_pages=num_pages,
-                         prefix_sharing=prefix_sharing)
+                         prefix_sharing=prefix_sharing, kv_dtype=kv_dtype)
     # warmup = one full identical run: prefill buckets, decode span buckets,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
     # pair, so a reduced warmup would leave XLA compiles inside the timed
@@ -270,6 +270,9 @@ def main() -> None:
     ap.add_argument("--dtype", choices=["bfloat16", "int8"], default=None,
                     help="weight storage; int8 = weight-only quantization "
                          "(models/quant.py). Default bf16 (1.3b) / int8 (6.7b)")
+    ap.add_argument("--kv-dtype", choices=["", "int8"], default="",
+                    help="KV page pool storage; int8 halves pool HBM and "
+                         "attention reads (per-token-head scales)")
     ap.add_argument("--tiny", action="store_true",
                     help="toy model + short budgets: CPU smoke test of the "
                          "bench harness itself, NOT a performance number")
@@ -346,7 +349,7 @@ def main() -> None:
         wall, stats = run_paged(params, cfg, tok, prompts, max_new,
                                 prefix_sharing=True, max_slots=args.slots,
                                 max_seq_len=args.max_seq_len,
-                                num_pages=num_pages)
+                                num_pages=num_pages, kv_dtype=args.kv_dtype)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -375,7 +378,8 @@ def main() -> None:
                                       prefix_sharing=False,
                                       max_slots=args.slots,
                                       max_seq_len=args.max_seq_len,
-                                      num_pages=num_pages)
+                                      num_pages=num_pages,
+                                      kv_dtype=args.kv_dtype)
             extras["prefix_sharing_speedup"] = round(wall_nopre / wall, 3)
 
         vs_baseline = 0.0

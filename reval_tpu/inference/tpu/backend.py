@@ -16,7 +16,7 @@ class TPUBackend(InferenceBackend):
                  prompt_type: str = "direct", dtype: str = "bfloat16",
                  num_chips: int = 1, dp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
-                 engine: str = "paged", **kwargs):
+                 engine: str = "paged", kv_dtype: str = "", **kwargs):
         """``engine``: "paged" (default — continuous batching over the
         paged KV cache + native scheduler) or "static" (rectangular
         batches; the dp>1 prompt-sharding path lives here).
@@ -24,7 +24,12 @@ class TPUBackend(InferenceBackend):
         ``dtype``: "bfloat16" (default), "float32", or "int8" —
         weight-only int8 quantization (models/quant.py): bf16 compute,
         halved weight HBM reads, ~2× params per chip (6.7b-class models
-        fit a single 16 GB v5e)."""
+        fit a single 16 GB v5e).
+
+        ``kv_dtype``: "" (KV pages stored in the activation dtype) or
+        "int8" — quantized page pool with per-(token, head) scales
+        (models/paged.py): half the pool HBM and attention read
+        traffic."""
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         if not model_path:
             raise ValueError(
@@ -37,7 +42,7 @@ class TPUBackend(InferenceBackend):
             self.engine = PagedTPUEngine.from_pretrained(
                 model_path, dtype=dtype, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
-                local_devices_only=local_devices_only,
+                local_devices_only=local_devices_only, kv_dtype=kv_dtype,
             )
         elif engine == "paged":
             # dp>1 with continuous batching: one paged replica per device
@@ -48,11 +53,16 @@ class TPUBackend(InferenceBackend):
             self.engine = DataParallelPagedEngine.from_pretrained(
                 model_path, dtype=dtype, dp_size=dp_size, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
-                local_devices_only=local_devices_only,
+                local_devices_only=local_devices_only, kv_dtype=kv_dtype,
             )
         else:
             # the static engine shards one rectangular batch over a dp×tp
             # mesh — one jit program over all chips, no replica threads
+            if kv_dtype:
+                raise ValueError(
+                    "kv_dtype is a paged-pool feature; the static engine's "
+                    "contiguous cache does not support it — drop kv_dtype "
+                    "or use engine='paged'")
             from .engine import TPUEngine
 
             self.engine = TPUEngine.from_pretrained(

@@ -38,7 +38,8 @@ class DataParallelPagedEngine:
     def __init__(self, params, cfg, tokenizer, *, dp_size: int,
                  tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,
-                 seed: int = 0, prefix_sharing: bool = True, devices=None):
+                 seed: int = 0, prefix_sharing: bool = True, devices=None,
+                 kv_dtype: str = ""):
         devices = list(devices if devices is not None else jax.devices())
         need = dp_size * tp_size
         if len(devices) < need:
@@ -55,7 +56,7 @@ class DataParallelPagedEngine:
                 params, cfg, tokenizer, max_slots=max_slots,
                 page_size=page_size, max_seq_len=max_seq_len,
                 num_pages=num_pages, mesh=mesh, seed=seed + r,
-                prefix_sharing=prefix_sharing))
+                prefix_sharing=prefix_sharing, kv_dtype=kv_dtype))
         self._pool = ThreadPoolExecutor(max_workers=dp_size,
                                         thread_name_prefix="dp-paged")
 
@@ -64,7 +65,7 @@ class DataParallelPagedEngine:
                         dp_size: int = 2, tp_size: int = 1,
                         max_slots: int = 8, page_size: int = 128,
                         max_seq_len: int = 8192, num_pages: int | None = None,
-                        tokenizer=None, seed: int = 0,
+                        tokenizer=None, seed: int = 0, kv_dtype: str = "",
                         local_devices_only: bool = False
                         ) -> "DataParallelPagedEngine":
         params, cfg = load_checkpoint(model_path, dtype=dtype)
@@ -74,7 +75,7 @@ class DataParallelPagedEngine:
         return cls(params, cfg, tokenizer, dp_size=dp_size, tp_size=tp_size,
                    max_slots=max_slots, page_size=page_size,
                    max_seq_len=max_seq_len, num_pages=num_pages, seed=seed,
-                   devices=devices)
+                   devices=devices, kv_dtype=kv_dtype)
 
     @property
     def stats(self) -> EngineStats:

@@ -103,7 +103,8 @@ class PagedTPUEngine:
     def __init__(self, params, cfg: ModelConfig, tokenizer, *,
                  max_slots: int = 8, page_size: int = PAGE_SIZE,
                  max_seq_len: int = 8192, num_pages: int | None = None,
-                 mesh=None, seed: int = 0, prefix_sharing: bool = True):
+                 mesh=None, seed: int = 0, prefix_sharing: bool = True,
+                 kv_dtype: str = ""):
         assert max_seq_len % page_size == 0
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -134,10 +135,20 @@ class PagedTPUEngine:
             self._replicated = None
         self.rt = PagedRuntime(self.num_pages, page_size, max_slots,
                                self.max_pages_per_seq)
-        self.cache = init_paged_cache(cfg, self.num_pages, page_size, dtype=dtype)
+        self.cache = init_paged_cache(cfg, self.num_pages, page_size,
+                                      dtype=dtype, kv_dtype=kv_dtype)
         if self._cache_sharding is not None:
+            # pool arrays are [rows, H_kv, D]; int8 scale arrays [rows, H_kv]
+            # shard the same H_kv axis one rank down
+            from jax.sharding import NamedSharding
+
+            scale_sharding = NamedSharding(
+                self.mesh, type(self._cache_sharding.spec)(
+                    *self._cache_sharding.spec[:2]))
             self.cache = jax.tree.map(
-                lambda c: jax.device_put(c, self._cache_sharding), self.cache)
+                lambda c: jax.device_put(
+                    c, self._cache_sharding if c.ndim == 3 else scale_sharding),
+                self.cache)
         self._jit_prefill = jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
         self._jit_prefill_ctx = jax.jit(
             partial(prefill_with_context, cfg=cfg, logits_mode="last"))
@@ -154,7 +165,7 @@ class PagedTPUEngine:
                         tp_size: int = 1, max_slots: int = 8,
                         page_size: int = PAGE_SIZE, max_seq_len: int = 8192,
                         num_pages: int | None = None, tokenizer=None,
-                        seed: int = 0,
+                        seed: int = 0, kv_dtype: str = "",
                         local_devices_only: bool = False) -> "PagedTPUEngine":
         params, cfg = load_checkpoint(model_path, dtype=dtype)
         if tokenizer is None:
@@ -167,7 +178,8 @@ class PagedTPUEngine:
             mesh = make_mesh(tp=tp_size, devices=devices)
         return cls(params, cfg, tokenizer, max_slots=max_slots,
                    page_size=page_size, max_seq_len=max_seq_len,
-                   num_pages=num_pages, mesh=mesh, seed=seed)
+                   num_pages=num_pages, mesh=mesh, seed=seed,
+                   kv_dtype=kv_dtype)
 
     def close(self) -> None:
         if self.rt is not None:

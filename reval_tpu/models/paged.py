@@ -25,6 +25,12 @@ Layout (chosen by measurement on a v5e chip — see PERF.md):
 - the layer loop is **unrolled** (a Python ``for`` at trace time), NOT a
   ``lax.scan``: scanning over the cache as xs/ys stacks fresh output
   buffers every step, which again copies the entire pool per token.
+- optional **int8 pool** (``kv_dtype="int8"``): pages store symmetric
+  per-(token, head) int8 with f32 scales in sibling ``[N_pages * P,
+  H_kv]`` arrays — halves pool HBM and attention read traffic, the
+  dominant decode cost at large batch/long context.  Writes quantize the
+  fresh K/V vector (one amax over D per head); reads dequantize inside
+  the attention kernel.
 
 Page 0 is reserved as the **trash page**: table slots past a sequence's
 allocation and idle batch slots all point at it, so out-of-range writes
@@ -54,23 +60,32 @@ __all__ = [
 
 
 @partial(jax.tree_util.register_dataclass,
-         data_fields=("k", "v"), meta_fields=("page_size",))
+         data_fields=("k", "v", "k_scale", "v_scale"),
+         meta_fields=("page_size",))
 @dataclasses.dataclass
 class PagedKVCache:
     """Per-layer flat token-major page pool.
 
     ``k``/``v``: tuples of ``num_layers`` arrays, each
-    ``[N_pages * page_size, H_kv, D]``.  ``page_size`` is static metadata
-    (it shapes the flat-index arithmetic inside jit).
+    ``[N_pages * page_size, H_kv, D]``.  ``k_scale``/``v_scale``: None
+    (float pool) or per-layer ``[N_pages * page_size, H_kv]`` f32 scale
+    arrays (int8 pool).  ``page_size`` is static metadata (it shapes the
+    flat-index arithmetic inside jit).
     """
 
     k: tuple
     v: tuple
     page_size: int
+    k_scale: tuple | None = None
+    v_scale: tuple | None = None
 
     @property
     def num_pages(self) -> int:
         return self.k[0].shape[0] // self.page_size
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @property
     def dtype(self):
@@ -78,13 +93,37 @@ class PagedKVCache:
 
 
 def init_paged_cache(cfg: ModelConfig, num_pages: int, page_size: int = 128,
-                     dtype=jnp.bfloat16) -> PagedKVCache:
-    shape = (num_pages * page_size, cfg.num_kv_heads, cfg.head_dim)
+                     dtype=jnp.bfloat16, kv_dtype: str = "") -> PagedKVCache:
+    """``kv_dtype``: "" (store in ``dtype``) or "int8" (quantized pool
+    with per-(token, head) scales — half the HBM)."""
+    rows = num_pages * page_size
+    shape = (rows, cfg.num_kv_heads, cfg.head_dim)
+    quantized = kv_dtype == "int8"
+    store = jnp.int8 if quantized else dtype
+    scales = (tuple(jnp.ones((rows, cfg.num_kv_heads), jnp.float32)
+                    for _ in range(cfg.num_layers)) if quantized else None)
     return PagedKVCache(
-        k=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
-        v=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+        k=tuple(jnp.zeros(shape, store) for _ in range(cfg.num_layers)),
+        v=tuple(jnp.zeros(shape, store) for _ in range(cfg.num_layers)),
         page_size=page_size,
+        k_scale=scales,
+        v_scale=(tuple(jnp.ones((rows, cfg.num_kv_heads), jnp.float32)
+                       for _ in range(cfg.num_layers)) if quantized else None),
     )
+
+
+def _quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., H_kv, D] float → (int8 values, f32 scales [..., H_kv]) —
+    the shared symmetric recipe, reduced per (token, head)."""
+    from .quant import symmetric_int8
+
+    return symmetric_int8(x, axis=-1)
+
+
+def _layer_scales(cache: PagedKVCache, i: int):
+    if cache.quantized:
+        return cache.k_scale[i], cache.v_scale[i]
+    return None, None
 
 
 def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -109,26 +148,41 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     layers = params["layers"]
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for i in range(cfg.num_layers):
         layer = jax.tree.map(lambda x: x[i], layers)
         normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
         q, k, v = _qkv(normed, layer, cfg)      # q: [B, 1, H, D]
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # leading-dim scatter → in-place on the donated buffer
-        ki = cache.k[i].at[flat_pos].set(k[:, 0].astype(cache.dtype))
-        vi = cache.v[i].at[flat_pos].set(v[:, 0].astype(cache.dtype))
+        ks_i, vs_i = _layer_scales(cache, i)
+        if cache.quantized:
+            kq, ks_new = _quantize_kv(k[:, 0])
+            vq, vs_new = _quantize_kv(v[:, 0])
+            ki = cache.k[i].at[flat_pos].set(kq)
+            vi = cache.v[i].at[flat_pos].set(vq)
+            ks_i = ks_i.at[flat_pos].set(ks_new)
+            vs_i = vs_i.at[flat_pos].set(vs_new)
+            new_ks.append(ks_i)
+            new_vs.append(vs_i)
+        else:
+            # leading-dim scatter → in-place on the donated buffer
+            ki = cache.k[i].at[flat_pos].set(k[:, 0].astype(cache.dtype))
+            vi = cache.v[i].at[flat_pos].set(v[:, 0].astype(cache.dtype))
         new_k.append(ki)
         new_v.append(vi)
         attn = paged_decode_attention(
             q[:, 0], ki, vi, block_tables, attn_lens, page_size=page,
-            window=cfg.sliding_window)
+            window=cfg.sliding_window, k_scales=ks_i, v_scales=vs_i)
         h = h + _out_proj(attn[:, None], layer, cfg)
         normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
         h = h + _mlp(normed, layer, cfg)
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
-    return (_unembed(params, cfg, h)[:, 0, :],
-            PagedKVCache(k=tuple(new_k), v=tuple(new_v), page_size=page))
+    out_cache = PagedKVCache(
+        k=tuple(new_k), v=tuple(new_v), page_size=page,
+        k_scale=tuple(new_ks) if cache.quantized else None,
+        v_scale=tuple(new_vs) if cache.quantized else None)
+    return _unembed(params, cfg, h)[:, 0, :], out_cache
 
 
 def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,
@@ -147,7 +201,8 @@ def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,
     so it lands at ``table[b, (j-pad)//P]*P + (j-pad)%P`` and padding
     columns land in the trash page — no left-align roll copy of the
     multi-GB KV block first (the roll was half the commit's HBM traffic
-    and an OOM at 6.7b scale).
+    and an OOM at 6.7b scale).  Int8 pools quantize each layer's block
+    as it commits.
     """
     l, b, t, h_kv, d = kv.k.shape
     p = cache.page_size
@@ -159,8 +214,21 @@ def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,
     dest = (jnp.take_along_axis(prefill_tables, relc // p, axis=1) * p
             + relc % p)
     flat_idx = jnp.where(rel >= 0, dest, relc % p)         # pad → trash page 0
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for i in range(l):
-        new_k.append(cache.k[i].at[flat_idx].set(kv.k[i].astype(cache.dtype)))
-        new_v.append(cache.v[i].at[flat_idx].set(kv.v[i].astype(cache.dtype)))
-    return PagedKVCache(k=tuple(new_k), v=tuple(new_v), page_size=p)
+        if cache.quantized:
+            kq, ks = _quantize_kv(kv.k[i])
+            vq, vs = _quantize_kv(kv.v[i])
+            new_k.append(cache.k[i].at[flat_idx].set(kq))
+            new_v.append(cache.v[i].at[flat_idx].set(vq))
+            new_ks.append(cache.k_scale[i].at[flat_idx].set(ks))
+            new_vs.append(cache.v_scale[i].at[flat_idx].set(vs))
+        else:
+            new_k.append(cache.k[i].at[flat_idx].set(
+                kv.k[i].astype(cache.dtype)))
+            new_v.append(cache.v[i].at[flat_idx].set(
+                kv.v[i].astype(cache.dtype)))
+    return PagedKVCache(
+        k=tuple(new_k), v=tuple(new_v), page_size=p,
+        k_scale=tuple(new_ks) if cache.quantized else None,
+        v_scale=tuple(new_vs) if cache.quantized else None)

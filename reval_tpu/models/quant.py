@@ -42,14 +42,22 @@ MATMUL_WEIGHTS = (
 )
 
 
-def _quantize_leaf(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """[..., in, out] → (int8 weights, f32 scales [..., out])."""
-    wf = w.astype(jnp.float32)
-    s = jnp.max(jnp.abs(wf), axis=-2) / 127.0          # [..., out]
-    s = jnp.where(s == 0.0, 1.0, s)                    # all-zero column
-    q = jnp.round(wf / s[..., None, :])
+def symmetric_int8(x: jnp.ndarray, axis: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """THE int8 recipe (one definition for weights and KV): symmetric
+    per-slice scales ``amax/127`` reduced over ``axis``, zero slices
+    pinned to scale 1, values clipped to ±127.  Returns (int8, f32
+    scales with ``axis`` removed)."""
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=axis) / 127.0
+    s = jnp.where(s == 0.0, 1.0, s)
+    q = jnp.round(xf / jnp.expand_dims(s, axis))
     q = jnp.clip(q, -127, 127).astype(jnp.int8)
     return q, s
+
+
+def _quantize_leaf(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., in, out] → (int8 weights, f32 scales [..., out])."""
+    return symmetric_int8(w, axis=-2)
 
 
 def quantize_stacked(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -17,6 +17,10 @@ Layout (measured on v5e, see PERF.md and models/paged.py):
 - ``block_tables``: ``[B, max_pages]`` int32 page ids (0-padded past the
   end; padding is masked, never read as data).
 - ``seq_lens``: ``[B]`` int32 — tokens currently valid per sequence.
+- optional ``k_scales``/``v_scales``: ``[N_pages * P, H_kv]`` f32 —
+  per-(token, head) symmetric int8 scales when the pool stores int8
+  (models/paged.py ``kv_dtype="int8"``): dequantised value =
+  ``page_int8 * scale``.  Halves pool bytes and attention DMA.
 
 Kernel shape: grid ``(B, max_pages)`` with the page dimension innermost
 and *arbitrary* (sequential), so flash-style online-softmax accumulators
@@ -27,7 +31,10 @@ grid-step overhead is what buries fine-grained kernels.  The block table
 and sequence lengths ride in scalar-prefetch SMEM: Pallas reads
 ``block_tables[b, p]`` inside the BlockSpec index_map to schedule the
 HBM→VMEM DMA of the right page ahead of compute — the pipelining the CUDA
-kernel does by hand falls out of the grid spec.
+kernel does by hand falls out of the grid spec.  Dead pages (beyond the
+sequence's length, or wholly outside its sliding window) redirect their
+index_map to page 0: consecutive equal block indices skip the re-DMA, so
+table padding costs almost nothing.
 
 Everything compiles with ``interpret=True`` on CPU, which is how the unit
 tests validate the kernel bit-for-bit against the XLA reference below.
@@ -52,9 +59,13 @@ _NEG_INF = -1e30
 
 
 def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                   scale: float, max_pages: int, window: int | None,
-                   h_kv: int, g: int):
+                   *rest, page_size: int, scale: float, max_pages: int,
+                   window: int | None, h_kv: int, g: int, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -87,6 +98,9 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
             q = q_ref[0, h * g:(h + 1) * g].astype(jnp.float32)    # [G, D]
             k = k_ref[0, :, h].astype(jnp.float32)                 # [P, D]
             v = v_ref[0, :, h].astype(jnp.float32)                 # [P, D]
+            if ks_ref is not None:
+                k = k * ks_ref[0, :, h][:, None]
+                v = v * vs_ref[0, :, h][:, None]
             s = jax.lax.dot_general(                               # [G, P]
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -115,32 +129,55 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                                   *, page_size: int, scale: float | None = None,
                                   interpret: bool = False,
-                                  window: int | None = None):
+                                  window: int | None = None,
+                                  k_scales=None, v_scales=None):
     """One-token attention against a paged KV cache (Pallas TPU kernel).
 
     q: [B, H, D]; k_pages/v_pages: [N_pages * P, H_kv, D] (token-major
     flat); block_tables: [B, max_pages] int32; seq_lens: [B] int32 (≥1).
     ``window``: sliding-window size (static; per-model constant) — only
-    the most recent ``window`` keys participate.  Returns [B, H, D].
+    the most recent ``window`` keys participate.  ``k_scales``/
+    ``v_scales``: per-(token, head) f32 scales for int8 pools.
+    Returns [B, H, D].
     """
     b, h, d = q.shape
     h_kv = k_pages.shape[1]
     g = h // h_kv
     max_pages = block_tables.shape[1]
+    quantized = k_scales is not None
     scale = float(scale if scale is not None else d ** -0.5)
     kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
     vp = v_pages.reshape(-1, page_size, h_kv, d)
 
+    def page_index(b_, p_, bt, sl):
+        # dead pages (masked anyway) redirect to page 0: consecutive
+        # identical indices skip the HBM→VMEM re-DMA
+        alive = p_ * page_size < sl[b_]
+        if window is not None:
+            alive = alive & ((p_ + 1) * page_size > sl[b_] - window)
+        return jnp.where(alive, bt[b_, p_], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda b_, p_, bt, sl: (b_, 0, 0)),
+        pl.BlockSpec((1, page_size, h_kv, d),
+                     lambda b_, p_, bt, sl: (page_index(b_, p_, bt, sl), 0, 0, 0)),
+        pl.BlockSpec((1, page_size, h_kv, d),
+                     lambda b_, p_, bt, sl: (page_index(b_, p_, bt, sl), 0, 0, 0)),
+    ]
+    operands = [q, kp, vp]
+    if quantized:
+        ksp = k_scales.reshape(-1, page_size, h_kv)
+        vsp = v_scales.reshape(-1, page_size, h_kv)
+        spec_s = pl.BlockSpec(
+            (1, page_size, h_kv),
+            lambda b_, p_, bt, sl: (page_index(b_, p_, bt, sl), 0, 0))
+        in_specs += [spec_s, spec_s]
+        operands += [ksp, vsp]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda b_, p_, bt, sl: (b_, 0, 0)),
-            pl.BlockSpec((1, page_size, h_kv, d),
-                         lambda b_, p_, bt, sl: (bt[b_, p_], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, h_kv, d),
-                         lambda b_, p_, bt, sl: (bt[b_, p_], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda b_, p_, bt, sl: (b_, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, 128), jnp.float32),   # running max (lane-replicated)
@@ -150,7 +187,8 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
                                scale=scale, max_pages=max_pages,
-                               window=window, h_kv=h_kv, g=g)
+                               window=window, h_kv=h_kv, g=g,
+                               quantized=quantized)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -158,12 +196,13 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(block_tables, seq_lens, q, kp, vp)
+    )(block_tables, seq_lens, *operands)
 
 
 def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
                                *, page_size: int, scale: float | None = None,
-                               window: int | None = None):
+                               window: int | None = None,
+                               k_scales=None, v_scales=None):
     """Portable XLA reference for :func:`paged_decode_attention_pallas`.
 
     Gathers each sequence's pages (a leading-dim whole-page gather in the
@@ -179,13 +218,16 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
 
     kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
     vp = v_pages.reshape(-1, page_size, h_kv, d)
-    k_seq = kp[block_tables].reshape(b, s_max, h_kv, d)   # [B, S, H_kv, D]
-    v_seq = vp[block_tables].reshape(b, s_max, h_kv, d)
+    k_seq = kp[block_tables].reshape(b, s_max, h_kv, d).astype(jnp.float32)
+    v_seq = vp[block_tables].reshape(b, s_max, h_kv, d).astype(jnp.float32)
+    if k_scales is not None:
+        ksp = k_scales.reshape(-1, page_size, h_kv)
+        vsp = v_scales.reshape(-1, page_size, h_kv)
+        k_seq = k_seq * ksp[block_tables].reshape(b, s_max, h_kv)[..., None]
+        v_seq = v_seq * vsp[block_tables].reshape(b, s_max, h_kv)[..., None]
 
     qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
-    kf = k_seq.astype(jnp.float32)
-    vf = v_seq.astype(jnp.float32)
-    scores = jnp.einsum("bngd,bsnd->bngs", qg, kf) * scale
+    scores = jnp.einsum("bngd,bsnd->bngs", qg, k_seq) * scale
     pos = jnp.arange(s_max)[None, :]
     valid = pos < seq_lens[:, None]
     if window is not None:
@@ -193,13 +235,14 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
     scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
-    out = jnp.einsum("bngs,bsnd->bngd", probs, vf)
+    out = jnp.einsum("bngs,bsnd->bngd", probs, v_seq)
     return out.reshape(b, h, d).astype(q.dtype)
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            *, page_size: int, scale: float | None = None,
-                           window: int | None = None):
+                           window: int | None = None,
+                           k_scales=None, v_scales=None):
     """Backend-dispatching paged decode attention: Pallas on TPU, XLA
     elsewhere (same numerics; the kernel is tested against the XLA path).
 
@@ -211,10 +254,7 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     choice = os.environ.get("REVAL_TPU_PAGED_BACKEND")
     use_pallas = (choice == "pallas" if choice
                   else jax.default_backend() == "tpu")
-    if use_pallas:
-        return paged_decode_attention_pallas(
-            q, k_pages, v_pages, block_tables, seq_lens,
-            page_size=page_size, scale=scale, window=window)
-    return paged_decode_attention_xla(
-        q, k_pages, v_pages, block_tables, seq_lens,
-        page_size=page_size, scale=scale, window=window)
+    fn = paged_decode_attention_pallas if use_pallas else paged_decode_attention_xla
+    return fn(q, k_pages, v_pages, block_tables, seq_lens,
+              page_size=page_size, scale=scale, window=window,
+              k_scales=k_scales, v_scales=v_scales)

@@ -1,0 +1,189 @@
+"""Int8 KV page pool (models/paged.py ``kv_dtype="int8"``): quantized
+attention parity (XLA + Pallas interpret), decode-step parity against the
+float pool, commit roundtrip, and engine integration."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from reval_tpu.models import ModelConfig, init_kv_cache, init_random_params, prefill
+from reval_tpu.models.paged import (
+    _quantize_kv,
+    commit_prefill,
+    init_paged_cache,
+    paged_decode_step,
+)
+from reval_tpu.ops.pallas_attention import (
+    paged_decode_attention_pallas,
+    paged_decode_attention_xla,
+)
+
+PAGE = 128
+
+
+def small_cfg():
+    return ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=128)
+
+
+def make_quantized_paged(seed=0, b=3, h=8, h_kv=4, d=128, n_pages=12,
+                         max_pages=3):
+    """Float pages + their int8/scale form, so tests can compare the
+    quantized attention against the float path on the SAME values."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kf = jnp.asarray(rng.standard_normal((n_pages * PAGE, h_kv, d)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((n_pages * PAGE, h_kv, d)), jnp.float32)
+    kq, ks = _quantize_kv(kf)
+    vq, vs = _quantize_kv(vf)
+    tables = jnp.asarray(
+        rng.permutation(n_pages)[: b * max_pages].reshape(b, max_pages),
+        jnp.int32)
+    lens = jnp.asarray(rng.integers(1, max_pages * PAGE, size=b), jnp.int32)
+    return q, kf, vf, kq, ks, vq, vs, tables, lens
+
+
+def test_quantize_kv_roundtrip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((5, 4, 64)) * 3, jnp.float32)
+    q, s = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (5, 4)
+    deq = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+    err = np.abs(deq - np.asarray(x))
+    assert err.max() <= 0.5 * np.asarray(s).max() + 1e-6
+
+
+def test_quantized_xla_matches_dequantized_float():
+    q, kf, vf, kq, ks, vq, vs, tables, lens = make_quantized_paged()
+    deq_k = kq.astype(jnp.float32) * ks[..., None]
+    deq_v = vq.astype(jnp.float32) * vs[..., None]
+    ref = paged_decode_attention_xla(q, deq_k, deq_v, tables, lens,
+                                     page_size=PAGE)
+    got = paged_decode_attention_xla(q, kq, vq, tables, lens, page_size=PAGE,
+                                     k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # and it tracks the ORIGINAL float values closely (int8 noise only)
+    base = paged_decode_attention_xla(q, kf, vf, tables, lens, page_size=PAGE)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               rtol=0.1, atol=0.05)
+
+
+def test_quantized_pallas_matches_xla():
+    q, kf, vf, kq, ks, vq, vs, tables, lens = make_quantized_paged(seed=1)
+    ref = paged_decode_attention_xla(q, kq, vq, tables, lens, page_size=PAGE,
+                                     k_scales=ks, v_scales=vs)
+    got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
+                                        page_size=PAGE, interpret=True,
+                                        k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [64, 200])
+def test_quantized_windowed_pallas_matches_xla(window):
+    q, kf, vf, kq, ks, vq, vs, tables, lens = make_quantized_paged(seed=2)
+    ref = paged_decode_attention_xla(q, kq, vq, tables, lens, page_size=PAGE,
+                                     window=window, k_scales=ks, v_scales=vs)
+    got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
+                                        page_size=PAGE, interpret=True,
+                                        window=window, k_scales=ks,
+                                        v_scales=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_commit_roundtrip_int8():
+    """commit → gather+dequant reproduces the committed KV to int8 noise."""
+    cfg = small_cfg()
+    rng = np.random.default_rng(3)
+    b, t = 2, PAGE
+    kv = init_kv_cache(cfg, b, t, dtype=jnp.float32)
+    kv = type(kv)(jnp.asarray(rng.standard_normal(kv.k.shape), jnp.float32),
+                  jnp.asarray(rng.standard_normal(kv.v.shape), jnp.float32))
+    pad_len = jnp.asarray([7, 60], jnp.int32)
+    cache = init_paged_cache(cfg, num_pages=3, page_size=PAGE,
+                             dtype=jnp.float32, kv_dtype="int8")
+    tables = jnp.asarray([[1], [2]], jnp.int32)
+    cache = commit_prefill(cache, kv, pad_len, tables)
+    for row in range(b):
+        pad = int(pad_len[row])
+        n_valid = t - pad
+        page = int(tables[row, 0])
+        got = (np.asarray(cache.k[0][page * PAGE: page * PAGE + n_valid],
+                          np.float32)
+               * np.asarray(cache.k_scale[0][page * PAGE: page * PAGE + n_valid])[..., None])
+        want = np.asarray(kv.k[0, row, pad:], np.float32)
+        np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+
+def test_paged_decode_step_int8_tracks_float():
+    """Full decode steps over an int8 pool stay close to the float pool."""
+    cfg = small_cfg()
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    rng = np.random.default_rng(4)
+    b, t = 2, PAGE
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, t)), jnp.int32)
+    pad_len = jnp.asarray([5, 100], jnp.int32)
+    cache = init_kv_cache(cfg, b, t, dtype=jnp.float32)
+    logits, cache = prefill(params, cfg, tokens, pad_len, cache)
+
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    pools = {}
+    for kv_dtype in ("", "int8"):
+        pc = init_paged_cache(cfg, num_pages=5, page_size=PAGE,
+                              dtype=jnp.float32, kv_dtype=kv_dtype)
+        pools[kv_dtype] = commit_prefill(pc, cache, pad_len, tables[:, :1])
+
+    lens = t - pad_len
+    nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+    for _ in range(3):
+        ref, pools[""] = paged_decode_step(params, cfg, nxt, tables, lens,
+                                           pools[""])
+        got, pools["int8"] = paged_decode_step(params, cfg, nxt, tables, lens,
+                                               pools["int8"])
+        # logits drift is bounded by int8 KV noise; the decoded ARGMAX
+        # (what generation consumes) must agree here
+        assert (np.asarray(got).argmax(-1) == np.asarray(ref).argmax(-1)).all()
+        denom = np.abs(np.asarray(ref)).max()
+        assert np.abs(np.asarray(got) - np.asarray(ref)).max() / denom < 0.1
+        nxt = jnp.argmax(ref, axis=-1).astype(jnp.int32)[:, None]
+        lens = lens + 1
+
+
+def test_engine_generates_with_int8_kv():
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+
+    cfg = small_cfg()
+    params = init_random_params(cfg, seed=5, dtype="float32")
+    eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                         page_size=128, max_seq_len=512, kv_dtype="int8")
+    outs = eng.generate(["def f():", "x = 1 +"], max_new_tokens=8,
+                        temperature=0.0)
+    eng.close()
+    assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+
+
+def test_engine_int8_kv_with_prefix_sharing():
+    """Shared-prefix path + int8 pool: prefix pages quantize on commit and
+    riders read them through the scales."""
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+
+    cfg = small_cfg()
+    params = init_random_params(cfg, seed=6, dtype="float32")
+    shared = "#" * 300                      # > one page of common prefix
+    prompts = [shared + " def a():", shared + " def b():"]
+    outs = {}
+    for kv_dtype in ("", "int8"):
+        eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                             page_size=128, max_seq_len=1024,
+                             kv_dtype=kv_dtype, prefix_sharing=True)
+        outs[kv_dtype] = eng.generate(prompts, max_new_tokens=8,
+                                      temperature=0.0)
+        eng.close()
+    # int8 KV noise may flip a low-margin argmax on random weights, but
+    # the outputs must be well-formed and the same shape
+    assert len(outs["int8"]) == 2
+    assert all(isinstance(o, str) for o in outs["int8"])
