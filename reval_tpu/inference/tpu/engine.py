@@ -196,15 +196,21 @@ class TPUEngine:
         """``local_devices_only`` confines the mesh to this host's chips —
         the replicated-engines multihost mode (one full replica per host,
         prompts sharded over DCN by the fleet)."""
-        params, cfg = load_checkpoint(model_path, dtype=dtype)
-        if tokenizer is None:
-            tokenizer = HFTokenizer(model_path)
         mesh = None
         if tp_size * dp_size > 1:
             from ...parallel import make_mesh
 
             devices = jax.local_devices() if local_devices_only else None
             mesh = make_mesh(tp=tp_size, dp=dp_size, devices=devices)
+        if mesh is not None and dtype != "int8":
+            # shard-direct load (see PagedTPUEngine.from_pretrained)
+            from ...models import load_checkpoint_sharded
+
+            params, cfg = load_checkpoint_sharded(model_path, mesh, dtype=dtype)
+        else:
+            params, cfg = load_checkpoint(model_path, dtype=dtype)
+        if tokenizer is None:
+            tokenizer = HFTokenizer(model_path)
         return cls(params, cfg, tokenizer, batch_size=batch_size,
                    max_seq_len=max_seq_len, mesh=mesh, seed=seed)
 

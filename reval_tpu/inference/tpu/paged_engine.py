@@ -167,15 +167,23 @@ class PagedTPUEngine:
                         num_pages: int | None = None, tokenizer=None,
                         seed: int = 0, kv_dtype: str = "",
                         local_devices_only: bool = False) -> "PagedTPUEngine":
-        params, cfg = load_checkpoint(model_path, dtype=dtype)
-        if tokenizer is None:
-            tokenizer = HFTokenizer(model_path)
         mesh = None
         if tp_size > 1:
             from ...parallel import make_mesh
 
             devices = jax.local_devices() if local_devices_only else None
             mesh = make_mesh(tp=tp_size, devices=devices)
+        if mesh is not None and dtype != "int8":
+            # shard-direct load: each device reads only its slice of the
+            # checkpoint (34B+ would blow host RAM through the full-tree
+            # path; int8 needs whole-tensor amax so it keeps full load)
+            from ...models import load_checkpoint_sharded
+
+            params, cfg = load_checkpoint_sharded(model_path, mesh, dtype=dtype)
+        else:
+            params, cfg = load_checkpoint(model_path, dtype=dtype)
+        if tokenizer is None:
+            tokenizer = HFTokenizer(model_path)
         return cls(params, cfg, tokenizer, max_slots=max_slots,
                    page_size=page_size, max_seq_len=max_seq_len,
                    num_pages=num_pages, mesh=mesh, seed=seed,

@@ -14,6 +14,7 @@ from .model import (
     prefill_with_context,
 )
 from .quant import is_quantized, quantize_params
+from .sharded_loader import load_checkpoint_sharded
 from .zoo import MODEL_ZOO, ZooEntry, zoo_config, zoo_entry
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "init_random_params",
     "is_quantized",
     "load_checkpoint",
+    "load_checkpoint_sharded",
     "load_hf_config",
     "logits_for_tokens",
     "param_template",

@@ -96,19 +96,24 @@ def init_paged_cache(cfg: ModelConfig, num_pages: int, page_size: int = 128,
                      dtype=jnp.bfloat16, kv_dtype: str = "") -> PagedKVCache:
     """``kv_dtype``: "" (store in ``dtype``) or "int8" (quantized pool
     with per-(token, head) scales — half the HBM)."""
+    if kv_dtype not in ("", "int8"):
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected '' or 'int8'")
     rows = num_pages * page_size
     shape = (rows, cfg.num_kv_heads, cfg.head_dim)
     quantized = kv_dtype == "int8"
     store = jnp.int8 if quantized else dtype
-    scales = (tuple(jnp.ones((rows, cfg.num_kv_heads), jnp.float32)
-                    for _ in range(cfg.num_layers)) if quantized else None)
+
+    def mk_scales():
+        # two independent allocations (k and v) so donation stays safe
+        return (tuple(jnp.ones((rows, cfg.num_kv_heads), jnp.float32)
+                      for _ in range(cfg.num_layers)) if quantized else None)
+
     return PagedKVCache(
         k=tuple(jnp.zeros(shape, store) for _ in range(cfg.num_layers)),
         v=tuple(jnp.zeros(shape, store) for _ in range(cfg.num_layers)),
         page_size=page_size,
-        k_scale=scales,
-        v_scale=(tuple(jnp.ones((rows, cfg.num_kv_heads), jnp.float32)
-                       for _ in range(cfg.num_layers)) if quantized else None),
+        k_scale=mk_scales(),
+        v_scale=mk_scales(),
     )
 
 

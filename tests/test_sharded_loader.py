@@ -1,0 +1,92 @@
+"""Sharded checkpoint loading (models/sharded_loader.py): every shard read
+straight from safetensors must equal the full-load-then-shard path, with
+the production sharding rules applied — on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def llama_checkpoint(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    path = tmp_path_factory.mktemp("ckpt") / "tiny-llama-sharded"
+    torch.manual_seed(11)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=3, num_attention_heads=8,
+                      num_key_value_heads=8)
+    LlamaForCausalLM(cfg).eval().save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def test_sharded_load_matches_full_load(llama_checkpoint):
+    from reval_tpu.models import load_checkpoint, load_checkpoint_sharded
+    from reval_tpu.parallel import make_mesh, shard_params
+
+    mesh = make_mesh(tp=4, dp=2)
+    full, cfg_full = load_checkpoint(llama_checkpoint, dtype="float32")
+    sharded_ref = shard_params(full, cfg_full, mesh)
+    got, cfg = load_checkpoint_sharded(llama_checkpoint, mesh, dtype="float32")
+
+    assert cfg.num_layers == cfg_full.num_layers
+    ref_leaves = jax.tree_util.tree_flatten_with_path(sharded_ref)[0]
+    got_tree = dict(jax.tree_util.tree_flatten_with_path(got)[0])
+    assert len(ref_leaves) == len(got_tree)
+    for path, ref_leaf in ref_leaves:
+        got_leaf = got_tree[path]
+        np.testing.assert_allclose(np.asarray(got_leaf), np.asarray(ref_leaf),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=f"mismatch at {path}")
+        assert got_leaf.sharding.spec == ref_leaf.sharding.spec, path
+
+
+def test_sharded_load_runs_forward(llama_checkpoint):
+    """Sharded-loaded params drive a jitted forward to the same logits as
+    the full load."""
+    from reval_tpu.models import (
+        load_checkpoint,
+        load_checkpoint_sharded,
+        logits_for_tokens,
+    )
+    from reval_tpu.parallel import make_mesh
+
+    mesh = make_mesh(tp=8)
+    full, cfg = load_checkpoint(llama_checkpoint, dtype="float32")
+    got, cfg2 = load_checkpoint_sharded(llama_checkpoint, mesh, dtype="float32")
+    tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    ref = np.asarray(logits_for_tokens(full, cfg, tokens))
+    out = np.asarray(logits_for_tokens(got, cfg2, tokens))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_from_pretrained_tp_uses_sharded_load(llama_checkpoint):
+    """The tp>1 engine construction path loads shard-direct and generates
+    the same text as an unsharded engine."""
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+
+    prompts = ["def f(x):", "y = "]
+    solo = PagedTPUEngine.from_pretrained(
+        llama_checkpoint, dtype="float32", max_slots=2, max_seq_len=512,
+        tokenizer=ByteTokenizer())
+    want = solo.generate(prompts, max_new_tokens=6, temperature=0.0)
+    solo.close()
+    eng = PagedTPUEngine.from_pretrained(
+        llama_checkpoint, dtype="float32", tp_size=4, max_slots=2,
+        max_seq_len=512, tokenizer=ByteTokenizer())
+    assert "tp" in str(eng.params["layers"]["q_w"].sharding.spec)
+    got = eng.generate(prompts, max_new_tokens=6, temperature=0.0)
+    eng.close()
+    assert got == want
+
+
+def test_sharded_load_rejects_int8(llama_checkpoint):
+    from reval_tpu.models import load_checkpoint_sharded
+    from reval_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="int8"):
+        load_checkpoint_sharded(llama_checkpoint, make_mesh(tp=8),
+                                dtype="int8")
