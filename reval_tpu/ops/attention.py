@@ -18,17 +18,70 @@ portable path (CPU tests + TPU).  The Pallas ragged/paged decode kernel
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 __all__ = ["prefill_attention", "decode_attention", "context_prefill_attention"]
 
 _NEG_INF = -1e30
 
+#: key-block size for the flash-style blocked path; score blocks beyond
+#: this total key length never materialise the full [T_q, T_k] tensor
+_KEY_BLOCK = 512
+
 
 def _group_queries(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
     """[B, T, H, D] → [B, T, H_kv, G, D] grouping query heads per KV head."""
     b, t, h, d = q.shape
     return q.reshape(b, t, n_kv_heads, h // n_kv_heads, d)
+
+
+def _blocked_attention(qg, k, v, mask_fn, scale: float) -> jnp.ndarray:
+    """Flash-style exact attention: ``lax.scan`` over key blocks with
+    online-softmax accumulators, so the peak score transient is
+    [B, N, G, T_q, BLOCK] instead of [..., T_k] — at the 6.7b prefill
+    shape that is the difference between ~1 GB and ~¼ GB per layer of
+    scratch, which decides whether big-model prefill fits next to the
+    page pool (PERF.md).  Numerics are fp32 and EXACT (not an
+    approximation); ``mask_fn(cols) → [B, 1, 1, T_q, C]`` supplies
+    causal/pad/window validity per key block.
+    """
+    b, tq, n_kv, g, d = qg.shape
+    s = k.shape[1]
+    blk = min(_KEY_BLOCK, s)
+    n_blocks = (s + blk - 1) // blk
+    pad = n_blocks * blk - s
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # scan layout: key blocks leading
+    kb = jnp.moveaxis(k.reshape(b, n_blocks, blk, n_kv, d), 1, 0)
+    vb = jnp.moveaxis(v.reshape(b, n_blocks, blk, n_kv, d), 1, 0)
+    starts = jnp.arange(n_blocks, dtype=jnp.int32) * blk
+
+    m0 = jnp.full((b, n_kv, g, tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_kv, g, tq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, n_kv, g, tq, d), jnp.float32)
+
+    def body(carry, xs):
+        m, l, acc = carry
+        kc, vc, start = xs
+        cols = start + jnp.arange(blk)
+        scores = jnp.einsum("bqngd,bknd->bngqk", qg,
+                            kc.astype(jnp.float32)) * scale
+        valid = mask_fn(cols) & (cols < s)[None, None, None, None, :]
+        scores = jnp.where(valid, scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bngqk,bknd->bngqd", p,
+                                       vc.astype(jnp.float32))
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kb, vb, starts))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.moveaxis(out, -2, 1)          # [B, T_q, N, G, D]
 
 
 def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -41,23 +94,36 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     only the most recent ``window`` keys, itself included; None = full
     causal.  Buffer-position distance equals logical distance because both
     ends share the row's pad offset.  Returns [B, T, H, D].
+
+    Blocks over keys when T exceeds the key-block size (exact online
+    softmax; see ``_blocked_attention``), otherwise one dense fused
+    einsum.
     """
     b, t, h, d = q.shape
     n_kv = k.shape[2]
     scale = scale if scale is not None else d ** -0.5
     qg = _group_queries(q, n_kv).astype(jnp.float32)
+    rows = jnp.arange(t)[:, None]       # query positions
+
+    def mask_fn(cols):
+        """Key-column validity → [B, 1, 1, T, C] (one definition for the
+        blocked and dense paths)."""
+        causal = rows >= cols[None, :]
+        if window is not None:
+            causal = causal & (rows - cols[None, :] < window)
+        valid_key = cols[None, :] >= pad_len[:, None]
+        return (causal[None, None, None, :, :]
+                & valid_key[:, None, None, None, :])
+
+    if t > _KEY_BLOCK:
+        out = _blocked_attention(qg, k, v, mask_fn, scale)
+        return out.reshape(b, t, h, d).astype(q.dtype)
+
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     # scores: [B, H_kv, G, T_q, T_k]
     scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
-    rows = jnp.arange(t)[:, None]       # query positions
-    cols = jnp.arange(t)[None, :]       # key positions
-    causal = rows >= cols
-    if window is not None:
-        causal = causal & (rows - cols < window)
-    valid_key = cols >= pad_len[:, None, None, None, None]
-    mask = causal[None, None, None, :, :] & valid_key
-    scores = jnp.where(mask, scores, _NEG_INF)
+    scores = jnp.where(mask_fn(jnp.arange(t)), scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bngqk,bknd->bqngd", probs, vf)
@@ -84,25 +150,45 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     tc = ctx_k.shape[1]
     scale = scale if scale is not None else d ** -0.5
     qg = _group_queries(q, n_kv).astype(jnp.float32)
-    ctx_kf = jnp.broadcast_to(ctx_k, (b, tc, n_kv, d)).astype(jnp.float32)
-    ctx_vf = jnp.broadcast_to(ctx_v, (b, tc, n_kv, d)).astype(jnp.float32)
-    kf = jnp.concatenate([ctx_kf, k.astype(jnp.float32)], axis=1)
-    vf = jnp.concatenate([ctx_vf, v.astype(jnp.float32)], axis=1)
-    scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
     rows = jnp.arange(t)[:, None]              # suffix query buffer positions
-    cols = jnp.arange(t + tc)[None, :]         # key positions: ctx then suffix
-    in_ctx = cols < tc
-    causal = rows + tc >= cols                 # suffix key j valid if j-tc <= i
-    valid_suffix = cols - tc >= pad_len[:, None, None, None, None]
-    in_ctx = in_ctx[None, None, None, :, :]
-    causal = causal[None, None, None, :, :]
-    if window is not None:
-        # suffix↔suffix distance is pad-invariant (rows - (cols - tc));
-        # ctx keys sit at logical cols, queries at tc + (rows - pad)
-        causal = causal & (rows - (cols - tc) < window)[None, None, None, :, :]
-        q_logical = tc + rows - pad_len[:, None, None, None, None]
-        in_ctx = in_ctx & (q_logical - cols < window)
-    mask = in_ctx | (causal & valid_suffix)
+
+    def mask_for(cols):
+        """Validity of key columns ``cols`` (ctx keys ahead of suffix keys)
+        for every query → [B, 1, 1, T, C]."""
+        c = cols.shape[0]
+        in_ctx = jnp.broadcast_to((cols < tc)[None, :], (t, c))     # [T, C]
+        causal = rows + tc >= cols[None, :]                          # [T, C]
+        valid_suffix = (cols[None, :] - tc) >= pad_len[:, None]      # [B, C]
+        if window is not None:
+            # suffix↔suffix distance is pad-invariant (rows - (cols - tc));
+            # ctx keys sit at logical cols, queries at tc + (rows - pad)
+            causal = causal & (rows - (cols[None, :] - tc) < window)
+            q_logical = tc + rows[:, 0][None, :] - pad_len[:, None]  # [B, T]
+            in_ctx_b = (in_ctx[None, :, :]
+                        & (q_logical[:, :, None] - cols[None, None, :] < window))
+        else:
+            in_ctx_b = in_ctx[None, :, :]                            # [1|B,T,C]
+        mask = in_ctx_b | (causal[None, :, :] & valid_suffix[:, None, :])
+        return mask[:, None, None, :, :]
+
+    # concat in the WIDER of the two dtypes: a float32 context next to a
+    # bf16 suffix keeps its precision (score math upcasts to f32 anyway)
+    cat_t = jnp.result_type(ctx_k.dtype, k.dtype)
+    kcat = jnp.concatenate(
+        [jnp.broadcast_to(ctx_k, (b, tc, n_kv, d)).astype(cat_t),
+         k.astype(cat_t)], axis=1)
+    vcat = jnp.concatenate(
+        [jnp.broadcast_to(ctx_v, (b, tc, n_kv, d)).astype(cat_t),
+         v.astype(cat_t)], axis=1)
+
+    if t + tc > _KEY_BLOCK:
+        out = _blocked_attention(qg, kcat, vcat, mask_for, scale)
+        return out.reshape(b, t, h, d).astype(q.dtype)
+
+    kf = kcat.astype(jnp.float32)
+    vf = vcat.astype(jnp.float32)
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
+    mask = mask_for(jnp.arange(t + tc))
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
