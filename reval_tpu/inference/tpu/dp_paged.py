@@ -93,19 +93,26 @@ class DataParallelPagedEngine:
 
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0,
-                 stop: list[str] | None = None) -> list[str]:
+                 stop: list[str] | None = None, on_progress=None) -> list[str]:
         if not prompts:
             return []
         shards = [prompts[r::self.dp_size] for r in range(self.dp_size)]
 
         def run(arg):
-            replica, shard = arg
+            r, (replica, shard) = arg
             if not shard:
                 return []
+            cb = None
+            if on_progress is not None:
+                # map the replica-local index back to the caller's order;
+                # callbacks arrive from dp worker threads concurrently
+                def cb(j, text, _r=r):
+                    on_progress(_r + j * self.dp_size, text)
             return replica.generate(shard, max_new_tokens=max_new_tokens,
-                                    temperature=temperature, stop=stop)
+                                    temperature=temperature, stop=stop,
+                                    on_progress=cb)
 
-        results = list(self._pool.map(run, zip(self.replicas, shards)))
+        results = list(self._pool.map(run, enumerate(zip(self.replicas, shards))))
         out: list[str] = [""] * len(prompts)
         for r, shard_out in enumerate(results):
             for j, text in enumerate(shard_out):

@@ -236,7 +236,16 @@ class PagedTPUEngine:
 
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
-                 temperature: float = 0.0, stop: list[str] | None = None) -> list[str]:
+                 temperature: float = 0.0, stop: list[str] | None = None,
+                 on_progress=None) -> list[str]:
+        """``on_progress(index, text)``: streaming hook, called at every
+        decode-chunk boundary with the prompt's index and its finalised
+        text so far (stop/EOS truncation already applied).  The text
+        normally extends the previous call's, but BPE detokenisation is
+        not strictly prefix-stable at chunk edges — consumers should
+        diff defensively.  Costs one detokenisation of the generated ids
+        per chunk per live request — only paid when a callback is
+        installed."""
         if not prompts:
             return []
         stop = stop or []
@@ -269,8 +278,15 @@ class PagedTPUEngine:
 
             active: dict[int, int] = {}      # slot -> seq_id
             slot_token = np.zeros((self.max_slots, 1), np.int32)
+            notify = None
+            if on_progress is not None:
+                def notify(req, _stop=stop):
+                    on_progress(req.index,
+                                finalize_text(self.tokenizer, req.generated,
+                                              _stop))
             with profile_trace():
-                self._drive(reqs, active, slot_token, jnp.float32(temperature))
+                self._drive(reqs, active, slot_token, jnp.float32(temperature),
+                            notify)
         except Exception:
             # never leave requests queued/running in the native scheduler —
             # the next generate() would be handed stale seq ids
@@ -335,7 +351,7 @@ class PagedTPUEngine:
         return prefix_id
 
     def _drive(self, reqs: dict[int, _Request], active: dict[int, int],
-               slot_token: np.ndarray, temp) -> None:
+               slot_token: np.ndarray, temp, notify=None) -> None:
         """Admission/prefill/decode loop until every request is done.
 
         Loop state (tables, lens, pending token) lives ON DEVICE between
@@ -365,6 +381,8 @@ class PagedTPUEngine:
                     if self._finished(req, [firsts[slot]]):
                         self._retire(req, seq_id, slot, active)
                         dirty = True
+                    if notify is not None:
+                        notify(req)
             if not active:
                 if any(not r.done for r in reqs.values()):
                     raise RuntimeError(
@@ -431,6 +449,8 @@ class PagedTPUEngine:
                 if self._finished(req, chunk_ids):
                     self._retire(req, seq_id, slot, active)
                     dirty = True
+                if notify is not None:
+                    notify(req)
 
     # -- host-side helpers -------------------------------------------------
     def _dev(self, arr):

@@ -5,7 +5,12 @@ reference inference.py:110-131, start_server.sh):
 
 - ``GET /v1/models``           → ``{"data": [{"id": <model_id>}]}``
 - ``POST /v1/completions``     → prompt (string or list), ``max_tokens``,
-  ``temperature``, ``stop`` → ``{"choices": [{"index", "text"}]}``
+  ``temperature``, ``stop`` → ``{"choices": [{"index", "text"}]}``;
+  with ``"stream": true`` → Server-Sent Events, one
+  ``data: {"choices": [{"index", "text": <delta>}]}`` event per decode
+  chunk and a final ``data: [DONE]`` — the protocol the reference's
+  clients speak to vLLM's server (reference inference.py:115-131 sets
+  ``stream=True`` and accumulates deltas).
 
 Implementation notes:
 - stdlib ``ThreadingHTTPServer``; each request handles its own socket but
@@ -14,9 +19,11 @@ Implementation notes:
   from *list prompts in one request* (the client backend sends whole
   task batches), which the engine schedules together; concurrent separate
   requests queue on the lock.
-- no streaming: the reference's client accumulates the stream and returns
-  only the final string (reference inference.py:115-131), so a buffered
-  response is observationally identical through that client.
+- streaming rides the engine's ``on_progress`` hook (decode-chunk
+  granularity, ~32 tokens).  BPE detokenisation is not strictly
+  prefix-stable at chunk edges, so a delta is emitted only when the new
+  text extends what was already sent; a non-extending revision is held
+  back until it stabilises (the common case is plain extension).
 """
 
 from __future__ import annotations
@@ -28,17 +35,38 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 __all__ = ["EngineServer", "serve_config"]
 
 
+def _hold_stop_prefix(text: str, stop: list[str]) -> str:
+    """Trim a trailing substring that is a proper prefix of any stop
+    string — it might complete into the stop next chunk, in which case
+    the final text would retract it (append-only streams cannot)."""
+    if not stop:
+        return text
+    max_hold = max(len(s) for s in stop) - 1
+    for k in range(min(max_hold, len(text)), 0, -1):
+        tail = text[-k:]
+        if any(s.startswith(tail) for s in stop):
+            return text[:-k]
+    return text
+
+
 class EngineServer:
     """Serve ``generate_fn(prompts, max_tokens, temperature, stop) ->
-    list[str]`` over the OpenAI completions protocol."""
+    list[str]`` over the OpenAI completions protocol.  A ``generate_fn``
+    that also accepts ``on_progress`` gets chunk-granular SSE streaming;
+    otherwise ``"stream": true`` requests receive the buffered result in
+    SSE framing."""
 
     def __init__(self, generate_fn, model_id: str, port: int = 3000,
                  host: str = "127.0.0.1"):
         # loopback by default: the endpoint is unauthenticated, and the
         # in-repo client only ever connects to localhost; pass host="0.0.0.0"
         # deliberately to expose it
+        import inspect
+
         self.generate_fn = generate_fn
         self.model_id = model_id
+        self._streams = ("on_progress"
+                         in inspect.signature(generate_fn).parameters)
         self._lock = threading.Lock()
         outer = self
 
@@ -78,8 +106,12 @@ class EngineServer:
                         stop = [stop]
                     max_tokens = int(req.get("max_tokens", 256))
                     temperature = float(req.get("temperature", 0.0))
+                    stream = bool(req.get("stream", False))
                 except Exception as exc:        # malformed request → client error
                     self._send(400, {"error": str(exc)})
+                    return
+                if stream:
+                    self._stream(prompts, max_tokens, temperature, stop)
                     return
                 try:
                     with outer._lock:
@@ -95,6 +127,91 @@ class EngineServer:
                     "choices": [{"index": i, "text": t, "finish_reason": "stop"}
                                 for i, t in enumerate(texts)],
                 })
+
+            def _stream(self, prompts, max_tokens, temperature, stop) -> None:
+                """SSE streaming: one delta event per decode chunk.
+
+                Single-writer design: the engine runs on a worker thread
+                and only ever pushes (index, text, reason) into a queue —
+                it NEVER touches the socket, so a client that stops
+                reading stalls only this handler thread, not the engine
+                or the global engine lock, and concurrent dp-replica
+                callbacks cannot interleave bytes on the wire."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                import queue
+
+                q: queue.Queue = queue.Queue()
+
+                def run() -> None:
+                    try:
+                        kwargs = ({"on_progress":
+                                   lambda i, t: q.put((i, t, None))}
+                                  if outer._streams else {})
+                        with outer._lock:
+                            texts = outer.generate_fn(
+                                prompts, max_tokens=max_tokens,
+                                temperature=temperature, stop=stop, **kwargs)
+                        for i, t in enumerate(texts):
+                            q.put((i, t, "stop"))
+                    except Exception as exc:
+                        q.put(("error", str(exc), None))
+                    q.put(None)
+
+                threading.Thread(target=run, daemon=True,
+                                 name="sse-generate").start()
+
+                sent = [""] * len(prompts)
+                dead = False
+
+                def event(payload) -> bool:
+                    nonlocal dead
+                    if dead:
+                        return False        # client gone: drain, don't write
+                    try:
+                        self.wfile.write(b"data: "
+                                         + json.dumps(payload).encode()
+                                         + b"\n\n")
+                        self.wfile.flush()
+                        return True
+                    except OSError:
+                        dead = True
+                        return False
+
+                while True:
+                    item = q.get()
+                    if item is None:
+                        break
+                    if item[0] == "error":  # headers sent: in-band error
+                        event({"error": item[1]})
+                        continue
+                    i, text, reason = item
+                    if reason is None:
+                        # never stream a tail that might be the start of a
+                        # stop string: finalize_text only truncates on the
+                        # COMPLETE stop, so a chunk boundary mid-stop would
+                        # otherwise leak "[/ANS" and then retract it
+                        text = _hold_stop_prefix(text, stop)
+                    if text.startswith(sent[i]):
+                        delta = text[len(sent[i]):]
+                        sent[i] = text
+                    elif reason is None:
+                        continue            # detok wobble: wait for stability
+                    else:
+                        delta = ""          # terminal: always deliver finish
+                    if delta or reason is not None:
+                        event({"object": "text_completion",
+                               "model": outer.model_id,
+                               "choices": [{"index": i, "text": delta,
+                                            "finish_reason": reason}]})
+                if not dead:
+                    try:
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]   # resolved if port=0
@@ -119,9 +236,18 @@ class EngineServer:
 
 
 def _engine_generate_fn(engine):
-    def generate(prompts, *, max_tokens, temperature, stop):
+    import inspect
+
+    streams = "on_progress" in inspect.signature(engine.generate).parameters
+
+    def generate(prompts, *, max_tokens, temperature, stop, on_progress=None):
+        kwargs = {}
+        if on_progress is not None and streams:
+            # engines without the hook (static) fall back to a buffered
+            # result, still delivered over the SSE framing
+            kwargs["on_progress"] = on_progress
         return engine.generate(prompts, max_new_tokens=max_tokens,
-                               temperature=temperature, stop=stop)
+                               temperature=temperature, stop=stop, **kwargs)
     return generate
 
 

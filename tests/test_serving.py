@@ -125,3 +125,138 @@ def test_engine_fault_returns_500():
             assert "device fell over" in e.read().decode()
     finally:
         srv.shutdown()
+
+
+def _sse_events(resp):
+    """Parse a Server-Sent-Events body into its data payloads."""
+    import json as _json
+
+    events = []
+    for raw in resp.read().decode().split("\n\n"):
+        raw = raw.strip()
+        if not raw.startswith("data: "):
+            continue
+        payload = raw[len("data: "):]
+        events.append("[DONE]" if payload == "[DONE]" else _json.loads(payload))
+    return events
+
+
+def test_streaming_sse_deltas_assemble_to_final_text():
+    """stream=true yields per-chunk deltas that concatenate to the final
+    text, then [DONE] (the protocol reference inference.py:115-131 speaks)."""
+    import json as _json
+    import urllib.request
+
+    from reval_tpu.serving.server import EngineServer
+
+    def fake_generate(prompts, *, max_tokens, temperature, stop,
+                      on_progress=None):
+        finals = []
+        for i, _ in enumerate(prompts):
+            text = f"answer-{i} [ANSWER] YES"
+            if on_progress is not None:
+                for cut in (8, 15, len(text)):
+                    on_progress(i, text[:cut])
+            finals.append(text)
+        return finals
+
+    srv = EngineServer(fake_generate, model_id="m", port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=_json.dumps({"prompt": ["a", "b"], "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        events = _sse_events(urllib.request.urlopen(req, timeout=30))
+    finally:
+        srv.shutdown()
+    assert events[-1] == "[DONE]"
+    texts = {0: "", 1: ""}
+    finished = set()
+    for ev in events[:-1]:
+        choice = ev["choices"][0]
+        texts[choice["index"]] += choice["text"]
+        if choice["finish_reason"] == "stop":
+            finished.add(choice["index"])
+    assert texts == {0: "answer-0 [ANSWER] YES", 1: "answer-1 [ANSWER] YES"}
+    assert finished == {0, 1}
+    assert len(events) > 3        # actually incremental, not one blob
+
+
+def test_streaming_from_real_paged_engine():
+    """End to end: the paged engine's on_progress hook drives SSE and the
+    streamed text equals the buffered result."""
+    import json as _json
+    import urllib.request
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+    from reval_tpu.serving.server import EngineServer, _engine_generate_fn
+
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    engine = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                            page_size=128, max_seq_len=256)
+    want = engine.generate(["def f(x):"], max_new_tokens=48, temperature=0.0)
+    srv = EngineServer(_engine_generate_fn(engine), model_id="tiny",
+                       port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=_json.dumps({"prompt": "def f(x):", "stream": True,
+                              "max_tokens": 48,
+                              "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        events = _sse_events(urllib.request.urlopen(req, timeout=120))
+    finally:
+        srv.shutdown()
+    assert events[-1] == "[DONE]"
+    text = "".join(ev["choices"][0]["text"] for ev in events[:-1])
+    assert text == want[0]
+    assert len(events) >= 3       # several chunk boundaries fired
+
+
+def test_hold_stop_prefix():
+    from reval_tpu.serving.server import _hold_stop_prefix
+
+    stop = ["[/ANSWER]"]
+    assert _hold_stop_prefix("YES [", stop) == "YES "
+    assert _hold_stop_prefix("YES [/ANSWE", stop) == "YES "
+    assert _hold_stop_prefix("YES", stop) == "YES"         # no stop tail
+    assert _hold_stop_prefix("a [x", stop) == "a [x"   # "[x" isn't a prefix
+    assert _hold_stop_prefix("text", []) == "text"
+
+
+def test_streaming_never_leaks_stop_prefix():
+    """A chunk boundary mid-stop-string must not stream the partial stop
+    and later retract it: the accumulated stream equals the final text
+    and the finish event still arrives (review finding)."""
+    import json as _json
+    import urllib.request
+
+    from reval_tpu.serving.server import EngineServer
+
+    def fake_generate(prompts, *, max_tokens, temperature, stop,
+                      on_progress=None):
+        # chunk 1 ends mid-stop ("[/ANS"); chunk 2 completes the stop and
+        # finalize truncates back to "YES "
+        on_progress(0, "YES [/ANS")
+        on_progress(0, "YES ")
+        return ["YES "]
+
+    srv = EngineServer(fake_generate, model_id="m", port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=_json.dumps({"prompt": "p", "stream": True,
+                              "stop": ["[/ANSWER]"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        events = _sse_events(urllib.request.urlopen(req, timeout=30))
+    finally:
+        srv.shutdown()
+    assert events[-1] == "[DONE]"
+    text = "".join(ev["choices"][0]["text"] for ev in events[:-1])
+    assert text == "YES "                      # no "[/ANS" ever on the wire
+    assert any(ev["choices"][0]["finish_reason"] == "stop"
+               for ev in events[:-1])
