@@ -14,12 +14,18 @@ __all__ = ["TPUBackend"]
 class TPUBackend(InferenceBackend):
     def __init__(self, model_id: str, model_path: str | None = None, temp: float = 0.8,
                  prompt_type: str = "direct", dtype: str = "bfloat16",
-                 num_chips: int = 1, dp_size: int = 1, batch_size: int = 8,
+                 num_chips: int = 1, dp_size: int = 1, pp_size: int = 1,
+                 batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
                  engine: str = "paged", kv_dtype: str = "", **kwargs):
         """``engine``: "paged" (default — continuous batching over the
         paged KV cache + native scheduler) or "static" (rectangular
         batches; the dp>1 prompt-sharding path lives here).
+
+        ``pp_size``: >1 selects the pipeline-parallel static engine
+        (GPipe prefill + token-ring decode over pp stages, composed with
+        ``num_chips``-wide tp per stage) for layer stacks that exceed a
+        tp-sharded chip's HBM.
 
         ``dtype``: "bfloat16" (default), "float32", or "int8" —
         weight-only int8 quantization (models/quant.py): bf16 compute,
@@ -36,7 +42,21 @@ class TPUBackend(InferenceBackend):
                 "TPU backend needs model_path (a HuggingFace checkpoint directory "
                 "containing config.json + *.safetensors)"
             )
-        if engine == "paged" and dp_size == 1:
+        if pp_size > 1:
+            # pipeline parallelism implies the static engine (the paged
+            # scheduler has no pp path); kv_dtype is a paged-pool feature
+            if kv_dtype:
+                raise ValueError("kv_dtype requires the paged engine, "
+                                 "which has no pipeline-parallel path — "
+                                 "drop kv_dtype or pp_size")
+            from .pp_engine import PipelinedTPUEngine
+
+            self.engine = PipelinedTPUEngine.from_pretrained(
+                model_path, dtype=dtype, pp_size=pp_size, tp_size=num_chips,
+                batch_size=batch_size, max_seq_len=max_seq_len,
+                local_devices_only=local_devices_only,
+            )
+        elif engine == "paged" and dp_size == 1:
             from .paged_engine import PagedTPUEngine
 
             self.engine = PagedTPUEngine.from_pretrained(

@@ -173,8 +173,9 @@ class TPUEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ...parallel import shard_params
-            from ...parallel.sharding import kv_cache_spec
+            from ...parallel.sharding import kv_cache_spec, resolve_moe_impl
 
+            cfg = self.cfg = resolve_moe_impl(cfg, mesh)
             dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
             if batch_size % dp:
                 raise ValueError(f"batch_size {batch_size} must divide by dp={dp}")
@@ -235,6 +236,11 @@ class TPUEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _cache_rows(self, b: int) -> int:
+        """KV-cache batch rows for a ``b``-row generation batch.  The
+        pipelined engine over-allocates scratch rows for fill/drain ticks."""
+        return b
+
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None) -> list[str]:
@@ -271,7 +277,7 @@ class TPUEngine:
             tokens[row, t - len(seq):] = seq
             pad_len[row] = t - len(seq)
 
-        cache = init_kv_cache(self.cfg, b, t + max_new_tokens,
+        cache = init_kv_cache(self.cfg, self._cache_rows(b), t + max_new_tokens,
                               dtype=self.params["embed"].dtype)
         dev_tokens, dev_pad = jnp.asarray(tokens), jnp.asarray(pad_len)
         if self._input_sharding is not None:

@@ -125,8 +125,9 @@ class PagedTPUEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ...parallel import shard_params
-            from ...parallel.sharding import paged_cache_spec
+            from ...parallel.sharding import paged_cache_spec, resolve_moe_impl
 
+            cfg = self.cfg = resolve_moe_impl(cfg, mesh)
             self.params = shard_params(params, cfg, mesh)
             self._cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
             self._replicated = NamedSharding(mesh, P())

@@ -1,0 +1,106 @@
+"""PipelinedTPUEngine: the static engine over a pipeline-parallel mesh.
+
+Same generation contract as :class:`TPUEngine` (bucketed batches, chunked
+decode, post-detokenisation stop strings) with the model step swapped for
+the ``pp``-sharded GPipe/token-ring schedules in
+``reval_tpu.parallel.pipeline``.  Use when the layer stack does not fit one
+chip even tp-sharded (BASELINE.json configs[4]: CodeLlama-70B on v5p-16;
+the reference reached such models only through vLLM tensor parallelism,
+reference inference.py:92).
+
+The mesh may carry both ``pp`` and ``tp`` axes: the pipeline shard_map is
+manual over ``pp`` only, so tp sharding composes automatically (GSPMD
+partitions each stage's layer compute tp-wide).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ...models import ModelConfig
+from ...parallel.mesh import mesh_axis_sizes
+from ...parallel.pipeline import (
+    pipeline_decode_chunk,
+    pipeline_prefill,
+    shard_params_pp,
+)
+from .engine import TPUEngine
+
+__all__ = ["PipelinedTPUEngine"]
+
+
+class PipelinedTPUEngine(TPUEngine):
+    def __init__(self, params, cfg: ModelConfig, tokenizer, *,
+                 batch_size: int = 8, max_seq_len: int = 8192, mesh,
+                 n_micro: int | None = None, seed: int = 0):
+        pp = mesh_axis_sizes(mesh).get("pp", 1)
+        if pp < 2:
+            raise ValueError("PipelinedTPUEngine needs a mesh with pp >= 2")
+        # prefill microbatch count: more microbatches shrink the GPipe
+        # bubble ((P-1)/(M+P-1)); 2*pp halves it vs M=pp while keeping
+        # microbatches MXU-sized.  Decode always rings with exactly pp.
+        self.n_micro = n_micro if n_micro is not None else min(2 * pp, batch_size)
+        if batch_size % self.n_micro or batch_size % pp:
+            raise ValueError(
+                f"batch_size {batch_size} must divide by n_micro="
+                f"{self.n_micro} and pp={pp}")
+        if cfg.num_layers % pp:
+            raise ValueError(
+                f"pp={pp} must evenly divide num_layers={cfg.num_layers}")
+        from ...parallel.sharding import resolve_moe_impl
+
+        cfg = resolve_moe_impl(cfg, mesh)
+        super().__init__(params, cfg, tokenizer, batch_size=batch_size,
+                         max_seq_len=max_seq_len, mesh=None, seed=seed)
+        self.mesh = mesh
+        self._pp = pp
+        self.params = shard_params_pp(params, cfg, mesh)
+        self._jit_prefill = jax.jit(partial(
+            pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro))
+        self._jit_decode_chunk = jax.jit(
+            partial(self._pp_decode_chunk, cfg=cfg, mesh=mesh),
+            static_argnames=("steps",), donate_argnames=("cache",))
+
+    @classmethod
+    def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
+                        pp_size: int = 2, tp_size: int = 1,
+                        batch_size: int = 8, max_seq_len: int = 8192,
+                        tokenizer=None, seed: int = 0,
+                        local_devices_only: bool = False,
+                        n_micro: int | None = None) -> "PipelinedTPUEngine":
+        from ...models import load_checkpoint
+        from ...parallel import make_mesh
+        from ...parallel.pipeline import pp_param_specs
+
+        devices = jax.local_devices() if local_devices_only else None
+        mesh = make_mesh(pp=pp_size, tp=tp_size, devices=devices)
+        if dtype != "int8":
+            # shard-direct: each host reads only its stages'/tp-slices' bytes
+            from ...models import load_checkpoint_sharded
+
+            params, cfg = load_checkpoint_sharded(model_path, mesh,
+                                                  dtype=dtype,
+                                                  specs_fn=pp_param_specs)
+        else:
+            params, cfg = load_checkpoint(model_path, dtype=dtype)
+        if tokenizer is None:
+            from .tokenizer import HFTokenizer
+
+            tokenizer = HFTokenizer(model_path)
+        return cls(params, cfg, tokenizer, batch_size=batch_size,
+                   max_seq_len=max_seq_len, mesh=mesh, n_micro=n_micro,
+                   seed=seed)
+
+    def _cache_rows(self, b: int) -> int:
+        # fill/drain scratch: one microbatch of rows past the real batch
+        # (decode microbatches b/pp are the widest users of the slot)
+        return b + b // self._pp
+
+    @staticmethod
+    def _pp_decode_chunk(params, first_token, pad_len, cache, start_pos,
+                         temperature, key, *, cfg, mesh, steps: int):
+        return pipeline_decode_chunk(
+            params, cfg, first_token, pad_len, cache, start_pos,
+            temperature, key, mesh, steps=steps)

@@ -40,6 +40,14 @@ class ModelConfig:
     sliding_window: int | None = None  # mistral/starcoder2: attend last W keys
     hidden_act: str = "silu"
     dtype: str = "bfloat16"
+    # mixture-of-experts (mixtral): 0 experts = dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # "ragged": exact sort + lax.ragged_dot (dropless, HF-equivalent);
+    # "dispatch": capacity-bounded GShard dispatch (ep-shardable — engines
+    # switch to it automatically on an ep>1 mesh)
+    moe_impl: str = "ragged"
+    moe_capacity_factor: float = 2.0   # dispatch slots per expert vs uniform load
 
     @property
     def q_per_kv(self) -> int:
@@ -66,7 +74,13 @@ def load_hf_config(model_path: str | Path) -> ModelConfig:
         hidden_act=hf.get("hidden_act", hf.get("hidden_activation", "silu")),
         sliding_window=hf.get("sliding_window"),
     )
-    if model_type in ("llama", "mistral", "deepseek", "mixtral"):
+    if model_type == "mixtral":
+        return ModelConfig(
+            family="llama", rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+            **common)
+    if model_type in ("llama", "mistral", "deepseek"):
         return ModelConfig(family="llama", rms_norm_eps=hf.get("rms_norm_eps", 1e-6), **common)
     if model_type in ("gemma", "gemma2"):
         return ModelConfig(

@@ -40,6 +40,16 @@ def _weight_map(cfg: ModelConfig) -> dict:
             "up_w": ("model.layers.{i}.mlp.up_proj.weight", True),
             "down_w": ("model.layers.{i}.mlp.down_proj.weight", True),
         }
+        if cfg.num_experts:      # mixtral: MoE block replaces the dense MLP
+            for dense in ("gate_w", "up_w", "down_w"):
+                del m[dense]
+            m.update({
+                "router_w": ("model.layers.{i}.block_sparse_moe.gate.weight", True),
+                # HF names: w1 = gate, w2 = down, w3 = up ({e} = expert idx)
+                "moe_gate_w": ("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight", True),
+                "moe_down_w": ("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", True),
+                "moe_up_w": ("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", True),
+            })
         return m
     if cfg.family == "starcoder2":
         m = {
@@ -125,8 +135,9 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
     target = _DTYPES[dtype]
     reader = _ShardedReader(model_path)
 
-    def fetch(template: str, transpose: bool, i: int | None = None):
-        name = template.format(i=i) if i is not None else template
+    def fetch(template: str, transpose: bool, i: int | None = None,
+              e: int | None = None):
+        name = template if i is None else template.format(i=i, e=e)
         arr = np.asarray(reader.get(name))
         if transpose:
             arr = arr.T
@@ -157,9 +168,16 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
 
     layers: dict[str, jnp.ndarray] = {}
     for our_name, (template, transpose) in _weight_map(cfg).items():
-        if template.format(i=0) not in reader:
+        if template.format(i=0, e=0) not in reader:
             continue  # optional weight absent in this checkpoint
-        stacked = np.stack([fetch(template, transpose, i) for i in range(cfg.num_layers)])
+        if "{e}" in template:   # expert-stacked: [L, E, ...]
+            stacked = np.stack([
+                np.stack([fetch(template, transpose, i, ei)
+                          for ei in range(cfg.num_experts)])
+                for i in range(cfg.num_layers)])
+        else:
+            stacked = np.stack([fetch(template, transpose, i)
+                                for i in range(cfg.num_layers)])
         place(layers, our_name, jnp.asarray(stacked, dtype=target))
     params["layers"] = layers
     return params, cfg
@@ -178,7 +196,14 @@ def param_template(cfg: ModelConfig) -> dict:
         "o_w": (L, H * D, E),
         "mlp_norm_w": (L, E),
     }
-    if cfg.mlp_gated:
+    if cfg.num_experts:
+        layers.update({
+            "router_w": (L, E, cfg.num_experts),
+            "moe_gate_w": (L, cfg.num_experts, E, F),
+            "moe_up_w": (L, cfg.num_experts, E, F),
+            "moe_down_w": (L, cfg.num_experts, F, E),
+        })
+    elif cfg.mlp_gated:
         layers.update({"gate_w": (L, E, F), "up_w": (L, E, F), "down_w": (L, F, E)})
     else:
         layers.update({"fc_w": (L, E, F), "proj_w": (L, F, E)})
@@ -221,7 +246,7 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") 
     def place(store, name, shape):
         from .quant import MATMUL_WEIGHTS, quantize_into
 
-        if quantize and name in MATMUL_WEIGHTS and len(shape) == 3:
+        if quantize and name in MATMUL_WEIGHTS and len(shape) >= 3:
             # draw + quantize layer-by-layer: the stacked fp32 draw alone
             # is multi-GB at 6.7b scale (see quant.quantize_stacked)
             parts: dict = {name: [], name + "_scale": []}

@@ -73,7 +73,129 @@ def _mm(x, container, name: str):
     return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
 
 
+def _moe_capacity(s: int, cfg: ModelConfig) -> int:
+    """Static per-expert dispatch capacity for ``s`` tokens.
+
+    ``capacity_factor``× the uniform load, rounded up to a multiple of 8
+    (TPU lane tiling), floored at ``top_k`` and capped at ``s`` (an expert
+    can receive at most one assignment per token) — so small batches
+    (decode steps) always get drop-free exact routing, and large prefill
+    batches bound the dispatch buffer at ``E × cap × D``.
+    """
+    uniform = s * cfg.num_experts_per_tok / cfg.num_experts
+    cap = int(-(-uniform * cfg.moe_capacity_factor // 1))
+    cap = -(-max(cap, cfg.num_experts_per_tok) // 8) * 8
+    return min(cap, s)
+
+
+def _route(xs, layer, cfg: ModelConfig):
+    """Router math, HF-mixtral-equivalent: float32 softmax over all
+    experts, top-k, renormalised over the selected k."""
+    router = xs.astype(jnp.float32) @ layer["router_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(router, axis=-1)                    # [S, E] f32
+    topv, topi = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    return topv / jnp.sum(topv, axis=-1, keepdims=True), topi
+
+
+def _expert_w(layer, name: str, dtype):
+    """Expert weight stack [E, in, out] in compute dtype; int8 stacks
+    dequantise here (transient — the ragged path needs plain operands)."""
+    w = layer[name]
+    scale = layer.get(name + "_scale")
+    if scale is None:
+        return w if w.dtype == dtype else w.astype(dtype)
+    return w.astype(dtype) * scale[:, None, :].astype(dtype)
+
+
+def _moe_mlp_ragged(x, layer, cfg: ModelConfig):
+    """Exact dropless MoE (the default): sort assignments by expert and
+    run the expert FFNs as grouped matmuls via ``lax.ragged_dot``.
+
+    Every token's top-k experts contribute, always — bit-comparable to
+    HF/vLLM mixtral, and a token's output never depends on what else is
+    in the batch.  Static shapes throughout ([S*K] rows, group sizes are
+    data); the MXU sees one ragged-grouped GEMM per projection instead of
+    ``E`` small ones.  Not ``ep``-shardable (the row partition is data-
+    dependent) — engines switch to the dispatch formulation on ep meshes.
+    """
+    b, t, d = x.shape
+    s = b * t
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    xs = x.reshape(s, d)
+    topv, topi = _route(xs, layer, cfg)
+    flat_e = topi.reshape(-1)                                  # [S*K]
+    order = jnp.argsort(flat_e)
+    tok = order // k
+    xs_sorted = xs[tok]
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    wg = _expert_w(layer, "moe_gate_w", xs.dtype)
+    wu = _expert_w(layer, "moe_up_w", xs.dtype)
+    wd = _expert_w(layer, "moe_down_w", xs.dtype)
+    g = jax.lax.ragged_dot(xs_sorted, wg, group_sizes)
+    u = jax.lax.ragged_dot(xs_sorted, wu, group_sizes)
+    y = jax.lax.ragged_dot(_act(g, cfg) * u, wd, group_sizes)  # [S*K, D]
+    w_sorted = topv.reshape(-1)[order]
+    out = jnp.zeros((s, d), jnp.float32).at[tok].add(
+        y.astype(jnp.float32) * w_sorted[:, None])
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
+def _moe_mlp_dispatch(x, layer, cfg: ModelConfig):
+    """Capacity-bounded GShard dispatch — the ``ep``-shardable MoE path.
+
+    Assignments scatter into a dense ``[E, cap, D]`` buffer (leading-dim
+    scatter — no ``[S, E, cap]`` one-hot transient), the expert FFNs run
+    as ONE batched einsum over the expert dim (the ``ep`` mesh axis
+    shards that dim, see parallel/sharding.py), and results gather back
+    per assignment.  Assignments past an expert's ``cap`` slots are
+    DROPPED (combine weight zeroed): exact whenever ``cap == s`` (always
+    true for s <= 8, see ``_moe_capacity``), approximate under heavy
+    router skew beyond ``moe_capacity_factor`` — raise the factor for
+    exactness at more HBM.  The single-device default is the exact
+    ragged path; engines select this one only on ep>1 meshes.
+    """
+    b, t, d = x.shape
+    s = b * t
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    xs = x.reshape(s, d)
+    topv, topi = _route(xs, layer, cfg)
+    cap = _moe_capacity(s, cfg)
+
+    flat_e = topi.reshape(-1)                                  # [S*K]
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)
+    slot = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
+                               flat_e[:, None], axis=1)[:, 0]  # [S*K]
+    ok = slot < cap
+    eidx = jnp.where(ok, flat_e, e)        # overflow → scratch expert row
+    sidx = jnp.minimum(slot, cap - 1)
+    src = jnp.repeat(xs, k, axis=0)                            # [S*K, D]
+    buf = jnp.zeros((e + 1, cap, d), x.dtype).at[eidx, sidx].set(src)
+    xe = buf[:e]                                               # [E, cap, D]
+
+    def expert_mm(h, name, out_pattern):
+        w = layer[name]
+        scale = layer.get(name + "_scale")
+        y = jnp.einsum(out_pattern, h, w.astype(h.dtype))
+        if scale is not None:                  # weight-only int8 experts
+            y = y * scale[:, None, :].astype(h.dtype)
+        return y
+
+    g = expert_mm(xe, "moe_gate_w", "ecd,edf->ecf")
+    u = expert_mm(xe, "moe_up_w", "ecd,edf->ecf")
+    y = expert_mm(_act(g, cfg) * u, "moe_down_w", "ecf,efd->ecd")
+
+    ypad = jnp.concatenate([y, jnp.zeros((1, cap, d), y.dtype)], axis=0)
+    out_a = ypad[eidx, sidx].astype(jnp.float32)               # [S*K, D]
+    w_a = jnp.where(ok, topv.reshape(-1), 0.0)
+    out = (out_a * w_a[:, None]).reshape(s, k, d).sum(axis=1)
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
 def _mlp(x, layer, cfg: ModelConfig):
+    if cfg.num_experts:
+        if cfg.moe_impl == "dispatch":
+            return _moe_mlp_dispatch(x, layer, cfg)
+        return _moe_mlp_ragged(x, layer, cfg)
     if cfg.mlp_gated:
         gate = _mm(x, layer, "gate_w")
         up = _mm(x, layer, "up_w")
@@ -109,6 +231,23 @@ def _out_proj(attn_out, layer, cfg: ModelConfig):
     return out
 
 
+def _block(h, layer, cfg: ModelConfig, cos, sin, attend):
+    """One transformer block (norm → qkv → rope → attention → out-proj →
+    norm → mlp, pre-norm residuals) — THE block wiring, shared by every
+    forward variant (contiguous prefill/decode, paged decode, pipelined
+    stages).  ``attend(q, k, v) -> attn_out [B, T, H, D]`` supplies the
+    attention and owns any cache read/write (callers stash the rotated
+    k/v from inside the callback when they need to commit them)."""
+    normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
+    q, k, v = _qkv(normed, layer, cfg)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attend(q, k, v)
+    h = h + _out_proj(attn, layer, cfg)
+    normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
+    return h + _mlp(normed, layer, cfg)
+
+
 def _embed(params, cfg: ModelConfig, tokens):
     h = params["embed"][tokens]
     if cfg.embed_scale is not None:
@@ -139,17 +278,17 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
 
     def layer_step(h, xs):
         layer, k_slot, v_slot = xs
-        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
-        q, k, v = _qkv(normed, layer, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
-        attn = prefill_attention(q, k, v, pad_len, window=cfg.sliding_window)
-        h = h + _out_proj(attn, layer, cfg)
-        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
-        h = h + _mlp(normed, layer, cfg)
-        return h, (new_k, new_v)
+        kv = {}
+
+        def attend(q, k, v):
+            kv["k"] = jax.lax.dynamic_update_slice(
+                k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
+            kv["v"] = jax.lax.dynamic_update_slice(
+                v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
+            return prefill_attention(q, k, v, pad_len, window=cfg.sliding_window)
+
+        h = _block(h, layer, cfg, cos, sin, attend)
+        return h, (kv["k"], kv["v"])
 
     h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
@@ -179,18 +318,18 @@ def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     def layer_step(h, xs):
         layer, ctx_k, ctx_v, k_slot, v_slot = xs
-        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
-        q, k, v = _qkv(normed, layer, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        new_k = jax.lax.dynamic_update_slice(k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
-        attn = context_prefill_attention(q, k, v, ctx_k, ctx_v, pad_len,
-                                         window=cfg.sliding_window)
-        h = h + _out_proj(attn, layer, cfg)
-        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
-        h = h + _mlp(normed, layer, cfg)
-        return h, (new_k, new_v)
+        kv = {}
+
+        def attend(q, k, v):
+            kv["k"] = jax.lax.dynamic_update_slice(
+                k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
+            kv["v"] = jax.lax.dynamic_update_slice(
+                v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
+            return context_prefill_attention(q, k, v, ctx_k, ctx_v, pad_len,
+                                             window=cfg.sliding_window)
+
+        h = _block(h, layer, cfg, cos, sin, attend)
+        return h, (kv["k"], kv["v"])
 
     h, (new_k, new_v) = jax.lax.scan(
         layer_step, h, (params["layers"], ctx.k, ctx.v, cache.k, cache.v))
@@ -221,19 +360,17 @@ def decode_step(params, cfg: ModelConfig, token: jnp.ndarray, pad_len: jnp.ndarr
     layers = params["layers"]
     for i in range(cfg.num_layers):
         layer = jax.tree.map(lambda x: x[i], layers)
-        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
-        q, k, v = _qkv(normed, layer, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        ck = jax.lax.dynamic_update_slice(
-            ck, k[None].astype(ck.dtype), (i, 0, cur_pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(
-            cv, v[None].astype(cv.dtype), (i, 0, cur_pos, 0, 0))
-        attn = decode_attention(q, ck[i], cv[i], pad_len, cur_pos,
-                                window=cfg.sliding_window)
-        h = h + _out_proj(attn, layer, cfg)
-        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
-        h = h + _mlp(normed, layer, cfg)
+
+        def attend(q, k, v, i=i):
+            nonlocal ck, cv
+            ck = jax.lax.dynamic_update_slice(
+                ck, k[None].astype(ck.dtype), (i, 0, cur_pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v[None].astype(cv.dtype), (i, 0, cur_pos, 0, 0))
+            return decode_attention(q, ck[i], cv[i], pad_len, cur_pos,
+                                    window=cfg.sliding_window)
+
+        h = _block(h, layer, cfg, cos, sin, attend)
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
     return _unembed(params, cfg, h)[:, 0, :], KVCache(ck, cv)
 

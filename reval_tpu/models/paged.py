@@ -46,10 +46,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..ops import apply_rope, rope_angles
+from ..ops import rope_angles
 from ..ops.pallas_attention import paged_decode_attention
 from .configs import ModelConfig
-from .model import _embed, _mlp, _norm, _out_proj, _qkv, _unembed
+from .model import _block, _embed, _norm, _unembed
 
 __all__ = [
     "PagedKVCache",
@@ -156,32 +156,30 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
     new_ks, new_vs = [], []
     for i in range(cfg.num_layers):
         layer = jax.tree.map(lambda x: x[i], layers)
-        normed = _norm(h, layer["attn_norm_w"], layer.get("attn_norm_b"), cfg)
-        q, k, v = _qkv(normed, layer, cfg)      # q: [B, 1, H, D]
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        ks_i, vs_i = _layer_scales(cache, i)
-        if cache.quantized:
-            kq, ks_new = _quantize_kv(k[:, 0])
-            vq, vs_new = _quantize_kv(v[:, 0])
-            ki = cache.k[i].at[flat_pos].set(kq)
-            vi = cache.v[i].at[flat_pos].set(vq)
-            ks_i = ks_i.at[flat_pos].set(ks_new)
-            vs_i = vs_i.at[flat_pos].set(vs_new)
-            new_ks.append(ks_i)
-            new_vs.append(vs_i)
-        else:
-            # leading-dim scatter → in-place on the donated buffer
-            ki = cache.k[i].at[flat_pos].set(k[:, 0].astype(cache.dtype))
-            vi = cache.v[i].at[flat_pos].set(v[:, 0].astype(cache.dtype))
-        new_k.append(ki)
-        new_v.append(vi)
-        attn = paged_decode_attention(
-            q[:, 0], ki, vi, block_tables, attn_lens, page_size=page,
-            window=cfg.sliding_window, k_scales=ks_i, v_scales=vs_i)
-        h = h + _out_proj(attn[:, None], layer, cfg)
-        normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
-        h = h + _mlp(normed, layer, cfg)
+
+        def attend(q, k, v, i=i):
+            ks_i, vs_i = _layer_scales(cache, i)
+            if cache.quantized:
+                kq, ks_new = _quantize_kv(k[:, 0])
+                vq, vs_new = _quantize_kv(v[:, 0])
+                ki = cache.k[i].at[flat_pos].set(kq)
+                vi = cache.v[i].at[flat_pos].set(vq)
+                ks_i = ks_i.at[flat_pos].set(ks_new)
+                vs_i = vs_i.at[flat_pos].set(vs_new)
+                new_ks.append(ks_i)
+                new_vs.append(vs_i)
+            else:
+                # leading-dim scatter → in-place on the donated buffer
+                ki = cache.k[i].at[flat_pos].set(k[:, 0].astype(cache.dtype))
+                vi = cache.v[i].at[flat_pos].set(v[:, 0].astype(cache.dtype))
+            new_k.append(ki)
+            new_v.append(vi)
+            attn = paged_decode_attention(
+                q[:, 0], ki, vi, block_tables, attn_lens, page_size=page,
+                window=cfg.sliding_window, k_scales=ks_i, v_scales=vs_i)
+            return attn[:, None]
+
+        h = _block(h, layer, cfg, cos, sin, attend)
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
     out_cache = PagedKVCache(
         k=tuple(new_k), v=tuple(new_v), page_size=page,

@@ -33,11 +33,15 @@ import jax.numpy as jnp
 
 __all__ = ["MATMUL_WEIGHTS", "quantize_params", "quantize_stacked", "is_quantized"]
 
-#: matmul weights eligible for int8 storage ([..., in, out] layout)
+#: matmul weights eligible for int8 storage ([..., in, out] layout);
+#: the moe expert stacks are [L, E, in, out] and quantize per (layer,
+#: expert, out-channel).  The tiny router stays float (its logits pick
+#: experts — rounding there changes routing, not just values).
 MATMUL_WEIGHTS = (
     "q_w", "k_w", "v_w", "o_w",
     "gate_w", "up_w", "down_w",
     "fc_w", "proj_w",
+    "moe_gate_w", "moe_up_w", "moe_down_w",
     "lm_head",
 )
 

@@ -65,11 +65,15 @@ def _slices(key: tuple[tuple[int, int, int], ...]) -> tuple[slice, ...]:
 
 def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
                             dtype: str = "bfloat16",
-                            cfg: ModelConfig | None = None):
+                            cfg: ModelConfig | None = None,
+                            specs_fn=None):
     """Load an HF checkpoint directly into mesh-sharded ``jax.Array``s.
 
     Returns (params, cfg) like ``load_checkpoint``, but no host ever
     holds more than its own devices' shards (plus replicated leaves).
+    ``specs_fn`` overrides the sharding-rule function (default
+    ``parallel.sharding.param_specs``; the pipelined engine passes
+    ``pp_param_specs`` so each host reads only its stages' layers).
     """
     if dtype == "int8":
         raise ValueError(
@@ -86,7 +90,7 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
     if cfg.tie_word_embeddings or _TOP_LEVEL["lm_head"][0] not in reader:
         template.pop("lm_head", None)
         cfg.tie_word_embeddings = True
-    specs = param_specs(template, cfg, mesh)
+    specs = (specs_fn or param_specs)(template, cfg, mesh)
     wmap = _weight_map(cfg)
 
     def top_leaf(name: str, shape) -> jax.Array:
@@ -104,9 +108,13 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
         return jax.make_array_from_callback(tuple(shape), sharding, cb)
 
     def layer_leaf(name: str, shape) -> jax.Array:
-        """Stacked [L, ...] leaf assembled from L per-layer HF tensors;
-        the layer dim is never sharded, so each callback reads its
-        per-layer range for every layer and stacks."""
+        """Stacked [L, ...] leaf assembled from per-layer HF tensors; the
+        callback reads exactly the layer range JAX asks for, so a
+        ``pp``-sharded layer dim means each host reads only its own
+        stages' tensors.  MoE expert stacks
+        ([L, E, in, out], ``{e}`` in the template) additionally iterate
+        the callback's expert range — an ``ep``-sharded mesh then makes
+        each host read only its own experts' tensors."""
         hf_template, transpose = wmap[name]
         sharding = NamedSharding(mesh, specs["layers"][name])
         cache: dict = {}
@@ -115,9 +123,17 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
             key = _resolve(idx, shape)
             if key not in cache:
                 layer_rng = range(*key[0])
-                parts = [reader.get_range(hf_template.format(i=i),
-                                          _slices(key[1:]), transpose)
-                         for i in layer_rng]
+                if "{e}" in hf_template:
+                    parts = [
+                        np.stack([reader.get_range(
+                            hf_template.format(i=i, e=e),
+                            _slices(key[2:]), transpose)
+                            for e in range(*key[1])])
+                        for i in layer_rng]
+                else:
+                    parts = [reader.get_range(hf_template.format(i=i),
+                                              _slices(key[1:]), transpose)
+                             for i in layer_rng]
                 cache[key] = np.stack(parts).astype(np.float32).astype(target)
             return cache[key]
 
@@ -127,7 +143,7 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
     for name, shape in template.items():
         if name == "layers":
             for k, shp in shape.items():
-                if k not in wmap or wmap[k][0].format(i=0) not in reader:
+                if k not in wmap or wmap[k][0].format(i=0, e=0) not in reader:
                     continue           # optional weight absent (e.g. biases)
                 params["layers"][k] = layer_leaf(k, shp)
         else:

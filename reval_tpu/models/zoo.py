@@ -97,6 +97,11 @@ MODEL_ZOO: dict[str, ZooEntry] = {
     "codellama/CodeLlama-70b-Instruct-hf": ZooEntry(
         "codellama/CodeLlama-70b-Instruct-hf", "llama", "70B",
         _llama(32016, 8192, 28672, 80, 64, kv_heads=8, rope_theta=1000000.0)),
+    # beyond the reference list: MoE coding model (expert parallelism target)
+    "mistralai/Mixtral-8x7B-Instruct-v0.1": ZooEntry(
+        "mistralai/Mixtral-8x7B-Instruct-v0.1", "llama", "8x7B",
+        _llama(32000, 4096, 14336, 32, 32, kv_heads=8, rope_theta=1000000.0,
+               num_experts=8, num_experts_per_tok=2)),
 }
 
 # short aliases (config files accept either)

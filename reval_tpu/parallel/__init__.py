@@ -11,6 +11,12 @@ compiles the communication.
 from .mesh import make_mesh, init_distributed, mesh_axis_sizes
 from .sharding import param_specs, shard_params, batch_sharding, paged_cache_spec
 from .ring_attention import ring_self_attention, ring_attention_sharded
+from .pipeline import (
+    pipeline_decode_chunk,
+    pipeline_prefill,
+    pp_param_specs,
+    shard_params_pp,
+)
 
 __all__ = [
     "batch_sharding",
@@ -19,7 +25,11 @@ __all__ = [
     "mesh_axis_sizes",
     "paged_cache_spec",
     "param_specs",
+    "pipeline_decode_chunk",
+    "pipeline_prefill",
+    "pp_param_specs",
     "ring_attention_sharded",
     "ring_self_attention",
     "shard_params",
+    "shard_params_pp",
 ]

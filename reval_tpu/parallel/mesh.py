@@ -22,16 +22,23 @@ from jax.sharding import Mesh
 __all__ = ["make_mesh", "init_distributed", "mesh_axis_sizes"]
 
 
-def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, devices=None) -> Mesh:
-    """Build a ``(dp, sp, tp)`` mesh (singleton axes are kept — named axes
-    must exist for the sharding rules to reference them)."""
+def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, pp: int = 1, ep: int = 1,
+              devices=None) -> Mesh:
+    """Build a ``(dp, pp, sp, ep, tp)`` mesh (singleton axes are kept —
+    named axes must exist for the sharding rules to reference them).
+
+    Axis order puts the heaviest-traffic axes innermost (fastest ICI
+    links): ``tp`` exchanges activations every layer, ``ep`` all-to-alls
+    tokens every MoE block, ``sp`` ring-passes KV blocks, while ``pp``
+    moves one activation per microbatch tick and ``dp`` only syncs at
+    boundaries — those two can ride slower links (or DCN multi-host)."""
     devices = list(devices if devices is not None else jax.devices())
-    need = tp * dp * sp
+    need = tp * dp * sp * pp * ep
     if len(devices) < need:
-        raise ValueError(f"mesh needs {need} devices (tp={tp} dp={dp} sp={sp}), "
-                         f"have {len(devices)}")
-    arr = np.array(devices[:need]).reshape(dp, sp, tp)
-    return Mesh(arr, ("dp", "sp", "tp"))
+        raise ValueError(f"mesh needs {need} devices (tp={tp} dp={dp} sp={sp} "
+                         f"pp={pp} ep={ep}), have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, pp, sp, ep, tp)
+    return Mesh(arr, ("dp", "pp", "sp", "ep", "tp"))
 
 
 def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:

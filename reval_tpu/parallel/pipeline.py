@@ -1,0 +1,293 @@
+"""Pipeline parallelism (``pp`` mesh axis): GPipe microbatching over layers.
+
+The reference has no pipeline parallelism to port (its largest configs ride
+vLLM tensor parallelism, reference inference.py:92); this is the TPU-native
+answer to the same scaling problem for models whose layer stack does not fit
+one chip's HBM even sharded tp-wide (BASELINE.json configs[4]: CodeLlama-70B
+on v5p-16, where tp=16 would waste ICI on 70B's modest head count — pp=2/4
+over DCN-adjacent hosts keeps tp inside each host).
+
+Design (TPU-first):
+- The params pytree already stacks every per-layer weight as ``[L, ...]``
+  (models/model.py), so a pipeline stage is nothing more than sharding the
+  leading layer dim over the ``pp`` mesh axis: stage ``s`` holds layers
+  ``[s*L/P, (s+1)*L/P)``.  No parameter surgery, no per-stage module types.
+- The schedule runs inside one ``jax.shard_map`` over ``pp`` (other mesh
+  axes stay automatic, so tp sharding composes): every tick, each stage
+  scans its local layers over its current microbatch and ``ppermute``s the
+  activation to the next stage.  Data-dependent stage behaviour (pipeline
+  fill/drain) is expressed with clamped indices + scratch slots, not Python
+  control flow — everything jits to one XLA while loop.
+- **Prefill** is GPipe: ``M >= P`` microbatches, bubble fraction
+  ``(P-1)/(M+P-1)``.  KV writes land in the stage-local shard of the cache
+  (the cache's layer dim is ``pp``-sharded too, so cache traffic never
+  crosses stages).
+- **Decode** is a token ring: exactly ``M = P`` microbatches in flight, one
+  per stage; the last stage samples the next token, embeds it, and the ring
+  ``ppermute`` returns it to stage 0 — after the ``P``-tick fill, every
+  stage is busy every tick (no steady-state bubble), and a chunk of
+  ``steps`` tokens costs ``steps*P + P - 1`` ticks of ``1/P`` of the model
+  each.
+
+Scratch-slot convention: inactive (fill/drain) ticks write to dedicated
+scratch rows — batch row ``B`` (the cache carries ``B + mb`` rows) and
+output slot ``M`` — so no ``where``-select over whole cache buffers is
+needed and XLA keeps the real writes in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+from ..models.model import KVCache, _block, _embed, _norm, _unembed
+from ..ops import decode_attention, prefill_attention, rope_angles
+from .mesh import mesh_axis_sizes
+from .sharding import param_specs
+
+__all__ = ["pp_param_specs", "shard_params_pp", "pipeline_prefill",
+           "pipeline_decode_chunk", "pp_size"]
+
+
+def pp_size(mesh: Mesh) -> int:
+    return mesh_axis_sizes(mesh).get("pp", 1)
+
+
+def pp_param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    """The tp/replication rules of ``parallel.sharding`` with the stacked
+    layer dim additionally sharded over ``pp`` (stage = contiguous block of
+    layers).  Top-level leaves (embed/lm_head/final norm) replicate across
+    stages: the first stage needs the embedding, the last stage needs the
+    head, and both are small next to the layer stack."""
+    specs = param_specs(params, cfg, mesh)
+    pp = pp_size(mesh)
+    if pp == 1:
+        return specs
+    if cfg.num_layers % pp:
+        raise ValueError(f"pp={pp} must evenly divide num_layers={cfg.num_layers}")
+    specs["layers"] = {
+        name: P("pp", *spec[1:]) for name, spec in specs["layers"].items()
+    }
+    return specs
+
+
+def shard_params_pp(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    specs = pp_param_specs(params, cfg, mesh)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+
+
+def _ring(n: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _varying(x, axis: str = "pp"):
+    """Mark a replicated value as device-varying over ``axis`` so it can
+    seed a loop carry whose body output is varying (shard_map VMA rule)."""
+    return lax.pcast(x, (axis,), to="varying")
+
+
+def _run_local_layers_prefill(h, layers, pad, cfg, kv_dtype):
+    """Scan this stage's layers over one left-padded microbatch block;
+    returns the block output and the stage-local KV ([Lp, mb, T, H_kv, D])."""
+    t = h.shape[1]
+    positions = jnp.maximum(jnp.arange(t)[None, :] - pad[:, None], 0)
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(hc, layer):
+        kv = {}
+
+        def attend(q, k, v):
+            kv["k"], kv["v"] = k.astype(kv_dtype), v.astype(kv_dtype)
+            return prefill_attention(q, k, v, pad, window=cfg.sliding_window)
+
+        hc = _block(hc, layer, cfg, cos, sin, attend)
+        return hc, (kv["k"], kv["v"])
+
+    return lax.scan(layer_step, h, layers)
+
+
+def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                     pad_len: jnp.ndarray, cache: KVCache, mesh: Mesh,
+                     n_micro: int) -> tuple[jnp.ndarray, KVCache]:
+    """GPipe prefill of a left-padded [B, T] block over the ``pp`` axis.
+
+    ``cache`` must carry ``B + B//n_micro`` batch rows (the tail rows are
+    the fill/drain scratch — see module docstring); rows ``[0, B)`` come
+    back filled at positions ``[0, T)``.  Returns last-position logits
+    ``[B, 1, V]`` (the only logits generation needs) and the cache.
+    """
+    pp = pp_size(mesh)
+    b, t = tokens.shape
+    m_count = n_micro
+    if b % m_count:
+        raise ValueError(f"batch {b} must divide into n_micro={m_count}")
+    mb = b // m_count
+    if m_count < pp:
+        raise ValueError(f"n_micro={m_count} must be >= pp={pp}")
+
+    h = _embed(params, cfg, tokens)
+    hm = h.reshape(m_count, mb, t, h.shape[-1])
+    padm = pad_len.reshape(m_count, mb)
+    layers = params["layers"]
+    top = {k: v for k, v in params.items() if k != "layers"}
+
+    def staged(layers, hm, padm, ck, cv):
+        stage = lax.axis_index("pp")
+
+        def tick(ti, state):
+            h_cur, ck, cv, outbuf = state
+            m = ti - stage
+            active = (m >= 0) & (m < m_count)
+            mc = jnp.clip(m, 0, m_count - 1)
+            h_in = jnp.where(stage == 0,
+                             lax.dynamic_index_in_dim(hm, mc, 0, keepdims=False),
+                             h_cur)
+            pad = lax.dynamic_index_in_dim(padm, mc, 0, keepdims=False)
+            h_out, (ks, vs) = _run_local_layers_prefill(
+                h_in, layers, pad, cfg, ck.dtype)
+            row = jnp.where(active, mc * mb, b)
+            ck = lax.dynamic_update_slice(ck, ks, (0, row, 0, 0, 0))
+            cv = lax.dynamic_update_slice(cv, vs, (0, row, 0, 0, 0))
+            # left-padding puts every row's final prompt token last
+            h_last = h_out[:, -1, :]
+            is_out = active & (stage == pp - 1)
+            val = jnp.where(stage == pp - 1, h_last, jnp.zeros_like(h_last))
+            outbuf = lax.dynamic_update_slice(
+                outbuf, val[None], (jnp.where(is_out, mc, m_count), 0, 0))
+            h_next = lax.ppermute(h_out, "pp", _ring(pp))
+            return (h_next, ck, cv, outbuf)
+
+        h0 = _varying(jnp.zeros_like(hm[0]))
+        outbuf = _varying(jnp.zeros((m_count + 1, mb, hm.shape[-1]), hm.dtype))
+        _, ck, cv, outbuf = lax.fori_loop(
+            0, m_count + pp - 1, tick, (h0, ck, cv, outbuf))
+        return lax.psum(outbuf[:m_count], "pp"), ck, cv
+
+    outbuf, ck, cv = jax.shard_map(
+        staged, mesh=mesh, axis_names={"pp"},
+        in_specs=(P("pp"), P(), P(), P("pp"), P("pp")),
+        out_specs=(P(), P("pp"), P("pp")),
+    )(layers, hm, padm, cache.k, cache.v)
+
+    h_final = _norm(outbuf.reshape(b, -1), top["final_norm_w"],
+                    top.get("final_norm_b"), cfg)
+    logits = _unembed(top, cfg, h_final)
+    return logits[:, None, :], KVCache(ck, cv)
+
+
+def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
+                          pad_len: jnp.ndarray, cache: KVCache,
+                          start_pos: jnp.ndarray, temperature, key,
+                          mesh: Mesh, *, steps: int):
+    """Token-ring decode: ``steps`` tokens for every row of [B, 1]
+    ``first_token`` (engine-chunk contract: returns ``(toks [B, steps],
+    cache, last [B, 1])``).
+
+    ``M = P`` microbatches circulate; the last stage samples microbatch
+    ``m``'s next token, embeds it, and the ring permute hands it straight
+    back to stage 0 one tick later — zero steady-state bubble.
+    """
+    # function-local so ``reval_tpu.parallel`` (a models-layer dependency)
+    # never imports the inference package at module load
+    from ..inference.tpu.sampling import sample_token
+
+    pp = pp_size(mesh)
+    b = first_token.shape[0]
+    if b % pp:
+        raise ValueError(f"batch {b} must divide into pp={pp} ring microbatches")
+    mb = b // pp
+    n_total = steps * pp
+
+    emb_first = _embed(params, cfg, first_token)       # [B, 1, D]
+    hm = emb_first.reshape(pp, mb, 1, emb_first.shape[-1])
+    padm = pad_len.reshape(pp, mb)
+    layers = params["layers"]
+    top = {k: v for k, v in params.items() if k != "layers"}
+
+    def staged(layers, top, hm, padm, ck, cv):
+        stage = lax.axis_index("pp")
+        lp = jax.tree_util.tree_leaves(layers)[0].shape[0]
+        s_max = ck.shape[2]
+
+        def tick(ti, state):
+            h_cur, ck, cv, tokbuf = state
+            n = ti - stage
+            active = (n >= 0) & (n < n_total)
+            nc = jnp.clip(n, 0, n_total - 1)
+            m = nc % pp
+            j = nc // pp
+            h_in = jnp.where(
+                (stage == 0) & (j == 0),
+                lax.dynamic_index_in_dim(hm, m, 0, keepdims=False), h_cur)
+            pad = lax.dynamic_index_in_dim(padm, m, 0, keepdims=False)
+            row = jnp.where(active, m * mb, b)
+            pos = start_pos + j
+            positions = jnp.maximum(pos - pad, 0)[:, None]
+            cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+            # unrolled over the stage's layers with STATIC layer indices —
+            # the same choice as decode_step (models/model.py): scanning
+            # with the cache in carry defeats in-place updates
+            h_out = h_in
+            for li in range(lp):
+                layer = jax.tree_util.tree_map(lambda x: x[li], layers)
+
+                def attend(q, k, v, li=li):
+                    nonlocal ck, cv
+                    ck = lax.dynamic_update_slice(
+                        ck, k[None].astype(ck.dtype), (li, row, pos, 0, 0))
+                    cv = lax.dynamic_update_slice(
+                        cv, v[None].astype(cv.dtype), (li, row, pos, 0, 0))
+                    kc = lax.dynamic_slice(
+                        ck, (li, row, 0, 0, 0),
+                        (1, mb, s_max, ck.shape[3], ck.shape[4]))[0]
+                    vc = lax.dynamic_slice(
+                        cv, (li, row, 0, 0, 0),
+                        (1, mb, s_max, cv.shape[3], cv.shape[4]))[0]
+                    return decode_attention(q, kc, vc, pad, pos,
+                                            window=cfg.sliding_window)
+
+                h_out = _block(h_out, layer, cfg, cos, sin, attend)
+
+            def sample_and_embed(h_out):
+                hf = _norm(h_out[:, 0, :], top["final_norm_w"],
+                           top.get("final_norm_b"), cfg)
+                logits = _unembed(top, cfg, hf)
+                tok = sample_token(logits, temperature,
+                                   jax.random.fold_in(key, nc))
+                return tok.astype(jnp.int32), _embed(
+                    top, cfg, tok[:, None]).astype(h_out.dtype)
+
+            def passthrough(h_out):
+                return (_varying(jnp.zeros((mb,), jnp.int32)), h_out)
+
+            tok, h_ring = lax.cond(stage == pp - 1, sample_and_embed,
+                                   passthrough, h_out)
+            is_out = active & (stage == pp - 1)
+            tokbuf = lax.dynamic_update_slice(
+                tokbuf, tok[None], (jnp.where(is_out, nc, n_total), 0))
+            h_next = lax.ppermute(h_ring, "pp", _ring(pp))
+            return (h_next, ck, cv, tokbuf)
+
+        h0 = _varying(jnp.zeros_like(hm[0]))
+        tokbuf = _varying(jnp.zeros((n_total + 1, mb), jnp.int32))
+        _, ck, cv, tokbuf = lax.fori_loop(
+            0, n_total + pp - 1, tick, (h0, ck, cv, tokbuf))
+        return lax.psum(tokbuf[:n_total], "pp"), ck, cv
+
+    tokbuf, ck, cv = jax.shard_map(
+        staged, mesh=mesh, axis_names={"pp"},
+        in_specs=(P("pp"), P(), P(), P(), P("pp"), P("pp")),
+        out_specs=(P(), P("pp"), P("pp")),
+    )(layers, top, hm, padm, cache.k, cache.v)
+
+    # tokbuf flat index n = j*P + m holds step j of microbatch m
+    toks = tokbuf.reshape(steps, pp, mb).transpose(1, 2, 0).reshape(b, steps)
+    return toks, KVCache(ck, cv), toks[:, -1:]

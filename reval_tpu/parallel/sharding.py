@@ -20,7 +20,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.configs import ModelConfig
 
 __all__ = ["param_specs", "shard_params", "batch_sharding", "kv_cache_spec",
-           "paged_cache_spec"]
+           "paged_cache_spec", "resolve_moe_impl"]
+
+
+def resolve_moe_impl(cfg: ModelConfig, mesh: Mesh | None) -> ModelConfig:
+    """Pick the MoE formulation for a mesh: the exact ragged path cannot
+    shard its data-dependent row partition over ``ep``, so ep>1 meshes
+    switch to the capacity-dispatch path (see models/model.py).  Returns
+    a config copy — engines call this once at construction."""
+    import dataclasses
+
+    if (cfg.num_experts and mesh is not None
+            and dict(zip(mesh.axis_names, mesh.devices.shape)).get("ep", 1) > 1
+            and cfg.moe_impl != "dispatch"):
+        return dataclasses.replace(cfg, moe_impl="dispatch")
+    return cfg
 
 # leaf name → spec for stacked [L, ...] layer weights
 _LAYER_RULES = {
@@ -49,6 +63,17 @@ _LAYER_RULES = {
     "o_w_scale": P(),
     "down_w_scale": P(),
     "proj_w_scale": P(),
+    # mixture-of-experts: expert dim over ``ep``, per-expert FFN dims over
+    # ``tp`` (the batched-einsum formulation in models/model.py keeps the
+    # expert dim leading, so ep shards experts whole — the dispatch
+    # all-to-all is XLA-inserted from the scatter/gather shardings)
+    "moe_gate_w": P(None, "ep", None, "tp"),
+    "moe_up_w": P(None, "ep", None, "tp"),
+    "moe_down_w": P(None, "ep", "tp", None),
+    "moe_gate_w_scale": P(None, "ep", "tp"),
+    "moe_up_w_scale": P(None, "ep", "tp"),
+    "moe_down_w_scale": P(None, "ep", None),
+    "router_w": P(),     # [L, D, E] — tiny; replicate so routing is local
     # replicated small leaves
     "o_b": P(),
     "proj_b": P(),
@@ -68,12 +93,15 @@ _TOP_RULES = {
 
 
 def _divisible(cfg: ModelConfig, mesh: Mesh) -> dict[str, bool]:
-    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = sizes.get("tp", 1)
+    ep = sizes.get("ep", 1)
     return {
         "heads": cfg.num_heads % tp == 0,
         "kv_heads": cfg.num_kv_heads % tp == 0,
         "ffn": cfg.intermediate_size % tp == 0,
         "vocab": cfg.vocab_size % tp == 0,
+        "experts": cfg.num_experts % ep == 0 if cfg.num_experts else True,
     }
 
 
@@ -101,6 +129,11 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
             return P()
         if base in ("gate_w", "up_w", "down_w", "fc_w", "proj_w", "fc_b") and not div["ffn"]:
             return P()
+        if base in ("moe_gate_w", "moe_up_w", "moe_down_w"):
+            # drop per-axis on non-divisible dims, keep the rest
+            spec = P(*(None if (a == "ep" and not div["experts"])
+                       or (a == "tp" and not div["ffn"]) else a
+                       for a in spec))
         return spec
 
     specs: dict = {}

@@ -28,7 +28,8 @@ class TestMesh:
 
     def test_make_mesh_axes(self):
         mesh = make_mesh(tp=2, dp=2, sp=2)
-        assert mesh_axis_sizes(mesh) == {"dp": 2, "sp": 2, "tp": 2}
+        assert mesh_axis_sizes(mesh) == {"dp": 2, "pp": 1, "sp": 2,
+                                         "ep": 1, "tp": 2}
 
     def test_too_many_devices_raises(self):
         with pytest.raises(ValueError, match="needs 16 devices"):
