@@ -15,7 +15,7 @@ class TPUBackend(InferenceBackend):
     def __init__(self, model_id: str, model_path: str | None = None, temp: float = 0.8,
                  prompt_type: str = "direct", dtype: str = "bfloat16",
                  num_chips: int = 1, dp_size: int = 1, pp_size: int = 1,
-                 batch_size: int = 8,
+                 sp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
                  engine: str = "paged", kv_dtype: str = "", **kwargs):
         """``engine``: "paged" (default — continuous batching over the
@@ -26,6 +26,10 @@ class TPUBackend(InferenceBackend):
         (GPipe prefill + token-ring decode over pp stages, composed with
         ``num_chips``-wide tp per stage) for layer stacks that exceed a
         tp-sharded chip's HBM.
+
+        ``sp_size``: >1 adds sequence parallelism on the static engine —
+        ring-attention prefill with the sequence (and KV cache) sharded
+        over sp, for prompts past one chip's attention working set.
 
         ``dtype``: "bfloat16" (default), "float32", or "int8" —
         weight-only int8 quantization (models/quant.py): bf16 compute,
@@ -42,6 +46,14 @@ class TPUBackend(InferenceBackend):
                 "TPU backend needs model_path (a HuggingFace checkpoint directory "
                 "containing config.json + *.safetensors)"
             )
+        if sp_size > 1 and pp_size > 1:
+            raise ValueError("sp_size and pp_size cannot combine yet — "
+                             "pick sequence OR pipeline parallelism")
+        if sp_size > 1 and engine == "paged":
+            raise ValueError(
+                "sequence parallelism runs on the static engine "
+                "(the paged scheduler has no sp path) — pass "
+                "engine='static' with sp_size>1")
         if pp_size > 1:
             # pipeline parallelism implies the static engine (the paged
             # scheduler has no pp path); kv_dtype is a paged-pool feature
@@ -76,8 +88,8 @@ class TPUBackend(InferenceBackend):
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
             )
         else:
-            # the static engine shards one rectangular batch over a dp×tp
-            # mesh — one jit program over all chips, no replica threads
+            # the static engine shards one rectangular batch over a
+            # dp×sp×tp mesh — one jit program over all chips
             if kv_dtype:
                 raise ValueError(
                     "kv_dtype is a paged-pool feature; the static engine's "
@@ -87,7 +99,7 @@ class TPUBackend(InferenceBackend):
 
             self.engine = TPUEngine.from_pretrained(
                 model_path, dtype=dtype, tp_size=num_chips, dp_size=dp_size,
-                batch_size=batch_size, max_seq_len=max_seq_len,
+                sp_size=sp_size, batch_size=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only,
             )
 

@@ -176,13 +176,35 @@ class TPUEngine:
             from ...parallel.sharding import kv_cache_spec, resolve_moe_impl
 
             cfg = self.cfg = resolve_moe_impl(cfg, mesh)
-            dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            dp = sizes.get("dp", 1)
             if batch_size % dp:
                 raise ValueError(f"batch_size {batch_size} must divide by dp={dp}")
+            self._sp = sizes.get("sp", 1)
+            if self._sp > 1 and MIN_BUCKET % self._sp:
+                raise ValueError(
+                    f"sp={self._sp} must divide the bucket granularity "
+                    f"{MIN_BUCKET} (power-of-two sp up to {MIN_BUCKET})")
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
-            self._cache_sharding = NamedSharding(mesh, kv_cache_spec(cfg, mesh))
-        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
+            if sizes.get("sp", 1) > 1:
+                # sequence parallelism: prefill via ring attention with T
+                # sharded over sp; the cache keeps S sp-sharded and decode
+                # attention distributes for free (see parallel/sp_prefill)
+                from ...parallel.sp_prefill import (
+                    sequence_parallel_prefill, sp_kv_cache_spec)
+
+                self._cache_sharding = NamedSharding(
+                    mesh, sp_kv_cache_spec(cfg, mesh))
+                sp_prefill = jax.jit(partial(
+                    sequence_parallel_prefill, cfg=cfg, mesh=mesh))
+            else:
+                self._cache_sharding = NamedSharding(mesh, kv_cache_spec(cfg, mesh))
+                sp_prefill = None
+        else:
+            sp_prefill = None
+        self._jit_prefill = sp_prefill or jax.jit(
+            partial(prefill, cfg=cfg, logits_mode="last"))
         self._jit_decode_chunk = jax.jit(
             partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
             donate_argnames=("cache",),
@@ -191,18 +213,22 @@ class TPUEngine:
     # -- construction ------------------------------------------------------
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16", tp_size: int = 1,
-                        dp_size: int = 1, batch_size: int = 8, max_seq_len: int = 8192,
+                        dp_size: int = 1, sp_size: int = 1, batch_size: int = 8,
+                        max_seq_len: int = 8192,
                         tokenizer=None, seed: int = 0,
                         local_devices_only: bool = False) -> "TPUEngine":
         """``local_devices_only`` confines the mesh to this host's chips —
         the replicated-engines multihost mode (one full replica per host,
-        prompts sharded over DCN by the fleet)."""
+        prompts sharded over DCN by the fleet).  ``sp_size``: shard
+        prefill sequences (and the KV cache) over a sequence-parallel
+        ring for prompts past one chip's attention working set."""
         mesh = None
-        if tp_size * dp_size > 1:
+        if tp_size * dp_size * sp_size > 1:
             from ...parallel import make_mesh
 
             devices = jax.local_devices() if local_devices_only else None
-            mesh = make_mesh(tp=tp_size, dp=dp_size, devices=devices)
+            mesh = make_mesh(tp=tp_size, dp=dp_size, sp=sp_size,
+                             devices=devices)
         if mesh is not None and dtype != "int8":
             # shard-direct load (see PagedTPUEngine.from_pretrained)
             from ...models import load_checkpoint_sharded
@@ -241,6 +267,15 @@ class TPUEngine:
         pipelined engine over-allocates scratch rows for fill/drain ticks."""
         return b
 
+    def _cache_len(self, t: int, max_new: int) -> int:
+        """KV-cache sequence length for a ``t``-token bucket.  An
+        sp-sharded cache dim must divide evenly over the mesh, so round
+        up; the extra slots are past every row's final position and the
+        decode mask (``cols <= cur_pos``) never reads them."""
+        s = t + max_new
+        sp = getattr(self, "_sp", 1)
+        return -(-s // sp) * sp
+
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None) -> list[str]:
@@ -277,7 +312,8 @@ class TPUEngine:
             tokens[row, t - len(seq):] = seq
             pad_len[row] = t - len(seq)
 
-        cache = init_kv_cache(self.cfg, self._cache_rows(b), t + max_new_tokens,
+        cache = init_kv_cache(self.cfg, self._cache_rows(b),
+                              self._cache_len(t, max_new_tokens),
                               dtype=self.params["embed"].dtype)
         dev_tokens, dev_pad = jnp.asarray(tokens), jnp.asarray(pad_len)
         if self._input_sharding is not None:

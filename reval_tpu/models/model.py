@@ -262,7 +262,8 @@ def _unembed(params, cfg: ModelConfig, h):
 
 
 def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
-            cache: KVCache, logits_mode: str = "all") -> tuple[jnp.ndarray, KVCache]:
+            cache: KVCache, logits_mode: str = "all", attend_fn=None,
+            constrain=None) -> tuple[jnp.ndarray, KVCache]:
     """Process a left-padded prompt block [B, T]; fill cache positions
     [0, T); return logits and the updated cache.
 
@@ -270,11 +271,21 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
     [B, 1, V] for the final position only — generation needs nothing else,
     and skipping the [B, T, V] unembed matmul removes the single largest
     waste in prefill (T× the needed FLOPs into the vocab dimension).
+
+    ``attend_fn(q, k, v)`` overrides the attention (the only piece that
+    varies across prefill deployments — the sequence-parallel path swaps
+    in ring attention); ``constrain(h)`` (optional) re-annotates the
+    activation sharding after embed and after every layer.
     """
     b, t = tokens.shape
     h = _embed(params, cfg, tokens)
+    if constrain is not None:
+        h = constrain(h)
     positions = jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    if attend_fn is None:
+        def attend_fn(q, k, v):
+            return prefill_attention(q, k, v, pad_len, window=cfg.sliding_window)
 
     def layer_step(h, xs):
         layer, k_slot, v_slot = xs
@@ -285,9 +296,11 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
                 k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
             kv["v"] = jax.lax.dynamic_update_slice(
                 v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
-            return prefill_attention(q, k, v, pad_len, window=cfg.sliding_window)
+            return attend_fn(q, k, v)
 
         h = _block(h, layer, cfg, cos, sin, attend)
+        if constrain is not None:
+            h = constrain(h)
         return h, (kv["k"], kv["v"])
 
     h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))

@@ -110,6 +110,7 @@ _ALIASES = {
     "deepseek-coder-6.7b": "deepseek-ai/deepseek-coder-6.7b-base",
     "codellama-34b": "codellama/CodeLlama-34b-Instruct-hf",
     "codellama-70b": "codellama/CodeLlama-70b-Instruct-hf",
+    "mixtral-8x7b": "mistralai/Mixtral-8x7B-Instruct-v0.1",
 }
 
 

@@ -34,14 +34,21 @@ __all__ = ["ring_self_attention", "ring_attention_sharded"]
 _NEG_INF = -1e30
 
 
-def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale):
+def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale, pad_len=None):
     """One online-softmax accumulation of q against a KV block.
 
     q: [B, Tq, H_kv, G, D]; k/v: [B, Tk, H_kv, D]; positions: [Tq]/[Tk];
     m/l: [B, H_kv, G, Tq, 1]; acc: [B, Tq, H_kv, G, D].
+
+    ``pad_len`` [B]: left-pad counts.  Padding shifts query and key
+    positions equally, so the causal comparison is pad-invariant in
+    buffer coordinates — only pad KEYS need masking out.
     """
     scores = jnp.einsum("bqngd,bknd->bngqk", q, k) * scale
     mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None]
+    if pad_len is not None:
+        valid_key = k_pos[None, :] >= pad_len[:, None]     # [B, Tk]
+        mask = mask & valid_key[:, None, None, None, :]
     scores = jnp.where(mask, scores, _NEG_INF)
     m_cur = scores.max(axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_cur)
@@ -54,8 +61,10 @@ def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
-def _ring_body(q, k, v, *, axis_name: str | None, axis_size: int, scale):
-    """Local ring-attention body.  q: [B, Tl, H, D]; k/v: [B, Tl, H_kv, D]."""
+def _ring_body(q, k, v, pad_len, *, axis_name: str | None, axis_size: int,
+               scale):
+    """Local ring-attention body.  q: [B, Tl, H, D]; k/v: [B, Tl, H_kv, D];
+    pad_len: [B] or None."""
     b, t_loc, h, d = q.shape
     n_kv = k.shape[2]
     g = h // n_kv
@@ -77,7 +86,7 @@ def _ring_body(q, k, v, *, axis_name: str | None, axis_size: int, scale):
         # bf16 caches move half the bytes per ICI hop
         m, l, acc = _block_update(qg, k.astype(jnp.float32),
                                   v.astype(jnp.float32), q_pos, k_pos,
-                                  m, l, acc, scale)
+                                  m, l, acc, scale, pad_len=pad_len)
         if axis_name is not None and step + 1 < axis_size:
             perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
             k = jax.lax.ppermute(k, axis_name, perm)
@@ -88,22 +97,24 @@ def _ring_body(q, k, v, *, axis_name: str | None, axis_size: int, scale):
     return out.reshape(b, t_loc, h, d).astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, *, axis_name: str | None = None,
+def ring_self_attention(q, k, v, pad_len=None, *, axis_name: str | None = None,
                         axis_size: int = 1, scale: float | None = None):
     """Causal self-attention with ring-rotated KV blocks.
 
     Call inside ``shard_map`` with ``axis_name`` set (q/k/v are the local
     sequence shards), or stand-alone with ``axis_name=None`` for the
-    single-device reference semantics.  Sequences are unpadded; shard
-    layout is contiguous (device i holds positions [i·Tl, (i+1)·Tl)).
+    single-device reference semantics.  Shard layout is contiguous
+    (device i holds positions [i·Tl, (i+1)·Tl)); ``pad_len`` [B] marks
+    left-padding (pad keys masked; causality is pad-invariant).
     """
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
-    return _ring_body(q, k, v, axis_name=axis_name, axis_size=axis_size,
-                      scale=scale)
+    return _ring_body(q, k, v, pad_len, axis_name=axis_name,
+                      axis_size=axis_size, scale=scale)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
+def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, *,
+                           sp_axis: str = "sp", head_axis: str | None = None,
                            scale: float | None = None):
     """Shard ``q, k, v`` ([B, T, H, D], T divisible by the ``sp`` axis
     size) over the sequence dimension and run ring attention.
@@ -111,7 +122,9 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
     The returned array is sequence-sharded on the same axis; callers
     under ``jit`` can keep computing on it shard-local (norms/MLPs are
     elementwise over T) so the full sequence never materialises on one
-    device.
+    device.  ``head_axis`` additionally shards the head dim (attention is
+    head-local, so this is free parallelism — pass "tp" when it divides
+    both H and H_kv; GQA group blocks stay contiguous per shard).
     """
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[sp_axis]
     t = q.shape[1]
@@ -119,6 +132,11 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
         raise ValueError(f"sequence length {t} not divisible by sp={axis_size}")
     body = partial(ring_self_attention, axis_name=sp_axis,
                    axis_size=axis_size, scale=scale)
-    spec = P(None, sp_axis, None, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    spec = P(None, sp_axis, head_axis, None)
+    if pad_len is None:
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)(q, k, v)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec, P(None)),
+        out_specs=spec, check_vma=False)(q, k, v, pad_len)

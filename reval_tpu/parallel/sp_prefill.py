@@ -1,0 +1,77 @@
+"""Sequence-parallel prefill: one long prompt spread over the ``sp`` axis.
+
+Long-context is first-class here (the reference has none — SURVEY §5.7):
+when a prompt's KV or attention working set outgrows one chip, the
+*sequence* dimension shards over the mesh.  Everything except attention
+is position-local (norms, projections, MLPs — XLA keeps them sharded over
+T from the activation constraint); attention is the one cross-position op
+and runs as ring attention (`parallel/ring_attention.py`): KV blocks
+rotate around the ``sp`` ring, each hop overlapped with the block compute.
+
+The produced KV cache keeps the sequence dim ``sp``-sharded.  Decode then
+works unchanged: `decode_attention`'s score einsum contracts the sharded
+S dim, so XLA turns each step into shard-local partial attention + one
+psum — distributed decode attention for free, no code fork (the
+engine-side sharding constraint is the only sp-specific line).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+from ..models.model import KVCache, prefill
+from .mesh import mesh_axis_sizes
+from .ring_attention import ring_attention_sharded
+
+__all__ = ["sequence_parallel_prefill", "sp_kv_cache_spec"]
+
+
+def sp_kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
+    """[L, B, S, H_kv, D] with the sequence dim over ``sp`` (kv heads over
+    ``tp`` when divisible, batch over ``dp`` — same rules as the
+    contiguous spec, plus sp)."""
+    sizes = mesh_axis_sizes(mesh)
+    tp_ok = cfg.num_kv_heads % sizes.get("tp", 1) == 0
+    return P(None, "dp", "sp", "tp" if tp_ok else None, None)
+
+
+def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                              pad_len: jnp.ndarray, cache: KVCache,
+                              mesh: Mesh) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill a left-padded [B, T] block with T sharded over ``sp``.
+
+    Same contract as ``models.model.prefill(..., logits_mode="last")``:
+    the shared prefill scaffold runs with ring attention injected as the
+    ``attend_fn`` and an sp sharding constraint on the activations.
+    T must be divisible by the sp axis size.
+    """
+    sp = mesh_axis_sizes(mesh).get("sp", 1)
+    b, t = tokens.shape
+    if t % sp:
+        raise ValueError(f"prefill length {t} must be divisible by sp={sp}")
+    if cfg.sliding_window is not None:
+        raise NotImplementedError(
+            "ring attention has no sliding-window mask; run windowed models "
+            "(Mistral/StarCoder2) on a non-sp mesh — their window already "
+            "bounds the attention working set")
+    sizes = mesh_axis_sizes(mesh)
+    # shard heads over tp inside the ring too (when divisible): without
+    # this every tp device would all-gather full-head q/k/v and compute
+    # redundant attention, doubling the working set sp exists to shrink
+    tp = sizes.get("tp", 1)
+    heads_ok = (cfg.num_heads % tp == 0 and cfg.num_kv_heads % tp == 0)
+    head_axis = "tp" if tp > 1 and heads_ok else None
+    seq_sharding = NamedSharding(mesh, P(None, "sp", None))
+
+    def constrain(h):
+        return jax.lax.with_sharding_constraint(h, seq_sharding)
+
+    def attend_fn(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, pad_len,
+                                      head_axis=head_axis)
+
+    return prefill(params, cfg, tokens, pad_len, cache, logits_mode="last",
+                   attend_fn=attend_fn, constrain=constrain)
