@@ -63,6 +63,8 @@ def build_config_interactively() -> dict:
             cfg["num_chips"] = _ask("Number of TPU chips (tensor-parallel)", 1, int)
             cfg["dp_size"] = _ask("Data-parallel degree", 1, int)
             cfg["pp_size"] = _ask("Pipeline-parallel stages (1 = off)", 1, int)
+            cfg["sp_size"] = _ask("Sequence-parallel degree (1 = off; "
+                                  "long-context ring prefill)", 1, int)
         elif backend == "server":
             cfg["port"] = _ask("Enter port number", 3000, int)
         elif backend == "replay":

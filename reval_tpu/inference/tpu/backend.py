@@ -17,10 +17,13 @@ class TPUBackend(InferenceBackend):
                  num_chips: int = 1, dp_size: int = 1, pp_size: int = 1,
                  sp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
-                 engine: str = "paged", kv_dtype: str = "", **kwargs):
-        """``engine``: "paged" (default — continuous batching over the
-        paged KV cache + native scheduler) or "static" (rectangular
-        batches; the dp>1 prompt-sharding path lives here).
+                 engine: str | None = None, kv_dtype: str = "", **kwargs):
+        """``engine``: "paged" (continuous batching over the paged KV
+        cache + native scheduler) or "static" (rectangular batches; the
+        dp/sp/pp sharding paths live here).  Default (None) auto-selects:
+        paged, unless pp_size/sp_size>1 demand the static engine.
+        Explicitly requesting "paged" together with pp/sp is an error
+        rather than a silent engine swap.
 
         ``pp_size``: >1 selects the pipeline-parallel static engine
         (GPipe prefill + token-ring decode over pp stages, composed with
@@ -49,11 +52,13 @@ class TPUBackend(InferenceBackend):
         if sp_size > 1 and pp_size > 1:
             raise ValueError("sp_size and pp_size cannot combine yet — "
                              "pick sequence OR pipeline parallelism")
-        if sp_size > 1 and engine == "paged":
+        if engine == "paged" and (sp_size > 1 or pp_size > 1):
             raise ValueError(
-                "sequence parallelism runs on the static engine "
-                "(the paged scheduler has no sp path) — pass "
-                "engine='static' with sp_size>1")
+                "sequence/pipeline parallelism runs on the static engine "
+                "(the paged scheduler has no sp/pp path) — drop the "
+                "explicit engine='paged' or the sp_size/pp_size")
+        if engine is None:
+            engine = "static" if (sp_size > 1 or pp_size > 1) else "paged"
         if pp_size > 1:
             # pipeline parallelism implies the static engine (the paged
             # scheduler has no pp path); kv_dtype is a paged-pool feature

@@ -185,6 +185,11 @@ class TPUEngine:
                 raise ValueError(
                     f"sp={self._sp} must divide the bucket granularity "
                     f"{MIN_BUCKET} (power-of-two sp up to {MIN_BUCKET})")
+            if self._sp > 1 and cfg.sliding_window is not None:
+                # fail before any checkpoint-sized work, not at first trace
+                raise NotImplementedError(
+                    "ring attention has no sliding-window mask; run "
+                    "windowed models (Mistral/StarCoder2) on a non-sp mesh")
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
             if sizes.get("sp", 1) > 1:
@@ -222,6 +227,14 @@ class TPUEngine:
         prompts sharded over DCN by the fleet).  ``sp_size``: shard
         prefill sequences (and the KV cache) over a sequence-parallel
         ring for prompts past one chip's attention working set."""
+        if sp_size > 1:
+            from ...models.configs import load_hf_config
+
+            if load_hf_config(model_path).sliding_window is not None:
+                raise NotImplementedError(
+                    "ring attention has no sliding-window mask; run "
+                    "windowed models (Mistral/StarCoder2) on a non-sp "
+                    "mesh — checked before loading the checkpoint")
         mesh = None
         if tp_size * dp_size * sp_size > 1:
             from ...parallel import make_mesh

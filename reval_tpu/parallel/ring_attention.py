@@ -115,6 +115,7 @@ def ring_self_attention(q, k, v, pad_len=None, *, axis_name: str | None = None,
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, *,
                            sp_axis: str = "sp", head_axis: str | None = None,
+                           batch_axis: str | None = "dp",
                            scale: float | None = None):
     """Shard ``q, k, v`` ([B, T, H, D], T divisible by the ``sp`` axis
     size) over the sequence dimension and run ring attention.
@@ -124,19 +125,23 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, *,
     elementwise over T) so the full sequence never materialises on one
     device.  ``head_axis`` additionally shards the head dim (attention is
     head-local, so this is free parallelism — pass "tp" when it divides
-    both H and H_kv; GQA group blocks stay contiguous per shard).
+    both H and H_kv; GQA group blocks stay contiguous per shard), and
+    ``batch_axis`` keeps the batch dim data-parallel (attention is
+    batch-local too — replicating it would run dp-fold redundant rings).
     """
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[sp_axis]
     t = q.shape[1]
     if t % axis_size:
         raise ValueError(f"sequence length {t} not divisible by sp={axis_size}")
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        batch_axis = None
     body = partial(ring_self_attention, axis_name=sp_axis,
                    axis_size=axis_size, scale=scale)
-    spec = P(None, sp_axis, head_axis, None)
+    spec = P(batch_axis, sp_axis, head_axis, None)
     if pad_len is None:
         return jax.shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)(q, k, v)
     return jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec, P(None)),
+        body, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
         out_specs=spec, check_vma=False)(q, k, v, pad_len)

@@ -25,17 +25,17 @@ from ..models.configs import ModelConfig
 from ..models.model import KVCache, prefill
 from .mesh import mesh_axis_sizes
 from .ring_attention import ring_attention_sharded
+from .sharding import _divisible, kv_cache_spec
 
 __all__ = ["sequence_parallel_prefill", "sp_kv_cache_spec"]
 
 
 def sp_kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
-    """[L, B, S, H_kv, D] with the sequence dim over ``sp`` (kv heads over
-    ``tp`` when divisible, batch over ``dp`` — same rules as the
-    contiguous spec, plus sp)."""
-    sizes = mesh_axis_sizes(mesh)
-    tp_ok = cfg.num_kv_heads % sizes.get("tp", 1) == 0
-    return P(None, "dp", "sp", "tp" if tp_ok else None, None)
+    """[L, B, S, H_kv, D]: the contiguous cache rules (batch over dp, kv
+    heads over tp when divisible — ONE policy, defined in
+    parallel/sharding.py) with the sequence dim additionally over sp."""
+    base = kv_cache_spec(cfg, mesh)
+    return P(base[0], base[1], "sp", base[3], base[4])
 
 
 def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -57,14 +57,15 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
             "ring attention has no sliding-window mask; run windowed models "
             "(Mistral/StarCoder2) on a non-sp mesh — their window already "
             "bounds the attention working set")
-    sizes = mesh_axis_sizes(mesh)
     # shard heads over tp inside the ring too (when divisible): without
     # this every tp device would all-gather full-head q/k/v and compute
     # redundant attention, doubling the working set sp exists to shrink
-    tp = sizes.get("tp", 1)
-    heads_ok = (cfg.num_heads % tp == 0 and cfg.num_kv_heads % tp == 0)
-    head_axis = "tp" if tp > 1 and heads_ok else None
-    seq_sharding = NamedSharding(mesh, P(None, "sp", None))
+    div = _divisible(cfg, mesh)
+    head_axis = ("tp" if mesh_axis_sizes(mesh).get("tp", 1) > 1
+                 and div["heads"] and div["kv_heads"] else None)
+    # batch stays dp-sharded end to end (replication would run dp-fold
+    # redundant prefill)
+    seq_sharding = NamedSharding(mesh, P("dp", "sp", None))
 
     def constrain(h):
         return jax.lax.with_sharding_constraint(h, seq_sharding)

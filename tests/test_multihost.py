@@ -95,3 +95,55 @@ def test_two_process_replicate_mode(tmp_path):
         full = json.load(f)
     assert len(full) == 7
     assert [r.split("] ", 1)[0] + "]" for r in full[:4]] == ["[p0@0]"] * 4
+
+
+PP_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); out_dir = sys.argv[2]; port = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+
+    from reval_tpu.inference.tpu.engine import TPUEngine
+    from reval_tpu.inference.tpu.pp_engine import PipelinedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+    from reval_tpu.parallel import make_mesh
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 61,
+                      hidden_size=64, intermediate_size=128, num_layers=4,
+                      num_heads=4, num_kv_heads=2, head_dim=16)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    tok = ByteTokenizer()
+    # pp=4 ring spanning 2 processes x 2 local devices: stage hops 1->2
+    # cross the process boundary (gloo), exactly the multi-host shape
+    eng = PipelinedTPUEngine(params, cfg, tok, batch_size=4,
+                             max_seq_len=128, mesh=make_mesh(pp=4))
+    outs = eng.generate(["def f(x):", "x = 1"], max_new_tokens=6,
+                        temperature=0.0)
+    if pid == 0:
+        plain = TPUEngine(params, cfg, tok, batch_size=4, max_seq_len=128)
+        want = plain.generate(["def f(x):", "x = 1"], max_new_tokens=6,
+                              temperature=0.0)
+        assert outs == want, (outs, want)
+    print("WORKER_OK", pid)
+""")
+
+
+def test_two_process_pipeline_ring(tmp_path):
+    """The pp token ring crossing a REAL process boundary: a 4-stage
+    pipeline over 2 jax.distributed CPU processes (2 local devices each),
+    parity-checked against the single-process engine on process 0."""
+    script = tmp_path / "pp_worker.py"
+    script.write_text(PP_WORKER.format(repo=REPO))
+    procs, outs = _run_rig(script, tmp_path)
+    if any(p.returncode != 0 for p in procs):
+        procs, outs = _run_rig(script, tmp_path)   # free-port race retry
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {pid}" in out
