@@ -185,11 +185,13 @@ class TPUEngine:
                 raise ValueError(
                     f"sp={self._sp} must divide the bucket granularity "
                     f"{MIN_BUCKET} (power-of-two sp up to {MIN_BUCKET})")
-            if self._sp > 1 and cfg.sliding_window is not None:
+            if self._sp > 1 and (cfg.sliding_window is not None
+                                 or cfg.attn_softcap is not None):
                 # fail before any checkpoint-sized work, not at first trace
                 raise NotImplementedError(
-                    "ring attention has no sliding-window mask; run "
-                    "windowed models (Mistral/StarCoder2) on a non-sp mesh")
+                    "ring attention supports neither sliding windows nor "
+                    "score softcapping; run windowed/softcapped models "
+                    "(Mistral/StarCoder2/Gemma-2) on a non-sp mesh")
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
             if sizes.get("sp", 1) > 1:
@@ -230,11 +232,13 @@ class TPUEngine:
         if sp_size > 1:
             from ...models.configs import load_hf_config
 
-            if load_hf_config(model_path).sliding_window is not None:
+            probe = load_hf_config(model_path)
+            if (probe.sliding_window is not None
+                    or probe.attn_softcap is not None):
                 raise NotImplementedError(
-                    "ring attention has no sliding-window mask; run "
-                    "windowed models (Mistral/StarCoder2) on a non-sp "
-                    "mesh — checked before loading the checkpoint")
+                    "ring attention supports neither sliding windows nor "
+                    "score softcapping (Mistral/StarCoder2/Gemma-2); use a "
+                    "non-sp mesh — checked before loading the checkpoint")
         mesh = None
         if tp_size * dp_size * sp_size > 1:
             from ...parallel import make_mesh

@@ -40,6 +40,12 @@ class ModelConfig:
     sliding_window: int | None = None  # mistral/starcoder2: attend last W keys
     hidden_act: str = "silu"
     dtype: str = "bfloat16"
+    # gemma-2 family flags
+    use_post_norms: bool = False     # sandwich norms around attn + mlp outputs
+    alt_sliding: bool = False        # sliding window on EVEN layers only
+    attn_softcap: float | None = None    # tanh softcap on attention scores
+    final_softcap: float | None = None   # tanh softcap on lm logits
+    query_scale: float | None = None     # attention scale = query_scale**-0.5
     # mixture-of-experts (mixtral): 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -52,6 +58,27 @@ class ModelConfig:
     @property
     def q_per_kv(self) -> int:
         return self.num_heads // self.num_kv_heads
+
+    @property
+    def attn_scale(self) -> float:
+        base = self.query_scale if self.query_scale is not None else self.head_dim
+        return float(base) ** -0.5
+
+    def window_for_layer(self, i: int) -> int | None:
+        """Static sliding window for layer ``i`` (gemma-2 alternates:
+        sliding on even layers, global on odd — HF ``layer_types``)."""
+        if self.alt_sliding:
+            return self.sliding_window if i % 2 == 0 else None
+        return self.sliding_window
+
+    def layer_windows_array(self):
+        """[L] int32 window sizes for traced (scan-based) layer loops;
+        global layers get a sentinel larger than any position."""
+        import jax.numpy as jnp
+
+        big = 1 << 30
+        vals = [self.window_for_layer(i) or big for i in range(self.num_layers)]
+        return jnp.asarray(vals, jnp.int32)
 
 
 def load_hf_config(model_path: str | Path) -> ModelConfig:
@@ -82,12 +109,25 @@ def load_hf_config(model_path: str | Path) -> ModelConfig:
             **common)
     if model_type in ("llama", "mistral", "deepseek"):
         return ModelConfig(family="llama", rms_norm_eps=hf.get("rms_norm_eps", 1e-6), **common)
-    if model_type in ("gemma", "gemma2"):
+    if model_type == "gemma":
         return ModelConfig(
             family="gemma",
             rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
             norm_offset=1.0,
             embed_scale=float(hf["hidden_size"]) ** 0.5,
+            **{**common, "tie_word_embeddings": True},
+        )
+    if model_type == "gemma2":
+        return ModelConfig(
+            family="gemma",
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            norm_offset=1.0,
+            embed_scale=float(hf["hidden_size"]) ** 0.5,
+            use_post_norms=True,
+            alt_sliding=True,
+            attn_softcap=hf.get("attn_logit_softcapping"),
+            final_softcap=hf.get("final_logit_softcapping"),
+            query_scale=hf.get("query_pre_attn_scalar"),
             **{**common, "tie_word_embeddings": True},
         )
     if model_type == "starcoder2":

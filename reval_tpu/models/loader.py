@@ -50,6 +50,14 @@ def _weight_map(cfg: ModelConfig) -> dict:
                 "moe_down_w": ("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", True),
                 "moe_up_w": ("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", True),
             })
+        if cfg.use_post_norms:   # gemma-2 sandwich norms: HF's
+            # post_attention_layernorm is the POST-attn norm (not the MLP
+            # pre-norm as in llama); the MLP norms have their own names
+            m.update({
+                "post_attn_norm_w": ("model.layers.{i}.post_attention_layernorm.weight", False),
+                "mlp_norm_w": ("model.layers.{i}.pre_feedforward_layernorm.weight", False),
+                "post_mlp_norm_w": ("model.layers.{i}.post_feedforward_layernorm.weight", False),
+            })
         return m
     if cfg.family == "starcoder2":
         m = {
@@ -209,6 +217,8 @@ def param_template(cfg: ModelConfig) -> dict:
         layers.update({"fc_w": (L, E, F), "proj_w": (L, F, E)})
         if cfg.mlp_bias:
             layers.update({"fc_b": (L, F), "proj_b": (L, E)})
+    if cfg.use_post_norms:
+        layers.update({"post_attn_norm_w": (L, E), "post_mlp_norm_w": (L, E)})
     if cfg.use_layernorm:
         layers.update({"attn_norm_b": (L, E), "mlp_norm_b": (L, E)})
     if cfg.attention_bias:

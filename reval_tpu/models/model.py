@@ -243,9 +243,15 @@ def _block(h, layer, cfg: ModelConfig, cos, sin, attend):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = attend(q, k, v)
-    h = h + _out_proj(attn, layer, cfg)
+    out = _out_proj(attn, layer, cfg)
+    if cfg.use_post_norms:                       # gemma-2 sandwich norms
+        out = _norm(out, layer["post_attn_norm_w"], None, cfg)
+    h = h + out
     normed = _norm(h, layer["mlp_norm_w"], layer.get("mlp_norm_b"), cfg)
-    return h + _mlp(normed, layer, cfg)
+    out = _mlp(normed, layer, cfg)
+    if cfg.use_post_norms:
+        out = _norm(out, layer["post_mlp_norm_w"], None, cfg)
+    return h + out
 
 
 def _embed(params, cfg: ModelConfig, tokens):
@@ -257,8 +263,12 @@ def _embed(params, cfg: ModelConfig, tokens):
 
 def _unembed(params, cfg: ModelConfig, h):
     if cfg.tie_word_embeddings:
-        return (h @ params["embed"].T).astype(jnp.float32)
-    return _mm(h, params, "lm_head").astype(jnp.float32)
+        logits = (h @ params["embed"].T).astype(jnp.float32)
+    else:
+        logits = _mm(h, params, "lm_head").astype(jnp.float32)
+    if cfg.final_softcap is not None:            # gemma-2 logit softcapping
+        logits = cfg.final_softcap * jnp.tanh(logits / cfg.final_softcap)
+    return logits
 
 
 def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
@@ -283,27 +293,36 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
         h = constrain(h)
     positions = jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
-    if attend_fn is None:
-        def attend_fn(q, k, v):
-            return prefill_attention(q, k, v, pad_len, window=cfg.sliding_window)
+    # per-layer windows ride the scan as an [L] array (gemma-2 alternates
+    # sliding/global; other models get a uniform value, sentinel-big for
+    # none) — a traced window behaves identically in the masks
+    wins = cfg.layer_windows_array()
+
+    def default_attend(win):
+        def f(q, k, v):
+            return prefill_attention(q, k, v, pad_len, scale=cfg.attn_scale,
+                                     window=win, softcap=cfg.attn_softcap)
+        return f
 
     def layer_step(h, xs):
-        layer, k_slot, v_slot = xs
+        layer, k_slot, v_slot, win = xs
         kv = {}
+        inner = attend_fn if attend_fn is not None else default_attend(win)
 
         def attend(q, k, v):
             kv["k"] = jax.lax.dynamic_update_slice(
                 k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
             kv["v"] = jax.lax.dynamic_update_slice(
                 v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
-            return attend_fn(q, k, v)
+            return inner(q, k, v)
 
         h = _block(h, layer, cfg, cos, sin, attend)
         if constrain is not None:
             h = constrain(h)
         return h, (kv["k"], kv["v"])
 
-    h, (new_k, new_v) = jax.lax.scan(layer_step, h, (params["layers"], cache.k, cache.v))
+    h, (new_k, new_v) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache.k, cache.v, wins))
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
     if logits_mode == "last":
         h = h[:, -1:, :]   # left-padding puts every row's final token last
@@ -328,9 +347,10 @@ def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
     h = _embed(params, cfg, tokens)
     positions = tc + jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    wins = cfg.layer_windows_array()
 
     def layer_step(h, xs):
-        layer, ctx_k, ctx_v, k_slot, v_slot = xs
+        layer, ctx_k, ctx_v, k_slot, v_slot, win = xs
         kv = {}
 
         def attend(q, k, v):
@@ -339,13 +359,14 @@ def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
             kv["v"] = jax.lax.dynamic_update_slice(
                 v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
             return context_prefill_attention(q, k, v, ctx_k, ctx_v, pad_len,
-                                             window=cfg.sliding_window)
+                                             scale=cfg.attn_scale, window=win,
+                                             softcap=cfg.attn_softcap)
 
         h = _block(h, layer, cfg, cos, sin, attend)
         return h, (kv["k"], kv["v"])
 
     h, (new_k, new_v) = jax.lax.scan(
-        layer_step, h, (params["layers"], ctx.k, ctx.v, cache.k, cache.v))
+        layer_step, h, (params["layers"], ctx.k, ctx.v, cache.k, cache.v, wins))
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
     if logits_mode == "last":
         h = h[:, -1:, :]
@@ -381,7 +402,9 @@ def decode_step(params, cfg: ModelConfig, token: jnp.ndarray, pad_len: jnp.ndarr
             cv = jax.lax.dynamic_update_slice(
                 cv, v[None].astype(cv.dtype), (i, 0, cur_pos, 0, 0))
             return decode_attention(q, ck[i], cv[i], pad_len, cur_pos,
-                                    window=cfg.sliding_window)
+                                    scale=cfg.attn_scale,
+                                    window=cfg.window_for_layer(i),
+                                    softcap=cfg.attn_softcap)
 
         h = _block(h, layer, cfg, cos, sin, attend)
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)

@@ -176,7 +176,8 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
             new_v.append(vi)
             attn = paged_decode_attention(
                 q[:, 0], ki, vi, block_tables, attn_lens, page_size=page,
-                window=cfg.sliding_window, k_scales=ks_i, v_scales=vs_i)
+                scale=cfg.attn_scale, window=cfg.window_for_layer(i),
+                softcap=cfg.attn_softcap, k_scales=ks_i, v_scales=vs_i)
             return attn[:, None]
 
         h = _block(h, layer, cfg, cos, sin, attend)

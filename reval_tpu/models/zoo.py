@@ -102,6 +102,15 @@ MODEL_ZOO: dict[str, ZooEntry] = {
         "mistralai/Mixtral-8x7B-Instruct-v0.1", "llama", "8x7B",
         _llama(32000, 4096, 14336, 32, 32, kv_heads=8, rope_theta=1000000.0,
                num_experts=8, num_experts_per_tok=2)),
+    # beyond the reference list: gemma-2 (sandwich norms, softcaps,
+    # alternating sliding/global attention)
+    "google/gemma-2-2b-it": ZooEntry(
+        "google/gemma-2-2b-it", "gemma", "2B",
+        _llama(256000, 2304, 9216, 26, 8, kv_heads=4, head_dim=256,
+               family="gemma", norm_offset=1.0, embed_scale=2304 ** 0.5,
+               tie_word_embeddings=True, hidden_act="gelu_pytorch_tanh",
+               use_post_norms=True, alt_sliding=True, sliding_window=4096,
+               attn_softcap=50.0, final_softcap=30.0, query_scale=256.0)),
 }
 
 # short aliases (config files accept either)

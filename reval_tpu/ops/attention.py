@@ -25,6 +25,14 @@ __all__ = ["prefill_attention", "decode_attention", "context_prefill_attention"]
 
 _NEG_INF = -1e30
 
+
+def _softcap(scores, cap):
+    """Gemma-2 logit softcapping: ``cap * tanh(scores / cap)`` on RAW
+    (scaled, unmasked) scores; None = no-op."""
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
 #: key-block size for the flash-style blocked path; score blocks beyond
 #: this total key length never materialise the full [T_q, T_k] tensor
 _KEY_BLOCK = 512
@@ -36,7 +44,8 @@ def _group_queries(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
     return q.reshape(b, t, n_kv_heads, h // n_kv_heads, d)
 
 
-def _blocked_attention(qg, k, v, mask_fn, scale: float) -> jnp.ndarray:
+def _blocked_attention(qg, k, v, mask_fn, scale: float,
+                       softcap: float | None = None) -> jnp.ndarray:
     """Flash-style exact attention: ``lax.scan`` over key blocks with
     online-softmax accumulators, so the peak score transient is
     [B, N, G, T_q, BLOCK] instead of [..., T_k] — at the 6.7b prefill
@@ -67,8 +76,8 @@ def _blocked_attention(qg, k, v, mask_fn, scale: float) -> jnp.ndarray:
         m, l, acc = carry
         kc, vc, start = xs
         cols = start + jnp.arange(blk)
-        scores = jnp.einsum("bqngd,bknd->bngqk", qg,
-                            kc.astype(jnp.float32)) * scale
+        scores = _softcap(jnp.einsum("bqngd,bknd->bngqk", qg,
+                                     kc.astype(jnp.float32)) * scale, softcap)
         valid = mask_fn(cols) & (cols < s)[None, None, None, None, :]
         scores = jnp.where(valid, scores, _NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
@@ -86,7 +95,7 @@ def _blocked_attention(qg, k, v, mask_fn, scale: float) -> jnp.ndarray:
 
 def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       pad_len: jnp.ndarray, scale: float | None = None,
-                      window: int | None = None) -> jnp.ndarray:
+                      window=None, softcap: float | None = None) -> jnp.ndarray:
     """Causal self-attention over one left-padded prefill block.
 
     q: [B, T, H, D]; k, v: [B, T, H_kv, D]; pad_len: [B] int32.
@@ -116,13 +125,13 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 & valid_key[:, None, None, None, :])
 
     if t > _KEY_BLOCK:
-        out = _blocked_attention(qg, k, v, mask_fn, scale)
+        out = _blocked_attention(qg, k, v, mask_fn, scale, softcap)
         return out.reshape(b, t, h, d).astype(q.dtype)
 
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     # scores: [B, H_kv, G, T_q, T_k]
-    scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
+    scores = _softcap(jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale, softcap)
     scores = jnp.where(mask_fn(jnp.arange(t)), scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
@@ -134,7 +143,8 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               ctx_k: jnp.ndarray, ctx_v: jnp.ndarray,
                               pad_len: jnp.ndarray,
                               scale: float | None = None,
-                              window: int | None = None) -> jnp.ndarray:
+                              window=None,
+                              softcap: float | None = None) -> jnp.ndarray:
     """Causal attention for a suffix block that follows a shared context.
 
     The shared-prefix prefill path: ``ctx_k``/``ctx_v`` ([1, Tc, H_kv, D],
@@ -182,12 +192,12 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
          v.astype(cat_t)], axis=1)
 
     if t + tc > _KEY_BLOCK:
-        out = _blocked_attention(qg, kcat, vcat, mask_for, scale)
+        out = _blocked_attention(qg, kcat, vcat, mask_for, scale, softcap)
         return out.reshape(b, t, h, d).astype(q.dtype)
 
     kf = kcat.astype(jnp.float32)
     vf = vcat.astype(jnp.float32)
-    scores = jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale
+    scores = _softcap(jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale, softcap)
     mask = mask_for(jnp.arange(t + tc))
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
@@ -199,7 +209,7 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                      pad_len: jnp.ndarray, cur_pos: jnp.ndarray,
                      scale: float | None = None,
-                     window: int | None = None) -> jnp.ndarray:
+                     window=None, softcap: float | None = None) -> jnp.ndarray:
     """One-token attention against the cache.
 
     q: [B, 1, H, D]; caches: [B, S, H_kv, D]; pad_len: [B]; cur_pos: scalar
@@ -214,7 +224,8 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     qg = _group_queries(q, n_kv).astype(jnp.float32)          # [B, 1, N, G, D]
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
-    scores = jnp.einsum("bqngd,bsnd->bngqs", qg, kf) * scale  # [B, N, G, 1, S]
+    scores = _softcap(jnp.einsum("bqngd,bsnd->bngqs", qg, kf) * scale,
+                      softcap)                                # [B, N, G, 1, S]
     cols = jnp.arange(s)
     valid = (cols[None, :] >= pad_len[:, None]) & (cols[None, :] <= cur_pos)
     if window is not None:

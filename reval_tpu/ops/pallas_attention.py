@@ -49,6 +49,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import _softcap
+
 __all__ = [
     "paged_decode_attention",
     "paged_decode_attention_xla",
@@ -60,7 +62,8 @@ _NEG_INF = -1e30
 
 def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
                    *rest, page_size: int, scale: float, max_pages: int,
-                   window: int | None, h_kv: int, g: int, quantized: bool):
+                   window: int | None, softcap: float | None,
+                   h_kv: int, g: int, quantized: bool):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -105,6 +108,7 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
+            s = _softcap(s, softcap)             # gemma-2 score softcapping
             s = jnp.where(valid, s, _NEG_INF)
 
             rows = slice(h * g, (h + 1) * g)
@@ -125,11 +129,13 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("page_size", "scale", "interpret", "window"))
+    jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
+                              "softcap"))
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                                   *, page_size: int, scale: float | None = None,
                                   interpret: bool = False,
                                   window: int | None = None,
+                                  softcap: float | None = None,
                                   k_scales=None, v_scales=None):
     """One-token attention against a paged KV cache (Pallas TPU kernel).
 
@@ -187,8 +193,8 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
                                scale=scale, max_pages=max_pages,
-                               window=window, h_kv=h_kv, g=g,
-                               quantized=quantized)
+                               window=window, softcap=softcap, h_kv=h_kv,
+                               g=g, quantized=quantized)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -202,6 +208,7 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
 def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
                                *, page_size: int, scale: float | None = None,
                                window: int | None = None,
+                               softcap: float | None = None,
                                k_scales=None, v_scales=None):
     """Portable XLA reference for :func:`paged_decode_attention_pallas`.
 
@@ -228,6 +235,7 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
 
     qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
     scores = jnp.einsum("bngd,bsnd->bngs", qg, k_seq) * scale
+    scores = _softcap(scores, softcap)
     pos = jnp.arange(s_max)[None, :]
     valid = pos < seq_lens[:, None]
     if window is not None:
@@ -242,6 +250,7 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            *, page_size: int, scale: float | None = None,
                            window: int | None = None,
+                           softcap: float | None = None,
                            k_scales=None, v_scales=None):
     """Backend-dispatching paged decode attention: Pallas on TPU, XLA
     elsewhere (same numerics; the kernel is tested against the XLA path).
@@ -257,4 +266,4 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     fn = paged_decode_attention_pallas if use_pallas else paged_decode_attention_xla
     return fn(q, k_pages, v_pages, block_tables, seq_lens,
               page_size=page_size, scale=scale, window=window,
-              k_scales=k_scales, v_scales=v_scales)
+              softcap=softcap, k_scales=k_scales, v_scales=v_scales)

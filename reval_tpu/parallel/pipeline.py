@@ -93,24 +93,29 @@ def _varying(x, axis: str = "pp"):
     return lax.pcast(x, (axis,), to="varying")
 
 
-def _run_local_layers_prefill(h, layers, pad, cfg, kv_dtype):
+def _run_local_layers_prefill(h, layers, wins, pad, cfg, kv_dtype):
     """Scan this stage's layers over one left-padded microbatch block;
-    returns the block output and the stage-local KV ([Lp, mb, T, H_kv, D])."""
+    returns the block output and the stage-local KV ([Lp, mb, T, H_kv, D]).
+    ``wins``: [Lp] per-layer window sizes (sentinel-big = global) — the
+    stage's slice of the model-wide array, so gemma-2 window alternation
+    follows global layer indices across stages."""
     t = h.shape[1]
     positions = jnp.maximum(jnp.arange(t)[None, :] - pad[:, None], 0)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
-    def layer_step(hc, layer):
+    def layer_step(hc, xs):
+        layer, win = xs
         kv = {}
 
         def attend(q, k, v):
             kv["k"], kv["v"] = k.astype(kv_dtype), v.astype(kv_dtype)
-            return prefill_attention(q, k, v, pad, window=cfg.sliding_window)
+            return prefill_attention(q, k, v, pad, scale=cfg.attn_scale,
+                                     window=win, softcap=cfg.attn_softcap)
 
         hc = _block(hc, layer, cfg, cos, sin, attend)
         return hc, (kv["k"], kv["v"])
 
-    return lax.scan(layer_step, h, layers)
+    return lax.scan(layer_step, h, (layers, wins))
 
 
 def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -137,8 +142,9 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
     padm = pad_len.reshape(m_count, mb)
     layers = params["layers"]
     top = {k: v for k, v in params.items() if k != "layers"}
+    wins = cfg.layer_windows_array()
 
-    def staged(layers, hm, padm, ck, cv):
+    def staged(layers, wins, hm, padm, ck, cv):
         stage = lax.axis_index("pp")
 
         def tick(ti, state):
@@ -151,7 +157,7 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
                              h_cur)
             pad = lax.dynamic_index_in_dim(padm, mc, 0, keepdims=False)
             h_out, (ks, vs) = _run_local_layers_prefill(
-                h_in, layers, pad, cfg, ck.dtype)
+                h_in, layers, wins, pad, cfg, ck.dtype)
             row = jnp.where(active, mc * mb, b)
             ck = lax.dynamic_update_slice(ck, ks, (0, row, 0, 0, 0))
             cv = lax.dynamic_update_slice(cv, vs, (0, row, 0, 0, 0))
@@ -172,9 +178,9 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     outbuf, ck, cv = jax.shard_map(
         staged, mesh=mesh, axis_names={"pp"},
-        in_specs=(P("pp"), P(), P(), P("pp"), P("pp")),
+        in_specs=(P("pp"), P("pp"), P(), P(), P("pp"), P("pp")),
         out_specs=(P(), P("pp"), P("pp")),
-    )(layers, hm, padm, cache.k, cache.v)
+    )(layers, wins, hm, padm, cache.k, cache.v)
 
     h_final = _norm(outbuf.reshape(b, -1), top["final_norm_w"],
                     top.get("final_norm_b"), cfg)
@@ -210,8 +216,9 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
     padm = pad_len.reshape(pp, mb)
     layers = params["layers"]
     top = {k: v for k, v in params.items() if k != "layers"}
+    wins = cfg.layer_windows_array()
 
-    def staged(layers, top, hm, padm, ck, cv):
+    def staged(layers, wins, top, hm, padm, ck, cv):
         stage = lax.axis_index("pp")
         lp = jax.tree_util.tree_leaves(layers)[0].shape[0]
         s_max = ck.shape[2]
@@ -252,7 +259,9 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
                         cv, (li, row, 0, 0, 0),
                         (1, mb, s_max, cv.shape[3], cv.shape[4]))[0]
                     return decode_attention(q, kc, vc, pad, pos,
-                                            window=cfg.sliding_window)
+                                            scale=cfg.attn_scale,
+                                            window=wins[li],
+                                            softcap=cfg.attn_softcap)
 
                 h_out = _block(h_out, layer, cfg, cos, sin, attend)
 
@@ -284,9 +293,9 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
 
     tokbuf, ck, cv = jax.shard_map(
         staged, mesh=mesh, axis_names={"pp"},
-        in_specs=(P("pp"), P(), P(), P(), P("pp"), P("pp")),
+        in_specs=(P("pp"), P("pp"), P(), P(), P(), P("pp"), P("pp")),
         out_specs=(P(), P("pp"), P("pp")),
-    )(layers, top, hm, padm, cache.k, cache.v)
+    )(layers, wins, top, hm, padm, cache.k, cache.v)
 
     # tokbuf flat index n = j*P + m holds step j of microbatch m
     toks = tokbuf.reshape(steps, pp, mb).transpose(1, 2, 0).reshape(b, steps)

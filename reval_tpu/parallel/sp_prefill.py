@@ -52,11 +52,12 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
     b, t = tokens.shape
     if t % sp:
         raise ValueError(f"prefill length {t} must be divisible by sp={sp}")
-    if cfg.sliding_window is not None:
+    if cfg.sliding_window is not None or cfg.attn_softcap is not None:
         raise NotImplementedError(
-            "ring attention has no sliding-window mask; run windowed models "
-            "(Mistral/StarCoder2) on a non-sp mesh — their window already "
-            "bounds the attention working set")
+            "ring attention supports neither sliding windows nor score "
+            "softcapping; run windowed/softcapped models "
+            "(Mistral/StarCoder2/Gemma-2) on a non-sp mesh — a window "
+            "already bounds the attention working set")
     # shard heads over tp inside the ring too (when divisible): without
     # this every tp device would all-gather full-head q/k/v and compute
     # redundant attention, doubling the working set sp exists to shrink
@@ -72,7 +73,8 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     def attend_fn(q, k, v):
         return ring_attention_sharded(q, k, v, mesh, pad_len,
-                                      head_axis=head_axis)
+                                      head_axis=head_axis,
+                                      scale=cfg.attn_scale)
 
     return prefill(params, cfg, tokens, pad_len, cache, logits_mode="last",
                    attend_fn=attend_fn, constrain=constrain)

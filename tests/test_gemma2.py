@@ -1,0 +1,183 @@
+"""Gemma-2 family parity: sandwich norms, logit softcapping, query
+scaling, and per-layer alternating sliding/global attention — all four
+differ from gemma-1 and silently corrupt logits if ignored.
+
+Oracle: transformers' Gemma2ForCausalLM on a tiny random checkpoint
+(fp32, CPU), the same per-family strategy as the other parity suites
+(SURVEY §7 hard part 3).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+TINY_GEMMA2 = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+    head_dim=16, max_position_embeddings=512, rope_theta=10000.0,
+    rms_norm_eps=1e-6,
+    # window smaller than the test sequence so sliding layers actually mask
+    sliding_window=8, query_pre_attn_scalar=32,
+    attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+)
+
+
+def make_hf_gemma2(tmp_path, **overrides):
+    import torch
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    torch.manual_seed(0)
+    cfg = Gemma2Config(**{**TINY_GEMMA2, **overrides})
+    model = Gemma2ForCausalLM(cfg).eval()
+    # HF inits every RMSNorm weight to zero (identity under the w+1
+    # convention) — randomise them so mis-wiring any of the four per-layer
+    # norms (input/post-attn/pre-ffw/post-ffw) breaks logits parity
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if "norm" in name:
+                p.copy_(torch.randn_like(p) * 0.3)
+    path = tmp_path / "tiny-gemma2"
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def hf_logits(model, tokens):
+    import torch
+
+    with torch.no_grad():
+        out = model(torch.tensor(tokens))
+    return out.logits.float().numpy()
+
+
+@pytest.fixture(scope="module")
+def gemma2(tmp_path_factory):
+    from reval_tpu.models import load_checkpoint
+
+    tmp = tmp_path_factory.mktemp("ckpt")
+    model, path = make_hf_gemma2(tmp)
+    params, cfg = load_checkpoint(path, dtype="float32")
+    return model, params, cfg
+
+
+class TestGemma2Parity:
+    def test_config_parsed(self, gemma2):
+        _, _, cfg = gemma2
+        assert cfg.use_post_norms and cfg.alt_sliding
+        assert cfg.attn_softcap == 50.0 and cfg.final_softcap == 30.0
+        assert cfg.query_scale == 32 and cfg.sliding_window == 8
+        assert cfg.window_for_layer(0) == 8       # even layers sliding
+        assert cfg.window_for_layer(1) is None    # odd layers global
+
+    def test_post_norm_weights_loaded(self, gemma2):
+        _, params, _ = gemma2
+        layers = params["layers"]
+        assert layers["post_attn_norm_w"].shape == (4, 64)
+        assert layers["post_mlp_norm_w"].shape == (4, 64)
+        # the fixture randomises norms, so the four per-layer norms are
+        # distinct — a mis-mapped loader would alias two of them
+        assert not np.allclose(np.asarray(layers["mlp_norm_w"]),
+                               np.asarray(layers["post_attn_norm_w"]))
+
+    def test_logits_match_hf_past_the_window(self, gemma2):
+        from reval_tpu.models import logits_for_tokens
+
+        model, params, cfg = gemma2
+        rng = np.random.default_rng(0)
+        # t=24 > window=8: sliding layers mask real history; a wrong
+        # window rule (or all-global) diverges hard here
+        tokens = rng.integers(0, 255, size=(2, 24))
+        ours = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+        theirs = hf_logits(model, tokens)
+        np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-3)
+
+    def test_decode_matches_prefill(self, gemma2):
+        from reval_tpu.models import (
+            decode_step, init_kv_cache, logits_for_tokens, prefill)
+
+        _, params, cfg = gemma2
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, 255, size=(2, 17))
+        full = np.asarray(logits_for_tokens(params, cfg, jnp.asarray(tokens)))
+        cache = init_kv_cache(cfg, 2, 20, dtype=jnp.float32)
+        pad = jnp.zeros(2, jnp.int32)
+        _, cache = prefill(params, cfg, jnp.asarray(tokens[:, :-1]), pad, cache)
+        logits, _ = decode_step(params, cfg, jnp.asarray(tokens[:, -1:]),
+                                pad, cache, jnp.int32(16))
+        np.testing.assert_allclose(np.asarray(logits), full[:, -1, :],
+                                   atol=3e-4, rtol=3e-3)
+
+    def test_engines_agree(self, gemma2):
+        from reval_tpu.inference.tpu.engine import TPUEngine
+        from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+        from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+
+        _, params, cfg = gemma2
+        tok = ByteTokenizer()
+        prompts = ["def f(x):\n    return x + 1\n\nassert f(", "x = 1\ny ="]
+        eng = TPUEngine(params, cfg, tok, batch_size=2, max_seq_len=256)
+        want = eng.generate(prompts, max_new_tokens=10, temperature=0.0)
+        paged = PagedTPUEngine(params, cfg, tok, max_slots=2, page_size=64,
+                               max_seq_len=256)
+        got = paged.generate(prompts, max_new_tokens=10, temperature=0.0)
+        paged.close()
+        assert got == want
+
+    def test_pipelined_engine_runs_gemma2(self, gemma2):
+        from reval_tpu.inference.tpu.engine import TPUEngine
+        from reval_tpu.inference.tpu.pp_engine import PipelinedTPUEngine
+        from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+        from reval_tpu.parallel import make_mesh
+
+        _, params, cfg = gemma2
+        tok = ByteTokenizer()
+        prompts = ["def g(y):", "assert g("]
+        plain = TPUEngine(params, cfg, tok, batch_size=2, max_seq_len=256)
+        want = plain.generate(prompts, max_new_tokens=8, temperature=0.0)
+        eng = PipelinedTPUEngine(params, cfg, tok, batch_size=2,
+                                 max_seq_len=256, mesh=make_mesh(pp=2, tp=2))
+        got = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert got == want
+
+    def test_prefix_sharing_exact_with_alternating_windows(self, gemma2):
+        """The shared-prefix (context) prefill path must respect per-layer
+        windows too — riders attend context + suffix through the same
+        alternation."""
+        from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+        from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+
+        _, params, cfg = gemma2
+        tok = ByteTokenizer()
+        shared = "def helper(a, b):\n    return a * b + a - b\n\n" * 4
+        prompts = [shared + "assert helper(1, 2) == ", shared + "x = helper("]
+        on = PagedTPUEngine(params, cfg, tok, max_slots=2, page_size=64,
+                            max_seq_len=512, prefix_sharing=True)
+        got = on.generate(prompts, max_new_tokens=8, temperature=0.0)
+        on.close()
+        off = PagedTPUEngine(params, cfg, tok, max_slots=2, page_size=64,
+                             max_seq_len=512, prefix_sharing=False)
+        want = off.generate(prompts, max_new_tokens=8, temperature=0.0)
+        off.close()
+        assert got == want
+
+
+class TestSoftcapKernelParity:
+    def test_pallas_kernel_softcap_matches_xla(self):
+        from reval_tpu.ops.pallas_attention import (
+            paged_decode_attention_pallas, paged_decode_attention_xla)
+
+        rng = np.random.default_rng(0)
+        b, h, hk, d, page, npages = 2, 4, 2, 16, 8, 6
+        q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((npages * page, hk, d)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((npages * page, hk, d)), jnp.float32)
+        tables = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
+        lens = jnp.asarray([13, 21], jnp.int32)
+        want = paged_decode_attention_xla(q, kp, vp, tables, lens,
+                                          page_size=page, softcap=50.0)
+        got = paged_decode_attention_pallas(q, kp, vp, tables, lens,
+                                            page_size=page, softcap=50.0,
+                                            interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
