@@ -151,5 +151,6 @@ def load_hf_config(model_path: str | Path) -> ModelConfig:
             mlp_bias=hf.get("use_bias", True),
             **common,
         )
-    raise ValueError(f"unsupported model_type {model_type!r} "
-                     f"(supported: llama/mistral/deepseek, gemma, starcoder2)")
+    raise ValueError(f"unsupported model_type {model_type!r} (supported: "
+                     f"llama/mistral/deepseek, mixtral, gemma, gemma2, "
+                     f"starcoder2)")

@@ -111,6 +111,13 @@ MODEL_ZOO: dict[str, ZooEntry] = {
                tie_word_embeddings=True, hidden_act="gelu_pytorch_tanh",
                use_post_norms=True, alt_sliding=True, sliding_window=4096,
                attn_softcap=50.0, final_softcap=30.0, query_scale=256.0)),
+    "google/gemma-2-9b-it": ZooEntry(
+        "google/gemma-2-9b-it", "gemma", "9B",
+        _llama(256000, 3584, 14336, 42, 16, kv_heads=8, head_dim=256,
+               family="gemma", norm_offset=1.0, embed_scale=3584 ** 0.5,
+               tie_word_embeddings=True, hidden_act="gelu_pytorch_tanh",
+               use_post_norms=True, alt_sliding=True, sliding_window=4096,
+               attn_softcap=50.0, final_softcap=30.0, query_scale=256.0)),
 }
 
 # short aliases (config files accept either)

@@ -1,11 +1,16 @@
-"""Parallelism: device meshes, sharding rules, multi-host init, ring attention.
+"""Parallelism: meshes, sharding rules, multi-host init, and the four
+model-sharding strategies beyond plain dp.
 
 The TPU analogue of the reference's delegated tensor parallelism
 (``tensor_parallel_size`` handed to vLLM/NCCL, SURVEY §2.8): here sharding
-is first-class — a ``Mesh`` over ICI with named axes ``('dp', 'tp')``
-(+ ``'sp'`` for sequence parallelism), ``NamedSharding`` rules per weight,
-and XLA-inserted collectives.  No NCCL analogue exists to manage: pjit
-compiles the communication.
+is first-class — a ``Mesh`` with named axes ``('dp', 'pp', 'sp', 'ep',
+'tp')``, ``NamedSharding`` rules per weight, and XLA-inserted collectives.
+``tp``: Megatron-style rules (sharding.py).  ``pp``: GPipe prefill +
+token-ring decode over the stacked layer dim (pipeline.py).  ``sp``:
+ring-attention prefill with a sequence-sharded KV cache (ring_attention.py,
+sp_prefill.py).  ``ep``: MoE expert sharding (sharding.py + the dispatch
+formulation in models/model.py).  No NCCL analogue exists to manage: the
+compiler inserts the communication.
 """
 
 from .mesh import make_mesh, init_distributed, mesh_axis_sizes
