@@ -195,3 +195,26 @@ class TestSequenceParallelEngine:
                        mesh=make_mesh(sp=4, tp=2))
         got = sp.generate(prompts, max_new_tokens=8, temperature=0.0)
         assert got == want
+
+    def test_sp_engine_with_dp_axis(self):
+        """dp x sp x tp composition: batch stays data-parallel through the
+        ring path (regression: the sp constraint used to replicate batch
+        over dp, running dp-fold redundant prefill)."""
+        from reval_tpu.inference.tpu.engine import TPUEngine
+        from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+        from reval_tpu.models import ModelConfig, init_random_params
+        from reval_tpu.parallel import make_mesh
+
+        cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 61,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=4, num_kv_heads=2,
+                          head_dim=16)
+        params = init_random_params(cfg, seed=5, dtype="float32")
+        tok = ByteTokenizer()
+        prompts = ["def f(x):", "x = 1", "y = 2", "assert f("]
+        plain = TPUEngine(params, cfg, tok, batch_size=4, max_seq_len=512)
+        want = plain.generate(prompts, max_new_tokens=8, temperature=0.0)
+        eng = TPUEngine(params, cfg, tok, batch_size=4, max_seq_len=512,
+                        mesh=make_mesh(dp=2, sp=2, tp=2))
+        got = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert got == want
