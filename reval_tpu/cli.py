@@ -321,13 +321,17 @@ def run_serve(argv: list[str]) -> int:
                         help="run-config JSON (model/backend settings)")
     parser.add_argument("--port", type=int, default=None,
                         help="listen port (default: config 'port' or 3000)")
+    parser.add_argument("--warmup", action="store_true",
+                        help="pre-compile the generation programs before "
+                             "binding the port (first request otherwise "
+                             "pays 20-40s of jit per shape)")
     args = parser.parse_args(argv)
     if not os.path.exists(args.input):
         print(f"Error: {args.input} not found — run `python -m reval_tpu config` first")
         return 1
     with open(args.input) as f:
         cfg = json.load(f)
-    server = serve_config(cfg, port=args.port)
+    server = serve_config(cfg, port=args.port, warmup=args.warmup)
     print(f"serving {cfg.get('model_id')} on :{server.port} "
           f"(POST /v1/completions, GET /v1/models)")
     try:

@@ -9,6 +9,6 @@ the resident (sharded) TPU engine and speaks the same protocol to
 :class:`~reval_tpu.inference.client.HTTPClientBackend`.
 """
 
-from .server import EngineServer, serve_config
+from .server import EngineServer, serve_config, warmup_engine
 
-__all__ = ["EngineServer", "serve_config"]
+__all__ = ["EngineServer", "serve_config", "warmup_engine"]

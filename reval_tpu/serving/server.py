@@ -251,14 +251,39 @@ def _engine_generate_fn(engine):
     return generate
 
 
-def serve_config(cfg: dict, *, port: int | None = None) -> EngineServer:
+def warmup_engine(engine) -> float:
+    """Compile the hot programs before the server takes traffic.
+
+    The first request otherwise pays the jit cost (20-40 s per shape on a
+    real chip — SURVEY §7 hard part 4's bucketing bounds the shape count,
+    but the first hit per bucket still compiles).  One short and one long
+    prompt cover the smallest and a large prefill bucket plus the decode
+    chunk programs (the budget spans a full chunk, so the steady-state
+    chunk compiles, not just the short first-chunk variant).  Returns the
+    wall seconds spent (logged by the CLI).
+    """
+    import time
+
+    t0 = time.perf_counter()
+    for prompt in ("pass", "pass\n" * 300):
+        engine.generate([prompt], max_new_tokens=40, temperature=0.0,
+                        stop=["[/ANSWER]"])
+    return time.perf_counter() - t0
+
+
+def serve_config(cfg: dict, *, port: int | None = None,
+                 warmup: bool = False) -> EngineServer:
     """Build the TPU engine from a run config (same keys the ``tpu``
     backend takes) and return an unstarted server bound to ``port``
-    (default: config ``port`` or 3000)."""
+    (default: config ``port`` or 3000).  ``warmup`` pre-compiles the hot
+    generation programs before binding."""
     from ..inference.tpu.backend import TPUBackend
 
     backend = TPUBackend(**{k: v for k, v in cfg.items()
                             if k not in ("task", "backend", "port", "mock")})
+    if warmup:
+        secs = warmup_engine(backend.engine)
+        print(f"warmup: generation programs compiled in {secs:.1f}s")
     server = EngineServer(_engine_generate_fn(backend.engine),
                           model_id=cfg.get("model_id", "reval-tpu-model"),
                           port=port if port is not None else cfg.get("port", 3000))

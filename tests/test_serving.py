@@ -260,3 +260,22 @@ def test_streaming_never_leaks_stop_prefix():
     assert text == "YES "                      # no "[/ANS" ever on the wire
     assert any(ev["choices"][0]["finish_reason"] == "stop"
                for ev in events[:-1])
+
+
+def test_warmup_engine_compiles_and_serves():
+    """warmup_engine runs the hot generation programs (short + long
+    prompt, a full decode chunk) and the engine still serves normally."""
+    from reval_tpu.inference.tpu.engine import TPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+    from reval_tpu.serving import warmup_engine
+
+    cfg = ModelConfig(vocab_size=320, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32)
+    params = init_random_params(cfg, seed=1, dtype="float32")
+    engine = TPUEngine(params, cfg, ByteTokenizer(), batch_size=2,
+                       max_seq_len=2048)
+    secs = warmup_engine(engine)
+    assert secs > 0
+    outs = engine.generate(["def f(x):"], max_new_tokens=8, temperature=0.0)
+    assert len(outs) == 1 and isinstance(outs[0], str)
