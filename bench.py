@@ -153,8 +153,10 @@ class TrainedBPE:
 def flagship(tiny: bool = False, model: str = "1.3b",
              dtype: str = "bfloat16"):
     """Flagship shapes (BASELINE.json configs[0]: deepseek-coder-1.3b;
-    the 6.7b sibling runs single-chip via weight-only int8).  ``tiny``
-    swaps in a toy config for CPU smoke tests of the harness."""
+    the 6.7b sibling runs single-chip via weight-only int8).  ``model``
+    also accepts any zoo name/alias (models/zoo.py) for ad-hoc shape
+    benches.  ``tiny`` swaps in a toy config for CPU smoke tests of the
+    harness."""
     from reval_tpu.models import ModelConfig, init_random_params, zoo_config
 
     if tiny:
@@ -162,7 +164,8 @@ def flagship(tiny: bool = False, model: str = "1.3b",
                           intermediate_size=128, num_layers=2, num_heads=4,
                           num_kv_heads=2, head_dim=32)
         return init_random_params(cfg, seed=0, dtype="float32"), cfg
-    cfg = zoo_config(f"deepseek-coder-{model}")
+    name = f"deepseek-coder-{model}" if model in ("1.3b", "6.7b") else model
+    cfg = zoo_config(name)
     cfg.dtype = "bfloat16"
     params = init_random_params(cfg, seed=0, dtype=dtype)
     return params, cfg
@@ -264,9 +267,11 @@ def main() -> None:
                          "measured working set (~10 pages/slot direct, "
                          "~14/slot cot) instead of slots*max_seq_len — "
                          "preemption handles any overflow")
-    ap.add_argument("--model", choices=["1.3b", "6.7b"], default="1.3b",
-                    help="flagship shape; 6.7b forces int8 weights (bf16 "
-                         "does not fit a 16 GB chip next to the KV pool)")
+    ap.add_argument("--model", default="1.3b",
+                    help="flagship shape: 1.3b (default), 6.7b (forces "
+                         "int8 weights — bf16 does not fit a 16 GB chip "
+                         "next to the KV pool), or any models/zoo.py "
+                         "name/alias for ad-hoc shape benches")
     ap.add_argument("--dtype", choices=["bfloat16", "int8"], default=None,
                     help="weight storage; int8 = weight-only quantization "
                          "(models/quant.py). Default bf16 (1.3b) / int8 (6.7b)")
@@ -287,8 +292,10 @@ def main() -> None:
         max_new = 16
         args.prompts = min(args.prompts, 6)
         args.serial_prompts = min(args.serial_prompts, 4)
+    label = (f"deepseek-{args.model}" if args.model in ("1.3b", "6.7b")
+             else args.model.rsplit("/", 1)[-1])
     shape = ("TINY-SMOKE-TEST fp32" if args.tiny
-             else f"deepseek-{args.model}-shape "
+             else f"{label}-shape "
                   + ("int8-weights" if args.dtype == "int8" else "bf16"))
     metric = (f"DREval coverage probes/sec/chip "
               f"({shape}, {args.mode}, {max_new} new tok, "
