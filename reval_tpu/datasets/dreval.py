@@ -13,7 +13,6 @@ import json
 import unittest
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Callable
 
 __all__ = [
     "Families",

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import ast
 import itertools
-from typing import Any, Iterable
+from typing import Any
 
 from .nil import Nil
 

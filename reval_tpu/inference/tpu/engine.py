@@ -44,7 +44,7 @@ from ...models import (
     prefill,
 )
 from .sampling import sample_token
-from .tokenizer import ByteTokenizer, HFTokenizer
+from .tokenizer import HFTokenizer
 
 __all__ = ["TPUEngine", "StopScanner"]
 

@@ -10,7 +10,7 @@ statically so jit sees fixed control flow.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 
 __all__ = ["ModelConfig", "load_hf_config"]
