@@ -52,6 +52,11 @@ class TPUBackend(InferenceBackend):
         if sp_size > 1 and pp_size > 1:
             raise ValueError("sp_size and pp_size cannot combine yet — "
                              "pick sequence OR pipeline parallelism")
+        if dp_size > 1 and pp_size > 1:
+            raise ValueError(
+                "dp_size and pp_size cannot combine yet — the pipelined "
+                "engine has no dp axis, so dp_size>1 would silently run at "
+                "1/dp throughput; drop one of the two")
         if engine == "paged" and (sp_size > 1 or pp_size > 1):
             raise ValueError(
                 "sequence/pipeline parallelism runs on the static engine "

@@ -26,6 +26,7 @@ same functions — there is no separate multi-chip code path.
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 import time
 from dataclasses import dataclass
@@ -62,6 +63,14 @@ def profile_trace():
     if not trace_dir:
         return contextlib.nullcontext()
     return jax.profiler.trace(trace_dir)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_zeros(shape, dtype, sharding):
+    """Memoised jitted zeros-maker: out_shardings places each shard
+    directly on its device with no replicated transient; the lru_cache
+    keeps one compiled program per (shape, dtype, sharding)."""
+    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
 
 
 def pow2_bucket(n: int, minimum: int = 1) -> int:
@@ -281,6 +290,20 @@ class TPUEngine:
         pipelined engine over-allocates scratch rows for fill/drain ticks."""
         return b
 
+    def _init_cache(self, rows: int, length: int) -> KVCache:
+        """Fresh zero KV cache, created *born sharded* on a mesh: each
+        device materialises only its own shard (jit with out_shardings),
+        so the full [L, B, S, H_kv, D] buffer never transits one chip's
+        HBM — on a pp mesh that transient could exceed a single stage's
+        memory (the whole point of pipelining the layer stack)."""
+        dtype = self.params["embed"].dtype
+        if self._cache_sharding is None:
+            return init_kv_cache(self.cfg, rows, length, dtype=dtype)
+        shape = (self.cfg.num_layers, rows, length,
+                 self.cfg.num_kv_heads, self.cfg.head_dim)
+        zeros = _sharded_zeros(shape, jnp.dtype(dtype), self._cache_sharding)
+        return KVCache(zeros(), zeros())
+
     def _cache_len(self, t: int, max_new: int) -> int:
         """KV-cache sequence length for a ``t``-token bucket.  An
         sp-sharded cache dim must divide evenly over the mesh, so round
@@ -326,14 +349,12 @@ class TPUEngine:
             tokens[row, t - len(seq):] = seq
             pad_len[row] = t - len(seq)
 
-        cache = init_kv_cache(self.cfg, self._cache_rows(b),
-                              self._cache_len(t, max_new_tokens),
-                              dtype=self.params["embed"].dtype)
+        cache = self._init_cache(self._cache_rows(b),
+                                 self._cache_len(t, max_new_tokens))
         dev_tokens, dev_pad = jnp.asarray(tokens), jnp.asarray(pad_len)
         if self._input_sharding is not None:
             dev_tokens = jax.device_put(dev_tokens, self._input_sharding)
             dev_pad = jax.device_put(dev_pad, self._input_sharding)
-            cache = KVCache(*(jax.device_put(c, self._cache_sharding) for c in cache))
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("reval.prefill"):
             logits, cache = self._jit_prefill(

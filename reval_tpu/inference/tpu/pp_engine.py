@@ -57,6 +57,14 @@ class PipelinedTPUEngine(TPUEngine):
         self.mesh = mesh
         self._pp = pp
         self.params = shard_params_pp(params, cfg, mesh)
+        # born-sharded buffers (advisor round-2): the KV cache's layer dim
+        # is pp-sharded (matching pipeline_prefill's in_specs), so no
+        # full-size [L, B+mb, S, H_kv, D] transient ever lands on one
+        # stage's chip; tokens/pad replicate (the shard_map takes them P())
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._input_sharding = NamedSharding(mesh, P())
+        self._cache_sharding = NamedSharding(mesh, P("pp"))
         self._jit_prefill = jax.jit(partial(
             pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro))
         self._jit_decode_chunk = jax.jit(

@@ -29,10 +29,21 @@ def resolve_moe_impl(cfg: ModelConfig, mesh: Mesh | None) -> ModelConfig:
     switch to the capacity-dispatch path (see models/model.py).  Returns
     a config copy — engines call this once at construction."""
     import dataclasses
+    import warnings
 
     if (cfg.num_experts and mesh is not None
             and dict(zip(mesh.axis_names, mesh.devices.shape)).get("ep", 1) > 1
             and cfg.moe_impl != "dispatch"):
+        # visible signal (advisor round-2): dispatch is capacity-bounded, so
+        # under router skew assignments past capacity are DROPPED — logits
+        # can differ from the exact ragged path.  Python's default warning
+        # filter dedups by location, so this fires once per process.
+        warnings.warn(
+            "ep>1 mesh: switching MoE from the exact ragged path to "
+            "capacity-bounded dispatch; router skew beyond "
+            "moe_capacity_factor drops assignments and can change logits — "
+            "raise moe_capacity_factor for exactness",
+            stacklevel=2)
         return dataclasses.replace(cfg, moe_impl="dispatch")
     return cfg
 
