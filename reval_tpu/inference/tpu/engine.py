@@ -163,6 +163,7 @@ class EngineStats:
     prefill_tokens: int = 0
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
+    decode_chunks: int = 0
 
 
 class TPUEngine:

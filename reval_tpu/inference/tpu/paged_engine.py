@@ -90,6 +90,8 @@ class _Request:
     scanner: StopScanner
     generated: list[int] = field(default_factory=list)
     done: bool = False
+    temp: float = 0.0            # per-request sampling temperature
+    notify: object = None        # optional callable(req): progress hook
 
     @property
     def prefill_ids(self) -> list[int]:
@@ -97,6 +99,26 @@ class _Request:
         already-generated tokens (non-empty after a preemption — resume
         semantics, so sampled tokens are never resampled)."""
         return self.ids + self.generated
+
+
+@dataclass
+class _DriveState:
+    """Device/host loop state that survives across drive ticks.
+
+    Owning it in a dataclass (rather than `_drive` locals) lets the
+    continuous-batching session (serving/session.py) interleave NEW
+    request admission between decode chunks: each `_drive_tick` call is
+    one admission + prefill + chunk round against whatever `reqs`
+    currently holds — exactly vLLM's engine-step contract."""
+
+    active: dict[int, int]       # slot -> seq_id
+    slot_token: np.ndarray       # [B, 1] pending input token per slot
+    slot_temp: np.ndarray        # [B] per-slot sampling temperature
+    dev_state: object = None     # packed [B, span+2] device array
+    dev_temp: object = None      # [B] float32 device array
+    dirty: bool = True
+    span: int = 0
+    since_admit: int = 0
 
 
 class PagedTPUEngine:
@@ -235,6 +257,25 @@ class PagedTPUEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def encode_clipped(self, prompt: str, max_new_tokens: int) -> list[int]:
+        """Tokenise one prompt, left-clipping so prompt + generation fits
+        ``max_seq_len`` (the single source of the clipping rule — the
+        in-process ``generate`` path and the serving session both use it).
+        Raises ValueError when the token budget alone exceeds the
+        sequence capacity."""
+        max_len = self.max_pages_per_seq * self.page_size
+        limit = max_len - max_new_tokens - 1
+        if limit < 1:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
+                f"within max_seq_len={max_len}")
+        ids = self.tokenizer.encode(prompt)
+        if not ids:
+            ids = [self.tokenizer.pad_id]   # empty prompt: one pad token
+        if len(ids) > limit:
+            ids = ids[-limit:]      # clip from the left, keep the tail
+        return ids
+
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None,
@@ -250,23 +291,16 @@ class PagedTPUEngine:
         if not prompts:
             return []
         stop = stop or []
-        max_len = self.max_pages_per_seq * self.page_size
-        limit = max_len - max_new_tokens - 1
-        if limit < 1:
-            raise ValueError(
-                f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
-                f"within max_seq_len={max_len}")
-        encoded: list[list[int]] = []
-        for prompt in prompts:
-            ids = self.tokenizer.encode(prompt)
-            if not ids:
-                ids = [self.tokenizer.pad_id]   # empty prompt: one pad token
-            if len(ids) > limit:
-                ids = ids[-limit:]      # clip from the left, keep the tail
-            encoded.append(ids)
+        encoded = [self.encode_clipped(p, max_new_tokens) for p in prompts]
 
         prefix_id = self._reserve_shared_prefix(encoded)
         reqs: dict[int, _Request] = {}
+        notify = None
+        if on_progress is not None:
+            def notify(req, _stop=stop):
+                on_progress(req.index,
+                            finalize_text(self.tokenizer, req.generated,
+                                          _stop))
         try:
             for i, ids in enumerate(encoded):
                 if prefix_id is not None:
@@ -275,19 +309,11 @@ class PagedTPUEngine:
                 else:
                     seq_id = self.rt.submit(len(ids), max_new_tokens)
                 reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens,
-                                        scanner=StopScanner(self.tokenizer, stop))
+                                        scanner=StopScanner(self.tokenizer, stop),
+                                        temp=float(temperature), notify=notify)
 
-            active: dict[int, int] = {}      # slot -> seq_id
-            slot_token = np.zeros((self.max_slots, 1), np.int32)
-            notify = None
-            if on_progress is not None:
-                def notify(req, _stop=stop):
-                    on_progress(req.index,
-                                finalize_text(self.tokenizer, req.generated,
-                                              _stop))
             with profile_trace():
-                self._drive(reqs, active, slot_token, jnp.float32(temperature),
-                            notify)
+                self._drive(reqs)
         except Exception:
             # never leave requests queued/running in the native scheduler —
             # the next generate() would be handed stale seq ids
@@ -351,107 +377,123 @@ class PagedTPUEngine:
         self._prefix_ctx = ctx
         return prefix_id
 
-    def _drive(self, reqs: dict[int, _Request], active: dict[int, int],
-               slot_token: np.ndarray, temp, notify=None) -> None:
-        """Admission/prefill/decode loop until every request is done.
+    def new_drive_state(self) -> _DriveState:
+        return _DriveState(active={},
+                           slot_token=np.zeros((self.max_slots, 1), np.int32),
+                           slot_temp=np.zeros(self.max_slots, np.float32))
 
-        Loop state (tables, lens, pending token) lives ON DEVICE between
-        chunks as the packed array `_decode_chunk` returns; it is rebuilt
-        and re-uploaded only when the slot population changes (admission,
-        retirement, preemption) or the table span bucket grows.  A clean
-        steady-state chunk therefore costs one jit dispatch and one token
-        download — everything else rides device-resident state.
+    def _drive(self, reqs: dict[int, _Request]) -> None:
+        """Blocking admission/prefill/decode loop until every request is
+        done (the ``generate()`` path).  The continuous-batching session
+        calls ``_drive_tick`` directly so it can inject new requests
+        between chunks."""
+        st = self.new_drive_state()
+        while any(not r.done for r in reqs.values()):
+            self._drive_tick(reqs, st)
+
+    def _drive_tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
+        """ONE admission + prefill + decode-chunk round over ``reqs``.
+
+        Loop state (tables, lens, pending token, per-slot temperature)
+        lives ON DEVICE between chunks as the packed array `_decode_chunk`
+        returns; it is rebuilt and re-uploaded only when the slot
+        population changes (admission, retirement, preemption) or the
+        table span bucket grows.  A clean steady-state chunk therefore
+        costs one jit dispatch and one token download — everything else
+        rides device-resident state.
+
+        Raises RuntimeError when nothing is running *and* nothing could be
+        admitted while undone requests remain (scheduler deadlock — e.g. a
+        request larger than the whole pool).
         """
-        dev_state = None    # packed [B, span+2] device array, current iff not dirty
-        dirty = True
-        span = 0
-        since_admit = 0
-        while True:
-            admitted = self.rt.admit()
-            if admitted:
-                dirty = True
-                since_admit = 0
-                firsts = self._prefill_admitted(admitted, reqs, temp)
-                for seq_id, slot in admitted:
-                    req = reqs[seq_id]
-                    # append, not reset: after a preemption the kept tokens
-                    # were replayed by the resume prefill and stand
-                    req.generated.append(firsts[slot])
-                    slot_token[slot] = firsts[slot]
-                    active[slot] = seq_id
-                    if self._finished(req, [firsts[slot]]):
-                        self._retire(req, seq_id, slot, active)
-                        dirty = True
-                    if notify is not None:
-                        notify(req)
-            if not active:
-                if any(not r.done for r in reqs.values()):
-                    raise RuntimeError(
-                        "paged scheduler deadlock: nothing running or admissible")
-                break
-
-            budget = min(reqs[s].max_new - len(reqs[s].generated)
-                         for s in active.values())
-            cap = FIRST_CHUNK if since_admit == 0 else CHUNK
-            steps = _floor_pow2(min(cap, budget))
-            since_admit += 1
-
-            # every active sequence must have pages for the whole chunk
-            # BEFORE the decode writes into them
-            before = dict(active)
-            if self._reserve_chunk(active, reqs, steps):
-                dirty = True                 # a block table gained a page
-            if active != before:
-                dirty = True                 # a preemption emptied slots
-            if not active:
-                continue                     # everyone got preempted
-
-            lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
-            for slot, seq_id in active.items():
+        admitted = self.rt.admit()
+        if admitted:
+            st.dirty = True
+            st.since_admit = 0
+            firsts = self._prefill_admitted(admitted, reqs)
+            for seq_id, slot in admitted:
                 req = reqs[seq_id]
-                # materialised tokens = prompt + generated minus the pending
-                # input token (written during the chunk's first step)
-                lens[slot] = len(req.ids) + len(req.generated) - 1
-            # the attention kernel walks every table column it is given —
-            # slice to the pages this chunk can actually touch (pow2-bucketed
-            # so the shape set stays small), not the per-seq maximum.  A
-            # sequence crossing into a fresh page re-uses a table entry the
-            # runtime filled at allocation time, and every entry within the
-            # span was uploaded when the slot population last changed — the
-            # table row only needs re-uploading when the span bucket grows.
-            new_span = pow2_bucket(
-                int((lens.max() + steps + self.page_size - 1) // self.page_size))
-            new_span = min(new_span, self.max_pages_per_seq)
-            if new_span != span:
-                span = new_span
-                dirty = True
-            if dirty or dev_state is None:
-                tables = np.zeros((self.max_slots, span), np.int32)
-                for slot, seq_id in active.items():
-                    tables[slot] = self.rt.block_table(seq_id)[:span]
-                packed = np.concatenate(
-                    [tables, lens[:, None], slot_token.astype(np.int32)], axis=1)
-                dev_state = self._dev(jnp.asarray(packed))
-                dirty = False
-            t0 = time.perf_counter()
-            with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
-                toks, self.cache, dev_state = self._jit_chunk(
-                    self.params, dev_state, self.cache, temp,
-                    self._next_key(), steps=steps)
-            toks_host = np.asarray(toks)
-            self.stats.decode_seconds += time.perf_counter() - t0
-            self.stats.generated_tokens += steps * len(active)
+                # append, not reset: after a preemption the kept tokens
+                # were replayed by the resume prefill and stand
+                req.generated.append(firsts[slot])
+                st.slot_token[slot] = firsts[slot]
+                st.slot_temp[slot] = req.temp
+                st.active[slot] = seq_id
+                if self._finished(req, [firsts[slot]]):
+                    self._retire(req, seq_id, slot, st.active)
+                    st.dirty = True
+                if req.notify is not None:
+                    req.notify(req)
+        if not st.active:
+            if any(not r.done for r in reqs.values()):
+                raise RuntimeError(
+                    "paged scheduler deadlock: nothing running or admissible")
+            return
 
-            for slot, seq_id in list(active.items()):
-                req = reqs[seq_id]
-                chunk_ids = [int(t) for t in toks_host[slot]]
-                req.generated.extend(chunk_ids)
-                slot_token[slot] = chunk_ids[-1]
-                if self._finished(req, chunk_ids):
-                    self._retire(req, seq_id, slot, active)
-                    dirty = True
-                if notify is not None:
-                    notify(req)
+        budget = min(reqs[s].max_new - len(reqs[s].generated)
+                     for s in st.active.values())
+        cap = FIRST_CHUNK if st.since_admit == 0 else CHUNK
+        steps = _floor_pow2(min(cap, budget))
+        st.since_admit += 1
+
+        # every active sequence must have pages for the whole chunk
+        # BEFORE the decode writes into them
+        before = dict(st.active)
+        if self._reserve_chunk(st.active, reqs, steps):
+            st.dirty = True                 # a block table gained a page
+        if st.active != before:
+            st.dirty = True                 # a preemption emptied slots
+        if not st.active:
+            return                          # everyone got preempted
+
+        lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
+        for slot, seq_id in st.active.items():
+            req = reqs[seq_id]
+            # materialised tokens = prompt + generated minus the pending
+            # input token (written during the chunk's first step)
+            lens[slot] = len(req.ids) + len(req.generated) - 1
+        # the attention kernel walks every table column it is given —
+        # slice to the pages this chunk can actually touch (pow2-bucketed
+        # so the shape set stays small), not the per-seq maximum.  A
+        # sequence crossing into a fresh page re-uses a table entry the
+        # runtime filled at allocation time, and every entry within the
+        # span was uploaded when the slot population last changed — the
+        # table row only needs re-uploading when the span bucket grows.
+        new_span = pow2_bucket(
+            int((lens.max() + steps + self.page_size - 1) // self.page_size))
+        new_span = min(new_span, self.max_pages_per_seq)
+        if new_span != st.span:
+            st.span = new_span
+            st.dirty = True
+        if st.dirty or st.dev_state is None:
+            tables = np.zeros((self.max_slots, st.span), np.int32)
+            for slot, seq_id in st.active.items():
+                tables[slot] = self.rt.block_table(seq_id)[:st.span]
+            packed = np.concatenate(
+                [tables, lens[:, None], st.slot_token.astype(np.int32)], axis=1)
+            st.dev_state = self._dev(jnp.asarray(packed))
+            st.dev_temp = self._dev(jnp.asarray(st.slot_temp))
+            st.dirty = False
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
+            toks, self.cache, st.dev_state = self._jit_chunk(
+                self.params, st.dev_state, self.cache, st.dev_temp,
+                self._next_key(), steps=steps)
+        toks_host = np.asarray(toks)
+        self.stats.decode_seconds += time.perf_counter() - t0
+        self.stats.generated_tokens += steps * len(st.active)
+        self.stats.decode_chunks += 1
+
+        for slot, seq_id in list(st.active.items()):
+            req = reqs[seq_id]
+            chunk_ids = [int(t) for t in toks_host[slot]]
+            req.generated.extend(chunk_ids)
+            st.slot_token[slot] = chunk_ids[-1]
+            if self._finished(req, chunk_ids):
+                self._retire(req, seq_id, slot, st.active)
+                st.dirty = True
+            if req.notify is not None:
+                req.notify(req)
 
     # -- host-side helpers -------------------------------------------------
     def _dev(self, arr):
@@ -499,8 +541,7 @@ class PagedTPUEngine:
         return grew
 
     def _prefill_admitted(self, admitted: list[tuple[int, int]],
-                          reqs: dict[int, _Request],
-                          temperature: jnp.ndarray) -> dict[int, int]:
+                          reqs: dict[int, _Request]) -> dict[int, int]:
         """Prefill all just-admitted sequences, batched by prompt bucket.
 
         Sequences sharing a page bucket prefill as ONE left-padded batch
@@ -532,12 +573,12 @@ class PagedTPUEngine:
             step = max(1, token_budget // t)
             for start in range(0, len(full_group), step):
                 self._prefill_group(full_group[start:start + step], skip, n_pg,
-                                    t, reqs, temperature, firsts)
+                                    t, reqs, firsts)
         self.stats.prefill_seconds += time.perf_counter() - t0
         return firsts
 
     def _prefill_group(self, group, skip: int, n_pg: int, t: int,
-                       reqs: dict[int, _Request], temperature,
+                       reqs: dict[int, _Request],
                        firsts: dict[int, int]) -> None:
         assert skip in (0, self._prefix_len), \
             "prefix skip must match the one live prefix of this generate call"
@@ -546,10 +587,12 @@ class PagedTPUEngine:
         tokens = np.full((rows, t), self.tokenizer.pad_id, np.int32)
         pad_len = np.full(rows, t, np.int32)        # dummy rows: all pad
         tables = np.zeros((rows, n_pg), np.int32)   # dummy rows: trash
+        temps = np.zeros(rows, np.float32)          # dummy rows: greedy
         for row, (seq_id, _) in enumerate(group):
             ids = reqs[seq_id].prefill_ids[skip:]   # own (suffix) tokens
             tokens[row, t - len(ids):] = ids
             pad_len[row] = t - len(ids)
+            temps[row] = reqs[seq_id].temp
             # own pages sit after the shared-prefix pages in the table
             own = self.rt.block_table(seq_id)[pre_pages:pre_pages + n_pg]
             tables[row, : len(own)] = own
@@ -568,7 +611,8 @@ class PagedTPUEngine:
                     pad_len=dev_pad, cache=kv)
             self.cache = self._jit_commit(self.cache, kv, dev_pad,
                                           self._dev(jnp.asarray(tables)))
-        first = sample_token(logits[:, 0, :], temperature, self._next_key())
+        first = sample_token(logits[:, 0, :], self._dev(jnp.asarray(temps)),
+                             self._next_key())
         first_host = np.asarray(first)
         for row, (_, slot) in enumerate(group):
             firsts[slot] = int(first_host[row])

@@ -17,10 +17,15 @@ def sample_token(logits: jnp.ndarray, temperature: jnp.ndarray, key: jax.Array) 
     """logits [B, V] float32 → token ids [B].
 
     ``temperature <= 0`` means greedy (argmax); otherwise categorical over
-    ``logits / temperature`` via the Gumbel trick.
+    ``logits / temperature`` via the Gumbel trick.  ``temperature`` may be
+    a scalar or a per-row [B] vector — the paged engine batches requests
+    with different sampling temperatures into one decode step (continuous
+    cross-request batching, vLLM api_server semantics).
     """
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, 1e-6)
+    if temp.ndim == 1:
+        temp = temp[:, None]
     gumbel = -jnp.log(-jnp.log(
         jax.random.uniform(key, logits.shape, minval=1e-20, maxval=1.0)))
     sampled = jnp.argmax(logits / temp + gumbel, axis=-1)

@@ -10,5 +10,7 @@ the resident (sharded) TPU engine and speaks the same protocol to
 """
 
 from .server import EngineServer, serve_config, warmup_engine
+from .session import ContinuousSession
 
-__all__ = ["EngineServer", "serve_config", "warmup_engine"]
+__all__ = ["EngineServer", "serve_config", "warmup_engine",
+           "ContinuousSession"]

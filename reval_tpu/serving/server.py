@@ -57,17 +57,24 @@ class EngineServer:
     SSE framing."""
 
     def __init__(self, generate_fn, model_id: str, port: int = 3000,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", serialize: bool = True):
         # loopback by default: the endpoint is unauthenticated, and the
         # in-repo client only ever connects to localhost; pass host="0.0.0.0"
         # deliberately to expose it
+        #
+        # ``serialize=False``: generate_fn is safe under concurrent calls
+        # (a ContinuousSession routing every call into one live batch) —
+        # concurrent POSTs then overlap on the chip instead of queueing on
+        # the lock (vLLM api_server semantics, reference start_server.sh:17)
+        import contextlib
         import inspect
 
         self.generate_fn = generate_fn
         self.model_id = model_id
         self._streams = ("on_progress"
                          in inspect.signature(generate_fn).parameters)
-        self._lock = threading.Lock()
+        self._lock = (threading.Lock() if serialize
+                      else contextlib.nullcontext())
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -233,6 +240,9 @@ class EngineServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._httpd.server_close()
+        session = getattr(self, "_session", None)
+        if session is not None:
+            session.close()
 
 
 def _engine_generate_fn(engine):
@@ -276,15 +286,29 @@ def serve_config(cfg: dict, *, port: int | None = None,
     """Build the TPU engine from a run config (same keys the ``tpu``
     backend takes) and return an unstarted server bound to ``port``
     (default: config ``port`` or 3000).  ``warmup`` pre-compiles the hot
-    generation programs before binding."""
+    generation programs before binding.
+
+    A single paged engine is served through a :class:`ContinuousSession`:
+    concurrent POSTs join one live decode batch (vLLM api_server
+    semantics).  Other engines (static/pp/sp, dp replica sets) keep the
+    serialised per-request path."""
     from ..inference.tpu.backend import TPUBackend
+    from ..inference.tpu.paged_engine import PagedTPUEngine
 
     backend = TPUBackend(**{k: v for k, v in cfg.items()
                             if k not in ("task", "backend", "port", "mock")})
     if warmup:
         secs = warmup_engine(backend.engine)
         print(f"warmup: generation programs compiled in {secs:.1f}s")
-    server = EngineServer(_engine_generate_fn(backend.engine),
-                          model_id=cfg.get("model_id", "reval-tpu-model"),
-                          port=port if port is not None else cfg.get("port", 3000))
-    return server
+    model_id = cfg.get("model_id", "reval-tpu-model")
+    bind = port if port is not None else cfg.get("port", 3000)
+    if isinstance(backend.engine, PagedTPUEngine):
+        from .session import ContinuousSession
+
+        session = ContinuousSession(backend.engine)
+        server = EngineServer(session.generate_fn(), model_id=model_id,
+                              port=bind, serialize=False)
+        server._session = session       # keep the driver thread reachable
+        return server
+    return EngineServer(_engine_generate_fn(backend.engine),
+                        model_id=model_id, port=bind)

@@ -168,12 +168,12 @@ def test_preemption_resumes_generated_tokens(tiny):
     reqs_seen = {}
     orig = tight._prefill_admitted
 
-    def spy(self, admitted, reqs, temperature):
+    def spy(self, admitted, reqs):
         reqs_seen.update(reqs)
         for seq_id, _slot in admitted:
             if reqs[seq_id].generated:          # re-admission after preempt
                 resumed.append((seq_id, list(reqs[seq_id].generated)))
-        return orig(admitted, reqs, temperature)
+        return orig(admitted, reqs)
 
     tight._prefill_admitted = types.MethodType(spy, tight)
     outs = tight.generate(PROMPTS[:2], max_new_tokens=240, temperature=0.8)
