@@ -88,8 +88,9 @@ class TPUBackend(InferenceBackend):
             )
         elif engine == "paged":
             # dp>1 with continuous batching: one paged replica per device
-            # group (v5e-8 flagship shape: dp=2 × tp=4), prompts sharded
-            # round-robin across replicas in this process
+            # group (v5e-8 flagship shape: dp=2 × tp=4); replicas pull
+            # prompts from one shared work queue at chunk boundaries
+            # (demand-driven balancing, see dp_paged.py)
             from .dp_paged import DataParallelPagedEngine
 
             self.engine = DataParallelPagedEngine.from_pretrained(
