@@ -50,7 +50,7 @@ from .engine import (
     pow2_bucket,
     profile_trace,
 )
-from .sampling import sample_token
+from .sampling import sample_token_rows
 from .tokenizer import HFTokenizer
 
 __all__ = ["PagedTPUEngine"]
@@ -92,6 +92,10 @@ class _Request:
     done: bool = False
     temp: float = 0.0            # per-request sampling temperature
     notify: object = None        # optional callable(req): progress hook
+    #: raw uint32[2] PRNG key; token ``p`` samples from fold_in(key, p),
+    #: so the stream survives preemption, chunk re-partitioning, and
+    #: dp placement unchanged
+    key: np.ndarray = None
 
     @property
     def prefill_ids(self) -> list[int]:
@@ -223,39 +227,58 @@ class PagedTPUEngine:
 
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
-    def _decode_chunk(params, state, cache, temperature, key,
+    def _decode_chunk(params, state, cache, temperature,
                       *, cfg: ModelConfig, steps: int):
         """``steps`` paged decode iterations for the whole slot batch.
 
         ``state`` packs the whole per-chunk loop state into ONE int32
-        array ``[B, span + 2]`` — block tables, then seq_lens, then the
-        pending input token — so a steady-state chunk needs no host→device
-        uploads at all: the previous chunk's returned state feeds the next
-        call as a device-resident array.  Per-upload RPC latency on the
-        tunneled TPU measured ~100 ms/chunk of avoidable host work
-        (PERF.md), which is why this is packed rather than three arrays.
+        array ``[B, span + 5]`` — block tables, seq_lens, the pending
+        input token, the per-request PRNG key (2 bitcast words), and the
+        generated-token position — so a steady-state chunk needs no
+        host→device uploads at all: the previous chunk's returned state
+        feeds the next call as a device-resident array.  Per-upload RPC
+        latency on the tunneled TPU measured ~100 ms/chunk of avoidable
+        host work (PERF.md), which is why this is packed rather than five
+        arrays.  Sampling keys fold the request key with the generated
+        position (``sample_token_rows``), making every request's sample
+        stream schedule-independent.
         """
-        span = state.shape[1] - 2
+        span = state.shape[1] - 5
         block_tables = state[:, :span]
         seq_lens = state[:, span]
-        first_token = state[:, span + 1:]
+        first_token = state[:, span + 1:span + 2]
+        keys = jax.lax.bitcast_convert_type(state[:, span + 2:span + 4],
+                                            jnp.uint32)
+        gen_pos = state[:, span + 4]
 
         def body(carry, _):
-            token, cache, lens, key = carry
+            token, cache, lens, pos = carry
             logits, cache = paged_decode_step(params, cfg, token, block_tables,
                                               lens, cache)
-            key, sub = jax.random.split(key)
-            nxt = sample_token(logits, temperature, sub)
-            return (nxt[:, None], cache, lens + 1, key), nxt
+            row_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+            nxt = sample_token_rows(logits, temperature, row_keys)
+            return (nxt[:, None], cache, lens + 1, pos + 1), nxt
 
-        (last, cache, lens, _), toks = jax.lax.scan(
-            body, (first_token, cache, seq_lens, key), None, length=steps)
-        new_state = jnp.concatenate([block_tables, lens[:, None], last], axis=1)
+        (last, cache, lens, pos), toks = jax.lax.scan(
+            body, (first_token, cache, seq_lens, gen_pos), None, length=steps)
+        new_state = jnp.concatenate(
+            [block_tables, lens[:, None], last,
+             jax.lax.bitcast_convert_type(keys, jnp.int32), pos[:, None]],
+            axis=1)
         return toks.T, cache, new_state
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def request_keys(self, n: int) -> np.ndarray:
+        """[n, 2] uint32 per-request PRNG keys for one call: request ``i``
+        gets ``fold_in(call_key, i)``; one call-level key advance keeps
+        repeated calls (consistency-task repeats) sampling differently
+        while requests within a call are schedule-independent."""
+        base = self._next_key()
+        return np.asarray(jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            base, jnp.arange(n)), dtype=np.uint32)
 
     def encode_clipped(self, prompt: str, max_new_tokens: int) -> list[int]:
         """Tokenise one prompt, left-clipping so prompt + generation fits
@@ -301,6 +324,7 @@ class PagedTPUEngine:
                 on_progress(req.index,
                             finalize_text(self.tokenizer, req.generated,
                                           _stop))
+        keys = self.request_keys(len(encoded))
         try:
             for i, ids in enumerate(encoded):
                 if prefix_id is not None:
@@ -310,7 +334,8 @@ class PagedTPUEngine:
                     seq_id = self.rt.submit(len(ids), max_new_tokens)
                 reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens,
                                         scanner=StopScanner(self.tokenizer, stop),
-                                        temp=float(temperature), notify=notify)
+                                        temp=float(temperature), notify=notify,
+                                        key=keys[i])
 
             with profile_trace():
                 self._drive(reqs)
@@ -322,9 +347,7 @@ class PagedTPUEngine:
                     self.rt.release(seq_id)
             raise
         finally:
-            if prefix_id is not None:
-                self.rt.release(prefix_id)   # pages outlive us via rider refs
-            self._prefix_len, self._prefix_ctx = 0, None
+            self._release_shared_prefix(prefix_id)
 
         out: list[str] = [""] * len(prompts)
         for req in reqs.values():
@@ -381,6 +404,15 @@ class PagedTPUEngine:
         return _DriveState(active={},
                            slot_token=np.zeros((self.max_slots, 1), np.int32),
                            slot_temp=np.zeros(self.max_slots, np.float32))
+
+    def _release_shared_prefix(self, prefix_id: int | None) -> None:
+        """Tear down one call's shared-prefix state (the counterpart of
+        ``_reserve_shared_prefix`` — every driver, in-process or dp, must
+        use this pair so the lifecycle lives in one place).  The prefix
+        pages outlive the release while riders still hold refs."""
+        if prefix_id is not None:
+            self.rt.release(prefix_id)
+        self._prefix_len, self._prefix_ctx = 0, None
 
     def _drive(self, reqs: dict[int, _Request]) -> None:
         """Blocking admission/prefill/decode loop until every request is
@@ -467,10 +499,15 @@ class PagedTPUEngine:
             st.dirty = True
         if st.dirty or st.dev_state is None:
             tables = np.zeros((self.max_slots, st.span), np.int32)
+            keyarr = np.zeros((self.max_slots, 2), np.uint32)
+            posarr = np.zeros(self.max_slots, np.int32)
             for slot, seq_id in st.active.items():
                 tables[slot] = self.rt.block_table(seq_id)[:st.span]
+                keyarr[slot] = reqs[seq_id].key
+                posarr[slot] = len(reqs[seq_id].generated)
             packed = np.concatenate(
-                [tables, lens[:, None], st.slot_token.astype(np.int32)], axis=1)
+                [tables, lens[:, None], st.slot_token.astype(np.int32),
+                 keyarr.view(np.int32), posarr[:, None]], axis=1)
             st.dev_state = self._dev(jnp.asarray(packed))
             st.dev_temp = self._dev(jnp.asarray(st.slot_temp))
             st.dirty = False
@@ -478,7 +515,7 @@ class PagedTPUEngine:
         with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
             toks, self.cache, st.dev_state = self._jit_chunk(
                 self.params, st.dev_state, self.cache, st.dev_temp,
-                self._next_key(), steps=steps)
+                steps=steps)
         toks_host = np.asarray(toks)
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.generated_tokens += steps * len(st.active)
@@ -588,11 +625,16 @@ class PagedTPUEngine:
         pad_len = np.full(rows, t, np.int32)        # dummy rows: all pad
         tables = np.zeros((rows, n_pg), np.int32)   # dummy rows: trash
         temps = np.zeros(rows, np.float32)          # dummy rows: greedy
+        keys = np.zeros((rows, 2), np.uint32)
+        poss = np.zeros(rows, np.int32)
         for row, (seq_id, _) in enumerate(group):
-            ids = reqs[seq_id].prefill_ids[skip:]   # own (suffix) tokens
+            req = reqs[seq_id]
+            ids = req.prefill_ids[skip:]            # own (suffix) tokens
             tokens[row, t - len(ids):] = ids
             pad_len[row] = t - len(ids)
-            temps[row] = reqs[seq_id].temp
+            temps[row] = req.temp
+            keys[row] = req.key
+            poss[row] = len(req.generated)   # resume continues the stream
             # own pages sit after the shared-prefix pages in the table
             own = self.rt.block_table(seq_id)[pre_pages:pre_pages + n_pg]
             tables[row, : len(own)] = own
@@ -611,8 +653,10 @@ class PagedTPUEngine:
                     pad_len=dev_pad, cache=kv)
             self.cache = self._jit_commit(self.cache, kv, dev_pad,
                                           self._dev(jnp.asarray(tables)))
-        first = sample_token(logits[:, 0, :], self._dev(jnp.asarray(temps)),
-                             self._next_key())
+        row_keys = jax.vmap(jax.random.fold_in)(
+            self._dev(jnp.asarray(keys)), self._dev(jnp.asarray(poss)))
+        first = sample_token_rows(logits[:, 0, :],
+                                  self._dev(jnp.asarray(temps)), row_keys)
         first_host = np.asarray(first)
         for row, (_, slot) in enumerate(group):
             firsts[slot] = int(first_host[row])

@@ -10,23 +10,45 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_token"]
+__all__ = ["sample_token", "sample_token_rows"]
 
 
-def sample_token(logits: jnp.ndarray, temperature: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
-    """logits [B, V] float32 → token ids [B].
-
-    ``temperature <= 0`` means greedy (argmax); otherwise categorical over
-    ``logits / temperature`` via the Gumbel trick.  ``temperature`` may be
-    a scalar or a per-row [B] vector — the paged engine batches requests
-    with different sampling temperatures into one decode step (continuous
-    cross-request batching, vLLM api_server semantics).
-    """
+def _gumbel_select(logits: jnp.ndarray, temperature: jnp.ndarray,
+                   uniform: jnp.ndarray) -> jnp.ndarray:
+    """Shared core: greedy/temperature switch + Gumbel-max over
+    ``logits / temperature`` given pre-drawn uniform noise [B, V].
+    ``temperature <= 0`` means greedy (argmax); scalar or per-row [B]."""
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, 1e-6)
     if temp.ndim == 1:
         temp = temp[:, None]
-    gumbel = -jnp.log(-jnp.log(
-        jax.random.uniform(key, logits.shape, minval=1e-20, maxval=1.0)))
+    gumbel = -jnp.log(-jnp.log(uniform))
     sampled = jnp.argmax(logits / temp + gumbel, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def sample_token(logits: jnp.ndarray, temperature: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """logits [B, V] float32 → token ids [B]; one key for the whole batch
+    (the static engine's per-chunk stream)."""
+    uniform = jax.random.uniform(key, logits.shape, minval=1e-20, maxval=1.0)
+    return _gumbel_select(logits, temperature, uniform)
+
+
+def sample_token_rows(logits: jnp.ndarray, temperature: jnp.ndarray,
+                      keys: jnp.ndarray) -> jnp.ndarray:
+    """Per-row keyed sampling: logits [B, V], keys [B, 2] raw uint32
+    (legacy PRNG key data), temperature scalar or [B].
+
+    Each row draws from its OWN stream, so a sampled sequence is a pure
+    function of (request key, token position) — independent of batch
+    composition, decode-chunk schedule, preemption, and dp-replica
+    placement.  The paged engine keys each request as
+    ``fold_in(call_key, request_index)`` and folds the per-token position
+    inside the decode chunk; the reference gets no such guarantee from
+    vLLM (seeding there is per-engine-step), so reproducibility under
+    continuous batching is strictly better here.
+    """
+    uniform = jax.vmap(
+        lambda k, row: jax.random.uniform(k, row.shape, minval=1e-20,
+                                          maxval=1.0))(keys, logits)
+    return _gumbel_select(logits, temperature, uniform)

@@ -247,6 +247,7 @@ class ContinuousSession:
         from ..inference.tpu.paged_engine import _Request
 
         eng = self.engine
+        keys = eng.request_keys(len(sub.prompts))
         for pos, prompt in enumerate(sub.prompts):
             ids = eng.encode_clipped(prompt, sub.max_new)
             notify = None
@@ -258,5 +259,5 @@ class ContinuousSession:
             reqs[seq_id] = _Request(
                 index=pos, ids=ids, max_new=sub.max_new,
                 scanner=StopScanner(eng.tokenizer, sub.stop),
-                temp=sub.temperature, notify=notify)
+                temp=sub.temperature, notify=notify, key=keys[pos])
             origin[seq_id] = (sub, pos)
