@@ -272,7 +272,7 @@ def main() -> None:
                          "int8 weights — bf16 does not fit a 16 GB chip "
                          "next to the KV pool), or any models/zoo.py "
                          "name/alias for ad-hoc shape benches")
-    ap.add_argument("--dtype", choices=["bfloat16", "int8"], default=None,
+    ap.add_argument("--dtype", choices=["bfloat16", "int8", "int4"], default=None,
                     help="weight storage; int8 = weight-only quantization "
                          "(models/quant.py). Default bf16 (1.3b) / int8 (6.7b)")
     ap.add_argument("--kv-dtype", choices=["", "int8"], default="",
@@ -296,7 +296,7 @@ def main() -> None:
              else args.model.rsplit("/", 1)[-1])
     shape = ("TINY-SMOKE-TEST fp32" if args.tiny
              else f"{label}-shape "
-                  + ("int8-weights" if args.dtype == "int8" else "bf16"))
+                  + (args.dtype + "-weights" if args.dtype != "bfloat16" else "bf16"))
     metric = (f"DREval coverage probes/sec/chip "
               f"({shape}, {args.mode}, {max_new} new tok, "
               f"trained-BPE prompts)")

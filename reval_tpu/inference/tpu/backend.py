@@ -34,7 +34,7 @@ class TPUBackend(InferenceBackend):
         ring-attention prefill with the sequence (and KV cache) sharded
         over sp, for prompts past one chip's attention working set.
 
-        ``dtype``: "bfloat16" (default), "float32", or "int8" —
+        ``dtype``: "bfloat16" (default), "float32", "int8", or "int4" —
         weight-only int8 quantization (models/quant.py): bf16 compute,
         halved weight HBM reads, ~2× params per chip (6.7b-class models
         fit a single 16 GB v5e).

@@ -202,8 +202,9 @@ class PagedTPUEngine:
             mesh = make_mesh(tp=tp_size, devices=devices)
         if mesh is not None and dtype != "int8":
             # shard-direct load: each device reads only its slice of the
-            # checkpoint (34B+ would blow host RAM through the full-tree
-            # path; int8 needs whole-tensor amax so it keeps full load)
+            # checkpoint — incl. int4, whose group scales quantize
+            # shard-locally (34B+ would blow host RAM through the
+            # full-tree path; only int8's whole-tensor amax keeps it)
             from ...models import load_checkpoint_sharded
 
             params, cfg = load_checkpoint_sharded(model_path, mesh, dtype=dtype)

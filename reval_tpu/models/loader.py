@@ -133,11 +133,14 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
 
     ``dtype="int8"``: bf16 activations with weight-only int8 matmul
     weights (models/quant.py) — halves weight HBM reads and fits ~2×
-    the parameters per chip."""
+    the parameters per chip.  ``dtype="int4"``: group-wise weight-only
+    int4 (4× smaller weights — CodeLlama-34B in ~17 GB fits a v5e-8
+    tp-sharded WITH page-pool headroom, the shape the reference needed
+    multi-A800 vLLM tensor parallelism for)."""
     model_path = Path(model_path)
     cfg = cfg or load_hf_config(model_path)
-    quantize = dtype == "int8"
-    if quantize:
+    qmode = dtype if dtype in ("int8", "int4") else None
+    if qmode:
         dtype = "bfloat16"
     cfg.dtype = dtype
     target = _DTYPES[dtype]
@@ -153,12 +156,13 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
 
     def place(store: dict, name: str, arr: jnp.ndarray) -> None:
         """Store a leaf, quantizing matmul weights leaf-by-leaf — the
-        whole-tree quantize-after-load would hold bf16 AND int8 copies
-        of the model at once (20 GB for 6.7b: an OOM on a 16 GB chip)."""
+        whole-tree quantize-after-load would hold bf16 AND quantized
+        copies of the model at once (20 GB for 6.7b: an OOM on a 16 GB
+        chip)."""
         from .quant import quantize_into
 
-        if quantize:
-            quantize_into(store, name, arr)
+        if qmode:
+            quantize_into(store, name, arr, qmode)
         else:
             store[name] = arr
 
@@ -234,12 +238,13 @@ def param_template(cfg: ModelConfig) -> dict:
 def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") -> dict:
     """Random params matching the template — benches and sharding tests run
     real architectures without real checkpoints (this host has no egress).
-    ``dtype="int8"`` quantizes matmul weights leaf-by-leaf as they are
-    drawn (models/quant.py), so the float tree is never fully resident."""
+    ``dtype="int8"``/``"int4"`` quantizes matmul weights leaf-by-leaf as
+    they are drawn (models/quant.py), so the float tree is never fully
+    resident."""
     import jax
 
-    quantize = dtype == "int8"
-    target = _DTYPES["bfloat16" if quantize else dtype]
+    qmode = dtype if dtype in ("int8", "int4") else None
+    target = _DTYPES["bfloat16" if qmode else dtype]
     template = param_template(cfg)
     key = jax.random.PRNGKey(seed)
     flat: dict = {}
@@ -256,21 +261,21 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") 
     def place(store, name, shape):
         from .quant import MATMUL_WEIGHTS, quantize_into
 
-        if quantize and name in MATMUL_WEIGHTS and len(shape) >= 3:
+        if qmode and name in MATMUL_WEIGHTS and len(shape) >= 3:
             # draw + quantize layer-by-layer: the stacked fp32 draw alone
             # is multi-GB at 6.7b scale (see quant.quantize_stacked)
-            parts: dict = {name: [], name + "_scale": []}
-            tmp: dict = {}
+            parts: dict = {}
             for _ in range(shape[0]):
-                quantize_into(tmp, name, init_leaf(name, shape[1:]))
-                parts[name].append(tmp[name])
-                parts[name + "_scale"].append(tmp[name + "_scale"])
-            store[name] = jnp.stack(parts[name])
-            store[name + "_scale"] = jnp.stack(parts[name + "_scale"])
+                tmp: dict = {}
+                quantize_into(tmp, name, init_leaf(name, shape[1:]), qmode)
+                for k, v in tmp.items():
+                    parts.setdefault(k, []).append(v)
+            for k, v in parts.items():
+                store[k] = jnp.stack(v)
             return
         leaf = init_leaf(name, shape)
-        if quantize:
-            quantize_into(store, name, leaf)
+        if qmode:
+            quantize_into(store, name, leaf, qmode)
         else:
             store[name] = leaf
 

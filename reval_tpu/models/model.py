@@ -60,13 +60,34 @@ def _act(x, cfg: ModelConfig):
 
 
 def _mm(x, container, name: str):
-    """``x @ container[name]`` with transparent weight-only int8: when a
-    ``<name>_scale`` leaf rides along (models/quant.py), the int8 weight
-    converts to the activation dtype inside the dot (XLA fuses the
-    convert into the operand load) and the per-output-channel scale
-    applies to the product — exact w.r.t. the dequantised weight since
-    the scale is constant along the contraction dim."""
+    """``x @ container[name]`` with transparent weight-only quantization.
+
+    int8 (``<name>_scale`` [out]): the weight converts to the activation
+    dtype inside the dot (XLA fuses the convert into the operand load)
+    and the per-output-channel scale applies to the product — exact
+    w.r.t. the dequantised weight since the scale is constant along the
+    contraction dim.
+
+    int4 (``<name>_gscale`` [G, out], models/quant.py group-wise scheme):
+    the contraction splits into groups — one batched einsum over
+    ``[..., G, g] × [G, g, out]`` produces per-group partials that are
+    scaled and summed, so the scale (which varies along the contraction)
+    still applies outside a matmul and no dequantised bf16 copy of the
+    weight ever lands in HBM."""
     w = container[name]
+    gs = container.get(name + "_gscale")
+    if gs is not None:
+        n_groups = gs.shape[-2]
+        g = w.shape[-2] // n_groups
+        xg = x.reshape(*x.shape[:-1], n_groups, g)
+        wg = w.reshape(n_groups, g, w.shape[-1]).astype(x.dtype)
+        # f32 partials: bf16 would add ~G extra roundings per output
+        # element (scale-multiply + the group sum) that the int8 path's
+        # single f32-accumulated dot doesn't have
+        part = jnp.einsum("...gi,gio->...go", xg, wg,
+                          preferred_element_type=jnp.float32)
+        return jnp.sum(part * gs.astype(jnp.float32),
+                       axis=-2).astype(x.dtype)
     s = container.get(name + "_scale")
     if s is None:
         return x @ w
@@ -98,9 +119,16 @@ def _route(xs, layer, cfg: ModelConfig):
 
 
 def _expert_w(layer, name: str, dtype):
-    """Expert weight stack [E, in, out] in compute dtype; int8 stacks
-    dequantise here (transient — the ragged path needs plain operands)."""
+    """Expert weight stack [E, in, out] in compute dtype; quantized
+    stacks dequantise here (transient — the ragged path needs plain
+    operands).  int8: per-(expert, out) scale; int4: per-(expert, group,
+    out) scale (models/quant.py)."""
     w = layer[name]
+    gscale = layer.get(name + "_gscale")
+    if gscale is not None:
+        from .quant import dequantize_grouped
+
+        return dequantize_grouped(w, gscale, dtype)
     scale = layer.get(name + "_scale")
     if scale is None:
         return w if w.dtype == dtype else w.astype(dtype)
@@ -173,6 +201,8 @@ def _moe_mlp_dispatch(x, layer, cfg: ModelConfig):
     xe = buf[:e]                                               # [E, cap, D]
 
     def expert_mm(h, name, out_pattern):
+        if layer.get(name + "_gscale") is not None:    # int4: transient dequant
+            return jnp.einsum(out_pattern, h, _expert_w(layer, name, h.dtype))
         w = layer[name]
         scale = layer.get(name + "_scale")
         y = jnp.einsum(out_pattern, h, w.astype(h.dtype))

@@ -31,7 +31,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["MATMUL_WEIGHTS", "quantize_params", "quantize_stacked", "is_quantized"]
+__all__ = ["MATMUL_WEIGHTS", "quantize_params", "quantize_stacked",
+           "is_quantized", "symmetric_int4_grouped",
+           "symmetric_int4_grouped_np", "dequantize_grouped",
+           "dequantize_params", "GROUP_SIZE"]
+
+#: int4 group size along the contraction (input-feature) dim — the
+#: AWQ/GPTQ-standard granularity; per-output-channel alone is too coarse
+#: for 15 levels.  128 matches the TPU lane tile and divides every
+#: llama-family hidden/intermediate size.
+GROUP_SIZE = 128
 
 #: matmul weights eligible for int8 storage ([..., in, out] layout);
 #: the moe expert stacks are [L, E, in, out] and quantize per (layer,
@@ -64,48 +73,130 @@ def _quantize_leaf(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return symmetric_int8(w, axis=-2)
 
 
-def quantize_stacked(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+def _group_size_for(n_in: int, group_size: int) -> int:
+    """Largest power-of-two-reduced divisor of ``n_in`` at most
+    ``group_size`` (non-standard in-dims fall back gracefully)."""
+    g = min(group_size, n_in)
+    while n_in % g:
+        g //= 2
+    return max(g, 1)
+
+
+def symmetric_int4_grouped(w: jnp.ndarray, group_size: int = GROUP_SIZE
+                           ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Group-wise symmetric int4: ``[..., in, out]`` → (int4 weights of
+    the SAME shape, f32 scales ``[..., in/g, out]``).
+
+    Scale ``s[.., G, o] = max_abs(w[.., G*g:(G+1)*g, o]) / 7``; XLA's
+    native ``s4`` dtype stores two nibbles per byte on TPU, so weight HBM
+    is 4× smaller than bf16 (+ scales: f32/g ≈ 0.25 bit/weight at g=128).
+    """
+    *lead, n_in, n_out = w.shape
+    g = _group_size_for(n_in, group_size)
+    wf = w.astype(jnp.float32).reshape(*lead, n_in // g, g, n_out)
+    s = jnp.max(jnp.abs(wf), axis=-2) / 7.0
+    s = jnp.where(s == 0.0, 1.0, s)
+    q = jnp.round(wf / s[..., None, :])
+    q = jnp.clip(q, -7, 7).astype(jnp.int4).reshape(*lead, n_in, n_out)
+    return q, s
+
+
+def symmetric_int4_grouped_np(w, group_size: int = GROUP_SIZE):
+    """Host-side (numpy) twin of :func:`symmetric_int4_grouped` for the
+    shard-direct loader: quantizes a checkpoint slice without touching a
+    device.  Bit-identical grids given the same ``group_size``."""
+    import ml_dtypes
+    import numpy as np
+
+    *lead, n_in, n_out = w.shape
+    g = _group_size_for(n_in, group_size)
+    wf = np.asarray(w, np.float32).reshape(*lead, n_in // g, g, n_out)
+    s = np.abs(wf).max(axis=-2) / 7.0
+    s = np.where(s == 0.0, 1.0, s)
+    q = np.clip(np.round(wf / s[..., None, :]), -7, 7)
+    return (q.reshape(*lead, n_in, n_out).astype(ml_dtypes.int4),
+            s.astype(np.float32))
+
+
+def dequantize_grouped(q: jnp.ndarray, gscale: jnp.ndarray, dtype
+                       ) -> jnp.ndarray:
+    """int4 ``[..., in, out]`` + scales ``[..., G, out]`` → ``dtype``
+    weights (a transient — the dense hot path never calls this, see
+    ``_mm``'s fused group einsum; expert paths use it per layer)."""
+    *lead, n_in, n_out = q.shape
+    n_groups = gscale.shape[-2]
+    g = n_in // n_groups
+    wf = q.astype(dtype).reshape(*lead, n_groups, g, n_out)
+    return (wf * gscale[..., None, :].astype(dtype)).reshape(*lead, n_in, n_out)
+
+
+def dequantize_params(params: dict, dtype=jnp.float32) -> dict:
+    """Inverse of :func:`quantize_params` (int4/``_gscale`` leaves only —
+    the test/dryrun oracle): EVERY quantized leaf dequantises, including
+    top-level ones like ``lm_head``, so a comparison engine really runs
+    the plain-weights path end to end."""
+    def deq_store(src: dict) -> dict:
+        out: dict = {}
+        for name, leaf in src.items():
+            if name.endswith("_gscale"):
+                continue
+            gs = src.get(name + "_gscale")
+            out[name] = (dequantize_grouped(leaf, gs, dtype)
+                         if gs is not None else leaf)
+        return out
+
+    out = deq_store({k: v for k, v in params.items() if k != "layers"})
+    out["layers"] = deq_store(params["layers"])
+    return out
+
+
+def quantize_stacked(w: jnp.ndarray, mode: str = "int8"
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Quantize a stacked ``[L, in, out]`` weight layer-by-layer.
 
-    ``_quantize_leaf`` on the whole stack materialises fp32 temporaries of
-    the full stacked size (5.8 GB for 6.7b's MLP weights) — several alive
-    at once under JAX's async dispatch is an instant OOM next to the
-    model.  Slicing keeps the fp32 transient to one layer."""
+    Quantizing the whole stack materialises fp32 temporaries of the full
+    stacked size (5.8 GB for 6.7b's MLP weights) — several alive at once
+    under JAX's async dispatch is an instant OOM next to the model.
+    Slicing keeps the fp32 transient to one layer."""
+    leaf = _quantize_leaf if mode == "int8" else symmetric_int4_grouped
     if w.ndim <= 2:
-        return _quantize_leaf(w)
-    parts = [_quantize_leaf(w[i]) for i in range(w.shape[0])]
+        return leaf(w)
+    parts = [leaf(w[i]) for i in range(w.shape[0])]
     return (jnp.stack([q for q, _ in parts]),
             jnp.stack([s for _, s in parts]))
 
 
-def quantize_into(store: dict, name: str, arr: jnp.ndarray) -> None:
-    """Store ``arr`` under ``name``, quantizing it (int8 + ``<name>_scale``
-    sibling) when it is a matmul weight — the ONE place that defines the
-    storage convention ``_mm`` (models/model.py) and the sharding rules
-    (parallel/sharding.py) consume."""
+def quantize_into(store: dict, name: str, arr: jnp.ndarray,
+                  mode: str = "int8") -> None:
+    """Store ``arr`` under ``name``, quantizing it when it is a matmul
+    weight — the ONE place that defines the storage conventions ``_mm``
+    (models/model.py) and the sharding rules (parallel/sharding.py)
+    consume: int8 rides a per-out-channel ``<name>_scale`` sibling, int4
+    a per-(group, out-channel) ``<name>_gscale``."""
     if name in MATMUL_WEIGHTS:
-        q, s = quantize_stacked(arr)
+        q, s = quantize_stacked(arr, mode)
         store[name] = q
-        store[name + "_scale"] = s
+        store[name + ("_scale" if mode == "int8" else "_gscale")] = s
     else:
         store[name] = arr
 
 
-def quantize_params(params: dict) -> dict:
+def quantize_params(params: dict, mode: str = "int8") -> dict:
     """Return a params tree with matmul weights in int8 + ``*_scale``
-    leaves.  Norms, biases and the embedding stay in their dtype."""
+    (or int4 + ``*_gscale``) leaves.  Norms, biases and the embedding
+    stay in their dtype."""
     out: dict = {}
     for name, value in params.items():
         if name == "layers":
             layers: dict = {}
             for k, v in value.items():
-                quantize_into(layers, k, v)
+                quantize_into(layers, k, v, mode)
             out["layers"] = layers
         else:
-            quantize_into(out, name, value)
+            quantize_into(out, name, value, mode)
     return out
 
 
 def is_quantized(params: dict) -> bool:
     layers = params.get("layers", {})
-    return any(k.endswith("_scale") for k in layers)
+    return any(k.endswith(("_scale", "_gscale")) for k in layers)

@@ -16,9 +16,19 @@ process only materialises its own devices' shards, and the resulting
 ``jax.Array``s are global views over the mesh.
 
 Weight-only int8 (``dtype="int8"``) is NOT supported here: per-channel
-scales need a global amax over a dim that tensor parallelism may shard,
-so quantize-then-shard must see whole tensors — use ``load_checkpoint``
-for int8 (its models fit single-host RAM by construction).
+scales need a global amax over the WHOLE contraction dim, which tensor
+parallelism shards — use ``load_checkpoint`` for int8 (its models fit
+single-host RAM by construction).
+
+Weight-only int4 (``dtype="int4"``) IS supported — this loader is how
+the 34B CoT flagship actually reaches a v5e-8 (PERF.md HBM table; the
+full-tree path would put 17 GB bf16 leaves through one device).  int4's
+group scales are LOCAL to ``g`` consecutive contraction values, so each
+shard quantizes its own slice: the per-leaf group size is chosen to
+divide the shard's contraction slice (``_group_size_for(in/tp)``), which
+makes group boundaries align with shard boundaries — shard-local
+quantization is then bit-identical to quantizing the whole tensor at
+that group size.
 """
 
 from __future__ import annotations
@@ -80,6 +90,9 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
             "load_checkpoint(dtype='int8') and shard_params instead")
     from ..parallel.sharding import param_specs
 
+    int4 = dtype == "int4"
+    if int4:
+        dtype = "bfloat16"
     model_path = Path(model_path)
     cfg = cfg or load_hf_config(model_path)
     cfg.dtype = dtype
@@ -89,62 +102,121 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
     if cfg.tie_word_embeddings or _TOP_LEVEL["lm_head"][0] not in reader:
         template.pop("lm_head", None)
         cfg.tie_word_embeddings = True
-    specs = (specs_fn or param_specs)(template, cfg, mesh)
     wmap = _weight_map(cfg)
 
-    def top_leaf(name: str, shape) -> jax.Array:
+    g_eff: dict[str, int] = {}
+    if int4:
+        # per-leaf group size dividing the shard's contraction slice, so
+        # shard-local quantization == whole-tensor quantization at that g
+        from .quant import GROUP_SIZE, MATMUL_WEIGHTS, _group_size_for
+
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        pre_specs = (specs_fn or param_specs)(template, cfg, mesh)
+
+        def add_gscales(store: dict, spec_store: dict) -> None:
+            for name, shape in list(store.items()):
+                if name not in MATMUL_WEIGHTS or len(shape) < 2:
+                    continue
+                in_dim = len(shape) - 2
+                spec = spec_store[name]
+                ax = spec[in_dim] if in_dim < len(spec) else None
+                shards = sizes.get(ax, 1) if ax else 1
+                g = _group_size_for(shape[in_dim] // shards, GROUP_SIZE)
+                g_eff[name] = g
+                store[name + "_gscale"] = (*shape[:in_dim],
+                                           shape[in_dim] // g, shape[-1])
+
+        add_gscales(template["layers"], pre_specs["layers"])
+        add_gscales(template, pre_specs)   # top level: lm_head (if untied)
+    specs = (specs_fn or param_specs)(template, cfg, mesh)
+
+    def read_block(name: str, key, is_layer: bool) -> np.ndarray:
+        """One f32 host block covering ``key`` (weight index space)."""
+        if is_layer:
+            hf_template, transpose = wmap[name]
+            layer_rng = range(*key[0])
+            if "{e}" in hf_template:
+                parts = [
+                    np.stack([reader.get_range(
+                        hf_template.format(i=i, e=e),
+                        _slices(key[2:]), transpose)
+                        for e in range(*key[1])])
+                    for i in layer_rng]
+            else:
+                parts = [reader.get_range(hf_template.format(i=i),
+                                          _slices(key[1:]), transpose)
+                         for i in layer_rng]
+            return np.stack(parts).astype(np.float32)
         hf_name, transpose = _TOP_LEVEL[name]
-        sharding = NamedSharding(mesh, specs[name])
-        cache: dict = {}
+        return reader.get_range(hf_name, _slices(key),
+                                transpose).astype(np.float32)
 
-        def cb(idx):
-            key = _resolve(idx, shape)
-            if key not in cache:
-                cache[key] = reader.get_range(hf_name, _slices(key), transpose
-                                              ).astype(np.float32).astype(target)
-            return cache[key]
-
-        return jax.make_array_from_callback(tuple(shape), sharding, cb)
-
-    def layer_leaf(name: str, shape) -> jax.Array:
-        """Stacked [L, ...] leaf assembled from per-layer HF tensors; the
-        callback reads exactly the layer range JAX asks for, so a
+    def plain_leaf(name: str, shape, spec, is_layer: bool) -> jax.Array:
+        """The callback reads exactly the range JAX asks for: a
         ``pp``-sharded layer dim means each host reads only its own
-        stages' tensors.  MoE expert stacks
-        ([L, E, in, out], ``{e}`` in the template) additionally iterate
-        the callback's expert range — an ``ep``-sharded mesh then makes
-        each host read only its own experts' tensors."""
-        hf_template, transpose = wmap[name]
-        sharding = NamedSharding(mesh, specs["layers"][name])
+        stages' tensors, an ``ep``-sharded expert dim only its own
+        experts'."""
+        sharding = NamedSharding(mesh, spec)
         cache: dict = {}
 
         def cb(idx):
             key = _resolve(idx, shape)
             if key not in cache:
-                layer_rng = range(*key[0])
-                if "{e}" in hf_template:
-                    parts = [
-                        np.stack([reader.get_range(
-                            hf_template.format(i=i, e=e),
-                            _slices(key[2:]), transpose)
-                            for e in range(*key[1])])
-                        for i in layer_rng]
-                else:
-                    parts = [reader.get_range(hf_template.format(i=i),
-                                              _slices(key[1:]), transpose)
-                             for i in layer_rng]
-                cache[key] = np.stack(parts).astype(np.float32).astype(target)
+                cache[key] = read_block(name, key, is_layer).astype(target)
             return cache[key]
 
         return jax.make_array_from_callback(tuple(shape), sharding, cb)
+
+    def quantized_pair(name: str, shape, gshape, wspec, sspec,
+                       is_layer: bool) -> tuple[jax.Array, jax.Array]:
+        """int4 weight + gscale arrays sharing one read+quantize per
+        block: the gscale callback maps its (G-dim) index back onto the
+        weight's (in-dim) index, so congruently-sharded leaves hit the
+        same cache entry."""
+        from .quant import symmetric_int4_grouped_np
+
+        g = g_eff[name]
+        in_dim = len(shape) - 2
+        qcache: dict = {}
+
+        def block(key):
+            if key not in qcache:
+                qcache[key] = symmetric_int4_grouped_np(
+                    read_block(name, key, is_layer), group_size=g)
+            return qcache[key]
+
+        def w_cb(idx):
+            return block(_resolve(idx, shape))[0]
+
+        def s_cb(idx):
+            skey = list(_resolve(idx, gshape))
+            g0, g1, _ = skey[in_dim]
+            skey[in_dim] = (g0 * g, g1 * g, 1)
+            return block(tuple(skey))[1]
+
+        return (jax.make_array_from_callback(
+                    tuple(shape), NamedSharding(mesh, wspec), w_cb),
+                jax.make_array_from_callback(
+                    tuple(gshape), NamedSharding(mesh, sspec), s_cb))
+
+    def build(store: dict, spec_store: dict, shapes: dict,
+              is_layer: bool) -> None:
+        for name, shape in shapes.items():
+            if name.endswith("_gscale"):
+                continue
+            if is_layer and (name not in wmap
+                             or wmap[name][0].format(i=0, e=0) not in reader):
+                continue           # optional weight absent (e.g. biases)
+            if name + "_gscale" in shapes:
+                store[name], store[name + "_gscale"] = quantized_pair(
+                    name, shape, shapes[name + "_gscale"],
+                    spec_store[name], spec_store[name + "_gscale"], is_layer)
+            else:
+                store[name] = plain_leaf(name, shape, spec_store[name],
+                                         is_layer)
 
     params: dict = {"layers": {}}
-    for name, shape in template.items():
-        if name == "layers":
-            for k, shp in shape.items():
-                if k not in wmap or wmap[k][0].format(i=0, e=0) not in reader:
-                    continue           # optional weight absent (e.g. biases)
-                params["layers"][k] = layer_leaf(k, shp)
-        else:
-            params[name] = top_leaf(name, shape)
+    build(params["layers"], specs["layers"], template["layers"], True)
+    build(params, specs, {k: v for k, v in template.items() if k != "layers"},
+          False)
     return params, cfg

@@ -74,6 +74,23 @@ _LAYER_RULES = {
     "o_w_scale": P(),
     "down_w_scale": P(),
     "proj_w_scale": P(),
+    # int4 group scales [L, G, out]: out-feature-sharded weights shard
+    # the out dim; input-feature-sharded (partial-sum) weights shard the
+    # GROUP dim, which subdivides the contraction exactly like the weight
+    # (groups never straddle a tp shard: g=128 divides every in-slice)
+    "q_w_gscale": P(None, None, "tp"),
+    "k_w_gscale": P(None, None, "tp"),
+    "v_w_gscale": P(None, None, "tp"),
+    "gate_w_gscale": P(None, None, "tp"),
+    "up_w_gscale": P(None, None, "tp"),
+    "fc_w_gscale": P(None, None, "tp"),
+    "o_w_gscale": P(None, "tp", None),
+    "down_w_gscale": P(None, "tp", None),
+    "proj_w_gscale": P(None, "tp", None),
+    # moe int4 group scales [L, E, G, out] follow their weight's ep/tp dims
+    "moe_gate_w_gscale": P(None, "ep", None, "tp"),
+    "moe_up_w_gscale": P(None, "ep", None, "tp"),
+    "moe_down_w_gscale": P(None, "ep", "tp", None),
     # mixture-of-experts: expert dim over ``ep``, per-expert FFN dims over
     # ``tp`` (the batched-einsum formulation in models/model.py keeps the
     # expert dim leading, so ep shards experts whole — the dispatch
@@ -98,6 +115,7 @@ _TOP_RULES = {
     "embed": P("tp", None),       # vocab-sharded; also the tied lm head
     "lm_head": P(None, "tp"),
     "lm_head_scale": P("tp"),     # int8 scale follows lm_head's vocab dim
+    "lm_head_gscale": P(None, "tp"),   # int4 [G, V]: vocab dim sharded
     "final_norm_w": P(),
     "final_norm_b": P(),
 }
@@ -126,14 +144,14 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
 
     def top_spec(name):
         spec = _TOP_RULES.get(name, P())
-        base = name.removesuffix("_scale")
+        base = name.removesuffix("_gscale").removesuffix("_scale")
         if base in ("embed", "lm_head") and not div["vocab"]:
             return P()
         return spec
 
     def layer_spec(name):
         spec = _LAYER_RULES.get(name, P())
-        base = name.removesuffix("_scale")   # int8 scales follow their weight
+        base = name.removesuffix("_gscale").removesuffix("_scale")  # scales follow their weight
         if base in ("k_w", "v_w", "k_b", "v_b") and not div["kv_heads"]:
             return P()
         if base in ("q_w", "o_w", "q_b") and not div["heads"]:
@@ -147,12 +165,38 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
                        for a in spec))
         return spec
 
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def fit(name: str, spec: P, leaf) -> P:
+        """Shape safety net: drop any spec axis that does not divide its
+        dim (e.g. an int4 gscale with fewer groups than tp shards on a
+        toy config) — NamedSharding would reject it outright, and GSPMD
+        keeps the math correct with the dim replicated.  Downgrades are
+        WARNED (once per leaf/axis): a silently replicated 34B leaf is
+        gigabytes of duplicated HBM per chip and would otherwise surface
+        only as an unexplained OOM.  ``leaf`` is an array or a bare shape
+        tuple (the sharded loader passes the checkpoint template)."""
+        import warnings
+
+        shape = getattr(leaf, "shape", leaf)
+        out = []
+        for d, a in enumerate(spec[:len(shape)]):
+            if a is not None and shape[d] % sizes.get(a, 1) != 0:
+                warnings.warn(
+                    f"sharding: replicating dim {d} of {name!r} "
+                    f"(size {shape[d]} not divisible by {a}={sizes.get(a)})",
+                    stacklevel=3)
+                a = None
+            out.append(a)
+        return P(*out)
+
     specs: dict = {}
     for name, value in params.items():
         if name == "layers":
-            specs["layers"] = {k: layer_spec(k) for k in value}
+            specs["layers"] = {k: fit(k, layer_spec(k), v)
+                               for k, v in value.items()}
         else:
-            specs[name] = top_spec(name)
+            specs[name] = fit(name, top_spec(name), value)
     return specs
 
 
