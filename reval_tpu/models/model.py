@@ -69,25 +69,24 @@ def _mm(x, container, name: str):
     contraction dim.
 
     int4 (``<name>_gscale`` [G, out], models/quant.py group-wise scheme):
-    the contraction splits into groups — one batched einsum over
-    ``[..., G, g] × [G, g, out]`` produces per-group partials that are
-    scaled and summed, so the scale (which varies along the contraction)
-    still applies outside a matmul and no dequantised bf16 copy of the
-    weight ever lands in HBM."""
+    the scale folds into the weight operand — convert + broadcast-
+    multiply is an elementwise producer XLA fuses into the dot's operand
+    load (the same fusion the int8 convert rides), and the einsum
+    contracts BOTH the group and in-group dims in one f32-accumulated
+    dot, so there are no per-group partial sums and no [T, G, out]
+    intermediate (at 34B prefill shapes such partials would be
+    a multi-GB f32 transient)."""
     w = container[name]
     gs = container.get(name + "_gscale")
     if gs is not None:
         n_groups = gs.shape[-2]
         g = w.shape[-2] // n_groups
         xg = x.reshape(*x.shape[:-1], n_groups, g)
-        wg = w.reshape(n_groups, g, w.shape[-1]).astype(x.dtype)
-        # f32 partials: bf16 would add ~G extra roundings per output
-        # element (scale-multiply + the group sum) that the int8 path's
-        # single f32-accumulated dot doesn't have
-        part = jnp.einsum("...gi,gio->...go", xg, wg,
-                          preferred_element_type=jnp.float32)
-        return jnp.sum(part * gs.astype(jnp.float32),
-                       axis=-2).astype(x.dtype)
+        wdq = (w.reshape(n_groups, g, w.shape[-1]).astype(x.dtype)
+               * gs.astype(x.dtype)[:, None, :])
+        out = jnp.einsum("...gi,gio->...o", xg, wdq,
+                         preferred_element_type=jnp.float32)
+        return out.astype(x.dtype)
     s = container.get(name + "_scale")
     if s is None:
         return x @ w
@@ -301,7 +300,7 @@ def _unembed(params, cfg: ModelConfig, h):
 
 def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
             cache: KVCache, logits_mode: str = "all", attend_fn=None,
-            constrain=None) -> tuple[jnp.ndarray, KVCache]:
+            constrain=None, collect_hiddens: bool = False):
     """Process a left-padded prompt block [B, T]; fill cache positions
     [0, T); return logits and the updated cache.
 
@@ -314,6 +313,14 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
     varies across prefill deployments — the sequence-parallel path swaps
     in ring attention); ``constrain(h)`` (optional) re-annotates the
     activation sharding after embed and after every layer.
+
+    ``collect_hiddens=True`` (fidelity tests only — static flag, so the
+    generation path compiles without it) additionally returns the
+    pre-final-norm hidden states after every layer, ``[L, B, T, D]``.
+    For layers ``l < L-1`` these equal ``transformers``'
+    ``output_hidden_states`` entries ``hidden_states[l+1]``; HF's LAST
+    entry has the final norm already applied, so the last layer compares
+    through the logits instead (see tests/test_bf16_fidelity.py).
     """
     b, t = tokens.shape
     h = _embed(params, cfg, tokens)
@@ -347,14 +354,19 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
         h = _block(h, layer, cfg, cos, sin, attend)
         if constrain is not None:
             h = constrain(h)
-        return h, (kv["k"], kv["v"])
+        ys = (kv["k"], kv["v"], h) if collect_hiddens else (kv["k"], kv["v"])
+        return h, ys
 
-    h, (new_k, new_v) = jax.lax.scan(
+    h, ys = jax.lax.scan(
         layer_step, h, (params["layers"], cache.k, cache.v, wins))
+    new_k, new_v = ys[0], ys[1]
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
     if logits_mode == "last":
         h = h[:, -1:, :]   # left-padding puts every row's final token last
-    return _unembed(params, cfg, h), KVCache(new_k, new_v)
+    logits = _unembed(params, cfg, h)
+    if collect_hiddens:
+        return logits, KVCache(new_k, new_v), ys[2]
+    return logits, KVCache(new_k, new_v)
 
 
 def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,

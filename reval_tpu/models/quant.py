@@ -150,7 +150,7 @@ def dequantize_params(params: dict, dtype=jnp.float32) -> dict:
     return out
 
 
-def quantize_stacked(w: jnp.ndarray, mode: str = "int8"
+def quantize_stacked(w: jnp.ndarray, mode: str = "int8", tp: int = 1
                      ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Quantize a stacked ``[L, in, out]`` weight layer-by-layer.
 
@@ -158,7 +158,13 @@ def quantize_stacked(w: jnp.ndarray, mode: str = "int8"
     stacked size (5.8 GB for 6.7b's MLP weights) — several alive at once
     under JAX's async dispatch is an instant OOM next to the model.
     Slicing keeps the fp32 transient to one layer."""
-    leaf = _quantize_leaf if mode == "int8" else symmetric_int4_grouped
+    if mode == "int8":
+        leaf = _quantize_leaf
+    else:
+        g = _tp_aligned_group(w.shape[-2], tp)
+
+        def leaf(x):
+            return symmetric_int4_grouped(x, group_size=g)
     if w.ndim <= 2:
         return leaf(w)
     parts = [leaf(w[i]) for i in range(w.shape[0])]
@@ -166,34 +172,49 @@ def quantize_stacked(w: jnp.ndarray, mode: str = "int8"
             jnp.stack([s for _, s in parts]))
 
 
+def _tp_aligned_group(n_in: int, tp: int) -> int:
+    """int4 group size whose boundaries align with a ``tp``-way shard of
+    the contraction dim: groups then never straddle shards, so the
+    sharded ``_mm`` reshape needs no resharding and the gscale's group
+    dim divides ``tp``.  Same rule the shard-direct loader applies."""
+    if tp > 1 and n_in % tp == 0:
+        return _group_size_for(n_in // tp, GROUP_SIZE)
+    return _group_size_for(n_in, GROUP_SIZE)
+
+
 def quantize_into(store: dict, name: str, arr: jnp.ndarray,
-                  mode: str = "int8") -> None:
+                  mode: str = "int8", tp: int = 1) -> None:
     """Store ``arr`` under ``name``, quantizing it when it is a matmul
     weight — the ONE place that defines the storage conventions ``_mm``
     (models/model.py) and the sharding rules (parallel/sharding.py)
     consume: int8 rides a per-out-channel ``<name>_scale`` sibling, int4
     a per-(group, out-channel) ``<name>_gscale``."""
     if name in MATMUL_WEIGHTS:
-        q, s = quantize_stacked(arr, mode)
+        q, s = quantize_stacked(arr, mode, tp)
         store[name] = q
         store[name + ("_scale" if mode == "int8" else "_gscale")] = s
     else:
         store[name] = arr
 
 
-def quantize_params(params: dict, mode: str = "int8") -> dict:
+def quantize_params(params: dict, mode: str = "int8", tp: int = 1) -> dict:
     """Return a params tree with matmul weights in int8 + ``*_scale``
     (or int4 + ``*_gscale``) leaves.  Norms, biases and the embedding
-    stay in their dtype."""
+    stay in their dtype.
+
+    ``tp``: intended tensor-parallel width for params-in-hand int4 use
+    (engine construction from an already-loaded tree) — aligns group
+    boundaries to shard boundaries like the shard-direct loader does, so
+    in-sharded matmuls don't pay a GSPMD reshard every step."""
     out: dict = {}
     for name, value in params.items():
         if name == "layers":
             layers: dict = {}
             for k, v in value.items():
-                quantize_into(layers, k, v, mode)
+                quantize_into(layers, k, v, mode, tp)
             out["layers"] = layers
         else:
-            quantize_into(out, name, value, mode)
+            quantize_into(out, name, value, mode, tp)
     return out
 
 

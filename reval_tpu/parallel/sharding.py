@@ -179,6 +179,11 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
         import warnings
 
         shape = getattr(leaf, "shape", leaf)
+        if len(spec) > len(shape):
+            warnings.warn(
+                f"sharding: rule for {name!r} has rank {len(spec)} but the "
+                f"leaf is rank {len(shape)} — trailing axes dropped "
+                f"(template/rule mismatch?)", stacklevel=3)
         out = []
         for d, a in enumerate(spec[:len(shape)]):
             if a is not None and shape[d] % sizes.get(a, 1) != 0:

@@ -1,0 +1,129 @@
+"""Scale-realistic numerical fidelity (SURVEY §7 hard part 3; VERDICT
+round-2 weak item 4): per-layer drift at FLAGSHIP width/depth.
+
+The tiny-random parity tests (test_models.py) establish implementation
+correctness but say nothing about bf16 drift at real scale — error
+compounds with width (reduction length) and depth, and the failure mode
+that matters is a flipped YES/NO answer at temperature 0.8.  This test
+runs the actual deepseek-coder-1.3b shape (24 layers × 2048 hidden,
+flagship BASELINE.json configs[0]) with random weights:
+
+1. cross-implementation fp32: our per-layer hidden states vs
+   transformers' ``output_hidden_states`` — implementation parity at
+   scale, tight tolerance;
+2. bf16 vs fp32 (ours): per-layer relative drift with a justified
+   bound — bf16 unit roundoff is 2^-8 ≈ 3.9e-3, rounding errors
+   accumulate roughly with the square root of the number of sequential
+   roundings, so we allow eps * sqrt(ops_per_layer * (l+1)) with
+   ops_per_layer ≈ 7 (4 attn matmuls + 3 mlp) and a 4x safety factor;
+3. logits-level effect: relative logit error and greedy top-1 agreement
+   (reported; asserted only against catastrophic divergence, since
+   random-weight logit margins are pessimistically small vs a trained
+   model's).
+
+Runs minutes on one CPU core (a 1.3B fp32 torch forward + two jax
+forwards); kept as one test function so the cost is paid once.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+FLAGSHIP = dict(
+    vocab_size=32256, hidden_size=2048, intermediate_size=5504,
+    num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+    max_position_embeddings=4096, rope_theta=100000.0, rms_norm_eps=1e-6,
+    tie_word_embeddings=False,
+)
+
+SEQ = 128
+BF16_EPS = 2.0 ** -8
+OPS_PER_LAYER = 7
+SAFETY = 4.0
+
+
+@pytest.fixture(scope="module")
+def flagship_checkpoint(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    path = tmp_path_factory.mktemp("ckpt") / "flagship-random"
+    torch.manual_seed(42)
+    model = LlamaForCausalLM(LlamaConfig(**FLAGSHIP)).eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def test_flagship_width_bf16_per_layer_fidelity(flagship_checkpoint):
+    import torch
+
+    from reval_tpu.models import init_kv_cache, load_checkpoint, prefill
+
+    model, path = flagship_checkpoint
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, FLAGSHIP["vocab_size"] - 1, size=(1, SEQ))
+
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens), output_hidden_states=True)
+    # hidden_states[l+1] = decoder layer l output (pre final norm)
+    ref_hiddens = [h.float().numpy() for h in ref.hidden_states[1:]]
+    ref_logits = ref.logits.float().numpy()
+    del ref
+
+    params, cfg = load_checkpoint(path, dtype="float32")
+    pad = jnp.zeros(1, jnp.int32)
+    toks = jnp.asarray(tokens, jnp.int32)
+
+    def run(p, dtype):
+        cache = init_kv_cache(cfg, 1, SEQ, dtype=dtype)
+        logits, _, hiddens = prefill(p, cfg=cfg, tokens=toks, pad_len=pad,
+                                     cache=cache, collect_hiddens=True)
+        return (np.asarray(logits, np.float32),
+                np.asarray(hiddens, np.float32))
+
+    f32_logits, f32_hiddens = run(params, jnp.float32)
+
+    # -- 1. cross-implementation parity at scale (fp32 vs transformers) --
+    # transformers applies the FINAL norm to its last hidden_states entry
+    # (LlamaModel.forward norms before appending), so the last layer's
+    # pre-norm state isn't observable there — it is covered by the logits
+    # check below, which passes through final norm + lm_head.
+    for layer, ref_h in enumerate(ref_hiddens[:-1]):
+        rel = (np.linalg.norm(f32_hiddens[layer] - ref_h)
+               / np.linalg.norm(ref_h))
+        assert rel < 2e-3, f"fp32 impl divergence at layer {layer}: {rel:.2e}"
+    logit_rel = np.linalg.norm(f32_logits - ref_logits) / np.linalg.norm(ref_logits)
+    assert logit_rel < 2e-3, f"fp32 logits diverge: {logit_rel:.2e}"
+
+    # -- 2. bf16 drift, per layer, against the roundoff-growth model ----
+    bf16_params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, params)
+    bf16_logits, bf16_hiddens = run(bf16_params, jnp.bfloat16)
+
+    drifts = []
+    for layer in range(cfg.num_layers):
+        rel = (np.linalg.norm(bf16_hiddens[layer] - f32_hiddens[layer])
+               / np.linalg.norm(f32_hiddens[layer]))
+        bound = SAFETY * BF16_EPS * np.sqrt(OPS_PER_LAYER * (layer + 1))
+        drifts.append(rel)
+        assert rel < bound, (
+            f"bf16 drift at layer {layer}: {rel:.4f} exceeds the "
+            f"roundoff-growth bound {bound:.4f} — suggests a bf16-specific "
+            f"bug (e.g. a reduction not done in f32), not benign rounding")
+    # drift must actually grow like accumulation, not blow up early:
+    # the final layer's drift should dominate the first layer's
+    assert drifts[-1] > drifts[0]
+
+    # -- 3. logits-level effect ----------------------------------------
+    logit_drift = (np.linalg.norm(bf16_logits - f32_logits)
+                   / np.linalg.norm(f32_logits))
+    agree = float(np.mean(bf16_logits.argmax(-1) == f32_logits.argmax(-1)))
+    # random weights are the worst case for argmax stability (near-zero
+    # top-1 margins); catastrophic-divergence guard only
+    assert logit_drift < 0.10, f"bf16 logit drift {logit_drift:.3f}"
+    assert agree > 0.5, f"greedy agreement collapsed: {agree:.2f}"
+    print(f"per-layer drift: first={drifts[0]:.4f} last={drifts[-1]:.4f}; "
+          f"logits rel-err={logit_drift:.4f}; greedy agreement={agree:.2%}")

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-3 on-chip measurement suite (VERDICT items 1, 2, 3, 7 + the int8
+# default-dtype decision).  Idempotent: each step skips itself once its
+# artifact exists, so repeated invocations (the tpu_watch loop calls this
+# every time the tunnel is up) resume where the last window ended.
+#
+# Artifacts land in tpu_watch/:
+#   bench_direct.json        official flagship number (BENCH_r03 candidate)
+#   ablate.txt               decode-roofline ablation (VERDICT item 2)
+#   bench_direct_int8.json   weight-dtype A/B (round-2 pending decision)
+#   bench_cot.json           CoT shape baseline (VERDICT item 3)
+#   bench_cot_kv8.json       CoT + int8 KV pages A/B (VERDICT item 3)
+#   fleet.json               4-task fusion demo (VERDICT item 7)
+#   bench_direct_int4.json   int4 weight A/B
+#   ablate_int8.txt          ablation with int8 weights
+cd /root/repo || exit 1
+mkdir -p tpu_watch
+R=tpu_watch
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/.cache/jax_comp}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+log() { echo "$(date +%Y-%m-%dT%H:%M:%S) $*" >> $R/runbook.log; }
+
+# run <artifact> <timeout_s> <json|txt> <cmd...>
+run() {
+  local name=$1 to=$2 kind=$3; shift 3
+  [ -s "$R/$name" ] && { log "skip $name (done)"; return 0; }
+  log "start $name: $*"
+  timeout "$to" "$@" > "$R/$name.tmp" 2> "$R/$name.err"
+  local rc=$?
+  log "end $name rc=$rc"
+  if [ $rc -eq 0 ]; then
+    if [ "$kind" = json ]; then
+      grep -q '"value"' "$R/$name.tmp" && ! grep -q '"error"' "$R/$name.tmp" \
+        && mv "$R/$name.tmp" "$R/$name" && return 0
+      log "reject $name (no clean value JSON)"
+      return 1
+    fi
+    mv "$R/$name.tmp" "$R/$name"
+    return 0
+  fi
+  return $rc
+}
+
+run bench_direct.json      2400 json python bench.py
+run ablate.txt             1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600
+run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
+run bench_cot.json         3600 json python bench.py --mode cot
+run bench_cot_kv8.json     3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
+run fleet.json             2400 json python tools/fleet_bench.py
+run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
+run ablate_int8.txt        1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8
+log "runbook pass complete"

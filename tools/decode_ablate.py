@@ -71,9 +71,8 @@ def main() -> None:
     def run_variant(label: str, kv_dtype: str = "", no_attn: bool = False):
         orig = paged_mod.paged_decode_attention
         if no_attn:
-            paged_mod.paged_decode_attention = (
-                lambda q, k, v, bt, lens, page_size, window=None,
-                       k_scales=None, v_scales=None: q)
+            # signature-agnostic identity: the kernel's kwargs evolve
+            paged_mod.paged_decode_attention = lambda q, *a, **kw: q
         try:
             from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
 
@@ -93,22 +92,26 @@ def main() -> None:
                     tables[s, j] = 1 + s * need + j
             lens = np.full((b,), args.ctx, np.int32)
             tok = np.ones((b, 1), np.int32)
+            # packed state layout: tables | lens | token | PRNG key (2
+            # int32 words) | generated-pos (see _decode_chunk)
+            keys = eng.request_keys(b)
+            pos = np.zeros((b, 1), np.int32)
             state = jnp.asarray(
-                np.concatenate([tables, lens[:, None], tok], axis=1))
-            temp = jnp.float32(0.0)
-            key = jax.random.PRNGKey(0)
+                np.concatenate([tables, lens[:, None], tok,
+                                keys.view(np.int32), pos], axis=1))
+            temp = jnp.zeros((b,), jnp.float32)
 
             cache = eng.cache
             # warm compile
             toks, cache, state2 = eng._jit_chunk(eng.params, state, cache,
-                                                 temp, key, steps=args.steps)
+                                                 temp, steps=args.steps)
             jax.block_until_ready(toks)
             times = []
             st = state2
             for _ in range(args.reps):
                 t0 = time.perf_counter()
                 toks, cache, st = eng._jit_chunk(eng.params, st, cache,
-                                                 temp, key, steps=args.steps)
+                                                 temp, steps=args.steps)
                 jax.block_until_ready(toks)
                 times.append(time.perf_counter() - t0)
             eng.close()

@@ -1,0 +1,112 @@
+"""On-chip fleet-fusion demonstration (VERDICT round-2 item 7).
+
+The fleet runner's claim (fleet.py, replacing reference batch_run.py:20-32)
+is that concatenating all four tasks' prompts into ONE ``infer_many``
+keeps the chip saturated where per-task runs would each pay their own
+ragged tail.  This measures exactly that on real hardware: the four DREval
+tasks' genuine planned prompts (mock planning — same few-shot templates
+and programs the scoring pipeline sends), generated fused vs per-task on
+the same resident engine.
+
+Prints ONE JSON line: {"metric": "fleet_fusion_speedup", ...}.
+
+    python tools/fleet_bench.py --per-task 16
+    python tools/fleet_bench.py --tiny          # CPU smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def task_prompts(name: str, n: int, prompt_type: str) -> list[str]:
+    from reval_tpu.tasks import TASKS
+
+    items = 2
+    while True:
+        task = TASKS[name](model=None, prompt_type=prompt_type,
+                           dataset="humaneval", mock=True, max_items=items,
+                           progress=False)
+        _, jobs = task._plan()
+        if len(jobs) >= n or items > 64:
+            return [j.prompt for j in jobs][:n]
+        items *= 2
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--per-task", type=int, default=16,
+                    help="prompts per task (4 tasks)")
+    ap.add_argument("--max-new", type=int, default=256)
+    ap.add_argument("--slots", type=int, default=32)
+    ap.add_argument("--model", default="1.3b")
+    ap.add_argument("--dtype", choices=["bfloat16", "int8", "int4"],
+                    default="bfloat16")
+    ap.add_argument("--tiny", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import TrainedBPE, flagship
+
+    from reval_tpu.inference.tpu.engine import EngineStats
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.tasks import TASKS  # noqa: F401  (import check)
+
+    names = ("coverage", "path", "state", "output")
+    per = 3 if args.tiny else args.per_task
+    by_task = {n: task_prompts(n, per, "direct") for n in names}
+    all_prompts = [p for n in names for p in by_task[n]]
+    params, cfg = flagship(tiny=args.tiny, model=args.model,
+                           dtype=args.dtype)
+    tok = TrainedBPE(all_prompts)
+    max_new = 8 if args.tiny else args.max_new
+    slots = 4 if args.tiny else args.slots
+
+    eng = PagedTPUEngine(params, cfg, tok, max_slots=slots,
+                         max_seq_len=1024 if args.tiny else 2048)
+    stop = ["[/ANSWER]"]
+
+    def timed(prompt_sets):
+        # warmup covers every bucket/shape this exact workload hits
+        for ps in prompt_sets:
+            eng.generate(ps, max_new_tokens=max_new, temperature=0.0,
+                         stop=stop)
+        eng.stats = EngineStats()
+        t0 = time.perf_counter()
+        for ps in prompt_sets:
+            eng.generate(ps, max_new_tokens=max_new, temperature=0.0,
+                         stop=stop)
+        return time.perf_counter() - t0
+
+    fused_wall = timed([all_prompts])
+    per_task_wall = timed([by_task[n] for n in names])
+    eng.close()
+
+    n = len(all_prompts)
+    out = {
+        "metric": "fleet_fusion_speedup",
+        "value": round(per_task_wall / fused_wall, 3),
+        "unit": "x",
+        "vs_baseline": round(per_task_wall / fused_wall, 3),
+        "fused_probes_per_s": round(n / fused_wall, 3),
+        "per_task_probes_per_s": round(n / per_task_wall, 3),
+        "prompts": n,
+        "max_new": max_new,
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
