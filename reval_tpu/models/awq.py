@@ -1,0 +1,96 @@
+"""AWQ pre-quantized checkpoint ingestion.
+
+The reference consumes AWQ/GPTQ checkpoints through vLLM's
+``quantization`` kwarg (reference inference.py:93); published 4-bit 34B
+checkpoints (the CoT flagship class) ship in AWQ's GEMM format, so the
+TPU loader reads it natively and maps it onto this repo's int4 storage
+(models/quant.py) — asymmetric, hence the extra ``<name>_gzero`` leaf:
+
+AWQ GEMM tensor layout (per linear ``{module}.{qweight,qzeros,scales}``,
+AutoAWQ ``awq/utils/packing_utils.py`` semantics):
+
+- ``qweight`` int32 ``[in, out/8]``: eight unsigned 4-bit columns per
+  int32, nibble ``p`` (bit shift ``4p``) holding logical column
+  ``AWQ_ORDER[p]`` of its 8-column block;
+- ``qzeros`` int32 ``[in/g, out/8]``: zero points, packed identically;
+- ``scales`` fp16 ``[in/g, out]``;
+- dequantisation: ``w[i, o] = (q[i, o] - z[i//g, o]) * s[i//g, o]``.
+
+Mapping to our storage: ``w_int4 = q - 8`` (recentred into signed s4),
+``gscale = s``, ``gzero = (z - 8) * s`` — then
+``w = w_int4 * gscale - gzero`` exactly reproduces ``(q - z) * s``, and
+``_mm`` folds the subtraction into the same fused weight-operand chain
+as the symmetric path (models/model.py).
+
+No network egress on this host, so format compliance is validated by a
+synthetic writer (tests/test_awq.py) that packs with the same order map.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["AWQ_ORDER", "awq_config", "pack_awq", "unpack_awq",
+           "awq_to_leaves"]
+
+#: nibble position -> logical column offset within each 8-column block
+AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
+
+
+def awq_config(model_path) -> dict | None:
+    """The checkpoint's ``quantization_config`` when it is AWQ-GEMM 4-bit
+    (the only variant published 34B checkpoints use); None otherwise."""
+    cfg_path = Path(model_path) / "config.json"
+    if not cfg_path.exists():
+        return None
+    qc = json.loads(cfg_path.read_text()).get("quantization_config")
+    if not qc or qc.get("quant_method") != "awq":
+        return None
+    if qc.get("bits", 4) != 4:
+        raise ValueError(f"AWQ bits={qc.get('bits')} unsupported (int4 only)")
+    if qc.get("version", "gemm").lower() != "gemm":
+        # GEMV packs qweight output-major with a different nibble layout —
+        # unpacking it with GEMM semantics would be silent garbage for
+        # square projections, so refuse loudly
+        raise ValueError(f"AWQ version={qc.get('version')!r} unsupported "
+                         "(GEMM packing only)")
+    return qc
+
+
+def unpack_awq(packed: np.ndarray) -> np.ndarray:
+    """int32 ``[rows, cols/8]`` -> uint8 ``[rows, cols]`` of 4-bit values
+    in logical column order."""
+    rows, pcols = packed.shape
+    u = packed.astype(np.uint32)
+    out = np.empty((rows, pcols * 8), np.uint8)
+    for p, col in enumerate(AWQ_ORDER):
+        out[:, col::8] = ((u >> (4 * p)) & 0xF).astype(np.uint8)
+    return out
+
+
+def pack_awq(vals: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`unpack_awq` (the synthetic-checkpoint writer and
+    round-trip tests)."""
+    rows, cols = vals.shape
+    assert cols % 8 == 0
+    out = np.zeros((rows, cols // 8), np.uint32)
+    for p, col in enumerate(AWQ_ORDER):
+        out |= (vals[:, col::8].astype(np.uint32) & 0xF) << (4 * p)
+    return out.astype(np.int32)
+
+
+def awq_to_leaves(qweight: np.ndarray, qzeros: np.ndarray,
+                  scales: np.ndarray):
+    """AWQ tensors -> (w int4 [in, out], gscale f32 [G, out],
+    gzero f32 [G, out]) in this repo's storage convention."""
+    import ml_dtypes
+
+    q = unpack_awq(qweight)                       # [in, out] in 0..15
+    z = unpack_awq(qzeros)                        # [G, out] in 0..15
+    s = scales.astype(np.float32)                 # [G, out]
+    w = (q.astype(np.int8) - 8).astype(ml_dtypes.int4)
+    gzero = (z.astype(np.float32) - 8.0) * s
+    return w, s, gzero

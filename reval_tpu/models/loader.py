@@ -142,6 +142,16 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
     qmode = dtype if dtype in ("int8", "int4") else None
     if qmode:
         dtype = "bfloat16"
+    from .awq import awq_config
+
+    awq = awq_config(model_path)
+    if awq:
+        # checkpoint ships pre-quantized int4 (AWQ GEMM): ingest as-is —
+        # requesting int8/int4 on top is a no-op, the weights already are
+        qmode = None
+        if cfg.num_experts:
+            raise NotImplementedError(
+                "AWQ MoE checkpoints are not supported — dense families only")
     cfg.dtype = dtype
     target = _DTYPES[dtype]
     reader = _ShardedReader(model_path)
@@ -166,20 +176,47 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
         else:
             store[name] = arr
 
+    def awq_stacked(store: dict, our_name: str, base: str,
+                    n: int | None = None) -> None:
+        """Read one AWQ-quantized linear (``base``.{qweight,qzeros,scales},
+        already [in, out]-major — no transpose) into int4 + gscale +
+        gzero leaves; ``n`` stacks across layers."""
+        from .awq import awq_to_leaves
+
+        def one(i):
+            return awq_to_leaves(
+                np.asarray(reader.get(base.format(i=i) + ".qweight")),
+                np.asarray(reader.get(base.format(i=i) + ".qzeros")),
+                np.asarray(reader.get(base.format(i=i) + ".scales")))
+
+        parts = [one(i) for i in range(n)] if n is not None else [one(0)]
+        stack = (lambda xs: np.stack(xs)) if n is not None else (lambda xs: xs[0])
+        store[our_name] = jnp.asarray(stack([p[0] for p in parts]))
+        store[our_name + "_gscale"] = jnp.asarray(stack([p[1] for p in parts]))
+        store[our_name + "_gzero"] = jnp.asarray(stack([p[2] for p in parts]))
+
     params: dict = {}
     params["embed"] = jnp.asarray(fetch(*_TOP_LEVEL["embed"]), dtype=target)
     params["final_norm_w"] = jnp.asarray(fetch(*_TOP_LEVEL["final_norm_w"]), dtype=target)
     if _TOP_LEVEL["final_norm_b"][0] in reader:
         params["final_norm_b"] = jnp.asarray(fetch(*_TOP_LEVEL["final_norm_b"]), dtype=target)
     if not cfg.tie_word_embeddings:
+        lm_base = _TOP_LEVEL["lm_head"][0].removesuffix(".weight")
         if _TOP_LEVEL["lm_head"][0] in reader:
             place(params, "lm_head",
                   jnp.asarray(fetch(*_TOP_LEVEL["lm_head"]), dtype=target))
+        elif awq and lm_base + ".qweight" in reader:
+            awq_stacked(params, "lm_head", lm_base)
         else:
             cfg.tie_word_embeddings = True  # checkpoint ties implicitly
 
     layers: dict[str, jnp.ndarray] = {}
     for our_name, (template, transpose) in _weight_map(cfg).items():
+        base = template.removesuffix(".weight")
+        if (awq and template.endswith(".weight")
+                and base.format(i=0) + ".qweight" in reader):
+            awq_stacked(layers, our_name, base, cfg.num_layers)
+            continue
         if template.format(i=0, e=0) not in reader:
             continue  # optional weight absent in this checkpoint
         if "{e}" in template:   # expert-stacked: [L, E, ...]

@@ -84,6 +84,9 @@ def _mm(x, container, name: str):
         xg = x.reshape(*x.shape[:-1], n_groups, g)
         wdq = (w.reshape(n_groups, g, w.shape[-1]).astype(x.dtype)
                * gs.astype(x.dtype)[:, None, :])
+        gz = container.get(name + "_gzero")
+        if gz is not None:           # asymmetric (AWQ): w = q*s - z*s
+            wdq = wdq - gz.astype(x.dtype)[:, None, :]
         out = jnp.einsum("...gi,gio->...o", xg, wdq,
                          preferred_element_type=jnp.float32)
         return out.astype(x.dtype)

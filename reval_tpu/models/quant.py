@@ -118,16 +118,21 @@ def symmetric_int4_grouped_np(w, group_size: int = GROUP_SIZE):
             s.astype(np.float32))
 
 
-def dequantize_grouped(q: jnp.ndarray, gscale: jnp.ndarray, dtype
-                       ) -> jnp.ndarray:
-    """int4 ``[..., in, out]`` + scales ``[..., G, out]`` → ``dtype``
-    weights (a transient — the dense hot path never calls this, see
-    ``_mm``'s fused group einsum; expert paths use it per layer)."""
+def dequantize_grouped(q: jnp.ndarray, gscale: jnp.ndarray, dtype,
+                       gzero: jnp.ndarray | None = None) -> jnp.ndarray:
+    """int4 ``[..., in, out]`` + scales ``[..., G, out]`` (+ optional
+    AWQ-style zero offsets ``gzero`` [..., G, out], already scaled) →
+    ``dtype`` weights (a transient — the dense hot path never calls
+    this, see ``_mm``'s fused group einsum; expert paths use it per
+    layer)."""
     *lead, n_in, n_out = q.shape
     n_groups = gscale.shape[-2]
     g = n_in // n_groups
     wf = q.astype(dtype).reshape(*lead, n_groups, g, n_out)
-    return (wf * gscale[..., None, :].astype(dtype)).reshape(*lead, n_in, n_out)
+    wf = wf * gscale[..., None, :].astype(dtype)
+    if gzero is not None:
+        wf = wf - gzero[..., None, :].astype(dtype)
+    return wf.reshape(*lead, n_in, n_out)
 
 
 def dequantize_params(params: dict, dtype=jnp.float32) -> dict:
@@ -138,10 +143,11 @@ def dequantize_params(params: dict, dtype=jnp.float32) -> dict:
     def deq_store(src: dict) -> dict:
         out: dict = {}
         for name, leaf in src.items():
-            if name.endswith("_gscale"):
+            if name.endswith(("_gscale", "_gzero")):
                 continue
             gs = src.get(name + "_gscale")
-            out[name] = (dequantize_grouped(leaf, gs, dtype)
+            out[name] = (dequantize_grouped(leaf, gs, dtype,
+                                            src.get(name + "_gzero"))
                          if gs is not None else leaf)
         return out
 
@@ -190,6 +196,14 @@ def quantize_into(store: dict, name: str, arr: jnp.ndarray,
     consume: int8 rides a per-out-channel ``<name>_scale`` sibling, int4
     a per-(group, out-channel) ``<name>_gscale``."""
     if name in MATMUL_WEIGHTS:
+        if jnp.dtype(arr.dtype) in (jnp.dtype(jnp.int8), jnp.dtype(jnp.int4)):
+            # re-quantizing quantized CODES would treat -8..127 integers
+            # as float weights and orphan any _gzero sibling _mm still
+            # subtracts — silently wrong logits (e.g. an AWQ-loaded tree
+            # passed back through quantize_params)
+            raise ValueError(
+                f"{name} is already quantized ({arr.dtype}) — "
+                "quantize_params takes float-weight trees only")
         q, s = quantize_stacked(arr, mode, tp)
         store[name] = q
         store[name + ("_scale" if mode == "int8" else "_gscale")] = s

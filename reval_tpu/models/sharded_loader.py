@@ -89,6 +89,21 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
             "int8 needs whole-tensor amax before sharding; use "
             "load_checkpoint(dtype='int8') and shard_params instead")
     from ..parallel.sharding import param_specs
+    from .awq import awq_config
+
+    if awq_config(model_path):
+        # AWQ tensors (qweight/qzeros/scales packing) have no slice-read
+        # path yet: fall back to full-tree ingest + shard.  Host-RAM cost
+        # is the int4 tree (~17 GB for 34B — fine on this host class),
+        # NOT the bf16 tree the slice path exists to avoid.
+        from .loader import load_checkpoint
+
+        params, cfg = load_checkpoint(model_path, dtype=dtype, cfg=cfg)
+        specs = (specs_fn or param_specs)(params, cfg, mesh)
+        params = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            params, specs, is_leaf=lambda x: not isinstance(x, dict))
+        return params, cfg
 
     int4 = dtype == "int4"
     if int4:

@@ -143,15 +143,18 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     div = _divisible(cfg, mesh)
 
     def top_spec(name):
-        spec = _TOP_RULES.get(name, P())
-        base = name.removesuffix("_gscale").removesuffix("_scale")
+        # gzero leaves (AWQ asymmetric int4) shard exactly like gscales
+        spec = _TOP_RULES.get(name.replace("_gzero", "_gscale"), P())
+        base = (name.removesuffix("_gzero").removesuffix("_gscale")
+                .removesuffix("_scale"))
         if base in ("embed", "lm_head") and not div["vocab"]:
             return P()
         return spec
 
     def layer_spec(name):
-        spec = _LAYER_RULES.get(name, P())
-        base = name.removesuffix("_gscale").removesuffix("_scale")  # scales follow their weight
+        spec = _LAYER_RULES.get(name.replace("_gzero", "_gscale"), P())
+        base = (name.removesuffix("_gzero").removesuffix("_gscale")
+                .removesuffix("_scale"))  # scales follow their weight
         if base in ("k_w", "v_w", "k_b", "v_b") and not div["kv_heads"]:
             return P()
         if base in ("q_w", "o_w", "q_b") and not div["heads"]:
