@@ -17,7 +17,8 @@ class TPUBackend(InferenceBackend):
                  num_chips: int = 1, dp_size: int = 1, pp_size: int = 1,
                  sp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
-                 engine: str | None = None, kv_dtype: str = "", **kwargs):
+                 engine: str | None = None, kv_dtype: str = "",
+                 spec_k: int = 0, **kwargs):
         """``engine``: "paged" (continuous batching over the paged KV
         cache + native scheduler) or "static" (rectangular batches; the
         dp/sp/pp sharding paths live here).  Default (None) auto-selects:
@@ -85,6 +86,7 @@ class TPUBackend(InferenceBackend):
                 model_path, dtype=dtype, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
+                spec_k=spec_k,
             )
         elif engine == "paged":
             # dp>1 with continuous batching: one paged replica per device
@@ -97,6 +99,7 @@ class TPUBackend(InferenceBackend):
                 model_path, dtype=dtype, dp_size=dp_size, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
+                spec_k=spec_k,
             )
         else:
             # the static engine shards one rectangular batch over a

@@ -51,7 +51,7 @@ class DataParallelPagedEngine:
                  tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  seed: int = 0, prefix_sharing: bool = True, devices=None,
-                 kv_dtype: str = ""):
+                 kv_dtype: str = "", spec_k: int = 0):
         devices = list(devices if devices is not None else jax.devices())
         need = dp_size * tp_size
         if len(devices) < need:
@@ -69,7 +69,8 @@ class DataParallelPagedEngine:
                 params, cfg, tokenizer, max_slots=max_slots,
                 page_size=page_size, max_seq_len=max_seq_len,
                 num_pages=num_pages, mesh=mesh, seed=seed + r,
-                prefix_sharing=prefix_sharing, kv_dtype=kv_dtype))
+                prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
+                spec_k=spec_k))
         self._pool = ThreadPoolExecutor(max_workers=dp_size,
                                         thread_name_prefix="dp-paged")
 
@@ -79,6 +80,7 @@ class DataParallelPagedEngine:
                         max_slots: int = 8, page_size: int = 128,
                         max_seq_len: int = 8192, num_pages: int | None = None,
                         tokenizer=None, seed: int = 0, kv_dtype: str = "",
+                        spec_k: int = 0,
                         local_devices_only: bool = False
                         ) -> "DataParallelPagedEngine":
         params, cfg = load_checkpoint(model_path, dtype=dtype)
@@ -88,7 +90,7 @@ class DataParallelPagedEngine:
         return cls(params, cfg, tokenizer, dp_size=dp_size, tp_size=tp_size,
                    max_slots=max_slots, page_size=page_size,
                    max_seq_len=max_seq_len, num_pages=num_pages, seed=seed,
-                   devices=devices, kv_dtype=kv_dtype)
+                   devices=devices, kv_dtype=kv_dtype, spec_k=spec_k)
 
     @property
     def stats(self) -> EngineStats:
@@ -103,6 +105,8 @@ class DataParallelPagedEngine:
             agg.decode_seconds += s.decode_seconds
             agg.prefill_seconds += s.prefill_seconds
             agg.decode_chunks += s.decode_chunks
+            agg.spec_rounds += s.spec_rounds
+            agg.spec_accepted += s.spec_accepted
         return agg
 
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,

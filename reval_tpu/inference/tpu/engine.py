@@ -164,6 +164,8 @@ class EngineStats:
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
     decode_chunks: int = 0
+    spec_rounds: int = 0         # draft+verify rounds executed (per slot)
+    spec_accepted: int = 0       # draft tokens accepted (bonus excluded)
 
 
 class TPUEngine:

@@ -94,8 +94,13 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
     if awq_config(model_path):
         # AWQ tensors (qweight/qzeros/scales packing) have no slice-read
         # path yet: fall back to full-tree ingest + shard.  Host-RAM cost
-        # is the int4 tree (~17 GB for 34B — fine on this host class),
-        # NOT the bf16 tree the slice path exists to avoid.
+        # is the UNPACKED int4 tree (ml_dtypes.int4 stores one byte per
+        # element: ~34 GB for 34B plus a largest-leaf transient — fits a
+        # 100+ GB host, NOT a laptop), still well under the bf16 tree
+        # the slice path exists to avoid.  A checkpoint whose fixed
+        # group size misaligns with a tp shard gets its gscale/gzero
+        # group dim replicated by param_specs' fit() (with a warning)
+        # and pays a GSPMD reshard in _mm — correct, slower.
         from .loader import load_checkpoint
 
         params, cfg = load_checkpoint(model_path, dtype=dtype, cfg=cfg)

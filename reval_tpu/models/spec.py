@@ -1,0 +1,174 @@
+"""Greedy speculative decoding over the paged cache: n-gram draft + MXU verify.
+
+Decode is weight-bandwidth-bound (PERF.md: every step re-reads the matmul
+weights), so verifying K candidate tokens in ONE model pass makes
+accepted tokens nearly free: the weights are read once per verify round
+instead of once per token.  DREval generations are exceptionally
+draft-friendly — answers echo prompt fragments ("[ANSWER] ... [/ANSWER]",
+repeated variable/state lists, CoT traces quoting the program line by
+line) — so a prompt-lookup (n-gram) draft needs no draft model at all:
+candidates come from the sequence's OWN history (the technique vLLM
+ships as prompt-lookup / ngram speculative decoding; the reference never
+enables it).
+
+Greedy only, and exactly output-preserving: a candidate is accepted iff
+it equals the model's own argmax at that position, and the first
+mismatch position contributes the model's argmax as a bonus token — the
+emitted sequence is bit-identical to token-by-token greedy decode.
+
+Everything runs ON DEVICE inside the engine's jitted chunk (drafting is
+a vectorised bigram search over a device-resident history buffer), so
+the host round-trip cost per chunk is unchanged — critical on this
+host's tunneled TPU where each dispatch costs ~100 ms (PERF.md).
+
+Cache-write discipline: a verify round writes all K+1 positions' KV into
+the pages at ``lens .. lens+K``; only ``m+1`` (matches + bonus) advance
+``lens``.  Stale entries beyond the new length are never read (every
+attention masks by per-query length) and are overwritten when a later
+round reaches those positions — no rollback pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .configs import ModelConfig
+from .model import _block, _embed, _norm, _unembed
+from .paged import PagedKVCache, _layer_scales, _quantize_kv
+from ..ops import rope_angles
+from ..ops.pallas_attention import paged_decode_attention
+
+__all__ = ["paged_verify_step", "draft_ngram", "spec_round"]
+
+
+def paged_verify_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                      block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+                      cache: PagedKVCache) -> tuple[jnp.ndarray, PagedKVCache]:
+    """K-token step: ``tokens`` [B, K] occupy positions
+    ``seq_lens + [0..K)``; returns logits [B, K, V] and the cache with
+    all K positions' KV written.
+
+    The per-position causal structure folds into the existing per-row
+    paged kernel by flattening K into the batch dim: row ``b*K + j``
+    attends with length ``seq_lens[b] + j + 1`` over ``b``'s block table
+    — token j sees the cache plus candidates 0..j (their KV is written
+    before attention, exactly like the single-token step).
+    """
+    b, k = tokens.shape
+    page = cache.page_size
+    h = _embed(params, cfg, tokens)                        # [B, K, D]
+    positions = seq_lens[:, None] + jnp.arange(k)[None, :]   # [B, K]
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    pages = jnp.take_along_axis(block_tables, positions // page, axis=1)
+    flat_pos = (pages * page + positions % page).reshape(-1)  # [B*K]
+    attn_lens = (positions + 1).reshape(-1)                   # [B*K]
+    tables_rep = jnp.repeat(block_tables, k, axis=0)          # [B*K, P]
+
+    layers = params["layers"]
+    new_k, new_v = [], []
+    new_ks, new_vs = [], []
+    for i in range(cfg.num_layers):
+        layer = jax.tree.map(lambda x: x[i], layers)
+
+        def attend(q, kk, vv, i=i):
+            ks_i, vs_i = _layer_scales(cache, i)
+            kf = kk.reshape(b * k, *kk.shape[2:])
+            vf = vv.reshape(b * k, *vv.shape[2:])
+            if cache.quantized:
+                kq, ks_new = _quantize_kv(kf)
+                vq, vs_new = _quantize_kv(vf)
+                ki = cache.k[i].at[flat_pos].set(kq)
+                vi = cache.v[i].at[flat_pos].set(vq)
+                ks_i = ks_i.at[flat_pos].set(ks_new)
+                vs_i = vs_i.at[flat_pos].set(vs_new)
+                new_ks.append(ks_i)
+                new_vs.append(vs_i)
+            else:
+                ki = cache.k[i].at[flat_pos].set(kf.astype(cache.dtype))
+                vi = cache.v[i].at[flat_pos].set(vf.astype(cache.dtype))
+            new_k.append(ki)
+            new_v.append(vi)
+            qf = q.reshape(b * k, *q.shape[2:])
+            attn = paged_decode_attention(
+                qf, ki, vi, tables_rep, attn_lens, page_size=page,
+                scale=cfg.attn_scale, window=cfg.window_for_layer(i),
+                softcap=cfg.attn_softcap, k_scales=ks_i, v_scales=vs_i)
+            return attn.reshape(b, k, *attn.shape[1:])
+
+        h = _block(h, layer, cfg, cos, sin, attend)
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    out_cache = PagedKVCache(
+        k=tuple(new_k), v=tuple(new_v), page_size=page,
+        k_scale=tuple(new_ks) if cache.quantized else None,
+        v_scale=tuple(new_vs) if cache.quantized else None)
+    return _unembed(params, cfg, h), out_cache
+
+
+def draft_ngram(hist: jnp.ndarray, n_tok: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Prompt-lookup draft: for each row, find the LAST earlier occurrence
+    of the trailing bigram in ``hist[: n_tok]`` and propose the ``k``
+    tokens that followed it.  No-match rows get an arbitrary (recent)
+    window — a useless draft only costs acceptance, never correctness.
+
+    hist: [B, S] token history (prompt + generated so far);
+    n_tok: [B] valid lengths.  Returns candidates [B, k].
+    """
+    b, s = hist.shape
+    idx = jnp.arange(s - 1)
+    a = jnp.take_along_axis(hist, (n_tok - 2)[:, None], axis=1)   # [B,1]
+    bb = jnp.take_along_axis(hist, (n_tok - 1)[:, None], axis=1)
+    match = ((hist[:, :-1] == a) & (hist[:, 1:] == bb)
+             & (idx[None, :] < (n_tok - 2)[:, None]))             # [B, S-1]
+    p = jnp.max(jnp.where(match, idx[None, :], -1), axis=1)       # [B]
+    start = jnp.where(p >= 0, p + 2, jnp.maximum(n_tok - k, 0))
+    gather = jnp.clip(start[:, None] + jnp.arange(k)[None, :], 0, s - 1)
+    return jnp.take_along_axis(hist, gather, axis=1)              # [B, k]
+
+
+def spec_round(params, cfg: ModelConfig, last_token: jnp.ndarray,
+               hist: jnp.ndarray, n_tok: jnp.ndarray,
+               block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+               cache: PagedKVCache, k: int):
+    """One draft+verify round (greedy).
+
+    last_token [B, 1] is the pending input token (position ``seq_lens``).
+    Returns (out_tokens [B, k+1], n_out [B] in 1..k+1, new last_token,
+    hist, n_tok, seq_lens, cache) — out_tokens beyond ``n_out`` are
+    padding and must be masked by the caller.
+    """
+    cand = draft_ngram(hist, n_tok, k)                       # [B, k]
+    feed = jnp.concatenate([last_token, cand], axis=1)       # [B, k+1]
+    logits, cache = paged_verify_step(params, cfg, feed, block_tables,
+                                      seq_lens, cache)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k+1]
+    # greedy[:, j] = model's token AFTER feed[:, j]; candidate j (=feed
+    # j+1) is accepted iff it equals greedy[:, j] and all before matched
+    ok = cand == greedy[:, :-1]                              # [B, k]
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1)          # [B, k]
+    n_acc = acc.sum(axis=1)                                  # [B] 0..k
+    # emitted: accepted candidates then the bonus (model argmax at the
+    # first mismatch — or after all k accepts)
+    bonus = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)  # [B,1]
+    out = jnp.where(jnp.arange(k)[None, :] < n_acc[:, None], cand, 0)
+    out = jnp.concatenate([out, jnp.zeros_like(bonus)], axis=1)
+    out = out.at[jnp.arange(out.shape[0]), n_acc].set(bonus[:, 0])
+    n_out = n_acc + 1                                        # [B] 1..k+1
+    # append to history + advance
+    pos = n_tok[:, None] + jnp.arange(k + 1)[None, :]
+    upd = jnp.where(jnp.arange(k + 1)[None, :] < n_out[:, None], out,
+                    jnp.take_along_axis(
+                        hist, jnp.clip(pos, 0, hist.shape[1] - 1), axis=1))
+    hist = _scatter_rows(hist, jnp.clip(pos, 0, hist.shape[1] - 1), upd)
+    n_tok = n_tok + n_out
+    seq_lens = seq_lens + n_out
+    last = jnp.take_along_axis(out, (n_out - 1)[:, None], axis=1)
+    return out, n_out, last, hist, n_tok, seq_lens, cache
+
+
+def _scatter_rows(buf: jnp.ndarray, cols: jnp.ndarray,
+                  vals: jnp.ndarray) -> jnp.ndarray:
+    """buf[b, cols[b, j]] = vals[b, j] (batched column scatter)."""
+    b = buf.shape[0]
+    rows = jnp.repeat(jnp.arange(b)[:, None], cols.shape[1], axis=1)
+    return buf.at[rows, cols].set(vals)

@@ -49,5 +49,7 @@ run bench_cot.json         3600 json python bench.py --mode cot
 run bench_cot_kv8.json     3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
 run fleet.json             2400 json python tools/fleet_bench.py
 run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
+run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
+run bench_cot_spec.json    3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
 run ablate_int8.txt        1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8
 log "runbook pass complete"
