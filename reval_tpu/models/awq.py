@@ -34,7 +34,9 @@ from pathlib import Path
 import numpy as np
 
 __all__ = ["AWQ_ORDER", "awq_config", "pack_awq", "unpack_awq",
-           "awq_to_leaves"]
+           "awq_to_leaves", "gptq_config", "gptq_to_leaves",
+           "pack_gptq_rows", "unpack_gptq_rows",
+           "pack_gptq_cols", "unpack_gptq_cols"]
 
 #: nibble position -> logical column offset within each 8-column block
 AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
@@ -60,24 +62,26 @@ def awq_config(model_path) -> dict | None:
     return qc
 
 
-def unpack_awq(packed: np.ndarray) -> np.ndarray:
+def unpack_awq(packed: np.ndarray, order=AWQ_ORDER) -> np.ndarray:
     """int32 ``[rows, cols/8]`` -> uint8 ``[rows, cols]`` of 4-bit values
-    in logical column order."""
+    in logical column order.  ``order`` maps nibble position -> logical
+    column offset (AWQ's interleave by default; ``range(8)`` gives the
+    sequential GPTQ qzeros layout)."""
     rows, pcols = packed.shape
     u = packed.astype(np.uint32)
     out = np.empty((rows, pcols * 8), np.uint8)
-    for p, col in enumerate(AWQ_ORDER):
+    for p, col in enumerate(order):
         out[:, col::8] = ((u >> (4 * p)) & 0xF).astype(np.uint8)
     return out
 
 
-def pack_awq(vals: np.ndarray) -> np.ndarray:
+def pack_awq(vals: np.ndarray, order=AWQ_ORDER) -> np.ndarray:
     """Inverse of :func:`unpack_awq` (the synthetic-checkpoint writer and
     round-trip tests)."""
     rows, cols = vals.shape
     assert cols % 8 == 0
     out = np.zeros((rows, cols // 8), np.uint32)
-    for p, col in enumerate(AWQ_ORDER):
+    for p, col in enumerate(order):
         out |= (vals[:, col::8].astype(np.uint32) & 0xF) << (4 * p)
     return out.astype(np.int32)
 
@@ -93,4 +97,89 @@ def awq_to_leaves(qweight: np.ndarray, qzeros: np.ndarray,
     s = scales.astype(np.float32)                 # [G, out]
     w = (q.astype(np.int8) - 8).astype(ml_dtypes.int4)
     gzero = (z.astype(np.float32) - 8.0) * s
+    return w, s, gzero
+
+
+# -- GPTQ (AutoGPTQ v1 GEMM layout) ----------------------------------------
+#
+# The other format published 4-bit checkpoints ship in (the reference
+# reaches it through vLLM's quantization="gptq").  Differences from AWQ:
+# - qweight int32 [in/8, out]: eight 4-bit ROWS per int32, packed
+#   sequentially along the IN dim (no order map);
+# - qzeros int32 [G, out/8]: packed sequentially along OUT, and stored
+#   OFF BY ONE (AutoGPTQ writes z-1): dequant is (q - (z_stored + 1)) * s;
+# - scales fp16 [G, out];
+# - desc_act=True adds a g_idx permutation of the contraction dim —
+#   NOT supported here (rejected loudly): it breaks the contiguous-group
+#   invariant the int4 storage and its sharding rules rely on.
+
+
+def gptq_config(model_path) -> dict | None:
+    """The checkpoint's ``quantization_config`` when it is GPTQ 4-bit
+    with contiguous groups; None when not GPTQ."""
+    cfg_path = Path(model_path) / "config.json"
+    if not cfg_path.exists():
+        return None
+    qc = json.loads(cfg_path.read_text()).get("quantization_config")
+    if not qc or qc.get("quant_method") != "gptq":
+        return None
+    if qc.get("bits", 4) != 4:
+        raise ValueError(f"GPTQ bits={qc.get('bits')} unsupported (int4 only)")
+    if qc.get("desc_act", False):
+        raise ValueError(
+            "GPTQ desc_act=True (activation-order g_idx) unsupported — "
+            "groups must be contiguous along the contraction dim")
+    if qc.get("checkpoint_format", "gptq") != "gptq":
+        # gptq_v2 stores TRUE zeros (no -1): loading it with the v1 +1
+        # fold would shift every weight one scale step — silent garbage
+        raise ValueError(
+            f"GPTQ checkpoint_format={qc.get('checkpoint_format')!r} "
+            "unsupported (v1 'gptq' zeros-minus-one layout only)")
+    return qc
+
+
+def unpack_gptq_rows(packed: np.ndarray) -> np.ndarray:
+    """int32 ``[rows/8, cols]`` -> uint8 ``[rows, cols]``: eight
+    sequential 4-bit rows per int32 (GPTQ qweight packing)."""
+    prows, cols = packed.shape
+    u = packed.astype(np.uint32)
+    out = np.empty((prows * 8, cols), np.uint8)
+    for p in range(8):
+        out[p::8] = ((u >> (4 * p)) & 0xF).astype(np.uint8)
+    return out
+
+
+def unpack_gptq_cols(packed: np.ndarray) -> np.ndarray:
+    """int32 ``[rows, cols/8]`` -> uint8 ``[rows, cols]``: eight
+    sequential 4-bit columns per int32 (GPTQ qzeros packing — the AWQ
+    unpack with an identity order map)."""
+    return unpack_awq(packed, order=range(8))
+
+
+def pack_gptq_rows(vals: np.ndarray) -> np.ndarray:
+    rows, cols = vals.shape
+    assert rows % 8 == 0
+    out = np.zeros((rows // 8, cols), np.uint32)
+    for p in range(8):
+        out |= (vals[p::8].astype(np.uint32) & 0xF) << (4 * p)
+    return out.astype(np.int32)
+
+
+def pack_gptq_cols(vals: np.ndarray) -> np.ndarray:
+    return pack_awq(vals, order=range(8))
+
+
+def gptq_to_leaves(qweight: np.ndarray, qzeros: np.ndarray,
+                   scales: np.ndarray):
+    """GPTQ tensors -> (w int4 [in, out], gscale f32 [G, out],
+    gzero f32 [G, out]), same storage convention as AWQ: the stored
+    zeros' +1 offset folds into gzero so ``w*s - gzero`` reproduces
+    ``(q - (z_stored+1)) * s`` exactly."""
+    import ml_dtypes
+
+    q = unpack_gptq_rows(qweight)                 # [in, out] in 0..15
+    z = unpack_gptq_cols(qzeros).astype(np.float32) + 1.0   # true zeros
+    s = scales.astype(np.float32)                 # [G, out]
+    w = (q.astype(np.int8) - 8).astype(ml_dtypes.int4)
+    gzero = (z - 8.0) * s
     return w, s, gzero

@@ -142,16 +142,19 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
     qmode = dtype if dtype in ("int8", "int4") else None
     if qmode:
         dtype = "bfloat16"
-    from .awq import awq_config
+    from .awq import awq_config, awq_to_leaves, gptq_config, gptq_to_leaves
 
     awq = awq_config(model_path)
-    if awq:
-        # checkpoint ships pre-quantized int4 (AWQ GEMM): ingest as-is —
+    gptq = None if awq else gptq_config(model_path)
+    prequant = awq_to_leaves if awq else (gptq_to_leaves if gptq else None)
+    if prequant:
+        # checkpoint ships pre-quantized int4 (AWQ/GPTQ): ingest as-is —
         # requesting int8/int4 on top is a no-op, the weights already are
         qmode = None
         if cfg.num_experts:
             raise NotImplementedError(
-                "AWQ MoE checkpoints are not supported — dense families only")
+                "pre-quantized MoE checkpoints are not supported — "
+                "dense families only")
     cfg.dtype = dtype
     target = _DTYPES[dtype]
     reader = _ShardedReader(model_path)
@@ -178,13 +181,12 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
 
     def awq_stacked(store: dict, our_name: str, base: str,
                     n: int | None = None) -> None:
-        """Read one AWQ-quantized linear (``base``.{qweight,qzeros,scales},
-        already [in, out]-major — no transpose) into int4 + gscale +
-        gzero leaves; ``n`` stacks across layers."""
-        from .awq import awq_to_leaves
+        """Read one pre-quantized linear (``base``.{qweight,qzeros,scales},
+        AWQ and GPTQ both store [in, out]-major — no transpose) into
+        int4 + gscale + gzero leaves; ``n`` stacks across layers."""
 
         def one(i):
-            return awq_to_leaves(
+            return prequant(
                 np.asarray(reader.get(base.format(i=i) + ".qweight")),
                 np.asarray(reader.get(base.format(i=i) + ".qzeros")),
                 np.asarray(reader.get(base.format(i=i) + ".scales")))
@@ -205,7 +207,7 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
         if _TOP_LEVEL["lm_head"][0] in reader:
             place(params, "lm_head",
                   jnp.asarray(fetch(*_TOP_LEVEL["lm_head"]), dtype=target))
-        elif awq and lm_base + ".qweight" in reader:
+        elif prequant and lm_base + ".qweight" in reader:
             awq_stacked(params, "lm_head", lm_base)
         else:
             cfg.tie_word_embeddings = True  # checkpoint ties implicitly
@@ -213,7 +215,7 @@ def load_checkpoint(model_path: str | Path, dtype: str = "bfloat16",
     layers: dict[str, jnp.ndarray] = {}
     for our_name, (template, transpose) in _weight_map(cfg).items():
         base = template.removesuffix(".weight")
-        if (awq and template.endswith(".weight")
+        if (prequant and template.endswith(".weight")
                 and base.format(i=0) + ".qweight" in reader):
             awq_stacked(layers, our_name, base, cfg.num_layers)
             continue

@@ -89,10 +89,10 @@ def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
             "int8 needs whole-tensor amax before sharding; use "
             "load_checkpoint(dtype='int8') and shard_params instead")
     from ..parallel.sharding import param_specs
-    from .awq import awq_config
+    from .awq import awq_config, gptq_config
 
-    if awq_config(model_path):
-        # AWQ tensors (qweight/qzeros/scales packing) have no slice-read
+    if awq_config(model_path) or gptq_config(model_path):
+        # AWQ/GPTQ tensors (qweight/qzeros/scales packing) have no slice-read
         # path yet: fall back to full-tree ingest + shard.  Host-RAM cost
         # is the UNPACKED int4 tree (ml_dtypes.int4 stores one byte per
         # element: ~34 GB for 34B plus a largest-leaf transient — fits a

@@ -198,3 +198,133 @@ def test_requantizing_quantized_tree_refused(awq_checkpoint):
     params, _ = load_checkpoint(path, dtype="float32")
     with pytest.raises(ValueError, match="already quantized"):
         quantize_params(params, mode="int4")
+
+
+# -- GPTQ ------------------------------------------------------------------
+
+def _quantize_gptq(w_out_in: np.ndarray, group: int):
+    """Reference GPTQ writer: row-packed qweight, col-packed qzeros
+    stored z-1 (AutoGPTQ v1 semantics)."""
+    from reval_tpu.models.awq import pack_gptq_cols, pack_gptq_rows
+
+    w = w_out_in.T.astype(np.float32)              # [in, out]
+    n_in, n_out = w.shape
+    wg = w.reshape(n_in // group, group, n_out)
+    lo, hi = wg.min(axis=1), wg.max(axis=1)
+    s = np.maximum((hi - lo) / 15.0, 1e-8)
+    z = np.clip(np.round(-lo / s), 1, 15)          # >=1 so stored z-1 >= 0
+    q = np.clip(np.round(wg / s[:, None, :]) + z[:, None, :], 0, 15)
+    return (pack_gptq_rows(q.reshape(n_in, n_out).astype(np.uint8)),
+            pack_gptq_cols((z - 1).astype(np.uint8)), s.astype(np.float16))
+
+
+def test_gptq_pack_unpack_roundtrip():
+    from reval_tpu.models.awq import (pack_gptq_cols, pack_gptq_rows,
+                                      unpack_gptq_cols, unpack_gptq_rows)
+
+    rng = np.random.RandomState(4)
+    vals = rng.randint(0, 16, size=(64, 24)).astype(np.uint8)
+    np.testing.assert_array_equal(unpack_gptq_rows(pack_gptq_rows(vals)), vals)
+    np.testing.assert_array_equal(unpack_gptq_cols(pack_gptq_cols(vals)), vals)
+
+
+def test_gptq_to_leaves_reproduces_dequant_formula():
+    from reval_tpu.models.awq import gptq_to_leaves
+    from reval_tpu.models.quant import dequantize_grouped
+
+    rng = np.random.RandomState(5)
+    n_in, n_out = 128, 32
+    w_hf = rng.randn(n_out, n_in).astype(np.float32) * 0.05   # HF [out, in]
+    qw, qz, sc = _quantize_gptq(w_hf, GROUP)
+    w, gs, gz = gptq_to_leaves(qw, qz, sc)
+    got = np.asarray(dequantize_grouped(
+        jnp.asarray(w), jnp.asarray(gs), jnp.float32, jnp.asarray(gz)))
+    # oracle: (q - (z_stored + 1)) * s with true unpacked values
+    from reval_tpu.models.awq import unpack_gptq_cols, unpack_gptq_rows
+
+    q = unpack_gptq_rows(qw).astype(np.float32)
+    z = unpack_gptq_cols(qz).astype(np.float32) + 1.0
+    want = (q - np.repeat(z, GROUP, 0)) * np.repeat(
+        sc.astype(np.float32), GROUP, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def gptq_checkpoint(tmp_path_factory):
+    import torch
+    from safetensors.numpy import save_file
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    path = tmp_path_factory.mktemp("ckpt") / "tiny-llama-gptq"
+    path.mkdir()
+    torch.manual_seed(6)
+    hf_cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=4, tie_word_embeddings=False)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    tensors: dict = {}
+    for name, arr in ((k, v.float().numpy())
+                      for k, v in model.state_dict().items()):
+        if (name.endswith(".weight") and arr.ndim == 2
+                and "embed_tokens" not in name and "norm" not in name):
+            base = name.removesuffix(".weight")
+            qw, qz, sc = _quantize_gptq(arr, GROUP)
+            tensors[base + ".qweight"] = qw
+            tensors[base + ".qzeros"] = qz
+            tensors[base + ".scales"] = sc
+        else:
+            tensors[name] = arr.astype(np.float32)
+    save_file(tensors, str(path / "model.safetensors"))
+    cfg = json.loads(hf_cfg.to_json_string())
+    cfg["quantization_config"] = {"quant_method": "gptq", "bits": 4,
+                                  "group_size": GROUP, "desc_act": False}
+    (path / "config.json").write_text(json.dumps(cfg))
+    return path
+
+
+def test_gptq_checkpoint_loads_and_matches_oracle(gptq_checkpoint):
+    from reval_tpu.inference.tpu.engine import TPUEngine
+    from reval_tpu.models import load_checkpoint
+    from reval_tpu.models.quant import dequantize_params, is_quantized
+
+    params, cfg = load_checkpoint(gptq_checkpoint, dtype="float32")
+    assert is_quantized(params)
+    assert params["layers"]["q_w"].dtype == jnp.int4
+    assert "q_w_gzero" in params["layers"]
+
+    class _Tok:
+        eos_id, pad_id = 127, 0
+
+        def encode(self, text):
+            return [ord(c) % 120 + 1 for c in text]
+
+        def decode(self, ids):
+            return "".join(chr(32 + (int(i) % 90)) for i in ids)
+
+    prompts = ["def f(x):", "x = 1"]
+    eng = TPUEngine(params, cfg, _Tok(), batch_size=2, max_seq_len=256)
+    oracle = TPUEngine(dequantize_params(params), cfg, _Tok(), batch_size=2,
+                       max_seq_len=256)
+    assert (eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+            == oracle.generate(prompts, max_new_tokens=8, temperature=0.0))
+
+
+def test_gptq_desc_act_rejected(tmp_path):
+    from reval_tpu.models.awq import gptq_config
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"quantization_config": {"quant_method": "gptq", "bits": 4,
+                                 "desc_act": True}}))
+    with pytest.raises(ValueError, match="desc_act"):
+        gptq_config(tmp_path)
+
+
+def test_gptq_v2_format_rejected(tmp_path):
+    from reval_tpu.models.awq import gptq_config
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"quantization_config": {"quant_method": "gptq", "bits": 4,
+                                 "desc_act": False,
+                                 "checkpoint_format": "gptq_v2"}}))
+    with pytest.raises(ValueError, match="checkpoint_format"):
+        gptq_config(tmp_path)
