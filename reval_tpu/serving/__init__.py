@@ -10,7 +10,7 @@ the resident (sharded) TPU engine and speaks the same protocol to
 """
 
 from .server import EngineServer, serve_config, warmup_engine
-from .session import ContinuousSession
+from .session import ContinuousSession, MultiSession
 
 __all__ = ["EngineServer", "serve_config", "warmup_engine",
-           "ContinuousSession"]
+           "ContinuousSession", "MultiSession"]

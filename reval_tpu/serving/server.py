@@ -288,10 +288,11 @@ def serve_config(cfg: dict, *, port: int | None = None,
     (default: config ``port`` or 3000).  ``warmup`` pre-compiles the hot
     generation programs before binding.
 
-    A single paged engine is served through a :class:`ContinuousSession`:
-    concurrent POSTs join one live decode batch (vLLM api_server
-    semantics).  Other engines (static/pp/sp, dp replica sets) keep the
-    serialised per-request path."""
+    A single paged engine is served through a :class:`ContinuousSession`
+    and a dp replica set through a :class:`MultiSession` (one session per
+    replica, least-loaded routing): concurrent POSTs join live decode
+    batches (vLLM api_server semantics).  Other engines (static/pp/sp)
+    keep the serialised per-request path."""
     from ..inference.tpu.backend import TPUBackend
     from ..inference.tpu.paged_engine import PagedTPUEngine
 
@@ -300,15 +301,24 @@ def serve_config(cfg: dict, *, port: int | None = None,
     if warmup:
         secs = warmup_engine(backend.engine)
         print(f"warmup: generation programs compiled in {secs:.1f}s")
+    from ..inference.tpu.dp_paged import DataParallelPagedEngine
+
     model_id = cfg.get("model_id", "reval-tpu-model")
     bind = port if port is not None else cfg.get("port", 3000)
+    session = None
     if isinstance(backend.engine, PagedTPUEngine):
         from .session import ContinuousSession
 
         session = ContinuousSession(backend.engine)
+    elif isinstance(backend.engine, DataParallelPagedEngine):
+        # dp replica set: one session per replica + least-loaded routing
+        from .session import MultiSession
+
+        session = MultiSession(backend.engine.replicas)
+    if session is not None:
         server = EngineServer(session.generate_fn(), model_id=model_id,
                               port=bind, serialize=False)
-        server._session = session       # keep the driver thread reachable
+        server._session = session       # keep the driver threads reachable
         return server
     return EngineServer(_engine_generate_fn(backend.engine),
                         model_id=model_id, port=bind)

@@ -36,7 +36,7 @@ import queue
 import threading
 from dataclasses import dataclass, field
 
-__all__ = ["ContinuousSession"]
+__all__ = ["ContinuousSession", "MultiSession"]
 
 
 class _Pending:
@@ -47,6 +47,30 @@ class _Pending:
         self._remaining = n
         self._event = threading.Event()
         self._error: str | None = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
+        self._fired = False
+
+    def _fire(self) -> None:
+        """Resolve the handle (success or error) exactly once.  Done-
+        callbacks run BEFORE the event wakes waiters, so anything a
+        waiter observes after ``result()`` (e.g. MultiSession's load
+        counters) already reflects the release."""
+        with self._cb_lock:
+            if self._fired:
+                return
+            self._fired = True
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb()
+        self._event.set()
+
+    def _add_done_callback(self, cb) -> None:
+        with self._cb_lock:
+            if not self._fired:
+                self._callbacks.append(cb)
+                return
+        cb()
 
     def result(self, timeout: float | None = None) -> list[str]:
         """Block until every prompt in the submission finished."""
@@ -58,6 +82,17 @@ class _Pending:
 
     def done(self) -> bool:
         return self._event.is_set()
+
+
+def _generate_fn_for(submitter):
+    """EngineServer ``generate_fn`` over any ``submit(...) -> _Pending``
+    owner (single session or replica set) — pass ``serialize=False``."""
+    def generate(prompts, *, max_tokens, temperature, stop,
+                 on_progress=None):
+        return submitter.submit(prompts, max_new_tokens=max_tokens,
+                                temperature=temperature, stop=stop,
+                                on_progress=on_progress).result()
+    return generate
 
 
 @dataclass
@@ -108,7 +143,7 @@ class ContinuousSession:
         sub = _Submission(list(prompts), max_new_tokens, float(temperature),
                           list(stop or []), on_progress)
         if not sub.prompts:
-            sub.pending._event.set()
+            sub.pending._fire()
             return sub.pending
         with self._submit_lock:
             if self._closed.is_set():
@@ -120,12 +155,7 @@ class ContinuousSession:
         """A ``generate_fn`` for :class:`EngineServer` — blocking per
         call, but concurrent calls share the live batch, so the server
         must NOT serialise them (pass ``serialize=False``)."""
-        def generate(prompts, *, max_tokens, temperature, stop,
-                     on_progress=None):
-            return self.submit(prompts, max_new_tokens=max_tokens,
-                               temperature=temperature, stop=stop,
-                               on_progress=on_progress).result()
-        return generate
+        return _generate_fn_for(self)
 
     # -- driver side -------------------------------------------------------
     def start(self) -> "ContinuousSession":
@@ -173,7 +203,7 @@ class ContinuousSession:
                     # sequences so they don't decode into a dead handle
                     self._fail(sub, str(exc), reqs, origin)
                     sub.pending._error = str(exc)
-                    sub.pending._event.set()
+                    sub.pending._fire()
                 if block:
                     return                  # got work; go run a tick
 
@@ -217,7 +247,7 @@ class ContinuousSession:
                 sub.pending._remaining -= 1
                 eng.stats.prompts += 1
                 if sub.pending._remaining == 0:
-                    sub.pending._event.set()
+                    sub.pending._fire()
 
     def _fail(self, target: _Submission | None, msg: str, reqs: dict,
               origin: dict) -> None:
@@ -237,7 +267,7 @@ class ContinuousSession:
                     pass
             if not sub.pending.done():
                 sub.pending._error = msg
-                sub.pending._event.set()
+                sub.pending._fire()
 
     def _enqueue(self, sub: _Submission, reqs: dict,
                  origin: dict) -> None:
@@ -261,3 +291,66 @@ class ContinuousSession:
                 scanner=StopScanner(eng.tokenizer, sub.stop),
                 temp=sub.temperature, notify=notify, key=keys[pos])
             origin[seq_id] = (sub, pos)
+
+
+class MultiSession:
+    """Cross-request continuous batching over a replica set
+    (``DataParallelPagedEngine``): one :class:`ContinuousSession` per
+    replica, each with its own driver thread on its own device group, and
+    least-loaded routing of incoming submissions — the serve-mode
+    topology for the v5e-8 flagship shape (dp=2 × tp=4), where a single
+    session would leave half the chips idle.
+
+    Load feedback is by outstanding prompt count; a submission's weight
+    releases when its handle resolves (the ``_Pending`` done-callback),
+    so a replica stuck on long generations stops receiving work — the
+    serve-side analog of the in-process work-stealing queue
+    (inference/tpu/dp_paged.py)."""
+
+    def __init__(self, engines, autostart: bool = True):
+        self.sessions = [ContinuousSession(e, autostart=autostart)
+                         for e in engines]
+        self._load = [0] * len(self.sessions)
+        self._lock = threading.Lock()
+
+    def start(self) -> "MultiSession":
+        for s in self.sessions:
+            s.start()
+        return self
+
+    def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
+               temperature: float = 0.0, stop: list[str] | None = None,
+               on_progress=None) -> _Pending:
+        n = len(prompts)
+        with self._lock:
+            i = min(range(len(self.sessions)), key=self._load.__getitem__)
+            self._load[i] += n
+
+        def release() -> None:
+            with self._lock:
+                self._load[i] -= n
+
+        try:
+            pending = self.sessions[i].submit(
+                prompts, max_new_tokens=max_new_tokens,
+                temperature=temperature, stop=stop, on_progress=on_progress)
+        except Exception:
+            release()                   # closed session etc.: no leak
+            raise
+        pending._add_done_callback(release)
+        return pending
+
+    def generate_fn(self):
+        """See :meth:`ContinuousSession.generate_fn` — pass
+        ``serialize=False`` to the server."""
+        return _generate_fn_for(self)
+
+    def close(self) -> None:
+        for s in self.sessions:
+            s.close()
+
+    def __enter__(self) -> "MultiSession":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
