@@ -65,11 +65,14 @@ def profile_trace():
     return jax.profiler.trace(trace_dir)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def _sharded_zeros(shape, dtype, sharding):
     """Memoised jitted zeros-maker: out_shardings places each shard
     directly on its device with no replicated transient; the lru_cache
-    keeps one compiled program per (shape, dtype, sharding)."""
+    keeps one compiled program per (shape, dtype, sharding).  Bounded:
+    each entry pins its NamedSharding's mesh (and devices) plus a
+    compiled executable, so an unbounded cache would leak meshes from
+    closed engines in a long-lived server cycling cache-length buckets."""
     return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
 
 

@@ -568,6 +568,7 @@ class PagedTPUEngine:
                  keyarr.view(np.int32), posarr[:, None]], axis=1)
             st.dev_state = self._dev(jnp.asarray(packed))
             st.dev_temp = self._dev(jnp.asarray(st.slot_temp))
+            st.spec_dev = None            # spec-path carry now stale
             st.dirty = False
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):

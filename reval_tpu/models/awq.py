@@ -39,6 +39,16 @@ __all__ = ["AWQ_ORDER", "awq_config", "pack_awq", "unpack_awq",
            "pack_gptq_cols", "unpack_gptq_cols"]
 
 #: nibble position -> logical column offset within each 8-column block
+#:
+#: Verification status: the interleave (and the GPTQ "stored zeros are
+#: zero-1" v1 convention below) is validated only against this module's
+#: own pack_* twins — a synthetic writer built from the same constants.
+#: This host has zero network egress, so no tensor actually packed by
+#: AutoAWQ/AutoGPTQ has been cross-checked; a wrong nibble order would
+#: pass every in-repo test and garble a real published checkpoint.
+#: When egress (or a vendored golden fixture) is available, add a
+#: one-time cross-check against real AutoAWQ bytes before trusting
+#: this path on downloaded checkpoints.  (ADVICE r3.)
 AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
 
 

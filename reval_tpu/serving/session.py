@@ -32,6 +32,7 @@ where prefix sharing pays).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -173,6 +174,22 @@ class ContinuousSession:
             self._inbox.put(None)       # wake a blocked driver
         if self._thread is not None:
             self._thread.join(timeout=120)
+            if self._thread.is_alive():
+                # A wedged device dispatch (or a very long healthy drain)
+                # can outlive the join timeout.  The driver still owns
+                # the engine, so keep the thread reference — nulling it
+                # would let callers tear down/reuse the engine while the
+                # driver is live.  No raise: close() runs from __exit__
+                # and MultiSession.close(), where an exception would mask
+                # in-flight errors or strand sibling replicas un-closed.
+                # logging, not warnings.warn: the default warning filter
+                # dedups per call site, which would hide a second wedged
+                # replica in the same process.
+                logging.getLogger(__name__).warning(
+                    "ContinuousSession %#x driver did not exit within "
+                    "120s; engine is still owned by the driver thread "
+                    "(call close() again to re-join)", id(self))
+                return
             self._thread = None
 
     def __enter__(self) -> "ContinuousSession":
