@@ -63,8 +63,18 @@ class TPUBackend(InferenceBackend):
                 "sequence/pipeline parallelism runs on the static engine "
                 "(the paged scheduler has no sp/pp path) — drop the "
                 "explicit engine='paged' or the sp_size/pp_size")
+        import jax
+
+        cross_process = (not local_devices_only and jax.process_count() > 1)
+        if engine == "paged" and cross_process:
+            raise ValueError(
+                "multihost 'global' mode (mesh over every host's chips) "
+                "runs on the static engine — the paged scheduler's "
+                "host-side state is per-process.  Drop engine='paged', or "
+                "use multihost 'replicate' for per-host paged engines")
         if engine is None:
-            engine = "static" if (sp_size > 1 or pp_size > 1) else "paged"
+            engine = ("static" if (sp_size > 1 or pp_size > 1 or cross_process)
+                      else "paged")
         if pp_size > 1:
             # pipeline parallelism implies the static engine (the paged
             # scheduler has no pp path); kv_dtype is a paged-pool feature

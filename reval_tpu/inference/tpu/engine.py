@@ -184,6 +184,7 @@ class TPUEngine:
         self.params = params
         self._input_sharding = None
         self._cache_sharding = None
+        self._replicate = None       # set iff the mesh spans processes
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -208,6 +209,17 @@ class TPUEngine:
                     " — run this model on a non-sp mesh")
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
+            # multihost "global" mode: the mesh spans several processes
+            # (launchers/tpu_vm_fleet.sh MULTIHOST=global — one model over
+            # every host's chips).  Host readbacks then need an explicit
+            # replicate step: np.asarray() can only consume arrays that
+            # are fully addressable or fully replicated, and dp-sharded
+            # token outputs are neither.  The replicate jit is an XLA
+            # all-gather over ICI/DCN, a few KB per decode chunk.
+            if any(d.process_index != jax.process_index()
+                   for d in mesh.devices.flat):
+                self._replicate = jax.jit(
+                    lambda x: x, out_shardings=NamedSharding(mesh, P()))
             if sizes.get("sp", 1) > 1:
                 # sequence parallelism: prefill via ring attention with T
                 # sharded over sp; the cache keeps S sp-sharded and decode
@@ -287,8 +299,13 @@ class TPUEngine:
             body, (first_token, cache, start_pos, key), None, length=steps)
         return toks.T, cache, last
 
-    def _next_key(self) -> jax.Array:
+    def _next_key(self):
         self._key, sub = jax.random.split(self._key)
+        if self._replicate is not None:
+            # a key committed to this host's device 0 cannot feed a jit
+            # spanning the cross-process mesh; hand jit the host value
+            # (identical on every host — same seed, same split sequence)
+            return np.asarray(sub)
         return sub
 
     def _cache_rows(self, b: int) -> int:
@@ -338,6 +355,15 @@ class TPUEngine:
                     out[i] = text
         return out  # type: ignore[return-value]
 
+    def _host_read(self, arr) -> np.ndarray:
+        """Device tokens → numpy on EVERY host.  On a cross-process mesh
+        the dp-sharded output is not addressable here, so replicate first
+        (all-gather); every host then takes identical scheduling decisions
+        (stop scanning, loop exit) from identical data."""
+        if self._replicate is not None:
+            arr = self._replicate(arr)
+        return np.asarray(arr)
+
     def _generate_batch(self, batch_ids: list[list[int]], max_new_tokens: int,
                         temperature: float, stop: list[str]) -> list[str]:
         n_real = len(batch_ids)
@@ -357,25 +383,30 @@ class TPUEngine:
 
         cache = self._init_cache(self._cache_rows(b),
                                  self._cache_len(t, max_new_tokens))
-        dev_tokens, dev_pad = jnp.asarray(tokens), jnp.asarray(pad_len)
         if self._input_sharding is not None:
-            dev_tokens = jax.device_put(dev_tokens, self._input_sharding)
-            dev_pad = jax.device_put(dev_pad, self._input_sharding)
+            # device_put straight from numpy: each process contributes its
+            # addressable shards, so this works on a cross-process mesh
+            # (every host holds the same full batch in global mode)
+            dev_tokens = jax.device_put(tokens, self._input_sharding)
+            dev_pad = jax.device_put(pad_len, self._input_sharding)
+        else:
+            dev_tokens, dev_pad = jnp.asarray(tokens), jnp.asarray(pad_len)
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("reval.prefill"):
             logits, cache = self._jit_prefill(
                 self.params, tokens=dev_tokens, pad_len=dev_pad, cache=cache)
-            first = sample_token(logits[:, 0, :], jnp.float32(temperature),
+            first = sample_token(logits[:, 0, :], np.float32(temperature),
                                  self._next_key())
         jax.block_until_ready(first)
         self.stats.prefill_seconds += time.perf_counter() - t0
         self.stats.prefill_tokens += int((t - pad_len).sum())
 
         generated = np.zeros((b, 0), dtype=np.int32)
-        first_host = np.asarray(first)[:, None]
+        first_host = self._host_read(first)[:, None]
         generated = np.concatenate([generated, first_host], axis=1)
         token = first[:, None]
-        pos = jnp.int32(t)
+        pos = np.int32(t)   # host value: placeable on any (even cross-
+                            # process) device assignment by jit
         # dummy rows (batch padding) are born finished or they would pin
         # the whole batch to the full token budget
         finished = [False] * n_real + [True] * (b - n_real)
@@ -389,9 +420,9 @@ class TPUEngine:
             with jax.profiler.TraceAnnotation("reval.decode_chunk"):
                 toks, cache, token = self._jit_decode_chunk(
                     self.params, token, dev_pad, cache, pos,
-                    jnp.float32(temperature), self._next_key(), steps=steps)
+                    np.float32(temperature), self._next_key(), steps=steps)
             pos = pos + steps
-            chunk_host = np.asarray(toks)
+            chunk_host = self._host_read(toks)
             generated = np.concatenate([generated, chunk_host], axis=1)
             for row in range(n_real):
                 if not finished[row]:

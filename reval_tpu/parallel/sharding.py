@@ -15,6 +15,7 @@ divisible), keeping decode attention collective-free.
 from __future__ import annotations
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import ModelConfig
@@ -211,9 +212,22 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
 def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the rules above."""
     specs = param_specs(params, cfg, mesh)
+    # multihost global mode: device_put cannot move a committed
+    # single-device array onto a mesh spanning other processes — feed it
+    # the host value instead (each process then places just its own
+    # addressable shards; all hosts hold identical values by construction)
+    cross = any(d.process_index != jax.process_index()
+                for d in mesh.devices.flat)
+
+    def place(leaf, spec):
+        # already-global leaves (shard-direct loads) are not addressable
+        # here and must go straight through; device_put re-place is a no-op
+        if cross and isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+            leaf = np.asarray(leaf)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
     return jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        params, specs,
+        place, params, specs,
         is_leaf=lambda x: not isinstance(x, dict),
     )
 

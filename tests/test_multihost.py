@@ -57,7 +57,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_rig(script, tmp_path) -> tuple[list, list]:
+def _run_rig(script, tmp_path, nprocs: int = 2) -> tuple[list, list]:
     port = str(_free_port())
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("XLA_FLAGS", None)           # default 1 CPU device per process
@@ -65,7 +65,7 @@ def _run_rig(script, tmp_path) -> tuple[list, list]:
                                str(tmp_path), port],
                               stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                               text=True, env=env)
-             for pid in (0, 1)]
+             for pid in range(nprocs)]
     outs = []
     try:
         for p in procs:
@@ -133,6 +133,62 @@ PP_WORKER = textwrap.dedent("""
         assert outs == want, (outs, want)
     print("WORKER_OK", pid)
 """)
+
+
+GLOBAL_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); out_dir = sys.argv[2]; port = sys.argv[3]
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=4, process_id=pid)
+    assert jax.process_count() == 4
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from reval_tpu.inference.tpu.engine import TPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+    from reval_tpu.parallel import make_mesh
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 61,
+                      hidden_size=64, intermediate_size=128, num_layers=2,
+                      num_heads=4, num_kv_heads=2, head_dim=16)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    tok = ByteTokenizer()
+    prompts = ["def f(x):", "x = 1", "for i in range("]
+
+    # the 70B launcher shape (tpu_vm_fleet.sh MULTIHOST=global): one model
+    # over the JOINT 4-process x 2-device mesh, dp x tp; the batch spans
+    # dp groups that live on DIFFERENT processes
+    eng = TPUEngine(params, cfg, tok, batch_size=4, max_seq_len=128,
+                    mesh=make_mesh(dp=2, tp=4))
+    outs = eng.generate(prompts, max_new_tokens=6, temperature=0.0)
+
+    # every host must hold the full gathered outputs, and they must match
+    # a plain single-process local engine bit for bit
+    plain = TPUEngine(params, cfg, tok, batch_size=4, max_seq_len=128)
+    want = plain.generate(prompts, max_new_tokens=6, temperature=0.0)
+    assert outs == want, (outs, want)
+    print("WORKER_OK", pid)
+""")
+
+
+def test_four_process_global_mesh(tmp_path):
+    """MULTIHOST=global backing (round-3 verdict item 7): a dp=2 x tp=4
+    mesh spanning FOUR jax.distributed processes (2 local CPU devices
+    each), generation outputs identical to the single-process engine on
+    every host."""
+    script = tmp_path / "global_worker.py"
+    script.write_text(GLOBAL_WORKER.format(repo=REPO))
+    procs, outs = _run_rig(script, tmp_path, nprocs=4)
+    if any(p.returncode != 0 for p in procs):
+        procs, outs = _run_rig(script, tmp_path, nprocs=4)  # port race retry
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {pid}" in out
 
 
 def test_two_process_pipeline_ring(tmp_path):
