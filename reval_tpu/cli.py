@@ -48,6 +48,38 @@ def _ask(prompt: str, default, cast=str):
     return cast(raw)
 
 
+def _ask_path(prompt: str, default: str = "") -> str:
+    """`_ask` with readline TAB-completion over the filesystem — the
+    reference wizard's path affordance (reference evaluation.py:1070-1125
+    uses bullet + readline; stdlib readline covers the completion part).
+    Falls back to a plain prompt where readline is unavailable (win32,
+    non-tty pipes in tests)."""
+    try:
+        import glob
+        import readline
+    except ImportError:
+        return _ask(prompt, default)
+
+    def complete(text: str, state: int):
+        hits = glob.glob(os.path.expanduser(text) + "*")
+        hits = [h + ("/" if os.path.isdir(h) else "") for h in hits]
+        return hits[state] if state < len(hits) else None
+
+    old_completer = readline.get_completer()
+    old_delims = readline.get_completer_delims()
+    readline.set_completer(complete)
+    readline.set_completer_delims(" \t\n")
+    readline.parse_and_bind("tab: complete")
+    try:
+        return _ask(prompt, default)
+    finally:
+        readline.set_completer(old_completer)
+        readline.set_completer_delims(old_delims)
+        # parse_and_bind is global: un-bind TAB or every later plain
+        # _ask prompt keeps filesystem completion
+        readline.parse_and_bind('"\t": self-insert')
+
+
 def build_config_interactively() -> dict:
     cfg: dict = {}
     cfg["task"] = _choose("Select a task", TASK_CHOICES)
@@ -59,7 +91,8 @@ def build_config_interactively() -> dict:
     else:
         cfg["model_id"] = _ask("Enter model name", "deepseek-coder-1.3b")
         if backend == "tpu":
-            cfg["model_path"] = _ask("Enter model path (HF checkpoint dir)", "")
+            cfg["model_path"] = _ask_path(
+                "Enter model path (HF checkpoint dir; TAB completes)", "")
             cfg["num_chips"] = _ask("Number of TPU chips (tensor-parallel)", 1, int)
             cfg["dp_size"] = _ask("Data-parallel degree", 1, int)
             cfg["pp_size"] = _ask("Pipeline-parallel stages (1 = off)", 1, int)

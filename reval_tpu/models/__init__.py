@@ -4,7 +4,8 @@ Covers the reference model zoo (model_list.txt): llama family (CodeLlama,
 DeepSeek-Coder, Mistral, Magicoder), Gemma, StarCoder2."""
 
 from .configs import ModelConfig, load_hf_config
-from .loader import init_random_params, load_checkpoint, param_template
+from .loader import (init_random_int4, init_random_params, load_checkpoint,
+                     param_template)
 from .model import (
     KVCache,
     decode_step,
@@ -24,6 +25,7 @@ __all__ = [
     "ZooEntry",
     "decode_step",
     "init_kv_cache",
+    "init_random_int4",
     "init_random_params",
     "is_quantized",
     "load_checkpoint",

@@ -21,7 +21,7 @@ import numpy as np
 
 from .configs import ModelConfig, load_hf_config
 
-__all__ = ["load_checkpoint", "init_random_params", "param_template"]
+__all__ = ["load_checkpoint", "init_random_params", "init_random_int4", "param_template"]
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}
 
@@ -274,12 +274,15 @@ def param_template(cfg: ModelConfig) -> dict:
     return tree
 
 
-def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") -> dict:
+def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32",
+                       tp: int = 1) -> dict:
     """Random params matching the template — benches and sharding tests run
     real architectures without real checkpoints (this host has no egress).
     ``dtype="int8"``/``"int4"`` quantizes matmul weights leaf-by-leaf as
     they are drawn (models/quant.py), so the float tree is never fully
-    resident."""
+    resident.  ``tp``: intended tensor-parallel width — aligns int4 group
+    boundaries to shard boundaries (same rule as the shard-direct loader),
+    so a 34B-class tree can be born int4 AND born shard-aligned."""
     import jax
 
     qmode = dtype if dtype in ("int8", "int4") else None
@@ -306,7 +309,8 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") 
             parts: dict = {}
             for _ in range(shape[0]):
                 tmp: dict = {}
-                quantize_into(tmp, name, init_leaf(name, shape[1:]), qmode)
+                quantize_into(tmp, name, init_leaf(name, shape[1:]), qmode,
+                              tp=tp)
                 for k, v in tmp.items():
                     parts.setdefault(k, []).append(v)
             for k, v in parts.items():
@@ -314,7 +318,7 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") 
             return
         leaf = init_leaf(name, shape)
         if qmode:
-            quantize_into(store, name, leaf, qmode)
+            quantize_into(store, name, leaf, qmode, tp=tp)
         else:
             store[name] = leaf
 
@@ -325,4 +329,52 @@ def init_random_params(cfg: ModelConfig, seed: int = 0, dtype: str = "float32") 
                 place(flat["layers"], k, shape)
         else:
             place(flat, name, value)
+    return flat
+
+
+def init_random_int4(cfg: ModelConfig, seed: int = 0, tp: int = 1) -> dict:
+    """Random int4 params WITHOUT the float draw-and-quantize pass:
+    matmul weights are uniform int4 codes + uniform group scales written
+    directly (numpy, ~GB/s), everything else a small normal draw.  Same
+    leaf conventions as :func:`quant.quantize_into` (``<name>_gscale``
+    siblings, tp-aligned groups), so engines consume the tree unchanged.
+
+    This exists for the 34B north-star dryrun: drawing 34e9 normals
+    through jax.random and quantizing them takes the best part of an
+    hour on a CPU host, while the resulting VALUES are irrelevant to
+    footprint/compile/sharding validation — only sizes, dtypes and group
+    geometry matter."""
+    import ml_dtypes
+
+    from .quant import MATMUL_WEIGHTS, _tp_aligned_group
+
+    rng = np.random.default_rng(seed)
+    template = param_template(cfg)
+
+    def fill(store: dict, name: str, shape: tuple) -> None:
+        if name in MATMUL_WEIGHTS and len(shape) >= 2:
+            *lead, n_in, n_out = shape
+            g = _tp_aligned_group(n_in, tp)
+            codes = rng.integers(-7, 8, size=shape, dtype=np.int8)
+            store[name] = jnp.asarray(codes.astype(ml_dtypes.int4))
+            scales = rng.uniform(0.001, 0.004,
+                                 size=(*lead, n_in // g, n_out))
+            store[name + "_gscale"] = jnp.asarray(scales.astype(np.float32))
+        else:
+            arr = rng.standard_normal(shape, dtype=np.float32)
+            scale = 0.02 if len(shape) > 1 else 1.0
+            if (name.endswith("norm_w") and not cfg.use_layernorm
+                    and cfg.norm_offset == 0.0):
+                arr = np.ones(shape, np.float32)
+                scale = 1.0
+            store[name] = jnp.asarray((arr * scale).astype(ml_dtypes.bfloat16))
+
+    flat: dict = {}
+    for name, value in template.items():
+        if name == "layers":
+            flat["layers"] = {}
+            for k, shape in value.items():
+                fill(flat["layers"], k, shape)
+        else:
+            fill(flat, name, value)
     return flat

@@ -104,6 +104,7 @@ def awq_checkpoint(tmp_path_factory):
     return model, path
 
 
+@pytest.mark.slow
 def test_awq_checkpoint_loads_and_matches_dequant(awq_checkpoint):
     """Loaded AWQ leaves dequantise to exactly the values the AWQ formula
     assigns, and greedy generation matches an engine fed those values."""
@@ -159,6 +160,7 @@ def test_awq_detection_rejects_unsupported_bits(tmp_path):
     assert awq_config(tmp_path) is None
 
 
+@pytest.mark.slow
 def test_awq_loads_through_sharded_loader_fallback(awq_checkpoint):
     """Engines route mesh loads through load_checkpoint_sharded; an AWQ
     checkpoint must come back complete and sharded (full-tree fallback),
@@ -282,6 +284,7 @@ def gptq_checkpoint(tmp_path_factory):
     return path
 
 
+@pytest.mark.slow
 def test_gptq_checkpoint_loads_and_matches_oracle(gptq_checkpoint):
     from reval_tpu.inference.tpu.engine import TPUEngine
     from reval_tpu.models import load_checkpoint

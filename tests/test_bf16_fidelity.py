@@ -28,6 +28,8 @@ forwards); kept as one test function so the cost is paid once.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax
 import jax.numpy as jnp
 

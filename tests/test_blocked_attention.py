@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.ops import attention
 
 

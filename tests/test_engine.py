@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax.numpy as jnp
 
 from reval_tpu.inference.tpu.engine import TPUEngine, _bucket, truncate_at_stop

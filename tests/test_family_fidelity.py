@@ -1,0 +1,179 @@
+"""Full-family numerical fidelity matrix vs HF transformers at width
+(round-3 verdict item 2 fallback — no network egress, no cached real
+checkpoints on this host, so accuracy parity with a real pretrained model
+cannot be produced; this is the compensating evidence).
+
+The tiny per-family parity tests (test_models.py) prove implementation
+correctness at toy width; test_bf16_fidelity.py proves drift behavior at
+flagship width for ONE family.  A subtle RoPE / GQA / norm-offset /
+softcap / MoE-routing mapping bug could still pass both and flip YES/NO
+answers on a real checkpoint.  This matrix runs EVERY family surface in
+models/zoo.py at meaningful width (1024 hidden × 8 layers, where bf16
+reduction drift is measurable) against transformers' reference forward:
+
+| case       | family-specific machinery it pins                         |
+|------------|-----------------------------------------------------------|
+| llama-gqa  | grouped KV at width (CodeLlama-34B GQA-8 geometry)         |
+| mistral    | uniform sliding-window attention                           |
+| gemma      | norm offset (1+w), tied embeddings, gelu, sqrt(h) embed    |
+| gemma2     | logit softcap, sandwich norms, alternating local windows   |
+| starcoder2 | layernorm+bias, attention bias, ungated gelu MLP           |
+| mixtral    | top-2-of-N expert routing + per-expert MLPs                |
+
+Per case: (1) fp32 cross-implementation parity per layer + logits
+(tight); (2) bf16 drift within the roundoff-growth model of
+test_bf16_fidelity.py; (3) greedy agreement guard.  ~0.1-0.3 B params
+per case — minutes total, marked slow.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import jax
+import jax.numpy as jnp
+
+SEQ = 96
+BF16_EPS = 2.0 ** -8
+OPS_PER_LAYER = 7
+SAFETY = 4.0
+
+DIMS = dict(vocab_size=2048, hidden_size=1024, num_hidden_layers=8,
+            max_position_embeddings=4096)
+
+
+def _llama_gqa():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    return LlamaForCausalLM, LlamaConfig(
+        **DIMS, intermediate_size=2816, num_attention_heads=8,
+        num_key_value_heads=2, rope_theta=1000000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+
+
+def _mistral():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    return MistralForCausalLM, MistralConfig(
+        **DIMS, intermediate_size=2816, num_attention_heads=8,
+        num_key_value_heads=2, sliding_window=48, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+
+
+def _gemma():
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    return GemmaForCausalLM, GemmaConfig(
+        **DIMS, intermediate_size=2816, num_attention_heads=8,
+        num_key_value_heads=8, head_dim=128, hidden_act="gelu_pytorch_tanh",
+        rms_norm_eps=1e-6)        # gemma always ties embeddings
+
+
+def _gemma2():
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    return Gemma2ForCausalLM, Gemma2Config(
+        **DIMS, intermediate_size=2816, num_attention_heads=8,
+        num_key_value_heads=4, head_dim=128,
+        hidden_act="gelu_pytorch_tanh", rms_norm_eps=1e-6,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=48, query_pre_attn_scalar=128)
+
+
+def _starcoder2():
+    from transformers import Starcoder2Config, Starcoder2ForCausalLM
+
+    return Starcoder2ForCausalLM, Starcoder2Config(
+        **DIMS, intermediate_size=4096, num_attention_heads=8,
+        num_key_value_heads=2, hidden_act="gelu_pytorch_tanh",
+        norm_epsilon=1e-5, use_bias=True, tie_word_embeddings=False)
+
+
+def _mixtral():
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    return MixtralForCausalLM, MixtralConfig(
+        **DIMS, intermediate_size=2048, num_attention_heads=8,
+        num_key_value_heads=2, num_local_experts=4, num_experts_per_tok=2,
+        rms_norm_eps=1e-5, tie_word_embeddings=False)
+
+
+FAMILIES = {
+    "llama-gqa": _llama_gqa,
+    "mistral": _mistral,
+    "gemma": _gemma,
+    "gemma2": _gemma2,
+    "starcoder2": _starcoder2,
+    "mixtral": _mixtral,
+}
+
+
+@pytest.mark.parametrize("family", list(FAMILIES))
+def test_family_fidelity_at_width(family, tmp_path):
+    import torch
+
+    from reval_tpu.models import init_kv_cache, load_checkpoint, prefill
+
+    cls, hf_cfg = FAMILIES[family]()
+    torch.manual_seed(1234)
+    model = cls(hf_cfg).eval()
+    path = tmp_path / family
+    model.save_pretrained(path, safe_serialization=True)
+
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, hf_cfg.vocab_size - 1, size=(1, SEQ))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens), output_hidden_states=True)
+    ref_hiddens = [h.float().numpy() for h in ref.hidden_states[1:]]
+    ref_logits = ref.logits.float().numpy()
+    del ref, model
+
+    params, cfg = load_checkpoint(path, dtype="float32")
+    pad = jnp.zeros(1, jnp.int32)
+    toks = jnp.asarray(tokens, jnp.int32)
+
+    def run(p, dtype):
+        cache = init_kv_cache(cfg, 1, SEQ, dtype=dtype)
+        logits, _, hiddens = prefill(p, cfg=cfg, tokens=toks, pad_len=pad,
+                                     cache=cache, collect_hiddens=True)
+        return (np.asarray(logits, np.float32),
+                np.asarray(hiddens, np.float32))
+
+    f32_logits, f32_hiddens = run(params, jnp.float32)
+
+    # -- 1. fp32 cross-implementation parity, per layer + logits --------
+    # (transformers norms its LAST hidden_states entry, so the final
+    # pre-norm state is only observable through the logits check)
+    for layer, ref_h in enumerate(ref_hiddens[:-1]):
+        rel = (np.linalg.norm(f32_hiddens[layer] - ref_h)
+               / np.linalg.norm(ref_h))
+        assert rel < 2e-3, (
+            f"[{family}] fp32 impl divergence at layer {layer}: {rel:.2e}")
+    logit_rel = (np.linalg.norm(f32_logits - ref_logits)
+                 / np.linalg.norm(ref_logits))
+    assert logit_rel < 2e-3, f"[{family}] fp32 logits diverge: {logit_rel:.2e}"
+
+    # -- 2. bf16 drift within the roundoff-growth model -----------------
+    bf16_params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, params)
+    bf16_logits, bf16_hiddens = run(bf16_params, jnp.bfloat16)
+    drifts = []
+    for layer in range(cfg.num_layers):
+        rel = (np.linalg.norm(bf16_hiddens[layer] - f32_hiddens[layer])
+               / np.linalg.norm(f32_hiddens[layer]))
+        bound = SAFETY * BF16_EPS * np.sqrt(OPS_PER_LAYER * (layer + 1))
+        drifts.append(rel)
+        assert rel < bound, (
+            f"[{family}] bf16 drift at layer {layer}: {rel:.4f} exceeds "
+            f"the roundoff-growth bound {bound:.4f}")
+
+    # -- 3. greedy effect (random weights = worst-case margins) ----------
+    logit_drift = (np.linalg.norm(bf16_logits - f32_logits)
+                   / np.linalg.norm(f32_logits))
+    agree = float(np.mean(bf16_logits.argmax(-1) == f32_logits.argmax(-1)))
+    assert logit_drift < 0.10, f"[{family}] bf16 logit drift {logit_drift:.3f}"
+    assert agree > 0.5, f"[{family}] greedy agreement collapsed: {agree:.2f}"
+    print(f"[{family}] drift first={drifts[0]:.4f} last={drifts[-1]:.4f} "
+          f"logit-rel={logit_drift:.4f} greedy-agree={agree:.2%}")

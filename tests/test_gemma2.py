@@ -10,6 +10,8 @@ Oracle: transformers' Gemma2ForCausalLM on a tiny random checkpoint
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax
 import jax.numpy as jnp
 

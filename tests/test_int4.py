@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
 from reval_tpu.models import ModelConfig, init_random_params
 from reval_tpu.models.quant import (

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.models import ModelConfig, init_kv_cache, init_random_params, prefill
 from reval_tpu.models.paged import (
     _quantize_kv,

@@ -10,6 +10,8 @@ that defines the reference's vLLM weights' semantics.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax.numpy as jnp
 
 TINY_LLAMA = dict(

@@ -11,6 +11,8 @@ established per-family in-tree, SURVEY §7 hard part 3).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax
 import jax.numpy as jnp
 

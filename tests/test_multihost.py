@@ -6,6 +6,10 @@ degenerate case (round-1 verdict weak item 5).
 Each worker is a real OS process; the coordinator runs over localhost.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import subprocess
 import sys

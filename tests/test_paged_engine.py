@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.inference.tpu.engine import TPUEngine
 from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 from reval_tpu.inference.tpu.tokenizer import ByteTokenizer

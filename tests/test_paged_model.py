@@ -2,6 +2,10 @@
 the same logits as the contiguous left-padded cache path (test_models'
 oracle), for sequences of different lengths sharing one page pool."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 import numpy as np
 

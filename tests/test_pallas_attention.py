@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.ops.attention import decode_attention
 from reval_tpu.ops.pallas_attention import (
     paged_decode_attention_pallas,

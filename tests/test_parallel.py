@@ -5,6 +5,8 @@ vs single-device)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax
 import jax.numpy as jnp
 

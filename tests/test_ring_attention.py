@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.ops.attention import prefill_attention
 from reval_tpu.parallel import make_mesh
 from reval_tpu.parallel.ring_attention import (

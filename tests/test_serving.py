@@ -7,6 +7,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.inference.client import HTTPClientBackend
 from reval_tpu.serving import EngineServer
 

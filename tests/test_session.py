@@ -8,6 +8,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
 from reval_tpu.models import ModelConfig, init_random_params

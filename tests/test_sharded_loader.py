@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 
 @pytest.fixture(scope="module")
 def llama_checkpoint(tmp_path_factory):

@@ -5,6 +5,8 @@ every composition — acceptance only changes speed, never output."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # noqa: E402
+
 import jax.numpy as jnp
 
 from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
