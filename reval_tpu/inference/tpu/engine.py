@@ -201,12 +201,6 @@ class TPUEngine:
                 raise ValueError(
                     f"sp={self._sp} must divide the bucket granularity "
                     f"{MIN_BUCKET} (power-of-two sp up to {MIN_BUCKET})")
-            blockers = cfg.ring_attention_blockers()
-            if self._sp > 1 and blockers:
-                # fail before any checkpoint-sized work, not at first trace
-                raise NotImplementedError(
-                    f"ring attention does not support {', '.join(blockers)}"
-                    " — run this model on a non-sp mesh")
             self.params = shard_params(params, cfg, mesh)
             self._input_sharding = NamedSharding(mesh, P("dp"))
             # multihost "global" mode: the mesh spans several processes
@@ -254,15 +248,9 @@ class TPUEngine:
         the replicated-engines multihost mode (one full replica per host,
         prompts sharded over DCN by the fleet).  ``sp_size``: shard
         prefill sequences (and the KV cache) over a sequence-parallel
-        ring for prompts past one chip's attention working set."""
-        if sp_size > 1:
-            from ...models.configs import load_hf_config
-
-            blockers = load_hf_config(model_path).ring_attention_blockers()
-            if blockers:
-                raise NotImplementedError(
-                    f"ring attention does not support {', '.join(blockers)}"
-                    " — use a non-sp mesh (checked before checkpoint load)")
+        ring for prompts past one chip's attention working set (all
+        families — sliding windows and score softcapping ride the ring
+        masks since round 4)."""
         mesh = None
         if tp_size * dp_size * sp_size > 1:
             from ...parallel import make_mesh

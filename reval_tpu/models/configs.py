@@ -71,17 +71,6 @@ class ModelConfig:
             return self.sliding_window if i % 2 == 0 else None
         return self.sliding_window
 
-    def ring_attention_blockers(self) -> list[str]:
-        """Features the ring-attention (sp) path does not support — THE
-        predicate every sp guard shares (engine ctor, from_pretrained
-        probe, sp_prefill)."""
-        out = []
-        if self.sliding_window is not None:
-            out.append("sliding-window attention")
-        if self.attn_softcap is not None:
-            out.append("attention-score softcapping")
-        return out
-
     def layer_windows_array(self):
         """[L] int32 window sizes for traced (scan-based) layer loops;
         global layers get a sentinel larger than any position."""

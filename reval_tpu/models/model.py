@@ -312,10 +312,12 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
     and skipping the [B, T, V] unembed matmul removes the single largest
     waste in prefill (T× the needed FLOPs into the vocab dimension).
 
-    ``attend_fn(q, k, v)`` overrides the attention (the only piece that
-    varies across prefill deployments — the sequence-parallel path swaps
-    in ring attention); ``constrain(h)`` (optional) re-annotates the
-    activation sharding after embed and after every layer.
+    ``attend_fn(q, k, v, win)`` overrides the attention (the only piece
+    that varies across prefill deployments — the sequence-parallel path
+    swaps in ring attention); ``win`` is the layer's traced sliding-window
+    size (sentinel-big = full causal), threaded so windowed models work
+    under any attention override.  ``constrain(h)`` (optional)
+    re-annotates the activation sharding after embed and every layer.
 
     ``collect_hiddens=True`` (fidelity tests only — static flag, so the
     generation path compiles without it) additionally returns the
@@ -345,7 +347,8 @@ def prefill(params, cfg: ModelConfig, tokens: jnp.ndarray, pad_len: jnp.ndarray,
     def layer_step(h, xs):
         layer, k_slot, v_slot, win = xs
         kv = {}
-        inner = attend_fn if attend_fn is not None else default_attend(win)
+        inner = ((lambda q, k, v: attend_fn(q, k, v, win))
+                 if attend_fn is not None else default_attend(win))
 
         def attend(q, k, v):
             kv["k"] = jax.lax.dynamic_update_slice(

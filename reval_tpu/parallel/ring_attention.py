@@ -34,7 +34,8 @@ __all__ = ["ring_self_attention", "ring_attention_sharded"]
 _NEG_INF = -1e30
 
 
-def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale, pad_len=None):
+def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale, pad_len=None,
+                  window=None, softcap=None):
     """One online-softmax accumulation of q against a KV block.
 
     q: [B, Tq, H_kv, G, D]; k/v: [B, Tk, H_kv, D]; positions: [Tq]/[Tk];
@@ -42,10 +43,20 @@ def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale, pad_len=None):
 
     ``pad_len`` [B]: left-pad counts.  Padding shifts query and key
     positions equally, so the causal comparison is pad-invariant in
-    buffer coordinates — only pad KEYS need masking out.
+    buffer coordinates — only pad KEYS need masking out.  The same
+    shift-invariance makes the sliding ``window`` mask (a position
+    DIFFERENCE bound, traced per layer) exact across ring blocks, and
+    ``softcap`` is pointwise on scores so it composes with the online
+    softmax unchanged — ordering matches ops/attention.prefill_attention:
+    scale → softcap → mask.
     """
     scores = jnp.einsum("bqngd,bknd->bngqk", q, k) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None]
+    if window is not None:
+        in_window = (q_pos[:, None] - k_pos[None, :]) < window
+        mask = mask & in_window[None, None, None]
     if pad_len is not None:
         valid_key = k_pos[None, :] >= pad_len[:, None]     # [B, Tk]
         mask = mask & valid_key[:, None, None, None, :]
@@ -61,10 +72,11 @@ def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale, pad_len=None):
     return m_new, l_new, acc_new
 
 
-def _ring_body(q, k, v, pad_len, *, axis_name: str | None, axis_size: int,
-               scale):
+def _ring_body(q, k, v, pad_len, window=None, *, axis_name: str | None,
+               axis_size: int, scale, softcap=None):
     """Local ring-attention body.  q: [B, Tl, H, D]; k/v: [B, Tl, H_kv, D];
-    pad_len: [B] or None."""
+    pad_len: [B] or None; window: traced scalar (sentinel-big = full
+    causal) or None."""
     b, t_loc, h, d = q.shape
     n_kv = k.shape[2]
     g = h // n_kv
@@ -86,7 +98,8 @@ def _ring_body(q, k, v, pad_len, *, axis_name: str | None, axis_size: int,
         # bf16 caches move half the bytes per ICI hop
         m, l, acc = _block_update(qg, k.astype(jnp.float32),
                                   v.astype(jnp.float32), q_pos, k_pos,
-                                  m, l, acc, scale, pad_len=pad_len)
+                                  m, l, acc, scale, pad_len=pad_len,
+                                  window=window, softcap=softcap)
         if axis_name is not None and step + 1 < axis_size:
             perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
             k = jax.lax.ppermute(k, axis_name, perm)
@@ -97,8 +110,10 @@ def _ring_body(q, k, v, pad_len, *, axis_name: str | None, axis_size: int,
     return out.reshape(b, t_loc, h, d).astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, pad_len=None, *, axis_name: str | None = None,
-                        axis_size: int = 1, scale: float | None = None):
+def ring_self_attention(q, k, v, pad_len=None, window=None, *,
+                        axis_name: str | None = None,
+                        axis_size: int = 1, scale: float | None = None,
+                        softcap: float | None = None):
     """Causal self-attention with ring-rotated KV blocks.
 
     Call inside ``shard_map`` with ``axis_name`` set (q/k/v are the local
@@ -106,17 +121,23 @@ def ring_self_attention(q, k, v, pad_len=None, *, axis_name: str | None = None,
     single-device reference semantics.  Shard layout is contiguous
     (device i holds positions [i·Tl, (i+1)·Tl)); ``pad_len`` [B] marks
     left-padding (pad keys masked; causality is pad-invariant).
+
+    ``window``: sliding-window size (traced scalar ok — gemma-2
+    alternates per layer, sentinel-big = full causal); ``softcap``:
+    gemma-2 attention-score softcapping.  Semantics match
+    ``ops.attention.prefill_attention`` exactly.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
-    return _ring_body(q, k, v, pad_len, axis_name=axis_name,
-                      axis_size=axis_size, scale=scale)
+    return _ring_body(q, k, v, pad_len, window, axis_name=axis_name,
+                      axis_size=axis_size, scale=scale, softcap=softcap)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, *,
+def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, window=None, *,
                            sp_axis: str = "sp", head_axis: str | None = None,
                            batch_axis: str | None = "dp",
-                           scale: float | None = None):
+                           scale: float | None = None,
+                           softcap: float | None = None):
     """Shard ``q, k, v`` ([B, T, H, D], T divisible by the ``sp`` axis
     size) over the sequence dimension and run ring attention.
 
@@ -136,12 +157,20 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, *,
     if batch_axis is not None and batch_axis not in mesh.axis_names:
         batch_axis = None
     body = partial(ring_self_attention, axis_name=sp_axis,
-                   axis_size=axis_size, scale=scale)
+                   axis_size=axis_size, scale=scale, softcap=softcap)
     spec = P(batch_axis, sp_axis, head_axis, None)
-    if pad_len is None:
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_vma=False)(q, k, v)
+    args, specs = [q, k, v], [spec, spec, spec]
+    if pad_len is not None or window is not None:
+        # pad_len rides along whenever window does (positional order);
+        # zeros = "no padding", the masks it produces are no-ops
+        args.append(pad_len if pad_len is not None
+                    else jnp.zeros(q.shape[0], jnp.int32))
+        specs.append(P(batch_axis))
+    if window is not None:
+        # traced per-layer scalar (gemma-2 alternates): replicated operand,
+        # not a closure — shard_map wants traced values as explicit args
+        args.append(jnp.asarray(window))
+        specs.append(P())
     return jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
-        out_specs=spec, check_vma=False)(q, k, v, pad_len)
+        body, mesh=mesh, in_specs=tuple(specs),
+        out_specs=spec, check_vma=False)(*args)

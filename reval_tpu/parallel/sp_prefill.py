@@ -52,12 +52,6 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
     b, t = tokens.shape
     if t % sp:
         raise ValueError(f"prefill length {t} must be divisible by sp={sp}")
-    blockers = cfg.ring_attention_blockers()
-    if blockers:
-        raise NotImplementedError(
-            f"ring attention does not support {', '.join(blockers)} — run "
-            "this model on a non-sp mesh (a window already bounds the "
-            "attention working set)")
     # shard heads over tp inside the ring too (when divisible): without
     # this every tp device would all-gather full-head q/k/v and compute
     # redundant attention, doubling the working set sp exists to shrink
@@ -71,10 +65,14 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
     def constrain(h):
         return jax.lax.with_sharding_constraint(h, seq_sharding)
 
-    def attend_fn(q, k, v):
-        return ring_attention_sharded(q, k, v, mesh, pad_len,
+    def attend_fn(q, k, v, win):
+        # win: the layer's traced window (sentinel-big = full causal) —
+        # uniform-window (mistral) and alternating (gemma-2) models ride
+        # the same mask; softcap composes with the ring's online softmax
+        return ring_attention_sharded(q, k, v, mesh, pad_len, win,
                                       head_axis=head_axis,
-                                      scale=cfg.attn_scale)
+                                      scale=cfg.attn_scale,
+                                      softcap=cfg.attn_softcap)
 
     return prefill(params, cfg, tokens, pad_len, cache, logits_mode="last",
                    attend_fn=attend_fn, constrain=constrain)

@@ -220,3 +220,99 @@ class TestSequenceParallelEngine:
                         mesh=make_mesh(dp=2, sp=2, tp=2))
         got = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
         assert got == want
+
+
+class TestWindowedAndSoftcappedRing:
+    """Round-4: the two former sp blockers (sliding windows, score
+    softcapping) now ride the ring masks — oracle is the engine's dense
+    prefill_attention with identical parameters."""
+
+    def test_window_matches_dense(self):
+        q, k, v = make_qkv(seed=5, t=64, h=4, h_kv=2, d=16)
+        pad = jnp.zeros(q.shape[0], jnp.int32)
+        ref = prefill_attention(q, k, v, pad, window=24)
+        out = ring_attention_sharded(q, k, v, make_mesh(sp=4), pad,
+                                     jnp.int32(24))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_window_smaller_than_block_and_pad(self):
+        # window INSIDE one ring block + left padding: the distance mask
+        # and the pad mask must compose
+        q, k, v = make_qkv(seed=6, t=64, h=4, h_kv=4, d=16)
+        pad = jnp.asarray([9, 0], jnp.int32)
+        ref = prefill_attention(q, k, v, pad, window=5)
+        out = ring_attention_sharded(q, k, v, make_mesh(sp=4), pad,
+                                     jnp.int32(5))
+        np.testing.assert_allclose(np.asarray(out[0, 9:]),
+                                   np.asarray(ref[0, 9:]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref[1]),
+                                   atol=1e-5)
+
+    def test_softcap_matches_dense(self):
+        q, k, v = make_qkv(seed=7, t=64, h=4, h_kv=2, d=16)
+        pad = jnp.zeros(q.shape[0], jnp.int32)
+        ref = prefill_attention(q, k, v, pad, softcap=30.0)
+        out = ring_attention_sharded(q, k, v, make_mesh(sp=4), pad,
+                                     softcap=30.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_window_and_softcap_compose(self):
+        q, k, v = make_qkv(seed=8, t=64, h=4, h_kv=2, d=16)
+        pad = jnp.asarray([3, 0], jnp.int32)
+        ref = prefill_attention(q, k, v, pad, window=16, softcap=20.0)
+        out = ring_attention_sharded(q, k, v, make_mesh(sp=4), pad,
+                                     jnp.int32(16), softcap=20.0)
+        np.testing.assert_allclose(np.asarray(out[0, 3:]),
+                                   np.asarray(ref[0, 3:]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref[1]),
+                                   atol=1e-5)
+
+    def test_sp_engine_sliding_window_model(self):
+        """Mistral-shaped long-context: the sp engine generates
+        identically to the plain engine on a uniformly-windowed model."""
+        from reval_tpu.inference.tpu.engine import TPUEngine
+        from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+        from reval_tpu.models import ModelConfig, init_random_params
+        from reval_tpu.parallel import make_mesh
+
+        cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 61,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=4, num_kv_heads=2,
+                          head_dim=16, sliding_window=24)
+        params = init_random_params(cfg, seed=9, dtype="float32")
+        tok = ByteTokenizer()
+        prompts = ["def win(x):\n    " + "y = x * 2\n    " * 8,
+                   "assert win("]
+        plain = TPUEngine(params, cfg, tok, batch_size=2, max_seq_len=512)
+        want = plain.generate(prompts, max_new_tokens=8, temperature=0.0)
+        sp = TPUEngine(params, cfg, tok, batch_size=2, max_seq_len=512,
+                       mesh=make_mesh(sp=4))
+        got = sp.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert got == want
+
+    def test_sp_engine_gemma2_style_model(self):
+        """Softcap + alternating local/global windows + sandwich norms
+        (the gemma-2 surface) through the sp engine."""
+        from reval_tpu.inference.tpu.engine import TPUEngine
+        from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+        from reval_tpu.models import ModelConfig, init_random_params
+        from reval_tpu.parallel import make_mesh
+
+        cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 61,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=4, num_heads=4, num_kv_heads=2,
+                          head_dim=16, sliding_window=16,
+                          alt_sliding=True,
+                          attn_softcap=50.0, final_softcap=30.0,
+                          use_post_norms=True)
+        params = init_random_params(cfg, seed=10, dtype="float32")
+        tok = ByteTokenizer()
+        prompts = ["class Gem:\n    " + "a = 1\n    " * 10, "g = Gem()"]
+        plain = TPUEngine(params, cfg, tok, batch_size=2, max_seq_len=512)
+        want = plain.generate(prompts, max_new_tokens=8, temperature=0.0)
+        sp = TPUEngine(params, cfg, tok, batch_size=2, max_seq_len=512,
+                       mesh=make_mesh(sp=4, tp=2))
+        got = sp.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert got == want
