@@ -7,6 +7,12 @@
 # Every worker runs the same command; reval_tpu.parallel.distributed picks
 # up the TPU runtime metadata and joins the jax.distributed mesh, so this
 # one invocation covers the multi-host case (e.g. CodeLlama-70B on v5p-16).
+#
+# Off-TPU rigs (plain SSH clusters, CPU test fleets) have no runtime
+# metadata: export REVAL_TPU_COORDINATOR=host0:port,
+# REVAL_TPU_NUM_PROCESSES=N and a per-worker REVAL_TPU_PROCESS_ID
+# instead — ensure_initialized() reads them before falling back to
+# JAX's own cluster detection (tests/test_multihost.py drives this rig).
 set -euo pipefail
 
 : "${TPU_NAME:?set TPU_NAME to the TPU VM name}"

@@ -47,12 +47,34 @@ def ensure_initialized(coordinator_address: str | None = None,
     ``strict=True`` makes initialisation failure fatal — pass it whenever
     the caller *explicitly* asked for multi-host execution (otherwise every
     host silently degrades to an independent single-process run, and a pod
-    writes N duplicate result logs)."""
+    writes N duplicate result logs).
+
+    Topology resolution order: explicit arguments, then the
+    ``REVAL_TPU_COORDINATOR`` / ``REVAL_TPU_NUM_PROCESSES`` /
+    ``REVAL_TPU_PROCESS_ID`` environment rig (manual launches outside
+    SLURM/TPU-metadata — e.g. `launchers/tpu_vm_fleet.sh` over plain SSH,
+    or CPU test rigs), then JAX's own cluster auto-detection.  If the
+    embedding process already initialised ``jax.distributed`` itself,
+    that is honoured as-is."""
     global _initialized
     if _initialized:
         return
+    import os
+
     import jax
 
+    if jax.distributed.is_initialized():
+        # the embedding process brought up jax.distributed before calling
+        # us — a second initialize() would raise; their topology stands
+        _initialized = True
+        return
+    # each field resolves independently: explicit argument, then env
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("REVAL_TPU_COORDINATOR")
+    if num_processes is None and os.environ.get("REVAL_TPU_NUM_PROCESSES"):
+        num_processes = int(os.environ["REVAL_TPU_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("REVAL_TPU_PROCESS_ID"):
+        process_id = int(os.environ["REVAL_TPU_PROCESS_ID"])
     if num_processes == 1:
         _initialized = True
         return

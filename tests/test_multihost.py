@@ -61,12 +61,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_rig(script, tmp_path, nprocs: int = 2) -> tuple[list, list]:
+def _run_rig(script, tmp_path, nprocs: int = 2,
+             extra_args: list | None = None) -> tuple[list, list]:
     port = str(_free_port())
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("XLA_FLAGS", None)           # default 1 CPU device per process
     procs = [subprocess.Popen([sys.executable, str(script), str(pid),
-                               str(tmp_path), port],
+                               str(tmp_path), port] + (extra_args or []),
                               stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                               text=True, env=env)
              for pid in range(nprocs)]
@@ -207,3 +208,89 @@ def test_two_process_pipeline_ring(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"WORKER_OK {pid}" in out
+
+
+FLEET_GLOBAL_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); out_dir = sys.argv[2]; port = sys.argv[3]
+    # the launcher env rig (tpu_vm_fleet.sh off-TPU path): the CLI's
+    # ensure_initialized(strict=True) takes no explicit topology — it must
+    # find it here
+    os.environ["REVAL_TPU_COORDINATOR"] = "127.0.0.1:" + port
+    os.environ["REVAL_TPU_NUM_PROCESSES"] = "2"
+    os.environ["REVAL_TPU_PROCESS_ID"] = str(pid)
+
+    import json
+    cfg = {{"task": "coverage", "model_id": "fleet-global",
+            "model_path": sys.argv[4], "dtype": "float32",
+            "dataset": "humaneval", "prompt_type": "direct",
+            "tasks": ["coverage"], "max_items": 2, "temp": 0.0,
+            "num_chips": 4, "batch_size": 4,
+            "results_dir": os.path.join(out_dir, "results")}}
+    cfg_path = os.path.join(out_dir, f"fleet_cfg_{{pid}}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+
+    from reval_tpu import cli
+    rc = cli.main(["fleet", "-i", cfg_path, "--repeats", "1",
+                   "--multihost", "global"])
+    assert not rc, rc
+    assert jax.process_count() == 2, jax.process_count()
+    print("WORKER_OK", pid)
+""")
+
+
+def test_fleet_cli_global_mode_two_processes(tmp_path):
+    """The full MULTIHOST=global claim chain, CLI down: two
+    `reval_tpu fleet --multihost global` processes join one
+    jax.distributed rig via the launcher env vars, build ONE tp=4 static
+    engine over the joint 2x2-device mesh, run the coverage task on a
+    real (tiny) HF checkpoint, and only the primary host writes results."""
+    import torch
+    from tokenizers import Tokenizer, decoders, models as tok_models, pre_tokenizers
+    from transformers import LlamaConfig, LlamaForCausalLM, PreTrainedTokenizerFast
+
+    ckpt = tmp_path / "tiny-llama-fleet"
+    torch.manual_seed(5)
+    chars = [chr(i) for i in range(32, 127)] + ["\n", "\t"]
+    vocab = {c: i for i, c in enumerate(chars)}
+    vocab["<unk>"] = len(vocab); vocab["<eos>"] = len(vocab)
+    hf_cfg = LlamaConfig(vocab_size=len(vocab), hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=4096,
+                         eos_token_id=vocab["<eos>"])
+    LlamaForCausalLM(hf_cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+    tok = Tokenizer(tok_models.BPE(vocab=vocab, merges=[], unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+    tok.decoder = decoders.Fuse()
+    tok.save(str(ckpt / "tokenizer.json"))
+    PreTrainedTokenizerFast(tokenizer_file=str(ckpt / "tokenizer.json"),
+                            eos_token="<eos>",
+                            unk_token="<unk>").save_pretrained(ckpt)
+
+    script = tmp_path / "fleet_global_worker.py"
+    script.write_text(FLEET_GLOBAL_WORKER.format(repo=REPO))
+    procs, outs = _run_rig(script, tmp_path, nprocs=2, extra_args=[str(ckpt)])
+    if any(p.returncode != 0 for p in procs):
+        # port race retry — drop any partial first-attempt results or the
+        # final one-log-file assert counts both attempts
+        import shutil
+
+        shutil.rmtree(tmp_path / "results", ignore_errors=True)
+        procs, outs = _run_rig(script, tmp_path, nprocs=2,
+                               extra_args=[str(ckpt)])
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {pid}" in out
+    import glob
+
+    logs = glob.glob(str(tmp_path / "results" / "**" / "*.jsonl"),
+                     recursive=True)
+    # primary-only write: one task, one repeat, ONE log file total
+    assert len(logs) == 1, logs
