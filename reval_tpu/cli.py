@@ -367,6 +367,14 @@ def run_serve(argv: list[str]) -> int:
     server = serve_config(cfg, port=args.port, warmup=args.warmup)
     print(f"serving {cfg.get('model_id')} on :{server.port} "
           f"(POST /v1/completions, GET /v1/models)")
+    # orchestrators stop containers with SIGTERM: treat it like Ctrl-C so
+    # in-flight requests finish and the session driver joins cleanly
+    import signal
+
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -111,7 +111,9 @@ class DataParallelPagedEngine:
 
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0,
-                 stop: list[str] | None = None, on_progress=None) -> list[str]:
+                 stop: list[str] | None = None,
+                 top_k: int = 0, top_p: float = 1.0,
+                 on_progress=None) -> list[str]:
         if not prompts:
             return []
         stop = stop or []
@@ -163,8 +165,9 @@ class DataParallelPagedEngine:
                         reqs[seq] = _Request(
                             index=i, ids=ids, max_new=max_new_tokens,
                             scanner=StopScanner(eng.tokenizer, stop),
-                            temp=float(temperature), notify=notify,
-                            key=keys[i])
+                            temp=float(temperature),
+                            top_k=int(top_k), top_p=float(top_p),
+                            notify=notify, key=keys[i])
                     if not reqs:
                         break
                     eng._drive_tick(reqs, st)

@@ -44,7 +44,7 @@ from ...models import (
     load_checkpoint,
     prefill,
 )
-from .sampling import sample_token
+from .sampling import filter_logits, sample_token
 from .tokenizer import HFTokenizer
 
 __all__ = ["TPUEngine", "StopScanner"]
@@ -233,7 +233,8 @@ class TPUEngine:
         self._jit_prefill = sp_prefill or jax.jit(
             partial(prefill, cfg=cfg, logits_mode="last"))
         self._jit_decode_chunk = jax.jit(
-            partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
+            partial(self._decode_chunk, cfg=cfg),
+            static_argnames=("steps", "filtered"),
             donate_argnames=("cache",),
         )
 
@@ -273,12 +274,18 @@ class TPUEngine:
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
     def _decode_chunk(params, first_token, pad_len, cache: KVCache, start_pos,
-                      temperature, key, *, cfg: ModelConfig, steps: int):
-        """Run ``steps`` decode iterations; returns sampled tokens [B, steps]."""
+                      temperature, key, top_k=None, top_p=None, *,
+                      cfg: ModelConfig, steps: int, filtered: bool = False):
+        """Run ``steps`` decode iterations; returns sampled tokens [B, steps].
+
+        ``filtered`` (static) compiles the top-k/top-p logits filter into
+        the chunk; the default program carries no [B, V] sort."""
 
         def body(carry, _):
             token, cache, pos, key = carry
             logits, cache = decode_step(params, cfg, token, pad_len, cache, pos)
+            if filtered:
+                logits = filter_logits(logits, top_k, top_p, temperature)
             key, sub = jax.random.split(key)
             nxt = sample_token(logits, temperature, sub)
             return (nxt[:, None], cache, pos + 1, key), nxt
@@ -326,8 +333,12 @@ class TPUEngine:
 
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
-                 temperature: float = 0.0, stop: list[str] | None = None) -> list[str]:
-        """Generate completions for every prompt (any count); order preserved."""
+                 temperature: float = 0.0, stop: list[str] | None = None,
+                 top_k: int = 0, top_p: float = 1.0) -> list[str]:
+        """Generate completions for every prompt (any count); order
+        preserved.  ``top_k``/``top_p`` filter the sampling distribution
+        (0 / 1.0 = off — the defaults compile no filter into the chunk
+        program)."""
         if not prompts:
             return []
         stop = stop or []
@@ -338,7 +349,8 @@ class TPUEngine:
             for start in range(0, len(order), self.batch_size):
                 batch_idx = order[start:start + self.batch_size]
                 batch_ids = [ids[i] for i in batch_idx]
-                texts = self._generate_batch(batch_ids, max_new_tokens, temperature, stop)
+                texts = self._generate_batch(batch_ids, max_new_tokens, temperature, stop,
+                                             top_k=top_k, top_p=top_p)
                 for i, text in zip(batch_idx, texts):
                     out[i] = text
         return out  # type: ignore[return-value]
@@ -353,8 +365,12 @@ class TPUEngine:
         return np.asarray(arr)
 
     def _generate_batch(self, batch_ids: list[list[int]], max_new_tokens: int,
-                        temperature: float, stop: list[str]) -> list[str]:
+                        temperature: float, stop: list[str],
+                        top_k: int = 0, top_p: float = 1.0) -> list[str]:
         n_real = len(batch_ids)
+        filtered = top_k > 0 or top_p < 1.0
+        kf = np.full(self.batch_size, top_k, np.int32)
+        pf = np.full(self.batch_size, top_p, np.float32)
         b = self.batch_size
         pad_id = self.tokenizer.pad_id
         # clip overlong prompts from the left, keeping room to generate
@@ -383,7 +399,11 @@ class TPUEngine:
         with jax.profiler.TraceAnnotation("reval.prefill"):
             logits, cache = self._jit_prefill(
                 self.params, tokens=dev_tokens, pad_len=dev_pad, cache=cache)
-            first = sample_token(logits[:, 0, :], np.float32(temperature),
+            first_logits = logits[:, 0, :]
+            if filtered:
+                first_logits = filter_logits(first_logits, kf, pf,
+                                             np.float32(temperature))
+            first = sample_token(first_logits, np.float32(temperature),
                                  self._next_key())
         jax.block_until_ready(first)
         self.stats.prefill_seconds += time.perf_counter() - t0
@@ -408,7 +428,8 @@ class TPUEngine:
             with jax.profiler.TraceAnnotation("reval.decode_chunk"):
                 toks, cache, token = self._jit_decode_chunk(
                     self.params, token, dev_pad, cache, pos,
-                    np.float32(temperature), self._next_key(), steps=steps)
+                    np.float32(temperature), self._next_key(), kf, pf,
+                    steps=steps, filtered=filtered)
             pos = pos + steps
             chunk_host = self._host_read(toks)
             generated = np.concatenate([generated, chunk_host], axis=1)

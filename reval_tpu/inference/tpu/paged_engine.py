@@ -50,7 +50,7 @@ from .engine import (
     pow2_bucket,
     profile_trace,
 )
-from .sampling import sample_token_rows
+from .sampling import filter_logits, sample_token_rows
 from .tokenizer import HFTokenizer
 
 __all__ = ["PagedTPUEngine"]
@@ -91,6 +91,8 @@ class _Request:
     generated: list[int] = field(default_factory=list)
     done: bool = False
     temp: float = 0.0            # per-request sampling temperature
+    top_k: int = 0               # per-request top-k filter (0 = off)
+    top_p: float = 1.0           # per-request nucleus filter (1 = off)
     notify: object = None        # optional callable(req): progress hook
     #: raw uint32[2] PRNG key; token ``p`` samples from fold_in(key, p),
     #: so the stream survives preemption, chunk re-partitioning, and
@@ -118,8 +120,10 @@ class _DriveState:
     active: dict[int, int]       # slot -> seq_id
     slot_token: np.ndarray       # [B, 1] pending input token per slot
     slot_temp: np.ndarray        # [B] per-slot sampling temperature
+    slot_topk: np.ndarray = None  # [B] per-slot top-k (0 = off)
+    slot_topp: np.ndarray = None  # [B] per-slot top-p (1 = off)
     dev_state: object = None     # packed [B, span+2] device array
-    dev_temp: object = None      # [B] float32 device array
+    dev_samp: object = None      # [B, 3] float32 (temp, top_p, top_k)
     spec_dev: dict | None = None  # speculative-path device carry
     dirty: bool = True
     span: int = 0
@@ -195,7 +199,8 @@ class PagedTPUEngine:
         self._prefix_len = 0          # tokens covered by the shared prefix
         self._prefix_ctx = None       # its KVCache [L, 1, Tpre, H_kv, D]
         self._jit_chunk = jax.jit(
-            partial(self._decode_chunk, cfg=cfg), static_argnames=("steps",),
+            partial(self._decode_chunk, cfg=cfg),
+            static_argnames=("steps", "filtered"),
             donate_argnames=("cache",))
         self._jit_spec = jax.jit(
             partial(self._spec_chunk, cfg=cfg),
@@ -243,8 +248,8 @@ class PagedTPUEngine:
 
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
-    def _decode_chunk(params, state, cache, temperature,
-                      *, cfg: ModelConfig, steps: int):
+    def _decode_chunk(params, state, cache, sampling,
+                      *, cfg: ModelConfig, steps: int, filtered: bool = False):
         """``steps`` paged decode iterations for the whole slot batch.
 
         ``state`` packs the whole per-chunk loop state into ONE int32
@@ -267,10 +272,15 @@ class PagedTPUEngine:
                                             jnp.uint32)
         gen_pos = state[:, span + 4]
 
+        temperature = sampling[:, 0]
+
         def body(carry, _):
             token, cache, lens, pos = carry
             logits, cache = paged_decode_step(params, cfg, token, block_tables,
                                               lens, cache)
+            if filtered:    # static: default chunks carry no [B, V] sort
+                logits = filter_logits(logits, sampling[:, 2].astype(jnp.int32),
+                                       sampling[:, 1], temperature)
             row_keys = jax.vmap(jax.random.fold_in)(keys, pos)
             nxt = sample_token_rows(logits, temperature, row_keys)
             return (nxt[:, None], cache, lens + 1, pos + 1), nxt
@@ -336,6 +346,7 @@ class PagedTPUEngine:
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None,
+                 top_k: int = 0, top_p: float = 1.0,
                  on_progress=None) -> list[str]:
         """``on_progress(index, text)``: streaming hook, called at every
         decode-chunk boundary with the prompt's index and its finalised
@@ -368,8 +379,9 @@ class PagedTPUEngine:
                     seq_id = self.rt.submit(len(ids), max_new_tokens)
                 reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens,
                                         scanner=StopScanner(self.tokenizer, stop),
-                                        temp=float(temperature), notify=notify,
-                                        key=keys[i])
+                                        temp=float(temperature),
+                                        top_k=int(top_k), top_p=float(top_p),
+                                        notify=notify, key=keys[i])
 
             with profile_trace():
                 self._drive(reqs)
@@ -437,7 +449,9 @@ class PagedTPUEngine:
     def new_drive_state(self) -> _DriveState:
         return _DriveState(active={},
                            slot_token=np.zeros((self.max_slots, 1), np.int32),
-                           slot_temp=np.zeros(self.max_slots, np.float32))
+                           slot_temp=np.zeros(self.max_slots, np.float32),
+                           slot_topk=np.zeros(self.max_slots, np.int32),
+                           slot_topp=np.ones(self.max_slots, np.float32))
 
     def _release_shared_prefix(self, prefix_id: int | None) -> None:
         """Tear down one call's shared-prefix state (the counterpart of
@@ -484,6 +498,8 @@ class PagedTPUEngine:
                 req.generated.append(firsts[slot])
                 st.slot_token[slot] = firsts[slot]
                 st.slot_temp[slot] = req.temp
+                st.slot_topk[slot] = req.top_k
+                st.slot_topp[slot] = req.top_p
                 st.active[slot] = seq_id
                 if self._finished(req, [firsts[slot]]):
                     self._retire(req, seq_id, slot, st.active)
@@ -567,14 +583,18 @@ class PagedTPUEngine:
                 [tables, lens[:, None], st.slot_token.astype(np.int32),
                  keyarr.view(np.int32), posarr[:, None]], axis=1)
             st.dev_state = self._dev(jnp.asarray(packed))
-            st.dev_temp = self._dev(jnp.asarray(st.slot_temp))
+            samp = np.stack([st.slot_temp, st.slot_topp,
+                             st.slot_topk.astype(np.float32)], axis=1)
+            st.dev_samp = self._dev(jnp.asarray(samp))
             st.spec_dev = None            # spec-path carry now stale
             st.dirty = False
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
+            filtered = bool((st.slot_topk[list(st.active)] > 0).any()
+                            or (st.slot_topp[list(st.active)] < 1.0).any())
             toks, self.cache, st.dev_state = self._jit_chunk(
-                self.params, st.dev_state, self.cache, st.dev_temp,
-                steps=steps)
+                self.params, st.dev_state, self.cache, st.dev_samp,
+                steps=steps, filtered=filtered)
         toks_host = np.asarray(toks)
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.generated_tokens += steps * len(st.active)
@@ -745,6 +765,8 @@ class PagedTPUEngine:
         pad_len = np.full(rows, t, np.int32)        # dummy rows: all pad
         tables = np.zeros((rows, n_pg), np.int32)   # dummy rows: trash
         temps = np.zeros(rows, np.float32)          # dummy rows: greedy
+        topks = np.zeros(rows, np.int32)
+        topps = np.ones(rows, np.float32)
         keys = np.zeros((rows, 2), np.uint32)
         poss = np.zeros(rows, np.int32)
         for row, (seq_id, _) in enumerate(group):
@@ -753,6 +775,8 @@ class PagedTPUEngine:
             tokens[row, t - len(ids):] = ids
             pad_len[row] = t - len(ids)
             temps[row] = req.temp
+            topks[row] = req.top_k
+            topps[row] = req.top_p
             keys[row] = req.key
             poss[row] = len(req.generated)   # resume continues the stream
             # own pages sit after the shared-prefix pages in the table
@@ -775,7 +799,13 @@ class PagedTPUEngine:
                                           self._dev(jnp.asarray(tables)))
         row_keys = jax.vmap(jax.random.fold_in)(
             self._dev(jnp.asarray(keys)), self._dev(jnp.asarray(poss)))
-        first = sample_token_rows(logits[:, 0, :],
+        first_logits = logits[:, 0, :]
+        if (topks > 0).any() or (topps < 1.0).any():
+            first_logits = filter_logits(first_logits,
+                                         self._dev(jnp.asarray(topks)),
+                                         self._dev(jnp.asarray(topps)),
+                                         self._dev(jnp.asarray(temps)))
+        first = sample_token_rows(first_logits,
                                   self._dev(jnp.asarray(temps)), row_keys)
         first_host = np.asarray(first)
         for row, (_, slot) in enumerate(group):

@@ -3,6 +3,11 @@
 Greedy and temperature sampling are computed unconditionally and selected
 with ``where`` — both are trivial next to the model step, and it keeps the
 decode step free of data-dependent control flow (XLA requirement).
+
+Top-k / nucleus (top-p) filtering is available as :func:`filter_logits`.
+Engines keep it OUT of the compiled program unless some request in the
+batch asks for it (a static jit flag): the filter needs a [B, V] sort
+every step, and the defaults (top_p=1, top_k=off) must cost nothing.
 """
 
 from __future__ import annotations
@@ -10,7 +15,52 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_token", "sample_token_rows"]
+__all__ = ["filter_logits", "sample_token", "sample_token_rows"]
+
+_NEG_INF = -1e30
+
+
+def filter_logits(logits: jnp.ndarray, top_k: jnp.ndarray,
+                  top_p: jnp.ndarray,
+                  temperature: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Mask logits outside the top-k set and the top-p nucleus.
+
+    logits [B, V]; top_k [B] int32 (<=0 = off); top_p [B] float32
+    (>=1 = off); temperature [B] or scalar (the nucleus is computed over
+    the TEMPERATURE-SCALED distribution — vLLM/HF order: temperature,
+    then top-k, then top-p over the renormalized survivors; the token
+    crossing the ``top_p`` threshold is kept).  Returns the ORIGINAL
+    logits with masked entries at -1e30, so downstream sampling divides
+    by temperature exactly once.
+    """
+    v = logits.shape[-1]
+    scaled = logits
+    if temperature is not None:
+        temp = jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
+        if temp.ndim == 1:
+            temp = temp[:, None]
+        scaled = logits / temp
+    order = jnp.argsort(-scaled, axis=-1)              # descending
+    sorted_scaled = jnp.take_along_axis(scaled, order, axis=-1)
+    # rank of each vocab entry in the sorted order: scatter iota
+    ranks = jnp.zeros_like(order).at[
+        jnp.arange(logits.shape[0])[:, None], order].set(
+        jnp.arange(v, dtype=order.dtype)[None, :])
+    k = jnp.where(top_k <= 0, v, top_k).astype(jnp.int32)
+    keep = ranks < k[:, None]
+    # nucleus mass over the distribution RENORMALIZED after top-k: mask
+    # the beyond-k sorted tail before the softmax
+    kept_sorted = jnp.where(jnp.arange(v)[None, :] < k[:, None],
+                            sorted_scaled, _NEG_INF)
+    probs = jax.nn.softmax(kept_sorted, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p = jnp.where(top_p >= 1.0, jnp.inf, top_p)
+    # keep sorted positions whose PREVIOUS cumulative mass is < p (the
+    # crossing token stays); position 0 always stays
+    sorted_keep = jnp.concatenate(
+        [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < p[:, None]], axis=-1)
+    keep &= jnp.take_along_axis(sorted_keep, ranks, axis=-1)
+    return jnp.where(keep, logits, _NEG_INF)
 
 
 def _gumbel_select(logits: jnp.ndarray, temperature: jnp.ndarray,

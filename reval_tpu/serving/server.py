@@ -113,18 +113,23 @@ class EngineServer:
                         stop = [stop]
                     max_tokens = int(req.get("max_tokens", 256))
                     temperature = float(req.get("temperature", 0.0))
+                    top_k = int(req.get("top_k", 0))        # 0 = off
+                    top_p = float(req.get("top_p", 1.0))    # 1 = off
                     stream = bool(req.get("stream", False))
                 except Exception as exc:        # malformed request → client error
                     self._send(400, {"error": str(exc)})
                     return
+                sampling = ({"top_k": top_k, "top_p": top_p}
+                            if (top_k > 0 or top_p < 1.0) else {})
                 if stream:
-                    self._stream(prompts, max_tokens, temperature, stop)
+                    self._stream(prompts, max_tokens, temperature, stop,
+                                 **sampling)
                     return
                 try:
                     with outer._lock:
                         texts = outer.generate_fn(
                             prompts, max_tokens=max_tokens,
-                            temperature=temperature, stop=stop)
+                            temperature=temperature, stop=stop, **sampling)
                 except Exception as exc:        # engine/device fault → server error
                     self._send(500, {"error": str(exc)})
                     return
@@ -135,7 +140,8 @@ class EngineServer:
                                 for i, t in enumerate(texts)],
                 })
 
-            def _stream(self, prompts, max_tokens, temperature, stop) -> None:
+            def _stream(self, prompts, max_tokens, temperature, stop,
+                        **sampling) -> None:
                 """SSE streaming: one delta event per decode chunk.
 
                 Single-writer design: the engine runs on a worker thread
@@ -154,9 +160,10 @@ class EngineServer:
 
                 def run() -> None:
                     try:
-                        kwargs = ({"on_progress":
-                                   lambda i, t: q.put((i, t, None))}
-                                  if outer._streams else {})
+                        kwargs = dict(sampling)
+                        if outer._streams:
+                            kwargs["on_progress"] = (
+                                lambda i, t: q.put((i, t, None)))
                         with outer._lock:
                             texts = outer.generate_fn(
                                 prompts, max_tokens=max_tokens,
@@ -250,12 +257,15 @@ def _engine_generate_fn(engine):
 
     streams = "on_progress" in inspect.signature(engine.generate).parameters
 
-    def generate(prompts, *, max_tokens, temperature, stop, on_progress=None):
+    def generate(prompts, *, max_tokens, temperature, stop,
+                 top_k=0, top_p=1.0, on_progress=None):
         kwargs = {}
         if on_progress is not None and streams:
             # engines without the hook (static) fall back to a buffered
             # result, still delivered over the SSE framing
             kwargs["on_progress"] = on_progress
+        if top_k > 0 or top_p < 1.0:
+            kwargs.update(top_k=top_k, top_p=top_p)
         return engine.generate(prompts, max_new_tokens=max_tokens,
                                temperature=temperature, stop=stop, **kwargs)
     return generate
@@ -278,6 +288,14 @@ def warmup_engine(engine) -> float:
     for prompt in ("pass", "pass\n" * 300):
         engine.generate([prompt], max_new_tokens=40, temperature=0.0,
                         stop=["[/ANSWER]"])
+    # the top-k/top-p filter is a DISTINCT jitted chunk program (static
+    # flag): compile it too, or the first nucleus request stalls the
+    # live batch for the full jit cost despite this warmup
+    try:
+        engine.generate(["pass"], max_new_tokens=40, temperature=0.8,
+                        top_p=0.95, stop=["[/ANSWER]"])
+    except TypeError:
+        pass        # static/pp/sp engines without the filter path
     return time.perf_counter() - t0
 
 

@@ -89,9 +89,10 @@ def _generate_fn_for(submitter):
     """EngineServer ``generate_fn`` over any ``submit(...) -> _Pending``
     owner (single session or replica set) — pass ``serialize=False``."""
     def generate(prompts, *, max_tokens, temperature, stop,
-                 on_progress=None):
+                 top_k=0, top_p=1.0, on_progress=None):
         return submitter.submit(prompts, max_new_tokens=max_tokens,
                                 temperature=temperature, stop=stop,
+                                top_k=top_k, top_p=top_p,
                                 on_progress=on_progress).result()
     return generate
 
@@ -103,6 +104,8 @@ class _Submission:
     temperature: float
     stop: list[str]
     on_progress: object
+    top_k: int = 0
+    top_p: float = 1.0
     pending: _Pending = field(init=False)
 
     def __post_init__(self):
@@ -136,13 +139,15 @@ class ContinuousSession:
     # -- caller side -------------------------------------------------------
     def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
                temperature: float = 0.0, stop: list[str] | None = None,
+               top_k: int = 0, top_p: float = 1.0,
                on_progress=None) -> _Pending:
         """Enqueue a prompt batch; returns a handle whose ``result()``
         blocks until all its prompts finish.  ``on_progress(index, text)``
         streams finalised-so-far text at decode-chunk granularity (same
         contract as ``PagedTPUEngine.generate``)."""
         sub = _Submission(list(prompts), max_new_tokens, float(temperature),
-                          list(stop or []), on_progress)
+                          list(stop or []), on_progress,
+                          top_k=int(top_k), top_p=float(top_p))
         if not sub.prompts:
             sub.pending._fire()
             return sub.pending
@@ -306,7 +311,8 @@ class ContinuousSession:
             reqs[seq_id] = _Request(
                 index=pos, ids=ids, max_new=sub.max_new,
                 scanner=StopScanner(eng.tokenizer, sub.stop),
-                temp=sub.temperature, notify=notify, key=keys[pos])
+                temp=sub.temperature, top_k=sub.top_k, top_p=sub.top_p,
+                notify=notify, key=keys[pos])
             origin[seq_id] = (sub, pos)
 
 
@@ -337,6 +343,7 @@ class MultiSession:
 
     def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
                temperature: float = 0.0, stop: list[str] | None = None,
+               top_k: int = 0, top_p: float = 1.0,
                on_progress=None) -> _Pending:
         n = len(prompts)
         with self._lock:
@@ -350,7 +357,8 @@ class MultiSession:
         try:
             pending = self.sessions[i].submit(
                 prompts, max_new_tokens=max_new_tokens,
-                temperature=temperature, stop=stop, on_progress=on_progress)
+                temperature=temperature, stop=stop, top_k=top_k, top_p=top_p,
+                on_progress=on_progress)
         except Exception:
             release()                   # closed session etc.: no leak
             raise
