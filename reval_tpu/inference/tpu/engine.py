@@ -368,7 +368,9 @@ class TPUEngine:
                         temperature: float, stop: list[str],
                         top_k: int = 0, top_p: float = 1.0) -> list[str]:
         n_real = len(batch_ids)
-        filtered = top_k > 0 or top_p < 1.0
+        # greedy (temp 0) never needs the filter: masking can't change
+        # the argmax, and the filtered program pays a [B, V] sort per step
+        filtered = (top_k > 0 or top_p < 1.0) and temperature > 0
         kf = np.full(self.batch_size, top_k, np.int32)
         pf = np.full(self.batch_size, top_p, np.float32)
         b = self.batch_size

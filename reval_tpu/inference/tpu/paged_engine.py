@@ -590,8 +590,13 @@ class PagedTPUEngine:
             st.dirty = False
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
-            filtered = bool((st.slot_topk[list(st.active)] > 0).any()
-                            or (st.slot_topp[list(st.active)] < 1.0).any())
+            # filtering can never change an argmax, so greedy rows
+            # (temp 0) don't justify the filtered program's per-step
+            # [B, V] sort even when they carry top_k/top_p values
+            rows = list(st.active)
+            filtered = bool(((st.slot_topk[rows] > 0)
+                             | (st.slot_topp[rows] < 1.0))
+                            [st.slot_temp[rows] > 0].any())
             toks, self.cache, st.dev_state = self._jit_chunk(
                 self.params, st.dev_state, self.cache, st.dev_samp,
                 steps=steps, filtered=filtered)

@@ -69,7 +69,8 @@ class PipelinedTPUEngine(TPUEngine):
             pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro))
         self._jit_decode_chunk = jax.jit(
             partial(self._pp_decode_chunk, cfg=cfg, mesh=mesh),
-            static_argnames=("steps",), donate_argnames=("cache",))
+            static_argnames=("steps", "filtered"),
+            donate_argnames=("cache",))
 
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
@@ -108,7 +109,9 @@ class PipelinedTPUEngine(TPUEngine):
 
     @staticmethod
     def _pp_decode_chunk(params, first_token, pad_len, cache, start_pos,
-                         temperature, key, *, cfg, mesh, steps: int):
+                         temperature, key, top_k=None, top_p=None, *,
+                         cfg, mesh, steps: int, filtered: bool = False):
         return pipeline_decode_chunk(
             params, cfg, first_token, pad_len, cache, start_pos,
-            temperature, key, mesh, steps=steps)
+            temperature, key, mesh, steps=steps,
+            top_k=top_k, top_p=top_p, filtered=filtered)

@@ -191,7 +191,10 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
 def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
                           pad_len: jnp.ndarray, cache: KVCache,
                           start_pos: jnp.ndarray, temperature, key,
-                          mesh: Mesh, *, steps: int):
+                          mesh: Mesh, *, steps: int,
+                          top_k: jnp.ndarray | None = None,
+                          top_p: jnp.ndarray | None = None,
+                          filtered: bool = False):
     """Token-ring decode: ``steps`` tokens for every row of [B, 1]
     ``first_token`` (engine-chunk contract: returns ``(toks [B, steps],
     cache, last [B, 1])``).
@@ -199,10 +202,15 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
     ``M = P`` microbatches circulate; the last stage samples microbatch
     ``m``'s next token, embeds it, and the ring permute hands it straight
     back to stage 0 one tick later — zero steady-state bubble.
+
+    ``filtered`` (static) compiles the top-k/nucleus logits filter into
+    the last stage's sampling; ``top_k``/``top_p`` are per-row [B]
+    arrays (ignored when ``filtered`` is False, so default chunks carry
+    no [mb, V] sort).
     """
     # function-local so ``reval_tpu.parallel`` (a models-layer dependency)
     # never imports the inference package at module load
-    from ..inference.tpu.sampling import sample_token
+    from ..inference.tpu.sampling import filter_logits, sample_token
 
     pp = pp_size(mesh)
     b = first_token.shape[0]
@@ -214,11 +222,17 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
     emb_first = _embed(params, cfg, first_token)       # [B, 1, D]
     hm = emb_first.reshape(pp, mb, 1, emb_first.shape[-1])
     padm = pad_len.reshape(pp, mb)
+    if top_k is None:
+        top_k = jnp.zeros((b,), jnp.int32)
+    if top_p is None:
+        top_p = jnp.ones((b,), jnp.float32)
+    kfm = jnp.asarray(top_k, jnp.int32).reshape(pp, mb)
+    pfm = jnp.asarray(top_p, jnp.float32).reshape(pp, mb)
     layers = params["layers"]
     top = {k: v for k, v in params.items() if k != "layers"}
     wins = cfg.layer_windows_array()
 
-    def staged(layers, wins, top, hm, padm, ck, cv):
+    def staged(layers, wins, top, hm, padm, kfm, pfm, ck, cv):
         stage = lax.axis_index("pp")
         lp = jax.tree_util.tree_leaves(layers)[0].shape[0]
         s_max = ck.shape[2]
@@ -269,6 +283,10 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
                 hf = _norm(h_out[:, 0, :], top["final_norm_w"],
                            top.get("final_norm_b"), cfg)
                 logits = _unembed(top, cfg, hf)
+                if filtered:   # static: default chunks carry no [mb, V] sort
+                    kfj = lax.dynamic_index_in_dim(kfm, m, 0, keepdims=False)
+                    pfj = lax.dynamic_index_in_dim(pfm, m, 0, keepdims=False)
+                    logits = filter_logits(logits, kfj, pfj, temperature)
                 tok = sample_token(logits, temperature,
                                    jax.random.fold_in(key, nc))
                 return tok.astype(jnp.int32), _embed(
@@ -293,9 +311,10 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
 
     tokbuf, ck, cv = jax.shard_map(
         staged, mesh=mesh, axis_names={"pp"},
-        in_specs=(P("pp"), P("pp"), P(), P(), P(), P("pp"), P("pp")),
+        in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P(), P("pp"),
+                  P("pp")),
         out_specs=(P(), P("pp"), P("pp")),
-    )(layers, wins, top, hm, padm, cache.k, cache.v)
+    )(layers, wins, top, hm, padm, kfm, pfm, cache.k, cache.v)
 
     # tokbuf flat index n = j*P + m holds step j of microbatch m
     toks = tokbuf.reshape(steps, pp, mb).transpose(1, 2, 0).reshape(b, steps)

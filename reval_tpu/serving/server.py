@@ -120,7 +120,8 @@ class EngineServer:
                     self._send(400, {"error": str(exc)})
                     return
                 sampling = ({"top_k": top_k, "top_p": top_p}
-                            if (top_k > 0 or top_p < 1.0) else {})
+                            if (top_k > 0 or top_p < 1.0)
+                            and temperature > 0 else {})
                 if stream:
                     self._stream(prompts, max_tokens, temperature, stop,
                                  **sampling)
@@ -290,12 +291,14 @@ def warmup_engine(engine) -> float:
                         stop=["[/ANSWER]"])
     # the top-k/top-p filter is a DISTINCT jitted chunk program (static
     # flag): compile it too, or the first nucleus request stalls the
-    # live batch for the full jit cost despite this warmup
-    try:
+    # live batch for the full jit cost despite this warmup.  Detect
+    # filter support by signature (not try/except TypeError, which would
+    # also swallow real plumbing bugs inside a supporting engine).
+    import inspect
+
+    if "top_p" in inspect.signature(engine.generate).parameters:
         engine.generate(["pass"], max_new_tokens=40, temperature=0.8,
                         top_p=0.95, stop=["[/ANSWER]"])
-    except TypeError:
-        pass        # static/pp/sp engines without the filter path
     return time.perf_counter() - t0
 
 

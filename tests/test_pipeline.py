@@ -163,3 +163,21 @@ def test_pipelined_engine_with_tp_axis():
                              mesh=mesh)
     got = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
     assert got == want
+
+
+def test_pipelined_engine_filtered_sampling():
+    """top_k=1 at temperature>0 must reproduce greedy: proves the
+    top-k/nucleus filter is actually compiled into the last ring stage
+    (and that the kwarg plumbing through _pp_decode_chunk holds — a
+    missing `filtered` static broke the whole pp path once)."""
+    cfg = small_cfg(vocab_size=ByteTokenizer.vocab_size + 61)
+    params = init_random_params(cfg, seed=2, dtype="float32")
+    tok = ByteTokenizer()
+    prompts = ["def add(a, b):", "x = 1\ny =", "assert add(", "print("]
+
+    mesh = make_mesh(pp=2)
+    eng = PipelinedTPUEngine(params, cfg, tok, batch_size=4, max_seq_len=128,
+                             mesh=mesh)
+    greedy = eng.generate(prompts, max_new_tokens=12, temperature=0.0)
+    got = eng.generate(prompts, max_new_tokens=12, temperature=1.7, top_k=1)
+    assert got == greedy

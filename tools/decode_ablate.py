@@ -68,7 +68,24 @@ def main() -> None:
     print(f"device: {dev.device_kind} | model {args.model} {args.dtype} | "
           f"slots={args.slots} ctx={args.ctx} steps={args.steps}")
 
-    def run_variant(label: str, kv_dtype: str = "", no_attn: bool = False):
+    # Bare dispatch round-trip: a trivial jitted op, timed like a chunk
+    # (dispatch + block).  On the tunneled chip this IS the per-chunk RPC
+    # floor — it separates host/tunnel latency from on-device work.
+    tiny_f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    jax.block_until_ready(tiny_f(x))
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(tiny_f(x))
+        rtts.append(time.perf_counter() - t0)
+    rtt_ms = statistics.median(rtts) * 1000
+    print(f"bare jit dispatch round-trip: {rtt_ms:.3f} ms "
+          f"(amortised per step at chunk={args.steps}: {rtt_ms/args.steps:.3f} ms)")
+
+    def run_variant(label: str, kv_dtype: str = "", no_attn: bool = False,
+                    steps: int | None = None):
+        steps = args.steps if steps is None else steps
         orig = paged_mod.paged_decode_attention
         if no_attn:
             # signature-agnostic identity: the kernel's kwargs evolve
@@ -78,7 +95,7 @@ def main() -> None:
 
             page = 128
             # budget covers warm-up + every timed rep (lens advances each)
-            need = (args.ctx + args.steps * (args.reps + 1)) // page + 2
+            need = (args.ctx + steps * (args.reps + 1)) // page + 2
             num_pages = 1 + args.slots * need
             eng = PagedTPUEngine(params, cfg, ByteTokenizer(),
                                  max_slots=args.slots, page_size=page,
@@ -99,23 +116,27 @@ def main() -> None:
             state = jnp.asarray(
                 np.concatenate([tables, lens[:, None], tok,
                                 keys.view(np.int32), pos], axis=1))
-            temp = jnp.zeros((b,), jnp.float32)
+            # sampling params ride a [B, 3] stack (temp | top_p | top_k)
+            # since the per-request top-k/nucleus change
+            temp = jnp.asarray(np.stack(
+                [np.zeros(b, np.float32), np.ones(b, np.float32),
+                 np.zeros(b, np.float32)], axis=1))
 
             cache = eng.cache
             # warm compile
             toks, cache, state2 = eng._jit_chunk(eng.params, state, cache,
-                                                 temp, steps=args.steps)
+                                                 temp, steps=steps)
             jax.block_until_ready(toks)
             times = []
             st = state2
             for _ in range(args.reps):
                 t0 = time.perf_counter()
                 toks, cache, st = eng._jit_chunk(eng.params, st, cache,
-                                                 temp, steps=args.steps)
+                                                 temp, steps=steps)
                 jax.block_until_ready(toks)
                 times.append(time.perf_counter() - t0)
             eng.close()
-            ms_step = statistics.median(times) / args.steps * 1000
+            ms_step = statistics.median(times) / steps * 1000
             print(f"{label:10s} {ms_step:8.3f} ms/step  "
                   f"{args.slots / ms_step * 1000:8.0f} tok/s")
             return ms_step
@@ -125,6 +146,13 @@ def main() -> None:
     full = run_variant("full")
     noattn = run_variant("no-attn", no_attn=True)
     kv8 = run_variant("kv-int8", kv_dtype="int8")
+
+    # chunk-length sweep: per-chunk dispatch/RPC overhead shows up as the
+    # per-step cost falling with longer chunks; on-device inefficiency
+    # does not amortise away
+    for s in (8, 64):
+        if s != args.steps:
+            run_variant(f"full@{s}", steps=s)
 
     # roofline: weight bytes + kv bytes per step at device bandwidth
     wbytes = sum(x.size * x.dtype.itemsize
