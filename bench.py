@@ -96,6 +96,15 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj))
 
 
+def note(msg: str) -> None:
+    """Phase marker on stderr (stdout carries ONLY the final JSON line).
+
+    A wedged-tunnel bench looks identical to a slow compile from outside;
+    these markers make `tail bench.err` name the phase it died in."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
 def fail(metric: str, error: str, detail: str = "") -> None:
     out = {"metric": metric, "value": 0.0, "unit": "probes/s/chip",
            "vs_baseline": 0.0, "error": error}
@@ -243,9 +252,11 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
     # pair, so a reduced warmup would leave XLA compiles inside the timed
     # region on a cold compile cache
+    note("  paged warmup pass (compiles land here)")
     eng.generate(prompts, max_new_tokens=max_new,
                  temperature=0.0, stop=["[/ANSWER]"])
     eng.stats = EngineStats()
+    note("  paged timed pass")
     t0 = time.perf_counter()
     outs = eng.generate(prompts, max_new_tokens=max_new, temperature=0.0,
                         stop=["[/ANSWER]"])
@@ -352,6 +363,7 @@ def main() -> None:
               f"({shape}, {args.mode}, {max_new} new tok, "
               f"{tok_label} prompts)")
 
+    note('pre-flight device probe')
     health, probe_error = probe_devices(force_cpu=args.tiny)
     if health is None:
         if probe_error == "timeout":
@@ -372,6 +384,7 @@ def main() -> None:
                           os.path.expanduser("~/.cache/reval_tpu_xla"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+        note(f'devices ok ({health[1]}); building prompts')
         prompts = build_prompts(args.prompts, args.mode)
         tok = hf_tok[0] if hf_tok else TrainedBPE(prompts)
         params, cfg = flagship(tiny=args.tiny, model=args.model,
@@ -412,6 +425,8 @@ def main() -> None:
             per_seq = min(per_seq, args.max_seq_len // page)
             num_pages = 1 + args.slots * per_seq + 16
         spec_k = 4 if args.spec else 0
+        note(f'params ready ({args.dtype}); paged warmup+run '
+             f'(slots={args.slots}, pages={num_pages})')
         wall, stats = run_paged(params, cfg, tok, prompts, max_new,
                                 prefix_sharing=True, max_slots=args.slots,
                                 max_seq_len=args.max_seq_len,
@@ -446,6 +461,7 @@ def main() -> None:
                 stats.spec_accepted / max(1, stats.spec_rounds * spec_k), 3)
 
         if not args.skip_ab:
+            note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); prefix-sharing A/B')
             wall_nopre, _ = run_paged(params, cfg, tok, prompts, max_new,
                                       prefix_sharing=False,
                                       max_slots=args.slots,
@@ -457,6 +473,7 @@ def main() -> None:
         vs_baseline = 0.0
         if not args.skip_serial:
             sp = prompts[: args.serial_prompts]
+            note(f'serial baseline ({len(sp)} prompts, batch 1)')
             serial_s, _ = run_serial(params, cfg, tok, sp, max_new)
             serial_per_sec = len(sp) / serial_s / chips_used
             extras["serial_probes_per_sec"] = round(serial_per_sec, 4)

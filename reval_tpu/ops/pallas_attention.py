@@ -55,6 +55,7 @@ __all__ = [
     "paged_decode_attention",
     "paged_decode_attention_xla",
     "paged_decode_attention_pallas",
+    "paged_decode_attention_pallas_seq",
 ]
 
 _NEG_INF = -1e30
@@ -205,6 +206,172 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
     )(block_tables, seq_lens, *operands)
 
 
+def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
+                       *rest, page_size: int, scale: float,
+                       window: int | None, softcap: float | None,
+                       h_kv: int, g: int, quantized: bool):
+    """One grid step = one WHOLE sequence: a double-buffered in-kernel
+    page loop replaces the per-(sequence, page) grid of
+    ``_decode_kernel``.
+
+    Why: at decode shapes the per-page work is a handful of [G, D]x[D, P]
+    matvecs (~1-3 us) — the same order as TPU grid-step overhead, so the
+    page-granular grid pays ~50% overhead (measured 1442 tok/s vs ~4000
+    tok/s HBM roofline at the bench shape, PERF.md).  Here the grid is
+    just [B]; the kernel walks the sequence's live pages with
+    ``make_async_copy`` HBM→VMEM fetches two pages deep, so page p+1
+    streams in while page p computes — the hand-rolled version of the
+    pipelining BlockSpec index_maps gave the old kernel, minus the
+    dead-step overhead."""
+    if quantized:
+        ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, sem = rest
+    else:
+        o_ref, k_buf, v_buf, sem = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
+    b = pl.program_id(0)
+    seq_len = seq_lens_ref[b]
+    n_live = (seq_len + page_size - 1) // page_size
+    if window is not None:
+        p0 = jnp.maximum((seq_len - window) // page_size, 0)
+    else:
+        p0 = jnp.int32(0)
+
+    def dmas(slot, p):
+        page = block_tables_ref[b, p]
+        out = [
+            pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot],
+                                  sem.at[slot, 0]),
+            pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot],
+                                  sem.at[slot, 1]),
+        ]
+        if quantized:
+            out += [
+                pltpu.make_async_copy(ks_hbm.at[page], ks_buf.at[slot],
+                                      sem.at[slot, 2]),
+                pltpu.make_async_copy(vs_hbm.at[page], vs_buf.at[slot],
+                                      sem.at[slot, 3]),
+            ]
+        return out
+
+    for d in dmas(p0 % 2, p0):
+        d.start()
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = p % 2
+
+        @pl.when(p + 1 < n_live)
+        def _prefetch():
+            for d in dmas((p + 1) % 2, p + 1):
+                d.start()
+
+        for d in dmas(slot, p):
+            d.wait()
+
+        cols = jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        pos = p * page_size + cols                     # [1, P]
+        valid = pos < seq_len
+        if window is not None:
+            valid = valid & (pos >= seq_len - window)
+
+        for h in range(h_kv):
+            q = q_ref[0, h * g:(h + 1) * g].astype(jnp.float32)    # [G, D]
+            k = k_buf[slot, :, h].astype(jnp.float32)              # [P, D]
+            v = v_buf[slot, :, h].astype(jnp.float32)
+            if quantized:
+                k = k * ks_buf[slot, :, h][:, None]
+                v = v * vs_buf[slot, :, h][:, None]
+            s = jax.lax.dot_general(                               # [G, P]
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = _softcap(s, softcap)
+            s = jnp.where(valid, s, _NEG_INF)
+
+            rows = slice(h * g, (h + 1) * g)
+            m_prev = m[rows]                              # [G, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(s - m_new)                    # [G, P]
+            l = l.at[rows].set(alpha * l[rows]
+                               + probs.sum(axis=-1, keepdims=True))
+            acc = acc.at[rows].set(acc[rows] * alpha + jnp.dot(
+                probs, v, preferred_element_type=jnp.float32))
+            m = m.at[rows].set(m_new)
+        return m, l, acc
+
+    h = h_kv * g
+    m0 = jnp.full((h, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc0 = jnp.zeros((h, q_ref.shape[2]), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(p0, n_live, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
+                              "softcap"))
+def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
+                                      seq_lens, *, page_size: int,
+                                      scale: float | None = None,
+                                      interpret: bool = False,
+                                      window: int | None = None,
+                                      softcap: float | None = None,
+                                      k_scales=None, v_scales=None):
+    """Per-sequence paged decode attention (see ``_decode_kernel_seq``).
+
+    Same contract as :func:`paged_decode_attention_pallas`; the pools stay
+    in HBM (``memory_space=ANY``) and the kernel streams live pages only.
+    """
+    b, h, d = q.shape
+    h_kv = k_pages.shape[1]
+    g = h // h_kv
+    quantized = k_scales is not None
+    scale = float(scale if scale is not None else d ** -0.5)
+    kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
+    vp = v_pages.reshape(-1, page_size, h_kv, d)
+
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda b_, bt, sl: (b_, 0, 0)),
+        any_spec, any_spec,
+    ]
+    operands = [q, kp, vp]
+    scratch = [
+        pltpu.VMEM((2, page_size, h_kv, d), k_pages.dtype),
+        pltpu.VMEM((2, page_size, h_kv, d), v_pages.dtype),
+    ]
+    n_sems = 2
+    if quantized:
+        in_specs += [any_spec, any_spec]
+        operands += [k_scales.reshape(-1, page_size, h_kv),
+                     v_scales.reshape(-1, page_size, h_kv)]
+        scratch += [pltpu.VMEM((2, page_size, h_kv), jnp.float32),
+                    pltpu.VMEM((2, page_size, h_kv), jnp.float32)]
+        n_sems = 4
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_sems)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, d), lambda b_, bt, sl: (b_, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(_decode_kernel_seq, page_size=page_size,
+                               scale=scale, window=window, softcap=softcap,
+                               h_kv=h_kv, g=g, quantized=quantized)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(block_tables, seq_lens, *operands)
+
+
 def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
                                *, page_size: int, scale: float | None = None,
                                window: int | None = None,
@@ -255,15 +422,27 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     """Backend-dispatching paged decode attention: Pallas on TPU, XLA
     elsewhere (same numerics; the kernel is tested against the XLA path).
 
-    ``REVAL_TPU_PAGED_BACKEND=pallas|xla`` overrides the choice — the XLA
-    gather formulation is sometimes preferable (and is what CPU uses).
+    ``REVAL_TPU_PAGED_BACKEND=pallas|pallas_seq|xla`` overrides — the XLA
+    gather formulation is what CPU uses; ``pallas_seq`` selects the
+    per-sequence streaming kernel (pending on-chip A/B before it becomes
+    the TPU default).
     """
     import os
 
     choice = os.environ.get("REVAL_TPU_PAGED_BACKEND")
-    use_pallas = (choice == "pallas" if choice
-                  else jax.default_backend() == "tpu")
-    fn = paged_decode_attention_pallas if use_pallas else paged_decode_attention_xla
+    if choice == "pallas_seq":
+        fn = paged_decode_attention_pallas_seq
+    else:
+        use_pallas = (choice == "pallas" if choice
+                      else jax.default_backend() == "tpu")
+        fn = (paged_decode_attention_pallas if use_pallas
+              else paged_decode_attention_xla)
+    kw = {}
+    if fn is not paged_decode_attention_xla:
+        # an explicitly-chosen Pallas kernel off-TPU runs in interpret
+        # mode: slow, but it lets the whole engine path execute the real
+        # kernel on CPU (end-to-end validation without a chip)
+        kw["interpret"] = jax.default_backend() != "tpu"
     return fn(q, k_pages, v_pages, block_tables, seq_lens,
               page_size=page_size, scale=scale, window=window,
-              softcap=softcap, k_scales=k_scales, v_scales=v_scales)
+              softcap=softcap, k_scales=k_scales, v_scales=v_scales, **kw)

@@ -176,10 +176,14 @@ class TestSoftcapKernelParity:
         vp = jnp.asarray(rng.standard_normal((npages * page, hk, d)), jnp.float32)
         tables = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
         lens = jnp.asarray([13, 21], jnp.int32)
+        from reval_tpu.ops.pallas_attention import (
+            paged_decode_attention_pallas_seq)
+
         want = paged_decode_attention_xla(q, kp, vp, tables, lens,
                                           page_size=page, softcap=50.0)
-        got = paged_decode_attention_pallas(q, kp, vp, tables, lens,
-                                            page_size=page, softcap=50.0,
-                                            interpret=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   atol=1e-5, rtol=1e-5)
+        for kernel in (paged_decode_attention_pallas,
+                       paged_decode_attention_pallas_seq):
+            got = kernel(q, kp, vp, tables, lens, page_size=page,
+                         softcap=50.0, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, rtol=1e-5)

@@ -17,8 +17,12 @@ from reval_tpu.models.paged import (
 )
 from reval_tpu.ops.pallas_attention import (
     paged_decode_attention_pallas,
+    paged_decode_attention_pallas_seq,
     paged_decode_attention_xla,
 )
+
+KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_seq]
+KERNEL_IDS = ["page-grid", "per-seq"]
 
 PAGE = 128
 
@@ -71,26 +75,25 @@ def test_quantized_xla_matches_dequantized_float():
                                rtol=0.1, atol=0.05)
 
 
-def test_quantized_pallas_matches_xla():
+@pytest.mark.parametrize("kernel", KERNELS, ids=KERNEL_IDS)
+def test_quantized_pallas_matches_xla(kernel):
     q, kf, vf, kq, ks, vq, vs, tables, lens = make_quantized_paged(seed=1)
     ref = paged_decode_attention_xla(q, kq, vq, tables, lens, page_size=PAGE,
                                      k_scales=ks, v_scales=vs)
-    got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
-                                        page_size=PAGE, interpret=True,
-                                        k_scales=ks, v_scales=vs)
+    got = kernel(q, kq, vq, tables, lens, page_size=PAGE, interpret=True,
+                 k_scales=ks, v_scales=vs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
 
 @pytest.mark.parametrize("window", [64, 200])
-def test_quantized_windowed_pallas_matches_xla(window):
+@pytest.mark.parametrize("kernel", KERNELS, ids=KERNEL_IDS)
+def test_quantized_windowed_pallas_matches_xla(kernel, window):
     q, kf, vf, kq, ks, vq, vs, tables, lens = make_quantized_paged(seed=2)
     ref = paged_decode_attention_xla(q, kq, vq, tables, lens, page_size=PAGE,
                                      window=window, k_scales=ks, v_scales=vs)
-    got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
-                                        page_size=PAGE, interpret=True,
-                                        window=window, k_scales=ks,
-                                        v_scales=vs)
+    got = kernel(q, kq, vq, tables, lens, page_size=PAGE, interpret=True,
+                 window=window, k_scales=ks, v_scales=vs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 

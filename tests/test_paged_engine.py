@@ -289,3 +289,21 @@ class TestScheduleIndependentSampling:
         got = dpp.generate(PROMPTS, max_new_tokens=16, temperature=0.8)
         dpp.close()
         assert got == want
+
+
+def test_seq_kernel_engine_parity(tiny, monkeypatch):
+    """The per-sequence streaming Pallas kernel, driven through the WHOLE
+    paged engine (interpret mode on CPU), generates token-identically to
+    the XLA-attention engine — the end-to-end guard for flipping
+    REVAL_TPU_PAGED_BACKEND=pallas_seq on the chip."""
+    cfg, params = tiny
+    want_eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                              max_seq_len=512, num_pages=12)
+    want = want_eng.generate(PROMPTS[:3], max_new_tokens=8, temperature=0.0)
+    want_eng.close()
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas_seq")
+    got_eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                             max_seq_len=512, num_pages=12)
+    got = got_eng.generate(PROMPTS[:3], max_new_tokens=8, temperature=0.0)
+    got_eng.close()
+    assert got == want

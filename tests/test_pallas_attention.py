@@ -15,8 +15,14 @@ pytestmark = pytest.mark.slow  # noqa: E402
 from reval_tpu.ops.attention import decode_attention
 from reval_tpu.ops.pallas_attention import (
     paged_decode_attention_pallas,
+    paged_decode_attention_pallas_seq,
     paged_decode_attention_xla,
 )
+
+# both TPU kernels must match the XLA oracle bit-for-bit in interpret mode:
+# the per-(seq, page) grid kernel and the per-sequence streaming kernel
+KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_seq]
+KERNEL_IDS = ["page-grid", "per-seq"]
 
 PAGE = 128
 
@@ -46,20 +52,20 @@ def make_paged(seed=0, b=4, h=8, h_kv=4, d=128, n_pages=16, max_pages=3,
     return q, k_pages, v_pages, tables, seq_lens
 
 
-def test_pallas_kernel_matches_xla_reference():
+@pytest.mark.parametrize("kernel", KERNELS, ids=KERNEL_IDS)
+def test_pallas_kernel_matches_xla_reference(kernel):
     q, kp, vp, tables, lens = make_paged()
     ref = paged_decode_attention_xla(q, kp, vp, tables, lens, page_size=PAGE)
-    out = paged_decode_attention_pallas(q, kp, vp, tables, lens,
-                                        page_size=PAGE, interpret=True)
+    out = kernel(q, kp, vp, tables, lens, page_size=PAGE, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_pallas_kernel_mha_single_group():
+@pytest.mark.parametrize("kernel", KERNELS, ids=KERNEL_IDS)
+def test_pallas_kernel_mha_single_group(kernel):
     q, kp, vp, tables, lens = make_paged(seed=1, h=4, h_kv=4)  # G == 1
     ref = paged_decode_attention_xla(q, kp, vp, tables, lens, page_size=PAGE)
-    out = paged_decode_attention_pallas(q, kp, vp, tables, lens,
-                                        page_size=PAGE, interpret=True)
+    out = kernel(q, kp, vp, tables, lens, page_size=PAGE, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
@@ -107,20 +113,21 @@ def test_padding_pages_never_leak():
                                      page_size=PAGE)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                rtol=1e-6, atol=1e-6)
-    out_p = paged_decode_attention_pallas(q, poisoned, vp, tables, lens,
-                                          page_size=PAGE, interpret=True)
-    np.testing.assert_allclose(np.asarray(out_p), np.asarray(base),
-                               rtol=1e-5, atol=1e-5)
+    for kernel in KERNELS:
+        out_p = kernel(q, poisoned, vp, tables, lens,
+                       page_size=PAGE, interpret=True)
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(base),
+                                   rtol=1e-5, atol=1e-5)
 
 
 @pytest.mark.parametrize("window", [1, 64, 200, 1000])
-def test_windowed_pallas_matches_xla(window):
+@pytest.mark.parametrize("kernel", KERNELS, ids=KERNEL_IDS)
+def test_windowed_pallas_matches_xla(kernel, window):
     q, kp, vp, tables, lens = make_paged(seed=4)
     ref = paged_decode_attention_xla(q, kp, vp, tables, lens,
                                      page_size=PAGE, window=window)
-    out = paged_decode_attention_pallas(q, kp, vp, tables, lens,
-                                        page_size=PAGE, interpret=True,
-                                        window=window)
+    out = kernel(q, kp, vp, tables, lens, page_size=PAGE, interpret=True,
+                 window=window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 

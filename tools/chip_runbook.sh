@@ -21,10 +21,23 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 log() { echo "$(date +%Y-%m-%dT%H:%M:%S) $*" >> $R/runbook.log; }
 
+probe_alive() {
+  timeout 45 python -c "
+import jax
+assert jax.devices()[0].platform == 'tpu'
+" > /dev/null 2>&1
+}
+
 # run <artifact> <timeout_s> <json|txt> <cmd...>
 run() {
   local name=$1 to=$2 kind=$3; shift 3
   [ -s "$R/$name" ] && { log "skip $name (done)"; return 0; }
+  # the tunnel wedges mid-pass: without this gate every remaining step
+  # burns its full timeout against a dead chip before dying
+  if ! probe_alive; then
+    log "abort pass before $name (tunnel wedged)"
+    exit 2
+  fi
   log "start $name: $*"
   timeout "$to" "$@" > "$R/$name.tmp" 2> "$R/$name.err"
   local rc=$?
@@ -42,6 +55,10 @@ run() {
   return $rc
 }
 
+# cheapest high-value artifact first: a short tunnel window must still
+# capture a post-round-3 paged decode number (serial baseline is stable
+# across rounds; the full official bench follows)
+run bench_quick.json       1200 json python bench.py --skip-serial --skip-ab --prompts 32
 run bench_direct.json      2400 json python bench.py
 run ablate.txt             1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600
 run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
@@ -49,6 +66,7 @@ run bench_cot.json         3600 json python bench.py --mode cot
 run bench_cot_kv8.json     3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
 run fleet.json             2400 json python tools/fleet_bench.py
 run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
+run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
 run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
 run bench_cot_spec.json    3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
 run ablate_int8.txt        1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8

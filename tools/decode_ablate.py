@@ -84,16 +84,19 @@ def main() -> None:
           f"(amortised per step at chunk={args.steps}: {rtt_ms/args.steps:.3f} ms)")
 
     def run_variant(label: str, kv_dtype: str = "", no_attn: bool = False,
-                    steps: int | None = None):
+                    steps: int | None = None, page: int = 128,
+                    backend: str | None = None):
         steps = args.steps if steps is None else steps
         orig = paged_mod.paged_decode_attention
+        orig_backend = os.environ.get("REVAL_TPU_PAGED_BACKEND")
         if no_attn:
             # signature-agnostic identity: the kernel's kwargs evolve
             paged_mod.paged_decode_attention = lambda q, *a, **kw: q
+        if backend:
+            os.environ["REVAL_TPU_PAGED_BACKEND"] = backend
         try:
             from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
 
-            page = 128
             # budget covers warm-up + every timed rep (lens advances each)
             need = (args.ctx + steps * (args.reps + 1)) // page + 2
             num_pages = 1 + args.slots * need
@@ -142,6 +145,10 @@ def main() -> None:
             return ms_step
         finally:
             paged_mod.paged_decode_attention = orig
+            if orig_backend is None:
+                os.environ.pop("REVAL_TPU_PAGED_BACKEND", None)
+            else:
+                os.environ["REVAL_TPU_PAGED_BACKEND"] = orig_backend
 
     full = run_variant("full")
     noattn = run_variant("no-attn", no_attn=True)
@@ -153,6 +160,19 @@ def main() -> None:
     for s in (8, 64):
         if s != args.steps:
             run_variant(f"full@{s}", steps=s)
+
+    # page-size sweep: the kernel runs one sequential grid step per
+    # (sequence, page) per layer — bigger pages halve the grid-step count
+    # at the cost of pool fragmentation; if this moves the needle the
+    # bottleneck is grid overhead, not DMA bandwidth
+    run_variant("page=256", page=256)
+    run_variant("page=512", page=512)
+
+    # the per-sequence streaming kernel (ops/pallas_attention.py
+    # _decode_kernel_seq): grid [B] + in-kernel double-buffered page DMA
+    # vs the per-(seq, page) grid of the default kernel
+    run_variant("seq-kernel", backend="pallas_seq")
+    run_variant("seqk-kv8", backend="pallas_seq", kv_dtype="int8")
 
     # roofline: weight bytes + kv bytes per step at device bandwidth
     wbytes = sum(x.size * x.dtype.itemsize
