@@ -61,6 +61,54 @@ __all__ = [
 _NEG_INF = -1e30
 
 
+def _page_scores(q, k, scale, softcap, valid, h_kv: int, g: int):
+    """Masked attention scores for one page, ALL heads in one dot.
+
+    q: [H, D] f32; k: [P, H_kv, D] f32 (already dequantized);
+    valid: [1, P] bool.  Returns s: [H, P] f32.
+
+    One batched ``dot_general`` over the kv-head dim replaces the per-head
+    matvec loop: at decode shapes the per-head ops are ~sub-µs each and
+    their fixed issue overhead — not bandwidth — dominated the measured
+    step time (23.6 ms vs a 8 ms roofline, tpu_watch r4 ablation), so the
+    kernel's job is to touch the page with as FEW ops as possible.
+    """
+    q3 = q.reshape(h_kv, g, q.shape[-1])                   # [H_kv, G, D]
+    s = jax.lax.dot_general(                               # [H_kv, G, P]
+        q3, k, (((2,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s.reshape(h_kv * g, -1)                            # [H, P]
+    s = _softcap(s, softcap)                 # gemma-2 score softcapping
+    return jnp.where(valid, s, _NEG_INF)
+
+
+def _page_values(probs, v, h_kv: int, g: int):
+    """probs: [H, P] f32, v: [P, H_kv, D] f32 → weighted values [H, D]."""
+    p3 = probs.reshape(h_kv, g, probs.shape[-1])           # [H_kv, G, P]
+    out = jax.lax.dot_general(                             # [H_kv, G, D]
+        p3, v, (((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(h_kv * g, v.shape[-1])              # [H, D]
+
+
+def _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv: int, g: int):
+    """Fold one page's scores/values into the online-softmax scratch.
+
+    s: [H, P] masked scores; v: [P, H_kv, D] dequantized values.
+    m_ref/l_ref are lane-replicated [H, 128]; acc_ref is [H, D]."""
+    m_prev = m_ref[:, :1]                         # [H, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)               # rescale old sums
+    probs = jnp.exp(s - m_new)                    # [H, P]
+    l_new = alpha * l_ref[:, :1] + probs.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + _page_values(probs, v, h_kv, g)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
 def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
                    *rest, page_size: int, scale: float, max_pages: int,
                    window: int | None, softcap: float | None,
@@ -96,33 +144,14 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
         valid = pos < seq_len
         if window is not None:
             valid = valid & (pos >= seq_len - window)
-        # one page for all heads: static loop over kv heads, each a
-        # [G, D] x [D, P] matmul (batched matvec has no 2D-matmul form)
-        for h in range(h_kv):
-            q = q_ref[0, h * g:(h + 1) * g].astype(jnp.float32)    # [G, D]
-            k = k_ref[0, :, h].astype(jnp.float32)                 # [P, D]
-            v = v_ref[0, :, h].astype(jnp.float32)                 # [P, D]
-            if ks_ref is not None:
-                k = k * ks_ref[0, :, h][:, None]
-                v = v * vs_ref[0, :, h][:, None]
-            s = jax.lax.dot_general(                               # [G, P]
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            s = _softcap(s, softcap)             # gemma-2 score softcapping
-            s = jnp.where(valid, s, _NEG_INF)
-
-            rows = slice(h * g, (h + 1) * g)
-            m_prev = m_ref[rows, :1]                      # [G, 1]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)    # [G, 1]
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)               # rescale old sums
-            probs = jnp.exp(s - m_new)                    # [G, P]
-            l_new = alpha * l_ref[rows, :1] + probs.sum(axis=-1, keepdims=True)
-            acc_ref[rows, :] = acc_ref[rows, :] * alpha + jnp.dot(
-                probs, v, preferred_element_type=jnp.float32)
-            m_ref[rows, :] = jnp.broadcast_to(m_new, (g, m_ref.shape[1]))
-            l_ref[rows, :] = jnp.broadcast_to(l_new, (g, l_ref.shape[1]))
+        q = q_ref[0].astype(jnp.float32)                       # [H, D]
+        k = k_ref[0].astype(jnp.float32)                       # [P, H_kv, D]
+        v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0][:, :, None]
+            v = v * vs_ref[0][:, :, None]
+        s = _page_scores(q, k, scale, softcap, valid, h_kv, g)  # [H, P]
+        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g)
 
     @pl.when(p == max_pages - 1)
     def _finalize():
@@ -214,19 +243,25 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
     page loop replaces the per-(sequence, page) grid of
     ``_decode_kernel``.
 
-    Why: at decode shapes the per-page work is a handful of [G, D]x[D, P]
-    matvecs (~1-3 us) — the same order as TPU grid-step overhead, so the
-    page-granular grid pays ~50% overhead (measured 1442 tok/s vs ~4000
-    tok/s HBM roofline at the bench shape, PERF.md).  Here the grid is
-    just [B]; the kernel walks the sequence's live pages with
+    Why: at decode shapes a page's compute is ~1 µs — the same order as
+    TPU grid-step overhead, and most of the [B, max_pages] grid's steps
+    are DEAD (table span vs ~5 live pages at the bench shape).  Here the
+    grid is just [B]; the kernel walks the sequence's live pages with
     ``make_async_copy`` HBM→VMEM fetches two pages deep, so page p+1
     streams in while page p computes — the hand-rolled version of the
     pipelining BlockSpec index_maps gave the old kernel, minus the
-    dead-step overhead."""
+    dead-step overhead.
+
+    The flash accumulators (m, l, acc) live in VMEM *scratch refs*
+    mutated by the loop body — loop-carried arrays updated with
+    ``.at[].set`` lower to ``scatter``, which Mosaic has no TPU lowering
+    for (found the hard way: the r3 version of this kernel only ever ran
+    in CPU interpret mode and died on the first real-chip compile)."""
     if quantized:
-        ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, sem = rest
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, sem,
+         m_ref, l_ref, acc_ref) = rest
     else:
-        o_ref, k_buf, v_buf, sem = rest
+        o_ref, k_buf, v_buf, sem, m_ref, l_ref, acc_ref = rest
         ks_hbm = vs_hbm = ks_buf = vs_buf = None
     b = pl.program_id(0)
     seq_len = seq_lens_ref[b]
@@ -256,8 +291,11 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
     for d in dmas(p0 % 2, p0):
         d.start()
 
+    m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
     def body(p, carry):
-        m, l, acc = carry
         slot = p % 2
 
         @pl.when(p + 1 < n_live)
@@ -274,39 +312,18 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
         if window is not None:
             valid = valid & (pos >= seq_len - window)
 
-        for h in range(h_kv):
-            q = q_ref[0, h * g:(h + 1) * g].astype(jnp.float32)    # [G, D]
-            k = k_buf[slot, :, h].astype(jnp.float32)              # [P, D]
-            v = v_buf[slot, :, h].astype(jnp.float32)
-            if quantized:
-                k = k * ks_buf[slot, :, h][:, None]
-                v = v * vs_buf[slot, :, h][:, None]
-            s = jax.lax.dot_general(                               # [G, P]
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            s = _softcap(s, softcap)
-            s = jnp.where(valid, s, _NEG_INF)
+        q = q_ref[0].astype(jnp.float32)                       # [H, D]
+        k = k_buf[slot].astype(jnp.float32)                    # [P, H_kv, D]
+        v = v_buf[slot].astype(jnp.float32)
+        if quantized:
+            k = k * ks_buf[slot][:, :, None]
+            v = v * vs_buf[slot][:, :, None]
+        s = _page_scores(q, k, scale, softcap, valid, h_kv, g)  # [H, P]
+        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g)
+        return carry
 
-            rows = slice(h * g, (h + 1) * g)
-            m_prev = m[rows]                              # [G, 1]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            probs = jnp.exp(s - m_new)                    # [G, P]
-            l = l.at[rows].set(alpha * l[rows]
-                               + probs.sum(axis=-1, keepdims=True))
-            acc = acc.at[rows].set(acc[rows] * alpha + jnp.dot(
-                probs, v, preferred_element_type=jnp.float32))
-            m = m.at[rows].set(m_new)
-        return m, l, acc
-
-    h = h_kv * g
-    m0 = jnp.full((h, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((h, 1), jnp.float32)
-    acc0 = jnp.zeros((h, q_ref.shape[2]), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(p0, n_live, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    jax.lax.fori_loop(p0, n_live, body, 0)
+    o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -351,6 +368,11 @@ def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
                     pltpu.VMEM((2, page_size, h_kv), jnp.float32)]
         n_sems = 4
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sems)))
+    scratch += [
+        pltpu.VMEM((h, 128), jnp.float32),   # running max (lane-replicated)
+        pltpu.VMEM((h, 128), jnp.float32),   # running denominator
+        pltpu.VMEM((h, d), jnp.float32),     # output accumulator
+    ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -430,6 +452,11 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     import os
 
     choice = os.environ.get("REVAL_TPU_PAGED_BACKEND")
+    if choice not in (None, "", "pallas", "pallas_seq", "xla"):
+        # a typo here would silently bench the wrong backend under the
+        # right label — fail loudly instead
+        raise ValueError(f"unknown REVAL_TPU_PAGED_BACKEND {choice!r}; "
+                         "expected pallas | pallas_seq | xla")
     if choice == "pallas_seq":
         fn = paged_decode_attention_pallas_seq
     else:

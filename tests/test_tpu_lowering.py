@@ -1,0 +1,76 @@
+"""Chip-free TPU lowering checks for every Pallas kernel.
+
+Interpret-mode tests (test_pallas_attention.py etc.) validate kernel
+*numerics* but never exercise the Pallas→Mosaic lowering pass, so a
+kernel can be bit-exact on CPU and still die on its first real-chip
+compile — the round-3 seq kernel did exactly that (``.at[].set`` on a
+loop-carried array lowers to ``scatter``, which Mosaic's TPU lowering
+rejects; found only when the tunnel came back in round 4).
+
+``jax.export`` with ``platforms=["tpu"]`` runs that lowering pass on any
+host: the Mosaic primitive-support layer that threw on the chip throws
+here too (verified: the round-3 seq kernel fails this test with the
+same error).  Every Pallas kernel must have a case here for each
+structurally distinct configuration (dtype, quantized scales, GQA vs
+MHA, window/softcap) — shapes can be small; lowering cares about
+structure, not size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from reval_tpu.ops.pallas_attention import (
+    paged_decode_attention_pallas,
+    paged_decode_attention_pallas_seq,
+)
+
+B, P, NPAGES, SPAN, D = 4, 128, 24, 6, 128
+
+KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_seq]
+
+
+def _export_tpu(fn, *args):
+    jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+def _operands(h, h_kv, store_dtype=jnp.bfloat16):
+    q = jnp.zeros((B, h, D), jnp.bfloat16)
+    kp = jnp.zeros((NPAGES * P, h_kv, D), store_dtype)
+    bt = jnp.zeros((B, SPAN), jnp.int32)
+    sl = jnp.ones((B,), jnp.int32)
+    return q, kp, bt, sl
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4), (8, 1)])
+def test_lowers_bf16(kernel, h, h_kv):
+    q, kp, bt, sl = _operands(h, h_kv)
+
+    def f(q, kp, vp, bt, sl):
+        return kernel(q, kp, vp, bt, sl, page_size=P)
+
+    _export_tpu(f, q, kp, kp, bt, sl)
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_lowers_int8_pool(kernel):
+    q, kp, bt, sl = _operands(16, 16, jnp.int8)
+    scales = jnp.ones((NPAGES * P, 16), jnp.float32)
+
+    def f(q, kp, vp, bt, sl, ks, vs):
+        return kernel(q, kp, vp, bt, sl, page_size=P, k_scales=ks, v_scales=vs)
+
+    _export_tpu(f, q, kp, kp, bt, sl, scales, scales)
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_lowers_window_softcap(kernel):
+    q, kp, bt, sl = _operands(16, 4)
+
+    def f(q, kp, vp, bt, sl):
+        return kernel(q, kp, vp, bt, sl, page_size=P, window=256, softcap=30.0)
+
+    _export_tpu(f, q, kp, kp, bt, sl)

@@ -1,18 +1,15 @@
 #!/bin/bash
-# Round-3 on-chip measurement suite (VERDICT items 1, 2, 3, 7 + the int8
-# default-dtype decision).  Idempotent: each step skips itself once its
-# artifact exists, so repeated invocations (the tpu_watch loop calls this
-# every time the tunnel is up) resume where the last window ended.
+# Round-4 on-chip measurement suite.  Idempotent: each step skips itself
+# once its artifact exists, so repeated invocations (the tpu_watch loop
+# calls this every time the tunnel is up) resume where the last window
+# ended.
 #
-# Artifacts land in tpu_watch/:
-#   bench_direct.json        official flagship number (BENCH_r03 candidate)
-#   ablate.txt               decode-roofline ablation (VERDICT item 2)
-#   bench_direct_int8.json   weight-dtype A/B (round-2 pending decision)
-#   bench_cot.json           CoT shape baseline (VERDICT item 3)
-#   bench_cot_kv8.json       CoT + int8 KV pages A/B (VERDICT item 3)
-#   fleet.json               4-task fusion demo (VERDICT item 7)
-#   bench_direct_int4.json   int4 weight A/B
-#   ablate_int8.txt          ablation with int8 weights
+# Round-4 state: the r3-kernel baselines live in tpu_watch/r3k_*.  The
+# attention kernel was rewritten after the r4 ablation showed in-kernel
+# per-head op count (not bandwidth) dominating (r3k_ablate_partial.txt:
+# full 23.6 ms/step vs no-attn 7.6 ms vs ~8 ms roofline), so every
+# artifact here re-measures on the batched-head kernels; kernel_ab runs
+# FIRST because it decides the default backend (grid vs seq).
 cd /root/repo || exit 1
 mkdir -p tpu_watch
 R=tpu_watch
@@ -55,19 +52,22 @@ run() {
   return $rc
 }
 
-# cheapest high-value artifact first: a short tunnel window must still
-# capture a post-round-3 paged decode number (serial baseline is stable
-# across rounds; the full official bench follows)
-run bench_quick.json       1200 json python bench.py --skip-serial --skip-ab --prompts 32
-run bench_direct.json      2400 json python bench.py
-run ablate.txt             1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600
-run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
-run bench_cot.json         3600 json python bench.py --mode cot
-run bench_cot_kv8.json     3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
-run fleet.json             2400 json python tools/fleet_bench.py
-run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
+# 1. decide the kernel default: attention-only A/B, ~3 min
+run kernel_ab.txt         900 txt  python tools/kernel_bench.py --slots 32 --ctx 600
+# 2. cheapest full-pipeline number on the new kernel
+run bench_quick.json     1200 json python bench.py --skip-serial --skip-ab --prompts 32
+# 3. localise what remains of the decode gap (seq-kernel variants now work)
+run ablate.txt           1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600
+# 4. official numbers
+run bench_direct.json    2400 json python bench.py
 run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
+run bench_cot.json       3600 json python bench.py --mode cot
+# 5. dtype / feature A-Bs on the new kernel
+run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
+run bench_cot_kv8.json   3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
+run fleet.json           2400 json python tools/fleet_bench.py
+run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
 run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
-run bench_cot_spec.json    3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
-run ablate_int8.txt        1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8
+run bench_cot_spec.json  3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
+run ablate_int8.txt      1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8
 log "runbook pass complete"

@@ -1,0 +1,127 @@
+"""Standalone paged-attention kernel A/B at the bench decode shape.
+
+Times ONLY the attention kernel (not the full decode step) for each
+backend × pool-dtype combination, at the flagship bench shape, plus the
+XLA gather formulation as a sanity floor.  Runs in ~2 minutes on a chip
+— small enough to fit a short tunnel window and decide the default
+backend (``REVAL_TPU_PAGED_BACKEND``) from data.
+
+    python tools/kernel_bench.py --slots 32 --ctx 600 --layers 24
+
+``--layers`` repeats the kernel per timed iteration to amortise
+dispatch the way a real decode step does (one call per layer).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--slots", type=int, default=32)
+    ap.add_argument("--ctx", type=int, default=600)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--page", type=int, default=128)
+    ap.add_argument("--span", type=int, default=16, help="block-table span")
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--tiny", action="store_true", help="CPU smoke")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+        args.slots, args.ctx, args.layers, args.span = 2, 96, 2, 3
+
+    from reval_tpu.ops import pallas_attention as pa
+
+    b, h, h_kv, d, p = (args.slots, args.heads, args.kv_heads,
+                        args.head_dim, args.page)
+    need = (args.ctx + p - 1) // p + 1
+    # the table must span every live page or the kernels read garbage ids
+    args.span = max(args.span, need)
+    n_pages = 1 + b * need
+    rng = np.random.default_rng(0)
+
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((n_pages * p, h_kv, d)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((n_pages * p, h_kv, d)), jnp.bfloat16)
+    kp8 = (kp * 16).astype(jnp.int8)
+    vp8 = (vp * 16).astype(jnp.int8)
+    ks = jnp.full((n_pages * p, h_kv), 1 / 16, jnp.float32)
+    tables = np.zeros((b, args.span), np.int32)
+    for s in range(b):
+        for j in range(need):
+            tables[s, j] = 1 + s * need + j
+    tables = jnp.asarray(tables)
+    lens = jnp.full((b,), args.ctx, jnp.int32)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} | B={b} H={h}/{h_kv} D={d} "
+          f"ctx={args.ctx} page={p} span={args.span} layers={args.layers}")
+
+    interp = jax.default_backend() != "tpu"
+
+    ok_count = 0
+
+    def variant(label, fn, k, v, scales=False):
+        nonlocal ok_count
+        kw = dict(page_size=p)
+        if scales:
+            kw.update(k_scales=ks, v_scales=ks)
+        if fn is not pa.paged_decode_attention_xla:
+            kw["interpret"] = interp
+
+        @jax.jit
+        def step(q, k, v, tables, lens):
+            out = q
+            for _ in range(args.layers):
+                out = fn(out, k, v, tables, lens, **kw)
+            return out
+
+        try:
+            jax.block_until_ready(step(q, k, v, tables, lens))  # compile
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(step(q, k, v, tables, lens))
+                times.append(time.perf_counter() - t0)
+            ms = statistics.median(times) * 1000
+            # bytes actually touched: live pages (K+V) per sequence per layer
+            live_pages = (args.ctx + p - 1) // p
+            elt = 1 if scales else 2
+            gb = (2 * b * live_pages * p * h_kv * d * elt * args.layers) / 1e9
+            print(f"{label:14s} {ms:8.3f} ms/step   {gb / (ms / 1000):6.1f} GB/s "
+                  f"effective")
+            ok_count += 1
+        except Exception as e:
+            print(f"{label:14s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+
+    variant("grid", pa.paged_decode_attention_pallas, kp, vp)
+    variant("seq", pa.paged_decode_attention_pallas_seq, kp, vp)
+    variant("grid-int8", pa.paged_decode_attention_pallas, kp8, vp8, scales=True)
+    variant("seq-int8", pa.paged_decode_attention_pallas_seq, kp8, vp8, scales=True)
+    if not args.tiny:
+        variant("xla", pa.paged_decode_attention_xla, kp, vp)
+
+    if ok_count == 0:
+        # nothing measured (wedged tunnel / driver fault): exit nonzero so
+        # the runbook's skip-if-exists logic retries instead of committing
+        # an artifact with zero data points
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
