@@ -61,6 +61,9 @@ run ablate.txt           1800 txt  python tools/decode_ablate.py --slots 32 --ct
 # 4. official numbers
 run bench_direct.json    2400 json python bench.py
 run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
+# int8 pool halves KV reads AND lets 64 slots fit → weight reads amortise
+# over 2x the batch; candidate new default if the A/B wins
+run bench_direct_kv8s64.json 2400 json python bench.py --kv-dtype int8 --slots 64 --skip-serial --skip-ab
 run bench_cot.json       3600 json python bench.py --mode cot
 # 5. dtype / feature A-Bs on the new kernel
 run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab

@@ -85,8 +85,9 @@ def main() -> None:
 
     def run_variant(label: str, kv_dtype: str = "", no_attn: bool = False,
                     steps: int | None = None, page: int = 128,
-                    backend: str | None = None):
+                    backend: str | None = None, slots: int | None = None):
         steps = args.steps if steps is None else steps
+        slots = args.slots if slots is None else slots
         orig = paged_mod.paged_decode_attention
         orig_backend = os.environ.get("REVAL_TPU_PAGED_BACKEND")
         if no_attn:
@@ -99,12 +100,12 @@ def main() -> None:
 
             # budget covers warm-up + every timed rep (lens advances each)
             need = (args.ctx + steps * (args.reps + 1)) // page + 2
-            num_pages = 1 + args.slots * need
+            num_pages = 1 + slots * need
             eng = PagedTPUEngine(params, cfg, ByteTokenizer(),
-                                 max_slots=args.slots, page_size=page,
+                                 max_slots=slots, page_size=page,
                                  max_seq_len=args.max_seq_len,
                                  num_pages=num_pages, kv_dtype=kv_dtype)
-            b = args.slots
+            b = slots
             span = eng.max_pages_per_seq
             tables = np.zeros((b, span), np.int32)
             for s in range(b):
@@ -141,7 +142,7 @@ def main() -> None:
             eng.close()
             ms_step = statistics.median(times) / steps * 1000
             print(f"{label:10s} {ms_step:8.3f} ms/step  "
-                  f"{args.slots / ms_step * 1000:8.0f} tok/s")
+                  f"{b / ms_step * 1000:8.0f} tok/s")
             return ms_step
         finally:
             paged_mod.paged_decode_attention = orig
@@ -173,6 +174,17 @@ def main() -> None:
     # vs the per-(seq, page) grid of the default kernel
     run_variant("seq-kernel", backend="pallas_seq")
     run_variant("seqk-kv8", backend="pallas_seq", kv_dtype="int8")
+
+    # slots sweep: weight reads amortise over the batch, KV reads scale
+    # with it — if no-attn ms/step is ~flat in slots the non-attention
+    # path is weight-bound (raise slots for tok/s); if it scales, the
+    # per-slot work (sampling, scatter, norms) is the next target.
+    # 64-slot pools only fit in HBM as int8 next to the bf16 weights.
+    run_variant("full@s16", slots=16)
+    run_variant("noatt@s16", no_attn=True, slots=16)
+    run_variant("kv8@s64", kv_dtype="int8", slots=64)
+    run_variant("noatt8@s64", no_attn=True, kv_dtype="int8", slots=64)
+    run_variant("seqk8@s64", backend="pallas_seq", kv_dtype="int8", slots=64)
 
     # roofline: weight bytes + kv bytes per step at device bandwidth
     wbytes = sum(x.size * x.dtype.itemsize
