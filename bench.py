@@ -453,6 +453,10 @@ def main() -> None:
             "max_new_tokens": max_new,
             "prefill_tokens": stats.prefill_tokens,
             "generated_tokens": stats.generated_tokens,
+            "prefill_tokens_per_sec": round(
+                stats.prefill_tokens / stats.prefill_seconds, 1)
+                if stats.prefill_seconds else 0.0,
+            "decode_share": round(stats.decode_seconds / wall, 3) if wall else 0.0,
             "wall_seconds": round(wall, 2),
         }
         if args.spec:

@@ -307,3 +307,31 @@ def test_seq_kernel_engine_parity(tiny, monkeypatch):
     got = got_eng.generate(PROMPTS[:3], max_new_tokens=8, temperature=0.0)
     got_eng.close()
     assert got == want
+
+
+def test_wide_slot_count_matches_narrow(tiny):
+    """64-slot engine (the int8-KV bench candidate width) produces the
+    same greedy outputs as a 2-slot engine, oversubscribed 80 prompts —
+    guards the packed-state layout, PRNG fold-in, and native-runtime slot
+    accounting at widths beyond the historical 32-slot shapes.  The float
+    pool compares EXACTLY (one corrupted high slot index must fail);
+    int8-at-width-64 is a separate approximate case because int8 pages
+    round KV values."""
+    cfg, params = tiny
+    prompts = [p + str(i) for i, p in enumerate(PROMPTS * 16)]   # 80
+    narrow = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                            page_size=PAGE, max_seq_len=256)
+    want = narrow.generate(prompts, max_new_tokens=6, temperature=0.0)
+    narrow.close()
+    wide = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=64,
+                          page_size=PAGE, max_seq_len=256)
+    got = wide.generate(prompts, max_new_tokens=6, temperature=0.0)
+    wide.close()
+    assert got == want
+
+    wide8 = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=64,
+                           page_size=PAGE, max_seq_len=256, kv_dtype="int8")
+    got8 = wide8.generate(prompts, max_new_tokens=6, temperature=0.0)
+    wide8.close()
+    agree = sum(a == b for a, b in zip(got8, want))
+    assert agree >= 76, f"only {agree}/80 int8 outputs match the float engine"

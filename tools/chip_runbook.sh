@@ -56,8 +56,9 @@ run() {
 run kernel_ab.txt         900 txt  python tools/kernel_bench.py --slots 32 --ctx 600
 # 2. cheapest full-pipeline number on the new kernel
 run bench_quick.json     1200 json python bench.py --skip-serial --skip-ab --prompts 32
-# 3. localise what remains of the decode gap (seq-kernel variants now work)
-run ablate.txt           1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600
+# 3. localise what remains of the decode gap — decision-critical groups
+#    only (kernel default + slot width); diagnostics ride a later step
+run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
 # 4. official numbers
 run bench_direct.json    2400 json python bench.py
 run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
@@ -72,5 +73,6 @@ run fleet.json           2400 json python tools/fleet_bench.py
 run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
 run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
 run bench_cot_spec.json  3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
-run ablate_int8.txt      1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8
+run ablate2.txt          1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants chunk,page
+run ablate_int8.txt      1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8 --variants core,seq
 log "runbook pass complete"

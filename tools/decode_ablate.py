@@ -38,6 +38,14 @@ def main() -> None:
     ap.add_argument("--model", default="deepseek-coder-1.3b")
     ap.add_argument("--dtype", choices=["bfloat16", "int8"], default="bfloat16")
     ap.add_argument("--max-seq-len", type=int, default=2048)
+    ap.add_argument("--variants", default="core,seq,slots,chunk,page",
+                    help="comma list of variant groups to run, in order: "
+                         "core (full/no-attn/kv-int8), seq (streaming "
+                         "kernel), slots (batch-width sweep), chunk "
+                         "(chunk-length sweep), page (page-size sweep).  "
+                         "Groups run in the order given, so a timeout or "
+                         "tunnel wedge loses the LAST groups — put the "
+                         "decision-critical ones first")
     ap.add_argument("--tiny", action="store_true", help="CPU smoke shape")
     args = ap.parse_args()
 
@@ -151,40 +159,61 @@ def main() -> None:
             else:
                 os.environ["REVAL_TPU_PAGED_BACKEND"] = orig_backend
 
-    full = run_variant("full")
-    noattn = run_variant("no-attn", no_attn=True)
-    kv8 = run_variant("kv-int8", kv_dtype="int8")
+    results = {}
 
-    # chunk-length sweep: per-chunk dispatch/RPC overhead shows up as the
-    # per-step cost falling with longer chunks; on-device inefficiency
-    # does not amortise away
-    for s in (8, 64):
-        if s != args.steps:
-            run_variant(f"full@{s}", steps=s)
+    def group_core():
+        results["full"] = run_variant("full")
+        results["no-attn"] = run_variant("no-attn", no_attn=True)
+        results["kv-int8"] = run_variant("kv-int8", kv_dtype="int8")
 
-    # page-size sweep: the kernel runs one sequential grid step per
-    # (sequence, page) per layer — bigger pages halve the grid-step count
-    # at the cost of pool fragmentation; if this moves the needle the
-    # bottleneck is grid overhead, not DMA bandwidth
-    run_variant("page=256", page=256)
-    run_variant("page=512", page=512)
+    def group_seq():
+        # the per-sequence streaming kernel (ops/pallas_attention.py
+        # _decode_kernel_seq): grid [B] + in-kernel double-buffered page
+        # DMA vs the per-(seq, page) grid of the default kernel
+        run_variant("seq-kernel", backend="pallas_seq")
+        run_variant("seqk-kv8", backend="pallas_seq", kv_dtype="int8")
 
-    # the per-sequence streaming kernel (ops/pallas_attention.py
-    # _decode_kernel_seq): grid [B] + in-kernel double-buffered page DMA
-    # vs the per-(seq, page) grid of the default kernel
-    run_variant("seq-kernel", backend="pallas_seq")
-    run_variant("seqk-kv8", backend="pallas_seq", kv_dtype="int8")
+    def group_slots():
+        # slots sweep: weight reads amortise over the batch, KV reads
+        # scale with it — if no-attn ms/step is ~flat in slots the
+        # non-attention path is weight-bound (raise slots for tok/s); if
+        # it scales, the per-slot work (sampling, scatter, norms) is the
+        # next target.  64-slot pools only fit in HBM as int8 next to
+        # the bf16 weights.
+        run_variant("kv8@s64", kv_dtype="int8", slots=64)
+        run_variant("seqk8@s64", backend="pallas_seq", kv_dtype="int8",
+                    slots=64)
+        run_variant("noatt8@s64", no_attn=True, kv_dtype="int8", slots=64)
+        run_variant("full@s16", slots=16)
+        run_variant("noatt@s16", no_attn=True, slots=16)
 
-    # slots sweep: weight reads amortise over the batch, KV reads scale
-    # with it — if no-attn ms/step is ~flat in slots the non-attention
-    # path is weight-bound (raise slots for tok/s); if it scales, the
-    # per-slot work (sampling, scatter, norms) is the next target.
-    # 64-slot pools only fit in HBM as int8 next to the bf16 weights.
-    run_variant("full@s16", slots=16)
-    run_variant("noatt@s16", no_attn=True, slots=16)
-    run_variant("kv8@s64", kv_dtype="int8", slots=64)
-    run_variant("noatt8@s64", no_attn=True, kv_dtype="int8", slots=64)
-    run_variant("seqk8@s64", backend="pallas_seq", kv_dtype="int8", slots=64)
+    def group_chunk():
+        # chunk-length sweep: per-chunk dispatch/RPC overhead shows up as
+        # the per-step cost falling with longer chunks; on-device
+        # inefficiency does not amortise away
+        for s in (8, 64):
+            if s != args.steps:
+                run_variant(f"full@{s}", steps=s)
+
+    def group_page():
+        # page-size sweep: the default kernel runs one sequential grid
+        # step per (sequence, page) per layer — bigger pages halve the
+        # grid-step count at the cost of pool fragmentation; if this
+        # moves the needle the bottleneck is grid overhead, not DMA
+        # bandwidth
+        run_variant("page=256", page=256)
+        run_variant("page=512", page=512)
+
+    groups = {"core": group_core, "seq": group_seq, "slots": group_slots,
+              "chunk": group_chunk, "page": group_page}
+    for name in args.variants.split(","):
+        name = name.strip()
+        if name not in groups:
+            raise SystemExit(f"unknown variant group {name!r}; "
+                             f"expected {sorted(groups)}")
+        groups[name]()
+    full, noattn, kv8 = (results.get("full"), results.get("no-attn"),
+                         results.get("kv-int8"))
 
     # roofline: weight bytes + kv bytes per step at device bandwidth
     wbytes = sum(x.size * x.dtype.itemsize
@@ -196,8 +225,9 @@ def main() -> None:
     print(f"\nroofline: weights {wbytes/1e9:.2f} GB + KV {kvbytes/1e9:.2f} GB "
           f"per step @ {bw/1e12:.2f} TB/s = {(wbytes+kvbytes)/bw*1000:.2f} ms/step "
           f"(attention share {kvbytes/(wbytes+kvbytes):.0%})")
-    print(f"attn cost observed: {full - noattn:.3f} ms/step; "
-          f"int8 pool saves {full - kv8:.3f} ms/step")
+    if full is not None and noattn is not None and kv8 is not None:
+        print(f"attn cost observed: {full - noattn:.3f} ms/step; "
+              f"int8 pool saves {full - kv8:.3f} ms/step")
 
 
 if __name__ == "__main__":
