@@ -74,3 +74,56 @@ def test_lowers_window_softcap(kernel):
         return kernel(q, kp, vp, bt, sl, page_size=P, window=256, softcap=30.0)
 
     _export_tpu(f, q, kp, kp, bt, sl)
+
+
+# -- whole-program lowering ---------------------------------------------------
+# The kernels above are necessary but not sufficient: the engine's jitted
+# programs wrap them in scans, scatters (KV page writes), quantization,
+# and sampling — any of which can hit its own Mosaic/XLA-TPU gap.  Export
+# the REAL chunk programs at tiny shapes with the Pallas kernel forced on.
+
+@pytest.fixture()
+def tiny_engine_parts(monkeypatch):
+    from functools import partial
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.models import ModelConfig, init_random_params
+    from reval_tpu.models.paged import init_paged_cache
+
+    cfg = ModelConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32)
+    params = init_random_params(cfg, seed=0, dtype="bfloat16")
+    return PagedTPUEngine, ModelConfig, init_paged_cache, cfg, params, partial
+
+
+@pytest.mark.parametrize("kv_dtype,backend", [
+    ("", "pallas"), ("", "pallas_seq"), ("int8", "pallas"),
+])
+def test_decode_chunk_program_lowers(tiny_engine_parts, monkeypatch,
+                                     kv_dtype, backend):
+    PagedTPUEngine, _, init_paged_cache, cfg, params, partial = tiny_engine_parts
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", backend)
+    cache = init_paged_cache(cfg, num_pages=20, page_size=16,
+                             dtype=jnp.bfloat16, kv_dtype=kv_dtype)
+    span, b = 6, 4
+    state = jnp.zeros((b, span + 5), jnp.int32).at[:, span].set(1)
+    sampling = jnp.zeros((b, 3), jnp.float32)
+    for filtered in (False, True):
+        fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=4,
+                     filtered=filtered)
+        _export_tpu(fn, params, state, cache, sampling)
+
+
+def test_spec_chunk_program_lowers(tiny_engine_parts, monkeypatch):
+    PagedTPUEngine, _, init_paged_cache, cfg, params, partial = tiny_engine_parts
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
+    cache = init_paged_cache(cfg, num_pages=20, page_size=16,
+                             dtype=jnp.bfloat16)
+    b, span, k = 2, 6, 3
+    last = jnp.zeros((b, 1), jnp.int32)
+    hist = jnp.zeros((b, 8), jnp.int32)
+    n_tok = jnp.zeros((b,), jnp.int32)
+    tables = jnp.zeros((b, span), jnp.int32)
+    lens = jnp.ones((b,), jnp.int32)
+    fn = partial(PagedTPUEngine._spec_chunk, cfg=cfg, rounds=2, k=k)
+    _export_tpu(fn, params, last, hist, n_tok, tables, lens, cache)
