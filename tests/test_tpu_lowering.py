@@ -18,6 +18,8 @@ structure, not size.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -56,9 +58,10 @@ def test_lowers_bf16(kernel, h, h_kv):
 
 
 @pytest.mark.parametrize("kernel", KERNELS)
-def test_lowers_int8_pool(kernel):
-    q, kp, bt, sl = _operands(16, 16, jnp.int8)
-    scales = jnp.ones((NPAGES * P, 16), jnp.float32)
+@pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4)])   # MHA + GQA folding
+def test_lowers_int8_pool(kernel, h, h_kv):
+    q, kp, bt, sl = _operands(h, h_kv, jnp.int8)
+    scales = jnp.ones((NPAGES * P, h_kv), jnp.float32)
 
     def f(q, kp, vp, bt, sl, ks, vs):
         return kernel(q, kp, vp, bt, sl, page_size=P, k_scales=ks, v_scales=vs)
@@ -83,9 +86,7 @@ def test_lowers_window_softcap(kernel):
 # the REAL chunk programs at tiny shapes with the Pallas kernel forced on.
 
 @pytest.fixture()
-def tiny_engine_parts(monkeypatch):
-    from functools import partial
-
+def tiny_engine_parts():
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
     from reval_tpu.models import ModelConfig, init_random_params
     from reval_tpu.models.paged import init_paged_cache
@@ -93,29 +94,30 @@ def tiny_engine_parts(monkeypatch):
     cfg = ModelConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32)
     params = init_random_params(cfg, seed=0, dtype="bfloat16")
-    return PagedTPUEngine, ModelConfig, init_paged_cache, cfg, params, partial
+    return PagedTPUEngine, init_paged_cache, cfg, params
 
 
 @pytest.mark.parametrize("kv_dtype,backend", [
-    ("", "pallas"), ("", "pallas_seq"), ("int8", "pallas"),
+    ("", "pallas"), ("", "pallas_seq"),
+    ("int8", "pallas"), ("int8", "pallas_seq"),
 ])
+@pytest.mark.parametrize("filtered", [False, True])
 def test_decode_chunk_program_lowers(tiny_engine_parts, monkeypatch,
-                                     kv_dtype, backend):
-    PagedTPUEngine, _, init_paged_cache, cfg, params, partial = tiny_engine_parts
+                                     kv_dtype, backend, filtered):
+    PagedTPUEngine, init_paged_cache, cfg, params = tiny_engine_parts
     monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", backend)
     cache = init_paged_cache(cfg, num_pages=20, page_size=16,
                              dtype=jnp.bfloat16, kv_dtype=kv_dtype)
     span, b = 6, 4
     state = jnp.zeros((b, span + 5), jnp.int32).at[:, span].set(1)
     sampling = jnp.zeros((b, 3), jnp.float32)
-    for filtered in (False, True):
-        fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=4,
-                     filtered=filtered)
-        _export_tpu(fn, params, state, cache, sampling)
+    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=4,
+                 filtered=filtered)
+    _export_tpu(fn, params, state, cache, sampling)
 
 
 def test_spec_chunk_program_lowers(tiny_engine_parts, monkeypatch):
-    PagedTPUEngine, _, init_paged_cache, cfg, params, partial = tiny_engine_parts
+    PagedTPUEngine, init_paged_cache, cfg, params = tiny_engine_parts
     monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
     cache = init_paged_cache(cfg, num_pages=20, page_size=16,
                              dtype=jnp.bfloat16)
