@@ -287,13 +287,16 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
     b = pl.program_id(0)
     seq_len = seq_lens_ref[b]
     n_live = (seq_len + page_size - 1) // page_size
-    if window is not None:
-        p0 = jnp.maximum((seq_len - window) // page_size, 0)
-    else:
-        p0 = jnp.int32(0)
 
-    def dmas(slot, p):
-        page = block_tables_ref[b, p]
+    def first_page(b_):
+        if window is not None:
+            return jnp.maximum((seq_lens_ref[b_] - window) // page_size, 0)
+        return jnp.int32(0)
+
+    p0 = first_page(b)
+
+    def dmas(slot, p, b_):
+        page = block_tables_ref[b_, p]
         out = [
             pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot],
                                   sem.at[slot, 0]),
@@ -309,8 +312,15 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
             ]
         return out
 
-    for d in dmas(p0 % 2, p0):
-        d.start()
+    # Cross-sequence pipelining: sequence b's first-page DMA was started
+    # by the EPILOGUE of grid step b-1 (the DMA queue never drains at a
+    # grid-step boundary); only the first grid step starts its own.
+    # Start/wait stay balanced: every step waits exactly the pages
+    # [p0, n_live) and starts [p0+1, n_live) plus its successor's p0.
+    @pl.when(b == 0)
+    def _first_seq():
+        for d in dmas(p0 % 2, p0, b):
+            d.start()
 
     m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
@@ -321,10 +331,10 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
 
         @pl.when(p + 1 < n_live)
         def _prefetch():
-            for d in dmas((p + 1) % 2, p + 1):
+            for d in dmas((p + 1) % 2, p + 1, b):
                 d.start()
 
-        for d in dmas(slot, p):
+        for d in dmas(slot, p, b):
             d.wait()
 
         cols = jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
@@ -345,6 +355,13 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
         return carry
 
     jax.lax.fori_loop(p0, n_live, body, 0)
+
+    @pl.when(b + 1 < pl.num_programs(0))
+    def _next_seq():
+        np0 = first_page(b + 1)
+        for d in dmas(np0 % 2, np0, b + 1):
+            d.start()
+
     o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 

@@ -192,3 +192,17 @@ def test_engine_int8_kv_with_prefix_sharing():
     # the outputs must be well-formed and the same shape
     assert len(outs["int8"]) == 2
     assert all(isinstance(o, str) for o in outs["int8"])
+
+@pytest.mark.parametrize("kernel", KERNELS, ids=KERNEL_IDS)
+def test_quantized_softcap_pallas_matches_xla(kernel):
+    """Scales x softcap TOGETHER: the kernels fold the k-scales into the
+    scores BEFORE softcapping (tanh(s*ks/cap) != tanh(s/cap)*ks), so this
+    combination locks the ordering the int8 fold relies on — neither the
+    scales-only nor softcap-only tests would catch a reorder."""
+    q, kf, vf, kq, ks, vq, vs, tables, lens = make_quantized_paged(seed=4)
+    ref = paged_decode_attention_xla(q, kq, vq, tables, lens, page_size=PAGE,
+                                     softcap=20.0, k_scales=ks, v_scales=vs)
+    got = kernel(q, kq, vq, tables, lens, page_size=PAGE, interpret=True,
+                 softcap=20.0, k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
