@@ -52,19 +52,20 @@ run() {
   return $rc
 }
 
-# 1. decide the kernel default: attention-only A/B, ~3 min
+# -- decision set first: a ~19-minute tunnel window must capture enough
+#    to pick the default (kernel backend, kv dtype, slot width) ---------
+# 1. kernel-only A/B, ~3-5 min
 run kernel_ab.txt         900 txt  python tools/kernel_bench.py --slots 32 --ctx 600
-# 2. cheapest full-pipeline number on the new kernel
+# 2. full pipeline on the current default config
 run bench_quick.json     1200 json python bench.py --skip-serial --skip-ab --prompts 32
-# 3. localise what remains of the decode gap — decision-critical groups
-#    only (kernel default + slot width); diagnostics ride a later step
-run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
-# 4. official numbers
-run bench_direct.json    2400 json python bench.py
+# 3. the two candidate default configs
 run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
 # int8 pool halves KV reads AND lets 64 slots fit → weight reads amortise
-# over 2x the batch; candidate new default if the A/B wins
+# over 2x the batch
 run bench_direct_kv8s64.json 2400 json python bench.py --kv-dtype int8 --slots 64 --skip-serial --skip-ab
+# -- diagnosis + official numbers --------------------------------------
+run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
+run bench_direct.json    2400 json python bench.py
 run bench_cot.json       3600 json python bench.py --mode cot
 # 5. dtype / feature A-Bs on the new kernel
 run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
