@@ -1,0 +1,69 @@
+"""The measurement tools must never burn a chip window on a tool bug.
+
+Every artifact in tools/chip_runbook.sh is produced by bench.py or a
+tools/ script; the TPU tunnel is up for ~minutes between multi-hour
+wedges (PERF.md), so a crash found on-chip costs a window.  Each tool
+has a ``--tiny`` CPU mode — run it as a real subprocess (the runbook's
+invocation shape) and assert it exits 0 with the expected markers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_tool(*argv: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, *argv], cwd=REPO,
+                          capture_output=True, text=True, timeout=1200)
+
+
+def test_bench_tiny_emits_one_json_line():
+    r = run_tool("bench.py", "--tiny")
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got {lines}"
+    d = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(d)
+    assert "error" not in d
+    assert d["value"] > 0
+
+
+def test_decode_ablate_tiny_all_groups():
+    r = run_tool("tools/decode_ablate.py", "--tiny")
+    assert r.returncode == 0, r.stderr[-2000:]
+    for marker in ("full", "no-attn", "kv-int8", "seq-kernel", "kv8@s64",
+                   "page=256", "roofline"):
+        assert marker in r.stdout, f"missing {marker!r} in:\n{r.stdout}"
+    assert "FAILED" not in r.stdout
+
+
+def test_decode_ablate_rejects_unknown_group():
+    r = run_tool("tools/decode_ablate.py", "--tiny", "--variants", "nope")
+    assert r.returncode != 0
+    assert "unknown variant group" in (r.stdout + r.stderr)
+
+
+def test_kernel_bench_tiny():
+    r = run_tool("tools/kernel_bench.py", "--tiny")
+    assert r.returncode == 0, r.stderr[-2000:]
+    for marker in ("grid", "seq", "grid-int8", "seq-int8"):
+        assert marker in r.stdout
+    assert "FAILED" not in r.stdout
+
+
+def test_fleet_bench_tiny():
+    r = run_tool("tools/fleet_bench.py", "--tiny")
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
+    assert lines, f"no json line in:\n{r.stdout}"
+    d = json.loads(lines[-1])
+    assert "metric" in d and "value" in d
