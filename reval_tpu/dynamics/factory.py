@@ -126,13 +126,3 @@ class CodeSpace:
         exec(compile(code, DRIVER_FILENAME, "exec"), self.ns)
 
 
-def method_source_segment(test_code: str, cls_name_pattern: Callable[[str], bool], method_name: str) -> str | None:
-    """Return the source of ``method_name`` inside the first class of
-    ``test_code`` whose name matches, using AST only (no temp files)."""
-    tree = ast.parse(test_code)
-    for node in tree.body:
-        if isinstance(node, ast.ClassDef) and cls_name_pattern(node.name):
-            for item in node.body:
-                if isinstance(item, ast.FunctionDef) and item.name == method_name:
-                    return ast.get_source_segment(test_code, item)
-    return None
