@@ -11,7 +11,6 @@ dynamics.py:15-92, without its module-global namespace pollution).
 
 from __future__ import annotations
 
-import ast
 from typing import Callable, Type
 
 __all__ = ["TRACE_FILENAME", "CodeSpace"]

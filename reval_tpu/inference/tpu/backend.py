@@ -18,7 +18,8 @@ class TPUBackend(InferenceBackend):
                  sp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
                  engine: str | None = None, kv_dtype: str = "",
-                 spec_k: int = 0, **kwargs):
+                 spec_k: int = 0, memory_utilization: float | None = None,
+                 **kwargs):
         """``engine``: "paged" (continuous batching over the paged KV
         cache + native scheduler) or "static" (rectangular batches; the
         dp/sp/pp sharding paths live here).  Default (None) auto-selects:
@@ -43,7 +44,13 @@ class TPUBackend(InferenceBackend):
         ``kv_dtype``: "" (KV pages stored in the activation dtype) or
         "int8" — quantized page pool with per-(token, head) scales
         (models/paged.py): half the pool HBM and attention read
-        traffic."""
+        traffic.
+
+        ``memory_utilization``: size the paged KV pool from the device's
+        reported HBM (pool = util × HBM − weights − workspace) — the
+        reference's ``gpu_memory_utilization`` vLLM kwarg (reference
+        inference.py:93).  None (default) reserves max_seq_len per slot;
+        paged engines only."""
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         if not model_path:
             raise ValueError(
@@ -82,6 +89,10 @@ class TPUBackend(InferenceBackend):
                 raise ValueError("kv_dtype requires the paged engine, "
                                  "which has no pipeline-parallel path — "
                                  "drop kv_dtype or pp_size")
+            if memory_utilization is not None:
+                raise ValueError("memory_utilization requires the paged "
+                                 "engine, which has no pipeline-parallel "
+                                 "path — drop memory_utilization or pp_size")
             from .pp_engine import PipelinedTPUEngine
 
             self.engine = PipelinedTPUEngine.from_pretrained(
@@ -96,7 +107,7 @@ class TPUBackend(InferenceBackend):
                 model_path, dtype=dtype, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
-                spec_k=spec_k,
+                spec_k=spec_k, memory_utilization=memory_utilization,
             )
         elif engine == "paged":
             # dp>1 with continuous batching: one paged replica per device
@@ -109,7 +120,7 @@ class TPUBackend(InferenceBackend):
                 model_path, dtype=dtype, dp_size=dp_size, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
-                spec_k=spec_k,
+                spec_k=spec_k, memory_utilization=memory_utilization,
             )
         else:
             # the static engine shards one rectangular batch over a
@@ -119,6 +130,11 @@ class TPUBackend(InferenceBackend):
                     "kv_dtype is a paged-pool feature; the static engine's "
                     "contiguous cache does not support it — drop kv_dtype "
                     "or use engine='paged'")
+            if memory_utilization is not None:
+                raise ValueError(
+                    "memory_utilization sizes the paged KV pool; the static "
+                    "engine reserves its contiguous cache per batch row — "
+                    "drop memory_utilization or use engine='paged'")
             from .engine import TPUEngine
 
             self.engine = TPUEngine.from_pretrained(

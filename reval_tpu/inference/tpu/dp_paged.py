@@ -51,7 +51,8 @@ class DataParallelPagedEngine:
                  tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  seed: int = 0, prefix_sharing: bool = True, devices=None,
-                 kv_dtype: str = "", spec_k: int = 0):
+                 kv_dtype: str = "", spec_k: int = 0,
+                 memory_utilization: float | None = None):
         devices = list(devices if devices is not None else jax.devices())
         need = dp_size * tp_size
         if len(devices) < need:
@@ -70,7 +71,7 @@ class DataParallelPagedEngine:
                 page_size=page_size, max_seq_len=max_seq_len,
                 num_pages=num_pages, mesh=mesh, seed=seed + r,
                 prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
-                spec_k=spec_k))
+                spec_k=spec_k, memory_utilization=memory_utilization))
         self._pool = ThreadPoolExecutor(max_workers=dp_size,
                                         thread_name_prefix="dp-paged")
 
@@ -81,7 +82,8 @@ class DataParallelPagedEngine:
                         max_seq_len: int = 8192, num_pages: int | None = None,
                         tokenizer=None, seed: int = 0, kv_dtype: str = "",
                         spec_k: int = 0,
-                        local_devices_only: bool = False
+                        local_devices_only: bool = False,
+                        memory_utilization: float | None = None,
                         ) -> "DataParallelPagedEngine":
         params, cfg = load_checkpoint(model_path, dtype=dtype)
         if tokenizer is None:
@@ -90,7 +92,8 @@ class DataParallelPagedEngine:
         return cls(params, cfg, tokenizer, dp_size=dp_size, tp_size=tp_size,
                    max_slots=max_slots, page_size=page_size,
                    max_seq_len=max_seq_len, num_pages=num_pages, seed=seed,
-                   devices=devices, kv_dtype=kv_dtype, spec_k=spec_k)
+                   devices=devices, kv_dtype=kv_dtype, spec_k=spec_k,
+                   memory_utilization=memory_utilization)
 
     @property
     def stats(self) -> EngineStats:

@@ -135,7 +135,8 @@ class PagedTPUEngine:
                  max_slots: int = 8, page_size: int = PAGE_SIZE,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  mesh=None, seed: int = 0, prefix_sharing: bool = True,
-                 kv_dtype: str = "", spec_k: int = 0, spec_rounds: int = 8):
+                 kv_dtype: str = "", spec_k: int = 0, spec_rounds: int = 8,
+                 memory_utilization: float | None = None):
         """``spec_k`` > 0 enables greedy n-gram speculative decoding
         (models/spec.py): chunks where EVERY active request is greedy run
         ``spec_rounds`` draft+verify rounds of ``spec_k`` candidates
@@ -143,7 +144,16 @@ class PagedTPUEngine:
         ``spec_k+1`` tokens per weight pass.  Off by default until the
         chip A/B (tools/chip_runbook.sh) lands: each verify round reads
         the KV pool ``spec_k+1`` times, so the win depends on the
-        weight-read/KV-read ratio at the deployment shape."""
+        weight-read/KV-read ratio at the deployment shape.
+
+        ``memory_utilization``: when set (and ``num_pages`` is not),
+        size the page pool from the device's reported HBM — the
+        equivalent of the ``gpu_memory_utilization`` the reference
+        passes to vLLM (reference inference.py:93): pool budget =
+        ``memory_utilization × HBM − weights − 1 GiB workspace``.
+        Preemption makes oversubscription safe, so the pool takes the
+        whole budget.  Devices that don't report memory (the CPU test
+        backend) fall back to the full per-slot reservation."""
         assert max_seq_len % page_size == 0
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -153,6 +163,10 @@ class PagedTPUEngine:
         self.spec_rounds = spec_rounds
         self.prefix_sharing = prefix_sharing
         self.max_pages_per_seq = max_seq_len // page_size
+        if num_pages is None and memory_utilization is not None:
+            num_pages = self._pages_for_budget(
+                params, cfg, mesh, page_size, kv_dtype, memory_utilization,
+                max_slots)
         # default pool: every slot can reach max_seq_len (no oversubscription;
         # pass a smaller num_pages to trade HBM for preemption risk)
         self.num_pages = (num_pages if num_pages is not None
@@ -206,6 +220,37 @@ class PagedTPUEngine:
             partial(self._spec_chunk, cfg=cfg),
             static_argnames=("rounds", "k"), donate_argnames=("cache",))
 
+    @staticmethod
+    def _pages_for_budget(params, cfg, mesh, page_size: int, kv_dtype: str,
+                          utilization: float, max_slots: int) -> int | None:
+        """Pages the HBM budget affords per device, or None (no memory
+        stats → caller keeps the deterministic full-reservation default).
+
+        All quantities are PER DEVICE: under a tp mesh both the weights
+        and the pool's kv-head axis are sharded ``mesh.size`` ways.
+        """
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        hbm = stats.get("bytes_limit")
+        if not hbm:
+            return None
+        shards = mesh.size if mesh is not None else 1
+        weight_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(params)) // shards
+        store = 1 if kv_dtype == "int8" else jnp.dtype(
+            params["embed"].dtype).itemsize
+        h_kv_local = max(1, cfg.num_kv_heads // shards)
+        per_token = 2 * cfg.num_layers * h_kv_local * cfg.head_dim * store
+        if kv_dtype == "int8":
+            per_token += 2 * cfg.num_layers * h_kv_local * 4   # f32 scales
+        budget = int(utilization * hbm) - weight_bytes - (1 << 30)
+        pages = budget // (page_size * per_token)
+        # never below a working minimum: one page per slot plus the trash
+        # page (preemption handles workloads larger than the pool)
+        return max(int(pages), max_slots + 1)
+
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
                         tp_size: int = 1, max_slots: int = 8,
@@ -213,7 +258,9 @@ class PagedTPUEngine:
                         num_pages: int | None = None, tokenizer=None,
                         seed: int = 0, kv_dtype: str = "",
                         spec_k: int = 0, spec_rounds: int = 8,
-                        local_devices_only: bool = False) -> "PagedTPUEngine":
+                        local_devices_only: bool = False,
+                        memory_utilization: float | None = None,
+                        ) -> "PagedTPUEngine":
         mesh = None
         if tp_size > 1:
             from ...parallel import make_mesh
@@ -235,7 +282,8 @@ class PagedTPUEngine:
         return cls(params, cfg, tokenizer, max_slots=max_slots,
                    page_size=page_size, max_seq_len=max_seq_len,
                    num_pages=num_pages, mesh=mesh, seed=seed,
-                   kv_dtype=kv_dtype, spec_k=spec_k, spec_rounds=spec_rounds)
+                   kv_dtype=kv_dtype, spec_k=spec_k, spec_rounds=spec_rounds,
+                   memory_utilization=memory_utilization)
 
     def close(self) -> None:
         if self.rt is not None:

@@ -335,3 +335,65 @@ def test_wide_slot_count_matches_narrow(tiny):
     wide8.close()
     agree = sum(a == b for a, b in zip(got8, want))
     assert agree >= 76, f"only {agree}/80 int8 outputs match the float engine"
+
+
+class TestMemoryUtilization:
+    """HBM-driven pool sizing (the reference's gpu_memory_utilization,
+    reference inference.py:93)."""
+
+    class _FakeDev:
+        def __init__(self, limit):
+            self._limit = limit
+
+        def memory_stats(self):
+            return {"bytes_limit": self._limit} if self._limit else {}
+
+    def test_pool_sized_from_reported_hbm(self, tiny, monkeypatch):
+        cfg, params = tiny
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        weight_bytes = sum(x.nbytes for x in
+                           _jax.tree_util.tree_leaves(params))
+        per_token = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * \
+            _jnp.dtype(params["embed"].dtype).itemsize
+        # pick the limit so the budget is comfortably POSITIVE (~100 MiB
+        # past the workspace reserve): the proportional formula itself is
+        # under test, not the floor clamp (that's the tight-budget case)
+        limit = 2 * ((1 << 30) + weight_bytes + (100 << 20))
+        monkeypatch.setattr(_jax, "local_devices",
+                            lambda *a, **k: [self._FakeDev(limit)])
+        eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                             page_size=PAGE, max_seq_len=256,
+                             memory_utilization=0.5)
+        budget = int(0.5 * limit) - weight_bytes - (1 << 30)
+        want = budget // (PAGE * per_token)
+        assert want > 3, "test must exercise the formula, not the clamp"
+        assert eng.num_pages == want
+        eng.close()
+
+    def test_no_stats_falls_back_to_full_reservation(self, tiny, monkeypatch):
+        cfg, params = tiny
+        import jax as _jax
+        monkeypatch.setattr(_jax, "local_devices",
+                            lambda *a, **k: [self._FakeDev(None)])
+        eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                             page_size=PAGE, max_seq_len=256,
+                             memory_utilization=0.9)
+        assert eng.num_pages == 1 + 2 * (256 // PAGE)
+        eng.close()
+
+    def test_tight_budget_still_generates(self, tiny, monkeypatch):
+        """A budget that affords only the minimum pool (slots+1 pages)
+        must still complete via preemption, not deadlock."""
+        cfg, params = tiny
+        import jax as _jax
+        monkeypatch.setattr(_jax, "local_devices",
+                            lambda *a, **k: [self._FakeDev(1 << 30)])
+        eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                             page_size=PAGE, max_seq_len=256,
+                             memory_utilization=0.9)
+        assert eng.num_pages == 3                          # floor clamp
+        outs = eng.generate(PROMPTS[:3], max_new_tokens=6, temperature=0.0)
+        eng.close()
+        assert len(outs) == 3 and all(isinstance(o, str) for o in outs)
