@@ -35,25 +35,36 @@ import subprocess
 import sys
 import time
 
-# bf16 peak FLOPs/s per chip by device_kind substring (public spec sheets)
-PEAK_FLOPS = [
-    ("v6", 918e12),        # Trillium
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),   # v5e reports "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+# per-chip specs by device_kind substring (public spec sheets):
+# (key, bf16 peak FLOPs/s, HBM bandwidth bytes/s).  Decode is
+# bandwidth-bound, so achieved fraction of the HBM roofline — not MFU —
+# is the "is it actually fast?" lens (round-4 verdict item 5).
+CHIP_SPECS = [
+    ("v6", 918e12, 1640e9),        # Trillium
+    ("v5p", 459e12, 2765e9),
+    ("v5 lite", 197e12, 819e9),    # v5e reports "TPU v5 lite"
+    ("v5e", 197e12, 819e9),
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
 ]
-DEFAULT_PEAK = 197e12
+DEFAULT_SPEC = (197e12, 819e9)     # unknown chip: assume v5e
+
+
+def _chip_spec(device_kind: str) -> tuple[float, float]:
+    kind = device_kind.lower()
+    for key, flops, bw in CHIP_SPECS:
+        if key in kind:
+            return flops, bw
+    return DEFAULT_SPEC
 
 
 def peak_flops_for(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, flops in PEAK_FLOPS:
-        if key in kind:
-            return flops
-    return DEFAULT_PEAK
+    return _chip_spec(device_kind)[0]
+
+
+def hbm_bw_for(device_kind: str) -> float:
+    return _chip_spec(device_kind)[1]
 
 
 # -- pre-flight ------------------------------------------------------------
@@ -105,11 +116,64 @@ def note(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
+def last_known_good() -> dict | None:
+    """Most recent clean bench artifact on disk (watcher-captured or a past
+    official record).
+
+    The tunnel on this host wedges for many hours at a time; a
+    driver-run bench during a wedge must not go down as 0.0 when the code
+    HAS a verified number from the last time a chip answered — so the
+    failure JSON carries it (value, metric, device, commit, timestamp)
+    alongside the error."""
+    import glob
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    paths = (glob.glob(os.path.join(root, "tpu_watch", "*.json"))
+             + glob.glob(os.path.join(root, "BENCH_r*.json")))
+    best = None
+    for path in paths:
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except Exception:
+            continue
+        if (not isinstance(obj, dict) or obj.get("error")
+                or not obj.get("value") or "metric" not in obj):
+            continue
+        mtime = os.path.getmtime(path)
+        if best is None or mtime > best[0]:
+            best = (mtime, path, obj)
+    if best is None:
+        return None
+    mtime, path, obj = best
+    out = {"value": obj["value"], "unit": obj.get("unit", ""),
+           "metric": obj["metric"], "device": obj.get("device", ""),
+           "source": os.path.relpath(path, root),
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                      time.localtime(mtime))}
+    try:   # the newest commit not younger than the artifact ≈ measured code
+        r = subprocess.run(
+            ["git", "-C", root, "log", "-1", "--format=%h",
+             f"--until=@{int(mtime)}"],
+            capture_output=True, text=True, timeout=10)
+        if r.returncode == 0 and r.stdout.strip():
+            out["measured_at_commit"] = r.stdout.strip()
+    except Exception:
+        pass
+    return out
+
+
 def fail(metric: str, error: str, detail: str = "") -> None:
     out = {"metric": metric, "value": 0.0, "unit": "probes/s/chip",
            "vs_baseline": 0.0, "error": error}
     if detail:
         out["detail"] = detail[-2000:]
+    try:
+        lk = last_known_good()
+    except Exception:
+        lk = None
+    if lk:
+        out["last_known"] = lk
     emit(out)
 
 
@@ -212,18 +276,25 @@ def flagship(tiny: bool = False, model: str = "1.3b",
     return params, cfg
 
 
-def count_matmul_params(params) -> int:
-    """Params that flow through matmuls each decode step (embedding table
-    lookup excluded; lm_head included)."""
+def count_matmul_params(params) -> tuple[int, int]:
+    """(count, resident bytes) of params that flow through matmuls each
+    decode step (embedding table lookup excluded; lm_head included).
+    Bytes come from the leaves as stored — int8 weights and all scales at
+    their true footprint; int4 halved, because ``nbytes`` reports 1 byte
+    per nibble (ml_dtypes itemsize) while XLA packs s4 two-per-byte on
+    TPU, and overstating weight traffic 2x would corrupt the
+    bandwidth_util lens this feeds."""
     import jax
+    import jax.numpy as jnp
 
-    total = 0
+    total = nbytes = 0
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         keys = "/".join(str(p) for p in path)
         if "embed" in keys:
             continue
         total += leaf.size
-    return total
+        nbytes += leaf.nbytes // 2 if leaf.dtype == jnp.int4 else leaf.nbytes
+    return total, nbytes
 
 
 def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
@@ -396,7 +467,7 @@ def main() -> None:
                     f"tokenizer at {hf_tok[1]} emits id {top} >= model "
                     f"vocab {cfg.vocab_size}; pair --tokenizer with the "
                     f"matching --model zoo shape")
-        n_matmul = count_matmul_params(params)
+        n_matmul, weight_bytes = count_matmul_params(params)
 
         # the bench engines run UNSHARDED (no mesh): exactly one chip does
         # the work, so per-chip numbers divide by 1 regardless of how many
@@ -440,10 +511,28 @@ def main() -> None:
         mfu = (tok_per_sec * decode_flops_per_token(cfg, n_matmul, avg_ctx)
                / (peak_flops_for(device_kind) * chips_used))
 
+        # decode HBM roofline: each weight pass streams the matmul params
+        # once, and each generated token reads its full KV context.  MFU
+        # is near-meaningless for bandwidth-bound decode; this fraction
+        # answers "actually fast?" directly (round-4 verdict item 5).
+        kvb = (1 if args.kv_dtype == "int8"
+               else params["embed"].dtype.itemsize)
+        kv_per_ctx = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * kvb
+        if args.kv_dtype == "int8":
+            kv_per_ctx += 2 * cfg.num_layers * cfg.num_kv_heads * 4  # f32 scales
+        decode_bytes = (stats.decode_steps * weight_bytes
+                        + stats.generated_tokens * avg_ctx * kv_per_ctx)
+        hbm_gbps = (decode_bytes / stats.decode_seconds / 1e9
+                    if stats.decode_seconds else 0.0)
+        bandwidth_util = hbm_gbps * 1e9 / hbm_bw_for(device_kind)
+
         extras = {
             "tokenizer": hf_tok[1] if hf_tok else "trained-bpe(benchmark-corpus)",
             "tokens_per_sec": round(tok_per_sec, 1),
             "mfu": round(mfu, 4),
+            "bandwidth_util": round(bandwidth_util, 4),
+            "hbm_gbps_achieved": round(hbm_gbps, 1),
+            "decode_steps": stats.decode_steps,
             "device": device_kind,
             "platform": platform,
             "chips_used": chips_used,

@@ -108,6 +108,7 @@ class DataParallelPagedEngine:
             agg.decode_seconds += s.decode_seconds
             agg.prefill_seconds += s.prefill_seconds
             agg.decode_chunks += s.decode_chunks
+            agg.decode_steps += s.decode_steps
             agg.spec_rounds += s.spec_rounds
             agg.spec_accepted += s.spec_accepted
         return agg

@@ -167,6 +167,9 @@ class EngineStats:
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
     decode_chunks: int = 0
+    decode_steps: int = 0        # weight passes: forward executions of the
+                                 # decode program over the batch (spec
+                                 # counts verify rounds, not tokens)
     spec_rounds: int = 0         # draft+verify rounds executed (per slot)
     spec_accepted: int = 0       # draft tokens accepted (bonus excluded)
 
@@ -433,6 +436,7 @@ class TPUEngine:
                     np.float32(temperature), self._next_key(), kf, pf,
                     steps=steps, filtered=filtered)
             pos = pos + steps
+            self.stats.decode_steps += steps
             chunk_host = self._host_read(toks)
             generated = np.concatenate([generated, chunk_host], axis=1)
             for row in range(n_real):

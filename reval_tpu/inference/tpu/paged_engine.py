@@ -163,10 +163,15 @@ class PagedTPUEngine:
         self.spec_rounds = spec_rounds
         self.prefix_sharing = prefix_sharing
         self.max_pages_per_seq = max_seq_len // page_size
+        if memory_utilization is not None and not (0.0 < memory_utilization <= 1.0):
+            # a tiny/negative value would silently clamp to the minimum
+            # pool and preempt constantly; >1 oversubscribes HBM
+            raise ValueError(
+                f"memory_utilization must be in (0, 1], got {memory_utilization}")
         if num_pages is None and memory_utilization is not None:
             num_pages = self._pages_for_budget(
                 params, cfg, mesh, page_size, kv_dtype, memory_utilization,
-                max_slots)
+                max_slots, self.max_pages_per_seq)
         # default pool: every slot can reach max_seq_len (no oversubscription;
         # pass a smaller num_pages to trade HBM for preemption risk)
         self.num_pages = (num_pages if num_pages is not None
@@ -222,7 +227,8 @@ class PagedTPUEngine:
 
     @staticmethod
     def _pages_for_budget(params, cfg, mesh, page_size: int, kv_dtype: str,
-                          utilization: float, max_slots: int) -> int | None:
+                          utilization: float, max_slots: int,
+                          max_pages_per_seq: int) -> int | None:
         """Pages the HBM budget affords per device, or None (no memory
         stats → caller keeps the deterministic full-reservation default).
 
@@ -247,9 +253,12 @@ class PagedTPUEngine:
             per_token += 2 * cfg.num_layers * h_kv_local * 4   # f32 scales
         budget = int(utilization * hbm) - weight_bytes - (1 << 30)
         pages = budget // (page_size * per_token)
-        # never below a working minimum: one page per slot plus the trash
-        # page (preemption handles workloads larger than the pool)
-        return max(int(pages), max_slots + 1)
+        # never above what the slots can address (pages past
+        # 1 + slots*max_pages_per_seq are unreachable HBM), never below a
+        # working minimum: one page per slot plus the trash page
+        # (preemption handles workloads larger than the pool)
+        pages = min(int(pages), 1 + max_slots * max_pages_per_seq)
+        return max(pages, max_slots + 1)
 
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",
@@ -652,6 +661,7 @@ class PagedTPUEngine:
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.generated_tokens += steps * len(st.active)
         self.stats.decode_chunks += 1
+        self.stats.decode_steps += steps
 
         for slot, seq_id in list(st.active.items()):
             req = reqs[seq_id]
@@ -701,6 +711,7 @@ class PagedTPUEngine:
         n_h = np.asarray(n_outs)           # [R, B]
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.decode_chunks += 1
+        self.stats.decode_steps += rounds   # one verify forward per round
         sd.update(last=last, hist=hist, n_tok=n_tok, lens=lens_d)
 
         for slot, seq_id in list(st.active.items()):

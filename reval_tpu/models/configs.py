@@ -50,10 +50,17 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     # "ragged": exact sort + lax.ragged_dot (dropless, HF-equivalent);
-    # "dispatch": capacity-bounded GShard dispatch (ep-shardable — engines
-    # switch to it automatically on an ep>1 mesh)
+    # "dispatch": GShard dispatch (ep-shardable — engines switch to it
+    # automatically on an ep>1 mesh)
     moe_impl: str = "ragged"
-    moe_capacity_factor: float = 2.0   # dispatch slots per expert vs uniform load
+    # dispatch slots per expert.  None (default) = EXACT drop-free
+    # dispatch: capacity covers every assignment and the dispatch chunks
+    # long token batches to bound its buffer — for an evaluation
+    # framework, batch-dependent logits are a correctness hazard, so
+    # lossy routing must be a loud opt-in.  A float trades exactness for
+    # compute: that multiple of the uniform load, assignments beyond it
+    # DROP under router skew.
+    moe_capacity_factor: float | None = None
 
     @property
     def q_per_kv(self) -> int:

@@ -99,12 +99,19 @@ def _mm(x, container, name: str):
 def _moe_capacity(s: int, cfg: ModelConfig) -> int:
     """Static per-expert dispatch capacity for ``s`` tokens.
 
-    ``capacity_factor``× the uniform load, rounded up to a multiple of 8
-    (TPU lane tiling), floored at ``top_k`` and capped at ``s`` (an expert
-    can receive at most one assignment per token) — so small batches
-    (decode steps) always get drop-free exact routing, and large prefill
-    batches bound the dispatch buffer at ``E × cap × D``.
+    Default (``moe_capacity_factor is None``): EXACT — an expert can
+    receive at most one assignment per token (top-k indices are distinct
+    experts), so capacity ``s`` provably fits every assignment; rounded
+    up to a multiple of 8 for TPU lane tiling (slots past ``s`` are
+    simply never addressed).  ``_moe_mlp_dispatch`` chunks long batches
+    so this never exceeds ``MOE_DISPATCH_CHUNK``.
+
+    Lossy opt-in (a float): ``capacity_factor``× the uniform load,
+    rounded up to a multiple of 8, floored at ``top_k`` and capped at
+    ``s`` — beyond it, skewed routing DROPS assignments.
     """
+    if cfg.moe_capacity_factor is None:
+        return -(-s // 8) * 8
     uniform = s * cfg.num_experts_per_tok / cfg.num_experts
     cap = int(-(-uniform * cfg.moe_capacity_factor // 1))
     cap = -(-max(cap, cfg.num_experts_per_tok) // 8) * 8
@@ -170,24 +177,48 @@ def _moe_mlp_ragged(x, layer, cfg: ModelConfig):
     return out.reshape(b, t, d).astype(x.dtype)
 
 
-def _moe_mlp_dispatch(x, layer, cfg: ModelConfig):
-    """Capacity-bounded GShard dispatch — the ``ep``-shardable MoE path.
+# Token-axis chunk for the EXACT dispatch MoE path: bounds the
+# [E+1, cap, D] scatter buffer (cap == chunk tokens) on long prefill
+# batches.  Routing is per-token and exact capacity admits every
+# assignment, so chunking never changes logits.  Lossy mode (explicit
+# capacity factor) never chunks: its drop rule is defined over the WHOLE
+# batch, and per-chunk capacity would change which assignments drop.
+MOE_DISPATCH_CHUNK = 1024
 
-    Assignments scatter into a dense ``[E, cap, D]`` buffer (leading-dim
-    scatter — no ``[S, E, cap]`` one-hot transient), the expert FFNs run
-    as ONE batched einsum over the expert dim (the ``ep`` mesh axis
-    shards that dim, see parallel/sharding.py), and results gather back
-    per assignment.  Assignments past an expert's ``cap`` slots are
-    DROPPED (combine weight zeroed): exact whenever ``cap == s`` (always
-    true for s <= 8, see ``_moe_capacity``), approximate under heavy
-    router skew beyond ``moe_capacity_factor`` — raise the factor for
-    exactness at more HBM.  The single-device default is the exact
-    ragged path; engines select this one only on ep>1 meshes.
+
+def _moe_mlp_dispatch(x, layer, cfg: ModelConfig):
+    """GShard dispatch — the ``ep``-shardable MoE path.
+
+    Assignments scatter into a dense ``[E, cap, D]`` buffer, the expert
+    FFNs run as ONE batched einsum over the expert dim (the ``ep`` mesh
+    axis shards that dim, see parallel/sharding.py), and results gather
+    back per assignment.  By default (``moe_capacity_factor=None``) the
+    capacity provably fits every assignment — EXACT, logits match the
+    ragged path bit-for-bit semantics under any router skew; batches
+    longer than ``MOE_DISPATCH_CHUNK`` dispatch chunk-by-chunk
+    (``lax.map``) to bound the buffer.  An explicit float capacity
+    factor is the lossy opt-in: assignments past ``cap`` slots are
+    DROPPED (combine weight zeroed).  The single-device default is the
+    exact ragged path; engines select this one only on ep>1 meshes.
     """
     b, t, d = x.shape
     s = b * t
-    e, k = cfg.num_experts, cfg.num_experts_per_tok
     xs = x.reshape(s, d)
+    c = MOE_DISPATCH_CHUNK
+    if cfg.moe_capacity_factor is not None or s <= c:
+        out = _dispatch_block(xs, layer, cfg)
+    else:
+        n = -(-s // c)
+        xp = jnp.pad(xs, ((0, n * c - s), (0, 0)))
+        out = jax.lax.map(lambda blk: _dispatch_block(blk, layer, cfg),
+                          xp.reshape(n, c, d)).reshape(n * c, d)[:s]
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
+def _dispatch_block(xs, layer, cfg: ModelConfig):
+    """One dispatch round over ``[S, D]`` tokens (see caller)."""
+    s, d = xs.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
     topv, topi = _route(xs, layer, cfg)
     cap = _moe_capacity(s, cfg)
 
@@ -199,7 +230,7 @@ def _moe_mlp_dispatch(x, layer, cfg: ModelConfig):
     eidx = jnp.where(ok, flat_e, e)        # overflow → scratch expert row
     sidx = jnp.minimum(slot, cap - 1)
     src = jnp.repeat(xs, k, axis=0)                            # [S*K, D]
-    buf = jnp.zeros((e + 1, cap, d), x.dtype).at[eidx, sidx].set(src)
+    buf = jnp.zeros((e + 1, cap, d), xs.dtype).at[eidx, sidx].set(src)
     xe = buf[:e]                                               # [E, cap, D]
 
     def expert_mm(h, name, out_pattern):
@@ -220,7 +251,7 @@ def _moe_mlp_dispatch(x, layer, cfg: ModelConfig):
     out_a = ypad[eidx, sidx].astype(jnp.float32)               # [S*K, D]
     w_a = jnp.where(ok, topv.reshape(-1), 0.0)
     out = (out_a * w_a[:, None]).reshape(s, k, d).sum(axis=1)
-    return out.reshape(b, t, d).astype(x.dtype)
+    return out.astype(xs.dtype)                                # [S, D]
 
 
 def _mlp(x, layer, cfg: ModelConfig):

@@ -385,6 +385,14 @@ def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
     g = h // h_kv
     quantized = k_scales is not None
     scale = float(scale if scale is not None else d ** -0.5)
+    # the kernel's DMA start/wait chain assumes every sequence owns at
+    # least one live page (a zero-len row would orphan the predecessor's
+    # prefetched first-page copy — silent corruption, not a crash).  The
+    # engine always passes lens >= 1 (idle slots point at the trash
+    # page); enforce the contract here so any other caller is safe too —
+    # a clamped row attends over one trash-page token and its output is
+    # never read.
+    seq_lens = jnp.maximum(seq_lens, 1)
     kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
     vp = v_pages.reshape(-1, page_size, h_kv, d)
 

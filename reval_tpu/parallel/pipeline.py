@@ -76,6 +76,21 @@ def pp_param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
 
 def shard_params_pp(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     specs = pp_param_specs(params, cfg, mesh)
+    if jax.default_backend() == "cpu":
+        # XLA:CPU check-fails ("Invalid binary instruction opcode copy",
+        # hlo_instruction.cc) compiling bf16 dots inside this module's
+        # nested while loops (scan-over-layers inside the GPipe fori_loop
+        # inside shard_map) — the same dots compile fine under plain jit
+        # (the static/paged engines run bf16 on CPU), so this is
+        # pp-program-specific; reduced toys hit either this fatal or
+        # "UNIMPLEMENTED: unsupported operand type BF16 in op dot".  On
+        # the CPU backend (virtual-mesh validation only) run the pp
+        # engine in f32: upcast bf16 leaves, which makes the activations
+        # (and KV cache dtype, derived from embed) f32 too.  s4 weight
+        # stacks are unaffected and stay s4.
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
+            params)
     return jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params, specs,

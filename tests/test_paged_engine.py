@@ -369,8 +369,19 @@ class TestMemoryUtilization:
         budget = int(0.5 * limit) - weight_bytes - (1 << 30)
         want = budget // (PAGE * per_token)
         assert want > 3, "test must exercise the formula, not the clamp"
+        # pages past what the slots can address are unreachable HBM
+        # (advisor r4): the pool caps at 1 + slots * max_pages_per_seq
+        want = min(want, 1 + 2 * (256 // PAGE))
         assert eng.num_pages == want
         eng.close()
+
+    def test_memory_utilization_range_validated(self, tiny):
+        cfg, params = tiny
+        for bad in (0.0, -0.5, 1.5):
+            with pytest.raises(ValueError, match="memory_utilization"):
+                PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                               page_size=PAGE, max_seq_len=256,
+                               memory_utilization=bad)
 
     def test_no_stats_falls_back_to_full_reservation(self, tiny, monkeypatch):
         cfg, params = tiny

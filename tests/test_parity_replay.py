@@ -54,3 +54,22 @@ def test_full_sweep_cli_smoke():
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 2
     assert "no reference logs" in r.stdout
+
+
+@pytest.mark.slow
+def test_full_12_row_sweep_reproduces():
+    """Round-4 verdict item 9: ALL committed reference trailers reproduce,
+    not just the 3 representative rows above — the full sweep (coverage/
+    path/output x direct/cot x temps, state direct+cot) as one slow-tier
+    gate whenever the reference tree is present."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parity_replay.py"),
+         "--reference", REFERENCE],
+        capture_output=True, text=True, timeout=1800, cwd=REPO)
+    assert r.returncode == 0, f"stdout:\n{r.stdout[-4000:]}\nstderr:\n{r.stderr[-2000:]}"
+    # the tool prints one "ok" line per replayed row; every committed row
+    # must replay (a SKIP would silently shrink the oracle)
+    lines = r.stdout.splitlines()
+    ok = sum(1 for l in lines if l.startswith("ok"))
+    skipped = [l for l in lines if l.startswith("SKIP")]
+    assert ok >= 12 and not skipped, r.stdout[-4000:]

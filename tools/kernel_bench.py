@@ -103,6 +103,11 @@ def main() -> None:
             live_pages = (args.ctx + p - 1) // p
             elt = 1 if scales else 2
             gb = (2 * b * live_pages * p * h_kv * d * elt * args.layers) / 1e9
+            if scales:
+                # the f32 K/V scale arrays are real traffic too — without
+                # them the int8 rows understate their GB/s in the very
+                # artifact that decides the default backend
+                gb += (2 * b * live_pages * p * h_kv * 4 * args.layers) / 1e9
             print(f"{label:14s} {ms:8.3f} ms/step   {gb / (ms / 1000):6.1f} GB/s "
                   f"effective")
             ok_count += 1
