@@ -63,6 +63,10 @@ run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq pyth
 # int8 pool halves KV reads AND lets 64 slots fit → weight reads amortise
 # over 2x the batch
 run bench_direct_kv8s64.json 2400 json python bench.py --kv-dtype int8 --slots 64 --skip-serial --skip-ab
+# 4. speculative decoding measure-or-cut (round-4 verdict item 3): the
+#    spec path is deleted this round unless a number lands, so its A/B
+#    outranks the diagnosis steps
+run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
 # -- diagnosis + official numbers --------------------------------------
 run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
 run bench_direct.json    2400 json python bench.py
@@ -72,7 +76,6 @@ run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial 
 run bench_cot_kv8.json   3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
 run fleet.json           2400 json python tools/fleet_bench.py
 run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
-run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
 run bench_cot_spec.json  3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
 run ablate2.txt          1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants chunk,page
 run ablate_int8.txt      1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8 --variants core,seq
