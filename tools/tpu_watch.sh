@@ -31,6 +31,8 @@ while true; do
   else
     echo "$ts wedged" >> tpu_watch/probe.log
     rm -f tpu_watch/ALIVE
-    sleep 240
+    # a wedged probe already blocks 45 s; a long sleep on top can eat
+    # 4+ minutes of a ~19-minute tunnel window before the runbook starts
+    sleep 90
   fi
 done
