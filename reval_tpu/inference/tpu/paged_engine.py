@@ -218,11 +218,11 @@ class PagedTPUEngine:
         self._prefix_len = 0          # tokens covered by the shared prefix
         self._prefix_ctx = None       # its KVCache [L, 1, Tpre, H_kv, D]
         self._jit_chunk = jax.jit(
-            partial(self._decode_chunk, cfg=cfg),
+            partial(self._decode_chunk, cfg=cfg, mesh=mesh),
             static_argnames=("steps", "filtered"),
             donate_argnames=("cache",))
         self._jit_spec = jax.jit(
-            partial(self._spec_chunk, cfg=cfg),
+            partial(self._spec_chunk, cfg=cfg, mesh=mesh),
             static_argnames=("rounds", "k"), donate_argnames=("cache",))
 
     @staticmethod
@@ -306,7 +306,8 @@ class PagedTPUEngine:
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
     def _decode_chunk(params, state, cache, sampling,
-                      *, cfg: ModelConfig, steps: int, filtered: bool = False):
+                      *, cfg: ModelConfig, steps: int, filtered: bool = False,
+                      mesh=None):
         """``steps`` paged decode iterations for the whole slot batch.
 
         ``state`` packs the whole per-chunk loop state into ONE int32
@@ -334,7 +335,7 @@ class PagedTPUEngine:
         def body(carry, _):
             token, cache, lens, pos = carry
             logits, cache = paged_decode_step(params, cfg, token, block_tables,
-                                              lens, cache)
+                                              lens, cache, mesh=mesh)
             if filtered:    # static: default chunks carry no [B, V] sort
                 logits = filter_logits(logits, sampling[:, 2].astype(jnp.int32),
                                        sampling[:, 1], temperature)
@@ -352,7 +353,7 @@ class PagedTPUEngine:
 
     @staticmethod
     def _spec_chunk(params, last, hist, n_tok, tables, lens, cache,
-                    *, cfg: ModelConfig, rounds: int, k: int):
+                    *, cfg: ModelConfig, rounds: int, k: int, mesh=None):
         """``rounds`` greedy draft+verify rounds (models/spec.py) as one
         jitted program: same one-dispatch-per-chunk host cost as
         ``_decode_chunk``, emitting 1..k+1 tokens per round per slot."""
@@ -361,7 +362,8 @@ class PagedTPUEngine:
         def body(carry, _):
             last, hist, n_tok, lens, cache = carry
             out, n_out, last, hist, n_tok, lens, cache = spec_round(
-                params, cfg, last, hist, n_tok, tables, lens, cache, k)
+                params, cfg, last, hist, n_tok, tables, lens, cache, k,
+                mesh=mesh)
             return (last, hist, n_tok, lens, cache), (out, n_out)
 
         (last, hist, n_tok, lens, cache), (outs, n_outs) = jax.lax.scan(

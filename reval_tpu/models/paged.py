@@ -131,16 +131,80 @@ def _layer_scales(cache: PagedKVCache, i: int):
     return None, None
 
 
+def _attention_tp_manual(q2, ki, vi, block_tables, attn_lens, ks_i, vs_i,
+                         *, page: int, cfg: ModelConfig, win, mesh):
+    """Dispatch paged attention, manually sharded over ``tp`` when a mesh
+    is present.
+
+    Mosaic custom calls cannot be GSPMD-auto-partitioned ("Please wrap
+    the call in a shard_map" on a real multi-chip compile) — the CPU
+    virtual mesh never catches this because interpret mode traces plain
+    HLO, which GSPMD happily partitions; the deviceless AOT tier
+    (tests/test_tpu_aot_compile.py) did.  Attention is embarrassingly
+    parallel over heads, so a partial-manual shard_map over ``tp`` alone
+    needs no collectives inside: each shard runs the kernel on its local
+    query heads against its local (kv-divisible) or replicated
+    (indivisible) KV slice, mirroring exactly the shardings
+    ``param_specs``/``paged_cache_spec`` chose for the operands.
+    """
+    call = partial(paged_decode_attention, page_size=page,
+                   scale=cfg.attn_scale, window=win,
+                   softcap=cfg.attn_softcap)
+    if mesh is None:
+        return call(q2, ki, vi, block_tables, attn_lens,
+                    k_scales=ks_i, v_scales=vs_i)
+    from ..parallel.mesh import mesh_axis_sizes
+
+    if mesh_axis_sizes(mesh).get("tp", 1) == 1:
+        return call(q2, ki, vi, block_tables, attn_lens,
+                    k_scales=ks_i, v_scales=vs_i)
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import _divisible
+
+    div = _divisible(cfg, mesh)
+    # q may shard over heads ONLY when the per-shard query heads still
+    # line up with their kv groups: either the kv heads shard the same
+    # way, or there is a single kv head every query head maps to (MQA).
+    # With kv replicated and h_kv > 1, a head-sharded q would make the
+    # kernel recompute g from local shapes and pair query heads with the
+    # wrong kv heads — silently wrong logits, so fall back to
+    # replicated q (param_specs replicates q_w in that case too).
+    q_shardable = div["heads"] and (div["kv_heads"] or cfg.num_kv_heads == 1)
+    q_spec = P(None, "tp", None) if q_shardable else P(None, None, None)
+    kv_spec = P(None, "tp", None) if div["kv_heads"] else P(None, None, None)
+    sc_spec = P(None, "tp") if div["kv_heads"] else P(None, None)
+    in_specs = [q_spec, kv_spec, kv_spec, P(), P()]
+    args = [q2, ki, vi, block_tables, attn_lens]
+    if ks_i is not None:
+        in_specs += [sc_spec, sc_spec]
+        args += [ks_i, vs_i]
+
+    def local(q_, k_, v_, bt_, sl_, *scales):
+        ks_, vs_ = scales if scales else (None, None)
+        return call(q_, k_, v_, bt_, sl_, k_scales=ks_, v_scales=vs_)
+
+    # check_vma=False: pallas_call's out_shape is a plain ShapeDtypeStruct
+    # with no varying-axes metadata, which the vma checker rejects inside
+    # a manual region; correctness here is by construction (head-parallel,
+    # no cross-shard dataflow)
+    return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=q_spec, axis_names={"tp"},
+                         check_vma=False)(*args)
+
+
 def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
                       block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-                      cache: PagedKVCache) -> tuple[jnp.ndarray, PagedKVCache]:
+                      cache: PagedKVCache,
+                      mesh=None) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step at per-sequence positions.
 
     tokens: [B, 1] — next input token per slot; its position is
     ``seq_lens[b]`` (the current length, 0-indexed), so the caller advances
     ``seq_lens`` by one *after* the step.  block_tables: [B, max_pages];
     idle slots should point at the trash page with ``seq_lens == 1``.
-    Returns (logits [B, V], updated cache).
+    ``mesh``: the engine's mesh when tp-sharded (see
+    :func:`_attention_tp_manual`).  Returns (logits [B, V], updated cache).
     """
     page = cache.page_size
     h = _embed(params, cfg, tokens)
@@ -174,10 +238,9 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
                 vi = cache.v[i].at[flat_pos].set(v[:, 0].astype(cache.dtype))
             new_k.append(ki)
             new_v.append(vi)
-            attn = paged_decode_attention(
-                q[:, 0], ki, vi, block_tables, attn_lens, page_size=page,
-                scale=cfg.attn_scale, window=cfg.window_for_layer(i),
-                softcap=cfg.attn_softcap, k_scales=ks_i, v_scales=vs_i)
+            attn = _attention_tp_manual(
+                q[:, 0], ki, vi, block_tables, attn_lens, ks_i, vs_i,
+                page=page, cfg=cfg, win=cfg.window_for_layer(i), mesh=mesh)
             return attn[:, None]
 
         h = _block(h, layer, cfg, cos, sin, attend)

@@ -35,16 +35,17 @@ import jax.numpy as jnp
 
 from .configs import ModelConfig
 from .model import _block, _embed, _norm, _unembed
-from .paged import PagedKVCache, _layer_scales, _quantize_kv
+from .paged import (PagedKVCache, _attention_tp_manual, _layer_scales,
+                    _quantize_kv)
 from ..ops import rope_angles
-from ..ops.pallas_attention import paged_decode_attention
 
 __all__ = ["paged_verify_step", "draft_ngram", "spec_round"]
 
 
 def paged_verify_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
                       block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-                      cache: PagedKVCache) -> tuple[jnp.ndarray, PagedKVCache]:
+                      cache: PagedKVCache,
+                      mesh=None) -> tuple[jnp.ndarray, PagedKVCache]:
     """K-token step: ``tokens`` [B, K] occupy positions
     ``seq_lens + [0..K)``; returns logits [B, K, V] and the cache with
     all K positions' KV written.
@@ -90,10 +91,9 @@ def paged_verify_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
             new_k.append(ki)
             new_v.append(vi)
             qf = q.reshape(b * k, *q.shape[2:])
-            attn = paged_decode_attention(
-                qf, ki, vi, tables_rep, attn_lens, page_size=page,
-                scale=cfg.attn_scale, window=cfg.window_for_layer(i),
-                softcap=cfg.attn_softcap, k_scales=ks_i, v_scales=vs_i)
+            attn = _attention_tp_manual(
+                qf, ki, vi, tables_rep, attn_lens, ks_i, vs_i,
+                page=page, cfg=cfg, win=cfg.window_for_layer(i), mesh=mesh)
             return attn.reshape(b, k, *attn.shape[1:])
 
         h = _block(h, layer, cfg, cos, sin, attend)
@@ -129,7 +129,7 @@ def draft_ngram(hist: jnp.ndarray, n_tok: jnp.ndarray, k: int) -> jnp.ndarray:
 def spec_round(params, cfg: ModelConfig, last_token: jnp.ndarray,
                hist: jnp.ndarray, n_tok: jnp.ndarray,
                block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-               cache: PagedKVCache, k: int):
+               cache: PagedKVCache, k: int, mesh=None):
     """One draft+verify round (greedy).
 
     last_token [B, 1] is the pending input token (position ``seq_lens``).
@@ -140,7 +140,7 @@ def spec_round(params, cfg: ModelConfig, last_token: jnp.ndarray,
     cand = draft_ngram(hist, n_tok, k)                       # [B, k]
     feed = jnp.concatenate([last_token, cand], axis=1)       # [B, k+1]
     logits, cache = paged_verify_step(params, cfg, feed, block_tables,
-                                      seq_lens, cache)
+                                      seq_lens, cache, mesh=mesh)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k+1]
     # greedy[:, j] = model's token AFTER feed[:, j]; candidate j (=feed
     # j+1) is accepted iff it equals greedy[:, j] and all before matched

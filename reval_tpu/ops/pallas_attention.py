@@ -65,7 +65,16 @@ def _scale_rows(s_ph, g: int):
     """[P, H_kv] per-(token, head) scales → a [H, P] multiplier aligned
     with the [H, P] score/prob layout (kv-head scales repeat over the
     g query heads of their group)."""
-    t = s_ph.T[:, None, :]                                 # [H_kv, 1, P]
+    return _scale_rows_t(s_ph.T, g)
+
+
+def _scale_rows_t(s_hp, g: int):
+    """Transposed variant: [H_kv, P] scale page → [H, P] multiplier.
+    The seq kernel stores scale pages head-major so their HBM→VMEM DMA
+    slices end on the lane-aligned P dim (a [.., P, H_kv] layout has a
+    sub-lane minor dim that Mosaic's memref slicing rejects: "Slice
+    shape along dimension 2 must be aligned to tiling (128)")."""
+    t = s_hp[:, None, :]                                   # [H_kv, 1, P]
     return jnp.broadcast_to(
         t, (t.shape[0], g, t.shape[2])).reshape(-1, t.shape[2])
 
@@ -348,8 +357,8 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
         v = v_buf[slot].astype(jnp.float32)
         ks_hp = vs_hp = None
         if quantized:
-            ks_hp = _scale_rows(ks_buf[slot], g)
-            vs_hp = _scale_rows(vs_buf[slot], g)
+            ks_hp = _scale_rows_t(ks_buf[slot], g)             # [H_kv, P]
+            vs_hp = _scale_rows_t(vs_buf[slot], g)
         s = _page_scores(q, k, scale, softcap, valid, h_kv, g, ks_hp)
         _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g, vs_hp)
         return carry
@@ -409,10 +418,14 @@ def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
     n_sems = 2
     if quantized:
         in_specs += [any_spec, any_spec]
-        operands += [k_scales.reshape(-1, page_size, h_kv),
-                     v_scales.reshape(-1, page_size, h_kv)]
-        scratch += [pltpu.VMEM((2, page_size, h_kv), jnp.float32),
-                    pltpu.VMEM((2, page_size, h_kv), jnp.float32)]
+        # head-major [N, H_kv, P] pages: the DMA's minor dim must be the
+        # lane-aligned P (see _scale_rows_t); the transpose is a few MB
+        # over the whole pool, noise next to the page reads themselves
+        operands += [
+            k_scales.reshape(-1, page_size, h_kv).transpose(0, 2, 1),
+            v_scales.reshape(-1, page_size, h_kv).transpose(0, 2, 1)]
+        scratch += [pltpu.VMEM((2, h_kv, page_size), jnp.float32),
+                    pltpu.VMEM((2, h_kv, page_size), jnp.float32)]
         n_sems = 4
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sems)))
     scratch += [
@@ -495,6 +508,13 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     gather formulation is what CPU uses; ``pallas_seq`` selects the
     per-sequence streaming kernel (pending on-chip A/B before it becomes
     the TPU default).
+
+    ``REVAL_TPU_FORCE_MOSAIC=1`` forces ``interpret=False`` even when the
+    runtime backend is CPU: deviceless AOT compiles for a TPU *topology*
+    (tests/test_tpu_aot_compile.py, tools/aot_warm.py) run on a CPU host,
+    and keying interpret on ``jax.default_backend()`` would silently
+    trace the HLO emulation instead of the Mosaic kernel — compiling a
+    program the chip never runs.
     """
     import os
 
@@ -516,7 +536,9 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         # an explicitly-chosen Pallas kernel off-TPU runs in interpret
         # mode: slow, but it lets the whole engine path execute the real
         # kernel on CPU (end-to-end validation without a chip)
-        kw["interpret"] = jax.default_backend() != "tpu"
+        force = os.environ.get("REVAL_TPU_FORCE_MOSAIC", "").lower()
+        kw["interpret"] = (jax.default_backend() != "tpu"
+                           and force not in ("1", "true"))
     return fn(q, k_pages, v_pages, block_tables, seq_lens,
               page_size=page_size, scale=scale, window=window,
               softcap=softcap, k_scales=k_scales, v_scales=v_scales, **kw)

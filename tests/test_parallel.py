@@ -91,3 +91,24 @@ class TestShardedGenerationParity:
         base = single.generate(prompts, max_new_tokens=8)
         multi = sharded.generate(prompts, max_new_tokens=8)
         assert base == multi
+
+    def test_paged_parity_gqa_heads_divisible_kv_not(self):
+        """tp divides the query heads but NOT the kv heads (h=4, h_kv=2,
+        tp=4): the tp-manual attention wrapper must fall back to
+        replicated q — a head-sharded q against replicated kv silently
+        pairs query heads with the wrong kv groups (advisor round-5)."""
+        from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+
+        cfg = tiny_cfg()              # h=4, h_kv=2; tp=4 → kv indivisible
+        params = init_random_params(cfg, seed=5, dtype="float32")
+        prompts = ["def f(x):", "assert f(", "b" * 60]
+        single = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=3,
+                                page_size=64, max_seq_len=256)
+        base = single.generate(prompts, max_new_tokens=8)
+        single.close()
+        sharded = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=3,
+                                 page_size=64, max_seq_len=256,
+                                 mesh=make_mesh(tp=4))
+        multi = sharded.generate(prompts, max_new_tokens=8)
+        sharded.close()
+        assert base == multi

@@ -1,0 +1,125 @@
+"""Pre-pay TPU compile time before a tunnel window opens.
+
+The deviceless PJRT topology (`jax.experimental.topologies`) produces
+real XLA:TPU executables on this host with no chip, and those compiles
+land in the persistent compile cache — the same cache
+(`$JAX_COMPILATION_CACHE_DIR`, default matching tools/chip_runbook.sh)
+the on-chip runbook benches read.  If the runtime cache key matches, a
+~19-minute tunnel window spends its time MEASURING instead of
+compiling; if it doesn't match, the cost is only host CPU spent here.
+
+Warms the decode-chunk programs of the runbook's decision set at their
+exact runtime shapes (deepseek-coder-1.3b dims, spans/steps the engine
+buckets to):
+
+    backend {grid, seq} x kv {bf16, int8} x slots {32, 64}
+    x steps {8, 32}, plus the int8-weight variant of the default.
+
+Usage: python tools/aot_warm.py [--cache-dir DIR] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cache-dir",
+                    default=os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                           "/root/.cache/jax_comp"))
+    ap.add_argument("--quick", action="store_true",
+                    help="default config only (one backend, bf16, 32 slots)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", args.cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # the dispatcher keys interpret mode on the RUNTIME backend (cpu on
+    # this host) — force the Mosaic kernel or every warmed executable
+    # would contain the HLO emulation and never match an on-chip key
+    os.environ["REVAL_TPU_FORCE_MOSAIC"] = "1"
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.models import (init_random_params, quantize_params,
+                                  zoo_config)
+    from reval_tpu.models.paged import init_paged_cache
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
+    rep = NamedSharding(mesh, P())
+
+    def shaped(tree):
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep),
+            tree)
+
+    cfg = zoo_config("deepseek-coder-1.3b")
+    cfg.dtype = "bfloat16"
+    params_bf16 = shaped(jax.eval_shape(
+        lambda: init_random_params(cfg, seed=0, dtype="bfloat16")))
+    params_int8 = shaped(jax.eval_shape(
+        lambda: quantize_params(init_random_params(cfg, seed=0,
+                                                   dtype="bfloat16"))))
+
+    # the engine pow2-buckets the table span; bench prompts (~500 tok) +
+    # 256 new land in bucket 8 (paged_engine.pow2_bucket)
+    span = 8
+
+    def chunk_args(slots, kv_dtype, params):
+        # bench.py default pool: 1 + slots * per_seq + 16, per_seq ~7
+        num_pages = 1 + slots * 7 + 16
+        cache = shaped(jax.eval_shape(
+            lambda: init_paged_cache(cfg, num_pages=num_pages, page_size=128,
+                                     dtype=jnp.bfloat16, kv_dtype=kv_dtype)))
+        state = jax.ShapeDtypeStruct((slots, span + 5), jnp.int32,
+                                     sharding=rep)
+        sampling = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
+        return params, state, cache, sampling
+
+    jobs = [("grid", "", 32, "bf16w")]
+    if not args.quick:
+        jobs += [
+            ("pallas_seq", "", 32, "bf16w"),
+            ("grid", "int8", 64, "bf16w"),
+            ("pallas_seq", "int8", 64, "bf16w"),
+            ("grid", "", 32, "int8w"),
+        ]
+
+    failures = 0
+    for backend, kv_dtype, slots, wdtype in jobs:
+        os.environ["REVAL_TPU_PAGED_BACKEND"] = (
+            "pallas" if backend == "grid" else backend)
+        params = params_int8 if wdtype == "int8w" else params_bf16
+        for steps in (8, 32):
+            label = f"{backend}/kv={kv_dtype or 'bf16'}/s{slots}/{wdtype}/steps{steps}"
+            fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
+                         filtered=False)
+            t0 = time.time()
+            try:
+                (jax.jit(fn, donate_argnames=("cache",))
+                 .lower(*chunk_args(slots, kv_dtype, params)).compile())
+                print(f"warmed {label} in {time.time() - t0:.0f}s", flush=True)
+            except Exception as e:
+                failures += 1
+                print(f"FAILED {label}: {type(e).__name__}: {str(e)[:200]}",
+                      flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
