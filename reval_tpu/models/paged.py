@@ -184,13 +184,18 @@ def _attention_tp_manual(q2, ki, vi, block_tables, attn_lens, ks_i, vs_i,
         ks_, vs_ = scales if scales else (None, None)
         return call(q_, k_, v_, bt_, sl_, k_scales=ks_, v_scales=vs_)
 
+    # Manual over ALL mesh axes (the default), not just {"tp"}: Mosaic
+    # rejects custom calls whose manual axes are any strict subset of the
+    # mesh's axis names, and make_mesh keeps singleton (dp, pp, sp, ep)
+    # axes — a partial-manual region over {"tp"} compiles only on
+    # single-axis meshes.  The specs place only "tp"; every other axis is
+    # replicated (the paged engine is tp-only by contract).
     # check_vma=False: pallas_call's out_shape is a plain ShapeDtypeStruct
     # with no varying-axes metadata, which the vma checker rejects inside
     # a manual region; correctness here is by construction (head-parallel,
     # no cross-shard dataflow)
     return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                         out_specs=q_spec, axis_names={"tp"},
-                         check_vma=False)(*args)
+                         out_specs=q_spec, check_vma=False)(*args)
 
 
 def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
