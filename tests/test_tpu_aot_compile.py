@@ -237,6 +237,27 @@ def test_ring_attention_sp8_compiles_v5e8():
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 
+@pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
+def test_kernel_window_softcap_aot_compiles_v5e(backend):
+    """gemma-2's sliding window + score softcap variants, through real
+    Mosaic codegen (the export tier covers lowering only)."""
+    from reval_tpu.ops.pallas_attention import (
+        paged_decode_attention_pallas, paged_decode_attention_pallas_seq)
+
+    kernel = (paged_decode_attention_pallas if backend == "pallas"
+              else paged_decode_attention_pallas_seq)
+    topo = _topology("v5e:2x2")
+    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
+    q, kp, bt, sl = _kernel_operands(mesh, 16, 4)
+
+    def f(q, kp, vp, bt, sl):
+        return kernel(q, kp, vp, bt, sl, page_size=PAGE,
+                      window=4096, softcap=50.0)
+
+    compiled = jax.jit(f).lower(q, kp, kp, bt, sl).compile()
+    assert compiled.memory_analysis().temp_size_in_bytes >= 0
+
+
 def test_spec_chunk_compiles_v5e(monkeypatch):
     """The speculative draft+verify chunk program: its chip viability
     must be proven before any tunnel window runs the spec A/B
