@@ -10,17 +10,18 @@ VMEM, window scheduling, SPMD partitioning of the collectives the
 multi-chip engines rely on).  Execution and timing still need silicon;
 everything up to that runs here.
 
-The flagship case compiles the EXACT bench decode-chunk program at
-deepseek-coder-1.3b dims and asserts XLA's own memory analysis fits a
-16 GB v5e next to the page pool — the strongest chip-free form of the
-"does the bench config actually fit" claim.  Inputs are
-ShapeDtypeStructs (no host weight materialisation), so the 1.3b compile
-costs seconds of RAM, not gigabytes.
+The engine/bench programs come from ``tools/aot_programs`` — the SAME
+builders ``tools/aot_warm.py`` (compile-cache pre-warming) and
+``tools/aot_certify.py`` (the recorded-evidence artifact) use, so the
+shapes asserted here are the shapes warmed and certified.  Inputs are
+ShapeDtypeStructs (no host weight materialisation), so the 1.3b/34B
+compiles cost seconds of RAM, not gigabytes.
 """
 
 from __future__ import annotations
 
-from functools import partial
+import os
+import sys
 
 import numpy as np
 import pytest
@@ -29,35 +30,34 @@ pytestmark = pytest.mark.slow
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import aot_programs
 
 
 def _topology(name: str):
-    from jax.experimental import topologies
-
     try:
-        return topologies.get_topology_desc(platform="tpu",
-                                            topology_name=name)
+        return aot_programs.topology(name)
     except Exception as e:  # libtpu or the topology API unavailable
         pytest.skip(f"deviceless TPU topology {name!r} unavailable: {e}")
 
 
-def _replicated(mesh: Mesh):
-    return NamedSharding(mesh, P())
+def _build(builder, **kw):
+    """Run a shared program builder, skipping (not failing) when the
+    deviceless topology itself is unavailable on this host."""
+    _topology("v5e:2x2")
+    return builder(**kw)
 
 
-def _shaped(tree, sharding):
-    """Map a pytree of arrays/ShapeDtypeStructs to sharded ShapeDtypeStructs."""
-    return jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
-        tree)
-
+# -- raw kernels (structure variants not covered by the engine programs) ----
 
 B, PAGE, NPAGES, SPAN, D = 4, 128, 24, 6, 128
 
 
 def _kernel_operands(mesh, h, h_kv, store_dtype=jnp.bfloat16):
-    rep = _replicated(mesh)
+    rep = aot_programs._replicated(mesh)
     q = jax.ShapeDtypeStruct((B, h, D), jnp.bfloat16, sharding=rep)
     kp = jax.ShapeDtypeStruct((NPAGES * PAGE, h_kv, D), store_dtype,
                               sharding=rep)
@@ -66,14 +66,18 @@ def _kernel_operands(mesh, h, h_kv, store_dtype=jnp.bfloat16):
     return q, kp, bt, sl
 
 
-@pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
-@pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4)])
-def test_kernel_aot_compiles_v5e(backend, h, h_kv):
+def _kernel_for(backend):
     from reval_tpu.ops.pallas_attention import (
         paged_decode_attention_pallas, paged_decode_attention_pallas_seq)
 
-    kernel = (paged_decode_attention_pallas if backend == "pallas"
-              else paged_decode_attention_pallas_seq)
+    return (paged_decode_attention_pallas if backend == "pallas"
+            else paged_decode_attention_pallas_seq)
+
+
+@pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
+@pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4)])
+def test_kernel_aot_compiles_v5e(backend, h, h_kv):
+    kernel = _kernel_for(backend)
     topo = _topology("v5e:2x2")
     mesh = Mesh(np.array(topo.devices[:1]), ("x",))
     q, kp, bt, sl = _kernel_operands(mesh, h, h_kv)
@@ -87,14 +91,10 @@ def test_kernel_aot_compiles_v5e(backend, h, h_kv):
 
 @pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
 def test_kernel_int8_pool_aot_compiles_v5e(backend):
-    from reval_tpu.ops.pallas_attention import (
-        paged_decode_attention_pallas, paged_decode_attention_pallas_seq)
-
-    kernel = (paged_decode_attention_pallas if backend == "pallas"
-              else paged_decode_attention_pallas_seq)
+    kernel = _kernel_for(backend)
     topo = _topology("v5e:2x2")
     mesh = Mesh(np.array(topo.devices[:1]), ("x",))
-    rep = _replicated(mesh)
+    rep = aot_programs._replicated(mesh)
     h, h_kv = 16, 4
     q, kp, bt, sl = _kernel_operands(mesh, h, h_kv, store_dtype=jnp.int8)
     sc = jax.ShapeDtypeStruct((NPAGES * PAGE, h_kv), jnp.float32, sharding=rep)
@@ -107,62 +107,30 @@ def test_kernel_int8_pool_aot_compiles_v5e(backend):
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 
-def _flagship_model_parts(mesh, *, num_pages=241, kv_dtype=""):
-    """1.3b-dims (cfg, params, cache) as replicated ShapeDtypeStructs —
-    the model half of the EXACT bench default program (bench.py sizes
-    the pool the same way)."""
-    from reval_tpu.models import init_random_params, zoo_config
-    from reval_tpu.models.paged import init_paged_cache
+@pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
+def test_kernel_window_softcap_aot_compiles_v5e(backend):
+    """gemma-2's sliding window + score softcap variants, through real
+    Mosaic codegen (the export tier covers lowering only)."""
+    kernel = _kernel_for(backend)
+    topo = _topology("v5e:2x2")
+    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
+    q, kp, bt, sl = _kernel_operands(mesh, 16, 4)
 
-    cfg = zoo_config("deepseek-coder-1.3b")
-    cfg.dtype = "bfloat16"
-    rep = _replicated(mesh)
-    params = _shaped(
-        jax.eval_shape(lambda: init_random_params(cfg, seed=0,
-                                                  dtype="bfloat16")), rep)
-    cache = _shaped(
-        jax.eval_shape(lambda: init_paged_cache(cfg, num_pages=num_pages,
-                                                page_size=128,
-                                                dtype=jnp.bfloat16,
-                                                kv_dtype=kv_dtype)), rep)
-    return cfg, params, cache
+    def f(q, kp, vp, bt, sl):
+        return kernel(q, kp, vp, bt, sl, page_size=PAGE,
+                      window=4096, softcap=50.0)
+
+    compiled = jax.jit(f).lower(q, kp, kp, bt, sl).compile()
+    assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 
-# the engine pow2-buckets the table span (paged_engine.pow2_bucket);
-# bench prompts (~500 tok) + 256 new land in bucket 8 — span 7 would
-# compile a program the runtime never executes
-BENCH_SPAN = 8
+# -- engine/bench programs (shared builders) --------------------------------
 
-
-def _flagship_chunk_args(mesh, *, slots=32, num_pages=241, kv_dtype=""):
-    """The EXACT bench default decode-chunk operands at 1.3b dims."""
-    cfg, params, cache = _flagship_model_parts(mesh, num_pages=num_pages,
-                                               kv_dtype=kv_dtype)
-    rep = _replicated(mesh)
-    state = jax.ShapeDtypeStruct((slots, BENCH_SPAN + 5), jnp.int32,
-                                 sharding=rep)
-    sampling = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
-    return cfg, params, state, cache, sampling
-
-
-def test_flagship_decode_chunk_compiles_and_fits_v5e(monkeypatch):
+def test_flagship_decode_chunk_compiles_and_fits_v5e():
     """The bench's hot program (32 decode steps, 32 slots, grid kernel)
     fully compiles for a v5e and — by XLA's own memory analysis, cache
     donated exactly as the engine donates it — fits the 16 GB chip."""
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-
-    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
-    # the dispatcher keys interpret on the RUNTIME backend (cpu here);
-    # force the Mosaic kernel so this compiles the chip's program, not
-    # the HLO emulation
-    monkeypatch.setenv("REVAL_TPU_FORCE_MOSAIC", "1")
-    topo = _topology("v5e:2x2")
-    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
-    cfg, params, state, cache, sampling = _flagship_chunk_args(mesh)
-    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=32,
-                 filtered=False)
-    compiled = (jax.jit(fn, donate_argnames=("cache",))
-                .lower(params, state, cache, sampling).compile())
+    compiled = _build(aot_programs.compile_flagship_chunk)
     ma = compiled.memory_analysis()
     live = ma.argument_size_in_bytes + ma.temp_size_in_bytes
     # donated cache aliases the output pool, so args+temps is the
@@ -170,60 +138,45 @@ def test_flagship_decode_chunk_compiles_and_fits_v5e(monkeypatch):
     assert live <= 16 * 1024**3 * 0.9, f"{live / 2**30:.2f} GiB"
 
 
-def test_tp8_sharded_decode_chunk_compiles_v5e8(monkeypatch):
+def test_tp8_sharded_decode_chunk_compiles_v5e8():
     """The tp=8 multi-chip decode program — GSPMD partitioning plus the
-    all-reduces the tp engine relies on — compiles for a real 8-chip
-    v5e target (the v5e-8 flagship shape, BASELINE configs[3])."""
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-    from reval_tpu.models import init_random_params, zoo_config
-    from reval_tpu.models.paged import init_paged_cache
-    from reval_tpu.parallel.sharding import paged_cache_spec, param_specs
-
-    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
-    monkeypatch.setenv("REVAL_TPU_FORCE_MOSAIC", "1")
-    topo = _topology("v5e:4x2")
-    mesh = Mesh(np.array(topo.devices).reshape(8), ("tp",))
-    rep = _replicated(mesh)
-
-    cfg = zoo_config("deepseek-coder-1.3b")
-    cfg.dtype = "bfloat16"
-    specs = param_specs(
-        jax.eval_shape(lambda: init_random_params(cfg, seed=0,
-                                                  dtype="bfloat16")),
-        cfg, mesh)
-    params = jax.tree.map(
-        lambda s, sp: jax.ShapeDtypeStruct(
-            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
-        jax.eval_shape(lambda: init_random_params(cfg, seed=0,
-                                                  dtype="bfloat16")),
-        specs, is_leaf=lambda x: not isinstance(x, dict))
-    cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
-    cache = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(
-            s.shape, s.dtype,
-            sharding=cache_sharding if len(s.shape) == 3 else rep),
-        jax.eval_shape(lambda: init_paged_cache(cfg, num_pages=241,
-                                                page_size=128,
-                                                dtype=jnp.bfloat16)))
-    span, slots = 8, 32
-    state = jax.ShapeDtypeStruct((slots, span + 5), jnp.int32, sharding=rep)
-    sampling = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
-    # mesh=... engages the tp-manual shard_map around the Mosaic kernel,
-    # exactly as the engine's _jit_chunk partial does — without it GSPMD
-    # must auto-partition the custom call and the real-chip compile fails
-    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=8,
-                 filtered=False, mesh=mesh)
-    compiled = (jax.jit(fn, donate_argnames=("cache",))
-                .lower(params, state, cache, sampling).compile())
+    tp-manual Mosaic shard_map the tp engine relies on — compiles for a
+    real 8-chip v5e target (the v5e-8 flagship shape)."""
+    compiled = _build(aot_programs.compile_tp8_flagship_chunk)
     ma = compiled.memory_analysis()
     live = ma.argument_size_in_bytes + ma.temp_size_in_bytes
-    # per-chip: weights/8 (~0.34 GB) + pool/8 + replicated state
+    assert live <= 16 * 1024**3 * 0.9, f"{live / 2**30:.2f} GiB"
+
+
+def test_spec_chunk_compiles_v5e():
+    """The speculative draft+verify chunk program: its chip viability
+    must be proven before any tunnel window runs the spec A/B
+    (measure-or-cut, round-4 verdict item 3)."""
+    compiled = _build(aot_programs.compile_spec_chunk)
+    assert compiled.memory_analysis().temp_size_in_bytes >= 0
+
+
+def test_34b_northstar_decode_compiles_and_fits_v5e8():
+    """The ACTUAL north-star program (CodeLlama-34B, tp=8, weight-only
+    int4, paged decode — BASELINE configs[2]) compiled for a real 8-chip
+    v5e target, with XLA's own per-chip memory analysis asserting it
+    fits 16 GB.  The strongest chip-free form of the north-star claim:
+    everything short of execution."""
+    compiled = _build(aot_programs.compile_34b_northstar_chunk)
+    ma = compiled.memory_analysis()
+    live = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+    # XLA stores s4 packed on TPU, so this is the true per-chip resident
+    # footprint of the int4 north star next to its page pool
     assert live <= 16 * 1024**3 * 0.9, f"{live / 2**30:.2f} GiB"
 
 
 def test_ring_attention_sp8_compiles_v5e8():
     """Ring attention (sp=8 sequence parallelism): the ppermute ring must
     lower to real TPU collectives, not just run on the CPU mesh."""
+    from functools import partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from reval_tpu.parallel import ring_attention_sharded
     from reval_tpu.parallel.mesh import make_mesh
 
@@ -237,184 +190,25 @@ def test_ring_attention_sp8_compiles_v5e8():
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 
-@pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
-def test_kernel_window_softcap_aot_compiles_v5e(backend):
-    """gemma-2's sliding window + score softcap variants, through real
-    Mosaic codegen (the export tier covers lowering only)."""
-    from reval_tpu.ops.pallas_attention import (
-        paged_decode_attention_pallas, paged_decode_attention_pallas_seq)
-
-    kernel = (paged_decode_attention_pallas if backend == "pallas"
-              else paged_decode_attention_pallas_seq)
-    topo = _topology("v5e:2x2")
-    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
-    q, kp, bt, sl = _kernel_operands(mesh, 16, 4)
-
-    def f(q, kp, vp, bt, sl):
-        return kernel(q, kp, vp, bt, sl, page_size=PAGE,
-                      window=4096, softcap=50.0)
-
-    compiled = jax.jit(f).lower(q, kp, kp, bt, sl).compile()
-    assert compiled.memory_analysis().temp_size_in_bytes >= 0
-
-
-def test_spec_chunk_compiles_v5e(monkeypatch):
-    """The speculative draft+verify chunk program: its chip viability
-    must be proven before any tunnel window runs the spec A/B
-    (measure-or-cut, round-4 verdict item 3)."""
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-
-    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
-    monkeypatch.setenv("REVAL_TPU_FORCE_MOSAIC", "1")
-    topo = _topology("v5e:2x2")
-    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
-    rep = _replicated(mesh)
-    cfg, params, cache = _flagship_model_parts(mesh)
-    b, k = 32, 4
-    hist_len = 2048                       # max_pages_per_seq * page_size
-    last = jax.ShapeDtypeStruct((b, 1), jnp.int32, sharding=rep)
-    hist = jax.ShapeDtypeStruct((b, hist_len), jnp.int32, sharding=rep)
-    n_tok = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
-    tables = jax.ShapeDtypeStruct((b, BENCH_SPAN), jnp.int32, sharding=rep)
-    lens = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
-    fn = partial(PagedTPUEngine._spec_chunk, cfg=cfg, rounds=8, k=k)
-    compiled = (jax.jit(fn, donate_argnames=("cache",))
-                .lower(params, last, hist, n_tok, tables, lens, cache)
-                .compile())
-    assert compiled.memory_analysis().temp_size_in_bytes >= 0
-
-
-def test_34b_northstar_decode_compiles_and_fits_v5e8(monkeypatch):
-    """The ACTUAL north-star program (CodeLlama-34B, tp=8, weight-only
-    int4, paged decode — BASELINE configs[2]) compiled for a real 8-chip
-    v5e target, with XLA's own per-chip memory analysis asserting it
-    fits 16 GB.  The strongest chip-free form of the north-star claim:
-    everything short of execution."""
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-    from reval_tpu.models import init_random_int4, zoo_config
-    from reval_tpu.models.paged import init_paged_cache
-    from reval_tpu.parallel.mesh import make_mesh
-    from reval_tpu.parallel.sharding import paged_cache_spec, param_specs
-
-    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
-    monkeypatch.setenv("REVAL_TPU_FORCE_MOSAIC", "1")
-    topo = _topology("v5e:4x2")
-    mesh = make_mesh(tp=8, devices=np.array(topo.devices).reshape(8))
-    rep = _replicated(mesh)
-
-    cfg = zoo_config("codellama/CodeLlama-34b-Instruct-hf")
-    cfg.dtype = "bfloat16"
-    shapes = jax.eval_shape(lambda: init_random_int4(cfg, seed=0, tp=8))
-    specs = param_specs(shapes, cfg, mesh)
-    params = jax.tree.map(
-        lambda s, sp: jax.ShapeDtypeStruct(
-            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
-        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
-    cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
-    cache = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(
-            s.shape, s.dtype,
-            sharding=cache_sharding if len(s.shape) == 3 else rep),
-        jax.eval_shape(lambda: init_paged_cache(cfg, num_pages=48,
-                                                page_size=128,
-                                                dtype=jnp.bfloat16)))
-    span, slots = 8, 4            # dryrun_34b_northstar geometry
-    state = jax.ShapeDtypeStruct((slots, span + 5), jnp.int32, sharding=rep)
-    sampling = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
-    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=8,
-                 filtered=False, mesh=mesh)
-    compiled = (jax.jit(fn, donate_argnames=("cache",))
-                .lower(params, state, cache, sampling).compile())
-    ma = compiled.memory_analysis()
-    live = ma.argument_size_in_bytes + ma.temp_size_in_bytes
-    # XLA stores s4 packed on TPU, so this is the true per-chip resident
-    # footprint of the int4 north star next to its page pool
-    assert live <= 16 * 1024**3 * 0.9, f"{live / 2**30:.2f} GiB"
-
-
-def _70b_pp_setup():
-    """(mesh, cfg, params) for the v5p-16 pp=2 x tp=8 CodeLlama-70B
-    program (BASELINE configs[4]) — shared by the prefill and decode
-    compile tests so both certify the same sharding recipe."""
-    from reval_tpu.models import init_random_int4, zoo_config
-    from reval_tpu.parallel.mesh import make_mesh
-    from reval_tpu.parallel.pipeline import pp_param_specs
-
-    topo = _topology("v5p:4x2x2")
-    mesh = make_mesh(pp=2, tp=8, devices=np.array(topo.devices).reshape(16))
-    cfg = zoo_config("codellama/CodeLlama-70b-Instruct-hf")
-    cfg.num_layers = 2
-    cfg.dtype = "bfloat16"
-    shapes = jax.eval_shape(lambda: init_random_int4(cfg, seed=0, tp=8))
-    specs = pp_param_specs(shapes, cfg, mesh)
-    params = jax.tree.map(
-        lambda s, sp: jax.ShapeDtypeStruct(
-            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
-        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
-    return mesh, cfg, params
-
-
 def test_70b_pp_tp_prefill_compiles_v5p16():
     """BASELINE configs[4]: the pipeline (pp=2 x tp=8) GPipe prefill at
-    CodeLlama-70B widths (2 of 80 layers — compile cares about structure
-    and width, not depth) compiles for a 16-device v5p target, including
+    CodeLlama-70B widths compiles for a 16-device v5p target, including
     the shard_map collectives and int4 weight stacks."""
-    from reval_tpu.models import init_random_int4, zoo_config
-    from reval_tpu.models.model import KVCache
-    from reval_tpu.parallel.pipeline import pipeline_prefill
-
-    mesh, cfg, params = _70b_pp_setup()
-
-    b, t, mb = 4, 128, 2
-    n_micro = b // mb
-    rows = b + mb                 # fill/drain scratch rows (pipeline.py)
-    cache_shape = (cfg.num_layers, rows, t, cfg.num_kv_heads, cfg.head_dim)
-    cache_sharding = NamedSharding(mesh, P("pp"))
-    cache = KVCache(
-        k=jax.ShapeDtypeStruct(cache_shape, jnp.bfloat16,
-                               sharding=cache_sharding),
-        v=jax.ShapeDtypeStruct(cache_shape, jnp.bfloat16,
-                               sharding=cache_sharding))
-    rep = NamedSharding(mesh, P())
-    tokens = jax.ShapeDtypeStruct((b, t), jnp.int32, sharding=rep)
-    pad = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
-    fn = partial(pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=n_micro)
-    compiled = jax.jit(fn).lower(params, tokens=tokens, pad_len=pad,
-                                 cache=cache).compile()
+    compiled = _build(aot_programs.compile_70b_prefill)
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 
 def test_70b_pp_tp_decode_compiles_v5p16():
     """The 70B token-ring DECODE chunk (the half of the pp path the
-    prefill test above doesn't cover) compiles for the v5p-16 target."""
-    from reval_tpu.inference.tpu.pp_engine import PipelinedTPUEngine
-    from reval_tpu.models.model import KVCache
-
-    mesh, cfg, params = _70b_pp_setup()
-
-    b, t = 4, 256
-    rows = b + b // 2             # engine's scratch-row convention
-    cache_shape = (cfg.num_layers, rows, t, cfg.num_kv_heads, cfg.head_dim)
-    cache_sharding = NamedSharding(mesh, P("pp"))
-    cache = KVCache(
-        k=jax.ShapeDtypeStruct(cache_shape, jnp.bfloat16,
-                               sharding=cache_sharding),
-        v=jax.ShapeDtypeStruct(cache_shape, jnp.bfloat16,
-                               sharding=cache_sharding))
-    rep = NamedSharding(mesh, P())
-    first = jax.ShapeDtypeStruct((b, 1), jnp.int32, sharding=rep)
-    pad = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
-    pos = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)   # scalar bucket pos
-    temp = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
-    key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
-    # the engine ALWAYS passes [B] top_k/top_p arrays (engine.py
-    # _generate_batch) — omitting them would certify an executable with
-    # two fewer parameters than the one the runtime dispatches
-    kf = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
-    pf = jax.ShapeDtypeStruct((b,), jnp.float32, sharding=rep)
-    fn = partial(PipelinedTPUEngine._pp_decode_chunk, cfg=cfg, mesh=mesh,
-                 steps=4, filtered=False)
-    compiled = (jax.jit(fn, donate_argnames=("cache",))
-                .lower(params, first, pad, cache, pos, temp, key, kf, pf)
-                .compile())
+    prefill test above doesn't cover), with the exact runtime signature
+    (the engine always passes [B] top_k/top_p rows)."""
+    compiled = _build(aot_programs.compile_70b_decode)
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
+
+
+def test_prefill_commit_programs_compile_v5e():
+    """The paged engine's prefill + page-commit programs at the bench's
+    admission-wave row buckets."""
+    pre, commit = _build(aot_programs.compile_prefill_commit, rows=4)
+    assert pre.memory_analysis().temp_size_in_bytes >= 0
+    assert commit.memory_analysis().temp_size_in_bytes >= 0

@@ -1,0 +1,350 @@
+"""Single source of truth for the deviceless-AOT program builders.
+
+tests/test_tpu_aot_compile.py (the compile-certificate test tier),
+tools/aot_warm.py (compile-cache pre-warming), and tools/aot_certify.py
+(the recorded-evidence artifact) all compile THE SAME programs the
+runtime dispatches — if each kept its own copy of the shapes, a change
+to the engine's bucketing or state layout would drift one of them into
+certifying a program the runtime never executes.  Every builder here
+returns a ``jax.stages.Compiled`` for a real TPU target, produced on a
+chip-free host via ``jax.experimental.topologies``.
+
+Shape contracts mirrored from the engine/bench:
+- the engine pow2-buckets the block-table span (paged_engine.pow2_bucket);
+  bench prompts (~500 tok) + 256 new land in bucket 8 (direct) and
+  + 1024 new in bucket 16 (cot) — packed state rows are ``span + 5``;
+- bench.py sizes the page pool as ``1 + slots * per_seq + 16`` with
+  per_seq 7 (direct) / 13 (cot);
+- prefill row groups bucket to pow2 under the 768 MB byte budget
+  (paged_engine.PREFILL_BYTE_BUDGET): 8- and 4-row batches at t=512.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BENCH_SPAN_DIRECT = 8
+BENCH_SPAN_COT = 16
+PER_SEQ_DIRECT = 7
+PER_SEQ_COT = 13
+
+
+def bench_pool(slots: int, per_seq: int) -> int:
+    """bench.py's default page-pool size for a slot count."""
+    return 1 + slots * per_seq + 16
+
+
+def _env_mosaic(backend: str = "pallas") -> None:
+    """The dispatcher keys interpret mode on the RUNTIME backend (cpu on
+    a chip-free host) — force the Mosaic kernel so these compiles target
+    the chip's program, not the HLO emulation."""
+    os.environ["REVAL_TPU_PAGED_BACKEND"] = backend
+    os.environ["REVAL_TPU_FORCE_MOSAIC"] = "1"
+
+
+def topology(name: str):
+    """Deviceless PJRT TPU topology (raises when libtpu/the topology API
+    is unavailable — tests catch and skip)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.experimental import topologies
+
+    return topologies.get_topology_desc(platform="tpu", topology_name=name)
+
+
+def _replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def _shaped(tree, sharding):
+    import jax
+
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
+        tree)
+
+
+def _single_device_mesh(topo):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(topo.devices[:1]), ("x",))
+
+
+def flagship_model_parts(mesh, *, num_pages=bench_pool(32, PER_SEQ_DIRECT),
+                         kv_dtype="", weights="bf16w"):
+    """1.3b-dims (cfg, params, cache) as replicated ShapeDtypeStructs —
+    the model half of the EXACT bench default program."""
+    import jax
+    import jax.numpy as jnp
+
+    from reval_tpu.models import (init_random_params, quantize_params,
+                                  zoo_config)
+    from reval_tpu.models.paged import init_paged_cache
+
+    cfg = zoo_config("deepseek-coder-1.3b")
+    cfg.dtype = "bfloat16"
+    rep = _replicated(mesh)
+
+    def make():
+        p = init_random_params(cfg, seed=0, dtype="bfloat16")
+        return quantize_params(p) if weights == "int8w" else p
+
+    params = _shaped(jax.eval_shape(make), rep)
+    cache = _shaped(
+        jax.eval_shape(lambda: init_paged_cache(cfg, num_pages=num_pages,
+                                                page_size=128,
+                                                dtype=jnp.bfloat16,
+                                                kv_dtype=kv_dtype)), rep)
+    return cfg, params, cache
+
+
+def compile_flagship_chunk(*, steps=32, slots=32, kv_dtype="",
+                           weights="bf16w", per_seq=PER_SEQ_DIRECT,
+                           span=BENCH_SPAN_DIRECT, backend="pallas"):
+    """The bench decode-chunk program at 1.3b dims → v5e executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+
+    _env_mosaic(backend)
+    mesh = _single_device_mesh(topology("v5e:2x2"))
+    rep = _replicated(mesh)
+    cfg, params, cache = flagship_model_parts(
+        mesh, num_pages=bench_pool(slots, per_seq), kv_dtype=kv_dtype,
+        weights=weights)
+    state = jax.ShapeDtypeStruct((slots, span + 5), jnp.int32, sharding=rep)
+    samp = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
+    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
+                 filtered=False)
+    return (jax.jit(fn, donate_argnames=("cache",))
+            .lower(params, state, cache, samp).compile())
+
+
+def compile_spec_chunk(*, slots=32, rounds=8, k=4):
+    """The speculative draft+verify chunk program → v5e executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+
+    _env_mosaic("pallas")
+    mesh = _single_device_mesh(topology("v5e:2x2"))
+    rep = _replicated(mesh)
+    cfg, params, cache = flagship_model_parts(mesh)
+    hist_len = 2048                       # max_pages_per_seq * page_size
+    last = jax.ShapeDtypeStruct((slots, 1), jnp.int32, sharding=rep)
+    hist = jax.ShapeDtypeStruct((slots, hist_len), jnp.int32, sharding=rep)
+    n_tok = jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=rep)
+    tables = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT), jnp.int32,
+                                  sharding=rep)
+    lens = jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=rep)
+    fn = partial(PagedTPUEngine._spec_chunk, cfg=cfg, rounds=rounds, k=k)
+    return (jax.jit(fn, donate_argnames=("cache",))
+            .lower(params, last, hist, n_tok, tables, lens, cache).compile())
+
+
+def compile_tp8_flagship_chunk(*, steps=8, slots=32):
+    """The tp=8 multi-chip decode program (GSPMD + the tp-manual Mosaic
+    shard_map) → v5e-8 executable."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.models import init_random_params, zoo_config
+    from reval_tpu.models.paged import init_paged_cache
+    from reval_tpu.parallel.mesh import make_mesh
+    from reval_tpu.parallel.sharding import paged_cache_spec, param_specs
+
+    _env_mosaic("pallas")
+    topo = topology("v5e:4x2")
+    mesh = make_mesh(tp=8, devices=np.array(topo.devices).reshape(8))
+    rep = _replicated(mesh)
+    cfg = zoo_config("deepseek-coder-1.3b")
+    cfg.dtype = "bfloat16"
+    shapes = jax.eval_shape(
+        lambda: init_random_params(cfg, seed=0, dtype="bfloat16"))
+    specs = param_specs(shapes, cfg, mesh)
+    params = jax.tree.map(
+        lambda s, sp: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
+    cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
+    cache = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype,
+            sharding=cache_sharding if len(s.shape) == 3 else rep),
+        jax.eval_shape(lambda: init_paged_cache(
+            cfg, num_pages=bench_pool(slots, PER_SEQ_DIRECT), page_size=128,
+            dtype=jnp.bfloat16)))
+    state = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT + 5), jnp.int32,
+                                 sharding=rep)
+    samp = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
+    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
+                 filtered=False, mesh=mesh)
+    return (jax.jit(fn, donate_argnames=("cache",))
+            .lower(params, state, cache, samp).compile())
+
+
+def compile_34b_northstar_chunk(*, steps=8, slots=4, num_pages=48):
+    """The 34B north-star decode program (CodeLlama-34B, tp=8, int4,
+    paged — dryrun_34b_northstar geometry) → v5e-8 executable."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.models import init_random_int4, zoo_config
+    from reval_tpu.models.paged import init_paged_cache
+    from reval_tpu.parallel.mesh import make_mesh
+    from reval_tpu.parallel.sharding import paged_cache_spec, param_specs
+
+    _env_mosaic("pallas")
+    topo = topology("v5e:4x2")
+    mesh = make_mesh(tp=8, devices=np.array(topo.devices).reshape(8))
+    rep = _replicated(mesh)
+    cfg = zoo_config("codellama/CodeLlama-34b-Instruct-hf")
+    cfg.dtype = "bfloat16"
+    shapes = jax.eval_shape(lambda: init_random_int4(cfg, seed=0, tp=8))
+    specs = param_specs(shapes, cfg, mesh)
+    params = jax.tree.map(
+        lambda s, sp: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
+    cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
+    cache = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype,
+            sharding=cache_sharding if len(s.shape) == 3 else rep),
+        jax.eval_shape(lambda: init_paged_cache(
+            cfg, num_pages=num_pages, page_size=128, dtype=jnp.bfloat16)))
+    state = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT + 5), jnp.int32,
+                                 sharding=rep)
+    samp = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
+    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
+                 filtered=False, mesh=mesh)
+    return (jax.jit(fn, donate_argnames=("cache",))
+            .lower(params, state, cache, samp).compile())
+
+
+def setup_70b_pp():
+    """(mesh, cfg, params) for the v5p-16 pp=2 x tp=8 CodeLlama-70B
+    program (BASELINE configs[4]) at 2 of the 80 layers — compile cares
+    about structure and width, not depth."""
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding
+
+    from reval_tpu.models import init_random_int4, zoo_config
+    from reval_tpu.parallel.mesh import make_mesh
+    from reval_tpu.parallel.pipeline import pp_param_specs
+
+    topo = topology("v5p:4x2x2")
+    mesh = make_mesh(pp=2, tp=8, devices=np.array(topo.devices).reshape(16))
+    cfg = zoo_config("codellama/CodeLlama-70b-Instruct-hf")
+    cfg.num_layers = 2
+    cfg.dtype = "bfloat16"
+    shapes = jax.eval_shape(lambda: init_random_int4(cfg, seed=0, tp=8))
+    specs = pp_param_specs(shapes, cfg, mesh)
+    params = jax.tree.map(
+        lambda s, sp: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
+    return mesh, cfg, params
+
+
+def compile_70b_prefill(*, b=4, t=128, mb=2):
+    """The 70B GPipe prefill → v5p-16 executable."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from reval_tpu.models.model import KVCache
+    from reval_tpu.parallel.pipeline import pipeline_prefill
+
+    mesh, cfg, params = setup_70b_pp()
+    rows = b + mb                 # fill/drain scratch rows (pipeline.py)
+    cshape = (cfg.num_layers, rows, t, cfg.num_kv_heads, cfg.head_dim)
+    csh = NamedSharding(mesh, P("pp"))
+    cache = KVCache(
+        k=jax.ShapeDtypeStruct(cshape, jnp.bfloat16, sharding=csh),
+        v=jax.ShapeDtypeStruct(cshape, jnp.bfloat16, sharding=csh))
+    rep = _replicated(mesh)
+    tokens = jax.ShapeDtypeStruct((b, t), jnp.int32, sharding=rep)
+    pad = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
+    fn = partial(pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=b // mb)
+    return (jax.jit(fn)
+            .lower(params, tokens=tokens, pad_len=pad, cache=cache)
+            .compile())
+
+
+def compile_70b_decode(*, b=4, t=256, steps=4):
+    """The 70B token-ring decode chunk (exact runtime signature, incl.
+    the [B] top_k/top_p rows the engine always passes) → v5p-16."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from reval_tpu.inference.tpu.pp_engine import PipelinedTPUEngine
+    from reval_tpu.models.model import KVCache
+
+    mesh, cfg, params = setup_70b_pp()
+    rows = b + b // 2             # engine's scratch-row convention
+    cshape = (cfg.num_layers, rows, t, cfg.num_kv_heads, cfg.head_dim)
+    csh = NamedSharding(mesh, P("pp"))
+    cache = KVCache(
+        k=jax.ShapeDtypeStruct(cshape, jnp.bfloat16, sharding=csh),
+        v=jax.ShapeDtypeStruct(cshape, jnp.bfloat16, sharding=csh))
+    rep = _replicated(mesh)
+    first = jax.ShapeDtypeStruct((b, 1), jnp.int32, sharding=rep)
+    pad = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
+    pos = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+    temp = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+    kf = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=rep)
+    pf = jax.ShapeDtypeStruct((b,), jnp.float32, sharding=rep)
+    fn = partial(PipelinedTPUEngine._pp_decode_chunk, cfg=cfg, mesh=mesh,
+                 steps=steps, filtered=False)
+    return (jax.jit(fn, donate_argnames=("cache",))
+            .lower(params, first, pad, cache, pos, temp, key, kf, pf)
+            .compile())
+
+
+def compile_prefill_commit(*, rows, t=512, n_pg=4, weights="bf16w",
+                           kv_dtype="", num_pages=None):
+    """The paged engine's prefill + page-commit programs → v5e."""
+    import jax
+    import jax.numpy as jnp
+
+    from reval_tpu.models import init_kv_cache, prefill
+    from reval_tpu.models.paged import commit_prefill, init_paged_cache
+
+    _env_mosaic("pallas")
+    mesh = _single_device_mesh(topology("v5e:2x2"))
+    rep = _replicated(mesh)
+    num_pages = num_pages or bench_pool(32, PER_SEQ_DIRECT)
+    cfg, params, _ = flagship_model_parts(mesh, weights=weights)
+    kv = _shaped(jax.eval_shape(
+        lambda: init_kv_cache(cfg, rows, t, dtype=jnp.bfloat16)), rep)
+    tokens = jax.ShapeDtypeStruct((rows, t), jnp.int32, sharding=rep)
+    pad = jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=rep)
+    pre = (jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
+           .lower(params, tokens=tokens, pad_len=pad, cache=kv).compile())
+    pool = _shaped(jax.eval_shape(
+        lambda: init_paged_cache(cfg, num_pages=num_pages, page_size=128,
+                                 dtype=jnp.bfloat16, kv_dtype=kv_dtype)), rep)
+    tables = jax.ShapeDtypeStruct((rows, n_pg), jnp.int32, sharding=rep)
+    commit = (jax.jit(commit_prefill, donate_argnums=(0,))
+              .lower(pool, kv, pad, tables).compile())
+    return pre, commit

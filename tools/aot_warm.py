@@ -8,12 +8,15 @@ the on-chip runbook benches read.  If the runtime cache key matches, a
 ~19-minute tunnel window spends its time MEASURING instead of
 compiling; if it doesn't match, the cost is only host CPU spent here.
 
-Warms the decode-chunk programs of the runbook's decision set at their
-exact runtime shapes (deepseek-coder-1.3b dims, spans/steps the engine
-buckets to):
+The programs come from tools/aot_programs — the same builders the AOT
+test tier asserts on — at the exact runtime shapes of the runbook's
+decision set:
 
-    backend {grid, seq} x kv {bf16, int8} x slots {32, 64}
-    x steps {8, 32}, plus the int8-weight variant of the default.
+    decode: backend {grid, seq} x kv {bf16, int8} x slots {32, 64}
+            x steps {8, 32}, the int8-weight variant, and the cot
+            (24-slot / span-16) configs;
+    prefill+commit: every distinct (weights, kv dtype, pool) those
+            decode configs imply, at the 8- and 4-row admission buckets.
 
 Cache mechanics (measured): the persistent-cache KEY for each program is
 stable across runs/processes, and entries land in the cache dir — but
@@ -34,9 +37,13 @@ import argparse
 import os
 import sys
 import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import aot_programs
+from tools.aot_programs import (PER_SEQ_COT, PER_SEQ_DIRECT,
+                                BENCH_SPAN_COT, BENCH_SPAN_DIRECT,
+                                bench_pool)
 
 
 def main() -> int:
@@ -53,130 +60,55 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", args.cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    # the dispatcher keys interpret mode on the RUNTIME backend (cpu on
-    # this host) — force the Mosaic kernel or every warmed executable
-    # would contain the HLO emulation and never match an on-chip key
-    os.environ["REVAL_TPU_FORCE_MOSAIC"] = "1"
 
-    import numpy as np
-    import jax.numpy as jnp
-    from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-    from reval_tpu.models import (init_random_params, quantize_params,
-                                  zoo_config)
-    from reval_tpu.models.paged import init_paged_cache
-
-    topo = topologies.get_topology_desc(platform="tpu",
-                                        topology_name="v5e:2x2")
-    mesh = Mesh(np.array(topo.devices[:1]), ("x",))
-    rep = NamedSharding(mesh, P())
-
-    def shaped(tree):
-        return jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep),
-            tree)
-
-    cfg = zoo_config("deepseek-coder-1.3b")
-    cfg.dtype = "bfloat16"
-    params_bf16 = shaped(jax.eval_shape(
-        lambda: init_random_params(cfg, seed=0, dtype="bfloat16")))
-    params_int8 = shaped(jax.eval_shape(
-        lambda: quantize_params(init_random_params(cfg, seed=0,
-                                                   dtype="bfloat16"))))
-
-    def chunk_args(slots, kv_dtype, params, per_seq, span):
-        # bench.py default pool: 1 + slots * per_seq + 16
-        num_pages = 1 + slots * per_seq + 16
-        cache = shaped(jax.eval_shape(
-            lambda: init_paged_cache(cfg, num_pages=num_pages, page_size=128,
-                                     dtype=jnp.bfloat16, kv_dtype=kv_dtype)))
-        state = jax.ShapeDtypeStruct((slots, span + 5), jnp.int32,
-                                     sharding=rep)
-        sampling = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
-        return params, state, cache, sampling
-
-    # (backend, kv_dtype, slots, weights, per_seq, span): spans/pools are
-    # what the engine pow2-buckets to at the bench's prompt lengths —
-    # direct (~500 tok + 256 new): per_seq 7, span bucket 8; cot
-    # (+1024 new): per_seq 13, span bucket 16
-    jobs = [("grid", "", 32, "bf16w", 7, 8)]
+    # (backend, kv_dtype, slots, weights, per_seq, span)
+    jobs = [("pallas", "", 32, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT)]
     if not args.quick:
         jobs += [
-            ("pallas_seq", "", 32, "bf16w", 7, 8),
-            ("grid", "int8", 64, "bf16w", 7, 8),
-            ("pallas_seq", "int8", 64, "bf16w", 7, 8),
-            ("grid", "", 32, "int8w", 7, 8),
-            ("grid", "", 24, "bf16w", 13, 16),      # bench --mode cot
-            ("grid", "int8", 24, "bf16w", 13, 16),  # cot + int8 kv
+            ("pallas_seq", "", 32, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT),
+            ("pallas", "int8", 64, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT),
+            ("pallas_seq", "int8", 64, "bf16w", PER_SEQ_DIRECT,
+             BENCH_SPAN_DIRECT),
+            ("pallas", "", 32, "int8w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT),
+            ("pallas", "", 24, "bf16w", PER_SEQ_COT, BENCH_SPAN_COT),
+            ("pallas", "int8", 24, "bf16w", PER_SEQ_COT, BENCH_SPAN_COT),
         ]
 
-    # prefill + page-commit programs (the other half of a cold bench's
-    # compile time).  Bench prompts (~500 tok) bucket to t=512; the 768 MB
-    # prefill byte budget caps groups at 7 rows → pow2 row buckets 8 and
-    # 4 (the tail group of a 32-prompt admission wave).  The prefill
-    # program varies with the weight dtype, the commit program with the
-    # pool (size + kv dtype) — warm every distinct combination the
-    # decode jobs above will bench.
-    def warm_prefill(rows, t, n_pg, params, num_pages, kv_dtype, label):
-        from reval_tpu.models import init_kv_cache, prefill
-        from reval_tpu.models.paged import commit_prefill
-
-        kv = shaped(jax.eval_shape(
-            lambda: init_kv_cache(cfg, rows, t, dtype=jnp.bfloat16)))
-        tokens = jax.ShapeDtypeStruct((rows, t), jnp.int32, sharding=rep)
-        pad = jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=rep)
-        t0 = time.time()
-        (jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
-         .lower(params, tokens=tokens, pad_len=pad, cache=kv).compile())
-        pool = shaped(jax.eval_shape(
-            lambda: init_paged_cache(cfg, num_pages=num_pages, page_size=128,
-                                     dtype=jnp.bfloat16, kv_dtype=kv_dtype)))
-        tables = jax.ShapeDtypeStruct((rows, n_pg), jnp.int32, sharding=rep)
-        (jax.jit(commit_prefill, donate_argnums=(0,))
-         .lower(pool, kv, pad, tables).compile())
-        print(f"warmed prefill+commit rows={rows} t={t} {label} in "
-              f"{time.time() - t0:.0f}s", flush=True)
-
     failures = 0
+
+    def run(label, fn, **kw):
+        nonlocal failures
+        t0 = time.time()
+        try:
+            fn(**kw)
+            print(f"warmed {label} in {time.time() - t0:.0f}s", flush=True)
+        except Exception as e:
+            failures += 1
+            print(f"FAILED {label}: {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+
+    # prefill + page-commit: every distinct (weights, kv, pool) the
+    # decode jobs imply, at both admission-wave row buckets
     if not args.quick:
         seen: set[tuple] = set()
         for _, kv_dtype, slots, wdtype, per_seq, _ in jobs:
-            num_pages = 1 + slots * per_seq + 16
-            combo = (wdtype, kv_dtype, num_pages)
+            combo = (wdtype, kv_dtype, bench_pool(slots, per_seq))
             if combo in seen:
                 continue
             seen.add(combo)
-            params = params_int8 if wdtype == "int8w" else params_bf16
             for rows in (8, 4):
-                label = f"{wdtype}/kv={kv_dtype or 'bf16'}/pool{num_pages}"
-                try:
-                    warm_prefill(rows, 512, 4, params, num_pages, kv_dtype,
-                                 label)
-                except Exception as e:
-                    failures += 1
-                    print(f"FAILED prefill rows={rows} {label}: "
-                          f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+                run(f"prefill+commit rows={rows} {wdtype}/"
+                    f"kv={kv_dtype or 'bf16'}/pool{combo[2]}",
+                    aot_programs.compile_prefill_commit, rows=rows,
+                    weights=wdtype, kv_dtype=kv_dtype, num_pages=combo[2])
 
     for backend, kv_dtype, slots, wdtype, per_seq, span in jobs:
-        os.environ["REVAL_TPU_PAGED_BACKEND"] = (
-            "pallas" if backend == "grid" else backend)
-        params = params_int8 if wdtype == "int8w" else params_bf16
         for steps in (8, 32):
-            label = f"{backend}/kv={kv_dtype or 'bf16'}/s{slots}/{wdtype}/steps{steps}"
-            fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
-                         filtered=False)
-            t0 = time.time()
-            try:
-                (jax.jit(fn, donate_argnames=("cache",))
-                 .lower(*chunk_args(slots, kv_dtype, params, per_seq, span))
-                 .compile())
-                print(f"warmed {label} in {time.time() - t0:.0f}s", flush=True)
-            except Exception as e:
-                failures += 1
-                print(f"FAILED {label}: {type(e).__name__}: {str(e)[:200]}",
-                      flush=True)
+            run(f"{backend}/kv={kv_dtype or 'bf16'}/s{slots}/{wdtype}"
+                f"/steps{steps}",
+                aot_programs.compile_flagship_chunk, steps=steps,
+                slots=slots, kv_dtype=kv_dtype, weights=wdtype,
+                per_seq=per_seq, span=span, backend=backend)
     return 1 if failures else 0
 
 
