@@ -410,12 +410,14 @@ class TPUEngine:
                                              np.float32(temperature))
             first = sample_token(first_logits, np.float32(temperature),
                                  self._next_key())
-        jax.block_until_ready(first)
+        # the host read is the sync: through the axon tunnel
+        # block_until_ready returns before the device has executed, so
+        # timing must end on an actual fetch
+        first_host = self._host_read(first)[:, None]
         self.stats.prefill_seconds += time.perf_counter() - t0
         self.stats.prefill_tokens += int((t - pad_len).sum())
 
         generated = np.zeros((b, 0), dtype=np.int32)
-        first_host = self._host_read(first)[:, None]
         generated = np.concatenate([generated, first_host], axis=1)
         token = first[:, None]
         pos = np.int32(t)   # host value: placeable on any (even cross-

@@ -97,8 +97,12 @@ def _page_scores(q, k, scale, softcap, valid, h_kv: int, g: int,
     replaces a [P, H_kv, D] multiply on the keys (128× fewer elements).
     """
     q3 = q.reshape(h_kv, g, q.shape[-1])                   # [H_kv, G, D]
+    # Mosaic only lowers batched matmuls whose batch dims are BOTH dim 0
+    # ("batch dims must be equal" otherwise, and index-1 batches are
+    # rejected too — both probed on a real v5e); the [P, H_kv, D] page is
+    # therefore swapped to [H_kv, P, D] in VMEM before the dot.
     s = jax.lax.dot_general(                               # [H_kv, G, P]
-        q3, k, (((2,), (2,)), ((0,), (1,))),
+        q3, jnp.swapaxes(k, 0, 1), (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     ) * scale
     s = s.reshape(h_kv * g, -1)                            # [H, P]
@@ -112,7 +116,7 @@ def _page_values(probs, v, h_kv: int, g: int):
     """probs: [H, P] f32, v: [P, H_kv, D] f32 → weighted values [H, D]."""
     p3 = probs.reshape(h_kv, g, probs.shape[-1])           # [H_kv, G, P]
     out = jax.lax.dot_general(                             # [H_kv, G, D]
-        p3, v, (((2,), (0,)), ((0,), (1,))),
+        p3, jnp.swapaxes(v, 0, 1), (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(h_kv * g, v.shape[-1])              # [H, D]

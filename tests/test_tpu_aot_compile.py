@@ -44,10 +44,28 @@ def _topology(name: str):
         pytest.skip(f"deviceless TPU topology {name!r} unavailable: {e}")
 
 
-def _build(builder, **kw):
+@pytest.fixture(autouse=True)
+def _restore_backend_env():
+    """The shared builders set REVAL_TPU_PAGED_BACKEND / FORCE_MOSAIC
+    process-wide (their standalone-tool semantics); scope that to each
+    test so a later CPU test doesn't dispatch Mosaic uninterpreted."""
+    keys = ("REVAL_TPU_PAGED_BACKEND", "REVAL_TPU_FORCE_MOSAIC")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _build(builder, probe: str = "v5e:2x2", **kw):
     """Run a shared program builder, skipping (not failing) when the
-    deviceless topology itself is unavailable on this host."""
-    _topology("v5e:2x2")
+    deviceless topology itself is unavailable on this host.  ``probe``
+    must name the topology the builder actually requests — probing v5e
+    for a v5p-target builder would fail instead of skip on hosts whose
+    libtpu resolves one family but not the other."""
+    _topology(probe)
     return builder(**kw)
 
 
@@ -194,7 +212,7 @@ def test_70b_pp_tp_prefill_compiles_v5p16():
     """BASELINE configs[4]: the pipeline (pp=2 x tp=8) GPipe prefill at
     CodeLlama-70B widths compiles for a 16-device v5p target, including
     the shard_map collectives and int4 weight stacks."""
-    compiled = _build(aot_programs.compile_70b_prefill)
+    compiled = _build(aot_programs.compile_70b_prefill, probe="v5p:4x2x2")
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 
@@ -202,7 +220,7 @@ def test_70b_pp_tp_decode_compiles_v5p16():
     """The 70B token-ring DECODE chunk (the half of the pp path the
     prefill test above doesn't cover), with the exact runtime signature
     (the engine always passes [B] top_k/top_p rows)."""
-    compiled = _build(aot_programs.compile_70b_decode)
+    compiled = _build(aot_programs.compile_70b_decode, probe="v5p:4x2x2")
     assert compiled.memory_analysis().temp_size_in_bytes >= 0
 
 

@@ -79,13 +79,16 @@ def main() -> None:
     # Bare dispatch round-trip: a trivial jitted op, timed like a chunk
     # (dispatch + block).  On the tunneled chip this IS the per-chunk RPC
     # floor — it separates host/tunnel latency from on-device work.
+    # NB: every timed region here ends on np.asarray, not
+    # block_until_ready — through the axon tunnel block_until_ready
+    # returns before the device has executed; only a host fetch syncs.
     tiny_f = jax.jit(lambda x: x + 1)
     x = jnp.zeros((8,), jnp.int32)
-    jax.block_until_ready(tiny_f(x))
+    np.asarray(tiny_f(x))
     rtts = []
     for _ in range(10):
         t0 = time.perf_counter()
-        jax.block_until_ready(tiny_f(x))
+        np.asarray(tiny_f(x))
         rtts.append(time.perf_counter() - t0)
     rtt_ms = statistics.median(rtts) * 1000
     print(f"bare jit dispatch round-trip: {rtt_ms:.3f} ms "
@@ -138,14 +141,14 @@ def main() -> None:
             # warm compile
             toks, cache, state2 = eng._jit_chunk(eng.params, state, cache,
                                                  temp, steps=steps)
-            jax.block_until_ready(toks)
+            np.asarray(toks)
             times = []
             st = state2
             for _ in range(args.reps):
                 t0 = time.perf_counter()
                 toks, cache, st = eng._jit_chunk(eng.params, st, cache,
                                                  temp, steps=steps)
-                jax.block_until_ready(toks)
+                np.asarray(toks)  # host fetch = the only real sync (tunnel)
                 times.append(time.perf_counter() - t0)
             eng.close()
             ms_step = statistics.median(times) / steps * 1000
