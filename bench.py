@@ -311,7 +311,7 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
               max_slots=32, max_seq_len=2048, num_pages=None, kv_dtype="",
-              spec_k=0):
+              spec_k=0, progress_path=None):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
@@ -323,17 +323,69 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
     # pair, so a reduced warmup would leave XLA compiles inside the timed
     # region on a cold compile cache
+    # The tunnel can wedge MID-pass (warmup included — it is the longest
+    # phase); when the runbook's timeout then kills this process,
+    # everything measured so far must not vanish.  A sampler thread
+    # snapshots the engine's per-chunk stats into a sidecar JSON every
+    # few seconds — a stalled pass still leaves the true decode rate up
+    # to the stall (chip_runbook harvests it as <step>.partial.json).
+    # No "value" key: last_known_good() must never surface a partial as
+    # a clean artifact.
+    stop_evt = thr = None
+    phase = {"name": "warmup", "t0": time.perf_counter(), "warmup_wall": 0.0}
+    if progress_path:
+        import threading
+
+        stop_evt = threading.Event()
+
+        def _sample():
+            while not stop_evt.wait(5.0):
+                s = eng.stats
+                snap = {"partial": True, "phase": phase["name"],
+                        "elapsed_s": round(
+                            time.perf_counter() - phase["t0"], 2),
+                        "warmup_wall_s": round(phase["warmup_wall"], 2),
+                        "generated_tokens": s.generated_tokens,
+                        "decode_seconds": round(s.decode_seconds, 3),
+                        "decode_tok_s": round(
+                            s.generated_tokens / s.decode_seconds, 1)
+                        if s.decode_seconds > 0 else 0.0,
+                        "prefill_tokens": s.prefill_tokens,
+                        "decode_chunks": s.decode_chunks,
+                        "config": {"slots": max_slots, "kv_dtype": kv_dtype,
+                                   "spec_k": spec_k, "max_new": max_new,
+                                   "prompts": len(prompts)},
+                        "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+                try:
+                    with open(progress_path + ".tmp", "w") as f:
+                        json.dump(snap, f)
+                    os.replace(progress_path + ".tmp", progress_path)
+                except OSError:
+                    pass
+
+        thr = threading.Thread(target=_sample, daemon=True)
+        thr.start()
     note("  paged warmup pass (compiles land here)")
-    eng.generate(prompts, max_new_tokens=max_new,
-                 temperature=0.0, stop=["[/ANSWER]"])
-    eng.stats = EngineStats()
-    note("  paged timed pass")
     t0 = time.perf_counter()
-    outs = eng.generate(prompts, max_new_tokens=max_new, temperature=0.0,
-                        stop=["[/ANSWER]"])
+    try:
+        eng.generate(prompts, max_new_tokens=max_new,
+                     temperature=0.0, stop=["[/ANSWER]"])
+        warmup_wall = time.perf_counter() - t0
+        eng.stats = EngineStats()
+        note(f"  paged timed pass (warmup took {warmup_wall:.1f}s)")
+        phase.update(name="timed-pass", t0=time.perf_counter(),
+                     warmup_wall=warmup_wall)
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=max_new, temperature=0.0,
+                            stop=["[/ANSWER]"])
+    finally:
+        if stop_evt is not None:
+            stop_evt.set()
+            thr.join(timeout=2.0)
     wall = time.perf_counter() - t0
     assert len(outs) == len(prompts)
     stats = eng.stats
+    stats.warmup_wall = warmup_wall
     eng.close()
     return wall, stats
 
@@ -498,11 +550,14 @@ def main() -> None:
         spec_k = 4 if args.spec else 0
         note(f'params ready ({args.dtype}); paged warmup+run '
              f'(slots={args.slots}, pages={num_pages})')
+        progress = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tpu_watch", "bench_inflight.json")
+        os.makedirs(os.path.dirname(progress), exist_ok=True)
         wall, stats = run_paged(params, cfg, tok, prompts, max_new,
                                 prefix_sharing=True, max_slots=args.slots,
                                 max_seq_len=args.max_seq_len,
                                 num_pages=num_pages, kv_dtype=args.kv_dtype,
-                                spec_k=spec_k)
+                                spec_k=spec_k, progress_path=progress)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -547,6 +602,7 @@ def main() -> None:
                 if stats.prefill_seconds else 0.0,
             "decode_share": round(stats.decode_seconds / wall, 3) if wall else 0.0,
             "wall_seconds": round(wall, 2),
+            "warmup_wall_seconds": round(getattr(stats, "warmup_wall", 0.0), 2),
         }
         if args.spec:
             extras["spec"] = True

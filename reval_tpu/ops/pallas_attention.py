@@ -79,41 +79,76 @@ def _scale_rows_t(s_hp, g: int):
         t, (t.shape[0], g, t.shape[2])).reshape(-1, t.shape[2])
 
 
+def _group_onehot(h_kv: int, g: int):
+    """[H, 1, H_kv] f32 mask: 1 where kv-head j serves query head i
+    (j == i // g).  Compile-time constant-foldable iota comparison."""
+    hh = jax.lax.broadcasted_iota(jnp.int32, (h_kv * g, 1, h_kv), 0)
+    kk = jax.lax.broadcasted_iota(jnp.int32, (h_kv * g, 1, h_kv), 2)
+    return (kk == hh // g).astype(jnp.float32)
+
+
 def _page_scores(q, k, scale, softcap, valid, h_kv: int, g: int,
-                 ks_hp=None):
+                 ks_hp=None, wide: bool = False):
     """Masked attention scores for one page, ALL heads in one dot.
 
     q: [H, D] f32; k: [P, H_kv, D] f32 (int8 pools: CAST but not scaled);
     valid: [1, P] bool; ks_hp: None or [H, P] per-token k-scales from
     :func:`_scale_rows`.  Returns s: [H, P] f32.
 
-    One batched ``dot_general`` over the kv-head dim replaces the per-head
-    matvec loop: at decode shapes the per-head ops are ~sub-µs each and
-    their fixed issue overhead — not bandwidth — dominated the measured
-    step time (23.6 ms vs a 8 ms roofline, tpu_watch r4 ablation), so the
-    kernel's job is to touch the page with as FEW ops as possible.  The
-    int8 dequant scales don't vary along the contracted dim, so they
-    factor out of the dot EXACTLY — a [H, P] multiply on the scores
-    replaces a [P, H_kv, D] multiply on the keys (128× fewer elements).
+    One dot over the whole page replaces the per-head matvec loop: at
+    decode shapes the per-head ops are ~sub-µs each and their fixed issue
+    overhead — not bandwidth — dominated the measured step time (23.6 ms
+    vs a 8 ms roofline, tpu_watch r4 ablation), so the kernel's job is to
+    touch the page with as FEW ops as possible.  The int8 dequant scales
+    don't vary along the contracted dim, so they factor out of the dot
+    EXACTLY — a [H, P] multiply on the scores replaces a [P, H_kv, D]
+    multiply on the keys (128× fewer elements).
+
+    Two dot formulations (``wide`` picks; on-chip A/B decides defaults):
+    - batched (default): one kv-head-batched ``dot_general``.  Mosaic
+      only lowers batched matmuls whose batch dims are BOTH dim 0
+      ("batch dims must be equal" otherwise, and index-1 batches are
+      rejected too — probed on a real v5e), so the [P, H_kv, D] page is
+      swapped to [H_kv, P, D] in VMEM first — real data movement,
+      ~page-sized, on the critical path.
+    - wide: ONE plain 2D matmul against the page's free reshape
+      [P*H_kv, D], computing cross-head scores too (h_kv× the MXU FLOPs
+      — decode is bandwidth-bound, the MXU is idle anyway), then a
+      one-hot head-group mask-and-sum keeps the diagonal blocks.  No
+      transpose at all.
     """
-    q3 = q.reshape(h_kv, g, q.shape[-1])                   # [H_kv, G, D]
-    # Mosaic only lowers batched matmuls whose batch dims are BOTH dim 0
-    # ("batch dims must be equal" otherwise, and index-1 batches are
-    # rejected too — both probed on a real v5e); the [P, H_kv, D] page is
-    # therefore swapped to [H_kv, P, D] in VMEM before the dot.
-    s = jax.lax.dot_general(                               # [H_kv, G, P]
-        q3, jnp.swapaxes(k, 0, 1), (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    s = s.reshape(h_kv * g, -1)                            # [H, P]
+    h = h_kv * g
+    if wide:
+        p = k.shape[0]
+        k2 = k.reshape(p * h_kv, k.shape[-1])              # free reshape
+        s_full = jax.lax.dot_general(                      # [H, P*H_kv]
+            q, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s3 = s_full.reshape(h, p, h_kv)
+        s = (s3 * _group_onehot(h_kv, g)).sum(-1) * scale  # [H, P]
+    else:
+        q3 = q.reshape(h_kv, g, q.shape[-1])               # [H_kv, G, D]
+        s = jax.lax.dot_general(                           # [H_kv, G, P]
+            q3, jnp.swapaxes(k, 0, 1), (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s.reshape(h, -1)                               # [H, P]
     if ks_hp is not None:
         s = s * ks_hp
     s = _softcap(s, softcap)                 # gemma-2 score softcapping
     return jnp.where(valid, s, _NEG_INF)
 
 
-def _page_values(probs, v, h_kv: int, g: int):
-    """probs: [H, P] f32, v: [P, H_kv, D] f32 → weighted values [H, D]."""
+def _page_values(probs, v, h_kv: int, g: int, wide: bool = False):
+    """probs: [H, P] f32, v: [P, H_kv, D] f32 → weighted values [H, D].
+    Same two formulations as :func:`_page_scores`."""
+    if wide:
+        h, p = probs.shape
+        pv3 = probs[:, :, None] * _group_onehot(h_kv, g)   # [H, P, H_kv]
+        return jax.lax.dot_general(                        # [H, D]
+            pv3.reshape(h, p * h_kv), v.reshape(p * h_kv, v.shape[-1]),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
     p3 = probs.reshape(h_kv, g, probs.shape[-1])           # [H_kv, G, P]
     out = jax.lax.dot_general(                             # [H_kv, G, D]
         p3, jnp.swapaxes(v, 0, 1), (((2,), (1,)), ((0,), (0,))),
@@ -123,7 +158,7 @@ def _page_values(probs, v, h_kv: int, g: int):
 
 
 def _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv: int, g: int,
-                  vs_hp=None):
+                  vs_hp=None, wide: bool = False):
     """Fold one page's scores/values into the online-softmax scratch.
 
     s: [H, P] masked scores; v: [P, H_kv, D] values (int8 pools: CAST but
@@ -137,7 +172,7 @@ def _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv: int, g: int,
     probs = jnp.exp(s - m_new)                    # [H, P]
     l_new = alpha * l_ref[:, :1] + probs.sum(axis=-1, keepdims=True)
     pv = probs if vs_hp is None else probs * vs_hp
-    acc_ref[:] = acc_ref[:] * alpha + _page_values(pv, v, h_kv, g)
+    acc_ref[:] = acc_ref[:] * alpha + _page_values(pv, v, h_kv, g, wide)
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -145,7 +180,7 @@ def _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv: int, g: int,
 def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
                    *rest, page_size: int, scale: float, max_pages: int,
                    window: int | None, softcap: float | None,
-                   h_kv: int, g: int, quantized: bool):
+                   h_kv: int, g: int, quantized: bool, wide: bool):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -184,8 +219,8 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
         if ks_ref is not None:
             ks_hp = _scale_rows(ks_ref[0], g)
             vs_hp = _scale_rows(vs_ref[0], g)
-        s = _page_scores(q, k, scale, softcap, valid, h_kv, g, ks_hp)
-        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g, vs_hp)
+        s = _page_scores(q, k, scale, softcap, valid, h_kv, g, ks_hp, wide)
+        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g, vs_hp, wide)
 
     @pl.when(p == max_pages - 1)
     def _finalize():
@@ -194,13 +229,14 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
 
 @functools.partial(
     jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
-                              "softcap"))
+                              "softcap", "dot_mode"))
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                                   *, page_size: int, scale: float | None = None,
                                   interpret: bool = False,
                                   window: int | None = None,
                                   softcap: float | None = None,
-                                  k_scales=None, v_scales=None):
+                                  k_scales=None, v_scales=None,
+                                  dot_mode: str = "swap"):
     """One-token attention against a paged KV cache (Pallas TPU kernel).
 
     q: [B, H, D]; k_pages/v_pages: [N_pages * P, H_kv, D] (token-major
@@ -210,6 +246,9 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
     ``v_scales``: per-(token, head) f32 scales for int8 pools.
     Returns [B, H, D].
     """
+    if dot_mode not in ("swap", "wide"):
+        # a typo would silently bench swap under the wide label
+        raise ValueError(f"unknown dot_mode {dot_mode!r}; expected swap | wide")
     b, h, d = q.shape
     h_kv = k_pages.shape[1]
     g = h // h_kv
@@ -258,7 +297,8 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
     kernel = functools.partial(_decode_kernel, page_size=page_size,
                                scale=scale, max_pages=max_pages,
                                window=window, softcap=softcap, h_kv=h_kv,
-                               g=g, quantized=quantized)
+                               g=g, quantized=quantized,
+                               wide=dot_mode == "wide")
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -272,7 +312,7 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
 def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
                        *rest, page_size: int, scale: float,
                        window: int | None, softcap: float | None,
-                       h_kv: int, g: int, quantized: bool):
+                       h_kv: int, g: int, quantized: bool, wide: bool):
     """One grid step = one WHOLE sequence: a double-buffered in-kernel
     page loop replaces the per-(sequence, page) grid of
     ``_decode_kernel``.
@@ -363,8 +403,8 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
         if quantized:
             ks_hp = _scale_rows_t(ks_buf[slot], g)             # [H_kv, P]
             vs_hp = _scale_rows_t(vs_buf[slot], g)
-        s = _page_scores(q, k, scale, softcap, valid, h_kv, g, ks_hp)
-        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g, vs_hp)
+        s = _page_scores(q, k, scale, softcap, valid, h_kv, g, ks_hp, wide)
+        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g, vs_hp, wide)
         return carry
 
     jax.lax.fori_loop(p0, n_live, body, 0)
@@ -380,19 +420,23 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
 
 @functools.partial(
     jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
-                              "softcap"))
+                              "softcap", "dot_mode"))
 def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
                                       seq_lens, *, page_size: int,
                                       scale: float | None = None,
                                       interpret: bool = False,
                                       window: int | None = None,
                                       softcap: float | None = None,
-                                      k_scales=None, v_scales=None):
+                                      k_scales=None, v_scales=None,
+                                      dot_mode: str = "swap"):
     """Per-sequence paged decode attention (see ``_decode_kernel_seq``).
 
     Same contract as :func:`paged_decode_attention_pallas`; the pools stay
     in HBM (``memory_space=ANY``) and the kernel streams live pages only.
     """
+    if dot_mode not in ("swap", "wide"):
+        # a typo would silently bench swap under the wide label
+        raise ValueError(f"unknown dot_mode {dot_mode!r}; expected swap | wide")
     b, h, d = q.shape
     h_kv = k_pages.shape[1]
     g = h // h_kv
@@ -447,7 +491,8 @@ def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
     )
     kernel = functools.partial(_decode_kernel_seq, page_size=page_size,
                                scale=scale, window=window, softcap=softcap,
-                               h_kv=h_kv, g=g, quantized=quantized)
+                               h_kv=h_kv, g=g, quantized=quantized,
+                               wide=dot_mode == "wide")
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -511,7 +556,9 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     ``REVAL_TPU_PAGED_BACKEND=pallas|pallas_seq|xla`` overrides — the XLA
     gather formulation is what CPU uses; ``pallas_seq`` selects the
     per-sequence streaming kernel (pending on-chip A/B before it becomes
-    the TPU default).
+    the TPU default).  ``REVAL_TPU_KERNEL_DOT=swap|wide`` picks the
+    in-kernel dot formulation (see :func:`_page_scores`); read at trace
+    time, so it binds per compiled program like the backend choice.
 
     ``REVAL_TPU_FORCE_MOSAIC=1`` forces ``interpret=False`` even when the
     runtime backend is CPU: deviceless AOT compiles for a TPU *topology*
@@ -543,6 +590,11 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         force = os.environ.get("REVAL_TPU_FORCE_MOSAIC", "").lower()
         kw["interpret"] = (jax.default_backend() != "tpu"
                            and force not in ("1", "true"))
+        dot = os.environ.get("REVAL_TPU_KERNEL_DOT", "swap")
+        if dot not in ("swap", "wide"):
+            raise ValueError(f"unknown REVAL_TPU_KERNEL_DOT {dot!r}; "
+                             "expected swap | wide")
+        kw["dot_mode"] = dot
     return fn(q, k_pages, v_pages, block_tables, seq_lens,
               page_size=page_size, scale=scale, window=window,
               softcap=softcap, k_scales=k_scales, v_scales=v_scales, **kw)

@@ -20,9 +20,15 @@ from reval_tpu.ops.pallas_attention import (
 )
 
 # both TPU kernels must match the XLA oracle bit-for-bit in interpret mode:
-# the per-(seq, page) grid kernel and the per-sequence streaming kernel
-KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_seq]
-KERNEL_IDS = ["page-grid", "per-seq"]
+# the per-(seq, page) grid kernel and the per-sequence streaming kernel,
+# each under both in-kernel dot formulations (swap / wide — see
+# ops.pallas_attention._page_scores)
+from functools import partial
+
+KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_seq,
+           partial(paged_decode_attention_pallas, dot_mode="wide"),
+           partial(paged_decode_attention_pallas_seq, dot_mode="wide")]
+KERNEL_IDS = ["page-grid", "per-seq", "page-grid-wide", "per-seq-wide"]
 
 PAGE = 128
 

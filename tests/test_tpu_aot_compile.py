@@ -92,16 +92,17 @@ def _kernel_for(backend):
             else paged_decode_attention_pallas_seq)
 
 
+@pytest.mark.parametrize("dot_mode", ["swap", "wide"])
 @pytest.mark.parametrize("backend", ["pallas", "pallas_seq"])
 @pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4)])
-def test_kernel_aot_compiles_v5e(backend, h, h_kv):
+def test_kernel_aot_compiles_v5e(backend, h, h_kv, dot_mode):
     kernel = _kernel_for(backend)
     topo = _topology("v5e:2x2")
     mesh = Mesh(np.array(topo.devices[:1]), ("x",))
     q, kp, bt, sl = _kernel_operands(mesh, h, h_kv)
 
     def f(q, kp, vp, bt, sl):
-        return kernel(q, kp, vp, bt, sl, page_size=PAGE)
+        return kernel(q, kp, vp, bt, sl, page_size=PAGE, dot_mode=dot_mode)
 
     compiled = jax.jit(f).lower(q, kp, kp, bt, sl).compile()
     assert compiled.memory_analysis().temp_size_in_bytes >= 0

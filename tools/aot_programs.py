@@ -152,16 +152,17 @@ def compile_spec_chunk(*, slots=32, rounds=8, k=4):
             .lower(params, last, hist, n_tok, tables, lens, cache).compile())
 
 
-def compile_tp8_flagship_chunk(*, steps=8, slots=32):
-    """The tp=8 multi-chip decode program (GSPMD + the tp-manual Mosaic
-    shard_map) → v5e-8 executable."""
+def _compile_tp8_chunk(cfg, param_shapes, *, steps, slots, num_pages):
+    """Shared tp=8 decode-chunk builder: one copy of the mesh/sharding/
+    state recipe so the flagship and 34B certified programs cannot drift
+    from each other (they differ only in cfg, weight init, and pool
+    size)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-    from reval_tpu.models import init_random_params, zoo_config
     from reval_tpu.models.paged import init_paged_cache
     from reval_tpu.parallel.mesh import make_mesh
     from reval_tpu.parallel.sharding import paged_cache_spec, param_specs
@@ -170,58 +171,11 @@ def compile_tp8_flagship_chunk(*, steps=8, slots=32):
     topo = topology("v5e:4x2")
     mesh = make_mesh(tp=8, devices=np.array(topo.devices).reshape(8))
     rep = _replicated(mesh)
-    cfg = zoo_config("deepseek-coder-1.3b")
-    cfg.dtype = "bfloat16"
-    shapes = jax.eval_shape(
-        lambda: init_random_params(cfg, seed=0, dtype="bfloat16"))
-    specs = param_specs(shapes, cfg, mesh)
+    specs = param_specs(param_shapes, cfg, mesh)
     params = jax.tree.map(
         lambda s, sp: jax.ShapeDtypeStruct(
             s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
-        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
-    cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
-    cache = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(
-            s.shape, s.dtype,
-            sharding=cache_sharding if len(s.shape) == 3 else rep),
-        jax.eval_shape(lambda: init_paged_cache(
-            cfg, num_pages=bench_pool(slots, PER_SEQ_DIRECT), page_size=128,
-            dtype=jnp.bfloat16)))
-    state = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT + 5), jnp.int32,
-                                 sharding=rep)
-    samp = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
-    fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
-                 filtered=False, mesh=mesh)
-    return (jax.jit(fn, donate_argnames=("cache",))
-            .lower(params, state, cache, samp).compile())
-
-
-def compile_34b_northstar_chunk(*, steps=8, slots=4, num_pages=48):
-    """The 34B north-star decode program (CodeLlama-34B, tp=8, int4,
-    paged — dryrun_34b_northstar geometry) → v5e-8 executable."""
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding
-
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-    from reval_tpu.models import init_random_int4, zoo_config
-    from reval_tpu.models.paged import init_paged_cache
-    from reval_tpu.parallel.mesh import make_mesh
-    from reval_tpu.parallel.sharding import paged_cache_spec, param_specs
-
-    _env_mosaic("pallas")
-    topo = topology("v5e:4x2")
-    mesh = make_mesh(tp=8, devices=np.array(topo.devices).reshape(8))
-    rep = _replicated(mesh)
-    cfg = zoo_config("codellama/CodeLlama-34b-Instruct-hf")
-    cfg.dtype = "bfloat16"
-    shapes = jax.eval_shape(lambda: init_random_int4(cfg, seed=0, tp=8))
-    specs = param_specs(shapes, cfg, mesh)
-    params = jax.tree.map(
-        lambda s, sp: jax.ShapeDtypeStruct(
-            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
-        shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
+        param_shapes, specs, is_leaf=lambda x: not isinstance(x, dict))
     cache_sharding = NamedSharding(mesh, paged_cache_spec(cfg, mesh))
     cache = jax.tree.map(
         lambda s: jax.ShapeDtypeStruct(
@@ -236,6 +190,35 @@ def compile_34b_northstar_chunk(*, steps=8, slots=4, num_pages=48):
                  filtered=False, mesh=mesh)
     return (jax.jit(fn, donate_argnames=("cache",))
             .lower(params, state, cache, samp).compile())
+
+
+def compile_tp8_flagship_chunk(*, steps=8, slots=32):
+    """The tp=8 multi-chip decode program (GSPMD + the tp-manual Mosaic
+    shard_map) → v5e-8 executable."""
+    import jax
+
+    from reval_tpu.models import init_random_params, zoo_config
+
+    cfg = zoo_config("deepseek-coder-1.3b")
+    cfg.dtype = "bfloat16"
+    shapes = jax.eval_shape(
+        lambda: init_random_params(cfg, seed=0, dtype="bfloat16"))
+    return _compile_tp8_chunk(cfg, shapes, steps=steps, slots=slots,
+                              num_pages=bench_pool(slots, PER_SEQ_DIRECT))
+
+
+def compile_34b_northstar_chunk(*, steps=8, slots=4, num_pages=48):
+    """The 34B north-star decode program (CodeLlama-34B, tp=8, int4,
+    paged — dryrun_34b_northstar geometry) → v5e-8 executable."""
+    import jax
+
+    from reval_tpu.models import init_random_int4, zoo_config
+
+    cfg = zoo_config("codellama/CodeLlama-34b-Instruct-hf")
+    cfg.dtype = "bfloat16"
+    shapes = jax.eval_shape(lambda: init_random_int4(cfg, seed=0, tp=8))
+    return _compile_tp8_chunk(cfg, shapes, steps=steps, slots=slots,
+                              num_pages=num_pages)
 
 
 def setup_70b_pp():

@@ -36,9 +36,18 @@ run() {
     exit 2
   fi
   log "start $name: $*"
+  local t_start=$(date +%s)
   timeout "$to" "$@" > "$R/$name.tmp" 2> "$R/$name.err"
   local rc=$?
   log "end $name rc=$rc"
+  # a bench killed mid-pass still measured something: bench.py streams
+  # per-chunk stats to bench_inflight.json — keep a copy per step so the
+  # evidence survives the next step overwriting it
+  if [ $rc -ne 0 ] && [ -f "$R/bench_inflight.json" ] \
+     && [ "$(stat -c %Y "$R/bench_inflight.json")" -ge "$t_start" ]; then
+    cp "$R/bench_inflight.json" "$R/$name.partial.json"
+    log "saved $name.partial.json (mid-pass stats)"
+  fi
   if [ $rc -eq 0 ]; then
     if [ "$kind" = json ]; then
       grep -q '"value"' "$R/$name.tmp" && ! grep -q '"error"' "$R/$name.tmp" \

@@ -19,6 +19,7 @@ import os
 import statistics
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -137,6 +138,10 @@ def main() -> None:
 
     variant("grid", pa.paged_decode_attention_pallas, kp, vp)
     variant("seq", pa.paged_decode_attention_pallas_seq, kp, vp)
+    variant("grid-wide", partial(pa.paged_decode_attention_pallas,
+                                 dot_mode="wide"), kp, vp)
+    variant("seq-wide", partial(pa.paged_decode_attention_pallas_seq,
+                                dot_mode="wide"), kp, vp)
     variant("grid-int8", pa.paged_decode_attention_pallas, kp8, vp8, scales=True)
     variant("seq-int8", pa.paged_decode_attention_pallas_seq, kp8, vp8, scales=True)
     if not args.tiny:
