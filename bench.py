@@ -383,6 +383,29 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
             stop_evt.set()
             thr.join(timeout=2.0)
     wall = time.perf_counter() - t0
+    if progress_path:
+        # final snapshot marks this pass complete: if the process later
+        # dies in an A/B or serial phase, the harvested sidecar must not
+        # read as a timed pass that died ~5 s from its last sample
+        s = eng.stats
+        try:
+            with open(progress_path + ".tmp", "w") as f:
+                json.dump({"partial": True, "phase": "complete",
+                           "wall_s": round(wall, 2),
+                           "warmup_wall_s": round(warmup_wall, 2),
+                           "generated_tokens": s.generated_tokens,
+                           "decode_seconds": round(s.decode_seconds, 3),
+                           "decode_tok_s": round(
+                               s.generated_tokens / s.decode_seconds, 1)
+                           if s.decode_seconds > 0 else 0.0,
+                           "config": {"slots": max_slots,
+                                      "kv_dtype": kv_dtype,
+                                      "spec_k": spec_k, "max_new": max_new,
+                                      "prompts": len(prompts)},
+                           "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}, f)
+            os.replace(progress_path + ".tmp", progress_path)
+        except OSError:
+            pass
     assert len(outs) == len(prompts)
     stats = eng.stats
     stats.warmup_wall = warmup_wall

@@ -80,20 +80,31 @@ def _scale_rows_t(s_hp, g: int):
 
 
 def _group_onehot(h_kv: int, g: int):
-    """[H, 1, H_kv] f32 mask: 1 where kv-head j serves query head i
+    """[H, H_kv, 1] f32 mask: 1 where kv-head j serves query head i
     (j == i // g).  Compile-time constant-foldable iota comparison."""
-    hh = jax.lax.broadcasted_iota(jnp.int32, (h_kv * g, 1, h_kv), 0)
-    kk = jax.lax.broadcasted_iota(jnp.int32, (h_kv * g, 1, h_kv), 2)
+    hh = jax.lax.broadcasted_iota(jnp.int32, (h_kv * g, h_kv, 1), 0)
+    kk = jax.lax.broadcasted_iota(jnp.int32, (h_kv * g, h_kv, 1), 1)
     return (kk == hh // g).astype(jnp.float32)
+
+
+def _widen_q(q, h_kv: int, g: int):
+    """[H, D] → [H, H_kv*D]: each head's query placed at its kv-group's
+    block, zeros elsewhere.  Loop-invariant — the seq kernel hoists it
+    out of the per-page fori_loop (Mosaic does not reliably hoist from a
+    lowered loop body, and per-page op issue is the measured bottleneck)."""
+    d = q.shape[-1]
+    return (q[:, None, :] * _group_onehot(h_kv, g)).reshape(h_kv * g,
+                                                            h_kv * d)
 
 
 def _page_scores(q, k, scale, softcap, valid, h_kv: int, g: int,
                  ks_hp=None, wide: bool = False):
     """Masked attention scores for one page, ALL heads in one dot.
 
-    q: [H, D] f32; k: [P, H_kv, D] f32 (int8 pools: CAST but not scaled);
-    valid: [1, P] bool; ks_hp: None or [H, P] per-token k-scales from
-    :func:`_scale_rows`.  Returns s: [H, P] f32.
+    q: [H, D] f32 — or, when ``wide``, the PRE-WIDENED [H, H_kv*D] from
+    :func:`_widen_q`; k: [P, H_kv, D] f32 (int8 pools: CAST but not
+    scaled); valid: [1, P] bool; ks_hp: None or [H, P] per-token
+    k-scales from :func:`_scale_rows`.  Returns s: [H, P] f32.
 
     One dot over the whole page replaces the per-head matvec loop: at
     decode shapes the per-head ops are ~sub-µs each and their fixed issue
@@ -111,21 +122,20 @@ def _page_scores(q, k, scale, softcap, valid, h_kv: int, g: int,
       rejected too — probed on a real v5e), so the [P, H_kv, D] page is
       swapped to [H_kv, P, D] in VMEM first — real data movement,
       ~page-sized, on the critical path.
-    - wide: ONE plain 2D matmul against the page's free reshape
-      [P*H_kv, D], computing cross-head scores too (h_kv× the MXU FLOPs
-      — decode is bandwidth-bound, the MXU is idle anyway), then a
-      one-hot head-group mask-and-sum keeps the diagonal blocks.  No
-      transpose at all.
+    - wide: fold the head-group one-hot into a widened q
+      ([H, H_kv*D], zeros outside each head's kv block), so ONE plain 2D
+      matmul against the page's [P, H_kv*D] view yields [H, P] directly
+      (h_kv× the MXU FLOPs — decode is bandwidth-bound, the MXU is idle
+      anyway).  No transpose, and every reshape keeps the 128-lane minor
+      dim aligned (a lane-splitting reshape like [H, P*H_kv] →
+      [H, P, H_kv] is an "unsupported shape cast" in Mosaic).
     """
     h = h_kv * g
     if wide:
-        p = k.shape[0]
-        k2 = k.reshape(p * h_kv, k.shape[-1])              # free reshape
-        s_full = jax.lax.dot_general(                      # [H, P*H_kv]
-            q, k2, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        s3 = s_full.reshape(h, p, h_kv)
-        s = (s3 * _group_onehot(h_kv, g)).sum(-1) * scale  # [H, P]
+        p, d = k.shape[0], k.shape[-1]
+        s = jax.lax.dot_general(                           # [H, P]
+            q, k.reshape(p, h_kv * d), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
     else:
         q3 = q.reshape(h_kv, g, q.shape[-1])               # [H_kv, G, D]
         s = jax.lax.dot_general(                           # [H_kv, G, P]
@@ -144,11 +154,12 @@ def _page_values(probs, v, h_kv: int, g: int, wide: bool = False):
     Same two formulations as :func:`_page_scores`."""
     if wide:
         h, p = probs.shape
-        pv3 = probs[:, :, None] * _group_onehot(h_kv, g)   # [H, P, H_kv]
-        return jax.lax.dot_general(                        # [H, D]
-            pv3.reshape(h, p * h_kv), v.reshape(p * h_kv, v.shape[-1]),
-            (((1,), (0,)), ((), ())),
+        d = v.shape[-1]
+        ow = jax.lax.dot_general(                          # [H, H_kv*D]
+            probs, v.reshape(p, h_kv * d), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        return (ow.reshape(h, h_kv, d)                     # aligned split
+                * _group_onehot(h_kv, g)).sum(1)           # [H, D]
     p3 = probs.reshape(h_kv, g, probs.shape[-1])           # [H_kv, G, P]
     out = jax.lax.dot_general(                             # [H_kv, G, D]
         p3, jnp.swapaxes(v, 0, 1), (((2,), (1,)), ((0,), (0,))),
@@ -213,6 +224,8 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
         if window is not None:
             valid = valid & (pos >= seq_len - window)
         q = q_ref[0].astype(jnp.float32)                       # [H, D]
+        if wide:
+            q = _widen_q(q, h_kv, g)                           # [H, H_kv*D]
         k = k_ref[0].astype(jnp.float32)                       # [P, H_kv, D]
         v = v_ref[0].astype(jnp.float32)
         ks_hp = vs_hp = None
@@ -379,6 +392,10 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
     l_ref[:] = jnp.zeros_like(l_ref)
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    q_seq = q_ref[0].astype(jnp.float32)                       # [H, D]
+    if wide:
+        q_seq = _widen_q(q_seq, h_kv, g)       # loop-invariant: hoisted
+
     def body(p, carry):
         slot = p % 2
 
@@ -396,14 +413,14 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
         if window is not None:
             valid = valid & (pos >= seq_len - window)
 
-        q = q_ref[0].astype(jnp.float32)                       # [H, D]
         k = k_buf[slot].astype(jnp.float32)                    # [P, H_kv, D]
         v = v_buf[slot].astype(jnp.float32)
         ks_hp = vs_hp = None
         if quantized:
             ks_hp = _scale_rows_t(ks_buf[slot], g)             # [H_kv, P]
             vs_hp = _scale_rows_t(vs_buf[slot], g)
-        s = _page_scores(q, k, scale, softcap, valid, h_kv, g, ks_hp, wide)
+        s = _page_scores(q_seq, k, scale, softcap, valid, h_kv, g, ks_hp,
+                         wide)
         _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, g, vs_hp, wide)
         return carry
 
