@@ -63,12 +63,13 @@ run() {
 
 # -- decision set first: a ~19-minute tunnel window must capture enough
 #    to pick the default (kernel backend, kv dtype, slot width) ---------
-# 1. kernel-only A/B, ~3-5 min
-run kernel_ab.txt         900 txt  python tools/kernel_bench.py --slots 32 --ctx 600
+# 1. kernel-only A/B (7 variants incl. the wide dot mode), ~5-8 min
+run kernel_ab.txt        1500 txt  python tools/kernel_bench.py --slots 32 --ctx 600
 # 2. full pipeline on the current default config
 run bench_quick.json     1200 json python bench.py --skip-serial --skip-ab --prompts 32
-# 3. the two candidate default configs
+# 3. the candidate default configs
 run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
+run bench_direct_wide.json 2400 json env REVAL_TPU_KERNEL_DOT=wide python bench.py --skip-serial --skip-ab
 # int8 pool halves KV reads AND lets 64 slots fit → weight reads amortise
 # over 2x the batch
 run bench_direct_kv8s64.json 2400 json python bench.py --kv-dtype int8 --slots 64 --skip-serial --skip-ab
