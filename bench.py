@@ -116,15 +116,12 @@ def note(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
-def last_known_good() -> dict | None:
-    """Most recent clean bench artifact on disk (watcher-captured or a past
-    official record).
-
-    The tunnel on this host wedges for many hours at a time; a
-    driver-run bench during a wedge must not go down as 0.0 when the code
-    HAS a verified number from the last time a chip answered — so the
-    failure JSON carries it (value, metric, device, commit, timestamp)
-    alongside the error."""
+def _newest_artifact(extract):
+    """Newest (mtime, path, extract(obj)) over the on-disk bench artifacts
+    (watcher-captured + official records) where ``extract`` returns
+    non-None.  Per-file failures (concurrent watcher rewrites, malformed
+    JSON, wrong types) are contained — a scan here must never raise into
+    a caller that is trying to salvage an already-measured number."""
     import glob
 
     root = os.path.dirname(os.path.abspath(__file__))
@@ -135,17 +132,34 @@ def last_known_good() -> dict | None:
         try:
             with open(path) as f:
                 obj = json.load(f)
+            val = extract(obj) if isinstance(obj, dict) else None
+            if val is None:
+                continue
+            mtime = os.path.getmtime(path)
         except Exception:
             continue
-        if (not isinstance(obj, dict) or obj.get("error")
-                or not obj.get("value") or "metric" not in obj):
-            continue
-        mtime = os.path.getmtime(path)
         if best is None or mtime > best[0]:
-            best = (mtime, path, obj)
+            best = (mtime, path, val)
+    return best
+
+
+def last_known_good() -> dict | None:
+    """Most recent clean bench artifact on disk (watcher-captured or a past
+    official record).
+
+    The tunnel on this host wedges for many hours at a time; a
+    driver-run bench during a wedge must not go down as 0.0 when the code
+    HAS a verified number from the last time a chip answered — so the
+    failure JSON carries it (value, metric, device, commit, timestamp)
+    alongside the error."""
+    best = _newest_artifact(
+        lambda obj: obj if (not obj.get("error") and obj.get("value")
+                            and "metric" in obj
+                            and "TINY-SMOKE" not in obj["metric"]) else None)
     if best is None:
         return None
     mtime, path, obj = best
+    root = os.path.dirname(os.path.abspath(__file__))
     out = {"value": obj["value"], "unit": obj.get("unit", ""),
            "metric": obj["metric"], "device": obj.get("device", ""),
            "source": os.path.relpath(path, root),
@@ -161,6 +175,23 @@ def last_known_good() -> dict | None:
     except Exception:
         pass
     return out
+
+
+def _last_serial_rate() -> tuple[float, str] | None:
+    """Newest artifact's measured serial-harness rate (probes/s/chip) and
+    its source path — the vs_baseline denominator when a wedge kills the
+    serial phase but the headline paged number survived.  The source is
+    recorded in the emitted JSON so a reader can judge staleness/device
+    comparability."""
+    def extract(obj):
+        rate = obj.get("serial_probes_per_sec")
+        return float(rate) if rate else None
+
+    best = _newest_artifact(extract)
+    if best is None:
+        return None
+    root = os.path.dirname(os.path.abspath(__file__))
+    return best[2], os.path.relpath(best[1], root)
 
 
 def fail(metric: str, error: str, detail: str = "") -> None:
@@ -632,24 +663,65 @@ def main() -> None:
             extras["spec_accept_rate"] = round(
                 stats.spec_accepted / max(1, stats.spec_rounds * spec_k), 3)
 
+        # The headline number is already measured; the A/B and serial
+        # phases are garnish.  Persist it to disk NOW: a wedge in a
+        # garnish phase blocks forever (no exception) until the runbook
+        # timeout SIGKILLs this process, and the final emit() would never
+        # run.  The artifact carries value+metric and no error, so
+        # last_known_good() treats it as the clean measurement it is.
+        # TPU-only: a --tiny/--force-cpu smoke must never seed the
+        # last-known pool with toy numbers.
+        if platform == "tpu":
+            try:
+                headline = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tpu_watch",
+                    "bench_headline.json")
+                with open(headline + ".tmp", "w") as f:
+                    json.dump({"metric": metric,
+                               "value": round(probes_per_sec, 3),
+                               "unit": "probes/s/chip", "vs_baseline": 0.0,
+                               "pre_garnish": True, **extras}, f)
+                os.replace(headline + ".tmp", headline)
+            except OSError:
+                pass
+
+        # A garnish-phase exception must NOT discard the real value into
+        # fail()'s last_known path — record the phase error and emit what
+        # was measured.
         if not args.skip_ab:
             note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); prefix-sharing A/B')
-            wall_nopre, _ = run_paged(params, cfg, tok, prompts, max_new,
-                                      prefix_sharing=False,
-                                      max_slots=args.slots,
-                                      max_seq_len=args.max_seq_len,
-                                      num_pages=num_pages,
-                                      kv_dtype=args.kv_dtype)
-            extras["prefix_sharing_speedup"] = round(wall_nopre / wall, 3)
+            try:
+                wall_nopre, _ = run_paged(params, cfg, tok, prompts, max_new,
+                                          prefix_sharing=False,
+                                          max_slots=args.slots,
+                                          max_seq_len=args.max_seq_len,
+                                          num_pages=num_pages,
+                                          kv_dtype=args.kv_dtype)
+                extras["prefix_sharing_speedup"] = round(wall_nopre / wall, 3)
+            except Exception as e:
+                extras["ab_error"] = type(e).__name__
+                note(f'prefix-sharing A/B failed ({type(e).__name__}); '
+                     'keeping the measured headline')
 
         vs_baseline = 0.0
         if not args.skip_serial:
             sp = prompts[: args.serial_prompts]
             note(f'serial baseline ({len(sp)} prompts, batch 1)')
-            serial_s, _ = run_serial(params, cfg, tok, sp, max_new)
-            serial_per_sec = len(sp) / serial_s / chips_used
-            extras["serial_probes_per_sec"] = round(serial_per_sec, 4)
-            vs_baseline = probes_per_sec / serial_per_sec
+            try:
+                serial_s, _ = run_serial(params, cfg, tok, sp, max_new)
+                serial_per_sec = len(sp) / serial_s / chips_used
+                extras["serial_probes_per_sec"] = round(serial_per_sec, 4)
+                vs_baseline = probes_per_sec / serial_per_sec
+            except Exception as e:
+                extras["serial_error"] = type(e).__name__
+                lk_serial = _last_serial_rate()   # never raises
+                if lk_serial:
+                    rate, src = lk_serial
+                    extras["serial_probes_per_sec_last_known"] = rate
+                    extras["serial_last_known_source"] = src
+                    vs_baseline = probes_per_sec / rate
+                note(f'serial baseline failed ({type(e).__name__}); '
+                     'keeping the measured headline')
 
         emit({"metric": metric, "value": round(probes_per_sec, 3),
               "unit": "probes/s/chip", "vs_baseline": round(vs_baseline, 2),
