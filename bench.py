@@ -177,15 +177,20 @@ def last_known_good() -> dict | None:
     return out
 
 
-def _last_serial_rate() -> tuple[float, str] | None:
-    """Newest artifact's measured serial-harness rate (probes/s/chip) and
-    its source path — the vs_baseline denominator when a wedge kills the
-    serial phase but the headline paged number survived.  The source is
-    recorded in the emitted JSON so a reader can judge staleness/device
-    comparability."""
+def _last_serial_rate(shape: str, mode: str) -> tuple[float, str] | None:
+    """Newest COMPARABLE artifact's measured serial-harness rate
+    (probes/s/chip) and its source path — the vs_baseline denominator
+    when a wedge kills the serial phase but the headline paged number
+    survived.  Comparable = same model shape and eval mode in the metric
+    label (a cot serial rate is ~4× slower than direct; dividing across
+    modes would inflate the speedup) and never a tiny smoke."""
     def extract(obj):
         rate = obj.get("serial_probes_per_sec")
-        return float(rate) if rate else None
+        metric_s = obj.get("metric", "")
+        if (not rate or "TINY-SMOKE" in metric_s or shape not in metric_s
+                or f", {mode}," not in metric_s):
+            return None
+        return float(rate)
 
     best = _newest_artifact(extract)
     if best is None:
@@ -511,7 +516,39 @@ def main() -> None:
     ap.add_argument("--tiny", action="store_true",
                     help="toy model + short budgets: CPU smoke test of the "
                          "bench harness itself, NOT a performance number")
+    ap.add_argument("--no-autotune", action="store_true",
+                    help="ignore tpu_watch/autotune.json — REQUIRED for "
+                         "A/B candidate runs, which must measure exactly "
+                         "their pinned config (a decision feeding back "
+                         "into its own candidates oscillates on noise)")
     args = ap.parse_args()
+
+    # flags left at their defaults adopt the persisted autotune decision
+    # (tools/decide_defaults.py: the measured-best bench config from the
+    # last tunnel window), so the driver's official run benches the
+    # winning configuration without a live session editing constants.
+    # Scope-checked: a decision measured on 1.3b/direct must not override
+    # the memory-safe defaults of another model or mode (cot's 24 slots /
+    # 6.7b's 8 exist because bigger pools don't fit beside the weights).
+    if (not args.tiny and not args.no_autotune
+            and args.kv_dtype == "" and args.slots is None):
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "tpu_watch", "autotune.json")) as f:
+                tuned_obj = json.load(f)
+            tuned = tuned_obj.get("bench_args") or {}
+            scope = tuned_obj.get("scope") or {}
+            if (scope.get("mode") == args.mode
+                    and scope.get("model") == args.model):
+                if tuned.get("kv_dtype") in ("", "int8"):
+                    args.kv_dtype = tuned["kv_dtype"]
+                if isinstance(tuned.get("slots"), int):
+                    args.slots = tuned["slots"]
+                if tuned:
+                    note("autotune: applying measured-best bench config "
+                         f"{tuned}")
+        except (OSError, ValueError):
+            pass
 
     from reval_tpu.inference.base import MAX_NEW_TOKENS
 
@@ -714,7 +751,7 @@ def main() -> None:
                 vs_baseline = probes_per_sec / serial_per_sec
             except Exception as e:
                 extras["serial_error"] = type(e).__name__
-                lk_serial = _last_serial_rate()   # never raises
+                lk_serial = _last_serial_rate(shape, args.mode)  # no raise
                 if lk_serial:
                     rate, src = lk_serial
                     extras["serial_probes_per_sec_last_known"] = rate

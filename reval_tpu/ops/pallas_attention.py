@@ -60,6 +60,32 @@ __all__ = [
 
 _NEG_INF = -1e30
 
+_AUTOTUNE_CACHE: dict = {}
+
+
+def _autotune_defaults() -> dict:
+    """Measured-best kernel config persisted by tools/decide_defaults.py
+    (``{repo}/tpu_watch/autotune.json``; override with
+    ``REVAL_TPU_AUTOTUNE_FILE``).  Missing/invalid file → {}.  Cached per
+    path so the dispatch hot path stats the file once."""
+    import json
+    import os
+
+    path = os.environ.get("REVAL_TPU_AUTOTUNE_FILE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tpu_watch", "autotune.json")
+    if path not in _AUTOTUNE_CACHE:
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+            _AUTOTUNE_CACHE[path] = {
+                k: obj[k] for k in ("REVAL_TPU_PAGED_BACKEND",
+                                    "REVAL_TPU_KERNEL_DOT")
+                if isinstance(obj.get(k), str)}
+        except (OSError, ValueError):
+            _AUTOTUNE_CACHE[path] = {}
+    return _AUTOTUNE_CACHE[path]
+
 
 def _scale_rows(s_ph, g: int):
     """[P, H_kv] per-(token, head) scales → a [H, P] multiplier aligned
@@ -583,10 +609,18 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     and keying interpret on ``jax.default_backend()`` would silently
     trace the HLO emulation instead of the Mosaic kernel — compiling a
     program the chip never runs.
+
+    When an env var is UNSET, the persisted autotune decision
+    (``tpu_watch/autotune.json``, written by ``tools/decide_defaults.py``
+    from recorded on-chip A/B artifacts; path override:
+    ``REVAL_TPU_AUTOTUNE_FILE``) supplies the measured-best default — so
+    the driver's official bench and any engine user run the winning
+    config without a live session flipping constants.
     """
     import os
 
-    choice = os.environ.get("REVAL_TPU_PAGED_BACKEND")
+    choice = (os.environ.get("REVAL_TPU_PAGED_BACKEND")
+              or _autotune_defaults().get("REVAL_TPU_PAGED_BACKEND"))
     if choice not in (None, "", "pallas", "pallas_seq", "xla"):
         # a typo here would silently bench the wrong backend under the
         # right label — fail loudly instead
@@ -607,7 +641,8 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         force = os.environ.get("REVAL_TPU_FORCE_MOSAIC", "").lower()
         kw["interpret"] = (jax.default_backend() != "tpu"
                            and force not in ("1", "true"))
-        dot = os.environ.get("REVAL_TPU_KERNEL_DOT", "swap")
+        dot = (os.environ.get("REVAL_TPU_KERNEL_DOT")
+               or _autotune_defaults().get("REVAL_TPU_KERNEL_DOT") or "swap")
         if dot not in ("swap", "wide"):
             raise ValueError(f"unknown REVAL_TPU_KERNEL_DOT {dot!r}; "
                              "expected swap | wide")

@@ -13,6 +13,10 @@
 cd /root/repo || exit 1
 mkdir -p tpu_watch
 R=tpu_watch
+# apply the measured-best config decided on an earlier pass (see
+# tools/decide_defaults.py); decision-set steps that pin their own env
+# override per-step
+[ -f "$R/decided_env.sh" ] && . "$R/decided_env.sh"
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/.cache/jax_comp}"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
@@ -63,20 +67,29 @@ run() {
 
 # -- decision set first: a ~19-minute tunnel window must capture enough
 #    to pick the default (kernel backend, kv dtype, slot width) ---------
+# Decision-set steps pin EVERY config axis explicitly (backend, dot,
+# --no-autotune): a sourced decided_env.sh or persisted autotune.json
+# must never leak into the A/B rows, or decide_defaults would label
+# measurements with configs they did not run (self-reinforcing loop).
 # 1. kernel-only A/B (7 variants incl. the wide dot mode), ~5-8 min
 run kernel_ab.txt        1500 txt  python tools/kernel_bench.py --slots 32 --ctx 600
-# 2. full pipeline on the current default config
-run bench_quick.json     1200 json python bench.py --skip-serial --skip-ab --prompts 32
+# 2. full pipeline on the baseline default config
+run bench_quick.json     1200 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --skip-serial --skip-ab --prompts 32
 # 3. the candidate default configs
-run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq python bench.py --skip-serial --skip-ab
-run bench_direct_wide.json 2400 json env REVAL_TPU_KERNEL_DOT=wide python bench.py --skip-serial --skip-ab
+run bench_direct_seqk.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas_seq REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --skip-serial --skip-ab
+run bench_direct_wide.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=wide python bench.py --no-autotune --skip-serial --skip-ab
 # int8 pool halves KV reads AND lets 64 slots fit → weight reads amortise
 # over 2x the batch
-run bench_direct_kv8s64.json 2400 json python bench.py --kv-dtype int8 --slots 64 --skip-serial --skip-ab
+run bench_direct_kv8s64.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --kv-dtype int8 --slots 64 --skip-serial --skip-ab
 # 4. speculative decoding measure-or-cut (round-4 verdict item 3): the
 #    spec path is deleted this round unless a number lands, so its A/B
 #    outranks the diagnosis steps
-run bench_direct_spec.json 2400 json python bench.py --spec --skip-serial --skip-ab
+run bench_direct_spec.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --spec --skip-serial --skip-ab
+# 5. persist the winning (backend, dot-mode) so the diagnosis tier below,
+#    the dispatcher's autotune fallback, and the driver's official bench
+#    all run the measured-best config (idempotent: re-decides each pass
+#    from whatever artifacts exist)
+python tools/decide_defaults.py >> $R/runbook.log 2>&1 && . "$R/decided_env.sh"
 # -- diagnosis + official numbers --------------------------------------
 run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
 run bench_direct.json    2400 json python bench.py
