@@ -60,6 +60,24 @@ def test_no_artifacts_decides_nothing(tmp_path):
     assert decide(str(tmp_path)) is None
 
 
+def test_all_pallas_dead_falls_back_to_xla(tmp_path):
+    """Every Mosaic variant rejected by the chip helper → the emergency
+    xla tier (kernel row or bench artifact) still yields a working
+    decision instead of none."""
+    d = str(tmp_path)
+    with open(os.path.join(d, "kernel_ab.txt"), "w") as f:
+        f.write("grid           FAILED: MosaicError: ...\n"
+                "seq            FAILED: MosaicError: ...\n"
+                "xla             106.335 ms/step     37.9 GB/s effective\n")
+    got = decide(d)
+    assert got["REVAL_TPU_PAGED_BACKEND"] == "xla"
+
+    _write(d, "bench_direct_xlab.json", {"value": 0.9})
+    got = decide(d)
+    assert got["REVAL_TPU_PAGED_BACKEND"] == "xla"
+    assert got["evidence"]["tier"] == "full-pipeline"
+
+
 def test_dispatcher_env_unset_uses_autotune_file(tmp_path, monkeypatch):
     from reval_tpu.ops import pallas_attention as pa
 

@@ -81,6 +81,12 @@ run bench_direct_wide.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TP
 # int8 pool halves KV reads AND lets 64 slots fit → weight reads amortise
 # over 2x the batch
 run bench_direct_kv8s64.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --kv-dtype int8 --slots 64 --skip-serial --skip-ab
+# 3b. emergency tier: only when the pallas quick bench has no artifact
+#     (e.g. the chip helper rejects every Mosaic variant) — a working
+#     XLA-backend number beats a round of failure JSONs
+if [ ! -s "$R/bench_quick.json" ]; then
+  run bench_direct_xlab.json 2400 json env REVAL_TPU_PAGED_BACKEND=xla REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --skip-serial --skip-ab
+fi
 # 4. speculative decoding measure-or-cut (round-4 verdict item 3): the
 #    spec path is deleted this round unless a number lands, so its A/B
 #    outranks the diagnosis steps
@@ -90,6 +96,20 @@ run bench_direct_spec.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TP
 #    all run the measured-best config (idempotent: re-decides each pass
 #    from whatever artifacts exist)
 python tools/decide_defaults.py >> $R/runbook.log 2>&1 && . "$R/decided_env.sh"
+# A decision CHANGE invalidates the diagnosis tier: those artifacts
+# inherit the decided config, and the idempotent skip would otherwise
+# freeze headline numbers measured under a superseded (e.g. emergency
+# xla) decision forever.  Decision-set artifacts pin their own env and
+# stay.
+FP="${REVAL_TPU_PAGED_BACKEND:-pallas}/${REVAL_TPU_KERNEL_DOT:-swap}"
+if [ -f "$R/diagnosis_config.txt" ] && [ "$(cat "$R/diagnosis_config.txt")" != "$FP" ]; then
+  log "decision changed ($(cat "$R/diagnosis_config.txt") -> $FP): invalidating diagnosis artifacts"
+  rm -f "$R"/ablate.txt "$R"/ablate2.txt "$R"/bench_direct.json \
+        "$R"/bench_cot.json "$R"/bench_direct_int8.json \
+        "$R"/bench_cot_kv8.json "$R"/fleet.json \
+        "$R"/bench_direct_int4.json "$R"/bench_cot_spec.json
+fi
+echo "$FP" > "$R/diagnosis_config.txt"
 # -- diagnosis + official numbers --------------------------------------
 run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
 run bench_direct.json    2400 json python bench.py

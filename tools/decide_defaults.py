@@ -39,6 +39,10 @@ BENCH_CONFIGS = [
     ("bench_direct_wide.json", "pallas", "wide", {}),
     ("bench_direct_kv8s64.json", "pallas", "swap",
      {"kv_dtype": "int8", "slots": 64}),
+    # emergency tier: the runbook only measures this when the pallas
+    # quick bench failed (e.g. every Mosaic variant rejected by the
+    # chip helper) — a working slow backend beats a failing fast one
+    ("bench_direct_xlab.json", "xla", "swap", {}),
 ]
 # kernel_ab row label → (backend, dot) — fallback tier
 AB_ROWS = {
@@ -46,6 +50,7 @@ AB_ROWS = {
     "seq": ("pallas_seq", "swap"),
     "grid-wide": ("pallas", "wide"),
     "seq-wide": ("pallas_seq", "wide"),
+    "xla": ("xla", "swap"),
 }
 
 
