@@ -1,15 +1,17 @@
 #!/bin/bash
-# Round-4 on-chip measurement suite.  Idempotent: each step skips itself
+# Round-5 on-chip measurement suite.  Idempotent: each step skips itself
 # once its artifact exists, so repeated invocations (the tpu_watch loop
 # calls this every time the tunnel is up) resume where the last window
 # ended.
 #
-# Round-4 state: the r3-kernel baselines live in tpu_watch/r3k_*.  The
-# attention kernel was rewritten after the r4 ablation showed in-kernel
-# per-head op count (not bandwidth) dominating (r3k_ablate_partial.txt:
-# full 23.6 ms/step vs no-attn 7.6 ms vs ~8 ms roofline), so every
-# artifact here re-measures on the batched-head kernels; kernel_ab runs
-# FIRST because it decides the default backend (grid vs seq).
+# Round-5 state: the r3-kernel baselines live in tpu_watch/r3k_*; the
+# round-4 batched-head kernels needed an on-chip Mosaic fix (batch dims
+# must both be dim 0 — PERF.md round-5 session 1) and grew a second
+# A/B-able dot formulation (wide).  kernel_ab runs FIRST because it
+# decides the default backend/dot; tools/decide_defaults.py then
+# persists the winner (autotune.json + decided_env.sh) so the diagnosis
+# tier, the dispatcher, and the driver's official bench all run the
+# measured-best config even when no session is active.
 cd /root/repo || exit 1
 mkdir -p tpu_watch
 R=tpu_watch
