@@ -61,17 +61,29 @@ def main() -> int:
     jax.config.update("jax_compilation_cache_dir", args.cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    # (backend, kv_dtype, slots, weights, per_seq, span)
-    jobs = [("pallas", "", 32, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT)]
+    # (backend, kv_dtype, slots, weights, per_seq, span, dot_mode)
+    jobs = [("pallas", "", 32, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT,
+             "swap")]
     if not args.quick:
         jobs += [
-            ("pallas_seq", "", 32, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT),
-            ("pallas", "int8", 64, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT),
+            ("pallas_seq", "", 32, "bf16w", PER_SEQ_DIRECT,
+             BENCH_SPAN_DIRECT, "swap"),
+            # the wide dot-mode candidates (REVAL_TPU_KERNEL_DOT=wide):
+            # if the on-chip A/B flips the default, the diagnosis tier's
+            # first pass must not pay fresh compiles
+            ("pallas", "", 32, "bf16w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT,
+             "wide"),
+            ("pallas_seq", "", 32, "bf16w", PER_SEQ_DIRECT,
+             BENCH_SPAN_DIRECT, "wide"),
+            ("pallas", "int8", 64, "bf16w", PER_SEQ_DIRECT,
+             BENCH_SPAN_DIRECT, "swap"),
             ("pallas_seq", "int8", 64, "bf16w", PER_SEQ_DIRECT,
-             BENCH_SPAN_DIRECT),
-            ("pallas", "", 32, "int8w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT),
-            ("pallas", "", 24, "bf16w", PER_SEQ_COT, BENCH_SPAN_COT),
-            ("pallas", "int8", 24, "bf16w", PER_SEQ_COT, BENCH_SPAN_COT),
+             BENCH_SPAN_DIRECT, "swap"),
+            ("pallas", "", 32, "int8w", PER_SEQ_DIRECT, BENCH_SPAN_DIRECT,
+             "swap"),
+            ("pallas", "", 24, "bf16w", PER_SEQ_COT, BENCH_SPAN_COT, "swap"),
+            ("pallas", "int8", 24, "bf16w", PER_SEQ_COT, BENCH_SPAN_COT,
+             "swap"),
         ]
 
     failures = 0
@@ -91,7 +103,7 @@ def main() -> int:
     # decode jobs imply, at both admission-wave row buckets
     if not args.quick:
         seen: set[tuple] = set()
-        for _, kv_dtype, slots, wdtype, per_seq, _ in jobs:
+        for _, kv_dtype, slots, wdtype, per_seq, _, _ in jobs:
             combo = (wdtype, kv_dtype, bench_pool(slots, per_seq))
             if combo in seen:
                 continue
@@ -102,13 +114,15 @@ def main() -> int:
                     aot_programs.compile_prefill_commit, rows=rows,
                     weights=wdtype, kv_dtype=kv_dtype, num_pages=combo[2])
 
-    for backend, kv_dtype, slots, wdtype, per_seq, span in jobs:
+    for backend, kv_dtype, slots, wdtype, per_seq, span, dot in jobs:
+        os.environ["REVAL_TPU_KERNEL_DOT"] = dot   # read at trace time
         for steps in (8, 32):
             run(f"{backend}/kv={kv_dtype or 'bf16'}/s{slots}/{wdtype}"
-                f"/steps{steps}",
+                f"/steps{steps}/dot={dot}",
                 aot_programs.compile_flagship_chunk, steps=steps,
                 slots=slots, kv_dtype=kv_dtype, weights=wdtype,
                 per_seq=per_seq, span=span, backend=backend)
+    os.environ.pop("REVAL_TPU_KERNEL_DOT", None)
     return 1 if failures else 0
 
 
