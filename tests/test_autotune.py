@@ -78,6 +78,28 @@ def test_all_pallas_dead_falls_back_to_xla(tmp_path):
     assert got["evidence"]["tier"] == "full-pipeline"
 
 
+def test_main_writes_autotune_and_env_files(tmp_path):
+    from tools.decide_defaults import main
+
+    d = str(tmp_path)
+    _write(d, "bench_quick.json", {"value": 3.3})
+    assert main(["--watch", d]) == 0
+    with open(os.path.join(d, "autotune.json")) as f:
+        tuned = json.load(f)
+    assert tuned["REVAL_TPU_PAGED_BACKEND"] == "pallas"
+    assert "decided_at" in tuned
+    env = open(os.path.join(d, "decided_env.sh")).read()
+    assert "export REVAL_TPU_PAGED_BACKEND=pallas" in env
+    assert "export REVAL_TPU_KERNEL_DOT=swap" in env
+
+
+def test_main_no_artifacts_rc1(tmp_path):
+    from tools.decide_defaults import main
+
+    assert main(["--watch", str(tmp_path)]) == 1
+    assert not os.path.exists(os.path.join(str(tmp_path), "autotune.json"))
+
+
 def test_dispatcher_env_unset_uses_autotune_file(tmp_path, monkeypatch):
     from reval_tpu.ops import pallas_attention as pa
 

@@ -108,18 +108,25 @@ def decide(watch: str = WATCH) -> dict | None:
                          "ms_per_step": ms}}
 
 
-def main() -> int:
-    decision = decide()
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--watch", default=WATCH,
+                    help="artifact directory (default: tpu_watch/)")
+    args = ap.parse_args(argv)
+
+    decision = decide(args.watch)
     if decision is None:
         print("no usable artifacts yet; nothing decided")
         return 1
     decision["decided_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    os.makedirs(WATCH, exist_ok=True)
-    out = os.path.join(WATCH, "autotune.json")
+    os.makedirs(args.watch, exist_ok=True)
+    out = os.path.join(args.watch, "autotune.json")
     with open(out + ".tmp", "w") as f:
         json.dump(decision, f, indent=1)
     os.replace(out + ".tmp", out)
-    env = os.path.join(WATCH, "decided_env.sh")
+    env = os.path.join(args.watch, "decided_env.sh")
     with open(env + ".tmp", "w") as f:
         f.write("# written by tools/decide_defaults.py — measured-best "
                 "paged-attention config\n")
