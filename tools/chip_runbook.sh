@@ -113,9 +113,11 @@ if [ -f "$R/diagnosis_config.txt" ] && [ "$(cat "$R/diagnosis_config.txt")" != "
 fi
 echo "$FP" > "$R/diagnosis_config.txt"
 # -- diagnosis + official numbers --------------------------------------
-run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
+# official numbers FIRST (round-5 verdict wants a fresh direct headline
+# and a cot row; a 40-min ablation must not eat a short window first)
 run bench_direct.json    2400 json python bench.py
 run bench_cot.json       3600 json python bench.py --mode cot
+run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
 # 5. dtype / feature A-Bs on the new kernel
 run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
 run bench_cot_kv8.json   3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
