@@ -73,8 +73,9 @@ run() {
 # --no-autotune): a sourced decided_env.sh or persisted autotune.json
 # must never leak into the A/B rows, or decide_defaults would label
 # measurements with configs they did not run (self-reinforcing loop).
-# 1. kernel-only A/B (7 variants incl. the wide dot mode), ~5-8 min
-run kernel_ab.txt        1500 txt  python tools/kernel_bench.py --slots 32 --ctx 600
+# 1. kernel-only A/B (5 variants incl. the wide dot mode; int8 rows are
+#    diagnosis and run later), ~4-6 min
+run kernel_ab.txt        1500 txt  python tools/kernel_bench.py --slots 32 --ctx 600 --no-int8
 # 2. full pipeline on the baseline default config
 run bench_quick.json     1200 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --skip-serial --skip-ab --prompts 32
 # 3. the candidate default configs
@@ -118,6 +119,7 @@ echo "$FP" > "$R/diagnosis_config.txt"
 run bench_direct.json    2400 json python bench.py
 run bench_cot.json       3600 json python bench.py --mode cot
 run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
+run kernel_ab_int8.txt   1200 txt  python tools/kernel_bench.py --slots 32 --ctx 600
 # 5. dtype / feature A-Bs on the new kernel
 run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial --skip-ab
 run bench_cot_kv8.json   3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab

@@ -35,6 +35,10 @@ def main() -> None:
     ap.add_argument("--span", type=int, default=16, help="block-table span")
     ap.add_argument("--layers", type=int, default=24)
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--no-int8", action="store_true",
+                    help="skip the int8-pool rows (pure diagnosis — the "
+                         "kv8s64 full-pipeline bench decides the kv dtype; "
+                         "saves ~4 min of compiles in a short window)")
     ap.add_argument("--tiny", action="store_true", help="CPU smoke")
     args = ap.parse_args()
 
@@ -142,8 +146,11 @@ def main() -> None:
                                  dot_mode="wide"), kp, vp)
     variant("seq-wide", partial(pa.paged_decode_attention_pallas_seq,
                                 dot_mode="wide"), kp, vp)
-    variant("grid-int8", pa.paged_decode_attention_pallas, kp8, vp8, scales=True)
-    variant("seq-int8", pa.paged_decode_attention_pallas_seq, kp8, vp8, scales=True)
+    if not args.no_int8:
+        variant("grid-int8", pa.paged_decode_attention_pallas, kp8, vp8,
+                scales=True)
+        variant("seq-int8", pa.paged_decode_attention_pallas_seq, kp8, vp8,
+                scales=True)
     if not args.tiny:
         variant("xla", pa.paged_decode_attention_xla, kp, vp)
 
