@@ -151,15 +151,63 @@ def last_known_good() -> dict | None:
     driver-run bench during a wedge must not go down as 0.0 when the code
     HAS a verified number from the last time a chip answered — so the
     failure JSON carries it (value, metric, device, commit, timestamp)
-    alongside the error."""
-    best = _newest_artifact(
-        lambda obj: obj if (not obj.get("error") and obj.get("value")
-                            and "metric" in obj
-                            and "TINY-SMOKE" not in obj["metric"]) else None)
+    alongside the error.
+
+    When an autotune decision exists, its evidence artifact is preferred
+    over the merely-newest one: the newest file is often a pinned A/B
+    candidate (e.g. the wide dot mode) that lost the decision, and the
+    number a rerun under the decided config would reproduce is the
+    decision's, not the loser's.  Exception: an OFFICIAL artifact
+    (bench_direct/bench_cot/BENCH_r*, which always run the decided
+    config) newer than the evidence supersedes it — the decision file
+    only tracks decision-set sources, so without this the fallback
+    would report a stale A/B number forever after fresher official
+    measurements land.  bench_headline.json is NOT official — pinned
+    A/B candidates write it too."""
+    def _clean(obj):
+        if not isinstance(obj, dict):
+            return None
+        # driver records (BENCH_r*.json) nest the bench line under "parsed"
+        if "value" not in obj and isinstance(obj.get("parsed"), dict):
+            obj = obj["parsed"]
+        return obj if (not obj.get("error") and obj.get("value")
+                       and "metric" in obj
+                       and "TINY-SMOKE" not in obj["metric"]) else None
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    try:
+        with open(os.path.join(root, "tpu_watch", "autotune.json")) as f:
+            src = json.load(f)["evidence"]["source"]
+        # kernel-ab-tier decisions cite "kernel_ab.txt:<label>" — no
+        # full-pipeline artifact to prefer; fall through to newest
+        if src.endswith(".json"):
+            epath = os.path.join(root, "tpu_watch", src)
+            with open(epath) as f:
+                eobj = _clean(json.load(f))
+            if eobj:
+                best = (os.path.getmtime(epath), epath, eobj)
+    except Exception:
+        pass
+    if best is not None:
+        import glob
+        official = ([os.path.join(root, "tpu_watch", "bench_direct.json"),
+                     os.path.join(root, "tpu_watch", "bench_cot.json")]
+                    + glob.glob(os.path.join(root, "BENCH_r*.json")))
+        for path in official:
+            try:
+                with open(path) as f:
+                    obj = _clean(json.load(f))
+                mtime = os.path.getmtime(path)
+            except Exception:
+                continue
+            if obj and mtime > best[0]:
+                best = (mtime, path, obj)
+    if best is None:
+        best = _newest_artifact(_clean)
     if best is None:
         return None
     mtime, path, obj = best
-    root = os.path.dirname(os.path.abspath(__file__))
     out = {"value": obj["value"], "unit": obj.get("unit", ""),
            "metric": obj["metric"], "device": obj.get("device", ""),
            "source": os.path.relpath(path, root),
@@ -185,6 +233,8 @@ def _last_serial_rate(shape: str, mode: str) -> tuple[float, str] | None:
     label (a cot serial rate is ~4× slower than direct; dividing across
     modes would inflate the speedup) and never a tiny smoke."""
     def extract(obj):
+        if "value" not in obj and isinstance(obj.get("parsed"), dict):
+            obj = obj["parsed"]
         rate = obj.get("serial_probes_per_sec")
         metric_s = obj.get("metric", "")
         if (not rate or "TINY-SMOKE" in metric_s or shape not in metric_s

@@ -39,6 +39,10 @@ def main() -> None:
                     help="skip the int8-pool rows (pure diagnosis — the "
                          "kv8s64 full-pipeline bench decides the kv dtype; "
                          "saves ~4 min of compiles in a short window)")
+    ap.add_argument("--only-int8", action="store_true",
+                    help="run ONLY the int8-pool rows (the deferred half of "
+                         "a --no-int8 pass; the bf16/xla rows are already "
+                         "in kernel_ab.txt and need not be re-measured)")
     ap.add_argument("--tiny", action="store_true", help="CPU smoke")
     args = ap.parse_args()
 
@@ -63,9 +67,11 @@ def main() -> None:
     q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
     kp = jnp.asarray(rng.standard_normal((n_pages * p, h_kv, d)), jnp.bfloat16)
     vp = jnp.asarray(rng.standard_normal((n_pages * p, h_kv, d)), jnp.bfloat16)
-    kp8 = (kp * 16).astype(jnp.int8)
-    vp8 = (vp * 16).astype(jnp.int8)
-    ks = jnp.full((n_pages * p, h_kv), 1 / 16, jnp.float32)
+    kp8 = vp8 = ks = None
+    if not args.no_int8:
+        kp8 = (kp * 16).astype(jnp.int8)
+        vp8 = (vp * 16).astype(jnp.int8)
+        ks = jnp.full((n_pages * p, h_kv), 1 / 16, jnp.float32)
     tables = np.zeros((b, args.span), np.int32)
     for s in range(b):
         for j in range(need):
@@ -140,18 +146,19 @@ def main() -> None:
         except Exception as e:
             print(f"{label:14s} FAILED: {type(e).__name__}: {str(e)[:120]}")
 
-    variant("grid", pa.paged_decode_attention_pallas, kp, vp)
-    variant("seq", pa.paged_decode_attention_pallas_seq, kp, vp)
-    variant("grid-wide", partial(pa.paged_decode_attention_pallas,
-                                 dot_mode="wide"), kp, vp)
-    variant("seq-wide", partial(pa.paged_decode_attention_pallas_seq,
-                                dot_mode="wide"), kp, vp)
+    if not args.only_int8:
+        variant("grid", pa.paged_decode_attention_pallas, kp, vp)
+        variant("seq", pa.paged_decode_attention_pallas_seq, kp, vp)
+        variant("grid-wide", partial(pa.paged_decode_attention_pallas,
+                                     dot_mode="wide"), kp, vp)
+        variant("seq-wide", partial(pa.paged_decode_attention_pallas_seq,
+                                    dot_mode="wide"), kp, vp)
     if not args.no_int8:
         variant("grid-int8", pa.paged_decode_attention_pallas, kp8, vp8,
                 scales=True)
         variant("seq-int8", pa.paged_decode_attention_pallas_seq, kp8, vp8,
                 scales=True)
-    if not args.tiny:
+    if not args.tiny and not args.only_int8:
         variant("xla", pa.paged_decode_attention_xla, kp, vp)
 
     if ok_count == 0:

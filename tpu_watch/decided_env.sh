@@ -1,0 +1,3 @@
+# written by tools/decide_defaults.py — measured-best paged-attention config
+export REVAL_TPU_PAGED_BACKEND=pallas_seq
+export REVAL_TPU_KERNEL_DOT=swap
