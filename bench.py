@@ -744,6 +744,7 @@ def main() -> None:
             "decode_share": round(stats.decode_seconds / wall, 3) if wall else 0.0,
             "wall_seconds": round(wall, 2),
             "warmup_wall_seconds": round(getattr(stats, "warmup_wall", 0.0), 2),
+            "pipelined_chunks": getattr(stats, "pipelined_chunks", 0),
         }
         if args.spec:
             extras["spec"] = True

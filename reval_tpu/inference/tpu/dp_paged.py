@@ -109,6 +109,7 @@ class DataParallelPagedEngine:
             agg.prefill_seconds += s.prefill_seconds
             agg.decode_chunks += s.decode_chunks
             agg.decode_steps += s.decode_steps
+            agg.pipelined_chunks += s.pipelined_chunks
             agg.spec_rounds += s.spec_rounds
             agg.spec_accepted += s.spec_accepted
         return agg

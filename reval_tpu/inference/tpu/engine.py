@@ -170,6 +170,8 @@ class EngineStats:
     decode_steps: int = 0        # weight passes: forward executions of the
                                  # decode program over the batch (spec
                                  # counts verify rounds, not tokens)
+    pipelined_chunks: int = 0    # chunks whose fetch rode behind the next
+                                 # dispatch (paged engine chunk pipeline)
     spec_rounds: int = 0         # draft+verify rounds executed (per slot)
     spec_accepted: int = 0       # draft tokens accepted (bonus excluded)
 

@@ -26,6 +26,7 @@ parallelism for paged decode is one engine replica per host/dp-group
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -128,6 +129,10 @@ class _DriveState:
     dirty: bool = True
     span: int = 0
     since_admit: int = 0
+    #: in-flight decode chunk awaiting its host half:
+    #: (toks device array, steps, ((slot, seq_id), ...) snapshot, t0)
+    pending: tuple | None = None
+    t_mark: float = 0.0          # last fetch end (decode-wall accounting)
 
 
 class PagedTPUEngine:
@@ -136,7 +141,8 @@ class PagedTPUEngine:
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  mesh=None, seed: int = 0, prefix_sharing: bool = True,
                  kv_dtype: str = "", spec_k: int = 0, spec_rounds: int = 8,
-                 memory_utilization: float | None = None):
+                 memory_utilization: float | None = None,
+                 pipeline: bool | None = None):
         """``spec_k`` > 0 enables greedy n-gram speculative decoding
         (models/spec.py): chunks where EVERY active request is greedy run
         ``spec_rounds`` draft+verify rounds of ``spec_k`` candidates
@@ -153,7 +159,16 @@ class PagedTPUEngine:
         ``memory_utilization × HBM − weights − 1 GiB workspace``.
         Preemption makes oversubscription safe, so the pool takes the
         whole budget.  Devices that don't report memory (the CPU test
-        backend) fall back to the full per-slot reservation."""
+        backend) fall back to the full per-slot reservation.
+
+        ``pipeline``: one-deep chunk pipelining — a steady-state drive
+        tick dispatches the next decode chunk (whose loop state is
+        device-resident) BEFORE fetching the previous chunk's tokens,
+        hiding the per-chunk host cost (~100 ms of RPC dispatch + token
+        download on the tunneled v5e) behind device compute.  Output is
+        bit-identical; sequences that hit a stop string may compute one
+        discarded extra chunk.  Default on; ``None`` reads
+        ``REVAL_TPU_PIPELINE`` (set ``0`` to disable, e.g. for A/B)."""
         assert max_seq_len % page_size == 0
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -162,6 +177,10 @@ class PagedTPUEngine:
         self.spec_k = spec_k
         self.spec_rounds = spec_rounds
         self.prefix_sharing = prefix_sharing
+        if pipeline is None:
+            pipeline = os.environ.get(
+                "REVAL_TPU_PIPELINE", "1").lower() not in ("0", "false", "off")
+        self.pipeline = bool(pipeline)
         self.max_pages_per_seq = max_seq_len // page_size
         if memory_utilization is not None and not (0.0 < memory_utilization <= 1.0):
             # a tiny/negative value would silently clamp to the minimum
@@ -269,6 +288,7 @@ class PagedTPUEngine:
                         spec_k: int = 0, spec_rounds: int = 8,
                         local_devices_only: bool = False,
                         memory_utilization: float | None = None,
+                        pipeline: bool | None = None,
                         ) -> "PagedTPUEngine":
         mesh = None
         if tp_size > 1:
@@ -292,6 +312,7 @@ class PagedTPUEngine:
                    page_size=page_size, max_seq_len=max_seq_len,
                    num_pages=num_pages, mesh=mesh, seed=seed,
                    kv_dtype=kv_dtype, spec_k=spec_k, spec_rounds=spec_rounds,
+                   pipeline=pipeline,
                    memory_utilization=memory_utilization)
 
     def close(self) -> None:
@@ -529,6 +550,9 @@ class PagedTPUEngine:
         st = self.new_drive_state()
         while any(not r.done for r in reqs.values()):
             self._drive_tick(reqs, st)
+        # `done` is only ever set while processing a fetched chunk, so the
+        # loop cannot exit with one in flight; drain as a safety net
+        self._process_pending(reqs, st)
 
     def _drive_tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
         """ONE admission + prefill + decode-chunk round over ``reqs``.
@@ -571,11 +595,35 @@ class PagedTPUEngine:
                     "paged scheduler deadlock: nothing running or admissible")
             return
 
-        budget = min(reqs[s].max_new - len(reqs[s].generated)
-                     for s in st.active.values())
-        use_spec = (self.spec_k > 0
-                    and all(reqs[s].temp == 0.0
-                            for s in st.active.values()))
+        # ---- one-deep chunk pipeline flush gates ---------------------
+        # A steady tick dispatches the NEXT chunk before fetching the
+        # PREVIOUS one (see ``pipeline`` in __init__).  Any condition
+        # whose host logic needs the in-flight chunk's tokens — or that
+        # would free/reallocate pages the in-flight chunk still writes —
+        # fetches it first:
+        #   dirty       slot population or tables changed (admission,
+        #               retirement, preemption, span growth)
+        #   spec        the spec path packs device state from host-side
+        #               token history
+        #   budget 0    the in-flight steps consume some slot's whole
+        #               remaining budget: ground truth needed
+        #   page cross  the coming chunk would allocate pages, and
+        #               allocation can preempt — in-flight writes must
+        #               land before any page is freed for reuse
+        if st.pending is not None and (st.dirty
+                                       or self._spec_allowed(reqs, st)):
+            self._process_pending(reqs, st)
+        if st.pending is not None and self._chunk_budget(reqs, st) <= 0:
+            self._process_pending(reqs, st)
+        if st.pending is not None:
+            nxt = _floor_pow2(min(CHUNK, self._chunk_budget(reqs, st)))
+            if self._chunk_crosses_page(st, nxt):
+                self._process_pending(reqs, st)
+        if not st.active:
+            return                    # a flush retired the last runner
+
+        budget = self._chunk_budget(reqs, st)
+        use_spec = self._spec_allowed(reqs, st) and st.pending is None
         rounds = 0
         if use_spec:
             # rounds bound both the page reservation and the worst-case
@@ -607,13 +655,25 @@ class PagedTPUEngine:
             st.dirty = True                 # a preemption emptied slots
         if not st.active:
             return                          # everyone got preempted
+        if st.pending is not None and st.dirty:
+            # unreachable by construction — the page-cross gate above
+            # blocks any allocating (hence preempting) reserve while a
+            # chunk is in flight; kept as a correctness backstop
+            self._process_pending(reqs, st)
+            if not st.active:
+                return
 
+        pend_rows = dict(st.pending[2]) if st.pending is not None else {}
+        pend_steps = st.pending[1] if st.pending is not None else 0
         lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
         for slot, seq_id in st.active.items():
             req = reqs[seq_id]
-            # materialised tokens = prompt + generated minus the pending
-            # input token (written during the chunk's first step)
-            lens[slot] = len(req.ids) + len(req.generated) - 1
+            # materialised tokens = prompt + generated (plus any still in
+            # flight) minus the pending input token (written during the
+            # chunk's first step)
+            lens[slot] = (len(req.ids) + len(req.generated) - 1
+                          + (pend_steps if pend_rows.get(slot) == seq_id
+                             else 0))
         # the attention kernel walks every table column it is given —
         # slice to the pages this chunk can actually touch (pow2-bucketed
         # so the shape set stays small), not the per-seq maximum.  A
@@ -659,13 +719,74 @@ class PagedTPUEngine:
             toks, self.cache, st.dev_state = self._jit_chunk(
                 self.params, st.dev_state, self.cache, st.dev_samp,
                 steps=steps, filtered=filtered)
-        toks_host = np.asarray(toks)
-        self.stats.decode_seconds += time.perf_counter() - t0
-        self.stats.generated_tokens += steps * len(st.active)
+        chunk = (toks, steps, tuple(st.active.items()), t0)
+        prev, st.pending = st.pending, None
+        if self.pipeline:
+            # park this chunk; fetch the previous one BEHIND it — the
+            # download RTT rides under this chunk's device time
+            st.pending = chunk
+            if prev is not None:
+                self.stats.pipelined_chunks += 1
+                self._process_chunk(reqs, st, prev)
+        else:
+            self._process_chunk(reqs, st, chunk)
+
+    def _spec_allowed(self, reqs: dict[int, _Request],
+                      st: _DriveState) -> bool:
+        return (self.spec_k > 0
+                and all(reqs[s].temp == 0.0 for s in st.active.values()))
+
+    def _chunk_budget(self, reqs: dict[int, _Request],
+                      st: _DriveState) -> int:
+        """Smallest remaining new-token budget over the running slots,
+        counting tokens an in-flight chunk will deliver as spent."""
+        pend = dict(st.pending[2]) if st.pending is not None else {}
+        psteps = st.pending[1] if st.pending is not None else 0
+        return min(reqs[s].max_new - len(reqs[s].generated)
+                   - (psteps if pend.get(slot) == s else 0)
+                   for slot, s in st.active.items())
+
+    def _chunk_crosses_page(self, st: _DriveState, steps: int) -> bool:
+        """True when a chunk of ``steps`` would push any running sequence
+        across a page boundary — i.e. ``_reserve_chunk`` would allocate
+        (and on pool exhaustion preempt).  Lengths come from the runtime,
+        whose reservations already include the in-flight chunk's."""
+        p = self.page_size
+        for seq_id in st.active.values():
+            ln = self.rt.seq_len(seq_id)
+            if (ln + steps + p - 1) // p > (ln + p - 1) // p:
+                return True
+        return False
+
+    def _process_pending(self, reqs: dict[int, _Request],
+                         st: _DriveState) -> None:
+        chunk, st.pending = st.pending, None
+        if chunk is not None:
+            self._process_chunk(reqs, st, chunk)
+
+    def _process_chunk(self, reqs: dict[int, _Request], st: _DriveState,
+                       chunk: tuple) -> None:
+        """Host half of a dispatched chunk: fetch tokens, append,
+        stop-scan, retire, notify.  In pipelined mode this runs one chunk
+        behind dispatch; a sequence retired here may have one further
+        chunk in flight whose tokens are then discarded — the same
+        truncation semantics as in-chunk stop overrun, one chunk later.
+        Its pages stay allocated until this retire runs, so the in-flight
+        writes always land in still-owned pages."""
+        toks_dev, steps, rows, t0 = chunk
+        toks_host = np.asarray(toks_dev)
+        now = time.perf_counter()
+        # union-of-intervals: overlapped dispatch→fetch spans must not
+        # double-count decode wall time
+        self.stats.decode_seconds += now - max(t0, st.t_mark)
+        st.t_mark = now
+        self.stats.generated_tokens += steps * len(rows)
         self.stats.decode_chunks += 1
         self.stats.decode_steps += steps
 
-        for slot, seq_id in list(st.active.items()):
+        for slot, seq_id in rows:
+            if st.active.get(slot) != seq_id:
+                continue       # retired while this chunk was in flight
             req = reqs[seq_id]
             chunk_ids = [int(t) for t in toks_host[slot]]
             req.generated.extend(chunk_ids)
@@ -675,6 +796,14 @@ class PagedTPUEngine:
                 st.dirty = True
             if req.notify is not None:
                 req.notify(req)
+        if not st.active and st.pending is not None:
+            # the last running sequence just retired with its successor
+            # chunk still in flight: drain NOW.  A serving session can
+            # otherwise idle for minutes before its next tick reaches a
+            # flush gate, and that whole gap would be charged to
+            # decode_seconds when the stale chunk is finally fetched
+            # (dp_paged's per-call drive would leak the buffer outright).
+            self._process_pending(reqs, st)
 
     def _spec_tick(self, reqs: dict[int, _Request], st: _DriveState,
                    lens: np.ndarray, rounds: int) -> None:

@@ -1,0 +1,130 @@
+"""One-deep chunk pipelining (paged engine): dispatching chunk i+1 before
+fetching chunk i's tokens must be invisible in outputs — same programs,
+same inputs, only the host fetch ordering changes — while actually
+overlapping (stats.pipelined_chunks > 0).
+
+The serial baseline (pipeline=False) is the pre-pipeline engine: fetch
+immediately after every dispatch.  Reference analogue: vLLM's engine
+step loop is fully serial per step (the reference drives it one prompt
+at a time, inference.py:90-104); the pipeline is TPU-tunnel-first
+design with no reference counterpart.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # noqa: E402
+
+from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+from reval_tpu.models import ModelConfig, init_random_params
+
+PAGE = 128
+
+PROMPTS = [
+    "def add(a, b):\n    return a + b\nassert add(",
+    "x = 1",
+    "for i in range(10):\n    print(i)",
+    "y = [k * k for k in range(5)]",
+]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engines(tiny):
+    cfg, params = tiny
+    piped = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                           page_size=PAGE, max_seq_len=512, pipeline=True)
+    serial = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                            page_size=PAGE, max_seq_len=512, pipeline=False)
+    yield piped, serial
+    piped.close()
+    serial.close()
+
+
+def test_long_generation_crosses_pages_and_pipelines(engines):
+    """160 new tokens cross the 128-token page boundary: the crossing
+    gate must flush (never corrupt) and the steady chunks must overlap."""
+    piped, serial = engines
+    want = serial.generate(PROMPTS[:2], max_new_tokens=160, temperature=0.0)
+    got = piped.generate(PROMPTS[:2], max_new_tokens=160, temperature=0.0)
+    assert got == want
+    assert piped.stats.pipelined_chunks > 0
+    assert serial.stats.pipelined_chunks == 0
+
+
+def test_more_prompts_than_slots_parity(engines):
+    piped, serial = engines
+    want = serial.generate(PROMPTS * 2, max_new_tokens=40, temperature=0.0)
+    got = piped.generate(PROMPTS * 2, max_new_tokens=40, temperature=0.0)
+    assert got == want
+
+
+def test_stop_string_parity(engines):
+    """A stop hit while the next chunk is in flight discards that chunk's
+    tokens for the stopped slot — output must equal the serial engine's."""
+    piped, serial = engines
+    fulls = serial.generate(PROMPTS, max_new_tokens=48, temperature=0.0)
+    pick = next((i for i, f in enumerate(fulls) if len(f) > 6), None)
+    assert pick is not None, f"random model produced no text: {fulls!r}"
+    stop = fulls[pick][4:6]
+    want = serial.generate(PROMPTS, max_new_tokens=48, stop=[stop],
+                           temperature=0.0)
+    got = piped.generate(PROMPTS, max_new_tokens=48, stop=[stop],
+                         temperature=0.0)
+    assert got == want
+
+
+def test_sampled_parity(engines):
+    """fold_in(key, position) sampling is position-stable, so pipelining
+    cannot shift the stream."""
+    import jax
+
+    piped, serial = engines
+    # generate() advances the engine key per call and earlier tests call
+    # the two engines unequally often — pin both streams to the same key
+    piped._key = jax.random.PRNGKey(7)
+    serial._key = jax.random.PRNGKey(7)
+    want = serial.generate(PROMPTS[:2], max_new_tokens=40, temperature=0.9,
+                           top_k=8)
+    got = piped.generate(PROMPTS[:2], max_new_tokens=40, temperature=0.9,
+                         top_k=8)
+    assert got == want
+
+
+def test_preemption_parity(tiny):
+    """Pool smaller than slots x max_len: preemption (which frees and
+    reallocates pages) must still be fenced from in-flight chunks."""
+    cfg, params = tiny
+    kw = dict(max_slots=2, page_size=PAGE, max_seq_len=512, num_pages=5)
+    piped = PagedTPUEngine(params, cfg, ByteTokenizer(), pipeline=True, **kw)
+    serial = PagedTPUEngine(params, cfg, ByteTokenizer(), pipeline=False,
+                            **kw)
+    want = serial.generate(PROMPTS, max_new_tokens=96, temperature=0.0)
+    got = piped.generate(PROMPTS, max_new_tokens=96, temperature=0.0)
+    assert got == want
+    piped.close()
+    serial.close()
+
+
+def test_env_var_disables(tiny, monkeypatch):
+    cfg, params = tiny
+    monkeypatch.setenv("REVAL_TPU_PIPELINE", "0")
+    eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                         page_size=PAGE, max_seq_len=256)
+    assert eng.pipeline is False
+    monkeypatch.delenv("REVAL_TPU_PIPELINE")
+    eng2 = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                          page_size=PAGE, max_seq_len=256)
+    assert eng2.pipeline is True
+    eng.close()
+    eng2.close()

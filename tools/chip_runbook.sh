@@ -112,7 +112,8 @@ if [ -f "$R/diagnosis_config.txt" ] && [ "$(cat "$R/diagnosis_config.txt")" != "
   rm -f "$R"/ablate.txt "$R"/ablate2.txt "$R"/bench_direct.json \
         "$R"/bench_cot.json "$R"/bench_direct_int8.json \
         "$R"/bench_cot_kv8.json "$R"/fleet.json \
-        "$R"/bench_direct_int4.json "$R"/bench_cot_spec.json
+        "$R"/bench_direct_int4.json "$R"/bench_cot_spec.json \
+        "$R"/bench_direct_nopipe.json
 fi
 echo "$FP" > "$R/diagnosis_config.txt"
 # -- diagnosis + official numbers --------------------------------------
@@ -132,6 +133,10 @@ run bench_direct_kv8s64.json 1800 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_
 # one 40-min timeout (00:23 pass), so the official headline/cot rows go
 # first; spec pins its own config (decision must not contaminate it)
 run bench_direct_spec.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --spec --skip-serial --skip-ab
+# chunk-pipeline A/B: bench_direct.json above runs with the pipeline ON
+# (default); this row is the same decided config with it OFF — the delta
+# is the measured per-chunk host cost the pipeline hides
+run bench_direct_nopipe.json 2400 json env REVAL_TPU_PIPELINE=0 python bench.py --skip-serial --skip-ab
 run ablate.txt           2400 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants core,seq,slots
 run kernel_ab_int8.txt   1200 txt  python tools/kernel_bench.py --slots 32 --ctx 600 --only-int8
 # 5. dtype / feature A-Bs on the new kernel

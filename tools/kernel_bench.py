@@ -45,6 +45,9 @@ def main() -> None:
                          "in kernel_ab.txt and need not be re-measured)")
     ap.add_argument("--tiny", action="store_true", help="CPU smoke")
     args = ap.parse_args()
+    if args.no_int8 and args.only_int8:
+        ap.error("--no-int8 and --only-int8 are mutually exclusive "
+                 "(together they skip every variant)")
 
     import jax
     import jax.numpy as jnp
