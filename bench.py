@@ -249,6 +249,53 @@ def _last_serial_rate(shape: str, mode: str) -> tuple[float, str] | None:
     return best[2], os.path.relpath(best[1], root)
 
 
+class StallWatchdog:
+    """Fast-exit a wedged bench (learned from the kv8s64 pass, PERF.md
+    round-5 session 2: the tunnel died 8 minutes into warmup and the
+    step burned its full 40-minute timeout against a dead chip).
+
+    Trips only when BOTH hold: zero progress for ``stall_s`` AND
+    ``probe_fails`` consecutive failed device probes (killable
+    subprocesses ``probe_gap_s`` apart — a healthy chip mid-compile
+    answers them, and a successful probe resets the failure count).
+    The caller exits promptly so the runbook's wedge-abort fires
+    minutes, not tens of minutes, later; the last inflight snapshot
+    survives as the step's .partial.json."""
+
+    def __init__(self, stall_s: float = 420.0, probe_gap_s: float = 120.0,
+                 probe_fails: int = 3, prober=None):
+        self.stall_s, self.probe_gap_s = stall_s, probe_gap_s
+        self.probe_fails = probe_fails
+        self._probe = prober if prober is not None else self._probe_device
+        self._progress = None
+        self._changed = time.monotonic()
+        self._probed = 0.0
+        self._fails = 0
+
+    @staticmethod
+    def _probe_device() -> bool:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
+                capture_output=True, timeout=45)
+            return r.returncode == 0
+        except subprocess.TimeoutExpired:
+            return False
+
+    def stalled_and_dead(self, progress) -> bool:
+        now = time.monotonic()
+        if progress != self._progress:
+            self._progress, self._changed, self._fails = progress, now, 0
+            return False
+        if (now - self._changed < self.stall_s
+                or now - self._probed < self.probe_gap_s):
+            return False
+        self._probed = now
+        self._fails = 0 if self._probe() else self._fails + 1
+        return self._fails >= self.probe_fails
+
+
 def fail(metric: str, error: str, detail: str = "") -> None:
     out = {"metric": metric, "value": 0.0, "unit": "probes/s/chip",
            "vs_baseline": 0.0, "error": error}
@@ -424,6 +471,8 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
 
         stop_evt = threading.Event()
 
+        wd = StallWatchdog()
+
         def _sample():
             while not stop_evt.wait(5.0):
                 s = eng.stats
@@ -448,6 +497,13 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
                     os.replace(progress_path + ".tmp", progress_path)
                 except OSError:
                     pass
+                if wd.stalled_and_dead((s.prefill_tokens,
+                                        s.generated_tokens,
+                                        s.decode_chunks, s.decode_steps)):
+                    note("stall watchdog: no progress for "
+                         f"{wd.stall_s:.0f}s and {wd.probe_fails} device "
+                         "probes failed — tunnel wedged, exiting")
+                    os._exit(3)
 
         thr = threading.Thread(target=_sample, daemon=True)
         thr.start()

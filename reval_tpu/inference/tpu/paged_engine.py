@@ -571,6 +571,12 @@ class PagedTPUEngine:
         """
         admitted = self.rt.admit()
         if admitted:
+            # flush BEFORE prefilling: the admission prefill would
+            # otherwise run (and wait behind the in-flight chunk on the
+            # device stream) inside the pending chunk's dispatch→fetch
+            # interval, double-charging its wall into both
+            # prefill_seconds and decode_seconds
+            self._process_pending(reqs, st)
             st.dirty = True
             st.since_admit = 0
             firsts = self._prefill_admitted(admitted, reqs)
@@ -653,15 +659,16 @@ class PagedTPUEngine:
             st.dirty = True                 # a block table gained a page
         if st.active != before:
             st.dirty = True                 # a preemption emptied slots
-        if not st.active:
-            return                          # everyone got preempted
         if st.pending is not None and st.dirty:
             # unreachable by construction — the page-cross gate above
             # blocks any allocating (hence preempting) reserve while a
-            # chunk is in flight; kept as a correctness backstop
+            # chunk is in flight; kept as a correctness backstop.  Must
+            # run before the everyone-preempted return below: a stale
+            # chunk surviving into re-admission could append
+            # pre-preemption tokens after the resume token.
             self._process_pending(reqs, st)
-            if not st.active:
-                return
+        if not st.active:
+            return                          # everyone got preempted
 
         pend_rows = dict(st.pending[2]) if st.pending is not None else {}
         pend_steps = st.pending[1] if st.pending is not None else 0

@@ -95,3 +95,41 @@ def test_fleet_bench_tiny():
     assert lines, f"no json line in:\n{r.stdout}"
     d = json.loads(lines[-1])
     assert "metric" in d and "value" in d
+
+
+def test_stall_watchdog_state_machine(monkeypatch):
+    """Trips only on (no progress >= stall_s) AND probe_fails consecutive
+    failed probes spaced probe_gap_s apart; any progress or good probe
+    resets."""
+    import bench
+
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
+    alive = {"ok": False}
+    wd = bench.StallWatchdog(stall_s=400, probe_gap_s=100, probe_fails=3,
+                             prober=lambda: alive["ok"])
+
+    assert wd.stalled_and_dead((1, 0)) is False       # first observation
+    clock["t"] += 500
+    assert wd.stalled_and_dead((2, 0)) is False       # progress resets
+    # now stall: same progress tuple for > stall_s
+    clock["t"] += 399
+    assert wd.stalled_and_dead((2, 0)) is False       # under threshold
+    clock["t"] += 2                                   # 401s stalled
+    assert wd.stalled_and_dead((2, 0)) is False       # fail #1
+    assert wd.stalled_and_dead((2, 0)) is False       # gap not elapsed
+    clock["t"] += 101
+    assert wd.stalled_and_dead((2, 0)) is False       # fail #2
+    clock["t"] += 101
+    alive["ok"] = True
+    assert wd.stalled_and_dead((2, 0)) is False       # good probe resets
+    alive["ok"] = False
+    clock["t"] += 101
+    assert wd.stalled_and_dead((2, 0)) is False       # fail #1 again
+    clock["t"] += 101
+    assert wd.stalled_and_dead((2, 0)) is False       # fail #2
+    clock["t"] += 101
+    assert wd.stalled_and_dead((2, 0)) is True        # fail #3: trip
+    # progress mid-stall fully resets even after a trip-level count
+    clock["t"] += 10
+    assert wd.stalled_and_dead((3, 0)) is False
