@@ -801,6 +801,7 @@ def main() -> None:
             "wall_seconds": round(wall, 2),
             "warmup_wall_seconds": round(getattr(stats, "warmup_wall", 0.0), 2),
             "pipelined_chunks": getattr(stats, "pipelined_chunks", 0),
+            "patched_tables": getattr(stats, "patched_tables", 0),
         }
         if args.spec:
             extras["spec"] = True

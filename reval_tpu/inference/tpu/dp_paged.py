@@ -110,6 +110,7 @@ class DataParallelPagedEngine:
             agg.decode_chunks += s.decode_chunks
             agg.decode_steps += s.decode_steps
             agg.pipelined_chunks += s.pipelined_chunks
+            agg.patched_tables += s.patched_tables
             agg.spec_rounds += s.spec_rounds
             agg.spec_accepted += s.spec_accepted
         return agg

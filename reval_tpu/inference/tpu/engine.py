@@ -172,6 +172,9 @@ class EngineStats:
                                  # counts verify rounds, not tokens)
     pipelined_chunks: int = 0    # chunks whose fetch rode behind the next
                                  # dispatch (paged engine chunk pipeline)
+    patched_tables: int = 0      # in-place device table patches — chunks
+                                 # whose page crossings (one or more
+                                 # slots) were absorbed without a flush
     spec_rounds: int = 0         # draft+verify rounds executed (per slot)
     spec_accepted: int = 0       # draft tokens accepted (bonus excluded)
 

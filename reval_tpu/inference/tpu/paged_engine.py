@@ -240,6 +240,12 @@ class PagedTPUEngine:
             partial(self._decode_chunk, cfg=cfg, mesh=mesh),
             static_argnames=("steps", "filtered"),
             donate_argnames=("cache",))
+        # in-place update of the packed state's table columns (the first
+        # ``span`` columns) — lets a page-boundary crossing ride the
+        # chunk pipeline instead of flushing it (tables are host-known;
+        # lens/token/pos keep flowing device-side untouched)
+        self._jit_patch = jax.jit(
+            lambda state, tables: state.at[:, :tables.shape[1]].set(tables))
         self._jit_spec = jax.jit(
             partial(self._spec_chunk, cfg=cfg, mesh=mesh),
             static_argnames=("rounds", "k"), donate_argnames=("cache",))
@@ -622,8 +628,16 @@ class PagedTPUEngine:
         if st.pending is not None and self._chunk_budget(reqs, st) <= 0:
             self._process_pending(reqs, st)
         if st.pending is not None:
+            # A crossing that merely ALLOCATES can ride the pipeline
+            # (tables are host-known — the reserve below patches the
+            # device copy in place).  Flush only when the pool is short
+            # enough that the reserve could preempt: in-flight writes
+            # must land before any page is freed for reuse.  (Span
+            # bucket growth is handled at the dispatch path, which
+            # flushes and rebuilds when it detects the shape change.)
             nxt = _floor_pow2(min(CHUNK, self._chunk_budget(reqs, st)))
-            if self._chunk_crosses_page(st, nxt):
+            need = self._pages_needed_next(st, nxt)
+            if need and self.rt.free_pages < need:
                 self._process_pending(reqs, st)
         if not st.active:
             return                    # a flush retired the last runner
@@ -655,42 +669,38 @@ class PagedTPUEngine:
         # every active sequence must have pages for the whole chunk
         # BEFORE the decode writes into them
         before = dict(st.active)
-        if self._reserve_chunk(st.active, reqs, steps):
-            st.dirty = True                 # a block table gained a page
-        if st.active != before:
+        grew = self._reserve_chunk(st.active, reqs, steps)
+        preempted = st.active != before
+        if preempted:
             st.dirty = True                 # a preemption emptied slots
+        if grew:
+            if st.pending is not None and not preempted:
+                # pipelined crossing: the gate above guaranteed enough
+                # free pages, so this reserve only allocated — patch the
+                # new table entries into the device state in place
+                self._patch_dev_tables(st)
+            else:
+                st.dirty = True             # table copy stale: repack
         if st.pending is not None and st.dirty:
             # unreachable by construction — the page-cross gate above
-            # blocks any allocating (hence preempting) reserve while a
-            # chunk is in flight; kept as a correctness backstop.  Must
-            # run before the everyone-preempted return below: a stale
-            # chunk surviving into re-admission could append
-            # pre-preemption tokens after the resume token.
+            # flushes before any reserve that could preempt; kept as a
+            # correctness backstop.  Must run before the
+            # everyone-preempted return below: a stale chunk surviving
+            # into re-admission could append pre-preemption tokens after
+            # the resume token.
             self._process_pending(reqs, st)
         if not st.active:
             return                          # everyone got preempted
 
-        pend_rows = dict(st.pending[2]) if st.pending is not None else {}
-        pend_steps = st.pending[1] if st.pending is not None else 0
-        lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
-        for slot, seq_id in st.active.items():
-            req = reqs[seq_id]
-            # materialised tokens = prompt + generated (plus any still in
-            # flight) minus the pending input token (written during the
-            # chunk's first step)
-            lens[slot] = (len(req.ids) + len(req.generated) - 1
-                          + (pend_steps if pend_rows.get(slot) == seq_id
-                             else 0))
-        # the attention kernel walks every table column it is given —
-        # slice to the pages this chunk can actually touch (pow2-bucketed
-        # so the shape set stays small), not the per-seq maximum.  A
-        # sequence crossing into a fresh page re-uses a table entry the
-        # runtime filled at allocation time, and every entry within the
-        # span was uploaded when the slot population last changed — the
-        # table row only needs re-uploading when the span bucket grows.
-        new_span = pow2_bucket(
-            int((lens.max() + steps + self.page_size - 1) // self.page_size))
-        new_span = min(new_span, self.max_pages_per_seq)
+        lens, new_span = self._lens_and_span(reqs, st, steps)
+        if new_span != st.span and st.pending is not None:
+            # span bucket growth changes the packed state's SHAPE — a
+            # full repack is unavoidable and it needs the in-flight
+            # chunk's tokens: quiesce, then rebuild from ground truth
+            self._process_pending(reqs, st)
+            if not st.active:
+                return
+            lens, new_span = self._lens_and_span(reqs, st, steps)
         if new_span != st.span:
             st.span = new_span
             st.dirty = True
@@ -753,17 +763,52 @@ class PagedTPUEngine:
                    - (psteps if pend.get(slot) == s else 0)
                    for slot, s in st.active.items())
 
-    def _chunk_crosses_page(self, st: _DriveState, steps: int) -> bool:
-        """True when a chunk of ``steps`` would push any running sequence
-        across a page boundary — i.e. ``_reserve_chunk`` would allocate
-        (and on pool exhaustion preempt).  Lengths come from the runtime,
-        whose reservations already include the in-flight chunk's."""
+    def _lens_and_span(self, reqs: dict[int, _Request], st: _DriveState,
+                       steps: int) -> tuple[np.ndarray, int]:
+        """Per-slot materialised lengths (prompt + generated, counting
+        any in-flight chunk's tokens, minus the pending input token) and
+        the pow2 table-span bucket a ``steps`` chunk needs.  The
+        attention kernel walks every table column it is given — the span
+        slices the tables to the pages this chunk can actually touch,
+        bucketed so the compiled shape set stays small."""
+        pend_rows = dict(st.pending[2]) if st.pending is not None else {}
+        pend_steps = st.pending[1] if st.pending is not None else 0
+        lens = np.ones(self.max_slots, np.int32)   # idle slots: trash pos 1
+        for slot, seq_id in st.active.items():
+            req = reqs[seq_id]
+            lens[slot] = (len(req.ids) + len(req.generated) - 1
+                          + (pend_steps if pend_rows.get(slot) == seq_id
+                             else 0))
+        span = pow2_bucket(
+            int((lens.max() + steps + self.page_size - 1) // self.page_size))
+        return lens, min(span, self.max_pages_per_seq)
+
+    def _pages_needed_next(self, st: _DriveState, steps: int) -> int:
+        """Pages ``_reserve_chunk`` would have to allocate for a chunk of
+        ``steps`` (0 ⇒ the reserve provably cannot preempt).  Counts full
+        page-count deltas, so any page_size — even smaller than the
+        chunk — is handled.  Conservative when a rollback left a
+        sequence holding spare pages (the runtime then allocates fewer
+        than this estimate, never more)."""
         p = self.page_size
+        need = 0
         for seq_id in st.active.values():
             ln = self.rt.seq_len(seq_id)
-            if (ln + steps + p - 1) // p > (ln + p - 1) // p:
-                return True
-        return False
+            need += (ln + steps + p - 1) // p - (ln + p - 1) // p
+        return need
+
+    def _patch_dev_tables(self, st: _DriveState) -> None:
+        """Write the runtime's current block tables over the device
+        state's table columns without a fetch — the counterpart of a
+        full repack for the allocation-only crossing case.  Chained on
+        the in-flight chunk's output, so device ordering stays
+        dispatch-order."""
+        tables = np.zeros((self.max_slots, st.span), np.int32)
+        for slot, seq_id in st.active.items():
+            tables[slot] = self.rt.block_table(seq_id)[:st.span]
+        st.dev_state = self._jit_patch(st.dev_state,
+                                       self._dev(jnp.asarray(tables)))
+        self.stats.patched_tables += 1
 
     def _process_pending(self, reqs: dict[int, _Request],
                          st: _DriveState) -> None:
@@ -947,18 +992,43 @@ class PagedTPUEngine:
         token_budget = max(self.page_size, PREFILL_BYTE_BUDGET // per_token_kv)
         firsts: dict[int, int] = {}
         t0 = time.perf_counter()
+        # One-deep overlap (mirrors the decode chunk pipeline): harvest
+        # group i's sampled tokens AFTER dispatching group i+1, so the
+        # per-group host RTT rides behind the next group's device time.
+        # Device-side memory stays bounded: programs execute in dispatch
+        # order, so group i's transient KV block is consumed by its
+        # commit before group i+1's prefill runs — at most one extra
+        # block is allocated-but-not-yet-live, covered by the 1 GiB
+        # workspace reserve in _pages_for_budget.
+        pend = None
         for (skip, n_pg), full_group in by_bucket.items():
             t = n_pg * self.page_size
             step = max(1, token_budget // t)
             for start in range(0, len(full_group), step):
-                self._prefill_group(full_group[start:start + step], skip, n_pg,
-                                    t, reqs, firsts)
+                g = full_group[start:start + step]
+                first_dev = self._prefill_group(g, skip, n_pg, t, reqs)
+                if self.pipeline:
+                    if pend is not None:
+                        self._harvest_first(*pend, firsts)
+                    pend = (g, first_dev)
+                else:
+                    self._harvest_first(g, first_dev, firsts)
+        if pend is not None:
+            self._harvest_first(*pend, firsts)
         self.stats.prefill_seconds += time.perf_counter() - t0
         return firsts
 
+    @staticmethod
+    def _harvest_first(group, first_dev, firsts: dict[int, int]) -> None:
+        first_host = np.asarray(first_dev)
+        for row, (_, slot) in enumerate(group):
+            firsts[slot] = int(first_host[row])
+
     def _prefill_group(self, group, skip: int, n_pg: int, t: int,
-                       reqs: dict[int, _Request],
-                       firsts: dict[int, int]) -> None:
+                       reqs: dict[int, _Request]):
+        """Dispatch one bucketed prefill+commit+sample; returns the
+        device array of first sampled tokens WITHOUT fetching (the
+        caller overlaps the fetch with the next group's dispatch)."""
         assert skip in (0, self._prefix_len), \
             "prefix skip must match the one live prefix of this generate call"
         pre_pages = skip // self.page_size
@@ -1007,8 +1077,5 @@ class PagedTPUEngine:
                                          self._dev(jnp.asarray(topks)),
                                          self._dev(jnp.asarray(topps)),
                                          self._dev(jnp.asarray(temps)))
-        first = sample_token_rows(first_logits,
-                                  self._dev(jnp.asarray(temps)), row_keys)
-        first_host = np.asarray(first)
-        for row, (_, slot) in enumerate(group):
-            firsts[slot] = int(first_host[row])
+        return sample_token_rows(first_logits,
+                                 self._dev(jnp.asarray(temps)), row_keys)

@@ -52,14 +52,18 @@ def engines(tiny):
 
 
 def test_long_generation_crosses_pages_and_pipelines(engines):
-    """160 new tokens cross the 128-token page boundary: the crossing
-    gate must flush (never corrupt) and the steady chunks must overlap."""
+    """360 new tokens cross three page boundaries: the 128/256 crossings
+    coincide with pow2 span-bucket growth (1→2→4, full repack via
+    flush), while the 384 crossing lands inside the span-4 plateau and
+    must ride the pipeline as an in-place device table patch."""
     piped, serial = engines
-    want = serial.generate(PROMPTS[:2], max_new_tokens=160, temperature=0.0)
-    got = piped.generate(PROMPTS[:2], max_new_tokens=160, temperature=0.0)
+    want = serial.generate(PROMPTS[:2], max_new_tokens=360, temperature=0.0)
+    got = piped.generate(PROMPTS[:2], max_new_tokens=360, temperature=0.0)
     assert got == want
     assert piped.stats.pipelined_chunks > 0
+    assert piped.stats.patched_tables > 0    # plateau crossing: no flush
     assert serial.stats.pipelined_chunks == 0
+    assert serial.stats.patched_tables == 0
 
 
 def test_more_prompts_than_slots_parity(engines):
