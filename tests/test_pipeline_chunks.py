@@ -132,3 +132,36 @@ def test_env_var_disables(tiny, monkeypatch):
     assert eng2.pipeline is True
     eng.close()
     eng2.close()
+
+
+def test_fuzz_parity(engines):
+    """Randomized scenarios: any interleaving of stops, budgets, and
+    sampling must be invisible to outputs.  max_new values are chosen to
+    exercise uneven chunk tails (8+32+8 and 8+32+32+16+8) without
+    exploding the compiled (steps, span) shape set."""
+    import jax
+
+    piped, serial = engines
+    rng = np.random.default_rng(0)
+    pool = PROMPTS + ["while x:", "import os\n" * 2, "z = {'a': 1}"]
+    for case in range(6):
+        n = int(rng.integers(1, 5))
+        prompts = [pool[i] for i in rng.integers(0, len(pool), n)]
+        max_new = int(rng.choice([48, 96]))
+        temp = float(rng.choice([0.0, 0.8]))
+        stop = None
+        if rng.random() < 0.4:
+            # probe at the CASE's temperature with the case's key so the
+            # derived stop actually occurs in the compared streams —
+            # a greedy-probed stop would never fire in a sampled case
+            serial._key = jax.random.PRNGKey(100 + case)
+            probe = serial.generate(prompts[:1], max_new_tokens=max_new,
+                                    temperature=temp)[0]
+            if len(probe) > 4:
+                stop = [probe[2:4]]
+        piped._key = jax.random.PRNGKey(100 + case)
+        serial._key = jax.random.PRNGKey(100 + case)
+        kw = dict(max_new_tokens=max_new, temperature=temp, stop=stop)
+        want = serial.generate(prompts, **kw)
+        got = piped.generate(prompts, **kw)
+        assert got == want, f"case {case}: {prompts!r} {kw!r}"
