@@ -249,6 +249,43 @@ def _last_serial_rate(shape: str, mode: str) -> tuple[float, str] | None:
     return best[2], os.path.relpath(best[1], root)
 
 
+def acquire_chip_lock(max_wait_s: float = 1200.0, skip: bool = False):
+    """Advisory exclusive lock serialising chip users (the driver's
+    official bench vs an in-flight runbook step: two processes driving
+    the tunneled device concurrently tend to wedge it for both).  Waits
+    up to ``max_wait_s`` then proceeds anyway — best effort, never a
+    deadlock.  Returns the open fd (hold it for process lifetime; the
+    lock releases on exit) or None.  ``skip`` (a --tiny CPU smoke)
+    returns None without touching the lock file."""
+    if skip:
+        return None
+    try:
+        import fcntl
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(root, "tpu_watch"), exist_ok=True)
+        f = open(os.path.join(root, "tpu_watch", ".bench.lock"), "w")
+        deadline = time.monotonic() + max_wait_s
+        waited = False
+        while True:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return f
+            except OSError:
+                if time.monotonic() > deadline:
+                    note("chip lock still held after "
+                         f"{max_wait_s:.0f}s — proceeding anyway")
+                    return f
+                if not waited:
+                    note("waiting for a concurrent chip user "
+                         "(tpu_watch/.bench.lock)")
+                    waited = True
+                time.sleep(min(15.0, max(0.1,
+                                         deadline - time.monotonic())))
+    except Exception:
+        return None
+
+
 class StallWatchdog:
     """Fast-exit a wedged bench (learned from the kv8s64 pass, PERF.md
     round-5 session 2: the tunnel died 8 minutes into warmup and the
@@ -628,6 +665,8 @@ def main() -> None:
                          "their pinned config (a decision feeding back "
                          "into its own candidates oscillates on noise)")
     args = ap.parse_args()
+
+    chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
 
     # flags left at their defaults adopt the persisted autotune decision
     # (tools/decide_defaults.py: the measured-best bench config from the

@@ -13,6 +13,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -133,3 +134,16 @@ def test_stall_watchdog_state_machine(monkeypatch):
     # progress mid-stall fully resets even after a trip-level count
     clock["t"] += 10
     assert wd.stalled_and_dead((3, 0)) is False
+
+
+def test_chip_lock_serializes_and_never_deadlocks():
+    import bench
+
+    f1 = bench.acquire_chip_lock(max_wait_s=5)
+    assert f1 is not None
+    t0 = time.time()
+    # a second contender (fresh fd) must wait, then proceed anyway
+    f2 = bench.acquire_chip_lock(max_wait_s=1)
+    assert f2 is not None and time.time() - t0 >= 1
+    f1.close()
+    f2.close()

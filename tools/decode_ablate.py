@@ -49,6 +49,9 @@ def main() -> None:
     ap.add_argument("--tiny", action="store_true", help="CPU smoke shape")
     args = ap.parse_args()
 
+    from bench import acquire_chip_lock
+    chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
+
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -51,6 +51,9 @@ def main() -> None:
     ap.add_argument("--tiny", action="store_true")
     args = ap.parse_args()
 
+    from bench import acquire_chip_lock
+    chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
+
     import jax
 
     if args.tiny:

@@ -49,6 +49,9 @@ def main() -> None:
         ap.error("--no-int8 and --only-int8 are mutually exclusive "
                  "(together they skip every variant)")
 
+    from bench import acquire_chip_lock
+    chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
+
     import jax
     import jax.numpy as jnp
     import numpy as np
