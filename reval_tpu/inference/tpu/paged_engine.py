@@ -72,6 +72,15 @@ FIRST_CHUNK = 8
 def _floor_pow2(n: int) -> int:
     return 1 << (max(1, n).bit_length() - 1)
 
+
+def patch_state_tables(state, tables):
+    """Overwrite the packed drive state's table columns (the first
+    ``tables.shape[1]`` of them) in place — the chunk pipeline's
+    flush-free page-crossing path.  Module-level so the TPU lowering
+    tier exports THIS function, not a reconstruction
+    (tests/test_tpu_lowering.py)."""
+    return state.at[:, :tables.shape[1]].set(tables)
+
 # Cap on the transient KV block a prefill call materialises ([L, rows, T,
 # H_kv, D] before committing to pages) — large admissions prefill in
 # sub-batches instead.  A BYTE budget, not a token count: per-token KV is
@@ -244,8 +253,7 @@ class PagedTPUEngine:
         # ``span`` columns) — lets a page-boundary crossing ride the
         # chunk pipeline instead of flushing it (tables are host-known;
         # lens/token/pos keep flowing device-side untouched)
-        self._jit_patch = jax.jit(
-            lambda state, tables: state.at[:, :tables.shape[1]].set(tables))
+        self._jit_patch = jax.jit(patch_state_tables)
         self._jit_spec = jax.jit(
             partial(self._spec_chunk, cfg=cfg, mesh=mesh),
             static_argnames=("rounds", "k"), donate_argnames=("cache",))

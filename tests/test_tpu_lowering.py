@@ -129,3 +129,16 @@ def test_spec_chunk_program_lowers(tiny_engine_parts, monkeypatch):
     lens = jnp.ones((b,), jnp.int32)
     fn = partial(PagedTPUEngine._spec_chunk, cfg=cfg, rounds=2, k=k)
     _export_tpu(fn, params, last, hist, n_tok, tables, lens, cache)
+
+
+def test_table_patch_program_lowers():
+    """The chunk pipeline's in-place table patch (a dynamic-update-slice
+    over the packed state's table columns) must lower for TPU: it chains
+    directly onto the decode chunk's output on the hot path.  Exports
+    the engine's REAL function, not a reconstruction."""
+    from reval_tpu.inference.tpu.paged_engine import patch_state_tables
+
+    span, b = 6, 4
+    state = jnp.zeros((b, span + 5), jnp.int32)
+    tables = jnp.zeros((b, span), jnp.int32)
+    _export_tpu(patch_state_tables, state, tables)
