@@ -481,14 +481,13 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
               max_slots=32, max_seq_len=2048, num_pages=None, kv_dtype="",
-              spec_k=0, progress_path=None):
+              progress_path=None):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
     eng = PagedTPUEngine(params, cfg, tok, max_slots=max_slots,
                          max_seq_len=max_seq_len, num_pages=num_pages,
-                         prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
-                         spec_k=spec_k)
+                         prefix_sharing=prefix_sharing, kv_dtype=kv_dtype)
     # warmup = one full identical run: prefill buckets, decode span buckets,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
     # pair, so a reduced warmup would leave XLA compiles inside the timed
@@ -525,7 +524,7 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
                         "prefill_tokens": s.prefill_tokens,
                         "decode_chunks": s.decode_chunks,
                         "config": {"slots": max_slots, "kv_dtype": kv_dtype,
-                                   "spec_k": spec_k, "max_new": max_new,
+                                   "max_new": max_new,
                                    "prompts": len(prompts)},
                         "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
                 try:
@@ -579,7 +578,7 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
                            if s.decode_seconds > 0 else 0.0,
                            "config": {"slots": max_slots,
                                       "kv_dtype": kv_dtype,
-                                      "spec_k": spec_k, "max_new": max_new,
+                                      "max_new": max_new,
                                       "prompts": len(prompts)},
                            "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}, f)
             os.replace(progress_path + ".tmp", progress_path)
@@ -645,9 +644,6 @@ def main() -> None:
     ap.add_argument("--dtype", choices=["bfloat16", "int8", "int4"], default=None,
                     help="weight storage; int8 = weight-only quantization "
                          "(models/quant.py). Default bf16 (1.3b) / int8 (6.7b)")
-    ap.add_argument("--spec", action="store_true",
-                    help="greedy n-gram speculative decoding (models/spec.py)"
-                         " on the paged engine — A/B the decode-roofline gap")
     ap.add_argument("--kv-dtype", choices=["", "int8"], default="",
                     help="KV page pool storage; int8 halves pool HBM and "
                          "attention reads (per-token-head scales)")
@@ -783,7 +779,6 @@ def main() -> None:
             per_seq = (longest + page - 1) // page + 1
             per_seq = min(per_seq, args.max_seq_len // page)
             num_pages = 1 + args.slots * per_seq + 16
-        spec_k = 4 if args.spec else 0
         note(f'params ready ({args.dtype}); paged warmup+run '
              f'(slots={args.slots}, pages={num_pages})')
         progress = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -793,7 +788,7 @@ def main() -> None:
                                 prefix_sharing=True, max_slots=args.slots,
                                 max_seq_len=args.max_seq_len,
                                 num_pages=num_pages, kv_dtype=args.kv_dtype,
-                                spec_k=spec_k, progress_path=progress)
+                                progress_path=progress)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -842,10 +837,6 @@ def main() -> None:
             "pipelined_chunks": getattr(stats, "pipelined_chunks", 0),
             "patched_tables": getattr(stats, "patched_tables", 0),
         }
-        if args.spec:
-            extras["spec"] = True
-            extras["spec_accept_rate"] = round(
-                stats.spec_accepted / max(1, stats.spec_rounds * spec_k), 3)
 
         # The headline number is already measured; the A/B and serial
         # phases are garnish.  Persist it to disk NOW: a wedge in a

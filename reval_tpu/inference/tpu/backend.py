@@ -18,7 +18,7 @@ class TPUBackend(InferenceBackend):
                  sp_size: int = 1, batch_size: int = 8,
                  max_seq_len: int = 8192, local_devices_only: bool = False,
                  engine: str | None = None, kv_dtype: str = "",
-                 spec_k: int = 0, memory_utilization: float | None = None,
+                 memory_utilization: float | None = None,
                  **kwargs):
         """``engine``: "paged" (continuous batching over the paged KV
         cache + native scheduler) or "static" (rectangular batches; the
@@ -107,7 +107,7 @@ class TPUBackend(InferenceBackend):
                 model_path, dtype=dtype, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
-                spec_k=spec_k, memory_utilization=memory_utilization,
+                memory_utilization=memory_utilization,
             )
         elif engine == "paged":
             # dp>1 with continuous batching: one paged replica per device
@@ -120,7 +120,7 @@ class TPUBackend(InferenceBackend):
                 model_path, dtype=dtype, dp_size=dp_size, tp_size=num_chips,
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
-                spec_k=spec_k, memory_utilization=memory_utilization,
+                memory_utilization=memory_utilization,
             )
         else:
             # the static engine shards one rectangular batch over a

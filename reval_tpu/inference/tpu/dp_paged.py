@@ -51,7 +51,7 @@ class DataParallelPagedEngine:
                  tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  seed: int = 0, prefix_sharing: bool = True, devices=None,
-                 kv_dtype: str = "", spec_k: int = 0,
+                 kv_dtype: str = "",
                  memory_utilization: float | None = None):
         devices = list(devices if devices is not None else jax.devices())
         need = dp_size * tp_size
@@ -71,7 +71,7 @@ class DataParallelPagedEngine:
                 page_size=page_size, max_seq_len=max_seq_len,
                 num_pages=num_pages, mesh=mesh, seed=seed + r,
                 prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
-                spec_k=spec_k, memory_utilization=memory_utilization))
+                memory_utilization=memory_utilization))
         self._pool = ThreadPoolExecutor(max_workers=dp_size,
                                         thread_name_prefix="dp-paged")
 
@@ -81,7 +81,6 @@ class DataParallelPagedEngine:
                         max_slots: int = 8, page_size: int = 128,
                         max_seq_len: int = 8192, num_pages: int | None = None,
                         tokenizer=None, seed: int = 0, kv_dtype: str = "",
-                        spec_k: int = 0,
                         local_devices_only: bool = False,
                         memory_utilization: float | None = None,
                         ) -> "DataParallelPagedEngine":
@@ -92,7 +91,7 @@ class DataParallelPagedEngine:
         return cls(params, cfg, tokenizer, dp_size=dp_size, tp_size=tp_size,
                    max_slots=max_slots, page_size=page_size,
                    max_seq_len=max_seq_len, num_pages=num_pages, seed=seed,
-                   devices=devices, kv_dtype=kv_dtype, spec_k=spec_k,
+                   devices=devices, kv_dtype=kv_dtype,
                    memory_utilization=memory_utilization)
 
     @property
@@ -111,8 +110,6 @@ class DataParallelPagedEngine:
             agg.decode_steps += s.decode_steps
             agg.pipelined_chunks += s.pipelined_chunks
             agg.patched_tables += s.patched_tables
-            agg.spec_rounds += s.spec_rounds
-            agg.spec_accepted += s.spec_accepted
         return agg
 
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,

@@ -168,15 +168,12 @@ class EngineStats:
     prefill_seconds: float = 0.0
     decode_chunks: int = 0
     decode_steps: int = 0        # weight passes: forward executions of the
-                                 # decode program over the batch (spec
-                                 # counts verify rounds, not tokens)
+                                 # decode program over the batch
     pipelined_chunks: int = 0    # chunks whose fetch rode behind the next
                                  # dispatch (paged engine chunk pipeline)
     patched_tables: int = 0      # in-place device table patches — chunks
                                  # whose page crossings (one or more
                                  # slots) were absorbed without a flush
-    spec_rounds: int = 0         # draft+verify rounds executed (per slot)
-    spec_accepted: int = 0       # draft tokens accepted (bonus excluded)
 
 
 class TPUEngine:

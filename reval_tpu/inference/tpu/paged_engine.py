@@ -134,7 +134,6 @@ class _DriveState:
     slot_topp: np.ndarray = None  # [B] per-slot top-p (1 = off)
     dev_state: object = None     # packed [B, span+2] device array
     dev_samp: object = None      # [B, 3] float32 (temp, top_p, top_k)
-    spec_dev: dict | None = None  # speculative-path device carry
     dirty: bool = True
     span: int = 0
     since_admit: int = 0
@@ -149,19 +148,10 @@ class PagedTPUEngine:
                  max_slots: int = 8, page_size: int = PAGE_SIZE,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  mesh=None, seed: int = 0, prefix_sharing: bool = True,
-                 kv_dtype: str = "", spec_k: int = 0, spec_rounds: int = 8,
+                 kv_dtype: str = "",
                  memory_utilization: float | None = None,
                  pipeline: bool | None = None):
-        """``spec_k`` > 0 enables greedy n-gram speculative decoding
-        (models/spec.py): chunks where EVERY active request is greedy run
-        ``spec_rounds`` draft+verify rounds of ``spec_k`` candidates
-        instead of token-by-token decode — bit-identical output, up to
-        ``spec_k+1`` tokens per weight pass.  Off by default until the
-        chip A/B (tools/chip_runbook.sh) lands: each verify round reads
-        the KV pool ``spec_k+1`` times, so the win depends on the
-        weight-read/KV-read ratio at the deployment shape.
-
-        ``memory_utilization``: when set (and ``num_pages`` is not),
+        """``memory_utilization``: when set (and ``num_pages`` is not),
         size the page pool from the device's reported HBM — the
         equivalent of the ``gpu_memory_utilization`` the reference
         passes to vLLM (reference inference.py:93): pool budget =
@@ -183,8 +173,6 @@ class PagedTPUEngine:
         self.tokenizer = tokenizer
         self.max_slots = max_slots
         self.page_size = page_size
-        self.spec_k = spec_k
-        self.spec_rounds = spec_rounds
         self.prefix_sharing = prefix_sharing
         if pipeline is None:
             pipeline = os.environ.get(
@@ -254,9 +242,6 @@ class PagedTPUEngine:
         # chunk pipeline instead of flushing it (tables are host-known;
         # lens/token/pos keep flowing device-side untouched)
         self._jit_patch = jax.jit(patch_state_tables)
-        self._jit_spec = jax.jit(
-            partial(self._spec_chunk, cfg=cfg, mesh=mesh),
-            static_argnames=("rounds", "k"), donate_argnames=("cache",))
 
     @staticmethod
     def _pages_for_budget(params, cfg, mesh, page_size: int, kv_dtype: str,
@@ -299,7 +284,6 @@ class PagedTPUEngine:
                         page_size: int = PAGE_SIZE, max_seq_len: int = 8192,
                         num_pages: int | None = None, tokenizer=None,
                         seed: int = 0, kv_dtype: str = "",
-                        spec_k: int = 0, spec_rounds: int = 8,
                         local_devices_only: bool = False,
                         memory_utilization: float | None = None,
                         pipeline: bool | None = None,
@@ -325,8 +309,7 @@ class PagedTPUEngine:
         return cls(params, cfg, tokenizer, max_slots=max_slots,
                    page_size=page_size, max_seq_len=max_seq_len,
                    num_pages=num_pages, mesh=mesh, seed=seed,
-                   kv_dtype=kv_dtype, spec_k=spec_k, spec_rounds=spec_rounds,
-                   pipeline=pipeline,
+                   kv_dtype=kv_dtype, pipeline=pipeline,
                    memory_utilization=memory_utilization)
 
     def close(self) -> None:
@@ -385,25 +368,6 @@ class PagedTPUEngine:
              jax.lax.bitcast_convert_type(keys, jnp.int32), pos[:, None]],
             axis=1)
         return toks.T, cache, new_state
-
-    @staticmethod
-    def _spec_chunk(params, last, hist, n_tok, tables, lens, cache,
-                    *, cfg: ModelConfig, rounds: int, k: int, mesh=None):
-        """``rounds`` greedy draft+verify rounds (models/spec.py) as one
-        jitted program: same one-dispatch-per-chunk host cost as
-        ``_decode_chunk``, emitting 1..k+1 tokens per round per slot."""
-        from ...models.spec import spec_round
-
-        def body(carry, _):
-            last, hist, n_tok, lens, cache = carry
-            out, n_out, last, hist, n_tok, lens, cache = spec_round(
-                params, cfg, last, hist, n_tok, tables, lens, cache, k,
-                mesh=mesh)
-            return (last, hist, n_tok, lens, cache), (out, n_out)
-
-        (last, hist, n_tok, lens, cache), (outs, n_outs) = jax.lax.scan(
-            body, (last, hist, n_tok, lens, cache), None, length=rounds)
-        return outs, n_outs, last, hist, n_tok, lens, cache
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -623,15 +587,12 @@ class PagedTPUEngine:
         # fetches it first:
         #   dirty       slot population or tables changed (admission,
         #               retirement, preemption, span growth)
-        #   spec        the spec path packs device state from host-side
-        #               token history
         #   budget 0    the in-flight steps consume some slot's whole
         #               remaining budget: ground truth needed
         #   page cross  the coming chunk would allocate pages, and
         #               allocation can preempt — in-flight writes must
         #               land before any page is freed for reuse
-        if st.pending is not None and (st.dirty
-                                       or self._spec_allowed(reqs, st)):
+        if st.pending is not None and st.dirty:
             self._process_pending(reqs, st)
         if st.pending is not None and self._chunk_budget(reqs, st) <= 0:
             self._process_pending(reqs, st)
@@ -651,27 +612,8 @@ class PagedTPUEngine:
             return                    # a flush retired the last runner
 
         budget = self._chunk_budget(reqs, st)
-        use_spec = self._spec_allowed(reqs, st) and st.pending is None
-        rounds = 0
-        if use_spec:
-            # rounds bound both the page reservation and the worst-case
-            # writes (each round touches up to spec_k+1 positions), so
-            # they must fit the tightest slot's remaining budget — the
-            # encode_clipped invariant (prompt + max_new < max_seq_len)
-            # then guarantees every write lands inside the sequence's
-            # reachable pages.  Budget-starved chunks fall back to the
-            # exact token-by-token path.
-            cap_rounds = 2 if st.since_admit == 0 else self.spec_rounds
-            rounds = min(cap_rounds, budget // (self.spec_k + 1))
-            # pow2-bucket like the regular path's steps: each distinct
-            # rounds value is a fresh XLA compile of the scan
-            rounds = _floor_pow2(rounds) if rounds >= 1 else 0
-            use_spec = rounds >= 1
-        if use_spec:
-            steps = rounds * (self.spec_k + 1)
-        else:
-            cap = FIRST_CHUNK if st.since_admit == 0 else CHUNK
-            steps = _floor_pow2(min(cap, budget))
+        cap = FIRST_CHUNK if st.since_admit == 0 else CHUNK
+        steps = _floor_pow2(min(cap, budget))
         st.since_admit += 1
 
         # every active sequence must have pages for the whole chunk
@@ -712,9 +654,6 @@ class PagedTPUEngine:
         if new_span != st.span:
             st.span = new_span
             st.dirty = True
-        if use_spec:
-            self._spec_tick(reqs, st, lens, rounds)
-            return
         if st.dirty or st.dev_state is None:
             tables = np.zeros((self.max_slots, st.span), np.int32)
             keyarr = np.zeros((self.max_slots, 2), np.uint32)
@@ -730,7 +669,6 @@ class PagedTPUEngine:
             samp = np.stack([st.slot_temp, st.slot_topp,
                              st.slot_topk.astype(np.float32)], axis=1)
             st.dev_samp = self._dev(jnp.asarray(samp))
-            st.spec_dev = None            # spec-path carry now stale
             st.dirty = False
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("reval.paged_decode_chunk"):
@@ -755,11 +693,6 @@ class PagedTPUEngine:
                 self._process_chunk(reqs, st, prev)
         else:
             self._process_chunk(reqs, st, chunk)
-
-    def _spec_allowed(self, reqs: dict[int, _Request],
-                      st: _DriveState) -> bool:
-        return (self.spec_k > 0
-                and all(reqs[s].temp == 0.0 for s in st.active.values()))
 
     def _chunk_budget(self, reqs: dict[int, _Request],
                       st: _DriveState) -> int:
@@ -864,68 +797,6 @@ class PagedTPUEngine:
             # decode_seconds when the stale chunk is finally fetched
             # (dp_paged's per-call drive would leak the buffer outright).
             self._process_pending(reqs, st)
-
-    def _spec_tick(self, reqs: dict[int, _Request], st: _DriveState,
-                   lens: np.ndarray, rounds: int) -> None:
-        """Speculative variant of the chunk dispatch: ``rounds``
-        draft+verify rounds, then harvest the variable-length accepted
-        tokens and roll the scheduler's reserved lengths back to what
-        actually stands."""
-        k = self.spec_k
-        if st.dirty or st.spec_dev is None:
-            hist_len = self.max_pages_per_seq * self.page_size
-            tables = np.zeros((self.max_slots, st.span), np.int32)
-            hist = np.zeros((self.max_slots, hist_len), np.int32)
-            n_tok = np.full(self.max_slots, 2, np.int32)   # idle: harmless
-            for slot, seq_id in st.active.items():
-                tables[slot] = self.rt.block_table(seq_id)[:st.span]
-                ids = reqs[seq_id].prefill_ids
-                hist[slot, :len(ids)] = ids
-                n_tok[slot] = len(ids)
-            st.spec_dev = {
-                "tables": self._dev(jnp.asarray(tables)),
-                "hist": self._dev(jnp.asarray(hist)),
-                "n_tok": self._dev(jnp.asarray(n_tok)),
-                "lens": self._dev(jnp.asarray(lens)),
-                "last": self._dev(jnp.asarray(st.slot_token.astype(np.int32))),
-            }
-            st.dev_state = None           # regular-path carry now stale
-            st.dirty = False
-        sd = st.spec_dev
-        t0 = time.perf_counter()
-        with jax.profiler.TraceAnnotation("reval.paged_spec_chunk"):
-            outs, n_outs, last, hist, n_tok, lens_d, self.cache = (
-                self._jit_spec(self.params, sd["last"], sd["hist"],
-                               sd["n_tok"], sd["tables"], sd["lens"],
-                               self.cache, rounds=rounds, k=k))
-        outs_h = np.asarray(outs)          # [R, B, k+1]
-        n_h = np.asarray(n_outs)           # [R, B]
-        self.stats.decode_seconds += time.perf_counter() - t0
-        self.stats.decode_chunks += 1
-        self.stats.decode_steps += rounds   # one verify forward per round
-        sd.update(last=last, hist=hist, n_tok=n_tok, lens=lens_d)
-
-        for slot, seq_id in list(st.active.items()):
-            req = reqs[seq_id]
-            ids: list[int] = []
-            for r in range(rounds):
-                n = int(n_h[r, slot])
-                ids.extend(int(t) for t in outs_h[r, slot, :n])
-                self.stats.spec_accepted += n - 1
-            self.stats.spec_rounds += rounds
-            room = req.max_new - len(req.generated)
-            ids = ids[:room]
-            req.generated.extend(ids)
-            self.stats.generated_tokens += len(ids)
-            st.slot_token[slot] = ids[-1]
-            # reserved rounds*(k+1) positions; only the accepted stand —
-            # without this the phantom length accumulates every chunk
-            self.rt.rollback(seq_id, len(req.ids) + len(req.generated) - 1)
-            if self._finished(req, ids):
-                self._retire(req, seq_id, slot, st.active)
-                st.dirty = True
-            if req.notify is not None:
-                req.notify(req)
 
     # -- host-side helpers -------------------------------------------------
     def _dev(self, arr):

@@ -79,7 +79,6 @@ def load_native() -> ctypes.CDLL:
         "reval_rt_advance": ([ptr, i64, i32], i32),
         "reval_rt_fork": ([ptr, i64, p32], i64),
         "reval_rt_preempt": ([ptr, i64, i32], i32),
-        "reval_rt_rollback": ([ptr, i64, i32], i32),
         "reval_rt_preempt_last": ([ptr], i64),
         "reval_rt_release": ([ptr, i64], None),
         "reval_rt_free_pages": ([ptr], i32),
@@ -182,13 +181,6 @@ class PagedRuntime:
 
     def slot_of(self, seq_id: int) -> int:
         return self._lib.reval_rt_slot_of(self._h, seq_id)
-
-    def rollback(self, seq_id: int, new_len: int) -> None:
-        """Correct a sequence's length down to the tokens actually
-        materialised (speculative chunks reserve more than they accept);
-        pages are kept — see the native-side comment."""
-        if self._lib.reval_rt_rollback(self._h, seq_id, new_len) != 0:
-            raise ValueError(f"rollback({seq_id}, {new_len}) rejected")
 
     def advance(self, seq_id: int, n: int) -> int | None:
         """Extend by ``n`` tokens; None signals OOM (caller preempts)."""

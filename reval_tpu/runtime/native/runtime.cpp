@@ -321,28 +321,6 @@ void do_preempt(Runtime* rt, int64_t victim, int32_t materialized) {
 
 }  // namespace
 
-// Roll a running sequence's length back to the caller's count of tokens
-// actually materialised.  Speculative decode reserves up to
-// rounds*(k+1) positions per chunk (reval_rt_advance) but only the
-// accepted tokens stand; without this correction the phantom length
-// would ACCUMULATE chunk over chunk (unbounded page growth + inflated
-// preemption accounting).  Pages are deliberately KEPT even when the
-// rollback crosses a page boundary: the engine's device-resident block
-// tables may still reference them, and the next advance() reuses them
-// in place — the transient over-hold is bounded by one chunk's
-// reservation.  Returns 0, or -1 if the sequence is not running or
-// new_len is outside [prompt_len-1 .. len].
-int32_t reval_rt_rollback(void* h, int64_t seq_id, int32_t new_len) {
-  auto* rt = as_rt(h);
-  auto it = rt->seqs.find(seq_id);
-  if (it == rt->seqs.end() || it->second.state != SeqState::kRunning)
-    return -1;
-  Seq& seq = it->second;
-  if (new_len < seq.prompt_len - 1 || new_len > seq.len) return -1;
-  seq.len = new_len;
-  return 0;
-}
-
 // Preempt a specific running sequence, with the CALLER's count of tokens
 // actually materialised in its pages.  The runtime's own seq.len cannot be
 // trusted here: reval_rt_advance reserves pages for a decode chunk BEFORE

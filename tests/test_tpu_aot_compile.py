@@ -167,14 +167,6 @@ def test_tp8_sharded_decode_chunk_compiles_v5e8():
     assert live <= 16 * 1024**3 * 0.9, f"{live / 2**30:.2f} GiB"
 
 
-def test_spec_chunk_compiles_v5e():
-    """The speculative draft+verify chunk program: its chip viability
-    must be proven before any tunnel window runs the spec A/B
-    (measure-or-cut, round-4 verdict item 3)."""
-    compiled = _build(aot_programs.compile_spec_chunk)
-    assert compiled.memory_analysis().temp_size_in_bytes >= 0
-
-
 def test_34b_northstar_decode_compiles_and_fits_v5e8():
     """The ACTUAL north-star program (CodeLlama-34B, tp=8, weight-only
     int4, paged decode — BASELINE configs[2]) compiled for a real 8-chip

@@ -116,21 +116,6 @@ def test_decode_chunk_program_lowers(tiny_engine_parts, monkeypatch,
     _export_tpu(fn, params, state, cache, sampling)
 
 
-def test_spec_chunk_program_lowers(tiny_engine_parts, monkeypatch):
-    PagedTPUEngine, init_paged_cache, cfg, params = tiny_engine_parts
-    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
-    cache = init_paged_cache(cfg, num_pages=20, page_size=16,
-                             dtype=jnp.bfloat16)
-    b, span, k = 2, 6, 3
-    last = jnp.zeros((b, 1), jnp.int32)
-    hist = jnp.zeros((b, 8), jnp.int32)
-    n_tok = jnp.zeros((b,), jnp.int32)
-    tables = jnp.zeros((b, span), jnp.int32)
-    lens = jnp.ones((b,), jnp.int32)
-    fn = partial(PagedTPUEngine._spec_chunk, cfg=cfg, rounds=2, k=k)
-    _export_tpu(fn, params, last, hist, n_tok, tables, lens, cache)
-
-
 def test_table_patch_program_lowers():
     """The chunk pipeline's in-place table patch (a dynamic-update-slice
     over the packed state's table columns) must lower for TPU: it chains

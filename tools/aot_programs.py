@@ -129,29 +129,6 @@ def compile_flagship_chunk(*, steps=32, slots=32, kv_dtype="",
             .lower(params, state, cache, samp).compile())
 
 
-def compile_spec_chunk(*, slots=32, rounds=8, k=4):
-    """The speculative draft+verify chunk program → v5e executable."""
-    import jax
-    import jax.numpy as jnp
-
-    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
-
-    _env_mosaic("pallas")
-    mesh = _single_device_mesh(topology("v5e:2x2"))
-    rep = _replicated(mesh)
-    cfg, params, cache = flagship_model_parts(mesh)
-    hist_len = 2048                       # max_pages_per_seq * page_size
-    last = jax.ShapeDtypeStruct((slots, 1), jnp.int32, sharding=rep)
-    hist = jax.ShapeDtypeStruct((slots, hist_len), jnp.int32, sharding=rep)
-    n_tok = jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=rep)
-    tables = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT), jnp.int32,
-                                  sharding=rep)
-    lens = jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=rep)
-    fn = partial(PagedTPUEngine._spec_chunk, cfg=cfg, rounds=rounds, k=k)
-    return (jax.jit(fn, donate_argnames=("cache",))
-            .lower(params, last, hist, n_tok, tables, lens, cache).compile())
-
-
 def _compile_tp8_chunk(cfg, param_shapes, *, steps, slots, num_pages):
     """Shared tp=8 decode-chunk builder: one copy of the mesh/sharding/
     state recipe so the flagship and 34B certified programs cannot drift

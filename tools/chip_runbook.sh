@@ -15,6 +15,9 @@
 cd /root/repo || exit 1
 mkdir -p tpu_watch
 R=tpu_watch
+# spec path removed round 5 (measure-or-cut): stale A/B artifacts from
+# older passes must not read as current-round output
+rm -f "$R"/bench_direct_spec.json "$R"/bench_cot_spec.json
 # apply the measured-best config decided on an earlier pass (see
 # tools/decide_defaults.py); decision-set steps that pin their own env
 # override per-step
@@ -112,7 +115,7 @@ if [ -f "$R/diagnosis_config.txt" ] && [ "$(cat "$R/diagnosis_config.txt")" != "
   rm -f "$R"/ablate.txt "$R"/ablate2.txt "$R"/bench_direct.json \
         "$R"/bench_cot.json "$R"/bench_direct_int8.json \
         "$R"/bench_cot_kv8.json "$R"/fleet.json \
-        "$R"/bench_direct_int4.json "$R"/bench_cot_spec.json \
+        "$R"/bench_direct_int4.json \
         "$R"/bench_direct_nopipe.json
 fi
 echo "$FP" > "$R/diagnosis_config.txt"
@@ -128,11 +131,6 @@ run bench_cot.json       3600 json python bench.py --mode cot
 # rows.  If it lands a winner, the next pass's decide re-flips the
 # default and invalidates the diagnosis artifacts (designed mechanism).
 run bench_direct_kv8s64.json 1800 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --kv-dtype int8 --slots 64 --skip-serial --skip-ab
-# speculative decoding measure-or-cut (round-4 verdict item 3): a spec
-# number must land this round or the path is cut -- but it already ate
-# one 40-min timeout (00:23 pass), so the official headline/cot rows go
-# first; spec pins its own config (decision must not contaminate it)
-run bench_direct_spec.json 2400 json env REVAL_TPU_PAGED_BACKEND=pallas REVAL_TPU_KERNEL_DOT=swap python bench.py --no-autotune --spec --skip-serial --skip-ab
 # chunk-pipeline A/B: bench_direct.json above runs with the pipeline ON
 # (default); this row is the same decided config with it OFF — the delta
 # is the measured per-chunk host cost the pipeline hides
@@ -144,7 +142,6 @@ run bench_direct_int8.json 2400 json python bench.py --dtype int8 --skip-serial 
 run bench_cot_kv8.json   3600 json python bench.py --mode cot --kv-dtype int8 --skip-serial --skip-ab
 run fleet.json           2400 json python tools/fleet_bench.py
 run bench_direct_int4.json 2400 json python bench.py --dtype int4 --skip-serial --skip-ab
-run bench_cot_spec.json  3600 json python bench.py --mode cot --spec --skip-serial --skip-ab
 run ablate2.txt          1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --variants chunk,page
 run ablate_int8.txt      1800 txt  python tools/decode_ablate.py --slots 32 --ctx 600 --dtype int8 --variants core,seq
 log "runbook pass complete"
