@@ -270,6 +270,17 @@ def run_fleet(argv: list[str]) -> int:
     parser.add_argument("--multihost", choices=["replicate", "global"], default=None,
                         help="multi-host mode: engine replica per host with "
                              "sharded prompts, or one globally-sharded model")
+    parser.add_argument("--resume", action="store_true",
+                        help="skip (repeat, task) chunks already journaled in "
+                             "<results_dir>/fleet_checkpoint.jsonl (crash recovery)")
+    parser.add_argument("--chaos", type=float, default=None, metavar="RATE",
+                        help="inject transient faults (timeouts, 500s, truncated "
+                             "JSON, latency spikes) at this per-prompt rate — "
+                             "deterministic under --chaos-seed; hardening/smoke tool")
+    parser.add_argument("--chaos-seed", type=int, default=None,
+                        help="seed for the chaos fault schedule (default 0)")
+    parser.add_argument("--no-resilience", action="store_true",
+                        help="disable retry + batch bisection around the backend")
     parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                         help="override a config key (repeatable; JSON values accepted)")
     args = parser.parse_args(argv)
@@ -294,6 +305,13 @@ def run_fleet(argv: list[str]) -> int:
     if repeats < 1:
         print("Error: repeats must be >= 1")
         return 1
+    if (args.chaos if args.chaos is not None else cfg.get("chaos")) and multihost == "global":
+        # "global" runs can't wrap ResilientBackend (per-host retry would
+        # desynchronise the pod's collectives), so injected faults would
+        # abort the whole pod unretried — reject the combination up front
+        print("Error: --chaos is incompatible with --multihost global "
+              "(no retry layer can wrap pod-collective inference)")
+        return 1
     if cfg.get("replay_task") or cfg.get("backend") == "replay":
         # a replay backend serves ONE task's recorded generations in order;
         # the fleet's fused batch would hand them to the wrong tasks
@@ -306,21 +324,59 @@ def run_fleet(argv: list[str]) -> int:
         # must precede backend/device construction; an explicit multihost
         # request that cannot come up is fatal (N duplicate runs otherwise)
         ensure_initialized(strict=True)
+    chaos = args.chaos if args.chaos is not None else cfg.get("chaos")
+    chaos_seed = (args.chaos_seed if args.chaos_seed is not None
+                  else cfg.get("chaos_seed", 0))
+    resume = args.resume or bool(cfg.get("resume"))
+    resilience = cfg.get("resilience", True) and not args.no_resilience
+    # retry knobs ride the config as a dict, e.g. {"retry": {"max_attempts": 6}}
+    retry_policy = None
+    if cfg.get("retry"):
+        from .resilience import RetryPolicy
+
+        retry_policy = RetryPolicy(**cfg["retry"])
     backend = None
     if not use_mock:
+        # "retry" stays IN backend_kwargs: HTTPClientBackend consumes the
+        # same dict for its per-request policy (other backends ignore it)
         backend_kwargs = {k: v for k, v in cfg.items()
-                          if k not in ("task", "mock", "backend")}
+                          if k not in ("task", "mock", "backend", "chaos",
+                                       "chaos_seed", "resume", "resilience")}
         if multihost == "replicate":
             # each host runs a full replica on its OWN chips; without this
             # the engine would build its mesh over the global pod devices
             backend_kwargs["local_devices_only"] = True
         backend = create_backend(**backend_kwargs,
                                  mock=cfg.get("backend") == "mock")
+    elif chaos:
+        # chaos needs a shared backend to wrap; give the mock fleet one
+        # explicitly (tasks still store under the mock_model_* identity)
+        from .inference.mock import MockBackend
+
+        backend = MockBackend(prompt_type=cfg.get("prompt_type", "direct"))
+    if chaos and backend is not None:
+        from .resilience import ChaosBackend
+
+        backend = ChaosBackend(backend, rate=chaos, seed=chaos_seed)
+        print(f"[chaos] injecting faults at rate {chaos} (seed {chaos_seed})")
+    if retry_policy is not None and backend is not None:
+        from .resilience import RetryPolicy as _RP
+
+        # direct __dict__ check (matching ResilientBackend's detection):
+        # a ChaosBackend wrapper would delegate getattr to the client,
+        # but its faults fire above the client's retry loop, so the
+        # configured policy must stay with the ResilientBackend layer
+        if isinstance(getattr(backend, "__dict__", {}).get("retry"), _RP):
+            # the HTTP client already applies cfg["retry"] per request;
+            # handing the same policy to the ResilientBackend wrapper
+            # would nest the schedules (attempts × attempts per leaf)
+            retry_policy = None
     # every other config key (split, sandbox_timeout, valid_test_cases_path,
     # model_id, …) flows through to the tasks, same as `reval_tpu run`
     consumed = {"task", "backend", "mock", "custom_mock", "dataset",
                 "prompt_type", "results_dir", "repeats", "progress", "tasks",
-                "multihost", "run_consistency", "max_items"}
+                "multihost", "run_consistency", "max_items", "chaos",
+                "chaos_seed", "resume", "resilience", "retry"}
     task_kwargs = {k: v for k, v in cfg.items() if k not in consumed}
     cfg_tasks = cfg.get("tasks", FLEET_TASKS)
     cfg_tasks = (cfg_tasks,) if isinstance(cfg_tasks, str) else tuple(cfg_tasks)
@@ -332,14 +388,19 @@ def run_fleet(argv: list[str]) -> int:
         run_consistency=cfg.get("run_consistency", True),
         progress=cfg.get("progress", True),
         tasks=cfg_tasks,
-        multihost=multihost, max_items=max_items, **task_kwargs)
+        multihost=multihost, resume=resume, resilience=resilience,
+        retry_policy=retry_policy, max_items=max_items, **task_kwargs)
     try:
         result = fleet.run()
     finally:
         if backend is not None:
             backend.close()
+    if chaos and hasattr(backend, "injected"):
+        print(f"[chaos] {len(backend.injected)} faults injected, "
+              f"{result.get('lost_prompts', 0)} prompts lost")
     print(json.dumps({"consistency": result.get("consistency"),
-                      "final_repeat": result["repeats"][-1]}))
+                      "final_repeat": result["repeats"][-1],
+                      "lost_prompts": result.get("lost_prompts", 0)}))
     return 0
 
 

@@ -16,10 +16,19 @@ one process.  Per repeat, the fleet
    unchanged), then runs the consistency scorer over the latest logs.
 
 Mock/replay fleets (per-task backends) fall back to per-task inference.
+
+Resilience: the backend is wrapped in a
+:class:`~reval_tpu.resilience.ResilientBackend` (retry + batch bisection —
+one poisoned prompt costs one sentinel slot, not the fused batch), and
+every completed (repeat, task) chunk is journaled to a
+:class:`~reval_tpu.resilience.FleetCheckpoint` in ``results_dir`` so a
+killed run restarted with ``resume=True`` skips already-scored chunks and
+reproduces identical logs.
 """
 
 from __future__ import annotations
 
+from .resilience import INFER_FAILED, FleetCheckpoint, ResilientBackend, RetryPolicy
 from .tasks import TASKS, ConsistencyScorer
 
 __all__ = ["FleetRunner", "FLEET_TASKS"]
@@ -33,9 +42,19 @@ class FleetRunner:
                  results_dir: str = "model_generations",
                  run_consistency: bool = True, progress: bool = True,
                  tasks: tuple[str, ...] = FLEET_TASKS,
-                 multihost: str | None = None, **task_kwargs):
+                 multihost: str | None = None, resume: bool = False,
+                 resilience: bool = True,
+                 retry_policy: RetryPolicy | None = None, **task_kwargs):
         assert backend is not None or mock, "fleet needs a backend (or mock=True)"
         assert multihost in (None, "replicate", "global"), multihost
+        # "global" shards one model across hosts: every infer_many is a
+        # collective all hosts must enter identically, so per-host
+        # retry/bisection would desynchronise the pod — don't wrap.
+        # ("replicate" is per-host-local inference; wrapping is safe.)
+        if (backend is not None and resilience and multihost != "global"
+                and not isinstance(backend, ResilientBackend)):
+            backend = ResilientBackend(backend, policy=retry_policy,
+                                       progress=progress)
         self.dataset = dataset
         self.prompt_type = prompt_type
         self.repeats = repeats
@@ -49,24 +68,44 @@ class FleetRunner:
         # over DCN; "global" = one model sharded across all hosts, identical
         # prompts everywhere (70B-class); None = single host
         self.multihost = multihost
+        self.resume = resume
         self.task_kwargs = task_kwargs
 
-    def _make_tasks(self):
+    def _model_info(self) -> str:
+        return ("mock_model_" + self.prompt_type if self.mock
+                else self.backend.info)
+
+    def _make_tasks(self, names=None):
         return [
             TASKS[name](model=self.backend, prompt_type=self.prompt_type,
                         dataset=self.dataset, mock=self.mock,
                         results_dir=self.results_dir, progress=self.progress,
                         **self.task_kwargs)
-            for name in self.task_names
+            for name in (self.task_names if names is None else names)
         ]
 
-    def run_repeat(self) -> dict[str, dict]:
-        """One pass over all tasks with fused batched inference."""
-        tasks = self._make_tasks()
+    def run_repeat(self, rep: int = 0,
+                   checkpoint: FleetCheckpoint | None = None) -> dict[str, dict]:
+        """One pass over all tasks with fused batched inference.  Tasks the
+        checkpoint already holds for this repeat are skipped (their metrics
+        come from the journal) — the resume path after a crash."""
+        metrics: dict[str, dict] = {}
+        pending_names = []
+        for name in self.task_names:
+            row = checkpoint.done(rep, name) if checkpoint is not None else None
+            if row is not None:
+                metrics[name] = row["metrics"]
+                if self.progress:
+                    print(f"[fleet] resume: repeat {rep + 1} task {name} "
+                          f"already scored — skipping")
+            else:
+                pending_names.append(name)
+        if not pending_names:
+            return metrics
+        tasks = self._make_tasks(pending_names)
         planned = [(task, *task._plan()) for task in tasks]
         shared = self.backend is not None and all(
             t.backend is self.backend for t in tasks)
-        metrics: dict[str, dict] = {}
         if shared:
             all_jobs = [(task, job) for task, _, jobs in planned for job in jobs]
             if self.progress:
@@ -74,18 +113,36 @@ class FleetRunner:
                       f"{len(tasks)} tasks → one batched pass")
             prompts = [job.prompt for _, job in all_jobs]
             responses = self._infer(prompts)
+            self._check_aligned(len(responses), planned)
             if not self._should_write():
-                return {t.name: {} for t, _, _ in planned}
+                return {**metrics, **{t.name: {} for t, _, _ in planned}}
             cursor = 0
             for task, records, jobs in planned:
                 chunk = responses[cursor:cursor + len(jobs)]
                 cursor += len(jobs)
                 metrics[task.name] = task.score_and_write(records, jobs, chunk)
+                if checkpoint is not None:
+                    checkpoint.record(rep, task.name, metrics[task.name])
         else:
             for task, records, jobs in planned:
                 responses = task.backend.infer_many([j.prompt for j in jobs])
+                self._check_aligned(len(responses), [(task, records, jobs)])
                 metrics[task.name] = task.score_and_write(records, jobs, responses)
+                if checkpoint is not None and self._should_write():
+                    checkpoint.record(rep, task.name, metrics[task.name])
         return metrics
+
+    @staticmethod
+    def _check_aligned(n_responses: int, planned) -> None:
+        """A backend returning a short/long list must fail loudly with the
+        task attribution, never silently shift every later task's chunk."""
+        counts = {task.name: len(jobs) for task, _, jobs in planned}
+        total = sum(counts.values())
+        if n_responses != total:
+            raise RuntimeError(
+                f"[fleet] backend returned {n_responses} responses for "
+                f"{total} prompts (per-task prompt counts: {counts}) — "
+                f"refusing to mis-align task chunks")
 
     def _infer(self, prompts: list[str]) -> list[str]:
         """Batched inference, sharded across hosts when configured."""
@@ -104,19 +161,47 @@ class FleetRunner:
 
         return is_primary_host()
 
+    def _make_checkpoint(self) -> FleetCheckpoint | None:
+        """Single-host runs journal completions; multi-host runs don't
+        (hosts would need a shared journal to skip chunks in lockstep —
+        a divergent skip set would desynchronise the fused batches)."""
+        if self.multihost is not None:
+            if self.resume and self.progress:
+                print("[fleet] resume is single-host only — ignoring")
+            return None
+        # identity includes every knob that changes a chunk's *shape* —
+        # a journal from a different slice must never satisfy this run
+        checkpoint = FleetCheckpoint(self.results_dir, {
+            "model_info": self._model_info(), "dataset": self.dataset,
+            "prompt_type": self.prompt_type,
+            "split": self.task_kwargs.get("split"),
+            "max_items": self.task_kwargs.get("max_items")})
+        if self.resume:
+            n = checkpoint.load()
+            if self.progress and n:
+                print(f"[fleet] resume: {n} completed chunks in {checkpoint.path}")
+        else:
+            checkpoint.reset()
+        return checkpoint
+
     def run(self) -> dict:
         """All repeats + the consistency score (reference batch_run.py:20-32)."""
+        checkpoint = self._make_checkpoint()
         all_metrics: list[dict[str, dict]] = []
         for rep in range(self.repeats):
             if self.progress:
                 print(f"[fleet] repeat {rep + 1}/{self.repeats}")
-            all_metrics.append(self.run_repeat())
+            all_metrics.append(self.run_repeat(rep, checkpoint))
         result: dict = {"repeats": all_metrics}
+        if isinstance(self.backend, ResilientBackend) and self.backend.failures:
+            # prompts that exhausted retries and were scored as INFER_FAILED
+            result["lost_prompts"] = len(self.backend.failures)
+            if self.progress:
+                print(f"[fleet] {len(self.backend.failures)} prompts lost to "
+                      f"{INFER_FAILED} after retries")
         if (self.run_consistency and set(FLEET_TASKS) <= set(self.task_names)
                 and self._should_write()):
-            model_info = ("mock_model_" + self.prompt_type if self.mock
-                          else self.backend.info)
-            scorer = ConsistencyScorer(model_info, self.dataset,
+            scorer = ConsistencyScorer(self._model_info(), self.dataset,
                                        results_dir=self.results_dir,
                                        progress=self.progress)
             result["consistency"] = scorer.run()

@@ -6,6 +6,14 @@ Pairs with ``reval_tpu.serving.server``, which serves the in-process TPU
 engine over the same protocol — the split exists so one resident sharded
 model can serve many sequential task runs (reference start_server.sh
 topology, SURVEY §3.3).
+
+Resilience: construction no longer races the server.  A wait-for-server
+handshake polls ``/healthz`` (any HTTP answer counts as "up", so servers
+predating the route still pass) until the engine finishes loading/compiling,
+and every request afterwards runs under a
+:class:`~reval_tpu.resilience.RetryPolicy` — connection resets, timeouts,
+5xx responses, and truncated JSON bodies are retried with exponential
+backoff instead of killing the launcher.
 """
 
 from __future__ import annotations
@@ -13,6 +21,7 @@ from __future__ import annotations
 import json
 import urllib.request
 
+from ..resilience import RetryPolicy, wait_for_server
 from .base import InferenceBackend
 
 __all__ = ["HTTPClientBackend"]
@@ -20,28 +29,45 @@ __all__ = ["HTTPClientBackend"]
 
 class HTTPClientBackend(InferenceBackend):
     def __init__(self, model_id: str, port: int = 3000, host: str = "localhost",
-                 mock: bool = False, temp: float = 0.8, prompt_type: str = "direct", **kwargs):
+                 mock: bool = False, temp: float = 0.8, prompt_type: str = "direct",
+                 retry_policy: RetryPolicy | None = None, retry: dict | None = None,
+                 wait_for_server_s: float = 600.0, **kwargs):
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         self.base_url = f"http://{host}:{port}/v1"
+        # ``retry`` is the config-dict spelling (run configs are JSON);
+        # ``retry_policy`` the programmatic one
+        self.retry = retry_policy or RetryPolicy(**(retry or {}))
         self._server_model = model_id
         if not mock:
+            # Launchers start client and server concurrently; block here
+            # until the server answers instead of crashing on the eager
+            # /models probe.  The default budget is 10 minutes because the
+            # engine really does spend minutes loading + compiling a big
+            # checkpoint before it binds the port.
+            wait_for_server(lambda: self._request_once("/healthz", timeout=5),
+                            timeout=wait_for_server_s,
+                            describe=f"server at {self.base_url}")
             models = self._get("/models")
             self._server_model = models["data"][0]["id"]
             print(f"user-side model_id: {model_id}, server-side model_id: {self._server_model}")
 
-    def _get(self, route: str) -> dict:
-        with urllib.request.urlopen(self.base_url + route, timeout=30) as resp:
-            return json.load(resp)
-
-    def _post(self, route: str, payload: dict, timeout: float = 600) -> dict:
+    def _request_once(self, route: str, data: bytes | None = None,
+                      timeout: float = 30) -> dict:
         req = urllib.request.Request(
-            self.base_url + route,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
+            self.base_url + route, data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+            method="POST" if data is not None else "GET",
         )
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.load(resp)
+
+    def _get(self, route: str) -> dict:
+        return self.retry.call(lambda: self._request_once(route))
+
+    def _post(self, route: str, payload: dict, timeout: float = 600) -> dict:
+        data = json.dumps(payload).encode()
+        return self.retry.call(
+            lambda: self._request_once(route, data=data, timeout=timeout))
 
     def infer_one(self, prompt: str) -> str:
         out = self._post("/completions", {

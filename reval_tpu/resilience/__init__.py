@@ -1,0 +1,39 @@
+"""Resilience layer: retries, batch bisection, chaos injection, checkpoints.
+
+A fleet run is hours of accelerator time spread over thousands of prompts
+and an HTTP hop (``inference.client`` ↔ ``serving.server``); without this
+layer one connection reset, one poisoned prompt, or one mid-run kill aborts
+everything with nothing written.  The pieces compose:
+
+- :class:`RetryPolicy` — bounded exponential backoff + jitter around any
+  callable, with transport-level error classification (``retryable_error``)
+  and an injectable clock/sleep/rng so tests never really wait;
+- :func:`wait_for_server` — the client-side handshake loop that polls a
+  server's ``/healthz`` until it comes up instead of crashing when the
+  client is constructed first;
+- :class:`ResilientBackend` — wraps any ``InferenceBackend``; a failing
+  ``infer_many`` mega-batch is retried, then recursively bisected so a
+  poisoned prompt loses only its own slot (scored as :data:`INFER_FAILED`),
+  never the fleet's fused batch;
+- :class:`FleetCheckpoint` — an append-only JSONL journal of completed
+  (repeat, task) chunks in ``results_dir``; ``fleet --resume`` skips them;
+- :class:`ChaosBackend` — deterministic, seeded fault injection (timeouts,
+  HTTP 500s, truncated JSON, latency spikes) that proves the above works
+  and doubles as a hardening tool for the serving stack.
+"""
+
+from .chaos import CHAOS_MODES, ChaosBackend
+from .checkpoint import FleetCheckpoint
+from .resilient import INFER_FAILED, ResilientBackend
+from .retry import RetryPolicy, retryable_error, wait_for_server
+
+__all__ = [
+    "CHAOS_MODES",
+    "ChaosBackend",
+    "FleetCheckpoint",
+    "INFER_FAILED",
+    "ResilientBackend",
+    "RetryPolicy",
+    "retryable_error",
+    "wait_for_server",
+]

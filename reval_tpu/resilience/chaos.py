@@ -1,0 +1,128 @@
+"""ChaosBackend: deterministic, seeded fault injection for any backend.
+
+The test harness that proves the resilience layer actually works — and a
+reusable hardening tool: point a fleet at ``--chaos 0.3`` and watch it
+finish anyway.  Faults are *scheduled per prompt* from a seeded stream
+keyed on ``crc32(prompt) ^ seed`` (never Python's salted ``hash``), so the
+schedule is reproducible across processes and independent of call order —
+however a bisecting caller slices the batch, each prompt injects exactly
+the same faults in the same sequence.
+
+Faults are raised as the real exception types the transport produces
+(``TimeoutError``, ``urllib.error.HTTPError`` 500, ``json.JSONDecodeError``
+for a truncated body), so retry classification treats injected and genuine
+failures identically.  Latency spikes don't raise — they just stall.
+
+Each prompt's fault budget is finite (``max_faults_per_prompt``), i.e.
+chaos is *transient*: a caller with enough retries loses zero prompts.
+Keep ``max_faults_per_prompt`` below the retry policy's ``max_attempts``
+or single-prompt leaves can exhaust their budget and take the sentinel.
+Budgets are per *serve epoch*: once a prompt is successfully served, its
+next appearance (the fleet's next repeat) re-arms a fresh deterministic
+schedule — a 5-repeat chaos fleet is exercised on all 5 repeats, not
+just the first.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import zlib
+
+__all__ = ["CHAOS_MODES", "ChaosBackend"]
+
+CHAOS_MODES = ("timeout", "http_500", "bad_json", "latency")
+
+
+class ChaosBackend:
+    """Wrap a backend; inject faults at ``rate`` per prompt, seeded."""
+
+    def __init__(self, inner, rate: float = 0.3, seed: int = 0,
+                 modes: tuple[str, ...] = CHAOS_MODES,
+                 max_faults_per_prompt: int = 3, spike_s: float = 0.01,
+                 sleep=time.sleep):
+        assert 0.0 <= rate < 1.0, f"chaos rate must be in [0, 1), got {rate}"
+        unknown = set(modes) - set(CHAOS_MODES)
+        assert not unknown, f"unknown chaos modes: {sorted(unknown)}"
+        self.inner = inner
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.modes = tuple(modes)
+        self.max_faults_per_prompt = int(max_faults_per_prompt)
+        self.spike_s = float(spike_s)
+        self.sleep = sleep
+        # bookkeeping keys are crc32(prompt), not the (multi-KB) prompt
+        # strings, so a thousands-of-prompts × N-repeats fleet doesn't
+        # retain every prompt verbatim for the whole run
+        self._pending: dict[tuple[int, int], list[str]] = {}  # (epoch, crc) → faults left
+        self._epoch: dict[int, int] = {}           # crc → successful serves
+        self.injected: list[tuple[str, str]] = []  # (mode, prompt[:40]) log
+
+    # -- deterministic per-prompt schedule --------------------------------
+    def _schedule(self, prompt: str, epoch: int = 0) -> list[str]:
+        """Faults this prompt will inject on its ``epoch``-th serve,
+        freshly seeded per (prompt, epoch) so the schedule survives
+        process restarts and any batch slicing."""
+        key = zlib.crc32(prompt.encode("utf-8", "replace"))
+        rng = random.Random(((key << 32) ^ self.seed) + epoch * 0x9E3779B1)
+        faults = []
+        while (len(faults) < self.max_faults_per_prompt
+               and rng.random() < self.rate):
+            faults.append(rng.choice(self.modes))
+        return faults
+
+    def _raise(self, mode: str, prompt: str, batch: int):
+        self.injected.append((mode, prompt[:40]))
+        if mode == "latency":
+            self.sleep(self.spike_s)
+            return
+        if mode == "timeout":
+            raise TimeoutError(
+                f"chaos: injected timeout ({batch} prompts in flight)")
+        if mode == "http_500":
+            raise urllib.error.HTTPError(
+                "chaos://injected", 500, "chaos: injected internal error",
+                None, None)
+        # bad_json: what json.load raises on a connection cut mid-body
+        raise json.JSONDecodeError("chaos: truncated response body",
+                                   '{"choices": [', 13)
+
+    # -- the infer API ----------------------------------------------------
+    def infer_many(self, prompts) -> list[str]:
+        prompts = list(prompts)
+        for prompt in prompts:
+            crc = zlib.crc32(prompt.encode("utf-8", "replace"))
+            epoch = self._epoch.get(crc, 0)
+            pending = self._pending.setdefault(
+                (epoch, crc), self._schedule(prompt, epoch))
+            while pending:
+                # consume before raising: each fault fires exactly once
+                mode = pending.pop(0)
+                self._raise(mode, prompt, len(prompts))
+        out = self.inner.infer_many(prompts)
+        for prompt in prompts:
+            # a successful serve re-arms the prompt's next appearance;
+            # drop the drained schedule (kept until now: re-creating it
+            # mid-epoch would replay the full fault list forever)
+            crc = zlib.crc32(prompt.encode("utf-8", "replace"))
+            epoch = self._epoch.get(crc, 0)
+            self._pending.pop((epoch, crc), None)
+            self._epoch[crc] = epoch + 1
+        return out
+
+    def infer_one(self, prompt: str) -> str:
+        return self.infer_many([prompt])[0]
+
+    def infer(self, prompt: str) -> str:
+        return self.infer_many([prompt])[0]
+
+    # -- identity / lifecycle delegate to the wrapped backend -------------
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

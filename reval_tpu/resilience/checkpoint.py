@@ -1,0 +1,69 @@
+"""Crash-resumable fleet runs: an append-only JSONL completion journal.
+
+One row per completed (repeat, task) chunk, written *after* that task's
+log hits disk and fsync'd, so the journal never claims work whose log
+could be lost.  ``fleet --resume`` reloads the journal and skips completed
+chunks; because mock/greedy generation is deterministic and the per-task
+JSONL contract is unchanged, a killed-then-resumed run produces logs
+byte-identical to an uninterrupted one.
+
+Rows carry the run identity (model_info, dataset, prompt_type) and are
+filtered on load, so a journal left behind by a different model or prompt
+style can never satisfy this run's chunks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["FleetCheckpoint"]
+
+
+class FleetCheckpoint:
+    FILENAME = "fleet_checkpoint.jsonl"
+
+    def __init__(self, results_dir: str, identity: dict):
+        self.path = os.path.join(results_dir, self.FILENAME)
+        self.identity = dict(identity)
+        self._done: dict[tuple[int, str], dict] = {}
+
+    def load(self) -> int:
+        """Read the journal; keep rows matching this run's identity.
+        Returns the number of completed chunks found.  A torn final line
+        (crash mid-append) is ignored, not fatal."""
+        self._done = {}
+        if not os.path.exists(self.path):
+            return 0
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if any(row.get(k) != v for k, v in self.identity.items()):
+                    continue
+                self._done[(int(row["repeat"]), row["task"])] = row
+        return len(self._done)
+
+    def reset(self) -> None:
+        """Start fresh: a non-resume run must not inherit stale chunks."""
+        self._done = {}
+        if os.path.exists(self.path):
+            os.remove(self.path)
+
+    def done(self, repeat: int, task: str) -> dict | None:
+        return self._done.get((repeat, task))
+
+    def record(self, repeat: int, task: str, metrics: dict) -> None:
+        row = {**self.identity, "repeat": int(repeat), "task": task,
+               "metrics": metrics}
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._done[(int(repeat), task)] = row

@@ -1,0 +1,128 @@
+"""Batch bisection: one poisoned prompt must not sink the fused batch.
+
+The fleet concatenates every task's prompts into one ``infer_many``
+mega-batch (``fleet.py``) — great for chip utilisation, terrible for blast
+radius: before this wrapper, a single transient OOM or poisoned prompt
+aborted thousands of finished slots.  :class:`ResilientBackend` retries the
+whole batch under a :class:`~reval_tpu.resilience.retry.RetryPolicy`, and
+when retries don't clear it, recursively splits the batch and retries the
+halves.  Failures narrow to single prompts, which get the full retry
+budget and finally degrade to the :data:`INFER_FAILED` sentinel — scored
+as a wrong answer, exactly one slot lost.
+"""
+
+from __future__ import annotations
+
+from .retry import RetryPolicy
+
+__all__ = ["INFER_FAILED", "ResilientBackend"]
+
+# What a permanently-failing prompt "generates".  No answer parser matches
+# it, so the slot scores as wrong — the log keeps its shape and the error
+# is visible verbatim in the generated field.
+INFER_FAILED = "[REVAL:INFER_FAILED]"
+
+
+class ResilientBackend:
+    """Wrap any ``InferenceBackend``: retry + bisect ``infer_many``.
+
+    Duck-typed proxy — identity (``info``, ``prompt_type``, ``temp``, …)
+    delegates to the wrapped backend so tasks and the consistency scorer
+    see the same model.  ``failures`` records every prompt that exhausted
+    its retry budget (the fleet surfaces the count in its summary).
+    """
+
+    def __init__(self, inner, policy: RetryPolicy | None = None,
+                 sentinel: str = INFER_FAILED, batch_attempts: int = 2,
+                 max_loss_fraction: float = 0.5, progress: bool = True):
+        self.inner = inner
+        if policy is None:
+            # Only the DIRECT inner's own policy counts (instance dict, no
+            # __getattr__ delegation): a ChaosBackend sitting between this
+            # wrapper and an HTTP client injects faults *above* the
+            # client's retry loop, so a delegated policy must not collapse
+            # this layer's budget — the chaos faults would never retry.
+            inner_retry = getattr(inner, "__dict__", {}).get("retry")
+            if isinstance(inner_retry, RetryPolicy):
+                # the wrapped backend already retries every request at the
+                # transport level (HTTPClientBackend); retrying again here
+                # would multiply the schedules (4×4 requests per leaf) —
+                # this layer then only contributes the bisection
+                policy = RetryPolicy(max_attempts=1,
+                                     retryable=inner_retry.retryable)
+            else:
+                policy = RetryPolicy()
+        self.policy = policy
+        self.sentinel = sentinel
+        # Multi-prompt batches get a short retry budget before bisection:
+        # a batch-wide transient (server restart) usually clears in one
+        # retry, while per-prompt poison never does — splitting early keeps
+        # the wasted re-inference logarithmic instead of linear.
+        self.batch_attempts = max(1, min(int(batch_attempts),
+                                         policy.max_attempts))
+        # Sentinel-degrading is for *per-prompt* poison; a batch losing
+        # more than this fraction is a systemic failure (server down, bad
+        # protocol) and must abort with the real error, not "complete"
+        # with a log full of sentinels.
+        self.max_loss_fraction = float(max_loss_fraction)
+        self.progress = progress
+        self.failures: list[dict] = []
+
+    # -- the infer API ----------------------------------------------------
+    def infer_many(self, prompts) -> list[str]:
+        prompts = list(prompts)
+        if not prompts:
+            return []
+        before = len(self.failures)
+        out = self._attempt(prompts, depth=0)
+        lost = len(self.failures) - before
+        if len(prompts) > 1 and lost > len(prompts) * self.max_loss_fraction:
+            raise RuntimeError(
+                f"resilience: {lost}/{len(prompts)} prompts failed — "
+                f"systemic backend failure, not per-prompt poison "
+                f"(last error: {self.failures[-1]['error']})")
+        return out
+
+    def infer_one(self, prompt: str) -> str:
+        return self.infer_many([prompt])[0]
+
+    def infer(self, prompt: str) -> str:
+        return self.infer_many([prompt])[0]
+
+    def _attempt(self, prompts: list[str], depth: int) -> list[str]:
+        attempts = (self.policy.max_attempts if len(prompts) == 1
+                    else self.batch_attempts)
+        try:
+            out = self.policy.call(
+                lambda: self.inner.infer_many(prompts), attempts=attempts)
+        except Exception as exc:
+            if len(prompts) == 1:
+                self.failures.append({"prompt": prompts[0], "error": repr(exc)})
+                if self.progress:
+                    print(f"[resilience] prompt lost after "
+                          f"{attempts} attempts: {exc!r}")
+                return [self.sentinel]
+            if self.progress and depth == 0:
+                print(f"[resilience] batch of {len(prompts)} failed "
+                      f"({exc!r}) → bisecting")
+            mid = len(prompts) // 2
+            return (self._attempt(prompts[:mid], depth + 1)
+                    + self._attempt(prompts[mid:], depth + 1))
+        out = list(out)
+        if len(out) != len(prompts):
+            # A short list is a contract bug, not a transient: bisecting
+            # would "repair" it silently and mis-align task chunks.
+            raise RuntimeError(
+                f"backend contract violation: {type(self.inner).__name__}"
+                f".infer_many returned {len(out)} responses for "
+                f"{len(prompts)} prompts")
+        return out
+
+    # -- identity / lifecycle delegate to the wrapped backend -------------
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -1,0 +1,128 @@
+"""Retry policy + wait-for-server handshake.
+
+Classification first: only *transport-shaped* failures are retried —
+connection refused/reset while a server boots or restarts, request
+timeouts, throttling/5xx responses, and truncated or malformed JSON bodies
+(a connection dropped mid-response).  Application errors (HTTP 400/404,
+``ValueError`` from bad arguments, …) are bugs and propagate immediately;
+retrying them would only hide the stack trace for ``max_attempts`` longer.
+
+Everything time-shaped (clock, sleep, rng) is injectable so the backoff
+schedule is unit-testable without real sleeps.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import time
+import urllib.error
+from typing import Callable
+
+__all__ = ["RetryPolicy", "retryable_error", "wait_for_server"]
+
+# Status codes worth retrying: request timeout, throttling, and the 5xx
+# family a restarting or overloaded server emits.
+RETRYABLE_HTTP_CODES = frozenset({408, 425, 429, 500, 502, 503, 504})
+
+
+def retryable_error(exc: BaseException) -> bool:
+    """Is this failure transient at the transport level?"""
+    if isinstance(exc, urllib.error.HTTPError):
+        # Check before URLError: HTTPError subclasses it, and a 400/404 is
+        # an application error that must propagate.
+        return exc.code in RETRYABLE_HTTP_CODES
+    return isinstance(exc, (
+        urllib.error.URLError,          # refused / reset / DNS while booting
+        TimeoutError,                   # socket.timeout is an alias ≥3.10
+        ConnectionError,                # reset/aborted outside urllib
+        http.client.HTTPException,      # IncompleteRead, BadStatusLine, …
+        json.JSONDecodeError,           # truncated/malformed response body
+    ))
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with jitter around any callable.
+
+    ``delay(attempt) = min(base * multiplier**attempt, max_delay)`` plus a
+    uniform jitter of up to ``jitter * delay`` so a fleet of clients
+    hammering one recovering server doesn't retry in lockstep.
+    """
+
+    def __init__(self, max_attempts: int = 4, base_delay: float = 0.25,
+                 max_delay: float = 30.0, multiplier: float = 2.0,
+                 jitter: float = 0.25,
+                 retryable: Callable[[BaseException], bool] = retryable_error,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: random.Random | None = None):
+        assert max_attempts >= 1, "a policy needs at least one attempt"
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.retryable = retryable
+        self.sleep = sleep
+        self.rng = rng if rng is not None else random.Random()
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before retrying after the given 0-indexed attempt."""
+        delay = min(self.base_delay * self.multiplier ** attempt, self.max_delay)
+        if self.jitter:
+            delay += delay * self.jitter * self.rng.random()
+        return delay
+
+    def call(self, fn: Callable[[], "object"], *, attempts: int | None = None,
+             on_retry: Callable[[int, BaseException, float], None] | None = None):
+        """Run ``fn`` under the policy; re-raise the last error when the
+        attempt budget is spent or the error is not retryable.  ``attempts``
+        overrides ``max_attempts`` (batch bisection retries multi-prompt
+        batches less eagerly than single prompts)."""
+        budget = attempts if attempts is not None else self.max_attempts
+        for attempt in range(budget):
+            try:
+                return fn()
+            except Exception as exc:
+                if not self.retryable(exc) or attempt + 1 >= budget:
+                    raise
+                delay = self.delay_for(attempt)
+                if on_retry is not None:
+                    on_retry(attempt, exc, delay)
+                self.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+def wait_for_server(probe: Callable[[], "object"], *, timeout: float = 60.0,
+                    interval: float = 0.5, describe: str = "server",
+                    clock: Callable[[], float] = time.monotonic,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Poll ``probe()`` until the server answers or ``timeout`` elapses.
+
+    Any HTTP *response* — including an error status like 404 from a server
+    predating ``/healthz`` — means the server is up, so the handshake
+    returns.  Transport errors (connection refused while the engine is
+    still compiling, timeouts) keep polling; anything else is a real bug
+    and propagates.
+    """
+    deadline = clock() + timeout
+    announced = False
+    while True:
+        try:
+            return probe()
+        except urllib.error.HTTPError:
+            return None                 # it answered: up, just no such route
+        except Exception as exc:
+            if not retryable_error(exc):
+                raise
+            if clock() >= deadline:
+                raise TimeoutError(
+                    f"{describe} not reachable after {timeout:.0f}s "
+                    f"(last error: {exc!r})") from exc
+            if not announced:
+                # the wait can legitimately run minutes (engine loading);
+                # say so once instead of hanging silently
+                print(f"[resilience] waiting for {describe} "
+                      f"(up to {timeout:.0f}s; {exc!r})")
+                announced = True
+        sleep(max(0.0, min(interval, deadline - clock())))

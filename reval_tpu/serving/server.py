@@ -82,22 +82,42 @@ class EngineServer:
                 pass
 
             def _send(self, code: int, payload: dict) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    # client hung up mid-response: this handler thread is
+                    # done; the engine and other requests are unaffected
+                    pass
 
             def do_GET(self):
-                if self.path.rstrip("/") == "/v1/models":
+                path = self.path.rstrip("/")
+                if path == "/v1/models":
                     self._send(200, {"object": "list",
                                      "data": [{"id": outer.model_id,
                                                "object": "model"}]})
+                elif path in ("/healthz", "/v1/healthz"):
+                    # the client handshake polls this until the engine is
+                    # loaded; answering at all is the signal
+                    self._send(200, {"status": "ok",
+                                     "model": outer.model_id})
                 else:
                     self._send(404, {"error": f"unknown route {self.path}"})
 
             def do_POST(self):
+                # per-request isolation: whatever one request does, the
+                # worst outcome is its own error response — never a dead
+                # serve loop taking the whole fleet's backend with it
+                try:
+                    self._handle_post()
+                except Exception as exc:  # noqa: BLE001
+                    self._send(500, {"error": f"internal error: {exc}"})
+
+            def _handle_post(self):
                 if self.path.rstrip("/") != "/v1/completions":
                     self._send(404, {"error": f"unknown route {self.path}"})
                     return

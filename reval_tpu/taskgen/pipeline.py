@@ -173,7 +173,8 @@ def _gen_class_item(dataset, idx, item, stats, max_inputs, timeout):
             continue
         from ..tasks.base import TaskRunner
 
-        trace = TaskRunner.run_class_sandbox(test_cls, timeout)
+        trace, status = TaskRunner.run_class_sandbox(test_cls, timeout)
+        assert status == "ok", f"{status} tracing {test_cls.__name__}.dreval_test"
         task = probes_for_function(code, trace)
         if task:
             item["tasks"].append(

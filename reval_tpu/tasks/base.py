@@ -30,6 +30,7 @@ from ..datasets import DREvalDataset, Families, family_of
 from ..datasets.dreval import ClassEvalHooks
 from ..dynamics import CodeSpace, Sandbox
 from ..prompting import build_prompt
+from ..resilience import INFER_FAILED
 from .results import ResultsStore
 
 __all__ = ["TaskRunner", "ProbeTask", "ProbeJob"]
@@ -96,6 +97,11 @@ class TaskRunner:
                 "backend prompt type must match task prompt type"
         self.data = DREvalDataset.load(dataset, split)
         self.sandbox_timeout = sandbox_timeout
+        # ground-truth sandbox outcomes, tallied during planning; non-ok
+        # pairs degrade to skipped probes and are surfaced in the metrics
+        # trailer so fleet summaries can tell ground-truth timeouts from
+        # model errors
+        self.sandbox_stats = {"ok": 0, "timed out": 0, "exception": 0}
         self.progress = progress
         self.max_items = max_items  # smoke runs: only the first N benchmark rows
         self._no_skip: set[tuple] | None = None
@@ -135,6 +141,24 @@ class TaskRunner:
     def _skipped(self, key: tuple) -> bool:
         return self._no_skip is not None and key not in self._no_skip
 
+    # ---- sandbox accounting ----------------------------------------------
+    def _tally_sandbox(self, status: str) -> bool:
+        """Record one ground-truth sandbox outcome; True when it ran ok."""
+        key = "exception" if status.startswith("exception") else status
+        self.sandbox_stats[key] = self.sandbox_stats.get(key, 0) + 1
+        return status == "ok"
+
+    def _final_metrics(self) -> dict:
+        """The task's metrics plus failure accounting, when any occurred.
+        Clean runs keep the exact reference trailer shape."""
+        metrics = dict(self.metrics)
+        timed_out = self.sandbox_stats.get("timed out", 0)
+        raised = self.sandbox_stats.get("exception", 0)
+        if timed_out or raised:
+            metrics["sandbox_errors"] = {"timed_out": timed_out,
+                                         "exception": raised}
+        return metrics
+
     # ---- planning --------------------------------------------------------
     @staticmethod
     def _family_task_idx(idx: int, fam: str) -> int | None:
@@ -171,6 +195,17 @@ class TaskRunner:
                 self._plan_function_item(idx, fam, row["tasks"], record, jobs)
             if self.progress and (n + 1) % 25 == 0:
                 print(f"[{self.name}] planned {n + 1}/{len(rows)} items, {len(jobs)} prompts")
+        failed = (self.sandbox_stats["timed out"]
+                  + self.sandbox_stats["exception"])
+        if failed and self.sandbox_stats["ok"] == 0:
+            # partial sandbox failures degrade (skipped pairs, counted in
+            # the trailer) — but EVERY pair failing is a broken host/config
+            # (e.g. sandbox_timeout far too low), and scoring an empty run
+            # as "complete" would journal it as done under --resume
+            raise RuntimeError(
+                f"[{self.name}] ground truth failed for all {failed} pairs "
+                f"({dict(self.sandbox_stats)}) — broken sandbox config/host, "
+                f"refusing to score an empty run")
         return records, jobs
 
     def _plan_function_item(self, idx: int, fam: str, pairs: list, record: dict, jobs: list):
@@ -235,14 +270,18 @@ class TaskRunner:
 
     @staticmethod
     def run_class_sandbox(test_cls, timeout: float):
-        """Instantiate, setUp, and trace the pair's dreval_test."""
-        obj = test_cls()
-        if hasattr(obj, "setUp"):
-            obj.setUp()
+        """Instantiate, setUp, and trace the pair's dreval_test.  Returns
+        ``(states, status)``; callers decide whether a non-ok status is
+        fatal (taskgen) or a degraded skip (planning)."""
+        try:
+            obj = test_cls()
+            if hasattr(obj, "setUp"):
+                obj.setUp()
+        except Exception as exc:  # fixture failure: no trace possible
+            return None, f"exception: {exc}"
         sandbox = Sandbox(obj.dreval_test, timeout=timeout)
         _, states = sandbox.run()
-        assert sandbox.status == "ok", f"{sandbox.status} tracing {test_cls.__name__}.dreval_test"
-        return states
+        return states, sandbox.status
 
     # ---- trace-of-thoughts hooks (probe tasks implement) -----------------
     def tot_matches(self, job: "ProbeJob", ans) -> bool:
@@ -266,7 +305,7 @@ class TaskRunner:
         if self.progress:
             print(f"[{self.name}] tot: {len(valid_cases)} valid test cases, "
                   f"{scored} scored of {len(jobs)} probes")
-        self.metrics_trailer = self.metrics
+        self.metrics_trailer = self._final_metrics()
         records.append(self.metrics_trailer)
         from datetime import datetime, timezone
 
@@ -322,10 +361,16 @@ class TaskRunner:
         """Score planned jobs against their responses and persist the log.
         Split out of :meth:`run` so the fleet runner can batch inference
         across several tasks before scoring each."""
-        assert len(responses) == len(jobs)
+        assert len(responses) == len(jobs), (
+            f"[{self.name}] {len(responses)} responses for {len(jobs)} jobs")
         for job, resp in zip(jobs, responses):
             job.gen_entry["results"].append(self.score_job(job, resp))
-        self.metrics_trailer = self.metrics
+        self.metrics_trailer = self._final_metrics()
+        failed = sum(1 for r in responses if r == INFER_FAILED)
+        if failed:
+            # slots lost to the resilience sentinel (scored as wrong above):
+            # distinct from sandbox_errors, these are *model-side* losses
+            self.metrics_trailer["infer_failures"] = failed
         records.append(self.metrics_trailer)
         path = self.store.write(records, self.dataset)
         if self.progress:
@@ -377,7 +422,15 @@ class ProbeTask(TaskRunner):
                            sandbox, invocation, task_idx, gen_entry, jobs):
         args = self._resolve_args(space, self.data.inputs(idx)[pair["input_idx"]])
         _, states = sandbox.run(*args)
-        assert sandbox.status == "ok", f"{sandbox.status} running {entry} on DREval/{idx}"
+        if not self._tally_sandbox(sandbox.status):
+            # ground truth unavailable: skip this pair's probes (its
+            # gen_entry stays empty) and keep the run alive — the count
+            # lands in the metrics trailer as sandbox_errors
+            if self.progress:
+                print(f"[{self.name}] sandbox {sandbox.status!r} running "
+                      f"{entry} on DREval/{idx} — skipping "
+                      f"{len(pair['task'])} probes")
+            return
         for probe in pair["task"]:
             if self._skipped(self._probe_key(task_idx, pair["input_idx"], probe)):
                 continue
@@ -390,7 +443,13 @@ class ProbeTask(TaskRunner):
 
     def plan_class_pair(self, *, idx, pair, test_cls, code, codelines, _input,
                         setup, gen_entry, jobs):
-        states = self.run_class_sandbox(test_cls, self.sandbox_timeout)
+        states, status = self.run_class_sandbox(test_cls, self.sandbox_timeout)
+        if not self._tally_sandbox(status):
+            if self.progress:
+                print(f"[{self.name}] sandbox {status!r} tracing "
+                      f"{test_cls.__name__} on DREval/{idx} — skipping "
+                      f"{len(pair['task'])} probes")
+            return
         invocation = setup + "\n" + str(_input).rstrip()
         for probe in pair["task"]:
             # NOTE: ClassEval path prompts show un-numbered code (reference

@@ -8,6 +8,13 @@ reported as ``100 * score / total``.
 Output logs hold one record per input pair, so each verdict is expanded by
 that pair's probe count (taken from the coverage log) to align with the
 per-probe tasks.
+
+Degraded pairs: planning re-runs each pair's ground-truth sandbox per task,
+so a pair sitting near the sandbox timeout can skip (empty ``results``) in
+one task's log but not another's.  State/path flatten against the coverage
+log's per-pair probe counts — a count mismatch scores that pair wrong at
+coverage's count instead of desynchronising the ladder and crashing a
+finished fleet run at its final step.
 """
 
 from __future__ import annotations
@@ -44,10 +51,34 @@ class ConsistencyScorer:
                     verdicts.append(verdict)
         return verdicts
 
+    def _flatten_to_coverage(self, task: str, rule) -> list[bool]:
+        """Flatten a per-probe task's log aligned to the coverage log's
+        per-pair probe counts; a mismatched pair (its ground-truth sandbox
+        degraded in one task but not the other) scores wrong at coverage's
+        count rather than shifting every later verdict."""
+        verdicts = []
+        cov_rows = self.logs["coverage"]
+        for i, row in enumerate(self.logs[task][:-1]):
+            for j, gen in enumerate(row["generation"]):
+                expected = len(cov_rows[i]["generation"][j]["results"])
+                results = gen["results"]
+                if len(results) == expected:
+                    for atomic in results:
+                        verdict = rule(atomic)
+                        assert isinstance(verdict, bool)
+                        verdicts.append(verdict)
+                else:
+                    if self.progress:
+                        print(f"[consistency] {task} row {i} pair {j}: "
+                              f"{len(results)} results vs coverage's "
+                              f"{expected} — scoring pair as wrong")
+                    verdicts.extend([False] * expected)
+        return verdicts
+
     def run(self) -> float:
         coverage = self._flatten(self.logs["coverage"], lambda r: r["response"] == r["expected"])
-        state = self._flatten(self.logs["state"], lambda r: bool(r["eq"]))
-        path = self._flatten(self.logs["path"], lambda r: any(y in r["expected"] for y in r["response"]))
+        state = self._flatten_to_coverage("state", lambda r: bool(r["eq"]))
+        path = self._flatten_to_coverage("path", lambda r: any(y in r["expected"] for y in r["response"]))
         output: list[bool] = []
         coverage_rows = self.logs["coverage"]
         for i, row in enumerate(self.logs["output"][:-1]):

@@ -1,0 +1,662 @@
+"""Resilience layer: retry/backoff schedule (injected clock — no real
+sleeps), wait-for-server handshake, chaos determinism, batch bisection,
+crash-resumable fleet checkpoints, and the CLI chaos smoke target."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reval_tpu.fleet import FleetRunner
+from reval_tpu.inference.mock import MockBackend
+from reval_tpu.resilience import (
+    INFER_FAILED,
+    ChaosBackend,
+    FleetCheckpoint,
+    ResilientBackend,
+    RetryPolicy,
+    retryable_error,
+    wait_for_server,
+)
+
+
+def _no_sleep_policy(**kw):
+    kw.setdefault("jitter", 0.0)
+    return RetryPolicy(sleep=lambda s: None, **kw)
+
+
+class EchoBackend:
+    """Minimal infer_many backend for wrapper tests."""
+
+    info = "echo_model_direct_temp0.0"
+    prompt_type = "direct"
+
+    def __init__(self):
+        self.batches = []
+
+    def infer_many(self, prompts):
+        self.batches.append(list(prompts))
+        return [f"echo:{p}" for p in prompts]
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_exponential_no_jitter():
+    sleeps = []
+    policy = RetryPolicy(max_attempts=4, base_delay=1.0, multiplier=2.0,
+                         jitter=0.0, sleep=sleeps.append)
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 4:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert sleeps == [1.0, 2.0, 4.0]
+    assert attempts["n"] == 4
+
+
+def test_backoff_caps_at_max_delay_and_jitter_is_bounded():
+    import random
+
+    sleeps = []
+    policy = RetryPolicy(max_attempts=6, base_delay=1.0, multiplier=4.0,
+                         max_delay=5.0, jitter=0.5, sleep=sleeps.append,
+                         rng=random.Random(0))
+    with pytest.raises(TimeoutError):
+        policy.call(lambda: (_ for _ in ()).throw(TimeoutError("always")))
+    assert len(sleeps) == 5
+    for i, s in enumerate(sleeps):
+        base = min(1.0 * 4.0 ** i, 5.0)
+        assert base <= s <= base * 1.5
+    # seeded rng ⇒ the schedule itself is reproducible
+    sleeps2 = []
+    policy2 = RetryPolicy(max_attempts=6, base_delay=1.0, multiplier=4.0,
+                          max_delay=5.0, jitter=0.5, sleep=sleeps2.append,
+                          rng=random.Random(0))
+    with pytest.raises(TimeoutError):
+        policy2.call(lambda: (_ for _ in ()).throw(TimeoutError("always")))
+    assert sleeps2 == sleeps
+
+
+def test_non_retryable_raises_immediately():
+    policy = _no_sleep_policy(max_attempts=5)
+    attempts = {"n": 0}
+
+    def bad_request():
+        attempts["n"] += 1
+        raise ValueError("application bug")
+
+    with pytest.raises(ValueError):
+        policy.call(bad_request)
+    assert attempts["n"] == 1
+
+
+def test_attempts_override():
+    policy = _no_sleep_policy(max_attempts=5)
+    attempts = {"n": 0}
+
+    def always():
+        attempts["n"] += 1
+        raise TimeoutError("x")
+
+    with pytest.raises(TimeoutError):
+        policy.call(always, attempts=2)
+    assert attempts["n"] == 2
+
+
+def test_retryable_error_classification():
+    assert retryable_error(urllib.error.URLError("refused"))
+    assert retryable_error(TimeoutError())
+    assert retryable_error(socket.timeout())
+    assert retryable_error(ConnectionResetError())
+    assert retryable_error(json.JSONDecodeError("truncated", "{", 1))
+    assert retryable_error(urllib.error.HTTPError("u", 503, "busy", None, None))
+    assert retryable_error(urllib.error.HTTPError("u", 500, "ise", None, None))
+    assert not retryable_error(urllib.error.HTTPError("u", 400, "bad", None, None))
+    assert not retryable_error(urllib.error.HTTPError("u", 404, "nope", None, None))
+    assert not retryable_error(ValueError("bug"))
+
+
+# ---------------------------------------------------------------------------
+# wait_for_server
+# ---------------------------------------------------------------------------
+
+def test_wait_for_server_polls_until_up():
+    clock = {"t": 0.0}
+    probes = {"n": 0}
+
+    def probe():
+        probes["n"] += 1
+        if probes["n"] < 4:
+            raise urllib.error.URLError("connection refused")
+        return {"status": "ok"}
+
+    out = wait_for_server(probe, timeout=60.0, interval=0.5,
+                          clock=lambda: clock["t"],
+                          sleep=lambda s: clock.__setitem__("t", clock["t"] + s))
+    assert out == {"status": "ok"}
+    assert probes["n"] == 4
+
+
+def test_wait_for_server_http_error_means_up():
+    """An old server without /healthz answers 404 — that's still up."""
+    def probe():
+        raise urllib.error.HTTPError("u", 404, "no such route", None, None)
+
+    assert wait_for_server(probe, timeout=1.0, clock=lambda: 0.0,
+                           sleep=lambda s: None) is None
+
+
+def test_wait_for_server_times_out():
+    clock = {"t": 0.0}
+
+    def probe():
+        raise urllib.error.URLError("connection refused")
+
+    with pytest.raises(TimeoutError, match="not reachable"):
+        wait_for_server(probe, timeout=5.0, interval=1.0,
+                        clock=lambda: clock["t"],
+                        sleep=lambda s: clock.__setitem__("t", clock["t"] + s))
+
+
+# ---------------------------------------------------------------------------
+# ChaosBackend
+# ---------------------------------------------------------------------------
+
+def _chaos(seed, rate=0.5, **kw):
+    kw.setdefault("sleep", lambda s: None)
+    return ChaosBackend(EchoBackend(), rate=rate, seed=seed, **kw)
+
+
+def test_chaos_is_deterministic_under_a_fixed_seed():
+    prompts = [f"prompt-{i}" for i in range(24)]
+    runs = []
+    for _ in range(2):
+        chaos = _chaos(seed=7)
+        backend = ResilientBackend(chaos, policy=_no_sleep_policy(),
+                                   progress=False)
+        runs.append((backend.infer_many(prompts), list(chaos.injected)))
+    assert runs[0] == runs[1]
+    assert runs[0][1], "rate 0.5 over 24 prompts must inject something"
+
+
+def test_chaos_schedule_is_call_order_independent():
+    """However the caller slices the batch, each prompt's fault schedule
+    is the same — bisection can't change what gets injected."""
+    prompts = [f"p{i}" for i in range(8)]
+    per_prompt = {}
+    for p in prompts:
+        chaos = _chaos(seed=3)
+        per_prompt[p] = chaos._schedule(p)
+    chaos = _chaos(seed=3)
+    assert {p: chaos._schedule(p) for p in reversed(prompts)} == per_prompt
+
+
+def test_chaos_rearms_across_repeats():
+    """A successful serve re-arms the prompt's schedule: the fleet's later
+    repeats are still exercised, not silently chaos-free."""
+    chaos = _chaos(seed=11, rate=0.5)
+    backend = ResilientBackend(chaos, policy=_no_sleep_policy(), progress=False)
+    prompts = [f"r{i}" for i in range(12)]
+    backend.infer_many(prompts)
+    first = len(chaos.injected)
+    backend.infer_many(prompts)          # same prompts: repeat 2
+    assert first > 0
+    assert len(chaos.injected) > first, "repeat 2 must inject fresh faults"
+
+
+def test_chaos_faults_are_transient():
+    """Fault budgets are finite: enough bare retries always drain them."""
+    chaos = _chaos(seed=1, rate=0.6)
+    for prompt in (f"q{i}" for i in range(10)):
+        for _ in range(10):
+            try:
+                out = chaos.infer_many([prompt])
+                break
+            except Exception as exc:
+                assert retryable_error(exc)
+        assert out == [f"echo:{prompt}"]
+
+
+# ---------------------------------------------------------------------------
+# ResilientBackend: bisection
+# ---------------------------------------------------------------------------
+
+def test_bisection_isolates_a_permanently_poisoned_prompt():
+    class Poisoned(EchoBackend):
+        def infer_many(self, prompts):
+            if any(p == "BAD" for p in prompts):
+                raise TimeoutError("poisoned batch")
+            return super().infer_many(prompts)
+
+    prompts = [f"p{i}" for i in range(6)] + ["BAD"] + [f"p{i}" for i in range(6, 10)]
+    backend = ResilientBackend(Poisoned(), policy=_no_sleep_policy(),
+                               progress=False)
+    out = backend.infer_many(prompts)
+    assert len(out) == len(prompts)
+    for prompt, resp in zip(prompts, out):
+        assert resp == (INFER_FAILED if prompt == "BAD" else f"echo:{prompt}")
+    assert len(backend.failures) == 1
+    assert backend.failures[0]["prompt"] == "BAD"
+
+
+def test_zero_loss_under_transient_chaos():
+    prompts = [f"prompt-{i}" for i in range(40)]
+    chaos = _chaos(seed=11, rate=0.3)
+    backend = ResilientBackend(chaos, policy=_no_sleep_policy(), progress=False)
+    out = backend.infer_many(prompts)
+    assert out == [f"echo:{p}" for p in prompts]
+    assert backend.failures == []
+    assert chaos.injected, "rate 0.3 over 40 prompts must inject something"
+
+
+def test_short_response_list_is_a_contract_error_not_repaired():
+    class Short(EchoBackend):
+        def infer_many(self, prompts):
+            return ["only-one"]
+
+    backend = ResilientBackend(Short(), policy=_no_sleep_policy(), progress=False)
+    with pytest.raises(RuntimeError, match="contract violation"):
+        backend.infer_many(["a", "b", "c"])
+
+
+def test_systemic_failure_aborts_instead_of_sentineling_everything():
+    """A deterministic error hitting every prompt (server upgrade broke the
+    protocol) is a systemic failure: abort with the real error instead of
+    'completing' with a log full of sentinels."""
+    class Broken(EchoBackend):
+        def infer_many(self, prompts):
+            raise urllib.error.HTTPError("u", 400, "bad request", None, None)
+
+    backend = ResilientBackend(Broken(), policy=_no_sleep_policy(), progress=False)
+    with pytest.raises(RuntimeError, match="systemic"):
+        backend.infer_many([f"p{i}" for i in range(10)])
+
+
+def test_wrapper_composes_with_inner_retry_instead_of_multiplying():
+    """Wrapping a backend that already retries per request (HTTPClientBackend)
+    must not nest the schedules: the wrapper drops to one attempt per level
+    and keeps only the bisection."""
+    from reval_tpu.inference.client import HTTPClientBackend
+
+    client = HTTPClientBackend(model_id="m", mock=True, temp=0.0,
+                               prompt_type="direct")
+    backend = ResilientBackend(client, progress=False)
+    assert backend.policy.max_attempts == 1
+    assert backend.batch_attempts == 1
+
+
+def test_chaos_between_wrapper_and_client_keeps_full_budget():
+    """Chaos faults fire above the HTTP client's retry loop, so the
+    client's own policy must not collapse the wrapper's budget — only a
+    DIRECT client wrap composes down to one attempt."""
+    from reval_tpu.inference.client import HTTPClientBackend
+
+    client = HTTPClientBackend(model_id="m", mock=True, temp=0.0,
+                               prompt_type="direct")
+    chaos = ChaosBackend(client, rate=0.5, seed=2, sleep=lambda s: None)
+    backend = ResilientBackend(chaos, progress=False)
+    assert backend.policy.max_attempts > chaos.max_faults_per_prompt
+
+
+def test_class_sandbox_setup_failure_degrades():
+    from reval_tpu.tasks.base import TaskRunner
+
+    class Boom:
+        def setUp(self):
+            raise OSError("missing fixture file")
+
+    states, status = TaskRunner.run_class_sandbox(Boom, timeout=5)
+    assert states is None
+    assert status.startswith("exception")
+
+
+def test_wrapper_delegates_identity():
+    inner = MockBackend(prompt_type="direct")
+    backend = ResilientBackend(inner, policy=_no_sleep_policy(), progress=False)
+    assert backend.info == inner.info
+    assert backend.prompt_type == "direct"
+    assert backend.infer_one("x") == "mock_model_gen"
+    backend.close()
+
+
+# ---------------------------------------------------------------------------
+# FleetCheckpoint
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_and_identity_filter(tmp_path):
+    ident = {"model_info": "m_direct", "dataset": "humaneval",
+             "prompt_type": "direct"}
+    ckpt = FleetCheckpoint(str(tmp_path), ident)
+    assert ckpt.load() == 0
+    ckpt.record(0, "coverage", {"acc": 1.0})
+    ckpt.record(0, "path", {"acc": 0.5})
+    fresh = FleetCheckpoint(str(tmp_path), ident)
+    assert fresh.load() == 2
+    assert fresh.done(0, "coverage") is not None
+    assert fresh.done(0, "coverage")["metrics"] == {"acc": 1.0}
+    assert fresh.done(1, "coverage") is None
+    # a different run identity must not inherit these chunks
+    other = FleetCheckpoint(str(tmp_path), {**ident, "prompt_type": "cot"})
+    assert other.load() == 0
+    # torn trailing line (crash mid-append) is skipped, not fatal
+    with open(ckpt.path, "a") as f:
+        f.write('{"model_info": "m_direct", "trunc')
+    assert FleetCheckpoint(str(tmp_path), ident).load() == 2
+    # reset wipes the journal for non-resume runs
+    ckpt.reset()
+    assert not os.path.exists(ckpt.path)
+    assert FleetCheckpoint(str(tmp_path), ident).load() == 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet integration: misalignment guard, chaos fleet, crash + resume
+# ---------------------------------------------------------------------------
+
+def _read_task_logs(results_dir, task):
+    d = os.path.join(results_dir, f"{task}@mock_model_direct")
+    paths = sorted((os.path.join(d, f) for f in os.listdir(d)),
+                   key=os.path.getctime)
+    return [open(p).read() for p in paths]
+
+
+def test_fleet_rejects_misaligned_responses_with_task_attribution(tmp_path):
+    class Short(EchoBackend):
+        def infer_many(self, prompts):
+            return ["[ANSWER]x[/ANSWER]"] * (len(prompts) - 1)
+
+    fleet = FleetRunner(dataset="humaneval", repeats=1, backend=Short(),
+                        results_dir=str(tmp_path), progress=False,
+                        run_consistency=False, max_items=2, resilience=False)
+    with pytest.raises(RuntimeError, match="refusing to mis-align"):
+        fleet.run()
+
+
+def test_fleet_completes_under_chaos_with_zero_lost_prompts(tmp_path):
+    """The acceptance scenario: 30% transient faults, all repeats finish,
+    metrics identical to a chaos-free mock fleet."""
+    chaos = ChaosBackend(MockBackend(prompt_type="direct"), rate=0.3, seed=5,
+                         sleep=lambda s: None)
+    fleet = FleetRunner(dataset="humaneval", repeats=2, backend=chaos,
+                        mock=True, results_dir=str(tmp_path / "chaos"),
+                        progress=False, max_items=2,
+                        retry_policy=_no_sleep_policy())
+    result = fleet.run()
+    assert len(result["repeats"]) == 2
+    assert "lost_prompts" not in result
+    assert chaos.injected, "chaos at 0.3 must actually inject faults"
+    clean = FleetRunner(dataset="humaneval", repeats=2, mock=True,
+                        results_dir=str(tmp_path / "clean"), progress=False,
+                        max_items=2)
+    assert result["repeats"] == clean.run()["repeats"]
+
+
+def test_fleet_crash_then_resume_reproduces_identical_logs(tmp_path, monkeypatch):
+    from reval_tpu.tasks.base import TaskRunner
+
+    kwargs = dict(dataset="humaneval", repeats=2, mock=True, progress=False,
+                  run_consistency=False, max_items=2)
+
+    # uninterrupted reference run
+    FleetRunner(results_dir=str(tmp_path / "ref"), **kwargs).run()
+
+    # crash mid-repeat-0, after two of four tasks have scored
+    orig = TaskRunner.score_and_write
+    scored = {"n": 0}
+
+    def crashing(self, records, jobs, responses):
+        if scored["n"] == 2:
+            raise RuntimeError("simulated mid-repeat crash")
+        scored["n"] += 1
+        return orig(self, records, jobs, responses)
+
+    monkeypatch.setattr(TaskRunner, "score_and_write", crashing)
+    with pytest.raises(RuntimeError, match="simulated"):
+        FleetRunner(results_dir=str(tmp_path / "res"), **kwargs).run()
+    monkeypatch.setattr(TaskRunner, "score_and_write", orig)
+
+    ckpt_path = tmp_path / "res" / FleetCheckpoint.FILENAME
+    assert ckpt_path.exists()
+    assert len(ckpt_path.read_text().splitlines()) == 2  # two chunks survived
+
+    result = FleetRunner(results_dir=str(tmp_path / "res"), resume=True,
+                         **kwargs).run()
+    assert len(result["repeats"]) == 2
+    for task in ("coverage", "path", "state", "output"):
+        ref_logs = _read_task_logs(str(tmp_path / "ref"), task)
+        res_logs = _read_task_logs(str(tmp_path / "res"), task)
+        assert len(res_logs) == 2, task
+        assert sorted(res_logs) == sorted(ref_logs), task
+
+    # resuming a *finished* run is a no-op: no new logs appear
+    again = FleetRunner(results_dir=str(tmp_path / "res"), resume=True,
+                        **kwargs).run()
+    assert len(again["repeats"]) == 2
+    for task in ("coverage", "path", "state", "output"):
+        assert len(_read_task_logs(str(tmp_path / "res"), task)) == 2, task
+
+
+def test_resume_ignores_journal_from_a_different_slice(tmp_path, monkeypatch):
+    """A journal written with max_items=1 must not satisfy a max_items=2
+    resume — mixed-shape logs would crash or corrupt the consistency step."""
+    base = dict(dataset="humaneval", repeats=1, mock=True, progress=False,
+                run_consistency=False, results_dir=str(tmp_path))
+    FleetRunner(max_items=1, **base).run()
+    result = FleetRunner(max_items=2, resume=True, **base).run()
+    assert len(result["repeats"]) == 1
+    for task in ("coverage", "path", "state", "output"):
+        # identity mismatch → chunk re-ran → a second log exists
+        assert len(_read_task_logs(str(tmp_path), task)) == 2, task
+
+
+# ---------------------------------------------------------------------------
+# Sandbox status accounting (ground-truth failures degrade, not crash)
+# ---------------------------------------------------------------------------
+
+def test_sandbox_timeout_degrades_and_is_counted(tmp_path, monkeypatch):
+    """A *partial* sandbox failure (near-timeout jitter) skips those pairs
+    and surfaces the count — the run keeps going."""
+    from reval_tpu.dynamics.sandbox import Sandbox
+    from reval_tpu.dynamics.states import ExecutionTrace
+    from reval_tpu.tasks import TASKS
+
+    orig_run = Sandbox.run
+    calls = {"n": 0}
+
+    def flaky_run(self, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] % 2 == 0:
+            self.status = "timed out"
+            return None, ExecutionTrace()
+        return orig_run(self, *args, **kwargs)
+
+    monkeypatch.setattr(Sandbox, "run", flaky_run)
+    task = TASKS["coverage"](prompt_type="direct", dataset="humaneval",
+                             mock=True, progress=False, max_items=2,
+                             results_dir=str(tmp_path))
+    metrics = task.run()     # must complete, not assert
+    assert task.sandbox_stats["timed out"] >= 1
+    assert task.sandbox_stats["ok"] >= 1
+    assert metrics["sandbox_errors"]["timed_out"] == task.sandbox_stats["timed out"]
+    assert metrics["total"] > 0              # surviving pairs still scored
+
+
+def test_all_sandboxes_failing_is_fatal(tmp_path, monkeypatch):
+    """Every pair failing is a broken host/config, not degradation —
+    refuse to score (and journal) an empty run."""
+    from reval_tpu.dynamics.sandbox import Sandbox
+    from reval_tpu.dynamics.states import ExecutionTrace
+    from reval_tpu.tasks import TASKS
+
+    def timed_out_run(self, *args, **kwargs):
+        self.status = "timed out"
+        return None, ExecutionTrace()
+
+    monkeypatch.setattr(Sandbox, "run", timed_out_run)
+    task = TASKS["coverage"](prompt_type="direct", dataset="humaneval",
+                             mock=True, progress=False, max_items=1,
+                             results_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="all .* pairs"):
+        task.run()
+
+
+def test_sandbox_stats_absent_on_clean_runs(tmp_path):
+    from reval_tpu.tasks import TASKS
+
+    task = TASKS["coverage"](prompt_type="direct", dataset="humaneval",
+                             mock=True, progress=False, max_items=1,
+                             results_dir=str(tmp_path))
+    metrics = task.run()
+    assert "sandbox_errors" not in metrics   # reference trailer unchanged
+    assert task.sandbox_stats["ok"] > 0
+    assert task.sandbox_stats["timed out"] == 0
+
+
+def test_consistency_tolerates_degraded_pairs():
+    """A pair whose sandbox degraded in one task's planning but not
+    another's (near-timeout jitter) must score wrong, not desynchronise
+    the ladder and crash a finished fleet at its final step."""
+    from reval_tpu.tasks.consistency import ConsistencyScorer
+
+    scorer = object.__new__(ConsistencyScorer)
+    scorer.progress = False
+    trailer = {"acc": 0.0}
+
+    def rows(atomics):
+        return [{"generation": [{"results": atomics}]}, trailer]
+
+    scorer.logs = {
+        "coverage": rows([{"response": True, "expected": True}] * 2),
+        "state": rows([]),                       # degraded: sandbox skipped
+        "path": rows([{"response": [3], "expected": [7]}] * 2),
+        "output": rows([{"pass": False}]),
+    }
+    # each aligned case: c=True, s=False (degraded), p=False, o=False → 0.125
+    assert scorer.run() == 12.5
+
+
+def test_infer_failures_surface_in_trailer(tmp_path):
+    from reval_tpu.tasks import TASKS
+
+    class Sentinel(EchoBackend):
+        info = "mock_model_direct"
+
+        def infer_many(self, prompts):
+            out = ["[ANSWER]YES[/ANSWER]"] * len(prompts)
+            out[0] = INFER_FAILED
+            return out
+
+    task = TASKS["coverage"](model=Sentinel(), prompt_type="direct",
+                             dataset="humaneval", mock=True, progress=False,
+                             max_items=1, results_dir=str(tmp_path))
+    metrics = task.run()
+    assert metrics["infer_failures"] == 1
+    assert metrics["total"] > 0              # the slot still scored (wrong)
+
+
+# ---------------------------------------------------------------------------
+# Server handshake over real HTTP
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_healthz_route():
+    from reval_tpu.serving import EngineServer
+
+    srv = EngineServer(lambda prompts, **kw: list(prompts), model_id="hm",
+                       port=0).start()
+    try:
+        for route in ("/healthz", "/v1/healthz"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{route}", timeout=10) as resp:
+                assert resp.status == 200
+                assert json.load(resp) == {"status": "ok", "model": "hm"}
+    finally:
+        srv.shutdown()
+
+
+def test_client_constructed_before_server_waits_for_handshake():
+    """The launcher race: client first, server seconds later — the client
+    must block on the handshake instead of dying with URLError."""
+    from reval_tpu.inference.client import HTTPClientBackend
+    from reval_tpu.serving import EngineServer
+
+    port = _free_port()
+    started = []
+
+    def boot():
+        time.sleep(0.3)
+        srv = EngineServer(lambda prompts, **kw: ["late"] * len(prompts),
+                           model_id="late-model", port=port).start()
+        started.append(srv)
+
+    threading.Thread(target=boot, daemon=True).start()
+    try:
+        client = HTTPClientBackend(model_id="local", port=port, temp=0.0,
+                                   prompt_type="direct", wait_for_server_s=15)
+        assert client._server_model == "late-model"
+        assert client.infer_one("hi") == "late"
+    finally:
+        for srv in started:
+            srv.shutdown()
+
+
+def test_client_gives_up_when_no_server_appears():
+    from reval_tpu.inference.client import HTTPClientBackend
+
+    port = _free_port()
+    with pytest.raises(TimeoutError, match="not reachable"):
+        HTTPClientBackend(model_id="m", port=port, temp=0.0,
+                          prompt_type="direct", wait_for_server_s=0.2)
+
+
+# ---------------------------------------------------------------------------
+# CLI chaos smoke target (the tier-1 regression canary for this layer)
+# ---------------------------------------------------------------------------
+
+def test_chaos_rejects_multihost_global(capsys):
+    """No retry layer can wrap pod-collective inference, so injected
+    faults would abort the pod unretried — the CLI refuses up front."""
+    from reval_tpu.cli import main
+
+    assert main(["fleet", "--mock", "--chaos", "0.3",
+                 "--multihost", "global"]) == 1
+    assert "incompatible" in capsys.readouterr().out
+
+
+def test_chaos_smoke_cli(tmp_path, capsys):
+    from reval_tpu.cli import main
+
+    argv = ["fleet", "--mock", "--chaos", "0.3", "--resume",
+            "--max-items", "1", "--repeats", "2",
+            "--set", f"results_dir={tmp_path}",
+            "--set", 'retry={"base_delay": 0.001, "jitter": 0.0}',
+            "--set", "progress=false"]
+    assert main(list(argv)) == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["lost_prompts"] == 0
+    assert summary["consistency"] is not None
+    ckpt = tmp_path / FleetCheckpoint.FILENAME
+    assert ckpt.exists()
+    assert len(ckpt.read_text().splitlines()) == 8   # 2 repeats × 4 tasks
+
+    # second invocation resumes a finished run: no chunk re-runs, no new logs
+    assert main(list(argv)) == 0
+    for task in ("coverage", "path", "state", "output"):
+        d = tmp_path / f"{task}@mock_model_direct"
+        assert len(list(d.iterdir())) == 2, task
