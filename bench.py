@@ -549,6 +549,11 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
         eng.generate(prompts, max_new_tokens=max_new,
                      temperature=0.0, stop=["[/ANSWER]"])
         warmup_wall = time.perf_counter() - t0
+        # the warmup pass is the COLD prefix-cache pass (fresh engine):
+        # its prefill_tokens against the warm timed pass's measures the
+        # cross-call prefill collapse directly, with compiles excluded
+        # from both token counts
+        cold_prefill_tokens = eng.stats.prefill_tokens
         eng.stats = EngineStats()
         note(f"  paged timed pass (warmup took {warmup_wall:.1f}s)")
         phase.update(name="timed-pass", t0=time.perf_counter(),
@@ -587,8 +592,24 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     assert len(outs) == len(prompts)
     stats = eng.stats
     stats.warmup_wall = warmup_wall
+    prefix_cache = None
+    if prefix_sharing and eng.prefix_cache is not None:
+        # the timed pass ran against the warm cache: its counters ARE the
+        # steady-state fleet-repeat numbers
+        prefix_cache = {
+            "hit_tokens": stats.prefix_hit_tokens,
+            "hit_rate": round(stats.prefix_hit_rate, 4),
+            "evictions": stats.prefix_evictions,
+            "inserted_pages": stats.prefix_inserted_pages,
+            "cold_prefill_tokens": cold_prefill_tokens,
+            "warm_prefill_tokens": stats.prefill_tokens,
+            "warm_prefill_reduction": round(
+                1 - stats.prefill_tokens / cold_prefill_tokens, 4)
+            if cold_prefill_tokens else 0.0,
+            **eng.prefix_cache.counters(),
+        }
     eng.close()
-    return wall, stats
+    return wall, stats, prefix_cache
 
 
 def run_serial(params, cfg, tok, prompts, max_new, *, max_seq_len=4096):
@@ -627,6 +648,11 @@ def main() -> None:
                     help="skip the serial baseline (quick iteration)")
     ap.add_argument("--skip-ab", action="store_true",
                     help="skip the prefix-sharing off run")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable the persistent radix prefix cache for "
+                         "the headline run (A/B candidate pinning); the "
+                         "default run measures cache-on and emits the "
+                         "cache-off comparison as its A/B row")
     ap.add_argument("--slots", type=int, default=None,
                     help="paged-engine decode slots (batch width); default "
                          "32 direct / 24 cot (the cot pool needs the HBM)")
@@ -735,6 +761,11 @@ def main() -> None:
 
         if args.tiny:
             jax.config.update("jax_platforms", "cpu")
+            # a CPU smoke of the harness must not inherit the CHIP's
+            # autotuned kernel choice (tpu_watch/autotune.json may pin a
+            # Pallas kernel this host's jax can only interpret — or not
+            # even build); the XLA path is the CPU backend by design
+            os.environ.setdefault("REVAL_TPU_PAGED_BACKEND", "xla")
         jax.config.update("jax_compilation_cache_dir",
                           os.path.expanduser("~/.cache/reval_tpu_xla"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -784,11 +815,12 @@ def main() -> None:
         progress = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "tpu_watch", "bench_inflight.json")
         os.makedirs(os.path.dirname(progress), exist_ok=True)
-        wall, stats = run_paged(params, cfg, tok, prompts, max_new,
-                                prefix_sharing=True, max_slots=args.slots,
-                                max_seq_len=args.max_seq_len,
-                                num_pages=num_pages, kv_dtype=args.kv_dtype,
-                                progress_path=progress)
+        wall, stats, cache_row = run_paged(
+            params, cfg, tok, prompts, max_new,
+            prefix_sharing=not args.no_prefix_cache, max_slots=args.slots,
+            max_seq_len=args.max_seq_len,
+            num_pages=num_pages, kv_dtype=args.kv_dtype,
+            progress_path=progress)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -837,6 +869,8 @@ def main() -> None:
             "pipelined_chunks": getattr(stats, "pipelined_chunks", 0),
             "patched_tables": getattr(stats, "patched_tables", 0),
         }
+        if cache_row is not None:
+            extras["prefix_cache"] = cache_row
 
         # The headline number is already measured; the A/B and serial
         # phases are garnish.  Persist it to disk NOW: a wedge in a
@@ -863,19 +897,26 @@ def main() -> None:
         # A garnish-phase exception must NOT discard the real value into
         # fail()'s last_known path — record the phase error and emit what
         # was measured.
-        if not args.skip_ab:
-            note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); prefix-sharing A/B')
+        if not args.skip_ab and not args.no_prefix_cache:
+            note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); '
+                 'prefix-cache-off A/B')
             try:
-                wall_nopre, _ = run_paged(params, cfg, tok, prompts, max_new,
-                                          prefix_sharing=False,
-                                          max_slots=args.slots,
-                                          max_seq_len=args.max_seq_len,
-                                          num_pages=num_pages,
-                                          kv_dtype=args.kv_dtype)
+                wall_nopre, _, _ = run_paged(params, cfg, tok, prompts,
+                                             max_new,
+                                             prefix_sharing=False,
+                                             max_slots=args.slots,
+                                             max_seq_len=args.max_seq_len,
+                                             num_pages=num_pages,
+                                             kv_dtype=args.kv_dtype)
+                # legacy key (sharing and the cache are one mechanism now)
                 extras["prefix_sharing_speedup"] = round(wall_nopre / wall, 3)
+                # the --no-prefix-cache A/B row: what this exact run would
+                # have measured with the cache disabled
+                extras["no_prefix_cache_speedup"] = round(
+                    wall_nopre / wall, 3)
             except Exception as e:
                 extras["ab_error"] = type(e).__name__
-                note(f'prefix-sharing A/B failed ({type(e).__name__}); '
+                note(f'prefix-cache A/B failed ({type(e).__name__}); '
                      'keeping the measured headline')
 
         vs_baseline = 0.0

@@ -11,7 +11,12 @@ one process.  Per repeat, the fleet
 2. when the tasks share one backend, concatenates every prompt into a
    single ``infer_many`` call — the engine length-buckets and batches
    across task boundaries, keeping the chips saturated where four
-   processes would each trickle single prompts;
+   processes would each trickle single prompts.  The fused batch stays
+   **task-contiguous** (all of one task's prompts, then the next's): the
+   four tasks use four different few-shot templates, so a global batch
+   LCP is ≈ 0 — per-task grouping is what feeds the engine's radix
+   prefix cache one template run at a time, and repeats 2..N then hit
+   the cached template pages outright;
 3. scores and writes each task's log (the per-task JSONL contract is
    unchanged), then runs the consistency scorer over the latest logs.
 
@@ -107,6 +112,10 @@ class FleetRunner:
         shared = self.backend is not None and all(
             t.backend is self.backend for t in tasks)
         if shared:
+            # task-major order is load-bearing, not incidental: each task's
+            # prompts share one few-shot template, and grouping them keeps
+            # the radix prefix cache's insert-then-hit sequence per
+            # template (tests/test_prefix_cache.py pins the sharing)
             all_jobs = [(task, job) for task, _, jobs in planned for job in jobs]
             if self.progress:
                 print(f"[fleet] {len(all_jobs)} prompts across "
@@ -205,4 +214,29 @@ class FleetRunner:
                                        results_dir=self.results_dir,
                                        progress=self.progress)
             result["consistency"] = scorer.run()
+        trailer = self._prefix_cache_trailer()
+        if trailer:
+            result["prefix_cache"] = trailer
+            if self.progress:
+                print(f"[fleet] prefix cache: {trailer}")
         return result
+
+    def _prefix_cache_trailer(self) -> dict | None:
+        """Engine prefix-cache counters for the run summary, when the
+        backend exposes a TPU engine (ResilientBackend delegates attribute
+        access to the wrapped backend).  Repeats 2..N riding repeat 1's
+        cached templates show up here as hit_rate ≈ the template share."""
+        engine = getattr(self.backend, "engine", None)
+        stats = getattr(engine, "stats", None)
+        if stats is None or not getattr(stats, "prefix_lookup_tokens", 0):
+            return None
+        trailer = {
+            "hit_tokens": stats.prefix_hit_tokens,
+            "hit_rate": round(stats.prefix_hit_rate, 4),
+            "evictions": stats.prefix_evictions,
+            "inserted_pages": stats.prefix_inserted_pages,
+        }
+        gauges = getattr(engine, "prefix_cache_counters", None)
+        if callable(gauges):
+            trailer.update(gauges())
+        return trailer

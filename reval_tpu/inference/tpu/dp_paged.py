@@ -23,10 +23,11 @@ fan-out shape: many 2-token "[ANSWER] NO" rows) immediately takes work a
 busier replica would otherwise serialise.  Imbalance is bounded by one
 request's runtime instead of the worst static shard.
 
-Prefix sharing still applies: the page-aligned common prefix of the WHOLE
-call is reserved and prefilled once per replica, and every pulled prompt
-rides it via ``submit_prefixed`` (valid for any subset of the prompts,
-since the LCP of the full set prefixes each of them).
+Prefix reuse: each replica owns a persistent radix prefix cache (the page
+pool is per-replica state, so cached KV cannot cross replicas).  Every
+pulled prompt rides its replica's cache via ``submit_request`` — the
+first pull of a template prefills it once per replica, later pulls (and
+later CALLS: fleet repeats, serve traffic) hit the cached pages.
 """
 
 from __future__ import annotations
@@ -110,7 +111,22 @@ class DataParallelPagedEngine:
             agg.decode_steps += s.decode_steps
             agg.pipelined_chunks += s.pipelined_chunks
             agg.patched_tables += s.patched_tables
+            agg.prefix_hit_tokens += s.prefix_hit_tokens
+            agg.prefix_lookup_tokens += s.prefix_lookup_tokens
+            agg.prefix_inserted_pages += s.prefix_inserted_pages
+            agg.prefix_evictions += s.prefix_evictions
         return agg
+
+    def prefix_cache_counters(self) -> dict:
+        """Prefix-cache gauge snapshot summed over replicas (counters ride
+        the aggregated ``stats``)."""
+        out: dict = {}
+        for rep in self.replicas:
+            if rep.prefix_cache is None:
+                continue
+            for k, v in rep.prefix_cache.counters().items():
+                out[k] = out.get(k, 0) + v
+        return out
 
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0,
@@ -143,8 +159,6 @@ class DataParallelPagedEngine:
                     self.tokenizer, req.generated, _stop))
 
         def run_replica(eng: PagedTPUEngine) -> None:
-            prefix_id = None
-            reserved = False
             reqs: dict[int, _Request] = {}
             st = eng.new_drive_state()
             try:
@@ -153,24 +167,18 @@ class DataParallelPagedEngine:
                     with lock:
                         while work and len(reqs) + len(pulled) < eng.max_slots:
                             pulled.append(work.popleft())
-                    if pulled and self.prefix_sharing and not reserved:
-                        # lazy: a replica that never wins any work never
-                        # pays the prefix prefill or holds its pages
-                        prefix_id = eng._reserve_shared_prefix(encoded)
-                        reserved = True
                     for i in pulled:
                         ids = encoded[i]
-                        if prefix_id is not None:
-                            seq = eng.rt.submit_prefixed(
-                                prefix_id, len(ids), max_new_tokens)
-                        else:
-                            seq = eng.rt.submit(len(ids), max_new_tokens)
+                        # the replica's persistent radix cache: the first
+                        # pull of a template prefills + caches it, every
+                        # later pull (this call or the next) rides it
+                        seq, node = eng.submit_request(ids, max_new_tokens)
                         reqs[seq] = _Request(
                             index=i, ids=ids, max_new=max_new_tokens,
                             scanner=StopScanner(eng.tokenizer, stop),
                             temp=float(temperature),
                             top_k=int(top_k), top_p=float(top_p),
-                            notify=notify, key=keys[i])
+                            notify=notify, key=keys[i], node=node)
                     if not reqs:
                         break
                     eng._drive_tick(reqs, st)
@@ -184,10 +192,8 @@ class DataParallelPagedEngine:
             except Exception:
                 for seq, req in reqs.items():
                     if not req.done:    # done seqs were released by _retire
-                        eng.rt.release(seq)
+                        eng.release_request(seq, req)
                 raise
-            finally:
-                eng._release_shared_prefix(prefix_id)
 
         futures = [self._pool.submit(run_replica, eng)
                    for eng in self.replicas]

@@ -174,6 +174,16 @@ class EngineStats:
     patched_tables: int = 0      # in-place device table patches — chunks
                                  # whose page crossings (one or more
                                  # slots) were absorbed without a flush
+    # persistent radix prefix cache (paged engine; prefix_cache.py):
+    prefix_hit_tokens: int = 0      # prompt tokens served from cached KV
+    prefix_lookup_tokens: int = 0   # prompt tokens that consulted the cache
+    prefix_inserted_pages: int = 0  # pages prefilled into the cache
+    prefix_evictions: int = 0       # LRU nodes evicted under pool pressure
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        return (self.prefix_hit_tokens / self.prefix_lookup_tokens
+                if self.prefix_lookup_tokens else 0.0)
 
 
 class TPUEngine:

@@ -1,0 +1,264 @@
+"""Persistent radix prefix cache over the paged KV pool.
+
+vLLM-style automatic prefix caching for the paged engine: every
+page-aligned prompt prefix the engine prefills is registered in a radix
+tree keyed by token ids, backed by REF-COUNTED pages in the native pool
+(runtime/native/runtime.cpp), and SURVIVES across ``generate()`` calls and
+engine entry points.  A later prompt — same call, next fleet repeat, or an
+unrelated HTTP request — walks the tree for its longest cached page-aligned
+prefix and prefills only the uncovered suffix.  This replaces the old
+whole-batch-LCP reservation that was torn down inside each call
+(``_reserve_shared_prefix``): multiple distinct prefixes now live per
+batch (fused multi-task fleet batches hit per-template nodes), and
+single-prompt serve-mode requests share too.
+
+Structure: one node per POOL PAGE (``page_size`` tokens), children keyed by
+the next page's token tuple — a radix tree whose edge labels are all the
+same length, i.e. a page-granular trie, matching the only reuse unit the
+pool has.  Each node owns a native *prefix object* that refcounts the
+whole root→node page chain (``alloc_prefix`` / ``alloc_prefix_extend``),
+so riders attach the full chain with one ``submit_prefixed`` and releasing
+a leaf frees exactly its own page.
+
+Memory policy: insertion is best-effort behind a free-page WATERMARK
+(decode admission headroom — cached-but-idle prefixes must never starve
+running sequences), and LRU eviction of rider-free leaves runs on demand:
+before an insert that would cross the watermark, before preempting a
+running sequence on pool exhaustion, and before declaring admission
+deadlocked.  Nodes whose prefix an in-flight request rides are pinned
+(``riders``) for the request's whole lifetime — a preempted rider keeps
+its node alive so re-admission can re-attach the pages.
+
+Single-owner, like the runtime it wraps: one engine drives one cache from
+one thread (the dp engine builds one cache per replica).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["RadixPrefixCache", "PrefixNode"]
+
+
+@dataclass
+class PrefixNode:
+    """One cached page: ``key`` is this page's token ids (``page_size``
+    of them); ``prefix_id`` the native prefix object holding the whole
+    root→here chain by refcount."""
+
+    key: tuple
+    prefix_id: int
+    tok_len: int                       # tokens covered root→here inclusive
+    parent: "PrefixNode | None" = None
+    children: dict = field(default_factory=dict)
+    riders: int = 0                    # in-flight requests riding this node
+    tick: int = 0                      # LRU stamp (larger = fresher)
+
+    @property
+    def depth_pages(self) -> int:
+        return self.tok_len // len(self.key) if self.key else 0
+
+
+class RadixPrefixCache:
+    """See module docstring.  ``stats`` is a zero-arg callable returning
+    the engine's live :class:`EngineStats` (engines replace their stats
+    object wholesale between bench passes, so the cache must re-resolve
+    it per update rather than hold a reference)."""
+
+    def __init__(self, rt, page_size: int, *, watermark: int = 0,
+                 stats=None):
+        self.rt = rt
+        self.page = page_size
+        self.watermark = watermark
+        self._stats = stats if stats is not None else lambda: None
+        self.children: dict = {}       # root level: first page tuple → node
+        self._tick = 0
+        self.nodes = 0
+        self.cached_pages = 0
+
+    # -- lookup / insertion ------------------------------------------------
+    def match_len(self, ids) -> int:
+        """Tokens of ``ids`` covered by cached pages (pure query — no
+        stats, no pinning, no insertion).  Capped one token short of the
+        prompt so a full hit still leaves the rider its own first token."""
+        node = self._walk(ids)
+        return node.tok_len if node is not None else 0
+
+    def _walk(self, ids):
+        cap = max(0, (len(ids) - 1)) // self.page
+        node, children = None, self.children
+        for i in range(cap):
+            key = tuple(ids[i * self.page:(i + 1) * self.page])
+            nxt = children.get(key)
+            if nxt is None:
+                break
+            node, children = nxt, nxt.children
+        return node
+
+    def acquire(self, ids) -> tuple[PrefixNode | None, int]:
+        """Match + extend the tree for one prompt; pin and return the node
+        the request should ride.
+
+        Returns ``(node, new_from)``: ``node`` is the deepest cached node
+        covering ``ids`` (pinned — pair with :meth:`unpin` when the
+        request finishes), and ``new_from`` the token offset its newly
+        inserted pages start at (== ``node.tok_len`` when nothing new was
+        inserted).  The CALLER must prefill+commit tokens
+        ``[new_from, node.tok_len)`` into the new pages before any rider's
+        suffix prefill or decode touches them — within the engine this is
+        synchronous, so ordering holds by construction.
+
+        Insertion covers every full page of ``ids[:-1]`` that fits behind
+        the free-page watermark (evicting LRU rider-free leaves first);
+        under pressure the prefix is cached partially or not at all —
+        sharing then degrades gracefully instead of starving decode.
+        """
+        stats = self._stats()
+        if stats is not None:
+            stats.prefix_lookup_tokens += len(ids)
+        matched = self._walk(ids)
+        if matched is not None:
+            if stats is not None:
+                stats.prefix_hit_tokens += matched.tok_len
+            self._touch(matched)
+        cap = max(0, (len(ids) - 1)) // self.page
+        node = matched
+        start = node.tok_len // self.page if node is not None else 0
+        new_from = start * self.page
+        # the pin travels with the chain head as it grows: _make_room's
+        # eviction below must never reap the very node we are extending
+        # (a fresh leaf is rider-free until this pin reaches it)
+        if node is not None:
+            node.riders += 1
+        for i in range(start, cap):
+            if not self._make_room(1):
+                break
+            key = tuple(ids[i * self.page:(i + 1) * self.page])
+            try:
+                if node is None:
+                    prefix_id = self.rt.alloc_prefix(1)
+                else:
+                    prefix_id = self.rt.alloc_prefix_extend(node.prefix_id, 1)
+            except ValueError:
+                break                    # pool raced us below the watermark
+            child = PrefixNode(key=key, prefix_id=prefix_id,
+                               tok_len=(i + 1) * self.page, parent=node,
+                               riders=1)
+            (self.children if node is None else node.children)[key] = child
+            if node is not None:
+                node.riders -= 1         # hand the pin to the child
+            node = child
+            self.nodes += 1
+            self.cached_pages += 1
+            if stats is not None:
+                stats.prefix_inserted_pages += 1
+            self._touch(node)
+        return (node, new_from) if node is not None else (None, 0)
+
+    def unpin(self, node: PrefixNode) -> None:
+        assert node.riders > 0, "unpin without a matching acquire"
+        node.riders -= 1
+
+    # -- eviction ----------------------------------------------------------
+    def _touch(self, node: PrefixNode) -> None:
+        """Freshen the whole root→node chain: an ancestor is at least as
+        recently useful as the freshest path through it."""
+        self._tick += 1
+        while node is not None:
+            node.tick = self._tick
+            node = node.parent
+
+    def _evictable(self):
+        out = []
+        stack = list(self.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.riders == 0:
+                out.append(n)
+        return out
+
+    def evict_lru(self, n_pages: int = 1) -> int:
+        """Evict least-recently-used rider-free leaves until ``n_pages``
+        pool pages were freed (a leaf frees exactly its own page) or no
+        candidate remains.  Returns pages freed."""
+        freed = 0
+        stats = self._stats()
+        while freed < n_pages:
+            leaves = self._evictable()
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda n: n.tick)
+            self._drop(victim)
+            freed += 1
+            if stats is not None:
+                stats.prefix_evictions += 1
+        return freed
+
+    def _make_room(self, n_pages: int) -> bool:
+        """True when ``n_pages`` can be allocated while keeping the
+        watermark's worth of free pages for decode; evicts LRU leaves to
+        get there."""
+        need = n_pages + self.watermark
+        if self.rt.free_pages >= need:
+            return True
+        self.evict_lru(need - self.rt.free_pages)
+        return self.rt.free_pages >= need
+
+    def _drop(self, node: PrefixNode) -> None:
+        self.rt.release(node.prefix_id)
+        siblings = (self.children if node.parent is None
+                    else node.parent.children)
+        del siblings[node.key]
+        self.nodes -= 1
+        self.cached_pages -= 1
+
+    def drop_tail(self, node: PrefixNode, down_to: int) -> None:
+        """Remove ``node`` and its ancestors newer than ``down_to`` tokens
+        — the caller's failed-insert rollback (KV never committed, so the
+        nodes must not survive to serve garbage).  Only the chain just
+        built by one ``acquire`` may be dropped: within a single-owner
+        engine nothing else can ride it yet."""
+        while node is not None and node.tok_len > down_to:
+            parent = node.parent
+            assert not node.children, "drop_tail on a shared chain"
+            node.riders = 0
+            self._drop(node)
+            node = parent
+
+    def clear(self) -> None:
+        """Release every cached prefix (engine close / bench cold pass).
+        Pinned nodes are released too — callers must only clear with no
+        requests in flight."""
+        stack = list(self.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.rt.release(n.prefix_id)
+        self.children = {}
+        self.nodes = 0
+        self.cached_pages = 0
+
+    # -- gauges ------------------------------------------------------------
+    @property
+    def pinned_pages(self) -> int:
+        """Pages on root→node chains some in-flight request rides (an
+        upper bound on what eviction cannot touch right now)."""
+        pinned = set()
+        stack = list(self.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.riders > 0:
+                m = n
+                while m is not None and m.prefix_id not in pinned:
+                    pinned.add(m.prefix_id)
+                    m = m.parent
+        return len(pinned)
+
+    def counters(self) -> dict:
+        """Gauge snapshot (counters live on the engine's EngineStats)."""
+        return {"cached_pages": self.cached_pages,
+                "pinned_pages": self.pinned_pages,
+                "nodes": self.nodes}

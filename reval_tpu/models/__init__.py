@@ -12,6 +12,7 @@ from .model import (
     init_kv_cache,
     logits_for_tokens,
     prefill,
+    prefill_with_batched_context,
     prefill_with_context,
 )
 from .quant import is_quantized, quantize_params
@@ -34,6 +35,7 @@ __all__ = [
     "logits_for_tokens",
     "param_template",
     "prefill",
+    "prefill_with_batched_context",
     "prefill_with_context",
     "quantize_params",
     "zoo_config",

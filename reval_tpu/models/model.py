@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import apply_rope, decode_attention, prefill_attention, rope_angles, rms_norm
-from ..ops.attention import _softcap, context_prefill_attention
+from ..ops.attention import (_softcap, batched_context_prefill_attention,
+                             context_prefill_attention)
 from .configs import ModelConfig
 
 __all__ = ["KVCache", "init_kv_cache", "prefill", "prefill_with_context",
@@ -444,6 +445,55 @@ def prefill_with_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     h, (new_k, new_v) = jax.lax.scan(
         layer_step, h, (params["layers"], ctx.k, ctx.v, cache.k, cache.v, wins))
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    if logits_mode == "last":
+        h = h[:, -1:, :]
+    return _unembed(params, cfg, h), KVCache(new_k, new_v)
+
+
+def prefill_with_batched_context(params, cfg: ModelConfig,
+                                 tokens: jnp.ndarray, pad_len: jnp.ndarray,
+                                 ctx_k: jnp.ndarray, ctx_v: jnp.ndarray,
+                                 ctx_len: jnp.ndarray, cache: KVCache,
+                                 logits_mode: str = "last",
+                                 ) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill left-padded suffix blocks [B, T] where each row follows its
+    OWN cached context — the multi-prefix sibling of
+    :func:`prefill_with_context` (which broadcasts ONE context over the
+    batch).
+
+    ``ctx_k``/``ctx_v``: ``[L, B, Tc, H_kv, D]`` per-row context KV (rows
+    padded past ``ctx_len[b]`` are masked — the paged engine gathers them
+    from pool pages, see models/paged.py:gather_prefix_context).  Row
+    ``b``'s suffix positions start at ``ctx_len[b]``.  Returns logits and
+    the suffix KV (cache positions [0, T) = row sequence positions
+    [ctx_len, ctx_len + T)).
+    """
+    b, t = tokens.shape
+    h = _embed(params, cfg, tokens)
+    positions = (ctx_len[:, None]
+                 + jnp.maximum(jnp.arange(t)[None, :] - pad_len[:, None], 0))
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    wins = cfg.layer_windows_array()
+
+    def layer_step(h, xs):
+        layer, ck, cv, k_slot, v_slot, win = xs
+        kv = {}
+
+        def attend(q, k, v):
+            kv["k"] = jax.lax.dynamic_update_slice(
+                k_slot, k.astype(k_slot.dtype), (0, 0, 0, 0))
+            kv["v"] = jax.lax.dynamic_update_slice(
+                v_slot, v.astype(v_slot.dtype), (0, 0, 0, 0))
+            return batched_context_prefill_attention(
+                q, k, v, ck, cv, ctx_len, pad_len, scale=cfg.attn_scale,
+                window=win, softcap=cfg.attn_softcap)
+
+        h = _block(h, layer, cfg, cos, sin, attend)
+        return h, (kv["k"], kv["v"])
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer_step, h, (params["layers"], ctx_k, ctx_v, cache.k, cache.v, wins))
     h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
     if logits_mode == "last":
         h = h[:, -1:, :]

@@ -49,13 +49,16 @@ import jax.numpy as jnp
 from ..ops import rope_angles
 from ..ops.pallas_attention import paged_decode_attention
 from .configs import ModelConfig
-from .model import _block, _embed, _norm, _unembed
+from .model import (_block, _embed, _norm, _unembed,
+                    prefill_with_batched_context)
 
 __all__ = [
     "PagedKVCache",
     "init_paged_cache",
     "paged_decode_step",
     "commit_prefill",
+    "gather_prefix_context",
+    "prefill_with_paged_context",
 ]
 
 
@@ -194,8 +197,15 @@ def _attention_tp_manual(q2, ki, vi, block_tables, attn_lens, ks_i, vs_i,
     # with no varying-axes metadata, which the vma checker rejects inside
     # a manual region; correctness here is by construction (head-parallel,
     # no cross-shard dataflow)
-    return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                         out_specs=q_spec, check_vma=False)(*args)
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                             out_specs=q_spec, check_vma=False)(*args)
+    # jax 0.4.x spells it jax.experimental.shard_map with check_rep (the
+    # same replication checker check_vma renamed)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                      out_specs=q_spec, check_rep=False)(*args)
 
 
 def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -255,6 +265,60 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
         k_scale=tuple(new_ks) if cache.quantized else None,
         v_scale=tuple(new_vs) if cache.quantized else None)
     return _unembed(params, cfg, h)[:, 0, :], out_cache
+
+
+def gather_prefix_context(cache: PagedKVCache, ctx_tables: jnp.ndarray
+                          ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Gather per-row prefix KV out of the page pool into contiguous
+    context blocks: ``ctx_tables`` [B, N_pre] page ids (trash-page padded
+    past each row's real prefix) → ``(k, v)`` each ``[L, B, N_pre * P,
+    H_kv, D]`` — the ``ctx_k``/``ctx_v`` operands of
+    :func:`~reval_tpu.models.model.prefill_with_batched_context`.
+
+    The gather hits the pool's *leading* (token-major) dim — the
+    XLA-friendly whole-page gather form this layout was chosen for (see
+    module docstring).  Rows gathered from the trash page hold stale
+    bytes; the attention masks them via ``ctx_len``.  Int8 pools
+    dequantize through their scales here (the context is read-only —
+    nothing writes back).
+    """
+    p = cache.page_size
+    b, npre = ctx_tables.shape
+    flat = (ctx_tables[:, :, None] * p
+            + jnp.arange(p, dtype=jnp.int32)[None, None, :]).reshape(b, npre * p)
+
+    def gather(pool, scales):
+        x = pool[flat]                              # [B, Tc, H_kv, D]
+        if scales is not None:
+            x = x.astype(jnp.float32) * scales[flat][..., None]
+        return x
+
+    ks, vs = [], []
+    for i in range(len(cache.k)):
+        sk, sv = _layer_scales(cache, i)
+        ks.append(gather(cache.k[i], sk))
+        vs.append(gather(cache.v[i], sv))
+    return jnp.stack(ks), jnp.stack(vs)
+
+
+def prefill_with_paged_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                               pad_len: jnp.ndarray, ctx_tables: jnp.ndarray,
+                               ctx_len: jnp.ndarray, paged: PagedKVCache,
+                               cache: "KVCache", logits_mode: str = "last"):
+    """Prefill suffix blocks whose per-row prefix KV lives in pool pages.
+
+    The persistent radix prefix cache's prefill path: each admitted row's
+    longest cached prefix is already committed to (refcounted) pages, so
+    the suffix attends a context GATHERED from the pool instead of a
+    contiguous KV block held by the engine — no second copy of cached
+    prefixes ever exists, and different rows ride different prefixes in
+    one call.  ``paged`` is read-only here (commit of the suffix KV is a
+    separate donated step, as for plain prefill).
+    """
+    ctx_k, ctx_v = gather_prefix_context(paged, ctx_tables)
+    return prefill_with_batched_context(
+        params, cfg, tokens, pad_len, ctx_k, ctx_v, ctx_len, cache,
+        logits_mode=logits_mode)
 
 
 def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,

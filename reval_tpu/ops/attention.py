@@ -21,7 +21,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["prefill_attention", "decode_attention", "context_prefill_attention"]
+__all__ = ["prefill_attention", "decode_attention", "context_prefill_attention",
+           "batched_context_prefill_attention"]
 
 _NEG_INF = -1e30
 
@@ -190,6 +191,77 @@ def context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     vcat = jnp.concatenate(
         [jnp.broadcast_to(ctx_v, (b, tc, n_kv, d)).astype(cat_t),
          v.astype(cat_t)], axis=1)
+
+    if t + tc > _KEY_BLOCK:
+        out = _blocked_attention(qg, kcat, vcat, mask_for, scale, softcap)
+        return out.reshape(b, t, h, d).astype(q.dtype)
+
+    kf = kcat.astype(jnp.float32)
+    vf = vcat.astype(jnp.float32)
+    scores = _softcap(jnp.einsum("bqngd,bknd->bngqk", qg, kf) * scale, softcap)
+    mask = mask_for(jnp.arange(t + tc))
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, vf)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def batched_context_prefill_attention(q: jnp.ndarray, k: jnp.ndarray,
+                                      v: jnp.ndarray,
+                                      ctx_k: jnp.ndarray, ctx_v: jnp.ndarray,
+                                      ctx_len: jnp.ndarray,
+                                      pad_len: jnp.ndarray,
+                                      scale: float | None = None,
+                                      window=None,
+                                      softcap: float | None = None
+                                      ) -> jnp.ndarray:
+    """Causal attention for suffix blocks that each follow their OWN
+    cached context — the multi-prefix generalisation of
+    :func:`context_prefill_attention`.
+
+    ``ctx_k``/``ctx_v`` are PER-ROW: ``[B, Tc, H_kv, D]`` where row ``b``'s
+    valid context is its first ``ctx_len[b]`` positions (the rest is
+    padding from bucketing different prefix lengths together — typically
+    gathered trash-page rows, masked here).  Suffix queries sit at logical
+    positions ``ctx_len[b] + (i - pad_len[b])``; each attends its whole
+    (valid) context plus the causal/unpadded part of its own suffix.
+    Identical numerics to ``context_prefill_attention`` when every row
+    shares one full-length context — the single-prefix path is the
+    ``ctx_len == Tc`` special case.
+    """
+    b, t, h, d = q.shape
+    n_kv = k.shape[2]
+    tc = ctx_k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    qg = _group_queries(q, n_kv).astype(jnp.float32)
+    rows = jnp.arange(t)[:, None]              # suffix query buffer positions
+
+    def mask_for(cols):
+        """Validity of key columns ``cols`` (per-row ctx keys ahead of
+        suffix keys) for every query → [B, 1, 1, T, C]."""
+        c = cols.shape[0]
+        # ctx keys: valid iff inside this row's real context
+        in_ctx = (cols[None, :] < ctx_len[:, None]) & (cols < tc)[None, :]
+        in_ctx_b = jnp.broadcast_to(in_ctx[:, None, :], (b, t, c))
+        sj = cols[None, :] - tc                                  # suffix col
+        causal = rows >= (cols - tc)[None, :]                    # [T, C]
+        valid_suffix = (sj >= pad_len[:, None]) & (cols >= tc)[None, :]
+        if window is not None:
+            # suffix↔suffix distance is pad-invariant (rows - sj); ctx
+            # keys sit at logical cols, queries at ctx_len + (rows - pad)
+            causal = causal & (rows - (cols - tc)[None, :] < window)
+            q_logical = (ctx_len[:, None] + rows[:, 0][None, :]
+                         - pad_len[:, None])                     # [B, T]
+            in_ctx_b = (in_ctx_b
+                        & (q_logical[:, :, None] - cols[None, None, :]
+                           < window))
+        mask = in_ctx_b | (causal[None, :, :] & valid_suffix[:, None, :])
+        return mask[:, None, None, :, :]
+
+    cat_t = jnp.result_type(ctx_k.dtype, k.dtype)
+    kcat = jnp.concatenate([ctx_k.astype(cat_t), k.astype(cat_t)], axis=1)
+    vcat = jnp.concatenate([ctx_v.astype(cat_t), v.astype(cat_t)], axis=1)
 
     if t + tc > _KEY_BLOCK:
         out = _blocked_attention(qg, kcat, vcat, mask_for, scale, softcap)

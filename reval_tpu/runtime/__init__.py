@@ -71,6 +71,7 @@ def load_native() -> ctypes.CDLL:
         "reval_rt_destroy": ([ptr], None),
         "reval_rt_submit": ([ptr, i32, i32], i64),
         "reval_rt_alloc_prefix": ([ptr, i32], i64),
+        "reval_rt_alloc_prefix_extend": ([ptr, i64, i32], i64),
         "reval_rt_submit_prefixed": ([ptr, i64, i32, i32], i64),
         "reval_rt_admit": ([ptr, p64, p32, i32], i32),
         "reval_rt_block_table": ([ptr, i64, p32], i32),
@@ -142,6 +143,21 @@ class PagedRuntime:
         if prefix_id == -1:
             raise ValueError(f"cannot reserve {n_pages} prefix pages "
                              f"({self.free_pages} free)")
+        return prefix_id
+
+    def alloc_prefix_extend(self, parent_id: int, n_pages: int) -> int:
+        """Extend a live prefix by ``n_pages`` fresh pages: the child
+        prefix shares every parent page by refcount and owns the new tail
+        (the radix-tree building block — see
+        inference/tpu/prefix_cache.py).  Releasing the child frees only
+        its own pages."""
+        prefix_id = self._lib.reval_rt_alloc_prefix_extend(
+            self._h, parent_id, n_pages)
+        if prefix_id == -1:
+            raise ValueError(
+                f"cannot extend prefix {parent_id} by {n_pages} pages "
+                f"(dead/unknown parent, table overflow, or only "
+                f"{self.free_pages} pages free)")
         return prefix_id
 
     def submit_prefixed(self, prefix_id: int, prompt_len: int,

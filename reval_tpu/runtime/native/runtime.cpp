@@ -198,6 +198,36 @@ int64_t reval_rt_alloc_prefix(void* h, int32_t n_pages) {
   return prefix.id;
 }
 
+// Extend an existing prefix object by n_pages fresh pages: the child
+// prefix references every parent page by refcount and owns the new tail —
+// the building block of a radix prefix tree, where a longer cached prefix
+// shares all its ancestor pages with shorter ones.  Releasing the child
+// drops only its own refs (the parent keeps the shared pages alive), so
+// LRU eviction of a leaf frees exactly its own pages.  Returns the child
+// prefix id, or -1 (unknown/dead parent, bad n_pages, table overflow, OOM).
+int64_t reval_rt_alloc_prefix_extend(void* h, int64_t parent_id,
+                                     int32_t n_pages) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(parent_id);
+  if (it == rt->seqs.end() || it->second.state != SeqState::kPrefix)
+    return -1;
+  Seq& parent = it->second;
+  int32_t total = static_cast<int32_t>(parent.pages.size()) + n_pages;
+  if (n_pages < 1 || total > rt->max_pages_per_seq ||
+      static_cast<int32_t>(rt->free_list.size()) < n_pages)
+    return -1;
+  Seq child;
+  child.id = rt->next_id++;
+  child.pages = parent.pages;
+  for (int32_t p : child.pages) ++rt->ref_counts[p];
+  for (int32_t i = 0; i < n_pages; ++i) child.pages.push_back(rt->alloc_page());
+  child.len = total * rt->page_size;
+  child.prompt_len = child.len;
+  child.state = SeqState::kPrefix;
+  rt->seqs.emplace(child.id, child);
+  return child.id;
+}
+
 // Queue a request whose first pages are a shared prefix.  prompt_len is
 // the TOTAL prompt length (prefix tokens included); admission attaches the
 // prefix pages by refcount and allocates only the remainder.

@@ -22,12 +22,14 @@ lives on the request (`_Request.temp` / `.scanner` / `.max_new`), so one
 decode chunk can mix greedy and sampled requests: `sample_token` takes a
 per-slot temperature vector.
 
-Not composed with call-level prefix sharing: the shared-prefix fast path
-(`_reserve_shared_prefix`) is per-`generate()`-call state, while a
-session interleaves unrelated requests; sharing across HTTP requests
-would need refcounted prefix detection in the scheduler (future work —
-the in-process fleet path already fuses whole task batches, which is
-where prefix sharing pays).
+Prefix reuse composes across HTTP requests: submissions enter the engine
+through `submit_request`, which consults the engine's PERSISTENT radix
+prefix cache (inference/tpu/prefix_cache.py) — the cache outlives any
+one request, so a client re-sending the same few-shot template (the
+DREval serve shape) prefills only its suffix even with one prompt per
+POST.  Cached pages are refcounted pool pages; eviction under load is
+LRU over rider-free nodes, so a busy session cannot be starved by its
+own cache.
 """
 
 from __future__ import annotations
@@ -284,7 +286,7 @@ class ContinuousSession:
             origin.pop(seq_id)
             if not req.done:
                 try:
-                    eng.rt.release(seq_id)
+                    eng.release_request(seq_id, req)
                 except Exception:
                     pass
             if not sub.pending.done():
@@ -307,12 +309,15 @@ class ContinuousSession:
                 def notify(req, _sub=sub, _pos=pos):
                     _sub.on_progress(_pos, finalize_text(
                         eng.tokenizer, req.generated, _sub.stop))
-            seq_id = eng.rt.submit(len(ids), sub.max_new)
+            # ride the engine's persistent prefix cache: a template seen
+            # on ANY earlier request (this submission, a previous POST, a
+            # fleet call before the session attached) prefills only once
+            seq_id, node = eng.submit_request(ids, sub.max_new)
             reqs[seq_id] = _Request(
                 index=pos, ids=ids, max_new=sub.max_new,
                 scanner=StopScanner(eng.tokenizer, sub.stop),
                 temp=sub.temperature, top_k=sub.top_k, top_p=sub.top_p,
-                notify=notify, key=keys[pos])
+                notify=notify, key=keys[pos], node=node)
             origin[seq_id] = (sub, pos)
 
 

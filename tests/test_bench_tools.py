@@ -41,6 +41,12 @@ def test_bench_tiny_emits_one_json_line():
     assert d["decode_steps"] > 0
     assert d["hbm_gbps_achieved"] > 0
     assert 0 < d["bandwidth_util"] < 1
+    # persistent prefix cache counters + the cache-off A/B row
+    pc = d["prefix_cache"]
+    assert {"hit_tokens", "hit_rate", "evictions", "pinned_pages",
+            "warm_prefill_reduction"} <= set(pc)
+    assert pc["warm_prefill_reduction"] > 0
+    assert "no_prefix_cache_speedup" in d
 
 
 def test_bench_failure_carries_last_known():

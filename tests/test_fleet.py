@@ -71,6 +71,73 @@ def test_fleet_metrics_match_individual_runs(tmp_path):
         assert solo.run() == fleet_metrics[name], name
 
 
+def test_fleet_surfaces_prefix_cache_trailer(tmp_path):
+    """A backend exposing a TPU engine with prefix-cache counters gets
+    them summarised in the run result (the 'engine stats trailer')."""
+    from reval_tpu.inference.tpu.engine import EngineStats
+
+    class FakeEngine:
+        def __init__(self):
+            self.stats = EngineStats()
+            self.stats.prefix_lookup_tokens = 1000
+            self.stats.prefix_hit_tokens = 700
+            self.stats.prefix_inserted_pages = 9
+            self.stats.prefix_evictions = 2
+
+        def prefix_cache_counters(self):
+            return {"cached_pages": 9, "pinned_pages": 0, "nodes": 9}
+
+    class EngineBackend:
+        info = "engine_model_direct_temp0.0"
+        prompt_type = "direct"
+        engine = FakeEngine()
+
+        def infer_many(self, prompts):
+            return ["[ANSWER]x[/ANSWER]"] * len(prompts)
+
+    fleet = FleetRunner(dataset="humaneval", repeats=1,
+                        backend=EngineBackend(), results_dir=str(tmp_path),
+                        progress=False, run_consistency=False, max_items=2)
+    result = fleet.run()
+    trailer = result["prefix_cache"]
+    assert trailer["hit_tokens"] == 700
+    assert trailer["hit_rate"] == pytest.approx(0.7)
+    assert trailer["evictions"] == 2 and trailer["cached_pages"] == 9
+
+
+def test_fleet_fused_batch_is_task_contiguous(tmp_path):
+    """The fused pass must keep each task's prompts contiguous — per-task
+    grouping is what feeds the engine's radix prefix cache one template
+    run at a time (a global LCP over 4 templates is ~0)."""
+    from reval_tpu.tasks import TASKS
+
+    seen: dict[str, list[str]] = {}
+
+    class RecordingBackend:
+        info = "recording_model_direct_temp0.0"
+        prompt_type = "direct"
+
+        def infer_many(self, prompts):
+            seen["prompts"] = list(prompts)
+            return ["[ANSWER]x[/ANSWER]"] * len(prompts)
+
+    fleet = FleetRunner(dataset="humaneval", repeats=1,
+                        backend=RecordingBackend(), results_dir=str(tmp_path),
+                        progress=False, run_consistency=False, max_items=2)
+    fleet.run()
+    # reconstruct each task's own prompt list; the fused stream must be
+    # their concatenation in task order
+    expected = []
+    for name in ("coverage", "path", "state", "output"):
+        task = TASKS[name](model=None, prompt_type="direct",
+                           dataset="humaneval", mock=True, max_items=2,
+                           progress=False,
+                           results_dir=str(tmp_path / "solo"))
+        _, jobs = task._plan()
+        expected.extend(j.prompt for j in jobs)
+    assert seen["prompts"] == expected
+
+
 # ---------------------------------------------------------------------------
 # analyzer
 # ---------------------------------------------------------------------------

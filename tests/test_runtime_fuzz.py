@@ -66,6 +66,18 @@ class Harness:
         if pid >= 0:
             self.prefixes[pid] = n
 
+    def op_extend_prefix(self):
+        # radix-style chains: a child prefix shares the parent's pages
+        live = [p for p in self.prefixes if p not in self.released_prefixes]
+        if not live or len(self.prefixes) >= 6:
+            return
+        parent = int(self.rng.choice(live))
+        try:
+            child = self.rt.alloc_prefix_extend(parent, 1)
+        except ValueError:
+            return                       # OOM/overflow: fine under fuzz
+        self.prefixes[child] = self.prefixes[parent] + 1
+
     def op_admit(self):
         for seq, slot in self.rt.admit():
             assert seq in self.waiting, "admitted a sequence never submitted"
@@ -139,8 +151,8 @@ class Harness:
 def test_random_op_sequences_keep_invariants(seed):
     h = Harness(seed)
     ops = [h.op_submit, h.op_submit_prefixed, h.op_alloc_prefix, h.op_admit,
-           h.op_advance, h.op_advance, h.op_preempt, h.op_release,
-           h.op_release_prefix]
+           h.op_extend_prefix, h.op_advance, h.op_advance, h.op_preempt,
+           h.op_release, h.op_release_prefix]
     try:
         for step in range(400):
             op = ops[int(h.rng.integers(0, len(ops)))]
@@ -155,7 +167,7 @@ def test_fuzz_eventually_drains():
     pool to fully free — no leaked pages."""
     h = Harness(99)
     ops = [h.op_submit, h.op_submit_prefixed, h.op_alloc_prefix, h.op_admit,
-           h.op_advance, h.op_preempt]
+           h.op_extend_prefix, h.op_advance, h.op_preempt]
     try:
         for _ in range(200):
             ops[int(h.rng.integers(0, len(ops)))]()

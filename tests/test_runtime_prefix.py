@@ -87,6 +87,50 @@ def test_prefix_pages_query(rt):
         rt.prefix_pages(99999)
 
 
+def test_alloc_prefix_extend_shares_parent_pages(rt):
+    """The radix-tree building block: a child prefix refcounts every
+    parent page and owns only its fresh tail."""
+    pre = rt.alloc_prefix(2)
+    parent_pages = [p for p in rt.block_table(pre) if p != 0]
+    child = rt.alloc_prefix_extend(pre, 1)
+    child_pages = [p for p in rt.block_table(child) if p != 0]
+    assert child_pages[:2] == parent_pages and len(child_pages) == 3
+    assert all(rt.page_ref(p) == 2 for p in parent_pages)
+    assert rt.page_ref(child_pages[2]) == 1
+    assert rt.seq_len(child) == 3 * PAGE
+    # riders of the child attach the WHOLE chain
+    a = rt.submit_prefixed(child, 3 * PAGE + 2, 0)
+    rt.admit()
+    assert rt.prefix_pages(a) == 3
+    assert rt.page_ref(parent_pages[0]) == 3
+    # releasing the child frees only its own page (parent holds the rest)
+    rt.release(a)
+    rt.release(child)
+    assert all(rt.page_ref(p) == 1 for p in parent_pages)
+    assert rt.free_pages == 11 - 2
+    rt.release(pre)
+    assert rt.free_pages == 11
+
+
+def test_alloc_prefix_extend_validations(rt):
+    pre = rt.alloc_prefix(1)
+    with pytest.raises(ValueError):      # unknown parent
+        rt.alloc_prefix_extend(12345, 1)
+    with pytest.raises(ValueError):      # n_pages < 1
+        rt.alloc_prefix_extend(pre, 0)
+    with pytest.raises(ValueError):      # table overflow
+        rt.alloc_prefix_extend(pre, 6)
+    with pytest.raises(ValueError):      # OOM
+        rt.alloc_prefix_extend(pre, 11)
+    a = rt.submit_prefixed(pre, PAGE + 1, 0)     # a rider, not a prefix
+    rt.admit()
+    with pytest.raises(ValueError):      # parent must be a prefix object
+        rt.alloc_prefix_extend(a, 1)
+    rt.release(pre)
+    with pytest.raises(ValueError):      # dead parent
+        rt.alloc_prefix_extend(pre, 1)
+
+
 def test_dead_prefix_detaches_rider_for_full_prefill(rt):
     """A rider admitted after its prefix died must be told to prefill its
     whole prompt (prefix_pages == 0) and must own ALL its pages — the

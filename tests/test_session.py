@@ -211,9 +211,9 @@ def test_dp_work_stealing_balances_skewed_prompts(tiny):
 
 def test_dp_prefix_sharing_rides_work_stealing(tiny):
     """Few-shot-template prompts (shared 2-page prefix) through the dp
-    work queue: every replica reserves the call-wide prefix once and
-    pulled prompts ride it via submit_prefixed, token-identical to the
-    static engine."""
+    work queue: each replica's radix prefix cache prefills the template
+    once (on its first pull) and every later pulled prompt rides the
+    cached pages, token-identical to the static engine."""
     import jax
 
     from reval_tpu.inference.tpu.dp_paged import DataParallelPagedEngine

@@ -1,0 +1,166 @@
+"""Token-level prefix-overlap report: size the radix prefix cache and
+predict its hit rates before burning a chip window.
+
+For each task of a (dataset, prompt_type) workload this measures, on the
+GENUINE planned prompts (mock planning — the same few-shot templates and
+programs the scoring pipeline sends):
+
+- ``template_tokens``: the task's intra-task LCP (its few-shot template);
+- ``template_share``: template tokens / mean prompt tokens — the fraction
+  of every prompt's prefill that is pure repetition (PERF.md cites
+  50-72% for DREval direct prompts);
+- ``distinct_pages``: pages a page-granular radix tree holds after
+  inserting every prompt's full page-aligned prefix — the cache's working
+  set for one repeat (multiply by the page's KV bytes for HBM);
+- ``warm_hit_rate``: fraction of prompt tokens served from cache on a
+  repeat of the same prompt set (fleet repeats 2..N) — page-aligned full
+  prefixes over total tokens;
+- ``cold_hit_rate``: in-batch sharing on the FIRST pass (later prompts
+  hitting pages inserted by earlier ones, task-contiguous order).
+
+Prints ONE JSON line.  Examples:
+
+    python tools/prefix_stats.py --dataset humaneval --prompt-type direct
+    python tools/prefix_stats.py --tiny          # CPU smoke (tiny counts)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TASKS = ("coverage", "path", "state", "output")
+
+
+def task_prompts(name: str, n: int, dataset: str, prompt_type: str
+                 ) -> list[str]:
+    from reval_tpu.tasks import TASKS as TASK_CLASSES
+
+    items = 2
+    while True:
+        task = TASK_CLASSES[name](model=None, prompt_type=prompt_type,
+                                  dataset=dataset, mock=True, max_items=items,
+                                  progress=False)
+        _, jobs = task._plan()
+        if len(jobs) >= n or items > 64:
+            return [j.prompt for j in jobs][:n]
+        items *= 2
+
+
+def lcp_tokens(encoded: list[list[int]]) -> int:
+    if not encoded:
+        return 0
+    first = encoded[0]
+    lcp = min(len(e) for e in encoded)
+    for ids in encoded[1:]:
+        i, n = 0, min(lcp, len(ids))
+        while i < n and ids[i] == first[i]:
+            i += 1
+        lcp = i
+    return lcp
+
+
+def radix_stats(encoded: list[list[int]], page: int) -> tuple[int, int, int]:
+    """Simulate the engine's page-granular radix insertion over the
+    prompt stream → (distinct_pages, cold_hit_tokens, warm_hit_tokens).
+
+    cold: tokens a first pass serves from pages earlier prompts in the
+    SAME stream inserted; warm: tokens a full repeat of the stream serves
+    (every page-aligned prefix already cached)."""
+    tree: dict = {}
+    distinct = 0
+    cold_hits = 0
+    warm_hits = 0
+    for ids in encoded:
+        cap = max(0, len(ids) - 1) // page
+        warm_hits += cap * page
+        children = tree
+        missed = False
+        for i in range(cap):
+            key = tuple(ids[i * page:(i + 1) * page])
+            node = children.get(key)
+            if node is None:
+                node = children[key] = {}
+                distinct += 1
+                missed = True
+            elif not missed:
+                cold_hits += page
+            children = node
+    return distinct, cold_hits, warm_hits
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dataset", default="humaneval")
+    ap.add_argument("--prompt-type", choices=["direct", "cot"],
+                    default="direct")
+    ap.add_argument("--per-task", type=int, default=32,
+                    help="prompts per task (4 tasks)")
+    ap.add_argument("--page-size", type=int, default=128)
+    ap.add_argument("--tokenizer", default=None,
+                    help="real tokenizer dir (tokenizer.json); default: a "
+                         "BPE trained on the prompt corpus, like bench.py")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny counts: CPU smoke of the tool itself")
+    args = ap.parse_args()
+
+    per = 4 if args.tiny else args.per_task
+    page = args.page_size
+    by_task = {t: task_prompts(t, per, args.dataset, args.prompt_type)
+               for t in TASKS}
+    all_prompts = [p for t in TASKS for p in by_task[t]]
+
+    from bench import TrainedBPE, find_hf_tokenizer
+
+    hf = None if args.tiny else find_hf_tokenizer(args.tokenizer)
+    tok = hf[0] if hf else TrainedBPE(all_prompts)
+
+    enc = {t: [tok.encode(p) for p in by_task[t]] for t in TASKS}
+    out: dict = {
+        "metric": "prefix_overlap",
+        "dataset": args.dataset,
+        "prompt_type": args.prompt_type,
+        "page_size": page,
+        "tokenizer": hf[1] if hf else "trained-bpe(benchmark-corpus)",
+        "per_task_prompts": per,
+    }
+    tasks_out = {}
+    total_tokens = total_pages = total_cold = total_warm = 0
+    for t in TASKS:
+        toks = sum(len(e) for e in enc[t])
+        lcp = lcp_tokens(enc[t])
+        pages, cold, warm = radix_stats(enc[t], page)
+        mean = toks / max(len(enc[t]), 1)
+        tasks_out[t] = {
+            "prompts": len(enc[t]),
+            "total_tokens": toks,
+            "mean_prompt_tokens": round(mean, 1),
+            "template_tokens": lcp,
+            "template_share": round(lcp / mean, 4) if mean else 0.0,
+            "distinct_pages": pages,
+            "cold_hit_rate": round(cold / toks, 4) if toks else 0.0,
+            "warm_hit_rate": round(warm / toks, 4) if toks else 0.0,
+        }
+        total_tokens += toks
+        total_pages += pages
+        total_cold += cold
+        total_warm += warm
+    # the fused fleet batch: task-contiguous stream over ALL tasks — the
+    # cross-task LCP is ~0, so fused numbers are per-task sums, which is
+    # exactly why per-task grouping must feed the radix lookup
+    fused_enc = [e for t in TASKS for e in enc[t]]
+    out["fused_batch_lcp_tokens"] = lcp_tokens(fused_enc)
+    out["tasks"] = tasks_out
+    out["cache_working_set_pages"] = total_pages
+    out["cold_hit_rate"] = round(total_cold / total_tokens, 4)
+    out["warm_hit_rate"] = round(total_warm / total_tokens, 4)
+    out["value"] = out["warm_hit_rate"]
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
