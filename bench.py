@@ -868,6 +868,11 @@ def main() -> None:
             "warmup_wall_seconds": round(getattr(stats, "warmup_wall", 0.0), 2),
             "pipelined_chunks": getattr(stats, "pipelined_chunks", 0),
             "patched_tables": getattr(stats, "patched_tables", 0),
+            # serving lifecycle counters: zero for an in-process bench,
+            # nonzero when the same EngineStats rode a serve session
+            # (sheds = 429 load sheds, deadline_expired = engine-side
+            # request cancels, watchdog_trips = no-progress trips)
+            "serving": stats.serving_counters(),
         }
         if cache_row is not None:
             extras["prefix_cache"] = cache_row

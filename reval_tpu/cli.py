@@ -404,6 +404,53 @@ def run_fleet(argv: list[str]) -> int:
     return 0
 
 
+def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
+    """Self-contained serve-path smoke (the tier-1 regression canary for
+    the serving lifecycle, mirroring `fleet --mock --chaos`): post ``n``
+    prompts CONCURRENTLY through the resilient HTTP client against the
+    just-built server — engine-step chaos applies — then gracefully
+    drain and print one JSON summary line with the lifecycle counters."""
+    import threading
+
+    from .inference.client import HTTPClientBackend
+
+    server.start()
+    client = HTTPClientBackend(
+        model_id=cfg.get("model_id", "smoke"), port=server.port, temp=0.0,
+        prompt_type="direct", wait_for_server_s=30,
+        retry={"max_attempts": 10, "base_delay": 0.02,
+               "max_delay": 0.5, "jitter": 0.1})
+    prompts = [f"smoke prompt {i}" for i in range(n)]
+    outs: dict[int, str] = {}
+    errors: list[str] = []
+
+    def post(i: int) -> None:
+        try:
+            outs[i] = client.infer_one(prompts[i])
+        except Exception as exc:  # noqa: BLE001 — summarised below
+            errors.append(f"prompt {i}: {exc!r}")
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    server.shutdown()
+    session = getattr(server, "_session", None)
+    counters = (session.engine_stats()[0].serving_counters()
+                if session is not None else {})   # session-less engines:
+                                                  # no lifecycle counters
+    summary = {
+        "served": len(outs), "errors": len(errors), **counters,
+        "chaos_injected": len(step_chaos.injected) if step_chaos else 0,
+    }
+    print(json.dumps(summary))
+    if errors or len(outs) != n:
+        print(f"[smoke] failures: {errors[:3]}")
+        return 1
+    return 0
+
+
 def run_serve(argv: list[str]) -> int:
     """Serve the resident TPU engine over the OpenAI completions protocol
     (replaces the reference's vLLM api_server + start_server.sh)."""
@@ -419,27 +466,66 @@ def run_serve(argv: list[str]) -> int:
                         help="pre-compile the generation programs before "
                              "binding the port (first request otherwise "
                              "pays 20-40s of jit per shape)")
+    parser.add_argument("--mock", action="store_true",
+                        help="serve a host-only mock engine through the real "
+                             "session/server lifecycle (no checkpoint/TPU) — "
+                             "the serving smoke target")
+    parser.add_argument("--chaos-step", type=float, default=None, metavar="RATE",
+                        help="inject deterministic engine-step faults (stalled "
+                             "step, mid-batch exception) at this per-step rate "
+                             "into the serve loop — hardening/smoke tool")
+    parser.add_argument("--chaos-stall-s", type=float, default=0.05,
+                        help="stall duration for injected stalled steps")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="seed for the engine-step fault schedule")
+    parser.add_argument("--smoke", type=int, default=None, metavar="N",
+                        help="self-test: serve N concurrent prompts through "
+                             "the resilient client, drain gracefully, print a "
+                             "JSON counter summary, exit")
     args = parser.parse_args(argv)
-    if not os.path.exists(args.input):
+    cfg = {}
+    if os.path.exists(args.input):
+        with open(args.input) as f:
+            cfg = json.load(f)
+    elif not args.mock:
         print(f"Error: {args.input} not found — run `python -m reval_tpu config` first")
         return 1
-    with open(args.input) as f:
-        cfg = json.load(f)
-    server = serve_config(cfg, port=args.port, warmup=args.warmup)
+    if args.mock:
+        cfg["mock"] = True
+    step_chaos = None
+    if args.chaos_step:
+        from .resilience import EngineStepChaos
+
+        step_chaos = EngineStepChaos(rate=args.chaos_step,
+                                     seed=args.chaos_seed,
+                                     stall_s=args.chaos_stall_s)
+        print(f"[chaos] engine-step faults at rate {args.chaos_step} "
+              f"(seed {args.chaos_seed})")
+    server = serve_config(cfg, port=args.port, warmup=args.warmup,
+                          step_chaos=step_chaos)
+    if args.smoke is not None:
+        return _serve_smoke(server, cfg, args.smoke, step_chaos)
     print(f"serving {cfg.get('model_id')} on :{server.port} "
-          f"(POST /v1/completions, GET /v1/models)")
-    # orchestrators stop containers with SIGTERM: treat it like Ctrl-C so
-    # in-flight requests finish and the session driver joins cleanly
+          f"(POST /v1/completions, GET /v1/models /healthz /readyz)")
+    # orchestrators stop containers with SIGTERM: run the graceful drain
+    # on a side thread WHILE serve_forever keeps answering — rejected
+    # POSTs get their fast "503 draining" instead of hanging in the
+    # listen backlog; shutdown() itself stops the accept loop last, which
+    # unblocks serve_forever below.  Ctrl-C (KeyboardInterrupt inside the
+    # accept loop) falls through to the same idempotent shutdown().
     import signal
+    import threading
 
     def _sigterm(signum, frame):
-        raise KeyboardInterrupt
+        threading.Thread(target=server.shutdown, daemon=True,
+                         name="sigterm-drain").start()
 
     signal.signal(signal.SIGTERM, _sigterm)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
-        server.shutdown()
+        pass
+    server.shutdown()       # idempotent: waits for an in-progress drain
     return 0
 
 

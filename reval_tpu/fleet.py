@@ -219,6 +219,11 @@ class FleetRunner:
             result["prefix_cache"] = trailer
             if self.progress:
                 print(f"[fleet] prefix cache: {trailer}")
+        serving = self._serving_trailer()
+        if serving:
+            result["serving"] = serving
+            if self.progress:
+                print(f"[fleet] serving lifecycle: {serving}")
         return result
 
     def _prefix_cache_trailer(self) -> dict | None:
@@ -240,3 +245,16 @@ class FleetRunner:
         if callable(gauges):
             trailer.update(gauges())
         return trailer
+
+    def _serving_trailer(self) -> dict | None:
+        """Serving-lifecycle counters for the run summary, when the
+        backend exposes an engine whose stats saw lifecycle events
+        (co-located serve + fleet, or an engine that lived through a
+        drain).  All-zero counters stay out of the summary — a plain
+        in-process fleet never shed, expired, or tripped anything."""
+        stats = getattr(getattr(self.backend, "engine", None), "stats", None)
+        counters = getattr(stats, "serving_counters", None)
+        if not callable(counters):
+            return None
+        trailer = counters()
+        return trailer if any(trailer.values()) else None

@@ -8,12 +8,19 @@ model can serve many sequential task runs (reference start_server.sh
 topology, SURVEY §3.3).
 
 Resilience: construction no longer races the server.  A wait-for-server
-handshake polls ``/healthz`` (any HTTP answer counts as "up", so servers
-predating the route still pass) until the engine finishes loading/compiling,
-and every request afterwards runs under a
-:class:`~reval_tpu.resilience.RetryPolicy` — connection resets, timeouts,
-5xx responses, and truncated JSON bodies are retried with exponential
-backoff instead of killing the launcher.
+handshake polls ``/readyz`` — not just "the port answers" but "the engine
+is loaded, the driver is stepping, and the queue has room"; a 503
+(draining, wedged, still loading) keeps polling, while a 404 from an
+older server without the route still counts as up.  Every request
+afterwards runs under a :class:`~reval_tpu.resilience.RetryPolicy` —
+connection resets, timeouts, 5xx responses, truncated JSON bodies, and
+429 load sheds are retried with exponential backoff, honoring the
+server's ``Retry-After`` hint when one is sent.
+
+Deadlines: each completion request carries ``deadline_s`` — this client's
+remaining per-request budget (``request_timeout``) — so a server that
+cannot finish in time cancels the work engine-side (freeing its batch
+slot for live traffic) instead of generating tokens nobody will read.
 """
 
 from __future__ import annotations
@@ -26,26 +33,36 @@ from .base import InferenceBackend
 
 __all__ = ["HTTPClientBackend"]
 
+# /readyz statuses that mean "server up, engine not serving yet (loading,
+# draining, overloaded)" — the handshake keeps waiting through them
+READYZ_WAIT_STATUSES = frozenset({429, 503})
+
 
 class HTTPClientBackend(InferenceBackend):
     def __init__(self, model_id: str, port: int = 3000, host: str = "localhost",
                  mock: bool = False, temp: float = 0.8, prompt_type: str = "direct",
                  retry_policy: RetryPolicy | None = None, retry: dict | None = None,
-                 wait_for_server_s: float = 600.0, **kwargs):
+                 wait_for_server_s: float = 600.0,
+                 request_timeout: float = 600.0, **kwargs):
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         self.base_url = f"http://{host}:{port}/v1"
         # ``retry`` is the config-dict spelling (run configs are JSON);
         # ``retry_policy`` the programmatic one
         self.retry = retry_policy or RetryPolicy(**(retry or {}))
+        #: per-request wall budget; also sent as the request's
+        #: ``deadline_s`` so the server stops working for a caller that
+        #: has already given up
+        self.request_timeout = float(request_timeout)
         self._server_model = model_id
         if not mock:
             # Launchers start client and server concurrently; block here
-            # until the server answers instead of crashing on the eager
+            # until the server is READY instead of crashing on the eager
             # /models probe.  The default budget is 10 minutes because the
             # engine really does spend minutes loading + compiling a big
-            # checkpoint before it binds the port.
-            wait_for_server(lambda: self._request_once("/healthz", timeout=5),
+            # checkpoint before readiness flips.
+            wait_for_server(lambda: self._request_once("/readyz", timeout=5),
                             timeout=wait_for_server_s,
+                            retry_statuses=READYZ_WAIT_STATUSES,
                             describe=f"server at {self.base_url}")
             models = self._get("/models")
             self._server_model = models["data"][0]["id"]
@@ -64,19 +81,28 @@ class HTTPClientBackend(InferenceBackend):
     def _get(self, route: str) -> dict:
         return self.retry.call(lambda: self._request_once(route))
 
-    def _post(self, route: str, payload: dict, timeout: float = 600) -> dict:
+    def _post(self, route: str, payload: dict,
+              timeout: float | None = None) -> dict:
+        timeout = self.request_timeout if timeout is None else timeout
         data = json.dumps(payload).encode()
         return self.retry.call(
             lambda: self._request_once(route, data=data, timeout=timeout))
 
-    def infer_one(self, prompt: str) -> str:
-        out = self._post("/completions", {
+    def _completion_payload(self, prompt) -> dict:
+        return {
             "model": self._server_model,
             "prompt": prompt,
             "temperature": self.temp,
             "stop": self.config.stop,
             "max_tokens": self.config.max_new_tokens,
-        })
+            # the remaining budget this client will actually wait: past
+            # it the server cancels the request engine-side (504) rather
+            # than decode into a closed socket
+            "deadline_s": self.request_timeout,
+        }
+
+    def infer_one(self, prompt: str) -> str:
+        out = self._post("/completions", self._completion_payload(prompt))
         return out["choices"][0]["text"]
 
     def infer_many(self, prompts) -> list[str]:
@@ -84,12 +110,7 @@ class HTTPClientBackend(InferenceBackend):
         batches ride one request and the engine schedules them together."""
         if not prompts:
             return []
-        out = self._post("/completions", {
-            "model": self._server_model,
-            "prompt": list(prompts),
-            "temperature": self.temp,
-            "stop": self.config.stop,
-            "max_tokens": self.config.max_new_tokens,
-        })
+        out = self._post("/completions",
+                         self._completion_payload(list(prompts)))
         choices = sorted(out["choices"], key=lambda c: c.get("index", 0))
         return [c["text"] for c in choices]

@@ -115,6 +115,10 @@ class DataParallelPagedEngine:
             agg.prefix_lookup_tokens += s.prefix_lookup_tokens
             agg.prefix_inserted_pages += s.prefix_inserted_pages
             agg.prefix_evictions += s.prefix_evictions
+            agg.sheds += s.sheds
+            agg.deadline_expired += s.deadline_expired
+            agg.watchdog_trips += s.watchdog_trips
+            agg.drain_seconds += s.drain_seconds
         return agg
 
     def prefix_cache_counters(self) -> dict:

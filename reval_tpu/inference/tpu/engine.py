@@ -88,6 +88,26 @@ def _bucket(n: int) -> int:
     return pow2_bucket(n, MIN_BUCKET)
 
 
+def clip_prompt_ids(tokenizer, prompt: str, max_new_tokens: int,
+                    max_len: int) -> list[int]:
+    """Tokenise one prompt, left-clipping so prompt + generation fits
+    ``max_len`` — the single source of the clipping/rejection rule shared
+    by the paged engine and the serving mock engine (serve --mock must
+    reject exactly what production rejects).  Raises ValueError when the
+    token budget alone exceeds the sequence capacity."""
+    limit = max_len - max_new_tokens - 1
+    if limit < 1:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
+            f"within max_seq_len={max_len}")
+    ids = tokenizer.encode(prompt)
+    if not ids:
+        ids = [tokenizer.pad_id]    # empty prompt: one pad token
+    if len(ids) > limit:
+        ids = ids[-limit:]          # clip from the left, keep the tail
+    return ids
+
+
 def truncate_at_stop(text: str, stop: list[str]) -> str:
     """Cut at the earliest stop-string occurrence (stop excluded) —
     vLLM-compatible post-detokenisation stop semantics."""
@@ -179,11 +199,26 @@ class EngineStats:
     prefix_lookup_tokens: int = 0   # prompt tokens that consulted the cache
     prefix_inserted_pages: int = 0  # pages prefilled into the cache
     prefix_evictions: int = 0       # LRU nodes evicted under pool pressure
+    # serving lifecycle (serving/session.py + serving/server.py):
+    sheds: int = 0               # submissions rejected by admission control
+    deadline_expired: int = 0    # submissions cancelled at their deadline
+    watchdog_trips: int = 0      # no-progress watchdog activations
+    drain_seconds: float = 0.0   # wall spent in graceful drain at shutdown
 
     @property
     def prefix_hit_rate(self) -> float:
         return (self.prefix_hit_tokens / self.prefix_lookup_tokens
                 if self.prefix_lookup_tokens else 0.0)
+
+    def serving_counters(self) -> dict:
+        """The lifecycle counter block every surface reports (bench JSON,
+        fleet trailer, server drain log, serve smoke) — one definition so
+        a future counter cannot be added to three surfaces and silently
+        missed on the fourth."""
+        return {"sheds": self.sheds,
+                "deadline_expired": self.deadline_expired,
+                "watchdog_trips": self.watchdog_trips,
+                "drain_seconds": round(self.drain_seconds, 3)}
 
 
 class TPUEngine:

@@ -210,6 +210,9 @@ class PagedTPUEngine:
                           else 1 + max_slots * self.max_pages_per_seq)
         self.mesh = mesh
         self.stats = EngineStats()
+        #: decode-loop progress stamp (monotonic): the serving watchdog
+        #: reads it to tell "slow but stepping" from "wedged"
+        self.heartbeat = time.monotonic()
         self._key = jax.random.PRNGKey(seed)
         self.params = params
         dtype = params["embed"].dtype
@@ -410,22 +413,14 @@ class PagedTPUEngine:
 
     def encode_clipped(self, prompt: str, max_new_tokens: int) -> list[int]:
         """Tokenise one prompt, left-clipping so prompt + generation fits
-        ``max_seq_len`` (the single source of the clipping rule — the
-        in-process ``generate`` path and the serving session both use it).
-        Raises ValueError when the token budget alone exceeds the
-        sequence capacity."""
-        max_len = self.max_pages_per_seq * self.page_size
-        limit = max_len - max_new_tokens - 1
-        if limit < 1:
-            raise ValueError(
-                f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
-                f"within max_seq_len={max_len}")
-        ids = self.tokenizer.encode(prompt)
-        if not ids:
-            ids = [self.tokenizer.pad_id]   # empty prompt: one pad token
-        if len(ids) > limit:
-            ids = ids[-limit:]      # clip from the left, keep the tail
-        return ids
+        ``max_seq_len`` (the in-process ``generate`` path and the serving
+        session both use it; the rule itself lives in
+        :func:`clip_prompt_ids`).  Raises ValueError when the token
+        budget alone exceeds the sequence capacity."""
+        from .engine import clip_prompt_ids
+
+        return clip_prompt_ids(self.tokenizer, prompt, max_new_tokens,
+                               self.max_pages_per_seq * self.page_size)
 
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
@@ -621,6 +616,7 @@ class PagedTPUEngine:
         admitted while undone requests remain (scheduler deadlock — e.g. a
         request larger than the whole pool).
         """
+        self.heartbeat = time.monotonic()
         admitted = self.rt.admit()
         if (not admitted and self.rt.num_waiting
                 and self.rt.num_running < self.max_slots
@@ -852,6 +848,8 @@ class PagedTPUEngine:
         writes always land in still-owned pages."""
         toks_dev, steps, rows, t0 = chunk
         toks_host = np.asarray(toks_dev)
+        # the fetch returned: the device demonstrably made progress
+        self.heartbeat = time.monotonic()
         now = time.perf_counter()
         # union-of-intervals: overlapped dispatch→fetch spans must not
         # double-count decode wall time

@@ -19,21 +19,28 @@ everything with nothing written.  The pieces compose:
   (repeat, task) chunks in ``results_dir``; ``fleet --resume`` skips them;
 - :class:`ChaosBackend` — deterministic, seeded fault injection (timeouts,
   HTTP 500s, truncated JSON, latency spikes) that proves the above works
-  and doubles as a hardening tool for the serving stack.
+  and doubles as a hardening tool for the serving stack;
+- :class:`EngineStepChaos` — the server-side counterpart: deterministic
+  *engine-step* faults (stalled step, mid-batch exception) injected into
+  the serving session's drive loop, so the watchdog/drain/shed paths are
+  testable in the fast tier without a TPU.
 """
 
-from .chaos import CHAOS_MODES, ChaosBackend
+from .chaos import CHAOS_MODES, ENGINE_STEP_MODES, ChaosBackend, EngineStepChaos
 from .checkpoint import FleetCheckpoint
 from .resilient import INFER_FAILED, ResilientBackend
-from .retry import RetryPolicy, retryable_error, wait_for_server
+from .retry import RetryPolicy, retry_after_hint, retryable_error, wait_for_server
 
 __all__ = [
     "CHAOS_MODES",
+    "ENGINE_STEP_MODES",
     "ChaosBackend",
+    "EngineStepChaos",
     "FleetCheckpoint",
     "INFER_FAILED",
     "ResilientBackend",
     "RetryPolicy",
+    "retry_after_hint",
     "retryable_error",
     "wait_for_server",
 ]

@@ -27,13 +27,77 @@ from __future__ import annotations
 
 import json
 import random
+import threading as _threading
 import time
 import urllib.error
 import zlib
 
-__all__ = ["CHAOS_MODES", "ChaosBackend"]
+__all__ = ["CHAOS_MODES", "ENGINE_STEP_MODES", "ChaosBackend",
+           "EngineStepChaos"]
 
 CHAOS_MODES = ("timeout", "http_500", "bad_json", "latency")
+
+ENGINE_STEP_MODES = ("stall", "error")
+
+
+class EngineStepChaos:
+    """Deterministic *engine-step* fault injection for the serving driver.
+
+    ``ChaosBackend`` exercises the transport; these faults fire INSIDE the
+    serve loop, between decode steps — the failure modes the lifecycle
+    layer exists for:
+
+    - ``stall``: the step hangs for ``stall_s`` (a wedged device dispatch);
+      with ``stall_s`` past the session's watchdog threshold this is the
+      deterministic way to make the watchdog trip in a test.
+    - ``error``: the step raises mid-batch (a device fault); the driver
+      must fail the in-flight submissions and keep serving — clients see a
+      retryable 500, never a dead loop.
+
+    The schedule is keyed on the step ordinal alone (seeded, no wall
+    clock), so a run injects the same faults at the same steps regardless
+    of timing or request interleaving.  ``max_faults`` bounds the total so
+    a retrying caller always converges.
+    """
+
+    def __init__(self, rate: float = 0.2, seed: int = 0,
+                 modes: tuple[str, ...] = ENGINE_STEP_MODES,
+                 stall_s: float = 0.05, max_faults: int | None = None,
+                 sleep=time.sleep):
+        assert 0.0 <= rate <= 1.0, f"chaos rate must be in [0, 1], got {rate}"
+        unknown = set(modes) - set(ENGINE_STEP_MODES)
+        assert not unknown, f"unknown engine-step chaos modes: {sorted(unknown)}"
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.modes = tuple(modes)
+        self.stall_s = float(stall_s)
+        self.max_faults = max_faults
+        self.sleep = sleep
+        self.steps = 0
+        self.injected: list[tuple[str, int]] = []   # (mode, step ordinal)
+        # a MultiSession shares one injector across replica drivers: the
+        # ordinal/ledger must not tear (the stall/raise happens OUTSIDE
+        # the lock so one replica's fault never blocks the others' steps)
+        self._lock = _threading.Lock()
+
+    def tick(self) -> None:
+        """Call once per engine step, BEFORE the step runs."""
+        with self._lock:
+            self.steps += 1
+            step = self.steps
+            if (self.max_faults is not None
+                    and len(self.injected) >= self.max_faults):
+                return
+            rng = random.Random((self.seed << 32) ^ (step * 0x9E3779B1))
+            if rng.random() >= self.rate:
+                return
+            mode = self.modes[rng.randrange(len(self.modes))]
+            self.injected.append((mode, step))
+        if mode == "stall":
+            self.sleep(self.stall_s)
+            return
+        raise RuntimeError(
+            f"chaos: injected engine-step fault at step {step}")
 
 
 class ChaosBackend:

@@ -20,11 +20,29 @@ import time
 import urllib.error
 from typing import Callable
 
-__all__ = ["RetryPolicy", "retryable_error", "wait_for_server"]
+__all__ = ["RetryPolicy", "retryable_error", "retry_after_hint",
+           "wait_for_server"]
 
 # Status codes worth retrying: request timeout, throttling, and the 5xx
 # family a restarting or overloaded server emits.
 RETRYABLE_HTTP_CODES = frozenset({408, 425, 429, 500, 502, 503, 504})
+
+
+def retry_after_hint(exc: BaseException) -> float | None:
+    """The server's ``Retry-After`` header on an HTTP error, in seconds
+    (None when absent/unparseable).  The serving layer sends it with 429
+    load sheds and 503 drain responses; honoring it beats blind
+    exponential backoff — the server KNOWS how deep its queue is.
+    HTTP-date forms are ignored (the in-tree server only sends seconds).
+    """
+    headers = getattr(exc, "headers", None)
+    get = getattr(headers, "get", None)
+    if get is None:
+        return None
+    try:
+        return float(get("Retry-After"))
+    except (TypeError, ValueError):
+        return None
 
 
 def retryable_error(exc: BaseException) -> bool:
@@ -66,9 +84,20 @@ class RetryPolicy:
         self.sleep = sleep
         self.rng = rng if rng is not None else random.Random()
 
-    def delay_for(self, attempt: int) -> float:
-        """Backoff before retrying after the given 0-indexed attempt."""
-        delay = min(self.base_delay * self.multiplier ** attempt, self.max_delay)
+    def delay_for(self, attempt: int,
+                  exc: BaseException | None = None) -> float:
+        """Backoff before retrying after the given 0-indexed attempt.
+
+        When the failure carries a server ``Retry-After`` hint (a 429
+        load shed or 503 drain from the serving layer), the hint wins —
+        clamped to ``max_delay``, still jittered so a shedding server's
+        whole fleet doesn't return in lockstep."""
+        hint = retry_after_hint(exc) if exc is not None else None
+        if hint is not None:
+            delay = min(max(hint, 0.0), self.max_delay)
+        else:
+            delay = min(self.base_delay * self.multiplier ** attempt,
+                        self.max_delay)
         if self.jitter:
             delay += delay * self.jitter * self.rng.random()
         return delay
@@ -86,7 +115,7 @@ class RetryPolicy:
             except Exception as exc:
                 if not self.retryable(exc) or attempt + 1 >= budget:
                     raise
-                delay = self.delay_for(attempt)
+                delay = self.delay_for(attempt, exc)
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.sleep(delay)
@@ -95,25 +124,32 @@ class RetryPolicy:
 
 def wait_for_server(probe: Callable[[], "object"], *, timeout: float = 60.0,
                     interval: float = 0.5, describe: str = "server",
+                    retry_statuses: frozenset = frozenset(),
                     clock: Callable[[], float] = time.monotonic,
                     sleep: Callable[[float], None] = time.sleep):
     """Poll ``probe()`` until the server answers or ``timeout`` elapses.
 
     Any HTTP *response* — including an error status like 404 from a server
-    predating ``/healthz`` — means the server is up, so the handshake
+    predating the probed route — means the server is up, so the handshake
     returns.  Transport errors (connection refused while the engine is
     still compiling, timeouts) keep polling; anything else is a real bug
     and propagates.
+
+    ``retry_statuses``: HTTP codes that mean "up but KEEP waiting" — the
+    readiness handshake passes ``{429, 503}`` so a probe against
+    ``/readyz`` waits through engine load, drain, and overload instead of
+    treating the 503 as arrival.
     """
     deadline = clock() + timeout
     announced = False
     while True:
         try:
             return probe()
-        except urllib.error.HTTPError:
-            return None                 # it answered: up, just no such route
         except Exception as exc:
-            if not retryable_error(exc):
+            if isinstance(exc, urllib.error.HTTPError):
+                if exc.code not in retry_statuses:
+                    return None     # it answered: up, just no such route
+            elif not retryable_error(exc):
                 raise
             if clock() >= deadline:
                 raise TimeoutError(

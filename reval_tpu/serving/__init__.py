@@ -7,10 +7,27 @@ runs over the OpenAI completions protocol (reference inference.py:106-131).
 Here the same topology is one in-tree module: :class:`EngineServer` holds
 the resident (sharded) TPU engine and speaks the same protocol to
 :class:`~reval_tpu.inference.client.HTTPClientBackend`.
+
+Lifecycle hardening lives alongside: typed serving errors (429/503/504
+with stable codes), token-denominated admission control, per-request
+deadlines, a no-progress watchdog, a readiness (``/readyz``) vs liveness
+(``/healthz``) split, and graceful drain — see ``session.py`` and
+``server.py`` docstrings, and :class:`~.mock_engine.MockStepEngine` for
+the zero-TPU smoke target behind ``serve --mock``.
 """
 
+from .errors import (
+    DeadlineExceeded,
+    Draining,
+    EngineWedged,
+    Overloaded,
+    ServingError,
+)
+from .mock_engine import MockStepEngine
 from .server import EngineServer, serve_config, warmup_engine
 from .session import ContinuousSession, MultiSession
 
 __all__ = ["EngineServer", "serve_config", "warmup_engine",
-           "ContinuousSession", "MultiSession"]
+           "ContinuousSession", "MultiSession", "MockStepEngine",
+           "ServingError", "Overloaded", "Draining", "EngineWedged",
+           "DeadlineExceeded"]

@@ -1,0 +1,68 @@
+"""Typed serving-lifecycle errors.
+
+Every failure the serving layer produces on purpose is one of these, so
+the HTTP boundary can map it to a *stable* status + error code (and the
+fleet's retry/bisection machinery can classify it) instead of leaking
+``str(exc)`` of whatever the engine raised.  The messages are authored
+here — safe to put on the wire; anything else is an internal error and
+only its request id leaves the server.
+
+- :class:`Overloaded` — admission control shed the request (HTTP 429 +
+  ``Retry-After``); the queue of pending prompt tokens is above the
+  session's watermark.  Transient by construction: back off and retry.
+- :class:`Draining` — the server/session is in graceful shutdown (503):
+  no new work, in-flight requests finish.
+- :class:`EngineWedged` — the no-progress watchdog tripped (503): the
+  engine stopped stepping, every pending submission is failed with this
+  error (never left hanging), and readiness stays down until the process
+  is replaced.
+- :class:`DeadlineExceeded` — the request's client-supplied budget ran
+  out mid-service (504); the engine-side sequence was cancelled and its
+  pages/prefix pins freed.
+
+All subclass ``RuntimeError`` so pre-existing callers that caught the
+untyped failures keep working.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ServingError", "Overloaded", "Draining", "EngineWedged",
+           "DeadlineExceeded"]
+
+
+class ServingError(RuntimeError):
+    """Base: a deliberate serving-layer failure with a stable wire code."""
+
+    status: int = 500
+    code: str = "serving_error"
+
+    def __init__(self, message: str, *, retry_after: float | None = None):
+        super().__init__(message)
+        #: seconds the client should wait before retrying (None = no hint)
+        self.retry_after = retry_after
+
+
+class Overloaded(ServingError):
+    status = 429
+    code = "overloaded"
+
+    def __init__(self, message: str, *, retry_after: float | None = 1.0):
+        super().__init__(message, retry_after=retry_after)
+
+
+class Draining(ServingError):
+    status = 503
+    code = "draining"
+
+    def __init__(self, message: str, *, retry_after: float | None = 1.0):
+        super().__init__(message, retry_after=retry_after)
+
+
+class EngineWedged(ServingError):
+    status = 503
+    code = "engine_wedged"
+
+
+class DeadlineExceeded(ServingError):
+    status = 504
+    code = "deadline_exceeded"

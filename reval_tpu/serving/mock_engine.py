@@ -1,0 +1,101 @@
+"""MockStepEngine: a host-only engine speaking the session driver contract.
+
+``serve --mock`` and the fast-tier lifecycle tests need the FULL serving
+stack — admission control, deadlines, the watchdog, graceful drain — with
+none of the jit-compile cost a real (even tiny) model pays.  This engine
+implements exactly the surface :class:`~reval_tpu.serving.session.
+ContinuousSession` drives (``encode_clipped`` / ``request_keys`` /
+``submit_request`` / ``release_request`` / ``new_drive_state`` /
+``_drive_tick`` / ``stats`` / ``heartbeat``), generating a fixed response
+string a few tokens per tick, so every lifecycle path is exercised in
+milliseconds and the chaos hooks (stalled step, mid-batch exception)
+behave exactly as they would around a real decode step.
+
+``step_s`` inserts a per-tick sleep — the knob deadline/drain tests use
+to make "mid-decode" a real, controllable interval.
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+
+__all__ = ["MockStepEngine"]
+
+
+class MockStepEngine:
+    page_size = 128
+
+    def __init__(self, response: str = "mock_model_gen", step_s: float = 0.0,
+                 tokens_per_step: int = 16, max_slots: int = 8,
+                 max_seq_len: int = 8192):
+        from ..inference.tpu.engine import EngineStats
+        from ..inference.tpu.tokenizer import ByteTokenizer
+
+        self.tokenizer = ByteTokenizer()
+        self.stats = EngineStats()
+        self.response = response
+        self.step_s = float(step_s)
+        self.tokens_per_step = int(tokens_per_step)
+        self.max_slots = int(max_slots)
+        self.max_pages_per_seq = max(1, int(max_seq_len) // self.page_size)
+        self._resp_ids = [t for t in self.tokenizer.encode(response)
+                          if t != self.tokenizer.bos_id]
+        self._next_seq = 0
+        #: submitted-but-unreleased sequences — the invariant tests assert
+        #: drops back to zero after every cancel/expiry/failure path
+        self.live = 0
+        self.heartbeat = time.monotonic()
+
+    # -- the session driver contract --------------------------------------
+    def encode_clipped(self, prompt: str, max_new_tokens: int) -> list[int]:
+        from ..inference.tpu.engine import clip_prompt_ids
+
+        return clip_prompt_ids(self.tokenizer, prompt, max_new_tokens,
+                               self.max_pages_per_seq * self.page_size)
+
+    def request_keys(self, n: int) -> np.ndarray:
+        return np.zeros((n, 2), np.uint32)
+
+    def submit_request(self, ids: list[int], max_new_tokens: int):
+        self._next_seq += 1
+        self.live += 1
+        self.stats.prefill_tokens += len(ids)
+        return self._next_seq, None
+
+    def release_request(self, seq_id: int, req) -> None:
+        self.live -= 1
+        if req is not None:
+            req.node = None
+
+    def new_drive_state(self):
+        return SimpleNamespace(active={}, dirty=True, pending=None)
+
+    def close(self) -> None:
+        pass
+
+    def _drive_tick(self, reqs: dict, st) -> None:
+        """One mock decode step: every live request gains up to
+        ``tokens_per_step`` tokens of the canned response, then EOS."""
+        self.heartbeat = time.monotonic()
+        if self.step_s:
+            time.sleep(self.step_s)
+        for seq_id, req in list(reqs.items()):
+            if req.done:
+                continue
+            pos = len(req.generated)
+            chunk = self._resp_ids[pos:pos + self.tokens_per_step]
+            if not chunk:
+                chunk = [self.tokenizer.eos_id]
+            chunk = chunk[:max(1, req.max_new - pos)]
+            req.generated.extend(chunk)
+            self.stats.generated_tokens += len(chunk)
+            if (len(req.generated) >= req.max_new
+                    or self.tokenizer.eos_id in chunk
+                    or req.scanner.hit_new(chunk)):
+                req.done = True
+                self.release_request(seq_id, req)
+            if req.notify is not None:
+                req.notify(req)

@@ -4,13 +4,29 @@ Protocol surface (exactly what the client backend + reference harness use;
 reference inference.py:110-131, start_server.sh):
 
 - ``GET /v1/models``           → ``{"data": [{"id": <model_id>}]}``
+- ``GET /healthz``             → pure LIVENESS: the process answers.
+- ``GET /readyz``              → READINESS: engine loaded, driver alive,
+  heartbeat fresh, queue below the admission watermark, not draining —
+  503 with per-condition detail otherwise (per-replica for a dp set).
+  The client handshake polls this one.
 - ``POST /v1/completions``     → prompt (string or list), ``max_tokens``,
-  ``temperature``, ``stop`` → ``{"choices": [{"index", "text"}]}``;
+  ``temperature``, ``stop``, optional ``deadline_s`` (the client's
+  remaining budget — the server cancels the request engine-side when it
+  expires) → ``{"choices": [{"index", "text"}]}``;
   with ``"stream": true`` → Server-Sent Events, one
   ``data: {"choices": [{"index", "text": <delta>}]}`` event per decode
   chunk and a final ``data: [DONE]`` — the protocol the reference's
   clients speak to vLLM's server (reference inference.py:115-131 sets
   ``stream=True`` and accumulates deltas).
+
+Overload & lifecycle semantics (serving/session.py carries the state):
+
+- admission control full → ``429`` + ``Retry-After`` (code ``overloaded``)
+- graceful drain in progress → ``503`` (code ``draining``)
+- watchdog tripped → ``503`` (code ``engine_wedged``)
+- request deadline expired → ``504`` (code ``deadline_exceeded``)
+- anything unexpected → ``500`` with a stable code + request id ONLY;
+  the stack trace goes to the server log, never the wire.
 
 Implementation notes:
 - stdlib ``ThreadingHTTPServer``; each request handles its own socket but
@@ -24,15 +40,32 @@ Implementation notes:
   prefix-stable at chunk edges, so a delta is emitted only when the new
   text extends what was already sent; a non-extending revision is held
   back until it stabilises (the common case is plain extension).
+- ``shutdown()`` is a graceful drain: stop admitting (new POSTs get 503),
+  let in-flight requests finish, join SSE workers, close the session,
+  THEN tear the listener down — and it is idempotent.
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import math
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .errors import ServingError
+
 __all__ = ["EngineServer", "serve_config"]
+
+log = logging.getLogger(__name__)
+
+MAX_BODY_BYTES = 64 << 20   # request-body cap: a garbage multi-GB POST
+                            # must die at the socket, not in the tokenizer.
+                            # 64 MB clears the fleet's fused mega-batch
+                            # (every task's prompts in ONE request) with
+                            # room; config key ``max_body_bytes`` tunes it
 
 
 def _hold_stop_prefix(text: str, stop: list[str]) -> str:
@@ -49,15 +82,90 @@ def _hold_stop_prefix(text: str, stop: list[str]) -> str:
     return text
 
 
+def _err(code: str, message: str, request_id: str | None = None) -> dict:
+    body = {"code": code, "message": message}
+    if request_id is not None:
+        body["request_id"] = request_id
+    return {"error": body}
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def _validate_request(req: dict, max_tokens_cap: int | None) -> dict:
+    """Parse + validate one completions request body.
+
+    Raises ``ValueError`` with a CLIENT-safe message (everything here is
+    authored text, never engine internals).  Garbage numerics — NaN
+    temperature, negative/zero ``top_p``, absurd ``max_tokens`` — are a
+    400, not a wedged or OOMed engine; ``max_tokens`` is clamped to the
+    engine's sequence budget."""
+    prompts = req.get("prompt", "")
+    single = isinstance(prompts, str)
+    if single:
+        prompts = [prompts]
+    if (not isinstance(prompts, list)
+            or not all(isinstance(p, str) for p in prompts)):
+        raise ValueError("'prompt' must be a string or a list of strings")
+    stop = req.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (not isinstance(stop, list)
+            or not all(isinstance(s, str) for s in stop)):
+        raise ValueError("'stop' must be a string or a list of strings")
+    max_tokens = req.get("max_tokens", 256)
+    if not _finite(max_tokens) or int(max_tokens) < 1:
+        raise ValueError(f"'max_tokens' must be a positive integer, "
+                         f"got {max_tokens!r}")
+    max_tokens = int(max_tokens)
+    if max_tokens_cap is not None:
+        # clamp, don't reject: the OpenAI protocol treats max_tokens as a
+        # budget, and the engine's own clipping keeps prompt+generation
+        # inside max_seq_len
+        max_tokens = min(max_tokens, max_tokens_cap)
+    temperature = req.get("temperature", 0.0)
+    if not _finite(temperature) or temperature < 0:
+        raise ValueError(f"'temperature' must be a finite number >= 0, "
+                         f"got {temperature!r}")
+    top_k = req.get("top_k", 0)
+    if not _finite(top_k) or int(top_k) < 0:
+        raise ValueError(f"'top_k' must be a non-negative integer, "
+                         f"got {top_k!r}")
+    top_p = req.get("top_p", 1.0)
+    if not _finite(top_p) or not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"'top_p' must be a finite number in (0, 1], "
+                         f"got {top_p!r}")
+    deadline_s = req.get("deadline_s")
+    if deadline_s is not None and (not _finite(deadline_s) or deadline_s <= 0):
+        raise ValueError(f"'deadline_s' must be a finite number > 0, "
+                         f"got {deadline_s!r}")
+    return {"prompts": prompts, "single": single, "stop": stop,
+            "max_tokens": max_tokens, "temperature": float(temperature),
+            "top_k": int(top_k), "top_p": float(top_p),
+            "stream": bool(req.get("stream", False)),
+            "deadline_s": float(deadline_s) if deadline_s is not None else None}
+
+
 class EngineServer:
     """Serve ``generate_fn(prompts, max_tokens, temperature, stop) ->
     list[str]`` over the OpenAI completions protocol.  A ``generate_fn``
-    that also accepts ``on_progress`` gets chunk-granular SSE streaming;
-    otherwise ``"stream": true`` requests receive the buffered result in
-    SSE framing."""
+    that also accepts ``on_progress`` gets chunk-granular SSE streaming
+    (``deadline_s`` likewise forwards when accepted); otherwise
+    ``"stream": true`` requests receive the buffered result in SSE
+    framing.
+
+    ``ready_fn`` (→ dict with at least ``{"ready": bool}``) backs
+    ``/readyz``; without one the route reports ready whenever the server
+    is not draining (the engine was loaded before construction).
+    ``max_tokens_cap`` clamps per-request token budgets to the engine's
+    sequence capacity."""
 
     def __init__(self, generate_fn, model_id: str, port: int = 3000,
-                 host: str = "127.0.0.1", serialize: bool = True):
+                 host: str = "127.0.0.1", serialize: bool = True,
+                 ready_fn=None, max_tokens_cap: int | None = None,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 drain_timeout_s: float = 120.0):
         # loopback by default: the endpoint is unauthenticated, and the
         # in-repo client only ever connects to localhost; pass host="0.0.0.0"
         # deliberately to expose it
@@ -71,28 +179,54 @@ class EngineServer:
 
         self.generate_fn = generate_fn
         self.model_id = model_id
-        self._streams = ("on_progress"
-                         in inspect.signature(generate_fn).parameters)
+        params = inspect.signature(generate_fn).parameters
+        self._streams = "on_progress" in params
+        self._deadlines = "deadline_s" in params
         self._lock = (threading.Lock() if serialize
                       else contextlib.nullcontext())
+        self.ready_fn = ready_fn
+        self.max_tokens_cap = max_tokens_cap
+        self.max_body_bytes = int(max_body_bytes)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._draining = threading.Event()
+        self._shutdown_lock = threading.Lock()
+        self._shutdown_started = False
+        self._shutdown_complete = threading.Event()
+        # in-flight POST handlers + SSE worker threads, tracked so a
+        # graceful drain can wait for them before tearing anything down
+        self._inflight_cv = threading.Condition()
+        self._inflight_http = 0
+        self._workers: set[threading.Thread] = set()
+        self._workers_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
-            def _send(self, code: int, payload: dict) -> None:
+            def _send(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
                 try:
                     body = json.dumps(payload).encode()
                     self.send_response(code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
+                    for key, value in (headers or {}).items():
+                        self.send_header(key, value)
                     self.end_headers()
                     self.wfile.write(body)
                 except OSError:
                     # client hung up mid-response: this handler thread is
                     # done; the engine and other requests are unaffected
                     pass
+
+            def _send_serving_error(self, exc: ServingError,
+                                    rid: str) -> None:
+                headers = None
+                if exc.retry_after is not None:
+                    headers = {"Retry-After":
+                               str(int(math.ceil(exc.retry_after)))}
+                self._send(exc.status, _err(exc.code, str(exc), rid), headers)
 
             def do_GET(self):
                 path = self.path.rstrip("/")
@@ -101,58 +235,113 @@ class EngineServer:
                                      "data": [{"id": outer.model_id,
                                                "object": "model"}]})
                 elif path in ("/healthz", "/v1/healthz"):
-                    # the client handshake polls this until the engine is
-                    # loaded; answering at all is the signal
+                    # pure LIVENESS: the process answers — even while
+                    # draining or wedged (orchestrators must not kill a
+                    # pod for being busy shutting down cleanly)
                     self._send(200, {"status": "ok",
                                      "model": outer.model_id})
+                elif path in ("/readyz", "/v1/readyz"):
+                    if outer._draining.is_set():
+                        self._send(503, {"status": "draining",
+                                         "ready": False},
+                                   {"Retry-After": "1"})
+                        return
+                    info = (outer.ready_fn() if outer.ready_fn is not None
+                            else {"ready": True})
+                    ready = bool(info.get("ready"))
+                    self._send(200 if ready else 503,
+                               {"status": "ready" if ready else "unready",
+                                **info},
+                               None if ready else {"Retry-After": "1"})
                 else:
-                    self._send(404, {"error": f"unknown route {self.path}"})
+                    self._send(404, _err("not_found",
+                                         f"unknown route {self.path}"))
 
             def do_POST(self):
                 # per-request isolation: whatever one request does, the
                 # worst outcome is its own error response — never a dead
                 # serve loop taking the whole fleet's backend with it
-                try:
-                    self._handle_post()
-                except Exception as exc:  # noqa: BLE001
-                    self._send(500, {"error": f"internal error: {exc}"})
+                rid = uuid.uuid4().hex[:12]
+                with outer._track():
+                    try:
+                        self._handle_post(rid)
+                    except Exception:  # noqa: BLE001
+                        log.exception("request %s: unhandled handler error",
+                                      rid)
+                        self._send(500, _err(
+                            "internal_error",
+                            "internal error (see server log)", rid))
 
-            def _handle_post(self):
+            def _handle_post(self, rid: str):
                 if self.path.rstrip("/") != "/v1/completions":
-                    self._send(404, {"error": f"unknown route {self.path}"})
+                    self._send(404, _err("not_found",
+                                         f"unknown route {self.path}"))
+                    return
+                if outer._draining.is_set():
+                    self._send(503, _err("draining",
+                                         "server is draining", rid),
+                               {"Retry-After": "1"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length) or b"{}")
-                    prompts = req.get("prompt", "")
-                    single = isinstance(prompts, str)
-                    if single:
-                        prompts = [prompts]
-                    stop = req.get("stop") or []
-                    if isinstance(stop, str):
-                        stop = [stop]
-                    max_tokens = int(req.get("max_tokens", 256))
-                    temperature = float(req.get("temperature", 0.0))
-                    top_k = int(req.get("top_k", 0))        # 0 = off
-                    top_p = float(req.get("top_p", 1.0))    # 1 = off
-                    stream = bool(req.get("stream", False))
-                except Exception as exc:        # malformed request → client error
-                    self._send(400, {"error": str(exc)})
+                except ValueError:
+                    self._send(400, _err("invalid_request",
+                                         "bad Content-Length", rid))
                     return
-                sampling = ({"top_k": top_k, "top_p": top_p}
-                            if (top_k > 0 or top_p < 1.0)
-                            and temperature > 0 else {})
-                if stream:
-                    self._stream(prompts, max_tokens, temperature, stop,
-                                 **sampling)
+                if length < 0:
+                    # a negative length would defeat the cap below AND
+                    # turn rfile.read(length) into read-until-EOF
+                    self._send(400, _err("invalid_request",
+                                         "bad Content-Length", rid))
+                    return
+                if length > outer.max_body_bytes:
+                    self._send(413, _err(
+                        "request_too_large",
+                        f"body of {length} bytes exceeds the "
+                        f"{outer.max_body_bytes}-byte cap", rid))
+                    return
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("request body must be a JSON object")
+                    p = _validate_request(req, outer.max_tokens_cap)
+                except ValueError as exc:   # malformed request → client error
+                    self._send(400, _err("invalid_request", str(exc), rid))
+                    return
+                except Exception:
+                    self._send(400, _err("invalid_request",
+                                         "malformed JSON body", rid))
+                    return
+                sampling = ({"top_k": p["top_k"], "top_p": p["top_p"]}
+                            if (p["top_k"] > 0 or p["top_p"] < 1.0)
+                            and p["temperature"] > 0 else {})
+                if outer._deadlines and p["deadline_s"] is not None:
+                    sampling["deadline_s"] = p["deadline_s"]
+                if p["stream"]:
+                    self._stream(p["prompts"], p["max_tokens"],
+                                 p["temperature"], p["stop"], rid, **sampling)
                     return
                 try:
                     with outer._lock:
                         texts = outer.generate_fn(
-                            prompts, max_tokens=max_tokens,
-                            temperature=temperature, stop=stop, **sampling)
-                except Exception as exc:        # engine/device fault → server error
-                    self._send(500, {"error": str(exc)})
+                            p["prompts"], max_tokens=p["max_tokens"],
+                            temperature=p["temperature"], stop=p["stop"],
+                            **sampling)
+                except ServingError as exc:
+                    # deliberate lifecycle outcome: stable code + status,
+                    # message authored by the serving layer (wire-safe)
+                    self._send_serving_error(exc, rid)
+                    return
+                except ValueError as exc:
+                    # engine-side parameter rejection (token budget larger
+                    # than the sequence capacity, …): the request's fault
+                    self._send(400, _err("invalid_request", str(exc), rid))
+                    return
+                except Exception:       # engine/device fault → server error
+                    log.exception("request %s: generation failed", rid)
+                    self._send(500, _err("internal_error",
+                                         "internal error (see server log)",
+                                         rid))
                     return
                 self._send(200, {
                     "object": "text_completion",
@@ -161,7 +350,7 @@ class EngineServer:
                                 for i, t in enumerate(texts)],
                 })
 
-            def _stream(self, prompts, max_tokens, temperature, stop,
+            def _stream(self, prompts, max_tokens, temperature, stop, rid,
                         **sampling) -> None:
                 """SSE streaming: one delta event per decode chunk.
 
@@ -191,12 +380,24 @@ class EngineServer:
                                 temperature=temperature, stop=stop, **kwargs)
                         for i, t in enumerate(texts):
                             q.put((i, t, "stop"))
-                    except Exception as exc:
-                        q.put(("error", str(exc), None))
-                    q.put(None)
+                    except ServingError as exc:
+                        q.put(("error", _err(exc.code, str(exc), rid), None))
+                    except Exception:
+                        log.exception("request %s: streaming generation "
+                                      "failed", rid)
+                        q.put(("error", _err("internal_error",
+                                             "internal error (see server "
+                                             "log)", rid), None))
+                    finally:
+                        q.put(None)
+                        with outer._workers_lock:
+                            outer._workers.discard(threading.current_thread())
 
-                threading.Thread(target=run, daemon=True,
-                                 name="sse-generate").start()
+                worker = threading.Thread(target=run, daemon=True,
+                                          name="sse-generate")
+                with outer._workers_lock:
+                    outer._workers.add(worker)
+                worker.start()
 
                 sent = [""] * len(prompts)
                 dead = False
@@ -220,7 +421,7 @@ class EngineServer:
                     if item is None:
                         break
                     if item[0] == "error":  # headers sent: in-band error
-                        event({"error": item[1]})
+                        event(item[1])
                         continue
                     i, text, reason = item
                     if reason is None:
@@ -252,6 +453,29 @@ class EngineServer:
         self.port = self._httpd.server_address[1]   # resolved if port=0
         self._thread: threading.Thread | None = None
 
+    def attach_session(self, session) -> None:
+        """Bind a :class:`ContinuousSession`/:class:`MultiSession`: its
+        readiness backs ``/readyz`` and ``shutdown()`` drains it in the
+        right order (before the listener socket closes)."""
+        self._session = session
+        if self.ready_fn is None:
+            self.ready_fn = session.readiness
+
+    def _track(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def tracked():
+            with self._inflight_cv:
+                self._inflight_http += 1
+            try:
+                yield
+            finally:
+                with self._inflight_cv:
+                    self._inflight_http -= 1
+                    self._inflight_cv.notify_all()
+        return tracked()
+
     def start(self) -> "EngineServer":
         """Serve on a daemon thread (tests, co-located runs)."""
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -264,13 +488,74 @@ class EngineServer:
         self._httpd.serve_forever()
 
     def shutdown(self) -> None:
-        self._httpd.shutdown()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._httpd.server_close()
+        """Graceful drain, idempotent.  Order matters and is the point:
+
+        1. flip ``_draining`` — new POSTs get 503 + Retry-After and
+           ``/readyz`` goes unready, so load balancers/clients move on;
+        2. wait (bounded by ``drain_timeout_s``) for in-flight request
+           handlers, then join SSE worker threads;
+        3. close the session — the driver finishes whatever the handlers
+           left in flight and releases the engine;
+        4. only THEN stop the accept loop and close the listener socket;
+        5. record ``drain_seconds`` and flush a counters summary to the
+           log (the process is about to exit — this is the last trace).
+        """
+        with self._shutdown_lock:
+            started, self._shutdown_started = self._shutdown_started, True
+        if started:
+            # concurrent/second call: wait for the first drain to finish
+            # rather than return mid-drain (a caller exiting the process
+            # on return would kill the draining thread under it)
+            self._shutdown_complete.wait()
+            return
+        try:
+            self._drain()
+        finally:
+            # an exception mid-drain must not strand every other
+            # shutdown() caller on the wait above forever
+            self._shutdown_complete.set()
+
+    def _drain(self) -> None:
+        t0 = time.monotonic()
+        self._draining.set()
+        deadline = t0 + self.drain_timeout_s
+        with self._inflight_cv:
+            while (self._inflight_http
+                   and time.monotonic() < deadline):
+                self._inflight_cv.wait(
+                    timeout=max(0.01, min(1.0, deadline - time.monotonic())))
+            leftover = self._inflight_http
+        if leftover:
+            log.warning("shutdown: %d request(s) still in flight after "
+                        "%.0fs drain budget — proceeding", leftover,
+                        self.drain_timeout_s)
+        with self._workers_lock:
+            workers = list(self._workers)
+        for worker in workers:
+            worker.join(timeout=max(0.1, deadline - time.monotonic()))
         session = getattr(self, "_session", None)
         if session is not None:
             session.close()
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+        drain = time.monotonic() - t0
+        counters: dict = {}
+        if session is not None:
+            all_stats = session.engine_stats()
+            if all_stats:
+                # ONE wall-clock drain happened: record it once (the dp
+                # stats aggregator SUMS drain_seconds over replicas, so
+                # adding it to each would report the drain dp-fold)
+                all_stats[0].drain_seconds += drain
+            for stats in all_stats:
+                for key, value in stats.serving_counters().items():
+                    counters[key] = round(counters.get(key, 0) + value, 3)
+                counters["prompts"] = counters.get("prompts", 0) + stats.prompts
+        log.info("EngineServer drained in %.3fs (lifecycle counters: %s)",
+                 drain, counters or "n/a")
 
 
 def _engine_generate_fn(engine):
@@ -290,6 +575,17 @@ def _engine_generate_fn(engine):
         return engine.generate(prompts, max_new_tokens=max_tokens,
                                temperature=temperature, stop=stop, **kwargs)
     return generate
+
+
+def _max_tokens_cap(engine) -> int | None:
+    """The largest per-request token budget ``encode_clipped`` accepts
+    (one prompt token + the clip margin must survive)."""
+    max_len = getattr(engine, "max_seq_len", None)
+    if max_len is None:
+        pages = getattr(engine, "max_pages_per_seq", None)
+        if pages:
+            max_len = pages * getattr(engine, "page_size", 128)
+    return max_len - 2 if max_len else None
 
 
 def warmup_engine(engine) -> float:
@@ -323,7 +619,7 @@ def warmup_engine(engine) -> float:
 
 
 def serve_config(cfg: dict, *, port: int | None = None,
-                 warmup: bool = False) -> EngineServer:
+                 warmup: bool = False, step_chaos=None) -> EngineServer:
     """Build the TPU engine from a run config (same keys the ``tpu``
     backend takes) and return an unstarted server bound to ``port``
     (default: config ``port`` or 3000).  ``warmup`` pre-compiles the hot
@@ -333,33 +629,74 @@ def serve_config(cfg: dict, *, port: int | None = None,
     and a dp replica set through a :class:`MultiSession` (one session per
     replica, least-loaded routing): concurrent POSTs join live decode
     batches (vLLM api_server semantics).  Other engines (static/pp/sp)
-    keep the serialised per-request path."""
-    from ..inference.tpu.backend import TPUBackend
-    from ..inference.tpu.paged_engine import PagedTPUEngine
+    keep the serialised per-request path.
 
-    backend = TPUBackend(**{k: v for k, v in cfg.items()
-                            if k not in ("task", "backend", "port", "mock")})
-    if warmup:
-        secs = warmup_engine(backend.engine)
-        print(f"warmup: generation programs compiled in {secs:.1f}s")
-    from ..inference.tpu.dp_paged import DataParallelPagedEngine
+    ``cfg["mock"]`` serves a host-only
+    :class:`~reval_tpu.serving.mock_engine.MockStepEngine` through the
+    SAME session/server stack — the zero-TPU lifecycle smoke target.
+    Lifecycle knobs ride the config: ``max_queued_tokens`` (admission
+    watermark), ``watchdog_s`` (no-progress threshold).  ``step_chaos``
+    injects engine-step faults into the session driver (hardening/tests).
+    """
+    from .session import ContinuousSession
 
     model_id = cfg.get("model_id", "reval-tpu-model")
     bind = port if port is not None else cfg.get("port", 3000)
+    lifecycle = {"max_queued_tokens": cfg.get("max_queued_tokens"),
+                 "watchdog_s": cfg.get("watchdog_s")}
+    body_cap = int(cfg.get("max_body_bytes", MAX_BODY_BYTES))
+    if cfg.get("mock"):
+        from .mock_engine import MockStepEngine
+
+        engine = MockStepEngine(
+            response=cfg.get("mock_response", "mock_model_gen"),
+            step_s=float(cfg.get("mock_step_s", 0.0)))
+        session = ContinuousSession(engine, step_chaos=step_chaos,
+                                    **lifecycle)
+        server = EngineServer(session.generate_fn(), model_id=model_id,
+                              port=bind, serialize=False,
+                              max_body_bytes=body_cap,
+                              max_tokens_cap=_max_tokens_cap(engine))
+        server.attach_session(session)
+        return server
+
+    from ..inference.tpu.backend import TPUBackend
+    from ..inference.tpu.dp_paged import DataParallelPagedEngine
+    from ..inference.tpu.paged_engine import PagedTPUEngine
+
+    backend = TPUBackend(**{k: v for k, v in cfg.items()
+                            if k not in ("task", "backend", "port", "mock",
+                                         "max_queued_tokens", "watchdog_s",
+                                         "max_body_bytes",
+                                         "mock_response", "mock_step_s")})
+    if warmup:
+        secs = warmup_engine(backend.engine)
+        print(f"warmup: generation programs compiled in {secs:.1f}s")
+
     session = None
     if isinstance(backend.engine, PagedTPUEngine):
-        from .session import ContinuousSession
-
-        session = ContinuousSession(backend.engine)
+        session = ContinuousSession(backend.engine, step_chaos=step_chaos,
+                                    **lifecycle)
+        cap = _max_tokens_cap(backend.engine)
     elif isinstance(backend.engine, DataParallelPagedEngine):
         # dp replica set: one session per replica + least-loaded routing
         from .session import MultiSession
 
-        session = MultiSession(backend.engine.replicas)
+        session = MultiSession(backend.engine.replicas,
+                               step_chaos=step_chaos, **lifecycle)
+        cap = _max_tokens_cap(backend.engine.replicas[0])
+    if session is None and step_chaos is not None:
+        # static/pp/sp engines have no session drive loop to inject into —
+        # failing loudly beats a hardening drill that silently tests nothing
+        raise ValueError("engine-step chaos requires a session-driven "
+                         "engine (paged, dp replicas, or --mock)")
     if session is not None:
         server = EngineServer(session.generate_fn(), model_id=model_id,
-                              port=bind, serialize=False)
-        server._session = session       # keep the driver threads reachable
+                              port=bind, serialize=False, max_tokens_cap=cap,
+                              max_body_bytes=body_cap)
+        server.attach_session(session)   # readiness + ordered drain
         return server
     return EngineServer(_engine_generate_fn(backend.engine),
-                        model_id=model_id, port=bind)
+                        model_id=model_id, port=bind,
+                        max_body_bytes=body_cap,
+                        max_tokens_cap=_max_tokens_cap(backend.engine))

@@ -30,16 +30,51 @@ DREval serve shape) prefills only its suffix even with one prompt per
 POST.  Cached pages are refcounted pool pages; eviction under load is
 LRU over rider-free nodes, so a busy session cannot be starved by its
 own cache.
+
+Lifecycle hardening (on top of the batching):
+
+- **Admission control.** The pending queue is bounded in *prompt tokens*
+  (`max_queued_tokens`); a submission that would push it past the
+  watermark is shed with a typed :class:`~.errors.Overloaded` (HTTP 429 +
+  Retry-After at the server) instead of growing an unbounded backlog.  A
+  submission arriving at an EMPTY queue always admits — a single batch
+  larger than the watermark must make progress, not 429 forever.
+- **Per-request deadlines.** `submit(..., deadline_s=...)` carries the
+  client's remaining budget; the driver cancels expired submissions
+  between steps via the engine's `release_request` lifecycle (pages and
+  prefix pins freed — the slot goes to a live request) and fails the
+  handle with :class:`~.errors.DeadlineExceeded`.
+- **No-progress watchdog.** The driver (and the engine's own decode loop)
+  stamp a heartbeat every step; a watchdog thread detects a stamp older
+  than `watchdog_s` while work is in flight, flips readiness, and fails
+  every pending handle with :class:`~.errors.EngineWedged` — a wedged
+  device never strands callers in `result()`.  Wedged is sticky: the
+  fleet's retry/bisection/resume machinery (resilience/) takes over and a
+  fresh process replaces this one.
+- **Readiness.** :meth:`ContinuousSession.readiness` condenses all of the
+  above (driver alive, heartbeat fresh, queue below watermark, not
+  draining/wedged) for the server's `/readyz`; `MultiSession` routes
+  around unready replicas.
+- **Chaos hook.** `step_chaos` (a
+  :class:`~reval_tpu.resilience.EngineStepChaos`) injects a stalled step
+  or mid-batch exception between decode steps, so every path above is
+  testable in the fast tier without a TPU.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 
+from .errors import DeadlineExceeded, Draining, EngineWedged, Overloaded, ServingError
+
 __all__ = ["ContinuousSession", "MultiSession"]
+
+log = logging.getLogger(__name__)
 
 
 class _Pending:
@@ -50,6 +85,7 @@ class _Pending:
         self._remaining = n
         self._event = threading.Event()
         self._error: str | None = None
+        self._exc: ServingError | None = None
         self._cb_lock = threading.Lock()
         self._callbacks: list = []
         self._fired = False
@@ -79,6 +115,8 @@ class _Pending:
         """Block until every prompt in the submission finished."""
         if not self._event.wait(timeout):
             raise TimeoutError("generation did not finish in time")
+        if self._exc is not None:
+            raise self._exc
         if self._error is not None:
             raise RuntimeError(self._error)
         return self.texts  # type: ignore[return-value]
@@ -91,15 +129,16 @@ def _generate_fn_for(submitter):
     """EngineServer ``generate_fn`` over any ``submit(...) -> _Pending``
     owner (single session or replica set) — pass ``serialize=False``."""
     def generate(prompts, *, max_tokens, temperature, stop,
-                 top_k=0, top_p=1.0, on_progress=None):
+                 top_k=0, top_p=1.0, on_progress=None, deadline_s=None):
         return submitter.submit(prompts, max_new_tokens=max_tokens,
                                 temperature=temperature, stop=stop,
                                 top_k=top_k, top_p=top_p,
-                                on_progress=on_progress).result()
+                                on_progress=on_progress,
+                                deadline_s=deadline_s).result()
     return generate
 
 
-@dataclass
+@dataclass(eq=False)           # identity hash: submissions live in sets
 class _Submission:
     prompts: list[str]
     max_new: int
@@ -109,6 +148,12 @@ class _Submission:
     top_k: int = 0
     top_p: float = 1.0
     pending: _Pending = field(init=False)
+    #: token ids per prompt, encoded in the SUBMITTING thread (admission
+    #: control needs the counts before the driver ever sees this)
+    encoded: list = field(init=False, default_factory=list)
+    tokens: int = field(init=False, default=0)
+    #: monotonic-clock expiry (None = no deadline)
+    deadline: float | None = field(init=False, default=None)
 
     def __post_init__(self):
         self.pending = _Pending(len(self.prompts))
@@ -123,18 +168,50 @@ class ContinuousSession:
 
     ``autostart=False`` lets tests enqueue several submissions first and
     then start the driver, making the fused-admission path deterministic.
+
+    ``max_queued_tokens``: admission-control watermark in pending prompt
+    tokens (default ``REVAL_TPU_MAX_QUEUED_TOKENS`` or 4 × slots ×
+    max_seq_len).  ``watchdog_s``: no-progress threshold (default
+    ``REVAL_TPU_WATCHDOG_S`` or 120 s — generously above a worst-case
+    first-request jit compile; 0 disables).  ``step_chaos``: an
+    :class:`~reval_tpu.resilience.EngineStepChaos` fault injector run
+    before every engine step.
     """
 
-    def __init__(self, engine, autostart: bool = True):
+    def __init__(self, engine, autostart: bool = True, *,
+                 max_queued_tokens: int | None = None,
+                 watchdog_s: float | None = None, step_chaos=None):
         self.engine = engine
         self._inbox: queue.Queue = queue.Queue()
         self._closed = threading.Event()
+        self._wedged = threading.Event()
         # serialises the closed-check against the inbox put: without it a
         # submit() could check "open", lose the CPU, and land its put after
         # close()'s sentinel let the driver exit — a handle nobody ever
         # resolves (and a server handler blocked forever on result())
         self._submit_lock = threading.Lock()
         self._thread: threading.Thread | None = None
+        self._step_chaos = step_chaos
+        # -- admission control ---------------------------------------------
+        max_seq = (getattr(engine, "max_pages_per_seq", 64)
+                   * getattr(engine, "page_size", 128))
+        if max_queued_tokens is None:
+            max_queued_tokens = (
+                int(os.environ.get("REVAL_TPU_MAX_QUEUED_TOKENS", 0))
+                or 4 * getattr(engine, "max_slots", 8) * max_seq)
+        self.max_queued_tokens = int(max_queued_tokens)
+        self._acct_lock = threading.Lock()
+        self._queued_tokens = 0
+        #: submissions whose handle has not resolved yet — what the
+        #: watchdog fails on a trip (the driver's reqs/origin are locals)
+        self._inflight: set[_Submission] = set()
+        # -- watchdog -------------------------------------------------------
+        if watchdog_s is None:
+            watchdog_s = float(os.environ.get("REVAL_TPU_WATCHDOG_S", "120"))
+        self.watchdog_s = max(0.0, float(watchdog_s))
+        self._heartbeat = time.monotonic()
+        self._watch_stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
         if autostart:
             self.start()
 
@@ -142,22 +219,72 @@ class ContinuousSession:
     def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
                temperature: float = 0.0, stop: list[str] | None = None,
                top_k: int = 0, top_p: float = 1.0,
-               on_progress=None) -> _Pending:
+               on_progress=None, deadline_s: float | None = None) -> _Pending:
         """Enqueue a prompt batch; returns a handle whose ``result()``
         blocks until all its prompts finish.  ``on_progress(index, text)``
         streams finalised-so-far text at decode-chunk granularity (same
-        contract as ``PagedTPUEngine.generate``)."""
+        contract as ``PagedTPUEngine.generate``).  ``deadline_s`` is the
+        caller's remaining budget: past it the driver cancels the
+        submission engine-side and the handle raises
+        :class:`DeadlineExceeded`.
+
+        Raises :class:`Overloaded` when the pending-token queue is above
+        the watermark, :class:`Draining` after :meth:`close`,
+        :class:`EngineWedged` after a watchdog trip, and ``ValueError``
+        for a token budget no prompt could ever fit (a client error — the
+        server maps it to 400)."""
         sub = _Submission(list(prompts), max_new_tokens, float(temperature),
                           list(stop or []), on_progress,
                           top_k=int(top_k), top_p=float(top_p))
         if not sub.prompts:
             sub.pending._fire()
             return sub.pending
+        if self._wedged.is_set():
+            raise EngineWedged("engine watchdog tripped; session is not serving")
+        # tokenise in the caller's thread: token-denominated admission
+        # control needs the counts before the driver sees the submission,
+        # and it keeps tokenisation off the driver's critical path
+        sub.encoded = [self.engine.encode_clipped(p, max_new_tokens)
+                       for p in sub.prompts]
+        sub.tokens = sum(len(ids) for ids in sub.encoded)
+        if deadline_s is not None:
+            sub.deadline = time.monotonic() + float(deadline_s)
+        with self._acct_lock:
+            # shed only when a backlog exists: a lone submission bigger
+            # than the watermark must run (bounded per-sequence anyway),
+            # not bounce forever
+            if (self._queued_tokens
+                    and self._queued_tokens + sub.tokens > self.max_queued_tokens):
+                self.engine.stats.sheds += 1
+                raise Overloaded(
+                    f"pending queue full: {self._queued_tokens} prompt tokens "
+                    f"queued (watermark {self.max_queued_tokens})",
+                    retry_after=self._retry_after_locked())
+            self._queued_tokens += sub.tokens
+            self._inflight.add(sub)
+        sub.pending._add_done_callback(lambda: self._release_acct(sub))
         with self._submit_lock:
             if self._closed.is_set():
-                raise RuntimeError("session is closed")
+                self._release_acct(sub)
+                raise Draining("session is closed")
+            if self._wedged.is_set():
+                self._release_acct(sub)
+                raise EngineWedged(
+                    "engine watchdog tripped; session is not serving")
             self._inbox.put(sub)
         return sub.pending
+
+    def _retry_after_locked(self) -> float:
+        """Retry-After hint under ``_acct_lock``: ~0.5 s per 2k queued
+        tokens — rough, but it scales the fleet's backoff with the
+        backlog instead of hammering a saturated server."""
+        return round(min(30.0, max(0.5, self._queued_tokens / 4096.0)), 2)
+
+    def _release_acct(self, sub: _Submission) -> None:
+        with self._acct_lock:
+            if sub in self._inflight:
+                self._inflight.discard(sub)
+                self._queued_tokens -= sub.tokens
 
     def generate_fn(self):
         """A ``generate_fn`` for :class:`EngineServer` — blocking per
@@ -165,12 +292,76 @@ class ContinuousSession:
         must NOT serialise them (pass ``serialize=False``)."""
         return _generate_fn_for(self)
 
+    # -- readiness ---------------------------------------------------------
+    def _accepting(self) -> bool:
+        return not (self._wedged.is_set() or self._closed.is_set())
+
+    def readiness(self) -> dict:
+        """Readiness snapshot for ``/readyz``: engine loaded (a session
+        implies it), driver alive, heartbeat fresh, queue below the
+        watermark, not draining or wedged."""
+        hb = max(self._heartbeat, getattr(self.engine, "heartbeat", 0.0))
+        hb_age = time.monotonic() - hb
+        alive = self._thread is not None and self._thread.is_alive()
+        with self._acct_lock:
+            queued = self._queued_tokens
+            busy = bool(self._inflight)
+        stale = bool(busy and self.watchdog_s and hb_age > self.watchdog_s)
+        ready = (alive and self._accepting() and not stale
+                 and queued < self.max_queued_tokens)
+        return {"ready": ready, "driver_alive": alive,
+                "wedged": self._wedged.is_set(),
+                "draining": self._closed.is_set(),
+                "heartbeat_age_s": round(hb_age, 3),
+                "queued_tokens": queued,
+                "max_queued_tokens": self.max_queued_tokens}
+
+    def engine_stats(self) -> list:
+        return [self.engine.stats]
+
+    # -- watchdog ----------------------------------------------------------
+    def _watch(self) -> None:
+        interval = max(0.02, min(1.0, (self.watchdog_s or 1.0) / 4))
+        while not self._watch_stop.wait(interval):
+            with self._acct_lock:
+                busy = bool(self._inflight)
+            if not busy:
+                continue
+            hb = max(self._heartbeat, getattr(self.engine, "heartbeat", 0.0))
+            if time.monotonic() - hb > self.watchdog_s:
+                self.trip_watchdog()
+
+    def trip_watchdog(self) -> None:
+        """Declare the engine wedged: flip readiness, fail every pending
+        handle with a typed error (no caller is ever left hanging), and
+        stop accepting submissions.  Sticky — recovery is a new process;
+        the driver releases engine-side sequences if/when it unsticks."""
+        with self._acct_lock:
+            if self._wedged.is_set():
+                return
+            self._wedged.set()
+            pending = list(self._inflight)
+        self.engine.stats.watchdog_trips += 1
+        log.error("ContinuousSession %#x: engine made no progress for "
+                  ">%.1fs — watchdog tripped, failing %d pending "
+                  "submission(s)", id(self), self.watchdog_s, len(pending))
+        exc = EngineWedged(
+            f"engine made no progress for >{self.watchdog_s:.1f}s "
+            f"(watchdog tripped)")
+        for sub in pending:
+            self._resolve_error(sub, exc)
+
     # -- driver side -------------------------------------------------------
     def start(self) -> "ContinuousSession":
         if self._thread is None:
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name="paged-session-driver")
             self._thread.start()
+        if (self._watch_thread is None and self.watchdog_s
+                and not self._watch_stop.is_set()):
+            self._watch_thread = threading.Thread(
+                target=self._watch, daemon=True, name="paged-session-watchdog")
+            self._watch_thread.start()
         return self
 
     def close(self) -> None:
@@ -179,6 +370,7 @@ class ContinuousSession:
         with self._submit_lock:
             self._closed.set()
             self._inbox.put(None)       # wake a blocked driver
+        joined = True
         if self._thread is not None:
             self._thread.join(timeout=120)
             if self._thread.is_alive():
@@ -196,8 +388,13 @@ class ContinuousSession:
                     "ContinuousSession %#x driver did not exit within "
                     "120s; engine is still owned by the driver thread "
                     "(call close() again to re-join)", id(self))
-                return
-            self._thread = None
+                joined = False
+            else:
+                self._thread = None
+        if joined and self._watch_thread is not None:
+            self._watch_stop.set()
+            self._watch_thread.join(timeout=5)
+            self._watch_thread = None
 
     def __enter__(self) -> "ContinuousSession":
         return self.start()
@@ -220,25 +417,50 @@ class ContinuousSession:
                     return
                 if sub is None:
                     return
+                if self._wedged.is_set():
+                    # enqueued before the trip flag landed: reject, never
+                    # hand work to a wedged engine
+                    self._resolve_error(sub, EngineWedged(
+                        "engine watchdog tripped; session is not serving"))
+                    continue
                 try:
                     self._enqueue(sub, reqs, origin)
                 except Exception as exc:   # oversized request etc.
                     # roll back any of THIS submission's already-queued
                     # sequences so they don't decode into a dead handle
-                    self._fail(sub, str(exc), reqs, origin)
-                    sub.pending._error = str(exc)
-                    sub.pending._fire()
+                    self._fail(sub, exc, reqs, origin, st)
+                    self._resolve_error(sub, exc)
                 if block:
                     return                  # got work; go run a tick
 
         while True:
+            # heartbeat: one stamp per loop iteration — every decode step
+            # and every idle poll.  The watchdog reads the max of this and
+            # the engine's own in-tick stamp.
+            self._heartbeat = time.monotonic()
+            if self._wedged.is_set():
+                # watchdog tripped while we were stuck: the handles are
+                # already failed; release engine-side sequences so pages
+                # and prefix pins free, then only drain-and-reject
+                if reqs:
+                    self._fail(None, EngineWedged(
+                        "engine watchdog tripped"), reqs, origin, st)
+                if self._closed.is_set() and self._inbox.empty():
+                    return
+                drain(block=True)
+                continue
             if not reqs:
                 if self._closed.is_set() and self._inbox.empty():
                     return
                 drain(block=True)
                 continue
             drain(block=False)
+            self._expire_deadlines(reqs, origin, st)
+            if not reqs:
+                continue
             try:
+                if self._step_chaos is not None:
+                    self._step_chaos.tick()
                 eng._drive_tick(reqs, st)
             except RuntimeError as exc:
                 if "deadlock" in str(exc):
@@ -249,16 +471,16 @@ class ContinuousSession:
                     head = min((s for s, r in reqs.items() if not r.done),
                                default=None)
                     if head is not None:
-                        self._fail(origin[head][0], str(exc), reqs, origin)
+                        self._fail(origin[head][0], exc, reqs, origin, st)
                         st.dirty = True
                         continue
-                self._fail(None, str(exc), reqs, origin)
+                self._fail(None, exc, reqs, origin)
                 st = eng.new_drive_state()
                 continue
             except Exception as exc:
-                # device fault: fail every in-flight submission, release
-                # their sequences, start clean
-                self._fail(None, str(exc), reqs, origin)
+                # device fault (or injected engine-step chaos): fail every
+                # in-flight submission, release their sequences, start clean
+                self._fail(None, exc, reqs, origin)
                 st = eng.new_drive_state()
                 continue
             for seq_id in [s for s, r in reqs.items() if r.done]:
@@ -273,10 +495,42 @@ class ContinuousSession:
                 if sub.pending._remaining == 0:
                     sub.pending._fire()
 
-    def _fail(self, target: _Submission | None, msg: str, reqs: dict,
-              origin: dict) -> None:
+    def _expire_deadlines(self, reqs: dict, origin: dict, st) -> None:
+        """Cancel submissions whose deadline passed: release their
+        scheduler sequences (pages + prefix pins free for live work) and
+        fail the handle with :class:`DeadlineExceeded`."""
+        now = time.monotonic()
+        expired = {sub for sub, _ in origin.values()
+                   if sub.deadline is not None and now >= sub.deadline}
+        if not expired:
+            return
+        # land any in-flight pipelined chunk's writes BEFORE releasing
+        # pages it may still target
+        flush = getattr(self.engine, "_process_pending", None)
+        if flush is not None:
+            flush(reqs, st)
+        for sub in expired:
+            self.engine.stats.deadline_expired += 1
+            self._fail(sub, DeadlineExceeded(
+                "request deadline exceeded before generation finished"),
+                reqs, origin, st)
+
+    @staticmethod
+    def _resolve_error(sub: _Submission, exc: BaseException) -> None:
+        if sub.pending.done():
+            return
+        if isinstance(exc, ServingError):
+            sub.pending._exc = exc
+        sub.pending._error = str(exc)
+        sub.pending._fire()
+
+    def _fail(self, target: _Submission | None, exc: BaseException,
+              reqs: dict, origin: dict, st=None) -> None:
         """Error ``target``'s pending handle (or every submission when
-        ``target`` is None), releasing its scheduler sequences."""
+        ``target`` is None), releasing its scheduler sequences.  With
+        ``st`` given, the released sequences are also dropped from the
+        drive state's active slots (a deadline can expire a RUNNING
+        request; the engine must not keep decoding into a freed slot)."""
         eng = self.engine
         for seq_id in list(reqs):
             sub, _ = origin[seq_id]
@@ -289,21 +543,24 @@ class ContinuousSession:
                     eng.release_request(seq_id, req)
                 except Exception:
                     pass
-            if not sub.pending.done():
-                sub.pending._error = msg
-                sub.pending._fire()
+            if st is not None:
+                active = getattr(st, "active", None) or {}
+                for slot, sid in list(active.items()):
+                    if sid == seq_id:
+                        active.pop(slot)
+                        st.dirty = True
+            self._resolve_error(sub, exc)
 
     def _enqueue(self, sub: _Submission, reqs: dict,
                  origin: dict) -> None:
-        """Tokenise + hand a submission's prompts to the native scheduler
-        (driver thread only — the runtime is single-owner)."""
+        """Hand a submission's (already tokenised) prompts to the native
+        scheduler (driver thread only — the runtime is single-owner)."""
         from ..inference.tpu.engine import StopScanner, finalize_text
         from ..inference.tpu.paged_engine import _Request
 
         eng = self.engine
         keys = eng.request_keys(len(sub.prompts))
-        for pos, prompt in enumerate(sub.prompts):
-            ids = eng.encode_clipped(prompt, sub.max_new)
+        for pos, ids in enumerate(sub.encoded):
             notify = None
             if sub.on_progress is not None:
                 def notify(req, _sub=sub, _pos=pos):
@@ -333,10 +590,28 @@ class MultiSession:
     releases when its handle resolves (the ``_Pending`` done-callback),
     so a replica stuck on long generations stops receiving work — the
     serve-side analog of the in-process work-stealing queue
-    (inference/tpu/dp_paged.py)."""
+    (inference/tpu/dp_paged.py).
 
-    def __init__(self, engines, autostart: bool = True):
-        self.sessions = [ContinuousSession(e, autostart=autostart)
+    Routing skips replicas that stopped accepting (wedged watchdog,
+    draining) outright, and prefers READY replicas (queue below
+    watermark, fresh heartbeat, live driver) over merely-accepting ones —
+    a replica drowning in queued tokens must not shed a request a
+    sibling had room for.  One bad replica degrades capacity, not
+    availability.  When NO replica accepts, the typed error reflects why
+    (wedged beats draining), so the server returns the right status.
+
+    ``step_chaos`` is shared across the replica drivers (the step ordinal
+    is then process-global, so cross-replica fault placement depends on
+    scheduling — single-session runs keep the fully deterministic
+    schedule)."""
+
+    def __init__(self, engines, autostart: bool = True, *,
+                 max_queued_tokens: int | None = None,
+                 watchdog_s: float | None = None, step_chaos=None):
+        self.sessions = [ContinuousSession(e, autostart=autostart,
+                                           max_queued_tokens=max_queued_tokens,
+                                           watchdog_s=watchdog_s,
+                                           step_chaos=step_chaos)
                          for e in engines]
         self._load = [0] * len(self.sessions)
         self._lock = threading.Lock()
@@ -349,10 +624,24 @@ class MultiSession:
     def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
                temperature: float = 0.0, stop: list[str] | None = None,
                top_k: int = 0, top_p: float = 1.0,
-               on_progress=None) -> _Pending:
+               on_progress=None, deadline_s: float | None = None) -> _Pending:
         n = len(prompts)
         with self._lock:
-            i = min(range(len(self.sessions)), key=self._load.__getitem__)
+            accepting = [i for i, s in enumerate(self.sessions)
+                         if s._accepting()]
+            if not accepting:
+                if any(s._wedged.is_set() for s in self.sessions):
+                    raise EngineWedged("no replica is serving (watchdog tripped)")
+                raise Draining("all replicas are draining/closed")
+            # prefer READY replicas (queue below watermark, heartbeat
+            # fresh, driver alive): an overloaded/stale replica must not
+            # shed or stall a request a sibling has room for.  Fall back
+            # to merely-accepting replicas so the typed shed/wedge error
+            # still comes from a real submit when everyone is saturated.
+            ready = [i for i in accepting
+                     if self.sessions[i].readiness()["ready"]]
+            pool = ready or accepting
+            i = min(pool, key=self._load.__getitem__)
             self._load[i] += n
 
         def release() -> None:
@@ -363,9 +652,9 @@ class MultiSession:
             pending = self.sessions[i].submit(
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, stop=stop, top_k=top_k, top_p=top_p,
-                on_progress=on_progress)
+                on_progress=on_progress, deadline_s=deadline_s)
         except Exception:
-            release()                   # closed session etc.: no leak
+            release()                   # closed/shedding session etc.: no leak
             raise
         pending._add_done_callback(release)
         return pending
@@ -374,6 +663,15 @@ class MultiSession:
         """See :meth:`ContinuousSession.generate_fn` — pass
         ``serialize=False`` to the server."""
         return _generate_fn_for(self)
+
+    def readiness(self) -> dict:
+        """Per-replica readiness; the set is ready while ANY replica is
+        (degraded capacity still serves)."""
+        reps = [s.readiness() for s in self.sessions]
+        return {"ready": any(r["ready"] for r in reps), "replicas": reps}
+
+    def engine_stats(self) -> list:
+        return [s.engine.stats for s in self.sessions]
 
     def close(self) -> None:
         for s in self.sessions:

@@ -103,7 +103,9 @@ def test_real_engine_behind_server():
 
 def test_engine_fault_returns_500():
     """Internal generate failures are server errors (500), not client
-    errors — only malformed requests get 400 (advisor finding)."""
+    errors — only malformed requests get 400 (advisor finding).  The body
+    carries a stable code + request id, never the raw exception text
+    (that stays in the server log)."""
     import json as _json
     import urllib.error
     import urllib.request
@@ -124,7 +126,11 @@ def test_engine_fault_returns_500():
             assert False, "expected HTTPError"
         except urllib.error.HTTPError as e:
             assert e.code == 500
-            assert "device fell over" in e.read().decode()
+            raw = e.read().decode()
+            body = _json.loads(raw)
+            assert body["error"]["code"] == "internal_error"
+            assert body["error"]["request_id"]
+            assert "device fell over" not in raw     # no leaked internals
     finally:
         srv.shutdown()
 

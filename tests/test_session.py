@@ -135,14 +135,14 @@ def test_mixed_temperature_one_batch(tiny):
 
 
 def test_oversized_request_fails_only_itself(tiny):
-    """A request that cannot ever fit errors its own handle; the session
-    keeps serving others."""
+    """A request whose token budget cannot ever fit is rejected AT SUBMIT
+    (a client error — the server maps it to 400); the session keeps
+    serving others."""
     eng = make_engine(tiny)
     try:
         session = ContinuousSession(eng)
-        bad = session.submit(["x"], max_new_tokens=10_000, temperature=0.0)
-        with pytest.raises(RuntimeError):
-            bad.result(timeout=300)
+        with pytest.raises(ValueError):
+            session.submit(["x"], max_new_tokens=10_000, temperature=0.0)
         ok = session.submit([PROMPTS[1]], max_new_tokens=8, temperature=0.0)
         assert isinstance(ok.result(timeout=300)[0], str)
         session.close()
