@@ -595,12 +595,11 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     prefix_cache = None
     if prefix_sharing and eng.prefix_cache is not None:
         # the timed pass ran against the warm cache: its counters ARE the
-        # steady-state fleet-repeat numbers
+        # steady-state fleet-repeat numbers.  Same base dict as the fleet
+        # trailer (EngineStats.prefix_counters), plus the bench-only
+        # cold/warm comparison.
         prefix_cache = {
-            "hit_tokens": stats.prefix_hit_tokens,
-            "hit_rate": round(stats.prefix_hit_rate, 4),
-            "evictions": stats.prefix_evictions,
-            "inserted_pages": stats.prefix_inserted_pages,
+            **stats.prefix_counters(),
             "cold_prefill_tokens": cold_prefill_tokens,
             "warm_prefill_tokens": stats.prefill_tokens,
             "warm_prefill_reduction": round(
@@ -681,12 +680,21 @@ def main() -> None:
     ap.add_argument("--tiny", action="store_true",
                     help="toy model + short budgets: CPU smoke test of the "
                          "bench harness itself, NOT a performance number")
+    ap.add_argument("--no-obs", action="store_true",
+                    help="disable latency-histogram observation "
+                         "(REVAL_TPU_OBS=0) — the A/B that prices the "
+                         "observability layer's hot-path cost (PERF.md); "
+                         "counters stay on (engine accounting needs them)")
     ap.add_argument("--no-autotune", action="store_true",
                     help="ignore tpu_watch/autotune.json — REQUIRED for "
                          "A/B candidate runs, which must measure exactly "
                          "their pinned config (a decision feeding back "
                          "into its own candidates oscillates on noise)")
     args = ap.parse_args()
+
+    if args.no_obs:
+        # before any engine construction: EngineStats reads it once
+        os.environ["REVAL_TPU_OBS"] = "0"
 
     chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
 
@@ -873,7 +881,13 @@ def main() -> None:
             # (sheds = 429 load sheds, deadline_expired = engine-side
             # request cancels, watchdog_trips = no-progress trips)
             "serving": stats.serving_counters(),
+            # per-request latency distributions from the timed pass:
+            # TTFT/TPOT/e2e/queue-wait p50/p95/p99 — the SLO lens the
+            # serving studies use (empty under --no-obs)
+            "latency": stats.latency_summary(),
         }
+        if args.no_obs:
+            extras["obs_disabled"] = True
         if cache_row is not None:
             extras["prefix_cache"] = cache_row
 

@@ -408,11 +408,15 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
     """Self-contained serve-path smoke (the tier-1 regression canary for
     the serving lifecycle, mirroring `fleet --mock --chaos`): post ``n``
     prompts CONCURRENTLY through the resilient HTTP client against the
-    just-built server — engine-step chaos applies — then gracefully
-    drain and print one JSON summary line with the lifecycle counters."""
+    just-built server — engine-step chaos applies — then scrape and
+    VERIFY ``/metrics`` (exposition grammar parses, every request shows
+    up in the request counter and the ttft/e2e histograms), gracefully
+    drain, and print one JSON summary line with the lifecycle counters."""
     import threading
+    import urllib.request
 
     from .inference.client import HTTPClientBackend
+    from .obs.metrics import parse_prometheus
 
     server.start()
     client = HTTPClientBackend(
@@ -435,18 +439,43 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
         t.start()
     for t in threads:
         t.join(timeout=120)
+    # scrape BEFORE the drain (the listener closes during shutdown) and
+    # self-verify: the smoke is the tier-1 canary for /metrics too
+    obs = {"metrics_ok": False, "requests_total": 0,
+           "ttft_count": 0, "e2e_count": 0}
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=10) as r:
+            samples = parse_prometheus(r.read().decode())
+        obs.update(
+            metrics_ok=True,
+            requests_total=int(samples.get("reval_requests_total", 0)),
+            ttft_count=int(samples.get("reval_request_ttft_seconds_count", 0)),
+            e2e_count=int(samples.get("reval_request_e2e_seconds_count", 0)))
+    except Exception as exc:  # noqa: BLE001 — summarised below
+        errors.append(f"/metrics: {exc!r}")
     server.shutdown()
     session = getattr(server, "_session", None)
     counters = (session.engine_stats()[0].serving_counters()
                 if session is not None else {})   # session-less engines:
                                                   # no lifecycle counters
     summary = {
-        "served": len(outs), "errors": len(errors), **counters,
+        "served": len(outs), "errors": len(errors), **counters, **obs,
         "chaos_injected": len(step_chaos.injected) if step_chaos else 0,
     }
+    if server.trace_out:
+        summary["trace_out"] = server.trace_out
     print(json.dumps(summary))
-    if errors or len(outs) != n:
-        print(f"[smoke] failures: {errors[:3]}")
+    # chaos-free runs must account for every request in the histograms;
+    # under injected faults retries legitimately shift the counts
+    metrics_bad = (not obs["metrics_ok"]
+                   or (step_chaos is None
+                       and not (obs["requests_total"] >= n
+                                and obs["ttft_count"] >= n
+                                and obs["e2e_count"] >= n)))
+    if errors or len(outs) != n or metrics_bad:
+        print(f"[smoke] failures: {errors[:3]}"
+              + (" [metrics check failed]" if metrics_bad else ""))
         return 1
     return 0
 
@@ -480,8 +509,14 @@ def run_serve(argv: list[str]) -> int:
                         help="seed for the engine-step fault schedule")
     parser.add_argument("--smoke", type=int, default=None, metavar="N",
                         help="self-test: serve N concurrent prompts through "
-                             "the resilient client, drain gracefully, print a "
-                             "JSON counter summary, exit")
+                             "the resilient client, verify /metrics covers "
+                             "them, drain gracefully, print a JSON counter "
+                             "summary, exit")
+    parser.add_argument("--trace-out", default=None, metavar="PATH",
+                        help="write a Chrome-trace/Perfetto JSON of per-"
+                             "request span trees (queue wait, first token, "
+                             "decode) here at shutdown; ids follow "
+                             "X-Request-Id")
     args = parser.parse_args(argv)
     cfg = {}
     if os.path.exists(args.input):
@@ -492,6 +527,8 @@ def run_serve(argv: list[str]) -> int:
         return 1
     if args.mock:
         cfg["mock"] = True
+    if args.trace_out:
+        cfg["trace_out"] = args.trace_out
     step_chaos = None
     if args.chaos_step:
         from .resilience import EngineStepChaos
@@ -506,7 +543,8 @@ def run_serve(argv: list[str]) -> int:
     if args.smoke is not None:
         return _serve_smoke(server, cfg, args.smoke, step_chaos)
     print(f"serving {cfg.get('model_id')} on :{server.port} "
-          f"(POST /v1/completions, GET /v1/models /healthz /readyz)")
+          f"(POST /v1/completions, GET /v1/models /healthz /readyz "
+          f"/metrics /statusz)")
     # orchestrators stop containers with SIGTERM: run the graceful drain
     # on a side thread WHILE serve_forever keeps answering — rejected
     # POSTs get their fast "503 draining" instead of hanging in the

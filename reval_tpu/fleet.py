@@ -224,6 +224,15 @@ class FleetRunner:
             result["serving"] = serving
             if self.progress:
                 print(f"[fleet] serving lifecycle: {serving}")
+        latency = self._latency_trailer()
+        if latency:
+            result["latency"] = latency
+            if self.progress:
+                for name, row in latency.items():
+                    print(f"[fleet] latency {name}: "
+                          f"p50={row['p50']}s p95={row['p95']}s "
+                          f"p99={row['p99']}s (n={row['count']})")
+        self._write_metrics_snapshot(result)
         return result
 
     def _prefix_cache_trailer(self) -> dict | None:
@@ -235,12 +244,9 @@ class FleetRunner:
         stats = getattr(engine, "stats", None)
         if stats is None or not getattr(stats, "prefix_lookup_tokens", 0):
             return None
-        trailer = {
-            "hit_tokens": stats.prefix_hit_tokens,
-            "hit_rate": round(stats.prefix_hit_rate, 4),
-            "evictions": stats.prefix_evictions,
-            "inserted_pages": stats.prefix_inserted_pages,
-        }
+        # the SAME dict bench JSON renders (EngineStats.prefix_counters —
+        # the serving_counters sibling), so the two surfaces cannot drift
+        trailer = dict(stats.prefix_counters())
         gauges = getattr(engine, "prefix_cache_counters", None)
         if callable(gauges):
             trailer.update(gauges())
@@ -258,3 +264,48 @@ class FleetRunner:
             return None
         trailer = counters()
         return trailer if any(trailer.values()) else None
+
+    def _latency_trailer(self) -> dict | None:
+        """p50/p95/p99 of the engine's request-latency histograms (TTFT,
+        TPOT, e2e, queue-wait) — distributions, not averages, are the
+        operative serving SLOs (Comparative Analysis of vLLM and TGI,
+        PAPERS.md).  None when the backend exposes no instrumented
+        engine (HTTP/mock fleets) or obs was disabled."""
+        stats = getattr(getattr(self.backend, "engine", None), "stats", None)
+        summary = getattr(stats, "latency_summary", None)
+        if not callable(summary):
+            return None
+        return summary() or None
+
+    def _write_metrics_snapshot(self, result: dict) -> None:
+        """Persist the engine's full metrics registry next to the fleet
+        checkpoint journal (<results_dir>/fleet_metrics.json): the run's
+        distributions survive for ``tools/obs_report.py`` (one snapshot
+        renders; two diff — e.g. before/after a scheduler change)."""
+        stats = getattr(getattr(self.backend, "engine", None), "stats", None)
+        if stats is None or self.multihost is not None:
+            return
+        import json
+        import os
+        import time
+
+        snap = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "dataset": self.dataset, "prompt_type": self.prompt_type,
+                "repeats": self.repeats,
+                "metrics": stats.registry.snapshot()}
+        if result.get("latency"):
+            snap["latency"] = result["latency"]
+        if result.get("prefix_cache"):
+            snap["prefix_cache"] = result["prefix_cache"]
+        if result.get("serving"):
+            snap["serving"] = result["serving"]
+        try:
+            os.makedirs(self.results_dir, exist_ok=True)
+            path = os.path.join(self.results_dir, "fleet_metrics.json")
+            with open(path + ".tmp", "w") as f:
+                json.dump(snap, f, indent=1)
+            os.replace(path + ".tmp", path)
+            if self.progress:
+                print(f"[fleet] metrics snapshot: {path}")
+        except OSError:
+            pass        # a read-only results dir must not fail the run

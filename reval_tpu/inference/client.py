@@ -21,12 +21,20 @@ Deadlines: each completion request carries ``deadline_s`` — this client's
 remaining per-request budget (``request_timeout``) — so a server that
 cannot finish in time cancels the work engine-side (freeing its batch
 slot for live traffic) instead of generating tokens nobody will read.
+
+Request ids: the client MINTS one id per logical request, sends it as
+``X-Request-Id``, and keeps it across retries of that request — so the
+server's logs/spans and this side's retry log (``(request_id, attempt,
+delay)`` via the RetryPolicy ``label``) all name the same request, and a
+re-sent attempt is attributable to its original.  The server echoes the
+id on every response.
 """
 
 from __future__ import annotations
 
 import json
 import urllib.request
+import uuid
 
 from ..resilience import RetryPolicy, wait_for_server
 from .base import InferenceBackend
@@ -69,24 +77,35 @@ class HTTPClientBackend(InferenceBackend):
             print(f"user-side model_id: {model_id}, server-side model_id: {self._server_model}")
 
     def _request_once(self, route: str, data: bytes | None = None,
-                      timeout: float = 30) -> dict:
+                      timeout: float = 30,
+                      request_id: str | None = None) -> dict:
+        headers = {"Content-Type": "application/json"} if data else {}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
         req = urllib.request.Request(
-            self.base_url + route, data=data,
-            headers={"Content-Type": "application/json"} if data else {},
+            self.base_url + route, data=data, headers=headers,
             method="POST" if data is not None else "GET",
         )
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.load(resp)
 
     def _get(self, route: str) -> dict:
-        return self.retry.call(lambda: self._request_once(route))
+        rid = uuid.uuid4().hex[:12]
+        return self.retry.call(
+            lambda: self._request_once(route, request_id=rid),
+            label=f"request {rid} (GET {route})")
 
     def _post(self, route: str, payload: dict,
               timeout: float | None = None) -> dict:
         timeout = self.request_timeout if timeout is None else timeout
         data = json.dumps(payload).encode()
+        # ONE id for every retry attempt of this logical request: the
+        # server's span/log trail shows the re-sends as the same request
+        rid = uuid.uuid4().hex[:12]
         return self.retry.call(
-            lambda: self._request_once(route, data=data, timeout=timeout))
+            lambda: self._request_once(route, data=data, timeout=timeout,
+                                       request_id=rid),
+            label=f"request {rid} (POST {route})")
 
     def _completion_payload(self, prompt) -> dict:
         return {

@@ -33,6 +33,7 @@ later CALLS: fleet repeats, serve traffic) hit the cached pages.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor, wait as futures_wait
 
@@ -97,28 +98,14 @@ class DataParallelPagedEngine:
 
     @property
     def stats(self) -> EngineStats:
-        """Aggregated over replicas (seconds are summed device-time, not
-        wall-clock — divide by dp for a wall estimate under full overlap)."""
+        """Aggregated over replicas by registry merge — counters sum,
+        histogram buckets add, gauges take last — so a metric added to
+        ``EngineStats`` can never be silently dropped here again.
+        (Seconds are summed device-time, not wall-clock — divide by dp
+        for a wall estimate under full overlap.)"""
         agg = EngineStats()
         for rep in self.replicas:
-            s = rep.stats
-            agg.prompts += s.prompts
-            agg.generated_tokens += s.generated_tokens
-            agg.prefill_tokens += s.prefill_tokens
-            agg.decode_seconds += s.decode_seconds
-            agg.prefill_seconds += s.prefill_seconds
-            agg.decode_chunks += s.decode_chunks
-            agg.decode_steps += s.decode_steps
-            agg.pipelined_chunks += s.pipelined_chunks
-            agg.patched_tables += s.patched_tables
-            agg.prefix_hit_tokens += s.prefix_hit_tokens
-            agg.prefix_lookup_tokens += s.prefix_lookup_tokens
-            agg.prefix_inserted_pages += s.prefix_inserted_pages
-            agg.prefix_evictions += s.prefix_evictions
-            agg.sheds += s.sheds
-            agg.deadline_expired += s.deadline_expired
-            agg.watchdog_trips += s.watchdog_trips
-            agg.drain_seconds += s.drain_seconds
+            agg.merge(rep.stats)
         return agg
 
     def prefix_cache_counters(self) -> dict:
@@ -140,6 +127,10 @@ class DataParallelPagedEngine:
         if not prompts:
             return []
         stop = stop or []
+        # latency stamps anchor at CALL time, not queue-pull time: a
+        # prompt that waits in the shared work queue must show that wait
+        # in queue_wait/ttft/e2e, same clock as the serving session
+        t_submit = time.perf_counter()
         encoded = [self.replicas[0].encode_clipped(p, max_new_tokens)
                    for p in prompts]
         # LPT order (longest prompt first): with demand-driven pulling the
@@ -182,7 +173,8 @@ class DataParallelPagedEngine:
                             scanner=StopScanner(eng.tokenizer, stop),
                             temp=float(temperature),
                             top_k=int(top_k), top_p=float(top_p),
-                            notify=notify, key=keys[i], node=node)
+                            notify=notify, key=keys[i], node=node,
+                            t_submit=t_submit)
                     if not reqs:
                         break
                     eng._drive_tick(reqs, st)

@@ -29,7 +29,6 @@ import contextlib
 import functools
 import os
 import time
-from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -179,31 +178,64 @@ def finalize_text(tokenizer, ids: list[int], stop: list[str]) -> str:
     return truncate_at_stop(tokenizer.decode(ids), stop)
 
 
-@dataclass
-class EngineStats:
-    prompts: int = 0
-    generated_tokens: int = 0
-    prefill_tokens: int = 0
-    decode_seconds: float = 0.0
-    prefill_seconds: float = 0.0
-    decode_chunks: int = 0
-    decode_steps: int = 0        # weight passes: forward executions of the
-                                 # decode program over the batch
-    pipelined_chunks: int = 0    # chunks whose fetch rode behind the next
-                                 # dispatch (paged engine chunk pipeline)
-    patched_tables: int = 0      # in-place device table patches — chunks
-                                 # whose page crossings (one or more
-                                 # slots) were absorbed without a flush
+#: (attribute, metric name, python type) — the EngineStats counter set.
+#: Attribute access keeps the historical dataclass field names (every
+#: caller, test, and JSON surface reads ``stats.prompts`` etc.); the
+#: VALUES live in the obs registry so ``/metrics``, dp/MultiSession
+#: merges, and the fleet snapshot all see one store.
+_STAT_FIELDS = (
+    ("prompts", "reval_engine_prompts_total", int),
+    ("generated_tokens", "reval_engine_generated_tokens_total", int),
+    ("prefill_tokens", "reval_engine_prefill_tokens_total", int),
+    ("decode_seconds", "reval_engine_decode_seconds_total", float),
+    ("prefill_seconds", "reval_engine_prefill_seconds_total", float),
+    ("decode_chunks", "reval_engine_decode_chunks_total", int),
+    # weight passes: forward executions of the decode program
+    ("decode_steps", "reval_engine_decode_steps_total", int),
+    # chunks whose fetch rode behind the next dispatch (chunk pipeline)
+    ("pipelined_chunks", "reval_engine_pipelined_chunks_total", int),
+    # in-place device table patches — page crossings absorbed flush-free
+    ("patched_tables", "reval_engine_patched_tables_total", int),
     # persistent radix prefix cache (paged engine; prefix_cache.py):
-    prefix_hit_tokens: int = 0      # prompt tokens served from cached KV
-    prefix_lookup_tokens: int = 0   # prompt tokens that consulted the cache
-    prefix_inserted_pages: int = 0  # pages prefilled into the cache
-    prefix_evictions: int = 0       # LRU nodes evicted under pool pressure
+    ("prefix_hit_tokens", "reval_prefix_hit_tokens_total", int),
+    ("prefix_lookup_tokens", "reval_prefix_lookup_tokens_total", int),
+    ("prefix_inserted_pages", "reval_prefix_inserted_pages_total", int),
+    ("prefix_evictions", "reval_prefix_evictions_total", int),
     # serving lifecycle (serving/session.py + serving/server.py):
-    sheds: int = 0               # submissions rejected by admission control
-    deadline_expired: int = 0    # submissions cancelled at their deadline
-    watchdog_trips: int = 0      # no-progress watchdog activations
-    drain_seconds: float = 0.0   # wall spent in graceful drain at shutdown
+    ("sheds", "reval_serving_sheds_total", int),
+    ("deadline_expired", "reval_serving_deadline_expired_total", int),
+    ("watchdog_trips", "reval_serving_watchdog_trips_total", int),
+    ("drain_seconds", "reval_serving_drain_seconds_total", float),
+)
+
+
+class EngineStats:
+    """Engine counters + latency histograms over one obs registry.
+
+    Historically a plain dataclass of ints/floats; the fields survive as
+    properties (read/write/`+=` all work) over
+    :class:`~reval_tpu.obs.metrics.MetricsRegistry` counters, which adds
+    the histogram side (TTFT/TPOT/e2e/queue-wait distributions via
+    :meth:`observe_request`) and registry-level merging for dp replicas
+    and ``/metrics``.  ``REVAL_TPU_OBS=0`` (bench ``--no-obs``) disables
+    histogram observation only — counters are engine accounting and stay
+    on."""
+
+    def __init__(self, registry=None):
+        from ...obs.metrics import MetricsRegistry
+
+        if registry is None:
+            enabled = (os.environ.get("REVAL_TPU_OBS", "1").lower()
+                       not in ("0", "false", "off"))
+            registry = MetricsRegistry(enabled=enabled)
+        self.registry = registry
+        for _, metric, _ in _STAT_FIELDS:
+            registry.counter(metric)
+
+    def merge(self, other: "EngineStats") -> None:
+        """Fold another stats block in: counters sum, histogram buckets
+        add, gauges take last (the dp-replica aggregation rule)."""
+        self.registry.merge(other.registry)
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -219,6 +251,76 @@ class EngineStats:
                 "deadline_expired": self.deadline_expired,
                 "watchdog_trips": self.watchdog_trips,
                 "drain_seconds": round(self.drain_seconds, 3)}
+
+    def prefix_counters(self) -> dict:
+        """The prefix-cache counter block, the ``serving_counters``
+        sibling: bench JSON and the fleet trailer both render THIS dict
+        (they used to format the same four counters independently)."""
+        return {"hit_tokens": self.prefix_hit_tokens,
+                "hit_rate": round(self.prefix_hit_rate, 4),
+                "evictions": self.prefix_evictions,
+                "inserted_pages": self.prefix_inserted_pages}
+
+    # -- latency histograms ------------------------------------------------
+    def observe_request(self, req) -> None:
+        """Record one retired request's lifecycle stamps (perf_counter
+        seconds on the request object: ``t_submit``/``t_admit``/
+        ``t_first``/``t_done``) into the latency histograms.  Engines
+        call this exactly once per request, at retirement."""
+        from ...obs import metrics as m
+
+        reg = self.registry
+        reg.counter(m.REQUESTS).add(1)
+        t_submit = getattr(req, "t_submit", None)
+        if t_submit is None:
+            return
+        t_done = getattr(req, "t_done", None)
+        if t_done is None:
+            t_done = time.perf_counter()
+        t_admit = getattr(req, "t_admit", None)
+        t_first = getattr(req, "t_first", None)
+        if t_admit is not None:
+            reg.histogram(m.QUEUE_WAIT).observe(max(0.0, t_admit - t_submit))
+        if t_first is not None:
+            reg.histogram(m.TTFT).observe(max(0.0, t_first - t_submit))
+        reg.histogram(m.E2E).observe(max(0.0, t_done - t_submit))
+        n = len(getattr(req, "generated", None) or ())
+        if t_first is not None and n > 1:
+            reg.histogram(m.TPOT).observe(
+                max(0.0, (t_done - t_first) / (n - 1)))
+
+    def latency_summary(self) -> dict:
+        """Percentile digest of the request histograms — the fleet
+        trailer and bench ``latency`` block.  Empty dict when nothing
+        was observed (obs disabled, or no requests retired)."""
+        from ...obs import metrics as m
+
+        out: dict = {}
+        for label, name in (("queue_wait", m.QUEUE_WAIT), ("ttft", m.TTFT),
+                            ("tpot", m.TPOT), ("e2e", m.E2E)):
+            h = self.registry.histogram(name)
+            if h.count:
+                out[label] = {"count": h.count,
+                              "mean": round(h.sum / h.count, 6),
+                              "p50": round(h.percentile(0.50), 6),
+                              "p95": round(h.percentile(0.95), 6),
+                              "p99": round(h.percentile(0.99), 6)}
+        return out
+
+
+def _stat_property(metric: str, cast) -> property:
+    def fget(self):
+        return cast(self.registry.counter(metric).value)
+
+    def fset(self, v):
+        self.registry.counter(metric).set(v)
+
+    return property(fget, fset)
+
+
+for _name, _metric, _cast in _STAT_FIELDS:
+    setattr(EngineStats, _name, _stat_property(_metric, _cast))
+del _name, _metric, _cast
 
 
 class TPUEngine:

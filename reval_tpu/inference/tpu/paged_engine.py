@@ -50,6 +50,7 @@ from ...models import (
     load_checkpoint,
     prefill,
 )
+from ...obs import metrics as obs_metrics
 from ...models.paged import (
     commit_prefill,
     init_paged_cache,
@@ -114,6 +115,14 @@ class _Request:
     scanner: StopScanner
     generated: list[int] = field(default_factory=list)
     done: bool = False
+    #: lifecycle stamps (perf_counter): construction defaults to "now",
+    #: but the serving session passes its own submit time so queue wait
+    #: spent in the session inbox is part of the request's latency.
+    #: Admission keeps the FIRST stamp across preemption re-admissions.
+    t_submit: float = field(default_factory=time.perf_counter)
+    t_admit: float | None = None
+    t_first: float | None = None
+    t_done: float | None = None
     temp: float = 0.0            # per-request sampling temperature
     top_k: int = 0               # per-request top-k filter (0 = off)
     top_p: float = 1.0           # per-request nucleus filter (1 = off)
@@ -602,6 +611,20 @@ class PagedTPUEngine:
         self._process_pending(reqs, st)
 
     def _drive_tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
+        """One engine step (see :meth:`_tick`), timed into the
+        ``reval_engine_step_seconds`` histogram — the per-step half of
+        the measurement loop (FlashInfer-Bench's point: scheduler and
+        kernel work only compound when the engine itself measures)."""
+        t0 = time.perf_counter()
+        try:
+            self._tick(reqs, st)
+        finally:
+            self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(
+                time.perf_counter() - t0)
+            self.stats.registry.gauge(obs_metrics.FREE_PAGES).set(
+                self.rt.free_pages if self.rt is not None else 0)
+
+    def _tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
         """ONE admission + prefill + decode-chunk round over ``reqs``.
 
         Loop state (tables, lens, pending token, per-slot temperature)
@@ -637,12 +660,20 @@ class PagedTPUEngine:
             self._process_pending(reqs, st)
             st.dirty = True
             st.since_admit = 0
+            t_admit = time.perf_counter()
             firsts = self._prefill_admitted(admitted, reqs)
+            t_first = time.perf_counter()
             for seq_id, slot in admitted:
                 req = reqs[seq_id]
+                # first admission only: a preemption resume keeps the
+                # original stamps (the request's latency, not the slot's)
+                if req.t_admit is None:
+                    req.t_admit = t_admit
                 # append, not reset: after a preemption the kept tokens
                 # were replayed by the resume prefill and stand
                 req.generated.append(firsts[slot])
+                if req.t_first is None:
+                    req.t_first = t_first
                 st.slot_token[slot] = firsts[slot]
                 st.slot_temp[slot] = req.temp
                 st.slot_topk[slot] = req.top_k
@@ -853,7 +884,9 @@ class PagedTPUEngine:
         now = time.perf_counter()
         # union-of-intervals: overlapped dispatch→fetch spans must not
         # double-count decode wall time
-        self.stats.decode_seconds += now - max(t0, st.t_mark)
+        span = now - max(t0, st.t_mark)
+        self.stats.decode_seconds += span
+        self.stats.registry.histogram(obs_metrics.DECODE_CHUNK).observe(span)
         st.t_mark = now
         self.stats.generated_tokens += steps * len(rows)
         self.stats.decode_chunks += 1
@@ -893,6 +926,8 @@ class PagedTPUEngine:
     def _retire(self, req: _Request, seq_id: int, slot: int,
                 active: dict[int, int]) -> None:
         req.done = True
+        req.t_done = time.perf_counter()
+        self.stats.observe_request(req)
         self.release_request(seq_id, req)
         active.pop(slot, None)
 
@@ -987,7 +1022,9 @@ class PagedTPUEngine:
                     self._harvest_first(g, first_dev, firsts)
         if pend is not None:
             self._harvest_first(*pend, firsts)
-        self.stats.prefill_seconds += time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        self.stats.prefill_seconds += wall
+        self.stats.registry.histogram(obs_metrics.PREFILL_BATCH).observe(wall)
         return firsts
 
     @staticmethod

@@ -1,0 +1,14 @@
+"""Observability: metrics registry, latency histograms, span tracing.
+
+``metrics`` carries the process-wide metric namespace (``METRICS``) and
+the mergeable :class:`MetricsRegistry` that backs
+:class:`~reval_tpu.inference.tpu.engine.EngineStats`; ``trace`` emits
+Chrome-trace/Perfetto span trees per served request (``serve
+--trace-out``).  The serving server exposes both: ``GET /metrics``
+(Prometheus text) and ``GET /statusz`` (JSON snapshot).
+"""
+
+from .metrics import METRICS, LATENCY_BUCKETS, MetricsRegistry
+from .trace import Tracer
+
+__all__ = ["METRICS", "LATENCY_BUCKETS", "MetricsRegistry", "Tracer"]

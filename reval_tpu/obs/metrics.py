@@ -1,0 +1,458 @@
+"""Metrics registry: counters, gauges, and fixed-bucket histograms.
+
+The observability backbone for the serving stack (ISSUE 4 / PAPERS.md:
+serving-systems studies report TTFT/TPOT *distributions*, not averages —
+the operative SLOs are percentiles, so the primitive here is a mergeable
+fixed-bucket histogram, not a mean).
+
+Design constraints, in order:
+
+- **Hot-path cost.**  Nothing here runs per *token*.  Counters update per
+  request or per decode chunk (a few Hz), histograms observe once per
+  request or chunk.  Each mutation takes one uncontended lock (~100 ns);
+  the bench A/B (``bench.py --no-obs``, PERF.md) pins the total under the
+  2% acceptance bar.  ``MetricsRegistry(enabled=False)`` additionally
+  swaps histograms for a shared no-op — the knob the A/B flips — while
+  counters keep working (engine accounting depends on them).
+- **Mergeable.**  dp replicas and :class:`~reval_tpu.serving.session.
+  MultiSession` each own a registry; a ``/metrics`` scrape or a fleet
+  trailer merges them: counters SUM, histogram buckets ADD (same bounds
+  by construction — every histogram takes its buckets from the central
+  ``METRICS`` spec), gauges take the LAST merged value that was ever set.
+- **One namespace.**  Every metric name is declared ONCE in ``METRICS``
+  below; the registry rejects undeclared names, and
+  ``tools/check_metrics.py`` lints the spec against the README table and
+  against rogue ``reval_*`` literals elsewhere in the tree.  A metric
+  cannot be added to the code and silently missed in the docs.
+
+Exposition: :meth:`MetricsRegistry.render_prometheus` emits the
+Prometheus text format (version 0.0.4) directly — no ``prometheus_client``
+dependency; :meth:`snapshot` is the JSON twin (``/statusz``, fleet
+snapshots, ``tools/obs_report.py``).
+"""
+
+from __future__ import annotations
+
+import re as _re
+import threading
+
+__all__ = [
+    "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "parse_prometheus", "percentile_from_buckets",
+    "LATENCY_BUCKETS", "STEP_BUCKETS",
+    "REQUESTS", "QUEUE_WAIT", "TTFT", "TPOT", "E2E",
+    "ENGINE_STEP", "DECODE_CHUNK", "PREFILL_BATCH",
+    "QUEUED_TOKENS", "FREE_PAGES", "HTTP_REQUESTS",
+]
+
+# Log-spaced seconds buckets spanning sub-ms host paths (mock engine,
+# --tiny CPU smoke) through multi-minute cold-compile tails.  Upper
+# bounds are INCLUSIVE (Prometheus `le` semantics).
+LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0)
+
+# Engine-step / chunk timings sit in the 0.1 ms – 10 s band.
+STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+# -- metric name constants (import these; never inline the literals) -------
+REQUESTS = "reval_requests_total"
+QUEUE_WAIT = "reval_request_queue_wait_seconds"
+TTFT = "reval_request_ttft_seconds"
+TPOT = "reval_request_tpot_seconds"
+E2E = "reval_request_e2e_seconds"
+ENGINE_STEP = "reval_engine_step_seconds"
+DECODE_CHUNK = "reval_decode_chunk_seconds"
+PREFILL_BATCH = "reval_prefill_batch_seconds"
+QUEUED_TOKENS = "reval_session_queued_tokens"
+FREE_PAGES = "reval_engine_free_pages"
+HTTP_REQUESTS = "reval_http_requests_total"
+
+#: The canonical metric namespace: name -> (type, help[, buckets]).
+#: ``tools/check_metrics.py`` lints this dict against the README table.
+METRICS: dict[str, dict] = {
+    # per-request latency distributions (EngineStats.observe_request)
+    REQUESTS: {"type": "counter",
+               "help": "Requests retired by the engine (one per prompt)"},
+    QUEUE_WAIT: {"type": "histogram", "buckets": LATENCY_BUCKETS,
+                 "help": "Submit-to-admission wait (slot + scheduler queue)"},
+    TTFT: {"type": "histogram", "buckets": LATENCY_BUCKETS,
+           "help": "Time to first token, from submit"},
+    TPOT: {"type": "histogram", "buckets": LATENCY_BUCKETS,
+           "help": "Per-token decode latency after the first token"},
+    E2E: {"type": "histogram", "buckets": LATENCY_BUCKETS,
+          "help": "End-to-end request latency, submit to final token"},
+    # engine internals
+    ENGINE_STEP: {"type": "histogram", "buckets": STEP_BUCKETS,
+                  "help": "One admission+prefill+decode-chunk drive tick"},
+    DECODE_CHUNK: {"type": "histogram", "buckets": STEP_BUCKETS,
+                   "help": "Decode-chunk dispatch-to-fetch wall interval"},
+    PREFILL_BATCH: {"type": "histogram", "buckets": STEP_BUCKETS,
+                    "help": "One admission wave's bucketed prefill wall"},
+    # EngineStats counters (the pre-obs dataclass fields, same names
+    # on the Python side — see engine.EngineStats)
+    "reval_engine_prompts_total": {
+        "type": "counter", "help": "Prompts completed by generate()/serve"},
+    "reval_engine_generated_tokens_total": {
+        "type": "counter", "help": "Decode tokens produced (incl. overrun)"},
+    "reval_engine_prefill_tokens_total": {
+        "type": "counter", "help": "Prompt tokens prefilled"},
+    "reval_engine_decode_seconds_total": {
+        "type": "counter", "help": "Wall seconds in decode (union of chunks)"},
+    "reval_engine_prefill_seconds_total": {
+        "type": "counter", "help": "Wall seconds in prefill"},
+    "reval_engine_decode_chunks_total": {
+        "type": "counter", "help": "Decode chunks fetched"},
+    "reval_engine_decode_steps_total": {
+        "type": "counter", "help": "Decode weight passes (batch forward runs)"},
+    "reval_engine_pipelined_chunks_total": {
+        "type": "counter", "help": "Chunks whose fetch rode behind dispatch"},
+    "reval_engine_patched_tables_total": {
+        "type": "counter", "help": "In-place device table patches (no flush)"},
+    "reval_prefix_hit_tokens_total": {
+        "type": "counter", "help": "Prompt tokens served from cached KV"},
+    "reval_prefix_lookup_tokens_total": {
+        "type": "counter", "help": "Prompt tokens that consulted the cache"},
+    "reval_prefix_inserted_pages_total": {
+        "type": "counter", "help": "Pages prefilled into the prefix cache"},
+    "reval_prefix_evictions_total": {
+        "type": "counter", "help": "LRU cache nodes evicted under pressure"},
+    "reval_serving_sheds_total": {
+        "type": "counter", "help": "Submissions shed by admission control"},
+    "reval_serving_deadline_expired_total": {
+        "type": "counter", "help": "Submissions cancelled at their deadline"},
+    "reval_serving_watchdog_trips_total": {
+        "type": "counter", "help": "No-progress watchdog activations"},
+    "reval_serving_drain_seconds_total": {
+        "type": "counter", "help": "Wall seconds in graceful drain"},
+    # gauges — POINT values: a merged dp/MultiSession scrape keeps the
+    # last-merged replica's reading (the spec'd take-last rule), it does
+    # NOT sum a fleet-wide total; alert per replica, not on the merge
+    QUEUED_TOKENS: {"type": "gauge",
+                    "help": "Prompt tokens pending in the session queue "
+                            "(last-merged replica)"},
+    FREE_PAGES: {"type": "gauge",
+                 "help": "Free KV pool pages (last drive tick, "
+                         "last-merged replica)"},
+    # server-side
+    HTTP_REQUESTS: {"type": "counter",
+                    "help": "Completion POSTs received by the HTTP server "
+                            "(any outcome, incl. shed/drain rejections)"},
+}
+
+
+class Counter:
+    """Monotonic-by-convention accumulator.  ``add`` may carry floats
+    (seconds counters) and ``set`` exists for the EngineStats property
+    setters (test fixtures assign counters; prefix-cache rollbacks
+    subtract a mistakenly credited hit) — exposition still types it
+    ``counter``."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += v
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value.  ``updated`` distinguishes "never set" from
+    "set to 0", so a merge can take the LAST set value instead of
+    clobbering a live reading with a default zero."""
+
+    __slots__ = ("name", "_value", "updated", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self.updated = False
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+            self.updated = True
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with INCLUSIVE upper bounds (Prometheus
+    ``le``) plus an implicit ``+Inf`` overflow bucket.  Stores per-bucket
+    (non-cumulative) counts; exposition cumulates at render time.
+    """
+
+    __slots__ = ("name", "buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, name: str, buckets: tuple[float, ...]):
+        assert buckets == tuple(sorted(buckets)), "bucket bounds must ascend"
+        self.name = name
+        self.buckets = tuple(float(b) for b in buckets)
+        self.counts = [0] * (len(self.buckets) + 1)   # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def _bucket_index(self, v: float) -> int:
+        import bisect
+
+        # first bound >= v (le is inclusive: v exactly on a bound lands
+        # IN that bucket, tests/test_obs.py pins the boundary)
+        return bisect.bisect_left(self.buckets, v)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = self._bucket_index(v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def _read(self) -> tuple[list[int], float, int]:
+        """Consistent (counts, sum, count) snapshot under the lock —
+        merges and renders racing a live ``observe`` must never see a
+        count that disagrees with its buckets."""
+        with self._lock:
+            return list(self.counts), self.sum, self.count
+
+    def merge(self, other: "Histogram") -> None:
+        if other.buckets != self.buckets:
+            raise ValueError(f"histogram {self.name}: bucket bounds differ")
+        # read the source under ITS lock first (never hold both at once —
+        # a pair of cross-merges must not deadlock), then fold in
+        counts, o_sum, o_count = other._read()
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += c
+            self.sum += o_sum
+            self.count += o_count
+
+    def percentile(self, q: float) -> float:
+        """``histogram_quantile``-style estimate (see
+        :func:`percentile_from_buckets`)."""
+        counts, _, count = self._read()
+        return percentile_from_buckets(self.buckets, counts, count, q)
+
+
+def percentile_from_buckets(bounds: tuple[float, ...], counts,
+                            count: int, q: float) -> float:
+    """``histogram_quantile``-style estimate over raw bucket data: walk
+    the per-bucket (non-cumulative) counts — ``counts`` may carry the
+    +Inf bucket as its last element or omit it — to the target rank and
+    interpolate linearly inside the landing bucket.  The +Inf bucket
+    reports the highest finite bound (a floor, like Prometheus).  THE
+    one estimator: ``Histogram.percentile`` and ``tools/obs_report.py``
+    (snapshot diffs) both call it, so their numbers cannot diverge."""
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= rank:
+            if i >= len(bounds):                    # +Inf bucket
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            return lo + (bounds[i] - lo) * max(0.0, rank - cum) / c
+        cum += c
+    return bounds[-1]
+
+
+class _NullHistogram:
+    """Shared no-op stand-in when observation is disabled (``--no-obs``
+    A/B): observe costs one attribute lookup + a pass."""
+
+    __slots__ = ("name",)
+    buckets: tuple[float, ...] = ()
+    counts: list[int] = []
+    sum = 0.0
+    count = 0
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def merge(self, other) -> None:
+        pass
+
+    def percentile(self, q: float) -> float:
+        return 0.0
+
+
+class MetricsRegistry:
+    """Get-or-create store of named metrics, thread-safe for concurrent
+    registration and mutation.  Names must be declared in :data:`METRICS`
+    unless ``strict=False`` (ad-hoc experiments); requesting an existing
+    name as a different type raises — that is a namespace collision, not
+    a cache miss."""
+
+    def __init__(self, enabled: bool = True, strict: bool = True):
+        self.enabled = enabled
+        self.strict = strict
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    # -- registration ------------------------------------------------------
+    def _get(self, name: str, cls, factory):
+        spec = METRICS.get(name)
+        if spec is None and self.strict:
+            raise KeyError(
+                f"metric {name!r} is not declared in obs.metrics.METRICS — "
+                f"declare it there (and in the README table) first")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"metric {name!r} already registered as "
+                                 f"{type(m).__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter, lambda: Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge, lambda: Gauge(name))
+
+    def histogram(self, name: str,
+                  buckets: tuple[float, ...] | None = None) -> Histogram:
+        spec = METRICS.get(name) or {}
+        bounds = tuple(buckets if buckets is not None
+                       else spec.get("buckets", LATENCY_BUCKETS))
+        if not self.enabled:
+            return self._get(name, _NullHistogram,
+                             lambda: _NullHistogram(name))
+        return self._get(name, Histogram, lambda: Histogram(name, bounds))
+
+    # -- aggregation -------------------------------------------------------
+    def merge(self, other: "MetricsRegistry") -> None:
+        """Fold ``other`` into this registry: counters sum, histogram
+        buckets add, gauges take the last merged SET value."""
+        with other._lock:
+            items = list(other._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                self.counter(name).add(m.value)
+            elif isinstance(m, Gauge):
+                if m.updated:
+                    self.gauge(name).set(m.value)
+            elif isinstance(m, Histogram):
+                self.histogram(name, m.buckets).merge(m)
+            # _NullHistogram: nothing to carry
+
+    @staticmethod
+    def merged(registries) -> "MetricsRegistry":
+        out = MetricsRegistry()
+        for reg in registries:
+            out.merge(reg)
+        return out
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-able view: ``/statusz``, fleet snapshots, obs_report."""
+        counters: dict[str, float] = {}
+        gauges: dict[str, float] = {}
+        histograms: dict[str, dict] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in sorted(items):
+            if isinstance(m, Counter):
+                v = m.value
+                counters[name] = int(v) if float(v).is_integer() else v
+            elif isinstance(m, Gauge):
+                gauges[name] = m.value
+            elif isinstance(m, Histogram):
+                counts, h_sum, h_count = m._read()
+                histograms[name] = {
+                    "buckets": [[b, c] for b, c in zip(m.buckets, counts)],
+                    "inf": counts[-1], "sum": h_sum, "count": h_count}
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms}
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4 (no client library)."""
+        lines: list[str] = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            spec = METRICS.get(name, {})
+            help_text = spec.get("help", "")
+            if isinstance(m, Counter):
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} histogram")
+                counts, h_sum, h_count = m._read()
+                cum = 0
+                for bound, c in zip(m.buckets, counts):
+                    cum += c
+                    lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+                cum += counts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum {_fmt(h_sum)}")
+                lines.append(f"{name}_count {h_count}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers bare, floats via repr."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+_SAMPLE_RE = _re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^{}]*\})?'
+    r'\s+(?P<value>[^\s]+)$')
+_META_RE = _re.compile(
+    r'^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*$')
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Minimal exposition-format (0.0.4) checker + reader: returns
+    ``{series (incl. label string): value}`` and raises ``ValueError`` on
+    any line that fits neither the sample nor the comment grammar — the
+    ``serve --smoke`` self-test and tests/test_obs.py both gate on it."""
+    samples: dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not _META_RE.match(line):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: bad sample {line!r}")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad value in {line!r}") from None
+        samples[m.group("name") + (m.group("labels") or "")] = value
+    return samples

@@ -1,0 +1,131 @@
+"""Span tracing: Chrome-trace / Perfetto JSON for the serving stack.
+
+One request = one span tree.  The serving session records, per prompt of
+each submission, the lifecycle stamps the engine already keeps on its
+``_Request`` (submit, admission, first token, done) and this module turns
+them into nested complete ("X") events:
+
+    request                          [submit ........................ done]
+    ├── queue_wait                   [submit .. admit]
+    └── generate                              [admit ............... done]
+        ├── first_token                       [admit .. first]
+        └── decode                                    [first ....... done]
+
+Each (request_id, prompt index) pair gets its own trace ``tid`` with a
+``thread_name`` metadata event naming it, so Perfetto / chrome://tracing
+shows one labelled track per request and nesting is purely by time
+containment — no duplicate-depth overlaps.
+
+Cost model: recording is a list append under a lock, only on request
+*completion* (and only when a tracer is installed at all — ``serve
+--trace-out PATH``); nothing runs per token or per chunk.  Memory is
+bounded: past ``max_events`` (default 500k ≈ 80k requests) new events
+are dropped and counted, so a long-lived daemon cannot grow without
+bound and a truncated capture announces itself (``dropped_events`` in
+the envelope).  ``save()`` writes the standard ``{"traceEvents":
+[...]}`` envelope.
+
+Timestamps ride ``time.perf_counter()`` (the clock every engine stamp
+uses) scaled to microseconds; viewers normalise to the earliest event.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ["Tracer"]
+
+
+def _us(t: float) -> float:
+    return round(t * 1e6, 1)
+
+
+class Tracer:
+    #: event-count cap: a long-lived server must not grow without bound
+    #: (each request records ~6 events, so the default holds ~80k
+    #: requests — plenty for a capture session, bounded for a daemon).
+    #: Past it new events are DROPPED and counted; save() reports the
+    #: drop so a truncated capture is never mistaken for a quiet server.
+    MAX_EVENTS = 500_000
+
+    def __init__(self, max_events: int = MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._tids: dict[str, int] = {}
+        self._serial = 0
+        self.max_events = int(max_events)
+        self.dropped = 0
+
+    def _append(self, event: dict) -> bool:
+        """Append under the cap (caller holds no lock); False = dropped."""
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return False
+            self._events.append(event)
+            return True
+
+    def _tid(self, label: str) -> int:
+        with self._lock:
+            tid = self._tids.get(label)
+            if tid is not None:
+                return tid
+            tid = self._tids[label] = len(self._tids) + 1
+        self._append({"name": "thread_name", "ph": "M", "pid": 1,
+                      "tid": tid, "args": {"name": label}})
+        return tid
+
+    def span(self, name: str, t0: float, t1: float, tid: int,
+             args: dict | None = None) -> None:
+        if t1 < t0:
+            t1 = t0
+        event = {"name": name, "ph": "X", "pid": 1, "tid": tid,
+                 "ts": _us(t0), "dur": _us(t1 - t0)}
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def record_request(self, request_id: str | None, pos: int, *,
+                       t_submit: float, t_admit: float | None,
+                       t_first: float | None, t_done: float,
+                       n_tokens: int = 0, error: str | None = None) -> None:
+        """Emit the span tree for one finished prompt.  Stamps that never
+        happened (an error before admission) simply drop their spans —
+        the root span always exists, so every request is visible."""
+        if request_id is None:
+            with self._lock:
+                self._serial += 1
+                request_id = f"anon-{self._serial}"
+        label = (f"request {request_id}" if pos == 0
+                 else f"request {request_id}[{pos}]")
+        tid = self._tid(label)
+        args = {"request_id": request_id, "prompt_index": pos,
+                "tokens": n_tokens}
+        if error is not None:
+            args["error"] = error
+        self.span("request", t_submit, t_done, tid, args)
+        if t_admit is not None:
+            self.span("queue_wait", t_submit, t_admit, tid)
+            self.span("generate", t_admit, t_done, tid)
+            if t_first is not None and t_first >= t_admit:
+                self.span("first_token", t_admit, t_first, tid)
+                self.span("decode", t_first, t_done, tid)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def save(self, path: str) -> int:
+        """Write the Chrome-trace envelope; returns the event count."""
+        events = self.events()
+        other = {"producer": "reval_tpu.obs.trace",
+                 "saved_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+        if self.dropped:
+            other["dropped_events"] = self.dropped
+        payload = {"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": other}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return len(events)

@@ -15,10 +15,13 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import random
 import time
 import urllib.error
 from typing import Callable
+
+log = logging.getLogger(__name__)
 
 __all__ = ["RetryPolicy", "retryable_error", "retry_after_hint",
            "wait_for_server"]
@@ -103,11 +106,15 @@ class RetryPolicy:
         return delay
 
     def call(self, fn: Callable[[], "object"], *, attempts: int | None = None,
-             on_retry: Callable[[int, BaseException, float], None] | None = None):
+             on_retry: Callable[[int, BaseException, float], None] | None = None,
+             label: str | None = None):
         """Run ``fn`` under the policy; re-raise the last error when the
         attempt budget is spent or the error is not retryable.  ``attempts``
         overrides ``max_attempts`` (batch bisection retries multi-prompt
-        batches less eagerly than single prompts)."""
+        batches less eagerly than single prompts).  ``label`` names the
+        work in the retry log — the HTTP client passes its request id, so
+        a client-side retry and the server-side 500 for the same request
+        grep to one line."""
         budget = attempts if attempts is not None else self.max_attempts
         for attempt in range(budget):
             try:
@@ -116,6 +123,10 @@ class RetryPolicy:
                 if not self.retryable(exc) or attempt + 1 >= budget:
                     raise
                 delay = self.delay_for(attempt, exc)
+                if label is not None:
+                    log.warning("[retry] %s: attempt %d/%d failed (%r); "
+                                "retrying in %.2fs", label, attempt + 1,
+                                budget, exc, delay)
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.sleep(delay)

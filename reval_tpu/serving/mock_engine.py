@@ -22,6 +22,8 @@ from types import SimpleNamespace
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+
 __all__ = ["MockStepEngine"]
 
 
@@ -78,24 +80,38 @@ class MockStepEngine:
 
     def _drive_tick(self, reqs: dict, st) -> None:
         """One mock decode step: every live request gains up to
-        ``tokens_per_step`` tokens of the canned response, then EOS."""
+        ``tokens_per_step`` tokens of the canned response, then EOS.
+        Stamps the same lifecycle fields the paged engine keeps
+        (admit/first/done) and observes the same step/latency
+        histograms, so ``serve --mock`` exercises the whole
+        observability path host-only."""
+        t0 = time.perf_counter()
         self.heartbeat = time.monotonic()
         if self.step_s:
             time.sleep(self.step_s)
+        now = time.perf_counter()
         for seq_id, req in list(reqs.items()):
             if req.done:
                 continue
+            if req.t_admit is None:
+                req.t_admit = now
             pos = len(req.generated)
             chunk = self._resp_ids[pos:pos + self.tokens_per_step]
             if not chunk:
                 chunk = [self.tokenizer.eos_id]
             chunk = chunk[:max(1, req.max_new - pos)]
             req.generated.extend(chunk)
+            if req.t_first is None:
+                req.t_first = time.perf_counter()
             self.stats.generated_tokens += len(chunk)
             if (len(req.generated) >= req.max_new
                     or self.tokenizer.eos_id in chunk
                     or req.scanner.hit_new(chunk)):
                 req.done = True
+                req.t_done = time.perf_counter()
+                self.stats.observe_request(req)
                 self.release_request(seq_id, req)
             if req.notify is not None:
                 req.notify(req)
+        self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(
+            time.perf_counter() - t0)

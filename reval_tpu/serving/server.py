@@ -9,6 +9,18 @@ reference inference.py:110-131, start_server.sh):
   heartbeat fresh, queue below the admission watermark, not draining —
   503 with per-condition detail otherwise (per-replica for a dp set).
   The client handshake polls this one.
+- ``GET /metrics``             → Prometheus text exposition (0.0.4) of
+  every engine/session registry merged with the server's own counters —
+  TTFT/TPOT/e2e/queue-wait histograms, engine counters, gauges.  No
+  prometheus_client dependency; the renderer is obs/metrics.py.
+- ``GET /statusz``             → the JSON twin: the same merged metrics
+  as a snapshot dict plus model id and readiness detail.
+
+Request ids: every request gets one — the client's ``X-Request-Id``
+header when sent (sanitised), a minted one otherwise — and EVERY
+response echoes it back as ``X-Request-Id`` (success included), so
+client-side retry logs and server logs name the same request.  Error
+bodies and SSE error events carry it too.
 - ``POST /v1/completions``     → prompt (string or list), ``max_tokens``,
   ``temperature``, ``stop``, optional ``deadline_s`` (the client's
   remaining budget — the server cancels the request engine-side when it
@@ -50,11 +62,14 @@ from __future__ import annotations
 import json
 import logging
 import math
+import re
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import metrics as obs_metrics
+from ..obs.metrics import MetricsRegistry
 from .errors import ServingError
 
 __all__ = ["EngineServer", "serve_config"]
@@ -87,6 +102,18 @@ def _err(code: str, message: str, request_id: str | None = None) -> dict:
     if request_id is not None:
         body["request_id"] = request_id
     return {"error": body}
+
+
+_RID_RE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _request_id(headers) -> str:
+    """The caller's ``X-Request-Id``, sanitised (header values flow into
+    logs and response headers — strip anything that could smuggle a
+    newline or control byte, cap the length), or a fresh mint."""
+    rid = headers.get("X-Request-Id", "") if headers is not None else ""
+    rid = _RID_RE.sub("", rid)[:64]
+    return rid or uuid.uuid4().hex[:12]
 
 
 def _finite(x) -> bool:
@@ -165,7 +192,8 @@ class EngineServer:
                  host: str = "127.0.0.1", serialize: bool = True,
                  ready_fn=None, max_tokens_cap: int | None = None,
                  max_body_bytes: int = MAX_BODY_BYTES,
-                 drain_timeout_s: float = 120.0):
+                 drain_timeout_s: float = 120.0,
+                 stats_fn=None, tracer=None, trace_out: str | None = None):
         # loopback by default: the endpoint is unauthenticated, and the
         # in-repo client only ever connects to localhost; pass host="0.0.0.0"
         # deliberately to expose it
@@ -182,9 +210,18 @@ class EngineServer:
         params = inspect.signature(generate_fn).parameters
         self._streams = "on_progress" in params
         self._deadlines = "deadline_s" in params
+        self._req_ids = "request_id" in params
         self._lock = (threading.Lock() if serialize
                       else contextlib.nullcontext())
         self.ready_fn = ready_fn
+        #: zero-arg -> list[EngineStats]: the registries ``/metrics`` and
+        #: ``/statusz`` merge (attach_session wires it; session-less
+        #: engines pass it explicitly)
+        self.stats_fn = stats_fn
+        #: server-side counters (HTTP-level, engine-independent)
+        self._obs = MetricsRegistry()
+        self.tracer = tracer
+        self.trace_out = trace_out
         self.max_tokens_cap = max_tokens_cap
         self.max_body_bytes = int(max_body_bytes)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -205,12 +242,24 @@ class EngineServer:
                 pass
 
             def _send(self, code: int, payload: dict,
-                      headers: dict | None = None) -> None:
+                      headers: dict | None = None,
+                      request_id: str | None = None) -> None:
+                body = json.dumps(payload).encode()
+                self._send_bytes(code, body, "application/json",
+                                 headers, request_id)
+
+            def _send_bytes(self, code: int, body: bytes, ctype: str,
+                            headers: dict | None = None,
+                            request_id: str | None = None) -> None:
                 try:
-                    body = json.dumps(payload).encode()
                     self.send_response(code)
-                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
+                    if request_id is not None:
+                        # echoed on EVERY response (success included) so
+                        # the client's retry log and the server log name
+                        # the same request
+                        self.send_header("X-Request-Id", request_id)
                     for key, value in (headers or {}).items():
                         self.send_header(key, value)
                     self.end_headers()
@@ -226,25 +275,32 @@ class EngineServer:
                 if exc.retry_after is not None:
                     headers = {"Retry-After":
                                str(int(math.ceil(exc.retry_after)))}
-                self._send(exc.status, _err(exc.code, str(exc), rid), headers)
+                self._send(exc.status, _err(exc.code, str(exc), rid), headers,
+                           request_id=rid)
 
             def do_GET(self):
                 path = self.path.rstrip("/")
+                # echo the caller's id when one was sent (GETs don't mint:
+                # probes/scrapes are anonymous by default)
+                rid = (_RID_RE.sub("", self.headers.get("X-Request-Id", ""))
+                       [:64] or None)
                 if path == "/v1/models":
                     self._send(200, {"object": "list",
                                      "data": [{"id": outer.model_id,
-                                               "object": "model"}]})
+                                               "object": "model"}]},
+                               request_id=rid)
                 elif path in ("/healthz", "/v1/healthz"):
                     # pure LIVENESS: the process answers — even while
                     # draining or wedged (orchestrators must not kill a
                     # pod for being busy shutting down cleanly)
                     self._send(200, {"status": "ok",
-                                     "model": outer.model_id})
+                                     "model": outer.model_id},
+                               request_id=rid)
                 elif path in ("/readyz", "/v1/readyz"):
                     if outer._draining.is_set():
                         self._send(503, {"status": "draining",
                                          "ready": False},
-                                   {"Retry-After": "1"})
+                                   {"Retry-After": "1"}, request_id=rid)
                         return
                     info = (outer.ready_fn() if outer.ready_fn is not None
                             else {"ready": True})
@@ -252,16 +308,28 @@ class EngineServer:
                     self._send(200 if ready else 503,
                                {"status": "ready" if ready else "unready",
                                 **info},
-                               None if ready else {"Retry-After": "1"})
+                               None if ready else {"Retry-After": "1"},
+                               request_id=rid)
+                elif path in ("/metrics", "/v1/metrics"):
+                    self._send_bytes(
+                        200, outer.metrics_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        request_id=rid)
+                elif path in ("/statusz", "/v1/statusz"):
+                    self._send(200, outer.statusz(), request_id=rid)
                 else:
                     self._send(404, _err("not_found",
-                                         f"unknown route {self.path}"))
+                                         f"unknown route {self.path}"),
+                               request_id=rid)
 
             def do_POST(self):
                 # per-request isolation: whatever one request does, the
                 # worst outcome is its own error response — never a dead
-                # serve loop taking the whole fleet's backend with it
-                rid = uuid.uuid4().hex[:12]
+                # serve loop taking the whole fleet's backend with it.
+                # The id is the CLIENT's X-Request-Id when sent (so both
+                # sides' logs name the same request), minted otherwise.
+                rid = _request_id(self.headers)
+                outer._obs.counter(obs_metrics.HTTP_REQUESTS).add(1)
                 with outer._track():
                     try:
                         self._handle_post(rid)
@@ -270,35 +338,40 @@ class EngineServer:
                                       rid)
                         self._send(500, _err(
                             "internal_error",
-                            "internal error (see server log)", rid))
+                            "internal error (see server log)", rid),
+                            request_id=rid)
 
             def _handle_post(self, rid: str):
                 if self.path.rstrip("/") != "/v1/completions":
                     self._send(404, _err("not_found",
-                                         f"unknown route {self.path}"))
+                                         f"unknown route {self.path}"),
+                               request_id=rid)
                     return
                 if outer._draining.is_set():
                     self._send(503, _err("draining",
                                          "server is draining", rid),
-                               {"Retry-After": "1"})
+                               {"Retry-After": "1"}, request_id=rid)
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except ValueError:
                     self._send(400, _err("invalid_request",
-                                         "bad Content-Length", rid))
+                                         "bad Content-Length", rid),
+                               request_id=rid)
                     return
                 if length < 0:
                     # a negative length would defeat the cap below AND
                     # turn rfile.read(length) into read-until-EOF
                     self._send(400, _err("invalid_request",
-                                         "bad Content-Length", rid))
+                                         "bad Content-Length", rid),
+                               request_id=rid)
                     return
                 if length > outer.max_body_bytes:
                     self._send(413, _err(
                         "request_too_large",
                         f"body of {length} bytes exceeds the "
-                        f"{outer.max_body_bytes}-byte cap", rid))
+                        f"{outer.max_body_bytes}-byte cap", rid),
+                        request_id=rid)
                     return
                 try:
                     req = json.loads(self.rfile.read(length) or b"{}")
@@ -306,17 +379,22 @@ class EngineServer:
                         raise ValueError("request body must be a JSON object")
                     p = _validate_request(req, outer.max_tokens_cap)
                 except ValueError as exc:   # malformed request → client error
-                    self._send(400, _err("invalid_request", str(exc), rid))
+                    self._send(400, _err("invalid_request", str(exc), rid),
+                               request_id=rid)
                     return
                 except Exception:
                     self._send(400, _err("invalid_request",
-                                         "malformed JSON body", rid))
+                                         "malformed JSON body", rid),
+                               request_id=rid)
                     return
                 sampling = ({"top_k": p["top_k"], "top_p": p["top_p"]}
                             if (p["top_k"] > 0 or p["top_p"] < 1.0)
                             and p["temperature"] > 0 else {})
                 if outer._deadlines and p["deadline_s"] is not None:
                     sampling["deadline_s"] = p["deadline_s"]
+                if outer._req_ids:
+                    # sessions thread the id into spans + engine logs
+                    sampling["request_id"] = rid
                 if p["stream"]:
                     self._stream(p["prompts"], p["max_tokens"],
                                  p["temperature"], p["stop"], rid, **sampling)
@@ -335,20 +413,22 @@ class EngineServer:
                 except ValueError as exc:
                     # engine-side parameter rejection (token budget larger
                     # than the sequence capacity, …): the request's fault
-                    self._send(400, _err("invalid_request", str(exc), rid))
+                    self._send(400, _err("invalid_request", str(exc), rid),
+                               request_id=rid)
                     return
                 except Exception:       # engine/device fault → server error
                     log.exception("request %s: generation failed", rid)
                     self._send(500, _err("internal_error",
                                          "internal error (see server log)",
-                                         rid))
+                                         rid),
+                               request_id=rid)
                     return
                 self._send(200, {
                     "object": "text_completion",
                     "model": outer.model_id,
                     "choices": [{"index": i, "text": t, "finish_reason": "stop"}
                                 for i, t in enumerate(texts)],
-                })
+                }, request_id=rid)
 
             def _stream(self, prompts, max_tokens, temperature, stop, rid,
                         **sampling) -> None:
@@ -363,6 +443,7 @@ class EngineServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 import queue
 
@@ -455,11 +536,41 @@ class EngineServer:
 
     def attach_session(self, session) -> None:
         """Bind a :class:`ContinuousSession`/:class:`MultiSession`: its
-        readiness backs ``/readyz`` and ``shutdown()`` drains it in the
-        right order (before the listener socket closes)."""
+        readiness backs ``/readyz``, its engine registries feed
+        ``/metrics``, and ``shutdown()`` drains it in the right order
+        (before the listener socket closes)."""
         self._session = session
         if self.ready_fn is None:
             self.ready_fn = session.readiness
+        if self.stats_fn is None:
+            self.stats_fn = session.engine_stats
+
+    # -- observability endpoints -------------------------------------------
+    def merged_registry(self) -> MetricsRegistry:
+        """Every engine/session registry folded with the server's own
+        counters — counters sum, histogram buckets add, gauges take last
+        (the dp/MultiSession merge rule; one scrape sees the whole
+        replica set)."""
+        regs = [self._obs]
+        if self.stats_fn is not None:
+            regs.extend(s.registry for s in self.stats_fn())
+        return MetricsRegistry.merged(regs)
+
+    def metrics_text(self) -> str:
+        return self.merged_registry().render_prometheus()
+
+    def statusz(self) -> dict:
+        """JSON twin of ``/metrics`` + readiness detail (one curl shows
+        what a human wants; Prometheus scrapes the text twin)."""
+        out = {"model": self.model_id,
+               "draining": self._draining.is_set(),
+               "metrics": self.merged_registry().snapshot()}
+        if self.ready_fn is not None:
+            try:
+                out["readiness"] = self.ready_fn()
+            except Exception:   # a readiness fault must not kill statusz
+                out["readiness"] = {"ready": False, "error": "ready_fn failed"}
+        return out
 
     def _track(self):
         import contextlib
@@ -536,6 +647,14 @@ class EngineServer:
         session = getattr(self, "_session", None)
         if session is not None:
             session.close()
+        if self.tracer is not None and self.trace_out:
+            # after session.close(): every in-flight request has resolved,
+            # so its span tree is recorded — the file is complete
+            try:
+                n = self.tracer.save(self.trace_out)
+                log.info("wrote %d trace events to %s", n, self.trace_out)
+            except OSError:
+                log.exception("failed to write trace file %s", self.trace_out)
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -642,9 +761,16 @@ def serve_config(cfg: dict, *, port: int | None = None,
 
     model_id = cfg.get("model_id", "reval-tpu-model")
     bind = port if port is not None else cfg.get("port", 3000)
+    trace_out = cfg.get("trace_out")
+    tracer = None
+    if trace_out:
+        from ..obs.trace import Tracer
+
+        tracer = Tracer()
     lifecycle = {"max_queued_tokens": cfg.get("max_queued_tokens"),
-                 "watchdog_s": cfg.get("watchdog_s")}
+                 "watchdog_s": cfg.get("watchdog_s"), "tracer": tracer}
     body_cap = int(cfg.get("max_body_bytes", MAX_BODY_BYTES))
+    obs_kw = {"tracer": tracer, "trace_out": trace_out}
     if cfg.get("mock"):
         from .mock_engine import MockStepEngine
 
@@ -656,7 +782,8 @@ def serve_config(cfg: dict, *, port: int | None = None,
         server = EngineServer(session.generate_fn(), model_id=model_id,
                               port=bind, serialize=False,
                               max_body_bytes=body_cap,
-                              max_tokens_cap=_max_tokens_cap(engine))
+                              max_tokens_cap=_max_tokens_cap(engine),
+                              **obs_kw)
         server.attach_session(session)
         return server
 
@@ -667,7 +794,7 @@ def serve_config(cfg: dict, *, port: int | None = None,
     backend = TPUBackend(**{k: v for k, v in cfg.items()
                             if k not in ("task", "backend", "port", "mock",
                                          "max_queued_tokens", "watchdog_s",
-                                         "max_body_bytes",
+                                         "max_body_bytes", "trace_out",
                                          "mock_response", "mock_step_s")})
     if warmup:
         secs = warmup_engine(backend.engine)
@@ -693,10 +820,15 @@ def serve_config(cfg: dict, *, port: int | None = None,
     if session is not None:
         server = EngineServer(session.generate_fn(), model_id=model_id,
                               port=bind, serialize=False, max_tokens_cap=cap,
-                              max_body_bytes=body_cap)
+                              max_body_bytes=body_cap, **obs_kw)
         server.attach_session(session)   # readiness + ordered drain
         return server
+    # session-less engines (static/pp/sp) still expose /metrics: no
+    # per-request spans (the session records those), but every engine
+    # counter and engine-side histogram is there
     return EngineServer(_engine_generate_fn(backend.engine),
                         model_id=model_id, port=bind,
                         max_body_bytes=body_cap,
-                        max_tokens_cap=_max_tokens_cap(backend.engine))
+                        max_tokens_cap=_max_tokens_cap(backend.engine),
+                        stats_fn=lambda eng=backend.engine: [eng.stats],
+                        **obs_kw)

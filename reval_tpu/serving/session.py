@@ -129,12 +129,14 @@ def _generate_fn_for(submitter):
     """EngineServer ``generate_fn`` over any ``submit(...) -> _Pending``
     owner (single session or replica set) — pass ``serialize=False``."""
     def generate(prompts, *, max_tokens, temperature, stop,
-                 top_k=0, top_p=1.0, on_progress=None, deadline_s=None):
+                 top_k=0, top_p=1.0, on_progress=None, deadline_s=None,
+                 request_id=None):
         return submitter.submit(prompts, max_new_tokens=max_tokens,
                                 temperature=temperature, stop=stop,
                                 top_k=top_k, top_p=top_p,
                                 on_progress=on_progress,
-                                deadline_s=deadline_s).result()
+                                deadline_s=deadline_s,
+                                request_id=request_id).result()
     return generate
 
 
@@ -147,6 +149,9 @@ class _Submission:
     on_progress: object
     top_k: int = 0
     top_p: float = 1.0
+    #: the wire request id (``X-Request-Id``) this submission serves —
+    #: span tracing and server/client logs name requests by it
+    request_id: str | None = None
     pending: _Pending = field(init=False)
     #: token ids per prompt, encoded in the SUBMITTING thread (admission
     #: control needs the counts before the driver ever sees this)
@@ -154,9 +159,13 @@ class _Submission:
     tokens: int = field(init=False, default=0)
     #: monotonic-clock expiry (None = no deadline)
     deadline: float | None = field(init=False, default=None)
+    #: perf_counter stamp at submit: latency histograms and spans count
+    #: inbox wait from HERE, not from driver pickup
+    t_submit: float = field(init=False, default=0.0)
 
     def __post_init__(self):
         self.pending = _Pending(len(self.prompts))
+        self.t_submit = time.perf_counter()
 
 
 class ContinuousSession:
@@ -180,8 +189,12 @@ class ContinuousSession:
 
     def __init__(self, engine, autostart: bool = True, *,
                  max_queued_tokens: int | None = None,
-                 watchdog_s: float | None = None, step_chaos=None):
+                 watchdog_s: float | None = None, step_chaos=None,
+                 tracer=None):
         self.engine = engine
+        #: optional :class:`~reval_tpu.obs.trace.Tracer` — one span tree
+        #: per (request id, prompt) at completion; None = zero cost
+        self._tracer = tracer
         self._inbox: queue.Queue = queue.Queue()
         self._closed = threading.Event()
         self._wedged = threading.Event()
@@ -219,14 +232,16 @@ class ContinuousSession:
     def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
                temperature: float = 0.0, stop: list[str] | None = None,
                top_k: int = 0, top_p: float = 1.0,
-               on_progress=None, deadline_s: float | None = None) -> _Pending:
+               on_progress=None, deadline_s: float | None = None,
+               request_id: str | None = None) -> _Pending:
         """Enqueue a prompt batch; returns a handle whose ``result()``
         blocks until all its prompts finish.  ``on_progress(index, text)``
         streams finalised-so-far text at decode-chunk granularity (same
         contract as ``PagedTPUEngine.generate``).  ``deadline_s`` is the
         caller's remaining budget: past it the driver cancels the
         submission engine-side and the handle raises
-        :class:`DeadlineExceeded`.
+        :class:`DeadlineExceeded`.  ``request_id`` is the wire id the
+        server received (``X-Request-Id``): spans and logs carry it.
 
         Raises :class:`Overloaded` when the pending-token queue is above
         the watermark, :class:`Draining` after :meth:`close`,
@@ -235,7 +250,8 @@ class ContinuousSession:
         server maps it to 400)."""
         sub = _Submission(list(prompts), max_new_tokens, float(temperature),
                           list(stop or []), on_progress,
-                          top_k=int(top_k), top_p=float(top_p))
+                          top_k=int(top_k), top_p=float(top_p),
+                          request_id=request_id)
         if not sub.prompts:
             sub.pending._fire()
             return sub.pending
@@ -262,6 +278,7 @@ class ContinuousSession:
                     retry_after=self._retry_after_locked())
             self._queued_tokens += sub.tokens
             self._inflight.add(sub)
+            self._set_queue_gauge()
         sub.pending._add_done_callback(lambda: self._release_acct(sub))
         with self._submit_lock:
             if self._closed.is_set():
@@ -285,6 +302,16 @@ class ContinuousSession:
             if sub in self._inflight:
                 self._inflight.discard(sub)
                 self._queued_tokens -= sub.tokens
+                self._set_queue_gauge()
+
+    def _set_queue_gauge(self) -> None:
+        """Mirror the admission backlog into the obs registry (called
+        under ``_acct_lock``) so ``/metrics`` and ``/statusz`` expose
+        the same number ``/readyz`` decides on."""
+        from ..obs import metrics as obs_metrics
+
+        self.engine.stats.registry.gauge(
+            obs_metrics.QUEUED_TOKENS).set(self._queued_tokens)
 
     def generate_fn(self):
         """A ``generate_fn`` for :class:`EngineServer` — blocking per
@@ -492,8 +519,20 @@ class ContinuousSession:
                     eng.tokenizer, req.generated, sub.stop)
                 sub.pending._remaining -= 1
                 eng.stats.prompts += 1
+                if self._tracer is not None:
+                    self._trace_req(sub, pos, req)
                 if sub.pending._remaining == 0:
                     sub.pending._fire()
+
+    def _trace_req(self, sub: _Submission, pos: int, req,
+                   error: str | None = None) -> None:
+        """Emit one finished prompt's span tree from the stamps the
+        engine kept on its request object."""
+        t_done = req.t_done if req.t_done is not None else time.perf_counter()
+        self._tracer.record_request(
+            sub.request_id, pos, t_submit=req.t_submit, t_admit=req.t_admit,
+            t_first=req.t_first, t_done=t_done,
+            n_tokens=len(req.generated), error=error)
 
     def _expire_deadlines(self, reqs: dict, origin: dict, st) -> None:
         """Cancel submissions whose deadline passed: release their
@@ -533,11 +572,13 @@ class ContinuousSession:
         request; the engine must not keep decoding into a freed slot)."""
         eng = self.engine
         for seq_id in list(reqs):
-            sub, _ = origin[seq_id]
+            sub, pos = origin[seq_id]
             if target is not None and sub is not target:
                 continue
             req = reqs.pop(seq_id)
             origin.pop(seq_id)
+            if self._tracer is not None:
+                self._trace_req(sub, pos, req, error=str(exc))
             if not req.done:
                 try:
                     eng.release_request(seq_id, req)
@@ -574,7 +615,9 @@ class ContinuousSession:
                 index=pos, ids=ids, max_new=sub.max_new,
                 scanner=StopScanner(eng.tokenizer, sub.stop),
                 temp=sub.temperature, top_k=sub.top_k, top_p=sub.top_p,
-                notify=notify, key=keys[pos], node=node)
+                notify=notify, key=keys[pos], node=node,
+                # latency counts from the HTTP submit, inbox wait included
+                t_submit=sub.t_submit)
             origin[seq_id] = (sub, pos)
 
 
@@ -607,11 +650,15 @@ class MultiSession:
 
     def __init__(self, engines, autostart: bool = True, *,
                  max_queued_tokens: int | None = None,
-                 watchdog_s: float | None = None, step_chaos=None):
+                 watchdog_s: float | None = None, step_chaos=None,
+                 tracer=None):
+        # one shared tracer: replica placement is an `args` detail, the
+        # span tree is per request id either way
         self.sessions = [ContinuousSession(e, autostart=autostart,
                                            max_queued_tokens=max_queued_tokens,
                                            watchdog_s=watchdog_s,
-                                           step_chaos=step_chaos)
+                                           step_chaos=step_chaos,
+                                           tracer=tracer)
                          for e in engines]
         self._load = [0] * len(self.sessions)
         self._lock = threading.Lock()
@@ -624,7 +671,8 @@ class MultiSession:
     def submit(self, prompts: list[str], *, max_new_tokens: int = 256,
                temperature: float = 0.0, stop: list[str] | None = None,
                top_k: int = 0, top_p: float = 1.0,
-               on_progress=None, deadline_s: float | None = None) -> _Pending:
+               on_progress=None, deadline_s: float | None = None,
+               request_id: str | None = None) -> _Pending:
         n = len(prompts)
         with self._lock:
             accepting = [i for i, s in enumerate(self.sessions)
@@ -652,7 +700,8 @@ class MultiSession:
             pending = self.sessions[i].submit(
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, stop=stop, top_k=top_k, top_p=top_p,
-                on_progress=on_progress, deadline_s=deadline_s)
+                on_progress=on_progress, deadline_s=deadline_s,
+                request_id=request_id)
         except Exception:
             release()                   # closed/shedding session etc.: no leak
             raise

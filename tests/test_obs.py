@@ -1,0 +1,536 @@
+"""Observability layer (fast tier — host-only, no jit, no TPU).
+
+Covers the ISSUE-4 contracts end to end: registry semantics (counters
+sum, histogram buckets add, gauges take last — the dp-replica /
+MultiSession merge rule), histogram correctness at bucket boundaries,
+the Prometheus exposition grammar, `/metrics` + `/statusz` over a real
+mock serve stack, X-Request-Id echo on every response, retry logs naming
+the request, span tracing (one nested tree per request id), the fleet
+latency trailer + metrics snapshot, and the check_metrics/obs_report
+tools.
+"""
+
+import json
+import logging
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reval_tpu.inference.tpu.engine import EngineStats
+from reval_tpu.obs.metrics import (
+    E2E,
+    METRICS,
+    QUEUE_WAIT,
+    REQUESTS,
+    TTFT,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    parse_prometheus,
+)
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_accumulates_and_sets(self):
+        reg = MetricsRegistry()
+        c = reg.counter(REQUESTS)
+        c.add()
+        c.add(2)
+        assert c.value == 3
+        c.set(10)
+        assert reg.counter(REQUESTS).value == 10   # same object
+
+    def test_undeclared_name_rejected_strict(self):
+        reg = MetricsRegistry()
+        with pytest.raises(KeyError):
+            reg.counter("reval_engine_made_up_total")
+        lax = MetricsRegistry(strict=False)
+        assert lax.counter("reval_engine_made_up_total").value == 0
+
+    def test_type_collision_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter(REQUESTS)
+        with pytest.raises(ValueError):
+            reg.histogram(REQUESTS)
+
+    def test_merge_counters_sum_gauges_take_last(self):
+        from reval_tpu.obs.metrics import FREE_PAGES, QUEUED_TOKENS
+
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter(REQUESTS).add(3)
+        b.counter(REQUESTS).add(4)
+        a.gauge(QUEUED_TOKENS).set(100)
+        b.gauge(QUEUED_TOKENS).set(7)
+        a.gauge(FREE_PAGES).set(42)
+        b.gauge(FREE_PAGES)             # registered but never SET in b
+        merged = MetricsRegistry.merged([a, b])
+        assert merged.counter(REQUESTS).value == 7
+        assert merged.gauge(QUEUED_TOKENS).value == 7       # last set wins
+        assert merged.gauge(FREE_PAGES).value == 42         # unset ≠ zero
+
+    def test_merge_histogram_buckets_add(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        for v in (0.01, 0.3):
+            a.histogram(TTFT).observe(v)
+        for v in (0.01, 5.0):
+            b.histogram(TTFT).observe(v)
+        merged = MetricsRegistry.merged([a, b])
+        h = merged.histogram(TTFT)
+        assert h.count == 4
+        assert h.sum == pytest.approx(5.32)
+        i = h.buckets.index(0.01)
+        assert h.counts[i] == 2          # both 0.01 observations in one bucket
+
+    def test_merge_mismatched_buckets_rejected(self):
+        a = MetricsRegistry(strict=False)
+        b = MetricsRegistry(strict=False)
+        a.histogram("reval_engine_adhoc_seconds", buckets=(1.0, 2.0))
+        b.histogram("reval_engine_adhoc_seconds", buckets=(1.0, 3.0)).observe(1)
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+
+class TestHistogram:
+    def test_boundary_is_inclusive(self):
+        """A value exactly on a bucket bound lands IN that bucket
+        (Prometheus `le` semantics), not the next one up."""
+        h = Histogram("reval_request_ttft_seconds", buckets=(0.1, 0.5, 1.0))
+        h.observe(0.1)
+        h.observe(0.5)
+        h.observe(1.0)
+        assert h.counts == [1, 1, 1, 0]
+        h.observe(1.0000001)             # just past the top bound → +Inf
+        assert h.counts == [1, 1, 1, 1]
+        h.observe(0.0)                   # bottom edge → first bucket
+        assert h.counts == [2, 1, 1, 1]
+        assert h.count == 5
+
+    def test_cumulative_rendering(self):
+        reg = MetricsRegistry(strict=False)
+        h = reg.histogram("reval_engine_adhoc_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        samples = parse_prometheus(reg.render_prometheus())
+        assert samples['reval_engine_adhoc_seconds_bucket{le="0.1"}'] == 1
+        assert samples['reval_engine_adhoc_seconds_bucket{le="1"}'] == 2
+        assert samples['reval_engine_adhoc_seconds_bucket{le="+Inf"}'] == 3
+        assert samples['reval_engine_adhoc_seconds_count'] == 3
+        assert samples['reval_engine_adhoc_seconds_sum'] == pytest.approx(2.55)
+
+    def test_percentiles_interpolate(self):
+        h = Histogram("reval_request_e2e_seconds", buckets=(1.0, 2.0, 4.0))
+        for _ in range(100):
+            h.observe(1.5)               # all in the (1, 2] bucket
+        assert 1.0 <= h.percentile(0.5) <= 2.0
+        assert h.percentile(0.99) <= 2.0
+        assert h.percentile(0.5) == pytest.approx(1.5, abs=0.51)
+
+    def test_empty_percentile_zero(self):
+        h = Histogram("reval_request_e2e_seconds", buckets=(1.0,))
+        assert h.percentile(0.99) == 0.0
+
+
+def test_tracer_caps_memory_and_reports_drops(tmp_path):
+    from reval_tpu.obs.trace import Tracer
+
+    tr = Tracer(max_events=10)
+    for i in range(8):
+        tr.record_request(f"r{i}", 0, t_submit=0.0, t_admit=0.1,
+                          t_first=0.2, t_done=1.0, n_tokens=4)
+    path = tmp_path / "t.json"
+    n = tr.save(str(path))
+    assert n == 10 and tr.dropped > 0
+    payload = json.loads(path.read_text())
+    assert payload["otherData"]["dropped_events"] == tr.dropped
+
+
+def test_percentile_estimator_is_shared():
+    """obs_report's percentile over the snapshot encoding must equal the
+    live Histogram's — one estimator, two encodings."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import obs_report
+
+        h = Histogram(TTFT, buckets=(0.1, 0.5, 1.0, 5.0))
+        for v in (0.05, 0.2, 0.3, 0.7, 2.0, 9.0):
+            h.observe(v)
+        snap_h = {"buckets": [[b, c] for b, c in zip(h.buckets, h.counts)],
+                  "inf": h.counts[-1], "sum": h.sum, "count": h.count}
+        for q in (0.5, 0.9, 0.95, 0.99):
+            assert obs_report.percentile(snap_h, q) == h.percentile(q)
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_exposition_grammar_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_prometheus("this is { not a metric line\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("reval_requests_total not_a_number\n")
+
+
+# ---------------------------------------------------------------------------
+# EngineStats over the registry
+# ---------------------------------------------------------------------------
+
+class TestEngineStats:
+    def test_field_compat(self):
+        s = EngineStats()
+        s.prompts += 2
+        s.decode_seconds += 0.5
+        s.prefix_hit_tokens += 128
+        s.prefix_hit_tokens -= 28        # rollback path (failed insert)
+        assert (s.prompts, s.prefix_hit_tokens) == (2, 100)
+        assert isinstance(s.prompts, int)
+        assert s.decode_seconds == pytest.approx(0.5)
+        assert s.serving_counters() == {"sheds": 0, "deadline_expired": 0,
+                                        "watchdog_trips": 0,
+                                        "drain_seconds": 0.0}
+        assert s.prefix_counters() == {"hit_tokens": 100, "hit_rate": 0.0,
+                                       "evictions": 0, "inserted_pages": 0}
+
+    def test_replica_merge_sums_counters_and_buckets(self):
+        """The dp-replica / MultiSession aggregation contract: counters
+        sum, histogram buckets add, gauges take last."""
+        class Req:
+            t_submit, t_admit, t_first, t_done = 0.0, 0.1, 0.2, 1.2
+            generated = [1] * 11
+
+        reps = [EngineStats(), EngineStats()]
+        for s in reps:
+            s.prompts += 3
+            s.observe_request(Req())
+        agg = EngineStats()
+        for s in reps:
+            agg.merge(s)
+        assert agg.prompts == 6
+        assert agg.registry.counter(REQUESTS).value == 2
+        assert agg.registry.histogram(TTFT).count == 2
+        assert agg.registry.histogram(E2E).sum == pytest.approx(2.4)
+        lat = agg.latency_summary()
+        assert lat["tpot"]["count"] == 2
+        assert lat["tpot"]["mean"] == pytest.approx(0.1)
+        assert set(lat) == {"queue_wait", "ttft", "tpot", "e2e"}
+        for row in lat.values():
+            assert row["p50"] <= row["p95"] <= row["p99"]
+
+    def test_no_obs_disables_histograms_keeps_counters(self, monkeypatch):
+        monkeypatch.setenv("REVAL_TPU_OBS", "0")
+        s = EngineStats()
+
+        class Req:
+            t_submit, t_admit, t_first, t_done = 0.0, 0.1, 0.2, 1.2
+            generated = [1, 2]
+
+        s.observe_request(Req())
+        s.prompts += 1
+        assert s.prompts == 1
+        assert s.registry.counter(REQUESTS).value == 1
+        assert s.latency_summary() == {}
+
+
+# ---------------------------------------------------------------------------
+# serving stack: /metrics, /statusz, request ids, tracing
+# ---------------------------------------------------------------------------
+
+def _mock_server(tmp_path=None, **cfg):
+    from reval_tpu.serving import serve_config
+
+    base = {"mock": True}
+    base.update(cfg)
+    return serve_config(base, port=0).start()
+
+
+def _post(port, body, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class TestServingObservability:
+    def test_metrics_statusz_cover_requests(self):
+        srv = _mock_server()
+        try:
+            n = 5
+            for i in range(n):
+                with _post(srv.port, {"prompt": f"p{i}", "max_tokens": 32}):
+                    pass
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                samples = parse_prometheus(r.read().decode())
+            assert samples["reval_requests_total"] == n
+            assert samples["reval_request_ttft_seconds_count"] == n
+            assert samples["reval_request_e2e_seconds_count"] == n
+            assert samples["reval_request_queue_wait_seconds_count"] == n
+            assert samples["reval_engine_prompts_total"] == n
+            assert samples["reval_http_requests_total"] == n
+            assert samples["reval_engine_step_seconds_count"] >= 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/statusz", timeout=10) as r:
+                sz = json.load(r)
+            m = sz["metrics"]
+            assert m["counters"]["reval_requests_total"] == n
+            assert m["histograms"]["reval_request_e2e_seconds"]["count"] == n
+            assert sz["readiness"]["ready"] is True
+        finally:
+            srv.shutdown()
+
+    def test_request_id_echoed_on_every_response(self):
+        srv = _mock_server()
+        try:
+            # success echoes the caller's id
+            with _post(srv.port, {"prompt": "p", "max_tokens": 8},
+                       headers={"X-Request-Id": "my-id-001"}) as r:
+                assert r.headers["X-Request-Id"] == "my-id-001"
+            # a request without one gets a minted id back
+            with _post(srv.port, {"prompt": "p", "max_tokens": 8}) as r:
+                assert len(r.headers["X-Request-Id"]) >= 8
+            # errors echo it too (and keep it in the body)
+            try:
+                with _post(srv.port, {"prompt": "p", "max_tokens": -1},
+                           headers={"X-Request-Id": "bad.req-1"}):
+                    raise AssertionError("expected 400")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 400
+                assert exc.headers["X-Request-Id"] == "bad.req-1"
+                assert json.load(exc)["error"]["request_id"] == "bad.req-1"
+            # header injection attempts are sanitised, not relayed
+            with _post(srv.port, {"prompt": "p", "max_tokens": 8},
+                       headers={"X-Request-Id": "x y\tz!!"}) as r:
+                assert r.headers["X-Request-Id"] == "xyz"
+            # SSE responses carry it in the stream headers
+            with _post(srv.port, {"prompt": "p", "max_tokens": 8,
+                                  "stream": True},
+                       headers={"X-Request-Id": "sse-1"}) as r:
+                assert r.headers["X-Request-Id"] == "sse-1"
+                r.read()
+            # GETs echo when the caller sent one
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/healthz",
+                headers={"X-Request-Id": "probe-7"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.headers["X-Request-Id"] == "probe-7"
+        finally:
+            srv.shutdown()
+
+    def test_trace_file_has_one_span_tree_per_request(self, tmp_path):
+        trace = tmp_path / "trace.json"
+        srv = _mock_server(trace_out=str(trace))
+        try:
+            for i in range(3):
+                with _post(srv.port, {"prompt": f"p{i}", "max_tokens": 16},
+                           headers={"X-Request-Id": f"req-{i}"}):
+                    pass
+        finally:
+            srv.shutdown()
+        payload = json.loads(trace.read_text())
+        events = payload["traceEvents"]
+        roots = [e for e in events if e["name"] == "request"]
+        assert len(roots) == 3
+        by_rid = {e["args"]["request_id"]: e for e in roots}
+        assert set(by_rid) == {"req-0", "req-1", "req-2"}
+        # nesting: every child span fits inside its tid's root span
+        for e in events:
+            if e.get("ph") != "X" or e["name"] == "request":
+                continue
+            root = next(r for r in roots if r["tid"] == e["tid"])
+            assert e["ts"] >= root["ts"] - 1
+            assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1
+        # each tree carries the queue/generate split and the ttft split
+        names_per_tid = {}
+        for e in events:
+            if e.get("ph") == "X":
+                names_per_tid.setdefault(e["tid"], set()).add(e["name"])
+        for names in names_per_tid.values():
+            assert {"request", "queue_wait", "generate",
+                    "first_token", "decode"} <= names
+
+    def test_smoke_cli_with_trace_and_metrics(self, tmp_path, capsys):
+        from reval_tpu.cli import main
+
+        trace = tmp_path / "t.json"
+        rc = main(["serve", "--mock", "--port", "0", "--smoke", "4",
+                   "--trace-out", str(trace)])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["served"] == 4 and summary["errors"] == 0
+        assert summary["metrics_ok"] is True
+        assert summary["requests_total"] == 4
+        assert summary["ttft_count"] == 4 and summary["e2e_count"] == 4
+        payload = json.loads(trace.read_text())
+        assert len([e for e in payload["traceEvents"]
+                    if e["name"] == "request"]) == 4
+
+
+def test_multisession_metrics_merge_across_replicas():
+    """Two mock replicas behind one MultiSession: /metrics-style merge
+    sums both engines' counters and histogram buckets."""
+    from reval_tpu.serving import EngineServer, MockStepEngine, MultiSession
+
+    engines = [MockStepEngine(), MockStepEngine()]
+    ms = MultiSession(engines)
+    srv = EngineServer(ms.generate_fn(), model_id="dp-mock", port=0,
+                       serialize=False, max_tokens_cap=8000)
+    srv.attach_session(ms)
+    srv.start()
+    try:
+        # saturate replica 0 so least-loaded routing spreads work
+        import threading
+
+        def post(i):
+            with _post(srv.port, {"prompt": f"p{i}", "max_tokens": 8}):
+                pass
+
+        threads = [threading.Thread(target=post, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            samples = parse_prometheus(r.read().decode())
+        per_engine = [e.stats.registry.histogram(E2E).count for e in engines]
+        assert samples["reval_requests_total"] == 6
+        assert samples["reval_request_e2e_seconds_count"] == sum(per_engine)
+        assert sum(per_engine) == 6
+    finally:
+        srv.shutdown()
+
+
+def test_retry_log_names_request(caplog):
+    """Satellite: retry attempts log (request_id, attempt, delay)."""
+    from reval_tpu.resilience import RetryPolicy
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("reset")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0,
+                         sleep=lambda s: None)
+    with caplog.at_level(logging.WARNING, logger="reval_tpu.resilience.retry"):
+        assert policy.call(flaky, label="request deadbeef01") == "ok"
+    msgs = [r.getMessage() for r in caplog.records]
+    assert len(msgs) == 2
+    assert all("request deadbeef01" in m for m in msgs)
+    assert "attempt 1/5" in msgs[0] and "retrying in" in msgs[0]
+
+
+# ---------------------------------------------------------------------------
+# fleet surfacing
+# ---------------------------------------------------------------------------
+
+def test_fleet_latency_trailer_and_snapshot(tmp_path):
+    """A backend exposing an instrumented engine yields a `latency` block
+    (p50/p95/p99) in the fleet result and a registry snapshot file next
+    to the checkpoint journal."""
+    from reval_tpu.fleet import FleetRunner
+    from reval_tpu.inference.mock import MockBackend
+    from reval_tpu.serving import MockStepEngine
+
+    class EngineBackend(MockBackend):
+        def __init__(self):
+            super().__init__(prompt_type="direct")
+            self.engine = MockStepEngine()
+
+            class Req:
+                t_submit, t_admit, t_first, t_done = 0.0, 0.01, 0.05, 0.4
+                generated = [1] * 8
+
+            for _ in range(4):
+                self.engine.stats.observe_request(Req())
+
+    runner = FleetRunner(dataset="humaneval", repeats=1, max_items=1,
+                         backend=EngineBackend(), progress=False,
+                         resilience=False, run_consistency=False,
+                         tasks=("coverage",), results_dir=str(tmp_path))
+    result = runner.run()
+    assert result["latency"]["ttft"]["count"] == 4
+    assert result["latency"]["e2e"]["p50"] <= result["latency"]["e2e"]["p99"]
+    snap_path = tmp_path / "fleet_metrics.json"
+    assert snap_path.exists()
+    snap = json.loads(snap_path.read_text())
+    assert snap["latency"] == result["latency"]
+    assert snap["metrics"]["counters"]["reval_requests_total"] == 4
+    assert snap["metrics"]["histograms"]["reval_request_ttft_seconds"][
+        "count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# tools
+# ---------------------------------------------------------------------------
+
+def test_check_metrics_lint_passes():
+    """The wired-in CI check: every declared metric is documented, no
+    collisions, no rogue literals."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import check_metrics
+        errors = check_metrics.run_checks()
+    finally:
+        sys.path.remove(TOOLS)
+    assert errors == [], "\n".join(errors)
+
+
+def test_check_metrics_catches_undocumented(tmp_path):
+    """The lint actually bites: a spec metric absent from the README
+    table is reported."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import check_metrics
+        root = tmp_path / "repo"
+        (root / "reval_tpu" / "obs").mkdir(parents=True)
+        (root / "README.md").write_text("| `reval_requests_total` | c | x |\n")
+        errors = check_metrics.run_checks(str(root))
+    finally:
+        sys.path.remove(TOOLS)
+    missing = [e for e in errors if "missing from the README" in e]
+    assert len(missing) == len(METRICS) - 1
+
+def test_obs_report_renders_and_diffs(tmp_path, capsys):
+    sys.path.insert(0, TOOLS)
+    try:
+        import obs_report
+
+        reg = MetricsRegistry()
+        reg.counter(REQUESTS).add(5)
+        for v in (0.01, 0.02, 0.3):
+            reg.histogram(QUEUE_WAIT).observe(v)
+        a = tmp_path / "a.json"
+        a.write_text(json.dumps(reg.snapshot()))
+        reg.counter(REQUESTS).add(2)
+        reg.histogram(QUEUE_WAIT).observe(1.5)
+        b = tmp_path / "b.json"
+        b.write_text(json.dumps({"metrics": reg.snapshot()}))  # fleet nesting
+        assert obs_report.main([str(a)]) == 0
+        single = capsys.readouterr().out
+        assert "reval_request_queue_wait_seconds" in single
+        assert obs_report.main([str(a), str(b)]) == 0
+        delta = capsys.readouterr().out
+        assert "reval_requests_total" in delta
+        # the diff sees only the 2 new requests and the 1 new observation
+        line = next(l for l in delta.splitlines()
+                    if l.startswith("reval_request_queue_wait_seconds"))
+        assert " 1 " in line
+        line = next(l for l in delta.splitlines()
+                    if l.startswith("reval_requests_total"))
+        assert line.split()[-1] == "2"
+    finally:
+        sys.path.remove(TOOLS)

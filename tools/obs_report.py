@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Render a metrics snapshot (or diff two) as a console report.
+
+Input: JSON files carrying a registry snapshot — either a raw
+``MetricsRegistry.snapshot()`` dict, the fleet's
+``<results_dir>/fleet_metrics.json`` (snapshot under ``"metrics"``), or
+a ``/statusz`` response body (same nesting).  With two files the report
+is the DELTA: counters subtract, histogram bucket counts subtract, and
+the percentiles are recomputed from the bucket deltas — i.e. the
+distribution of exactly the requests that happened between the two
+scrapes, which is how you price a scheduler change without restarting
+the server.
+
+Usage:
+    python tools/obs_report.py SNAP.json [SNAP2.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reval_tpu.obs.metrics import percentile_from_buckets  # noqa: E402
+
+
+def load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        obj = json.load(f)
+    for key in ("metrics",):    # fleet_metrics.json / statusz nesting
+        if key in obj and isinstance(obj[key], dict):
+            obj = obj[key]
+    if not any(k in obj for k in ("counters", "gauges", "histograms")):
+        raise ValueError(f"{path}: not a metrics snapshot (no counters/"
+                         f"gauges/histograms key)")
+    return {"counters": obj.get("counters", {}),
+            "gauges": obj.get("gauges", {}),
+            "histograms": obj.get("histograms", {})}
+
+
+def diff_snapshots(a: dict, b: dict) -> dict:
+    """``b - a`` (a taken first).  Gauges keep b's value (a gauge is a
+    level, not a flow — diffing it would report nonsense)."""
+    counters = {k: round(b["counters"].get(k, 0) - a["counters"].get(k, 0), 6)
+                for k in sorted(set(a["counters"]) | set(b["counters"]))}
+    hists = {}
+    for name in sorted(set(a["histograms"]) | set(b["histograms"])):
+        ha = a["histograms"].get(name)
+        hb = b["histograms"].get(name)
+        if ha is None or hb is None:
+            hists[name] = hb or ha
+            continue
+        if [x[0] for x in ha["buckets"]] != [x[0] for x in hb["buckets"]]:
+            raise ValueError(f"{name}: bucket bounds differ between files")
+        hists[name] = {
+            "buckets": [[bb, cb - ca] for (bb, cb), (_, ca)
+                        in zip(hb["buckets"], ha["buckets"])],
+            "inf": hb.get("inf", 0) - ha.get("inf", 0),
+            "sum": hb["sum"] - ha["sum"],
+            "count": hb["count"] - ha["count"]}
+    return {"counters": counters, "gauges": dict(b["gauges"]),
+            "histograms": hists}
+
+
+def percentile(hist: dict, q: float) -> float:
+    """THE estimator (obs.metrics.percentile_from_buckets — shared with
+    Histogram.percentile so a diff report and a live /metrics scrape can
+    never disagree), applied to the snapshot encoding."""
+    bounds = tuple(b for b, _ in hist["buckets"])
+    counts = [c for _, c in hist["buckets"]] + [hist.get("inf", 0)]
+    return percentile_from_buckets(bounds, counts, hist["count"], q)
+
+
+def _fmt_secs(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:8.3f}s "
+    return f"{v * 1e3:8.3f}ms"
+
+
+def render(snap: dict, title: str) -> str:
+    lines = [f"== obs report: {title} ==", ""]
+    hists = {k: v for k, v in snap["histograms"].items() if v and v["count"]}
+    if hists:
+        lines.append(f"{'histogram':<40} {'count':>8} {'mean':>10} "
+                     f"{'p50':>10} {'p95':>10} {'p99':>10}")
+        for name, h in sorted(hists.items()):
+            mean = h["sum"] / h["count"]
+            lines.append(
+                f"{name:<40} {h['count']:>8} {_fmt_secs(mean):>10} "
+                f"{_fmt_secs(percentile(h, .50)):>10} "
+                f"{_fmt_secs(percentile(h, .95)):>10} "
+                f"{_fmt_secs(percentile(h, .99)):>10}")
+        lines.append("")
+    counters = {k: v for k, v in snap["counters"].items() if v}
+    if counters:
+        lines.append(f"{'counter':<48} {'value':>14}")
+        for name, v in sorted(counters.items()):
+            out = f"{v:.3f}" if isinstance(v, float) and v != int(v) else int(v)
+            lines.append(f"{name:<48} {out:>14}")
+        lines.append("")
+    if snap["gauges"]:
+        lines.append(f"{'gauge':<48} {'value':>14}")
+        for name, v in sorted(snap["gauges"].items()):
+            lines.append(f"{name:<48} {v:>14}")
+        lines.append("")
+    if len(lines) == 2:
+        lines.append("(empty snapshot: no non-zero metrics)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", help="metrics snapshot JSON (registry "
+                                     "snapshot, fleet_metrics.json, or a "
+                                     "/statusz body)")
+    ap.add_argument("snapshot_b", nargs="?", default=None,
+                    help="second snapshot: report the DELTA (b - a), "
+                         "percentiles recomputed from bucket deltas")
+    args = ap.parse_args(argv)
+    a = load_snapshot(args.snapshot)
+    if args.snapshot_b is None:
+        print(render(a, args.snapshot))
+        return 0
+    b = load_snapshot(args.snapshot_b)
+    print(render(diff_snapshots(a, b),
+                 f"{args.snapshot_b} - {args.snapshot}"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
