@@ -408,10 +408,16 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
     """Self-contained serve-path smoke (the tier-1 regression canary for
     the serving lifecycle, mirroring `fleet --mock --chaos`): post ``n``
     prompts CONCURRENTLY through the resilient HTTP client against the
-    just-built server — engine-step chaos applies — then scrape and
-    VERIFY ``/metrics`` (exposition grammar parses, every request shows
-    up in the request counter and the ttft/e2e histograms), gracefully
-    drain, and print one JSON summary line with the lifecycle counters."""
+    just-built server — engine-step chaos applies — while hammering
+    ``/debugz`` from scraper threads (every response must be well-formed
+    JSON, concurrency included), then scrape and VERIFY ``/metrics``
+    (exposition grammar parses, every request shows up in the request
+    counter and the ttft/e2e histograms), gracefully drain, and print
+    one JSON summary line with the lifecycle counters.  Under
+    ``--chaos-step``, additionally assert that injected ``error`` faults
+    produced at least one postmortem bundle and that every bundle on
+    disk parses."""
+    import glob
     import threading
     import urllib.request
 
@@ -434,11 +440,38 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
         except Exception as exc:  # noqa: BLE001 — summarised below
             errors.append(f"prompt {i}: {exc!r}")
 
+    # concurrent /debugz scrapes while requests are in flight: the live
+    # bundle must be well-formed JSON no matter what the driver is doing
+    debugz = {"scrapes": 0, "bad": 0}
+    scrape_stop = threading.Event()
+
+    def scrape() -> None:
+        while not scrape_stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/debugz",
+                        timeout=10) as r:
+                    bundle = json.loads(r.read())
+                debugz["scrapes"] += 1
+                if bundle.get("reason") != "debugz":
+                    debugz["bad"] += 1
+            except Exception as exc:  # noqa: BLE001 — summarised below
+                debugz["bad"] += 1
+                errors.append(f"/debugz: {exc!r}")
+            scrape_stop.wait(0.01)
+
     threads = [threading.Thread(target=post, args=(i,)) for i in range(n)]
+    scrapers = [threading.Thread(target=scrape, daemon=True)
+                for _ in range(3)]
+    for t in scrapers:
+        t.start()
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=120)
+    scrape_stop.set()
+    for t in scrapers:
+        t.join(timeout=10)
     # scrape BEFORE the drain (the listener closes during shutdown) and
     # self-verify: the smoke is the tier-1 canary for /metrics too
     obs = {"metrics_ok": False, "requests_total": 0,
@@ -459,9 +492,26 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
     counters = (session.engine_stats()[0].serving_counters()
                 if session is not None else {})   # session-less engines:
                                                   # no lifecycle counters
+    # postmortem self-check: every bundle on disk parses; injected
+    # `error` faults must have produced at least one (stall-only chaos
+    # legitimately dumps nothing unless the watchdog trips)
+    pm_dir = cfg.get("postmortem_dir")
+    postmortems = 0
+    if pm_dir:
+        for path in glob.glob(os.path.join(pm_dir, "postmortem-*.json")):
+            try:
+                with open(path) as f:
+                    bundle = json.load(f)
+                assert bundle.get("reason"), path
+                postmortems += 1
+            except Exception as exc:  # noqa: BLE001 — summarised below
+                errors.append(f"postmortem {path}: {exc!r}")
+    chaos_errors = (sum(1 for mode, _ in step_chaos.injected
+                        if mode == "error") if step_chaos else 0)
     summary = {
         "served": len(outs), "errors": len(errors), **counters, **obs,
         "chaos_injected": len(step_chaos.injected) if step_chaos else 0,
+        "debugz_scrapes": debugz["scrapes"], "postmortems": postmortems,
     }
     if server.trace_out:
         summary["trace_out"] = server.trace_out
@@ -473,9 +523,13 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
                        and not (obs["requests_total"] >= n
                                 and obs["ttft_count"] >= n
                                 and obs["e2e_count"] >= n)))
-    if errors or len(outs) != n or metrics_bad:
+    debugz_bad = debugz["bad"] > 0 or debugz["scrapes"] == 0
+    postmortem_bad = bool(pm_dir) and chaos_errors > 0 and postmortems == 0
+    if errors or len(outs) != n or metrics_bad or debugz_bad or postmortem_bad:
         print(f"[smoke] failures: {errors[:3]}"
-              + (" [metrics check failed]" if metrics_bad else ""))
+              + (" [metrics check failed]" if metrics_bad else "")
+              + (" [debugz check failed]" if debugz_bad else "")
+              + (" [postmortem check failed]" if postmortem_bad else ""))
         return 1
     return 0
 
@@ -517,6 +571,11 @@ def run_serve(argv: list[str]) -> int:
                              "request span trees (queue wait, first token, "
                              "decode) here at shutdown; ids follow "
                              "X-Request-Id")
+    parser.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                        help="where crash-dump bundles land (watchdog trip, "
+                             "driver fault, deadline storm, SIGUSR1, SIGTERM "
+                             "drain; default env REVAL_TPU_POSTMORTEM_DIR or "
+                             "tpu_watch/)")
     args = parser.parse_args(argv)
     cfg = {}
     if os.path.exists(args.input):
@@ -529,6 +588,14 @@ def run_serve(argv: list[str]) -> int:
         cfg["mock"] = True
     if args.trace_out:
         cfg["trace_out"] = args.trace_out
+    if args.postmortem_dir:
+        cfg["postmortem_dir"] = args.postmortem_dir
+    elif args.smoke is not None and "postmortem_dir" not in cfg:
+        # the smoke self-verifies bundle production: give it a private
+        # dir so the assertion never counts someone else's dumps
+        import tempfile
+
+        cfg["postmortem_dir"] = tempfile.mkdtemp(prefix="reval-postmortem-")
     step_chaos = None
     if args.chaos_step:
         from .resilience import EngineStepChaos
@@ -544,21 +611,36 @@ def run_serve(argv: list[str]) -> int:
         return _serve_smoke(server, cfg, args.smoke, step_chaos)
     print(f"serving {cfg.get('model_id')} on :{server.port} "
           f"(POST /v1/completions, GET /v1/models /healthz /readyz "
-          f"/metrics /statusz)")
+          f"/metrics /statusz /debugz; SIGUSR1 dumps a postmortem)")
     # orchestrators stop containers with SIGTERM: run the graceful drain
     # on a side thread WHILE serve_forever keeps answering — rejected
     # POSTs get their fast "503 draining" instead of hanging in the
     # listen backlog; shutdown() itself stops the accept loop last, which
     # unblocks serve_forever below.  Ctrl-C (KeyboardInterrupt inside the
     # accept loop) falls through to the same idempotent shutdown().
+    # A SIGTERM-triggered drain first lands a postmortem bundle — the
+    # flight-recorder runway of whatever the engine was doing when the
+    # orchestrator pulled the plug.
     import signal
     import threading
 
+    def _drain_with_postmortem():
+        server.dump_postmortem("sigterm_drain")
+        server.shutdown()
+
     def _sigterm(signum, frame):
-        threading.Thread(target=server.shutdown, daemon=True,
+        threading.Thread(target=_drain_with_postmortem, daemon=True,
                          name="sigterm-drain").start()
 
+    def _sigusr1(signum, frame):
+        # on-demand flight-data pull from a LIVE server: no drain, no
+        # pause — the bundle is assembled from racy reads by design
+        threading.Thread(target=server.dump_postmortem, args=("sigusr1",),
+                         daemon=True, name="sigusr1-postmortem").start()
+
     signal.signal(signal.SIGTERM, _sigterm)
+    if hasattr(signal, "SIGUSR1"):      # absent on win32
+        signal.signal(signal.SIGUSR1, _sigusr1)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -585,6 +667,10 @@ def main(argv: list[str] | None = None) -> int:
         return run_fleet(argv[1:])
     if argv and argv[0] == "serve":
         return run_serve(argv[1:])
+    if argv and argv[0] == "watch":
+        from .watch import run_watch
+
+        return run_watch(argv[1:])
     if argv and argv[0] == "analyze":
         return run_analyze(argv[1:])
     if argv and argv[0] == "taskgen":

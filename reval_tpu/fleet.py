@@ -33,6 +33,7 @@ reproduces identical logs.
 
 from __future__ import annotations
 
+from .obs.logging import log_event
 from .resilience import INFER_FAILED, FleetCheckpoint, ResilientBackend, RetryPolicy
 from .tasks import TASKS, ConsistencyScorer
 
@@ -100,6 +101,7 @@ class FleetRunner:
             row = checkpoint.done(rep, name) if checkpoint is not None else None
             if row is not None:
                 metrics[name] = row["metrics"]
+                log_event("fleet.resume_skip", repeat=rep + 1, task=name)
                 if self.progress:
                     print(f"[fleet] resume: repeat {rep + 1} task {name} "
                           f"already scored — skipping")
@@ -205,6 +207,8 @@ class FleetRunner:
         if isinstance(self.backend, ResilientBackend) and self.backend.failures:
             # prompts that exhausted retries and were scored as INFER_FAILED
             result["lost_prompts"] = len(self.backend.failures)
+            log_event("fleet.lost_prompts", level="warning",
+                      lost=len(self.backend.failures))
             if self.progress:
                 print(f"[fleet] {len(self.backend.failures)} prompts lost to "
                       f"{INFER_FAILED} after retries")
@@ -285,6 +289,17 @@ class FleetRunner:
         stats = getattr(getattr(self.backend, "engine", None), "stats", None)
         if stats is None or self.multihost is not None:
             return
+        from .obs import metrics as obs_metrics
+
+        if (not stats.registry.counter(obs_metrics.REQUESTS).value
+                and not stats.prompts):
+            # zero requests completed this run — e.g. a --resume where
+            # every chunk was already journaled.  Writing would clobber
+            # the PREVIOUS run's real distributions with an empty shell.
+            if self.progress:
+                print("[fleet] no requests completed — keeping the "
+                      "existing metrics snapshot")
+            return
         import json
         import os
         import time
@@ -307,5 +322,8 @@ class FleetRunner:
             os.replace(path + ".tmp", path)
             if self.progress:
                 print(f"[fleet] metrics snapshot: {path}")
-        except OSError:
-            pass        # a read-only results dir must not fail the run
+        except OSError as exc:
+            # a read-only results dir must not fail the run — but the
+            # lost snapshot should leave a trace
+            log_event("fleet.snapshot_error", level="warning", exc=exc,
+                      results_dir=self.results_dir)

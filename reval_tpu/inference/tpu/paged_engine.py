@@ -51,6 +51,8 @@ from ...models import (
     prefill,
 )
 from ...obs import metrics as obs_metrics
+from ...obs.flightrec import FlightRecorder
+from ...obs.logging import log_event
 from ...models.paged import (
     commit_prefill,
     init_paged_cache,
@@ -222,6 +224,10 @@ class PagedTPUEngine:
         #: decode-loop progress stamp (monotonic): the serving watchdog
         #: reads it to tell "slow but stepping" from "wedged"
         self.heartbeat = time.monotonic()
+        #: always-on per-step ring buffer feeding postmortem bundles
+        #: (obs/flightrec.py; REVAL_TPU_FLIGHTREC=0 disables — the A/B)
+        self.flightrec = FlightRecorder()
+        self._pinned_sample = 0     # decimated pinned-pages gauge (tree walk)
         self._key = jax.random.PRNGKey(seed)
         self.params = params
         dtype = params["embed"].dtype
@@ -619,10 +625,28 @@ class PagedTPUEngine:
         try:
             self._tick(reqs, st)
         finally:
-            self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(
-                time.perf_counter() - t0)
-            self.stats.registry.gauge(obs_metrics.FREE_PAGES).set(
-                self.rt.free_pages if self.rt is not None else 0)
+            dt = time.perf_counter() - t0
+            free = self.rt.free_pages if self.rt is not None else 0
+            self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(dt)
+            self.stats.registry.gauge(obs_metrics.FREE_PAGES).set(free)
+            fr = self.flightrec
+            if fr.enabled:
+                pc = self.prefix_cache
+                if pc is not None and not (fr.total & 63):
+                    # pinned_pages walks the radix tree: sample it every
+                    # 64 ticks, not per record (the rest is O(1) reads)
+                    self._pinned_sample = pc.pinned_pages
+                fr.record(
+                    len(st.active),
+                    self.rt.num_waiting if self.rt is not None else 0,
+                    free,
+                    pc.cached_pages if pc is not None else 0,
+                    self._pinned_sample,
+                    self.stats.prefix_hit_tokens,
+                    st.pending[1] if st.pending is not None else 0,
+                    dt,
+                    time.monotonic() - self.heartbeat,
+                    tuple(st.active.values()))
 
     def _tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
         """ONE admission + prefill + decode-chunk round over ``reqs``.
@@ -686,6 +710,9 @@ class PagedTPUEngine:
                     req.notify(req)
         if not st.active:
             if any(not r.done for r in reqs.values()):
+                log_event("engine.deadlock", level="error",
+                          waiting=self.rt.num_waiting,
+                          free_pages=self.rt.free_pages)
                 raise RuntimeError(
                     "paged scheduler deadlock: nothing running or admissible")
             return
@@ -958,6 +985,9 @@ class PagedTPUEngine:
                 # (never-executed) steps into its resume prompt
                 victim = max(active.values())
                 vreq = reqs[victim]
+                log_event("engine.preempt", level="warning", seq_id=victim,
+                          kept_tokens=len(vreq.ids) + len(vreq.generated) - 1,
+                          free_pages=self.rt.free_pages)
                 self.rt.preempt(victim, len(vreq.ids) + len(vreq.generated) - 1)
                 # generated tokens are KEPT: the runtime folded them into the
                 # victim's prompt_len, so re-admission prefills prompt+generated

@@ -1,14 +1,21 @@
-"""Observability: metrics registry, latency histograms, span tracing.
+"""Observability: metrics, span tracing, structured logs, flight recorder.
 
 ``metrics`` carries the process-wide metric namespace (``METRICS``) and
 the mergeable :class:`MetricsRegistry` that backs
 :class:`~reval_tpu.inference.tpu.engine.EngineStats`; ``trace`` emits
 Chrome-trace/Perfetto span trees per served request (``serve
---trace-out``).  The serving server exposes both: ``GET /metrics``
-(Prometheus text) and ``GET /statusz`` (JSON snapshot).
+--trace-out``); ``logging`` is the structured JSON event log (one
+declared-namespace event per line, ``EVENTS`` linted like ``METRICS``);
+``flightrec`` is the always-on per-step ring buffer behind crash-dump
+postmortem bundles.  The serving server exposes all of it: ``GET
+/metrics`` (Prometheus text), ``GET /statusz`` (JSON snapshot), and
+``GET /debugz`` (a live postmortem bundle).
 """
 
+from .flightrec import FlightRecorder, PostmortemWriter
+from .logging import EVENTS, log_event
 from .metrics import METRICS, LATENCY_BUCKETS, MetricsRegistry
 from .trace import Tracer
 
-__all__ = ["METRICS", "LATENCY_BUCKETS", "MetricsRegistry", "Tracer"]
+__all__ = ["METRICS", "LATENCY_BUCKETS", "MetricsRegistry", "Tracer",
+           "EVENTS", "log_event", "FlightRecorder", "PostmortemWriter"]

@@ -1,0 +1,237 @@
+"""Flight recorder: a bounded ring of per-step engine state + postmortems.
+
+PR 3's watchdog tells you *that* the engine wedged and PR 4's metrics
+tell you the aggregate shape of the run — but when the trip (or a chaos
+fault, preemption storm, or drain hang) actually fires, the state of the
+last N ticks is gone.  Production serving postmortems (FlashInfer-Bench;
+the vLLM/TGI comparison — PAPERS.md) need a continuous, cheap recording
+of per-step engine state, captured *before* anyone knew it would matter.
+
+:class:`FlightRecorder` is that recording: an always-on ring buffer
+(default :data:`CAPACITY` = 4096 records) the engine's drive tick feeds
+once per step.  One record is ONE tuple assignment into a preallocated
+list — no locks, no allocation beyond the tuple, no formatting — i.e.
+O(100ns)-class per tick (measured ~0.6 µs with its input reads, PERF.md)
+against a tick wall of ≥1 ms host-only and ~100 ms on the tunneled chip.
+``REVAL_TPU_FLIGHTREC=0`` disables recording for the A/B.
+
+Writers are single-threaded by design (the engine is single-owner: one
+driver thread feeds one recorder); readers (``/debugz`` scrapes, dump
+triggers) copy the list and tolerate a record landing mid-copy — every
+element is an immutable tuple, so a snapshot is always a set of
+well-formed records, merely fuzzy at the newest edge.
+
+On top of it, this module assembles **postmortem bundles**: one JSON
+document carrying the flight-record runway, the metrics registry
+snapshot, readiness, the in-flight request table with lifecycle stamps,
+the span-tree tail, the recent structured-log ring
+(:mod:`~reval_tpu.obs.logging`), and an env/config fingerprint.
+:class:`PostmortemWriter` lands them as ``postmortem-<ts>.json`` with
+retention (keep the newest :data:`KEEP` bundles) and a rate limit (a
+fault storm must not turn into a disk storm).  Triggers live with their
+owners: watchdog trip / driver exception / deadline storm in the serving
+session, SIGUSR1 + SIGTERM-drain in the CLI, and ``GET /debugz`` serves
+the same bundle live without writing anything.
+``tools/postmortem_report.py`` renders a bundle as a human timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+__all__ = ["CAPACITY", "KEEP", "FIELDS", "FlightRecorder",
+           "PostmortemWriter", "build_bundle", "env_fingerprint"]
+
+#: ring capacity: at one record per drive tick and ~32 decode steps per
+#: tick, 4096 records cover ~130k decode steps of runway — minutes of
+#: serving before a trip, a full run on the fast tier
+CAPACITY = 4096
+
+#: bundles retained on disk (oldest pruned) — see PostmortemWriter
+KEEP = 8
+
+#: positional field names of one flight record (tuples in the ring carry
+#: values in exactly this order; snapshot() zips them back to dicts)
+FIELDS = (
+    "step",              # recorder ordinal (monotonic, never wraps)
+    "ts",                # wall clock (time.time) at record
+    "running",           # sequences in decode slots
+    "queued",            # sequences waiting in the native scheduler
+    "free_pages",        # KV pool pages free
+    "cached_pages",      # pages held by the radix prefix cache
+    "pinned_pages",      # cache pages pinned by riders (decimated sample)
+    "prefix_hit_tokens",  # cumulative cache-hit tokens (delta = per-step)
+    "chunk_steps",       # decode steps of the in-flight/last chunk
+    "step_ms",           # this drive tick's wall time
+    "hb_age_ms",         # watchdog heartbeat age when the tick ended
+    "seq_ids",           # sequence ids in the active slots (last touched)
+)
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records; see the module docstring for
+    the concurrency and cost model."""
+
+    __slots__ = ("capacity", "enabled", "total", "_buf")
+
+    def __init__(self, capacity: int = CAPACITY, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("REVAL_TPU_FLIGHTREC", "1").lower() \
+                not in ("0", "false", "off")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self.total = 0                       # records ever written
+        self._buf: list = [None] * self.capacity
+
+    def record(self, running: int, queued: int, free_pages: int,
+               cached_pages: int, pinned_pages: int, prefix_hit_tokens: int,
+               chunk_steps: int, step_s: float, hb_age: float,
+               seq_ids: tuple) -> None:
+        """One drive tick's state.  Single tuple store; no locking (one
+        writer — the engine's driver thread)."""
+        if not self.enabled:
+            return
+        n = self.total
+        self._buf[n % self.capacity] = (
+            n, time.time(), running, queued, free_pages, cached_pages,
+            pinned_pages, prefix_hit_tokens, chunk_steps,
+            step_s * 1e3, hb_age * 1e3, seq_ids)
+        self.total = n + 1
+
+    def records(self, last: int | None = None) -> list[tuple]:
+        """Retained records oldest → newest (raw tuples, FIELDS order)."""
+        n, cap = self.total, self.capacity
+        buf = list(self._buf)                # one racy-but-atomic copy
+        if n <= cap:
+            out = [r for r in buf[:n] if r is not None]
+        else:
+            head = n % cap
+            out = [r for r in buf[head:] + buf[:head] if r is not None]
+        out.sort(key=lambda r: r[0])         # writer may race the copy
+        return out[-last:] if last is not None else out
+
+    def snapshot(self, last: int | None = None) -> list[dict]:
+        """Retained records as JSON-able dicts (postmortem encoding)."""
+        return [
+            {k: (list(v) if isinstance(v, tuple) else
+                 round(v, 3) if isinstance(v, float) else v)
+             for k, v in zip(FIELDS, rec)}
+            for rec in self.records(last)
+        ]
+
+
+def env_fingerprint(extra: dict | None = None) -> dict:
+    """What was this process?  Every ``REVAL_TPU_*`` env knob, the
+    interpreter, and the jax version if jax was loaded (never imports
+    it — a mock serve stays host-only)."""
+    jax_mod = sys.modules.get("jax")
+    fp = {
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "platform": sys.platform,
+        "jax": getattr(jax_mod, "__version__", None),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith("REVAL_TPU_") or k == "JAX_PLATFORMS"},
+    }
+    if extra:
+        fp.update(extra)
+    return fp
+
+
+def build_bundle(reason: str, envelope: bool = True, **sections) -> dict:
+    """Assemble a postmortem bundle: the common envelope (version,
+    timestamps, reason, env fingerprint, recent structured-log ring)
+    plus whatever sections the caller owns (``flight``, ``metrics``,
+    ``readiness``, ``inflight``, ``requests``, ``spans``, ``replicas``,
+    ``error`` …).  ``envelope=False`` skips the process-global parts —
+    a dp replica's sub-bundle must not repeat the fingerprint and log
+    ring its parent envelope already carries, once per replica."""
+    bundle: dict = {"reason": reason}
+    if envelope:
+        from . import logging as obs_logging
+
+        bundle.update(
+            postmortem_version=1,
+            ts=time.time(),
+            iso=time.strftime("%Y-%m-%dT%H:%M:%S"),
+            fingerprint=env_fingerprint(),
+            recent_logs=obs_logging.recent(64),
+        )
+    for key, value in sections.items():
+        if value is not None:
+            bundle[key] = value
+    return bundle
+
+
+class PostmortemWriter:
+    """Land bundles on disk: ``<dir>/postmortem-<ts>-<pid>.json``.
+
+    - **atomic**: written to a ``.tmp`` sibling and renamed, so a
+      concurrent reader (or a crash mid-write) never sees a torn file;
+    - **retained**: only the newest ``keep`` bundles survive — a
+      long-lived server cannot fill the disk with trip history;
+    - **rate-limited PER REASON**: at most one bundle per
+      ``min_interval_s`` for a given trigger — a chaos/fault storm
+      collapses to its first dump per window, but a ``sigterm_drain``
+      landing right after a ``driver_exception`` still writes (distinct
+      triggers carry distinct stories);
+    - **non-fatal**: every failure is swallowed into a structured log
+      event; diagnostics must never take the serving path down.
+
+    Default directory: ``REVAL_TPU_POSTMORTEM_DIR`` or ``tpu_watch/``
+    (the repo's scratch-artifact convention; created on demand).
+    """
+
+    def __init__(self, directory: str | None = None, keep: int = KEEP,
+                 min_interval_s: float = 2.0):
+        self.directory = (directory
+                          or os.environ.get("REVAL_TPU_POSTMORTEM_DIR")
+                          or "tpu_watch")
+        self.keep = int(keep)
+        self.min_interval_s = float(min_interval_s)
+        self._last_dump: dict[str, float] = {}   # reason -> last success
+
+    def dump(self, bundle: dict) -> str | None:
+        """Write one bundle; returns the path, or None (rate-limited or
+        failed — failure is logged, never raised).  The rate limit is
+        per ``reason`` and only a SUCCESSFUL write arms it, so a failed
+        attempt (disk hiccup) does not suppress the retry."""
+        from . import logging as obs_logging
+
+        reason = str(bundle.get("reason"))
+        now = time.monotonic()
+        last = self._last_dump.get(reason)
+        if last is not None and now - last < self.min_interval_s:
+            return None
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            ms = int(time.time() * 1000) % 1000
+            name = f"postmortem-{stamp}-{ms:03d}-{os.getpid()}.json"
+            path = os.path.join(self.directory, name)
+            with open(path + ".tmp", "w") as f:
+                json.dump(bundle, f, default=str)
+            os.replace(path + ".tmp", path)
+            self._last_dump[reason] = now
+            self._prune()
+            obs_logging.log_event("session.postmortem", path=path,
+                                  reason=reason)
+            return path
+        except OSError as exc:
+            obs_logging.log_event("session.postmortem", level="error",
+                                  exc=exc, reason=reason)
+            return None
+
+    def _prune(self) -> None:
+        bundles = sorted(
+            f for f in os.listdir(self.directory)
+            if f.startswith("postmortem-") and f.endswith(".json"))
+        for stale in bundles[:-self.keep] if self.keep > 0 else bundles:
+            try:
+                os.remove(os.path.join(self.directory, stale))
+            except OSError:
+                pass

@@ -1,0 +1,167 @@
+"""Structured JSON logging: one event, one JSON object, one line.
+
+The serving stack's operational logging used to be ad-hoc — a
+``logging.warning`` here, a bare ``print`` there — which meant a
+postmortem grep had to know five message formats and could correlate
+nothing.  This module replaces those call sites with :func:`log_event`:
+
+    log_event("session.watchdog_trip", level="error",
+              watchdog_s=120.0, pending=3)
+
+emits exactly one line of JSON to stderr::
+
+    {"ts": "2026-08-03T12:00:00.123+00:00", "level": "error",
+     "component": "session", "event": "session.watchdog_trip",
+     "fields": {"watchdog_s": 120.0, "pending": 3}}
+
+Contracts (mirroring the metrics registry's namespace discipline):
+
+- **One namespace.**  Every event name is declared ONCE in :data:`EVENTS`
+  (``component.event``; the component is the prefix).  ``tools/
+  check_metrics.py`` lints call-site literals against the table in both
+  directions — an event cannot ship undeclared, or stay declared after
+  its last call site is deleted.  ``log_event`` itself never raises on an
+  unknown name (a typo in an ``except`` block must not mask the real
+  error); the lint is the enforcement.
+- **Correlation.**  ``request_id`` is a first-class key: the server, the
+  session, and the client's retry loop all pass the wire
+  ``X-Request-Id``, so one grep assembles a request's full story across
+  both sides.
+- **Bounded recall.**  The last :data:`RING_CAPACITY` events are kept in
+  an in-process ring regardless of the emission level — the flight
+  recorder's postmortem bundles (:mod:`~reval_tpu.obs.flightrec`) attach
+  them as the ``recent_logs`` section, so a crash dump carries the log
+  context that led up to it even when stderr scrolled away.
+
+Knobs: ``REVAL_TPU_LOG_LEVEL`` (default ``info``) filters emission;
+``REVAL_TPU_LOG=0`` silences stderr entirely (the ring still records, so
+postmortems stay complete).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["EVENTS", "RING_CAPACITY", "log_event", "recent"]
+
+#: The canonical event namespace: name -> one-line meaning.  Declared
+#: once, linted by ``tools/check_metrics.py`` against every
+#: ``log_event("...")`` literal in the tree (both directions) and
+#: against the README events table.
+EVENTS: dict[str, str] = {
+    # client side (inference/client.py, resilience/retry.py)
+    "client.retry": "an HTTP attempt failed and will be retried",
+    "client.wait": "waiting for server readiness during the handshake",
+    # engine (inference/tpu/paged_engine.py)
+    "engine.preempt": "a running sequence was preempted on pool exhaustion",
+    "engine.deadlock": "nothing running or admissible while work remains",
+    # serving session (serving/session.py)
+    "session.watchdog_trip": "no engine progress past watchdog_s; "
+                             "pending submissions failed typed",
+    "session.driver_error": "the driver tick raised; in-flight submissions "
+                            "failed and the drive state was reset",
+    "session.deadline_expired": "a submission was cancelled at its deadline",
+    "session.deadline_storm": "several deadlines expired in one sweep",
+    "session.drain_stuck": "the driver did not exit within the close timeout",
+    "session.postmortem": "a postmortem bundle was written (or failed)",
+    # HTTP server (serving/server.py)
+    "server.request_error": "a completions request failed server-side",
+    "server.drained": "graceful drain finished; lifecycle counters attached",
+    "server.trace_written": "the span trace file was written at drain",
+    "server.trace_error": "writing the span trace file failed",
+    # fleet (fleet.py)
+    "fleet.resume_skip": "a journaled (repeat, task) chunk was skipped",
+    "fleet.lost_prompts": "prompts exhausted retries and took the sentinel",
+    "fleet.snapshot_error": "writing fleet_metrics.json failed",
+}
+
+#: events retained in-process for postmortem bundles
+RING_CAPACITY = 512
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "error": logging.ERROR}
+
+_ring: deque = deque(maxlen=RING_CAPACITY)
+_ring_lock = threading.Lock()
+_logger = logging.getLogger("reval_tpu.events")
+_configured = False
+
+
+def _ensure_sink() -> logging.Logger:
+    """Attach the raw-JSON stderr handler once (idempotent).  The logger
+    does not propagate: the line IS the record — a root formatter
+    wrapping it would break one-object-per-line."""
+    global _configured
+    if not _configured:
+        if not _logger.handlers:
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter("%(message)s"))
+            _logger.addHandler(handler)
+        _logger.propagate = False
+        level = os.environ.get("REVAL_TPU_LOG_LEVEL", "info").lower()
+        _logger.setLevel(_LEVELS.get(level, logging.INFO))
+        if os.environ.get("REVAL_TPU_LOG", "1").lower() in ("0", "false",
+                                                            "off"):
+            _logger.setLevel(logging.CRITICAL + 1)
+        _configured = True
+    return _logger
+
+
+def _iso_now() -> str:
+    t = time.time()
+    ms = int((t - int(t)) * 1000)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(t)) + f".{ms:03d}"
+
+
+def _jsonable(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
+
+
+def log_event(event: str, *, level: str = "info",
+              request_id: str | None = None, exc: BaseException | None = None,
+              **fields) -> dict:
+    """Record one structured event; returns the record dict (tests and
+    callers that embed it in a bundle use the return value).
+
+    ``event`` must be a declared :data:`EVENTS` name (``component.event``
+    — the component is derived from the prefix); unknown names still log
+    (flagged by the lint, never a runtime crash in an error path).
+    ``exc`` attaches ``repr(exc)`` as the ``error`` field.
+    """
+    rec: dict = {"ts": _iso_now(), "level": level,
+                 "component": event.split(".", 1)[0], "event": event}
+    if request_id is not None:
+        rec["request_id"] = str(request_id)
+    if exc is not None:
+        rec["error"] = repr(exc)
+    if fields:
+        rec["fields"] = {k: _jsonable(v) for k, v in fields.items()}
+    with _ring_lock:
+        _ring.append(rec)
+    logger = _ensure_sink()
+    lvl = _LEVELS.get(level, logging.INFO)
+    if logger.isEnabledFor(lvl):
+        logger.log(lvl, json.dumps(rec, default=str))
+    return rec
+
+
+def recent(n: int | None = None, min_level: str = "debug") -> list[dict]:
+    """The last ``n`` (default: all retained) events at or above
+    ``min_level``, oldest first — the ``recent_logs`` postmortem
+    section."""
+    floor = _LEVELS.get(min_level, logging.DEBUG)
+    with _ring_lock:
+        events = list(_ring)
+    events = [e for e in events if _LEVELS.get(e["level"], 20) >= floor]
+    return events if n is None else events[-n:]

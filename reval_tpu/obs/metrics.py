@@ -38,7 +38,7 @@ import threading
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
-    "parse_prometheus", "percentile_from_buckets",
+    "parse_prometheus", "percentile_from_buckets", "snapshot_percentile",
     "LATENCY_BUCKETS", "STEP_BUCKETS",
     "REQUESTS", "QUEUE_WAIT", "TTFT", "TPOT", "E2E",
     "ENGINE_STEP", "DECODE_CHUNK", "PREFILL_BATCH",
@@ -273,6 +273,18 @@ def percentile_from_buckets(bounds: tuple[float, ...], counts,
             return lo + (bounds[i] - lo) * max(0.0, rank - cum) / c
         cum += c
     return bounds[-1]
+
+
+def snapshot_percentile(hist: dict, q: float) -> float:
+    """:func:`percentile_from_buckets` applied to the SNAPSHOT encoding
+    (``{"buckets": [[bound, count], ...], "inf": n, "count": n}`` — what
+    :meth:`MetricsRegistry.snapshot`, ``/statusz``, and
+    ``fleet_metrics.json`` carry).  One estimator, two encodings:
+    ``tools/obs_report.py`` and the ``reval_tpu watch`` console both call
+    this, so no rendered percentile can disagree with a live scrape."""
+    bounds = tuple(b for b, _ in hist["buckets"])
+    counts = [c for _, c in hist["buckets"]] + [hist.get("inf", 0)]
+    return percentile_from_buckets(bounds, counts, hist["count"], q)
 
 
 class _NullHistogram:

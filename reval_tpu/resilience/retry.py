@@ -15,13 +15,12 @@ from __future__ import annotations
 
 import http.client
 import json
-import logging
 import random
 import time
 import urllib.error
 from typing import Callable
 
-log = logging.getLogger(__name__)
+from ..obs.logging import log_event
 
 __all__ = ["RetryPolicy", "retryable_error", "retry_after_hint",
            "wait_for_server"]
@@ -124,9 +123,9 @@ class RetryPolicy:
                     raise
                 delay = self.delay_for(attempt, exc)
                 if label is not None:
-                    log.warning("[retry] %s: attempt %d/%d failed (%r); "
-                                "retrying in %.2fs", label, attempt + 1,
-                                budget, exc, delay)
+                    log_event("client.retry", level="warning", label=label,
+                              attempt=attempt + 1, budget=budget,
+                              delay_s=round(delay, 3), exc=exc)
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.sleep(delay)
@@ -169,7 +168,7 @@ def wait_for_server(probe: Callable[[], "object"], *, timeout: float = 60.0,
             if not announced:
                 # the wait can legitimately run minutes (engine loading);
                 # say so once instead of hanging silently
-                print(f"[resilience] waiting for {describe} "
-                      f"(up to {timeout:.0f}s; {exc!r})")
+                log_event("client.wait", target=describe,
+                          timeout_s=round(timeout, 1), exc=exc)
                 announced = True
         sleep(max(0.0, min(interval, deadline - clock())))

@@ -23,6 +23,7 @@ from types import SimpleNamespace
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs.flightrec import FlightRecorder
 
 __all__ = ["MockStepEngine"]
 
@@ -50,6 +51,9 @@ class MockStepEngine:
         #: drops back to zero after every cancel/expiry/failure path
         self.live = 0
         self.heartbeat = time.monotonic()
+        #: same per-step ring the paged engine feeds — serve --mock
+        #: exercises the flight-recorder/postmortem path host-only
+        self.flightrec = FlightRecorder()
 
     # -- the session driver contract --------------------------------------
     def encode_clipped(self, prompt: str, max_new_tokens: int) -> list[int]:
@@ -113,5 +117,10 @@ class MockStepEngine:
                 self.release_request(seq_id, req)
             if req.notify is not None:
                 req.notify(req)
-        self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(
-            time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(dt)
+        if self.flightrec.enabled:
+            self.flightrec.record(
+                sum(1 for r in reqs.values() if not r.done), 0, 0, 0, 0, 0,
+                self.tokens_per_step, dt,
+                time.monotonic() - self.heartbeat, tuple(reqs))

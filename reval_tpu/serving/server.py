@@ -15,6 +15,10 @@ reference inference.py:110-131, start_server.sh):
   prometheus_client dependency; the renderer is obs/metrics.py.
 - ``GET /statusz``             → the JSON twin: the same merged metrics
   as a snapshot dict plus model id and readiness detail.
+- ``GET /debugz``              → the live postmortem bundle (flight
+  records, in-flight request table, span tail, recent structured-log
+  events) — what a crash dump would contain right now, without writing
+  one.  ``reval_tpu watch`` polls this plus ``/statusz``.
 
 Request ids: every request gets one — the client's ``X-Request-Id``
 header when sent (sanitised), a minted one otherwise — and EVERY
@@ -60,21 +64,21 @@ Implementation notes:
 from __future__ import annotations
 
 import json
-import logging
 import math
 import re
 import threading
 import time
+import traceback
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import metrics as obs_metrics
+from ..obs.flightrec import PostmortemWriter, build_bundle
+from ..obs.logging import log_event
 from ..obs.metrics import MetricsRegistry
 from .errors import ServingError
 
 __all__ = ["EngineServer", "serve_config"]
-
-log = logging.getLogger(__name__)
 
 MAX_BODY_BYTES = 64 << 20   # request-body cap: a garbage multi-GB POST
                             # must die at the socket, not in the tokenizer.
@@ -193,7 +197,8 @@ class EngineServer:
                  ready_fn=None, max_tokens_cap: int | None = None,
                  max_body_bytes: int = MAX_BODY_BYTES,
                  drain_timeout_s: float = 120.0,
-                 stats_fn=None, tracer=None, trace_out: str | None = None):
+                 stats_fn=None, tracer=None, trace_out: str | None = None,
+                 postmortem_dir: str | None = None):
         # loopback by default: the endpoint is unauthenticated, and the
         # in-repo client only ever connects to localhost; pass host="0.0.0.0"
         # deliberately to expose it
@@ -222,6 +227,11 @@ class EngineServer:
         self._obs = MetricsRegistry()
         self.tracer = tracer
         self.trace_out = trace_out
+        #: lazy fallback writer for dump_postmortem on session-less
+        #: servers (sessions bring their own, with its retention window);
+        #: honors the same configured directory either way
+        self._postmortem_dir = postmortem_dir
+        self._postmortem_writer: PostmortemWriter | None = None
         self.max_tokens_cap = max_tokens_cap
         self.max_body_bytes = int(max_body_bytes)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -317,6 +327,12 @@ class EngineServer:
                         request_id=rid)
                 elif path in ("/statusz", "/v1/statusz"):
                     self._send(200, outer.statusz(), request_id=rid)
+                elif path in ("/debugz", "/v1/debugz"):
+                    # the postmortem bundle, live: what a crash dump
+                    # would contain RIGHT NOW (flight records, in-flight
+                    # request table, spans, recent logs) — nothing is
+                    # written; scrape-safe under concurrency
+                    self._send(200, outer.debug_bundle(), request_id=rid)
                 else:
                     self._send(404, _err("not_found",
                                          f"unknown route {self.path}"),
@@ -333,9 +349,10 @@ class EngineServer:
                 with outer._track():
                     try:
                         self._handle_post(rid)
-                    except Exception:  # noqa: BLE001
-                        log.exception("request %s: unhandled handler error",
-                                      rid)
+                    except Exception as exc:  # noqa: BLE001
+                        log_event("server.request_error", level="error",
+                                  request_id=rid, exc=exc, where="handler",
+                                  trace=traceback.format_exc())
                         self._send(500, _err(
                             "internal_error",
                             "internal error (see server log)", rid),
@@ -416,8 +433,10 @@ class EngineServer:
                     self._send(400, _err("invalid_request", str(exc), rid),
                                request_id=rid)
                     return
-                except Exception:       # engine/device fault → server error
-                    log.exception("request %s: generation failed", rid)
+                except Exception as exc:  # engine/device fault → server error
+                    log_event("server.request_error", level="error",
+                              request_id=rid, exc=exc, where="generate",
+                              trace=traceback.format_exc())
                     self._send(500, _err("internal_error",
                                          "internal error (see server log)",
                                          rid),
@@ -463,9 +482,10 @@ class EngineServer:
                             q.put((i, t, "stop"))
                     except ServingError as exc:
                         q.put(("error", _err(exc.code, str(exc), rid), None))
-                    except Exception:
-                        log.exception("request %s: streaming generation "
-                                      "failed", rid)
+                    except Exception as exc:
+                        log_event("server.request_error", level="error",
+                                  request_id=rid, exc=exc, where="stream",
+                                  trace=traceback.format_exc())
                         q.put(("error", _err("internal_error",
                                              "internal error (see server "
                                              "log)", rid), None))
@@ -572,6 +592,49 @@ class EngineServer:
                 out["readiness"] = {"ready": False, "error": "ready_fn failed"}
         return out
 
+    def debug_bundle(self) -> dict:
+        """The live postmortem bundle behind ``GET /debugz``: whatever a
+        crash dump would contain right now, for the attached session (or
+        a metrics-only bundle for session-less engines), plus the
+        server's own identity/drain state."""
+        session = getattr(self, "_session", None)
+        try:
+            if session is not None and hasattr(session, "postmortem_bundle"):
+                bundle = session.postmortem_bundle("debugz")
+            else:
+                # session-less engines (static/pp/sp): metrics + any
+                # flight records, no per-request lifecycle table
+                fr = getattr(getattr(self, "_engine", None),
+                             "flightrec", None)
+                bundle = build_bundle(
+                    "debugz", metrics=self.merged_registry().snapshot(),
+                    flight=fr.snapshot() if fr is not None else None)
+        except Exception as exc:    # a debug scrape must never 500
+            bundle = build_bundle("debugz", error=repr(exc))
+        bundle["model"] = self.model_id
+        bundle["draining"] = self._draining.is_set()
+        return bundle
+
+    def dump_postmortem(self, reason: str) -> str | None:
+        """Write the current bundle to disk (SIGUSR1 / SIGTERM-drain
+        triggers — the CLI wires the signals).  Uses the session's
+        writer (its retention window) when one is attached."""
+        session = getattr(self, "_session", None)
+        writer = getattr(session, "_postmortem", None)
+        if writer is None:
+            writer = self._postmortem_writer
+            if writer is None:
+                writer = self._postmortem_writer = PostmortemWriter(
+                    self._postmortem_dir)
+        bundle = self.debug_bundle()
+        bundle["reason"] = reason
+        try:
+            return writer.dump(bundle)
+        except Exception as exc:
+            log_event("session.postmortem", level="error", exc=exc,
+                      reason=reason)
+            return None
+
     def _track(self):
         import contextlib
 
@@ -636,10 +699,6 @@ class EngineServer:
                 self._inflight_cv.wait(
                     timeout=max(0.01, min(1.0, deadline - time.monotonic())))
             leftover = self._inflight_http
-        if leftover:
-            log.warning("shutdown: %d request(s) still in flight after "
-                        "%.0fs drain budget — proceeding", leftover,
-                        self.drain_timeout_s)
         with self._workers_lock:
             workers = list(self._workers)
         for worker in workers:
@@ -652,9 +711,11 @@ class EngineServer:
             # so its span tree is recorded — the file is complete
             try:
                 n = self.tracer.save(self.trace_out)
-                log.info("wrote %d trace events to %s", n, self.trace_out)
-            except OSError:
-                log.exception("failed to write trace file %s", self.trace_out)
+                log_event("server.trace_written", path=self.trace_out,
+                          events=n)
+            except OSError as exc:
+                log_event("server.trace_error", level="error",
+                          path=self.trace_out, exc=exc)
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -673,8 +734,9 @@ class EngineServer:
                 for key, value in stats.serving_counters().items():
                     counters[key] = round(counters.get(key, 0) + value, 3)
                 counters["prompts"] = counters.get("prompts", 0) + stats.prompts
-        log.info("EngineServer drained in %.3fs (lifecycle counters: %s)",
-                 drain, counters or "n/a")
+        log_event("server.drained", drain_seconds=round(drain, 3),
+                  leftover_requests=leftover, counters=counters or None,
+                  level="warning" if leftover else "info")
 
 
 def _engine_generate_fn(engine):
@@ -768,9 +830,11 @@ def serve_config(cfg: dict, *, port: int | None = None,
 
         tracer = Tracer()
     lifecycle = {"max_queued_tokens": cfg.get("max_queued_tokens"),
-                 "watchdog_s": cfg.get("watchdog_s"), "tracer": tracer}
+                 "watchdog_s": cfg.get("watchdog_s"), "tracer": tracer,
+                 "postmortem_dir": cfg.get("postmortem_dir")}
     body_cap = int(cfg.get("max_body_bytes", MAX_BODY_BYTES))
-    obs_kw = {"tracer": tracer, "trace_out": trace_out}
+    obs_kw = {"tracer": tracer, "trace_out": trace_out,
+              "postmortem_dir": cfg.get("postmortem_dir")}
     if cfg.get("mock"):
         from .mock_engine import MockStepEngine
 
@@ -795,6 +859,7 @@ def serve_config(cfg: dict, *, port: int | None = None,
                             if k not in ("task", "backend", "port", "mock",
                                          "max_queued_tokens", "watchdog_s",
                                          "max_body_bytes", "trace_out",
+                                         "postmortem_dir",
                                          "mock_response", "mock_step_s")})
     if warmup:
         secs = warmup_engine(backend.engine)
@@ -826,9 +891,11 @@ def serve_config(cfg: dict, *, port: int | None = None,
     # session-less engines (static/pp/sp) still expose /metrics: no
     # per-request spans (the session records those), but every engine
     # counter and engine-side histogram is there
-    return EngineServer(_engine_generate_fn(backend.engine),
-                        model_id=model_id, port=bind,
-                        max_body_bytes=body_cap,
-                        max_tokens_cap=_max_tokens_cap(backend.engine),
-                        stats_fn=lambda eng=backend.engine: [eng.stats],
-                        **obs_kw)
+    server = EngineServer(_engine_generate_fn(backend.engine),
+                          model_id=model_id, port=bind,
+                          max_body_bytes=body_cap,
+                          max_tokens_cap=_max_tokens_cap(backend.engine),
+                          stats_fn=lambda eng=backend.engine: [eng.stats],
+                          **obs_kw)
+    server._engine = backend.engine     # /debugz: flight records, no session
+    return server

@@ -59,22 +59,31 @@ Lifecycle hardening (on top of the batching):
   :class:`~reval_tpu.resilience.EngineStepChaos`) injects a stalled step
   or mid-batch exception between decode steps, so every path above is
   testable in the fast tier without a TPU.
+- **Postmortems.** Watchdog trips, driver faults, and deadline storms
+  dump a crash bundle (flight-record runway, metrics snapshot, in-flight
+  request table, span tail, recent logs — obs/flightrec.py) to
+  ``postmortem_dir``; ``GET /debugz`` serves the same document live.
 """
 
 from __future__ import annotations
 
-import logging
 import os
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs.flightrec import PostmortemWriter, build_bundle
+from ..obs.logging import log_event
 from .errors import DeadlineExceeded, Draining, EngineWedged, Overloaded, ServingError
 
 __all__ = ["ContinuousSession", "MultiSession"]
 
-log = logging.getLogger(__name__)
+#: deadline expiries in ONE driver sweep that count as a "storm" and
+#: trigger a postmortem bundle (env ``REVAL_TPU_DEADLINE_STORM``) — one
+#: slow request missing its budget is business as usual; a whole batch
+#: expiring together means the engine, not the request, is the story
+DEADLINE_STORM_N = int(os.environ.get("REVAL_TPU_DEADLINE_STORM", "3"))
 
 
 class _Pending:
@@ -190,8 +199,17 @@ class ContinuousSession:
     def __init__(self, engine, autostart: bool = True, *,
                  max_queued_tokens: int | None = None,
                  watchdog_s: float | None = None, step_chaos=None,
-                 tracer=None):
+                 tracer=None, postmortem_dir: str | None = None):
         self.engine = engine
+        #: crash-dump sink: watchdog trips, driver faults, and deadline
+        #: storms dump a bundle here (obs/flightrec.py; default
+        #: REVAL_TPU_POSTMORTEM_DIR or tpu_watch/)
+        self._postmortem = PostmortemWriter(postmortem_dir)
+        #: the driver's live request/origin tables, published by _run so
+        #: a postmortem (or /debugz) can read the in-flight lifecycle
+        #: stamps — read-only, racy by design (diagnostics, not control)
+        self._driver_reqs: dict = {}
+        self._driver_origin: dict = {}
         #: optional :class:`~reval_tpu.obs.trace.Tracer` — one span tree
         #: per (request id, prompt) at completion; None = zero cost
         self._tracer = tracer
@@ -346,6 +364,87 @@ class ContinuousSession:
     def engine_stats(self) -> list:
         return [self.engine.stats]
 
+    # -- postmortems -------------------------------------------------------
+    def postmortem_bundle(self, reason: str, error: str | None = None,
+                          *, envelope: bool = True) -> dict:
+        """One self-contained crash-dump document: the flight-record
+        runway, the metrics snapshot, readiness, the in-flight request
+        table with lifecycle stamps, and the span-tree tail.  Served
+        live by ``GET /debugz`` and written to disk on watchdog trips,
+        driver faults, deadline storms, SIGUSR1, and SIGTERM drains.
+
+        Reads racy driver state by design (diagnostics, not control);
+        every section is assembled defensively so a bundle can always be
+        produced, even mid-fault."""
+        eng = self.engine
+        sections: dict = {"error": error}
+        now = time.perf_counter()
+        mono = time.monotonic()
+        try:
+            fr = getattr(eng, "flightrec", None)
+            if fr is not None:
+                sections["flight"] = fr.snapshot()
+                sections["flight_dropped"] = max(0, fr.total - fr.capacity)
+        except Exception:
+            sections["flight"] = None
+        try:
+            sections["metrics"] = eng.stats.registry.snapshot()
+        except Exception:
+            sections["metrics"] = None
+        try:
+            sections["readiness"] = self.readiness()
+        except Exception:
+            sections["readiness"] = None
+        try:
+            with self._acct_lock:
+                inflight = list(self._inflight)
+            sections["inflight"] = [
+                {"request_id": sub.request_id, "prompts": len(sub.prompts),
+                 "tokens": sub.tokens,
+                 "age_s": round(now - sub.t_submit, 3),
+                 "deadline_in_s": (round(sub.deadline - mono, 3)
+                                   if sub.deadline is not None else None),
+                 "resolved": sub.pending.done()}
+                for sub in inflight]
+        except Exception:
+            sections["inflight"] = None
+        try:
+            origin = dict(self._driver_origin)
+            rows = []
+            for seq_id, req in list(self._driver_reqs.items()):
+                sub = origin.get(seq_id)
+                rows.append(
+                    {"seq_id": seq_id, "index": req.index,
+                     "request_id": sub[0].request_id if sub else None,
+                     "prompt_tokens": len(req.ids),
+                     "generated_tokens": len(req.generated),
+                     "done": req.done,
+                     "t_submit": req.t_submit, "t_admit": req.t_admit,
+                     "t_first": req.t_first, "t_done": req.t_done,
+                     "age_s": round(now - req.t_submit, 3)})
+            sections["requests"] = rows
+        except Exception:
+            sections["requests"] = None
+        try:
+            if self._tracer is not None:
+                events = self._tracer.events()
+                sections["spans"] = {"events": events[-256:],
+                                     "total": len(events),
+                                     "dropped": self._tracer.dropped}
+        except Exception:
+            sections["spans"] = None
+        return build_bundle(reason, envelope=envelope, **sections)
+
+    def _dump_postmortem(self, bundle: dict) -> str | None:
+        """Write a prebuilt bundle; diagnostics never raise into the
+        serving path."""
+        try:
+            return self._postmortem.dump(bundle)
+        except Exception as exc:   # never let a dump take serving down
+            log_event("session.postmortem", level="error", exc=exc,
+                      reason=bundle.get("reason"))
+            return None
+
     # -- watchdog ----------------------------------------------------------
     def _watch(self) -> None:
         interval = max(0.02, min(1.0, (self.watchdog_s or 1.0) / 4))
@@ -369,14 +468,19 @@ class ContinuousSession:
             self._wedged.set()
             pending = list(self._inflight)
         self.engine.stats.watchdog_trips += 1
-        log.error("ContinuousSession %#x: engine made no progress for "
-                  ">%.1fs — watchdog tripped, failing %d pending "
-                  "submission(s)", id(self), self.watchdog_s, len(pending))
+        log_event("session.watchdog_trip", level="error",
+                  watchdog_s=self.watchdog_s, pending=len(pending),
+                  session=f"{id(self):#x}")
         exc = EngineWedged(
             f"engine made no progress for >{self.watchdog_s:.1f}s "
             f"(watchdog tripped)")
+        # the whole point of the flight recorder: the trip ships the
+        # runway that led to it — snapshot BEFORE failing the handles
+        # (resolution empties the in-flight table the bundle records)
+        bundle = self.postmortem_bundle("watchdog_trip", error=str(exc))
         for sub in pending:
             self._resolve_error(sub, exc)
+        self._dump_postmortem(bundle)
 
     # -- driver side -------------------------------------------------------
     def start(self) -> "ContinuousSession":
@@ -408,13 +512,11 @@ class ContinuousSession:
                 # driver is live.  No raise: close() runs from __exit__
                 # and MultiSession.close(), where an exception would mask
                 # in-flight errors or strand sibling replicas un-closed.
-                # logging, not warnings.warn: the default warning filter
-                # dedups per call site, which would hide a second wedged
-                # replica in the same process.
-                logging.getLogger(__name__).warning(
-                    "ContinuousSession %#x driver did not exit within "
-                    "120s; engine is still owned by the driver thread "
-                    "(call close() again to re-join)", id(self))
+                # structured event, not warnings.warn: the default warning
+                # filter dedups per call site, which would hide a second
+                # wedged replica in the same process.
+                log_event("session.drain_stuck", level="warning",
+                          timeout_s=120, session=f"{id(self):#x}")
                 joined = False
             else:
                 self._thread = None
@@ -434,6 +536,9 @@ class ContinuousSession:
         reqs: dict[int, object] = {}
         # seq_id -> (submission, position of this prompt in it)
         origin: dict[int, tuple[_Submission, int]] = {}
+        # publish the live tables for postmortem/debugz snapshots
+        self._driver_reqs = reqs
+        self._driver_origin = origin
         st = eng.new_drive_state()
 
         def drain(block: bool) -> None:
@@ -501,13 +606,21 @@ class ContinuousSession:
                         self._fail(origin[head][0], exc, reqs, origin, st)
                         st.dirty = True
                         continue
+                log_event("session.driver_error", level="error", exc=exc)
+                bundle = self.postmortem_bundle("driver_exception",
+                                                error=repr(exc))
                 self._fail(None, exc, reqs, origin)
+                self._dump_postmortem(bundle)
                 st = eng.new_drive_state()
                 continue
             except Exception as exc:
                 # device fault (or injected engine-step chaos): fail every
                 # in-flight submission, release their sequences, start clean
+                log_event("session.driver_error", level="error", exc=exc)
+                bundle = self.postmortem_bundle("driver_exception",
+                                                error=repr(exc))
                 self._fail(None, exc, reqs, origin)
+                self._dump_postmortem(bundle)
                 st = eng.new_drive_state()
                 continue
             for seq_id in [s for s, r in reqs.items() if r.done]:
@@ -543,6 +656,13 @@ class ContinuousSession:
                    if sub.deadline is not None and now >= sub.deadline}
         if not expired:
             return
+        # a storm (a whole batch expiring in one sweep) means the engine
+        # is the story, not the requests: ship the runway before the
+        # cancellations rewrite the in-flight table
+        storm = (self.postmortem_bundle(
+                     "deadline_storm", error=f"{len(expired)} submissions "
+                     f"expired in one sweep")
+                 if len(expired) >= DEADLINE_STORM_N else None)
         # land any in-flight pipelined chunk's writes BEFORE releasing
         # pages it may still target
         flush = getattr(self.engine, "_process_pending", None)
@@ -550,9 +670,15 @@ class ContinuousSession:
             flush(reqs, st)
         for sub in expired:
             self.engine.stats.deadline_expired += 1
+            log_event("session.deadline_expired", level="warning",
+                      request_id=sub.request_id, prompts=len(sub.prompts))
             self._fail(sub, DeadlineExceeded(
                 "request deadline exceeded before generation finished"),
                 reqs, origin, st)
+        if storm is not None:
+            log_event("session.deadline_storm", level="error",
+                      expired=len(expired), threshold=DEADLINE_STORM_N)
+            self._dump_postmortem(storm)
 
     @staticmethod
     def _resolve_error(sub: _Submission, exc: BaseException) -> None:
@@ -651,15 +777,21 @@ class MultiSession:
     def __init__(self, engines, autostart: bool = True, *,
                  max_queued_tokens: int | None = None,
                  watchdog_s: float | None = None, step_chaos=None,
-                 tracer=None):
+                 tracer=None, postmortem_dir: str | None = None):
         # one shared tracer: replica placement is an `args` detail, the
         # span tree is per request id either way
         self.sessions = [ContinuousSession(e, autostart=autostart,
                                            max_queued_tokens=max_queued_tokens,
                                            watchdog_s=watchdog_s,
                                            step_chaos=step_chaos,
-                                           tracer=tracer)
+                                           tracer=tracer,
+                                           postmortem_dir=postmortem_dir)
                          for e in engines]
+        #: the server's SIGUSR1/SIGTERM dumps use this writer, so a dp
+        #: set honors the configured directory exactly like a single
+        #: session (replica-level trips use each session's own writer —
+        #: same directory, separate per-reason rate windows)
+        self._postmortem = PostmortemWriter(postmortem_dir)
         self._load = [0] * len(self.sessions)
         self._lock = threading.Lock()
 
@@ -721,6 +853,15 @@ class MultiSession:
 
     def engine_stats(self) -> list:
         return [s.engine.stats for s in self.sessions]
+
+    def postmortem_bundle(self, reason: str, error: str | None = None) -> dict:
+        """One bundle per replica under ONE shared envelope (``/debugz``
+        and SIGUSR1 for a dp replica set): the fingerprint and log ring
+        are process-global, so only the outer bundle carries them."""
+        return build_bundle(
+            reason, error=error,
+            replicas=[s.postmortem_bundle(reason, envelope=False)
+                      for s in self.sessions])
 
     def close(self) -> None:
         for s in self.sessions:

@@ -1,0 +1,193 @@
+"""`reval_tpu watch`: a live one-screen console over a serving endpoint.
+
+The Python sibling of ``tools/tpu_watch.sh`` (which babysits the *chip*
+through a flaky tunnel): this one babysits the *server*.  It polls
+``GET /statusz`` (merged metrics + readiness) and ``GET /debugz`` (the
+live postmortem bundle: flight-record tail, in-flight request table,
+recent structured-log events) and renders one refreshing screen:
+
+    throughput (req/s, tok/s from counter deltas) · queue depth ·
+    page pool (free/cached/pinned from the newest flight record) ·
+    latency percentiles (ttft/e2e/queue-wait, THE shared estimator) ·
+    lifecycle counters · last faults (error/warning log events)
+
+Read-only: two GETs per refresh, no state server-side.  A refresh
+against a down/unready server renders a waiting banner and keeps
+polling — the console is exactly for watching a server come up, drain,
+or die.
+
+Usage::
+
+    python -m reval_tpu watch [--host H] [--port P] [--interval S]
+                              [--iterations N] [--no-clear]
+
+``--iterations`` bounds the refresh count (smoke tests; default:
+forever, Ctrl-C exits cleanly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import urllib.error
+import urllib.request
+
+from .obs import metrics as obs_metrics
+from .obs.metrics import snapshot_percentile
+
+__all__ = ["run_watch", "render_screen"]
+
+CLEAR = "\x1b[H\x1b[2J"
+
+#: (label, histogram metric) rows of the latency block
+_LATENCY_ROWS = (("queue_wait", obs_metrics.QUEUE_WAIT),
+                 ("ttft", obs_metrics.TTFT),
+                 ("tpot", obs_metrics.TPOT),
+                 ("e2e", obs_metrics.E2E))
+
+#: counters whose per-interval RATE headlines the screen
+_RATE_ROWS = (("req/s", obs_metrics.REQUESTS),
+              ("gen tok/s", "reval_engine_generated_tokens_total"),
+              ("prefill tok/s", "reval_engine_prefill_tokens_total"))
+
+_SERVING_COUNTERS = ("reval_serving_sheds_total",
+                     "reval_serving_deadline_expired_total",
+                     "reval_serving_watchdog_trips_total",
+                     "reval_http_requests_total")
+
+
+def _fetch_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.3f}s" if v >= 1.0 else f"{v * 1e3:.1f}ms"
+
+
+def _rates(counters: dict, prev: dict | None, dt: float) -> list[str]:
+    out = []
+    for label, name in _RATE_ROWS:
+        cur = counters.get(name, 0)
+        if prev is None or dt <= 0:
+            out.append(f"{label} —")
+        else:
+            out.append(f"{label} {max(0.0, (cur - prev.get(name, 0)) / dt):.1f}")
+    return out
+
+
+def render_screen(status: dict, debug: dict, prev_counters: dict | None,
+                  dt: float, target: str) -> str:
+    """One screenful from a /statusz body + a /debugz bundle."""
+    metrics = status.get("metrics", {})
+    counters = metrics.get("counters", {})
+    gauges = metrics.get("gauges", {})
+    hists = metrics.get("histograms", {})
+    readiness = status.get("readiness", {}) or {}
+    lines = []
+    state = ("DRAINING" if status.get("draining")
+             else "READY" if readiness.get("ready") else "UNREADY")
+    lines.append(f"== reval_tpu watch · {target} · "
+                 f"{status.get('model', '?')} · {state} · "
+                 f"{time.strftime('%H:%M:%S')} ==")
+    lines.append("throughput   " + "  ".join(_rates(counters, prev_counters,
+                                                    dt)))
+    lines.append(f"totals       requests {counters.get(obs_metrics.REQUESTS, 0)}"
+                 f"  prompts {counters.get('reval_engine_prompts_total', 0)}"
+                 f"  gen tokens "
+                 f"{counters.get('reval_engine_generated_tokens_total', 0)}")
+
+    # queue / pool: the session gauge plus the newest flight record's view
+    flight = debug.get("flight") or []
+    for replica in debug.get("replicas", ()):   # dp: first replica's tail
+        flight = replica.get("flight") or flight
+        break
+    last = flight[-1] if flight else {}
+    lines.append(
+        f"queue        queued_tokens {int(gauges.get(obs_metrics.QUEUED_TOKENS, 0))}"
+        f"  inflight {len(debug.get('inflight') or [])}"
+        f"  running {last.get('running', '—')}"
+        f"  waiting {last.get('queued', '—')}")
+    lines.append(
+        f"page pool    free {int(gauges.get(obs_metrics.FREE_PAGES, 0))}"
+        f"  cached {last.get('cached_pages', '—')}"
+        f"  pinned {last.get('pinned_pages', '—')}"
+        f"  step {last.get('step', '—')}"
+        + (f"  step_ms {last.get('step_ms'):.2f}"
+           if isinstance(last.get("step_ms"), (int, float)) else ""))
+
+    rows = []
+    for label, name in _LATENCY_ROWS:
+        h = hists.get(name)
+        if h and h.get("count"):
+            rows.append(f"{label} p50 {_fmt_s(snapshot_percentile(h, .50))}"
+                        f"/p95 {_fmt_s(snapshot_percentile(h, .95))}"
+                        f"/p99 {_fmt_s(snapshot_percentile(h, .99))}")
+    lines.append("latency      " + ("  ".join(rows) if rows
+                                    else "(no requests observed)"))
+    lifecycle = "  ".join(
+        f"{name.split('_', 2)[-1].rsplit('_total', 1)[0]} "
+        f"{counters.get(name, 0)}" for name in _SERVING_COUNTERS)
+    hb = readiness.get("heartbeat_age_s")
+    lines.append("lifecycle    " + lifecycle
+                 + (f"  hb_age {hb}s" if hb is not None else ""))
+
+    faults = [e for e in (debug.get("recent_logs") or ())
+              if e.get("level") in ("error", "warning")][-4:]
+    lines.append("last faults" + ("  (none)" if not faults else ""))
+    for e in faults:
+        extra = e.get("error") or ""
+        lines.append(f"  {e.get('ts', '')} [{e.get('level')}] "
+                     f"{e.get('event')} {extra}"[:100])
+    return "\n".join(lines) + "\n"
+
+
+def run_watch(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="reval_tpu watch",
+        description="Live console over a serving endpoint "
+                    "(/statusz + /debugz)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=3000)
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh period, seconds (default 2)")
+    parser.add_argument("--iterations", type=int, default=None,
+                        help="stop after N refreshes (default: forever)")
+    parser.add_argument("--no-clear", action="store_true",
+                        help="append screens instead of clearing (pipes, "
+                             "logs, tests)")
+    args = parser.parse_args(argv)
+    base = f"http://{args.host}:{args.port}"
+    target = f"{args.host}:{args.port}"
+    prev_counters: dict | None = None
+    prev_t = time.monotonic()
+    n = 0
+    try:
+        while args.iterations is None or n < args.iterations:
+            if n:
+                time.sleep(args.interval)
+            n += 1
+            try:
+                status = _fetch_json(f"{base}/statusz")
+                debug = _fetch_json(f"{base}/debugz")
+            except (urllib.error.URLError, TimeoutError, ConnectionError,
+                    json.JSONDecodeError, OSError) as exc:
+                if not args.no_clear:
+                    print(CLEAR, end="")
+                print(f"== reval_tpu watch · {target} · UNREACHABLE · "
+                      f"{time.strftime('%H:%M:%S')} ==\n  {exc!r}\n"
+                      f"  (retrying every {args.interval:g}s)")
+                continue
+            now = time.monotonic()
+            screen = render_screen(status, debug, prev_counters,
+                                   now - prev_t, target)
+            prev_counters = dict(
+                status.get("metrics", {}).get("counters", {}))
+            prev_t = now
+            if not args.no_clear:
+                print(CLEAR, end="")
+            print(screen, end="", flush=True)
+    except KeyboardInterrupt:
+        pass
+    return 0

@@ -24,3 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 
 # Repo root on sys.path so `import reval_tpu` works without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Crash-dump bundles default to ./tpu_watch — tests that trip watchdogs or
+# inject faults would litter the repo's scratch dir; send them to a tmp dir
+# instead (tests asserting on bundles pass an explicit postmortem_dir,
+# which wins over this env default).
+if "REVAL_TPU_POSTMORTEM_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["REVAL_TPU_POSTMORTEM_DIR"] = tempfile.mkdtemp(
+        prefix="reval-test-postmortems-")

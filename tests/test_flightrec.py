@@ -1,0 +1,560 @@
+"""Flight recorder, structured logs, postmortem bundles, watch console
+(fast tier — host-only, no jit, no TPU).
+
+Covers the ISSUE-5 contracts: the bounded per-step ring (wrap, ordering,
+O(µs) record cost, the REVAL_TPU_FLIGHTREC=0 A/B), the structured-log
+event layer (declared namespace, bounded ring, JSON-line emission),
+postmortem production on every trigger (watchdog trip, driver fault,
+deadline storm, SIGUSR1-style on-demand), bundle completeness (flight
+runway covering the stalled step, in-flight request table, readiness),
+`tools/postmortem_report.py` rendering, `GET /debugz` under concurrent
+scrape, writer retention/rate-limit/atomicity, and the `reval_tpu watch`
+console against a live mock server.
+"""
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from reval_tpu.obs.flightrec import (
+    FIELDS,
+    FlightRecorder,
+    PostmortemWriter,
+    build_bundle,
+)
+from reval_tpu.obs.logging import EVENTS, log_event, recent
+from reval_tpu.serving import ContinuousSession, EngineServer, MockStepEngine
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+RESPONSE = "mock_model_gen"
+
+
+def make_stack(tmp_path, *, step_s=0.0, tokens_per_step=16, watchdog_s=30.0,
+               step_chaos=None, response=RESPONSE):
+    eng = MockStepEngine(response=response, step_s=step_s,
+                         tokens_per_step=tokens_per_step)
+    session = ContinuousSession(eng, watchdog_s=watchdog_s,
+                                step_chaos=step_chaos,
+                                postmortem_dir=str(tmp_path))
+    srv = EngineServer(session.generate_fn(), model_id="flightrec-mock",
+                       port=0, serialize=False, max_tokens_cap=8000)
+    srv.attach_session(session)
+    return eng, session, srv.start()
+
+
+def bundles_in(tmp_path) -> list[str]:
+    return sorted(glob.glob(os.path.join(str(tmp_path),
+                                         "postmortem-*.json")))
+
+
+def wait_for_bundles(tmp_path, n=1, timeout=5.0) -> list[str]:
+    """The dump runs on the tripping thread AFTER handles resolve —
+    callers that woke on result() must wait for the file."""
+    deadline = time.monotonic() + timeout
+    while len(bundles_in(tmp_path)) < n and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return bundles_in(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# the ring itself
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_wraps_and_keeps_newest(self):
+        fr = FlightRecorder(capacity=8, enabled=True)
+        for i in range(20):
+            fr.record(i, 0, 100 - i, 0, 0, 0, 32, 0.001, 0.0, (i,))
+        assert fr.total == 20
+        recs = fr.records()
+        assert len(recs) == 8
+        assert [r[0] for r in recs] == list(range(12, 20))  # newest 8, ordered
+        snap = fr.snapshot(last=3)
+        assert [s["step"] for s in snap] == [17, 18, 19]
+        assert set(snap[0]) == set(FIELDS)
+        assert snap[-1]["running"] == 19
+        assert snap[-1]["seq_ids"] == [19]
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("REVAL_TPU_FLIGHTREC", "0")
+        fr = FlightRecorder(capacity=8)
+        assert fr.enabled is False
+        fr.record(1, 0, 0, 0, 0, 0, 0, 0.0, 0.0, ())
+        assert fr.total == 0 and fr.records() == []
+
+    def test_record_cost_stays_sub_20us(self):
+        """The <2% hot-path bar (PERF.md) rests on a record being one
+        tuple store; a generous ceiling catches an accidental O(n) or
+        formatting regression without flaking on slow CI."""
+        fr = FlightRecorder()
+        n = 20_000
+        ids = (1, 2, 3, 4)
+        t0 = time.perf_counter()
+        for i in range(n):
+            fr.record(4, 2, 100, 8, 4, 1024, 32, 0.001, 0.0005, ids)
+        per = (time.perf_counter() - t0) / n
+        assert per < 20e-6, f"record() cost {per * 1e6:.2f}µs"
+        assert fr.total == n
+
+    def test_partial_snapshot_before_wrap(self):
+        fr = FlightRecorder(capacity=16, enabled=True)
+        fr.record(1, 0, 0, 0, 0, 0, 0, 0.002, 0.0, ())
+        snap = fr.snapshot()
+        assert len(snap) == 1 and snap[0]["step"] == 0
+        assert snap[0]["step_ms"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# structured logging
+# ---------------------------------------------------------------------------
+
+class TestStructuredLog:
+    def test_event_record_shape_and_ring(self):
+        rec = log_event("session.postmortem", request_id="req-1",
+                        path="/tmp/x.json", reason="test")
+        assert rec["component"] == "session"
+        assert rec["event"] == "session.postmortem"
+        assert rec["request_id"] == "req-1"
+        assert rec["fields"] == {"path": "/tmp/x.json", "reason": "test"}
+        assert recent(1)[-1] == rec
+        # the line is one JSON object
+        assert json.loads(json.dumps(rec, default=str))["event"] \
+            == "session.postmortem"
+
+    def test_unknown_event_never_raises(self):
+        # a typo in an except block must not mask the real error — the
+        # static lint (tools/check_metrics.py) is the enforcement
+        rec = log_event("engine.deadlock", level="error")
+        assert rec["level"] == "error"
+
+    def test_min_level_filter_and_bound(self):
+        log_event("client.wait", level="debug", target="t", timeout_s=1)
+        log_event("session.driver_error", level="error")
+        errs = recent(min_level="error")
+        assert errs and all(e["level"] == "error" for e in errs)
+
+    def test_every_declared_event_has_component_prefix(self):
+        for name in EVENTS:
+            comp, _, rest = name.partition(".")
+            assert comp and rest, name
+
+
+# ---------------------------------------------------------------------------
+# postmortem triggers through the real session/server stack
+# ---------------------------------------------------------------------------
+
+class _StallAt:
+    """step_chaos stand-in: a deterministic stall at one exact session
+    step (EngineStepChaos's schedule is seeded-random; the acceptance
+    test wants runway BEFORE the stall)."""
+
+    def __init__(self, at: int, stall_s: float):
+        self.at, self.stall_s, self.n = at, stall_s, 0
+        self.injected = []
+
+    def tick(self) -> None:
+        self.n += 1
+        if self.n == self.at:
+            self.injected.append(("stall", self.n))
+            time.sleep(self.stall_s)
+
+
+def test_watchdog_trip_dumps_bundle_covering_the_stall(tmp_path):
+    """THE acceptance path: a stalled step trips the watchdog, the
+    postmortem bundle's flight records cover the runway into the stall
+    (the stalled request rides the newest record), and
+    tools/postmortem_report.py renders it without error."""
+    from reval_tpu.serving import EngineWedged
+
+    chaos = _StallAt(at=12, stall_s=2.0)
+    # construct with a generous watchdog (the driver's FIRST enqueue
+    # lazily imports the paged engine — jax — which a 0.2s watchdog
+    # would misread as a wedge), warm up, then tighten it
+    eng, session, srv = make_stack(tmp_path, tokens_per_step=1,
+                                   watchdog_s=30.0, step_chaos=chaos)
+    try:
+        assert session.submit(["w"], max_new_tokens=2).result(timeout=10)
+        warm_ticks = eng.flightrec.total
+        assert 0 < warm_ticks < 12      # runway left before the stall
+        session.watchdog_s = 0.2
+        handle = session.submit(["x"], max_new_tokens=64)
+        with pytest.raises(EngineWedged):
+            handle.result(timeout=15)
+        assert eng.stats.watchdog_trips == 1
+        paths = wait_for_bundles(tmp_path)
+        assert len(paths) == 1
+        bundle = json.loads(open(paths[0]).read())
+        assert bundle["reason"] == "watchdog_trip"
+        assert "no progress" in bundle["error"]
+        # the runway covers every tick up to the one the engine stalled
+        # in: contiguous step ordinals ending at the recorder's head
+        flight = bundle["flight"]
+        assert len(flight) == eng.flightrec.total >= warm_ticks + 1
+        assert [r["step"] for r in flight] == list(range(len(flight)))
+        # the stalled request is ON the newest record and in the table
+        stalled = [r for r in bundle["requests"] if not r["done"]]
+        assert len(stalled) == 1
+        assert stalled[0]["seq_id"] in flight[-1]["seq_ids"]
+        assert stalled[0]["generated_tokens"] >= 1   # mid-decode
+        # the in-flight submission table names the stranded handle
+        assert len(bundle["inflight"]) == 1
+        assert bundle["readiness"]["wedged"] is True
+        assert bundle["metrics"]["counters"][
+            "reval_serving_watchdog_trips_total"] == 1
+        assert any(e["event"] == "session.watchdog_trip"
+                   for e in bundle["recent_logs"])
+        assert bundle["fingerprint"]["pid"] == os.getpid()
+    finally:
+        srv.shutdown()
+
+    # render the human timeline — must exit 0 and show the story
+    sys.path.insert(0, TOOLS)
+    try:
+        import postmortem_report
+        assert postmortem_report.main([paths[0]]) == 0
+        text = postmortem_report.render(bundle)
+    finally:
+        sys.path.remove(TOOLS)
+    assert "watchdog_trip" in text
+    assert "flight records" in text
+    assert "step" in text and "hb_ms" in text
+    assert "in-flight submissions: 1" in text
+
+
+def test_driver_exception_dumps_bundle(tmp_path):
+    from reval_tpu.resilience import EngineStepChaos
+
+    chaos = EngineStepChaos(rate=1.0, modes=("error",), max_faults=1)
+    eng, session, srv = make_stack(tmp_path, step_chaos=chaos)
+    try:
+        with pytest.raises(RuntimeError):
+            session.submit(["x"], max_new_tokens=8).result(timeout=10)
+        paths = wait_for_bundles(tmp_path)
+        assert len(paths) == 1
+        bundle = json.loads(open(paths[0]).read())
+        assert bundle["reason"] == "driver_exception"
+        assert "chaos" in bundle["error"]
+        # the driver recovers: the next request serves normally
+        out = session.submit(["y"], max_new_tokens=32).result(timeout=10)
+        assert out == [RESPONSE]
+    finally:
+        srv.shutdown()
+
+
+def test_deadline_storm_dumps_bundle_lone_expiry_does_not(tmp_path):
+    from reval_tpu.serving import DeadlineExceeded
+
+    eng, session, srv = make_stack(tmp_path, step_s=0.05, tokens_per_step=1)
+    try:
+        # one expiry: routine, no bundle
+        h = session.submit(["a"], max_new_tokens=64, deadline_s=0.01)
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=10)
+        deadline = time.monotonic() + 5
+        while session._driver_reqs and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert bundles_in(tmp_path) == []
+        # a storm (>= DEADLINE_STORM_N in one sweep): bundle
+        handles = [session.submit([f"p{i}"], max_new_tokens=64,
+                                  deadline_s=0.01) for i in range(4)]
+        for h in handles:
+            with pytest.raises(DeadlineExceeded):
+                h.result(timeout=10)
+        deadline = time.monotonic() + 5
+        while not bundles_in(tmp_path) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        paths = bundles_in(tmp_path)
+        assert len(paths) == 1
+        bundle = json.loads(open(paths[0]).read())
+        assert bundle["reason"] == "deadline_storm"
+        assert eng.stats.deadline_expired == 5
+    finally:
+        srv.shutdown()
+
+
+def test_on_demand_dump_and_debugz_route(tmp_path):
+    """server.dump_postmortem (the SIGUSR1/SIGTERM hook) writes a live
+    bundle; /debugz serves the same document without writing."""
+    eng, session, srv = make_stack(tmp_path)
+    try:
+        session.submit(["x"], max_new_tokens=32).result(timeout=10)
+        path = srv.dump_postmortem("sigusr1")
+        assert path is not None and os.path.exists(path)
+        bundle = json.loads(open(path).read())
+        assert bundle["reason"] == "sigusr1"
+        assert bundle["model"] == "flightrec-mock"
+        assert bundle["flight"], "served requests must leave flight records"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debugz", timeout=10) as r:
+            live = json.loads(r.read())
+        assert live["reason"] == "debugz"
+        assert live["readiness"]["ready"] is True
+        assert live["flight"][-1]["step"] == bundle["flight"][-1]["step"]
+        assert bundles_in(tmp_path) == [path]   # /debugz wrote nothing new
+    finally:
+        srv.shutdown()
+
+
+def test_debugz_wellformed_under_concurrent_scrape(tmp_path):
+    eng, session, srv = make_stack(tmp_path, step_s=0.002, tokens_per_step=2)
+    bad: list[str] = []
+    stop = threading.Event()
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/debugz",
+                        timeout=10) as r:
+                    bundle = json.loads(r.read())
+                if bundle.get("reason") != "debugz":
+                    bad.append("wrong reason")
+            except Exception as exc:  # noqa: BLE001
+                bad.append(repr(exc))
+
+    def post(i):
+        try:
+            session.submit([f"p{i}"], max_new_tokens=48).result(timeout=30)
+        except Exception as exc:  # noqa: BLE001
+            bad.append(f"post {i}: {exc!r}")
+
+    scrapers = [threading.Thread(target=scrape, daemon=True)
+                for _ in range(4)]
+    posts = [threading.Thread(target=post, args=(i,)) for i in range(8)]
+    try:
+        for t in scrapers + posts:
+            t.start()
+        for t in posts:
+            t.join(timeout=60)
+    finally:
+        stop.set()
+        for t in scrapers:
+            t.join(timeout=10)
+        srv.shutdown()
+    assert bad == []
+
+
+def test_multisession_bundle_has_one_section_per_replica(tmp_path):
+    from reval_tpu.serving import MultiSession
+
+    engines = [MockStepEngine(), MockStepEngine()]
+    ms = MultiSession(engines, postmortem_dir=str(tmp_path))
+    try:
+        ms.submit(["x"], max_new_tokens=16).result(timeout=10)
+        bundle = ms.postmortem_bundle("debugz")
+        assert len(bundle["replicas"]) == 2
+        assert all(rep["reason"] == "debugz" and "readiness" in rep
+                   for rep in bundle["replicas"])
+        # the process-global envelope (fingerprint, log ring) appears
+        # ONCE, on the outer bundle — not once per replica
+        assert "fingerprint" in bundle and "recent_logs" in bundle
+        assert all("fingerprint" not in rep and "recent_logs" not in rep
+                   for rep in bundle["replicas"])
+        json.dumps(bundle)      # wire-safe end to end
+        # server-level dumps (SIGUSR1/SIGTERM) honor the configured dir
+        from reval_tpu.serving import EngineServer
+
+        srv = EngineServer(ms.generate_fn(), model_id="dp", port=0,
+                           serialize=False, max_tokens_cap=8000)
+        srv.attach_session(ms)
+        path = srv.dump_postmortem("sigusr1")
+        assert path is not None and path.startswith(str(tmp_path))
+    finally:
+        ms.close()
+
+
+# ---------------------------------------------------------------------------
+# writer semantics
+# ---------------------------------------------------------------------------
+
+class TestPostmortemWriter:
+    def test_retention_prunes_oldest(self, tmp_path):
+        w = PostmortemWriter(str(tmp_path), keep=3, min_interval_s=0.0)
+        written = [w.dump(build_bundle(f"r{i}")) for i in range(6)]
+        assert all(written)
+        left = bundles_in(tmp_path)
+        assert len(left) == 3
+        reasons = [json.loads(open(p).read())["reason"] for p in left]
+        assert reasons == ["r3", "r4", "r5"]
+
+    def test_rate_limit_is_per_reason(self, tmp_path):
+        """A storm of one trigger collapses; a DIFFERENT trigger landing
+        inside the window still writes (a sigterm_drain right after a
+        driver_exception must not vanish)."""
+        w = PostmortemWriter(str(tmp_path), min_interval_s=60.0)
+        assert w.dump(build_bundle("driver_exception")) is not None
+        assert w.dump(build_bundle("driver_exception")) is None
+        assert w.dump(build_bundle("sigterm_drain")) is not None
+        assert len(bundles_in(tmp_path)) == 2
+
+    def test_failed_write_does_not_arm_the_rate_limit(self, tmp_path):
+        w = PostmortemWriter(str(tmp_path), min_interval_s=60.0)
+        w.directory = str(tmp_path / "file")
+        (tmp_path / "file").write_text("x")     # unwritable: a FILE
+        assert w.dump(build_bundle("r")) is None
+        w.directory = str(tmp_path)             # "disk recovered"
+        assert w.dump(build_bundle("r")) is not None
+
+    def test_unwritable_dir_never_raises(self, tmp_path):
+        victim = tmp_path / "file"
+        victim.write_text("x")           # a FILE where a dir must be
+        w = PostmortemWriter(str(victim), min_interval_s=0.0)
+        assert w.dump(build_bundle("r")) is None
+
+    def test_no_tmp_droppings(self, tmp_path):
+        w = PostmortemWriter(str(tmp_path), min_interval_s=0.0)
+        w.dump(build_bundle("r"))
+        assert not glob.glob(os.path.join(str(tmp_path), "*.tmp"))
+
+
+# ---------------------------------------------------------------------------
+# the watch console
+# ---------------------------------------------------------------------------
+
+class TestWatchConsole:
+    def test_watch_renders_live_server(self, tmp_path, capsys):
+        from reval_tpu.watch import run_watch
+
+        eng, session, srv = make_stack(tmp_path)
+        try:
+            for i in range(3):
+                session.submit([f"p{i}"], max_new_tokens=32).result(timeout=10)
+            rc = run_watch(["--port", str(srv.port), "--interval", "0.01",
+                            "--iterations", "2", "--no-clear"])
+        finally:
+            srv.shutdown()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "reval_tpu watch" in out and "READY" in out
+        assert "throughput" in out and "req/s" in out
+        assert "latency" in out and "p50" in out
+        assert "page pool" in out and "lifecycle" in out
+        assert "last faults" in out
+        # second refresh computes real rates from counter deltas
+        assert out.count("reval_tpu watch") == 2
+
+    def test_watch_survives_unreachable_server(self, capsys):
+        import socket
+
+        from reval_tpu.watch import run_watch
+
+        with socket.socket() as s:      # grab a port nobody serves
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        rc = run_watch(["--port", str(port), "--interval", "0.01",
+                        "--iterations", "2", "--no-clear"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "UNREACHABLE" in out and "retrying" in out
+
+    def test_render_screen_canned(self):
+        """Unit render: dp bundle shape, fault tail, rate deltas."""
+        from reval_tpu.obs import metrics as m
+        from reval_tpu.watch import render_screen
+
+        reg = m.MetricsRegistry()
+        reg.counter(m.REQUESTS).add(20)
+        reg.counter("reval_engine_generated_tokens_total").add(400)
+        reg.gauge(m.QUEUED_TOKENS).set(128)
+        reg.gauge(m.FREE_PAGES).set(55)
+        for v in (0.01, 0.02, 0.4):
+            reg.histogram(m.TTFT).observe(v)
+            reg.histogram(m.E2E).observe(v * 2)
+        status = {"model": "m", "draining": False,
+                  "metrics": reg.snapshot(), "readiness": {"ready": True}}
+        debug = {"replicas": [{"flight": [
+            {"step": 7, "running": 3, "queued": 1, "free_pages": 55,
+             "cached_pages": 9, "pinned_pages": 2, "step_ms": 1.25}]}],
+            "recent_logs": [{"ts": "t", "level": "error",
+                             "event": "session.driver_error",
+                             "error": "boom"}]}
+        prev = {m.REQUESTS: 10}
+        screen = render_screen(status, debug, prev, 2.0, "h:1")
+        assert "req/s 5.0" in screen
+        assert "queued_tokens 128" in screen
+        assert "free 55" in screen and "cached 9" in screen
+        assert "session.driver_error" in screen
+        assert "p50" in screen
+
+
+# ---------------------------------------------------------------------------
+# the A/B: recorder off end to end
+# ---------------------------------------------------------------------------
+
+def test_flightrec_disabled_serves_with_empty_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv("REVAL_TPU_FLIGHTREC", "0")
+    eng, session, srv = make_stack(tmp_path)
+    try:
+        out = session.submit(["x"], max_new_tokens=32).result(timeout=10)
+        assert out == [RESPONSE]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debugz", timeout=10) as r:
+            bundle = json.loads(r.read())
+        assert bundle["flight"] == []       # off, and the bundle says so
+        assert bundle["readiness"]["ready"] is True
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the real paged engine feeds the same ring
+# ---------------------------------------------------------------------------
+
+def test_paged_engine_drive_tick_feeds_recorder():
+    """Not just the mock: the real engine's drive tick records slots,
+    queue, page pool, and chunk sizes every step."""
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                         page_size=128, max_seq_len=256)
+    try:
+        eng.generate(["def f(x):", "def g(y):"], max_new_tokens=8,
+                     temperature=0.0)
+        assert eng.flightrec.total >= 1
+        recs = eng.flightrec.snapshot()
+        assert [r["step"] for r in recs] == list(range(len(recs)))
+        # the pool gauge is live (tiny engine: 1 + slots*pages_per_seq)
+        assert all(r["free_pages"] > 0 for r in recs)
+        assert any(r["running"] > 0 for r in recs)
+        assert all(r["step_ms"] >= 0 for r in recs)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# the events lint actually bites
+# ---------------------------------------------------------------------------
+
+def test_check_metrics_catches_undeclared_event(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import check_metrics
+
+        root = tmp_path / "repo"
+        (root / "reval_tpu" / "obs").mkdir(parents=True)
+        (root / "reval_tpu" / "rogue.py").write_text(
+            'log_event("engine.made_up_event", level="error")\n')
+        readme = ["| `reval_requests_total` | c | x |"]
+        readme += [f"| `{name}` | {help} |" for name, help in
+                   check_metrics._events_spec().items()]
+        (root / "README.md").write_text("\n".join(readme) + "\n")
+        errors = check_metrics.run_checks(str(root))
+    finally:
+        sys.path.remove(TOOLS)
+    assert any("engine.made_up_event" in e and "not declared" in e
+               for e in errors)
+    # declared-but-never-emitted is also reported (both directions)
+    assert any("never emitted" in e for e in errors)

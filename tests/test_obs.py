@@ -11,7 +11,6 @@ tools.
 """
 
 import json
-import logging
 import os
 import sys
 import urllib.error
@@ -411,8 +410,10 @@ def test_multisession_metrics_merge_across_replicas():
         srv.shutdown()
 
 
-def test_retry_log_names_request(caplog):
-    """Satellite: retry attempts log (request_id, attempt, delay)."""
+def test_retry_log_names_request():
+    """Satellite: retry attempts emit structured `client.retry` events
+    carrying (label=request id, attempt, budget, delay)."""
+    from reval_tpu.obs.logging import recent
     from reval_tpu.resilience import RetryPolicy
 
     calls = {"n": 0}
@@ -425,12 +426,16 @@ def test_retry_log_names_request(caplog):
 
     policy = RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0,
                          sleep=lambda s: None)
-    with caplog.at_level(logging.WARNING, logger="reval_tpu.resilience.retry"):
-        assert policy.call(flaky, label="request deadbeef01") == "ok"
-    msgs = [r.getMessage() for r in caplog.records]
-    assert len(msgs) == 2
-    assert all("request deadbeef01" in m for m in msgs)
-    assert "attempt 1/5" in msgs[0] and "retrying in" in msgs[0]
+    before = len([e for e in recent() if e["event"] == "client.retry"])
+    assert policy.call(flaky, label="request deadbeef01") == "ok"
+    events = [e for e in recent() if e["event"] == "client.retry"][before:]
+    assert len(events) == 2
+    assert all(e["fields"]["label"] == "request deadbeef01" for e in events)
+    assert all(e["level"] == "warning" for e in events)
+    first = events[0]["fields"]
+    assert (first["attempt"], first["budget"]) == (1, 5)
+    assert first["delay_s"] > 0
+    assert "ConnectionError" in events[0]["error"]
 
 
 # ---------------------------------------------------------------------------
@@ -501,8 +506,131 @@ def test_check_metrics_catches_undocumented(tmp_path):
         errors = check_metrics.run_checks(str(root))
     finally:
         sys.path.remove(TOOLS)
-    missing = [e for e in errors if "missing from the README" in e]
+    missing = [e for e in errors if "missing from the README metric" in e]
     assert len(missing) == len(METRICS) - 1
+
+def test_obs_report_diff_disjoint_metric_sets(tmp_path, capsys):
+    """Diff mode with DISJOINT snapshots (a server restart or a metric
+    added/removed between scrapes): b-only histograms diff against zero,
+    a-only histograms are dropped (rendering the old totals as a
+    positive 'delta' would be a lie), and a-only counters go negative —
+    the visible signature of a restart."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import obs_report
+
+        h_a = {"buckets": [[0.1, 2], [1.0, 1]], "inf": 0,
+               "sum": 0.4, "count": 3}
+        h_b = {"buckets": [[0.1, 5], [1.0, 0]], "inf": 1,
+               "sum": 2.0, "count": 6}
+        a = {"counters": {"reval_requests_total": 7},
+             "gauges": {},
+             "histograms": {"reval_request_ttft_seconds": h_a}}
+        b = {"counters": {"reval_engine_prompts_total": 4},
+             "gauges": {},
+             "histograms": {"reval_request_e2e_seconds": h_b}}
+        delta = obs_report.diff_snapshots(a, b)
+        # a-only counter: negative delta (restart signature); b-only: full
+        assert delta["counters"]["reval_requests_total"] == -7
+        assert delta["counters"]["reval_engine_prompts_total"] == 4
+        # a-only histogram dropped; b-only kept verbatim
+        assert "reval_request_ttft_seconds" not in delta["histograms"]
+        assert delta["histograms"]["reval_request_e2e_seconds"] == h_b
+        # the delta still renders and its percentiles compute
+        assert obs_report.percentile(h_b, 0.5) > 0
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        assert obs_report.main([str(pa), str(pb)]) == 0
+        out = capsys.readouterr().out
+        assert "reval_request_e2e_seconds" in out
+        assert "reval_request_ttft_seconds" not in out
+        assert "-7" in out
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_obs_report_empty_bucket_histograms(tmp_path, capsys):
+    """Histograms registered but never observed (count 0, all-zero
+    buckets) must not divide by zero, must stay out of the table, and an
+    all-empty snapshot says so instead of printing headers over
+    nothing."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import obs_report
+
+        reg = MetricsRegistry()
+        reg.histogram(TTFT)                 # registered, zero observations
+        snap = reg.snapshot()
+        assert snap["histograms"][TTFT]["count"] == 0
+        p = tmp_path / "empty.json"
+        p.write_text(json.dumps(snap))
+        assert obs_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "empty snapshot" in out
+        # diffing two empties is also clean (delta count 0 everywhere)
+        assert obs_report.main([str(p), str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "empty snapshot" in out
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_obs_report_gauge_only_registry(tmp_path, capsys):
+    """A registry holding only gauges (e.g. a scrape before any request
+    arrived) renders its gauge table; a diff keeps b's gauge LEVELS
+    (a gauge is a level, not a flow — never subtracted)."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import obs_report
+        from reval_tpu.obs.metrics import FREE_PAGES, QUEUED_TOKENS
+
+        rega, regb = MetricsRegistry(), MetricsRegistry()
+        rega.gauge(FREE_PAGES).set(100)
+        rega.gauge(QUEUED_TOKENS).set(5)
+        regb.gauge(FREE_PAGES).set(37)
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(rega.snapshot()))
+        pb.write_text(json.dumps(regb.snapshot()))
+        assert obs_report.main([str(pa)]) == 0
+        out = capsys.readouterr().out
+        assert FREE_PAGES in out and "100" in out
+        assert obs_report.main([str(pa), str(pb)]) == 0
+        out = capsys.readouterr().out
+        line = next(l for l in out.splitlines() if l.startswith(FREE_PAGES))
+        assert line.split()[-1] == "37.0"       # b's level, not 37-100
+        assert QUEUED_TOKENS not in out         # absent in b: not a delta
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_fleet_skips_snapshot_when_no_requests_completed(tmp_path):
+    """Satellite: a fully-journaled `--resume` run (zero new inference)
+    must NOT clobber the previous run's fleet_metrics.json with an
+    empty shell — and must not print a latency trailer."""
+    from reval_tpu.fleet import FleetRunner
+    from reval_tpu.inference.mock import MockBackend
+    from reval_tpu.serving import MockStepEngine
+
+    class EngineBackend(MockBackend):
+        def __init__(self):
+            super().__init__(prompt_type="direct")
+            self.engine = MockStepEngine()
+
+    previous = {"ts": "earlier", "metrics": {"counters":
+                {"reval_requests_total": 42}}}
+    snap_path = tmp_path / "fleet_metrics.json"
+    snap_path.write_text(json.dumps(previous))
+
+    runner = FleetRunner(dataset="humaneval", repeats=1, max_items=1,
+                         backend=EngineBackend(), progress=False,
+                         resilience=False, run_consistency=False,
+                         tasks=("coverage",), results_dir=str(tmp_path))
+    # simulate the fully-journaled resume: nothing retires on the engine
+    result = runner.run()
+    assert "latency" not in result
+    assert json.loads(snap_path.read_text()) == previous   # untouched
+
 
 def test_obs_report_renders_and_diffs(tmp_path, capsys):
     sys.path.insert(0, TOOLS)

@@ -628,16 +628,33 @@ def test_fleet_resume_across_wedged_then_restarted_server(tmp_path, capsys):
     assert len(journal.read_text().splitlines()) == 4   # 1 repeat × 4 tasks
 
 
-def test_serve_mock_chaos_smoke_cli(capsys):
+def test_serve_mock_chaos_smoke_cli(tmp_path, capsys):
     """Tier-1 serve-path chaos smoke, mirroring `fleet --mock --chaos`:
     `serve --mock --smoke` drives concurrent prompts through the resilient
-    client with engine-step chaos enabled, drains, and reports counters."""
+    client with engine-step chaos enabled while hammering /debugz (every
+    response must parse), drains, reports counters, and — when the chaos
+    schedule injected `error` faults — asserts a postmortem bundle was
+    produced and parses (the smoke exits 1 otherwise)."""
     from reval_tpu.cli import main
 
+    pm_dir = tmp_path / "postmortems"
+    # seed 6 @ rate 0.5 deterministically injects `error` faults within
+    # the first few steps (the schedule is keyed on step ordinal alone)
     rc = main(["serve", "--mock", "--port", "0", "--smoke", "6",
-               "--chaos-step", "0.3", "--chaos-seed", "5"])
+               "--chaos-step", "0.5", "--chaos-seed", "6",
+               "--postmortem-dir", str(pm_dir)])
     out = capsys.readouterr().out
     assert rc == 0, out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["served"] == 6 and summary["errors"] == 0
+    assert summary["debugz_scrapes"] > 0
+    assert summary["chaos_injected"] > 0
+    # error faults fired, so the smoke's own gate required ≥1 bundle
+    assert summary["postmortems"] >= 1
+    bundles = list(pm_dir.glob("postmortem-*.json"))
+    assert summary["postmortems"] == len(bundles)
+    assert all(json.loads(p.read_text())["reason"] == "driver_exception"
+               for p in bundles)
     summary = json.loads(out.strip().splitlines()[-1])
     assert summary["served"] == 6 and summary["errors"] == 0
     for key in ("sheds", "deadline_expired", "watchdog_trips",

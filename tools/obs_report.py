@@ -24,7 +24,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from reval_tpu.obs.metrics import percentile_from_buckets  # noqa: E402
+from reval_tpu.obs.metrics import snapshot_percentile  # noqa: E402
 
 
 def load_snapshot(path: str) -> dict:
@@ -50,8 +50,14 @@ def diff_snapshots(a: dict, b: dict) -> dict:
     for name in sorted(set(a["histograms"]) | set(b["histograms"])):
         ha = a["histograms"].get(name)
         hb = b["histograms"].get(name)
-        if ha is None or hb is None:
-            hists[name] = hb or ha
+        if hb is None:
+            # present in a, gone in b: the process restarted between the
+            # scrapes — rendering a's old totals as a positive "delta"
+            # would be a lie, so the series is dropped (the counters
+            # section still shows the restart as negative deltas)
+            continue
+        if ha is None:
+            hists[name] = hb      # appeared between scrapes: a is zero
             continue
         if [x[0] for x in ha["buckets"]] != [x[0] for x in hb["buckets"]]:
             raise ValueError(f"{name}: bucket bounds differ between files")
@@ -66,12 +72,11 @@ def diff_snapshots(a: dict, b: dict) -> dict:
 
 
 def percentile(hist: dict, q: float) -> float:
-    """THE estimator (obs.metrics.percentile_from_buckets — shared with
-    Histogram.percentile so a diff report and a live /metrics scrape can
-    never disagree), applied to the snapshot encoding."""
-    bounds = tuple(b for b, _ in hist["buckets"])
-    counts = [c for _, c in hist["buckets"]] + [hist.get("inf", 0)]
-    return percentile_from_buckets(bounds, counts, hist["count"], q)
+    """THE estimator (obs.metrics.snapshot_percentile, itself over
+    percentile_from_buckets — shared with Histogram.percentile and the
+    `reval_tpu watch` console, so a diff report, a live scrape, and the
+    watch screen can never disagree)."""
+    return snapshot_percentile(hist, q)
 
 
 def _fmt_secs(v: float) -> str:

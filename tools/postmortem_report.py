@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""Render a postmortem bundle as a human-readable timeline.
+
+Input: a ``postmortem-<ts>.json`` written by the serving stack (watchdog
+trip, driver fault, deadline storm, SIGUSR1, SIGTERM drain — see
+``reval_tpu/obs/flightrec.py``), or a saved ``GET /debugz`` body (same
+schema).  Output, per replica:
+
+- the envelope: reason, timestamps, env/config fingerprint;
+- the in-flight request table with lifecycle stamps (who was where when
+  it died: submitted / admitted / first token / done ages);
+- the recent structured-log tail (errors and warnings first-class);
+- the flight-record runway as a step timeline — the last N drive ticks
+  with slots, queue, page pool, chunk size, step wall and heartbeat age,
+  plus a summary of the stall (the slowest recorded steps).
+
+Usage:
+    python tools/postmortem_report.py BUNDLE.json [--records N] [--all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: flight-record columns rendered in the timeline, (header, key, width)
+_COLS = (("step", "step", 8), ("running", "running", 7),
+         ("queued", "queued", 6), ("free_pg", "free_pages", 7),
+         ("cached", "cached_pages", 6), ("pinned", "pinned_pages", 6),
+         ("chunk", "chunk_steps", 5), ("step_ms", "step_ms", 9),
+         ("hb_ms", "hb_age_ms", 8))
+
+
+def _fmt(v, width: int) -> str:
+    if isinstance(v, float):
+        return f"{v:>{width}.2f}"
+    return f"{str(v) if v is not None else '—':>{width}}"
+
+
+def render_flight(records: list[dict], last: int, out: list[str]) -> None:
+    if not records:
+        out.append("  (no flight records — recorder disabled or no ticks)")
+        return
+    total = len(records)
+    shown = records[-last:] if last else records
+    out.append(f"  {total} records retained, showing the last {len(shown)} "
+               f"(steps {shown[0].get('step')}..{shown[-1].get('step')})")
+    out.append("  " + " ".join(f"{h:>{w}}" for h, _, w in _COLS) + "  seq_ids")
+    for rec in shown:
+        row = " ".join(_fmt(rec.get(k), w) for _, k, w in _COLS)
+        ids = rec.get("seq_ids") or []
+        out.append("  " + row + "  " + ",".join(str(i) for i in ids[:8]))
+    slow = sorted(records, key=lambda r: r.get("step_ms") or 0)[-3:]
+    out.append("  slowest steps: " + "; ".join(
+        f"step {r.get('step')} = {r.get('step_ms', 0):.1f}ms"
+        for r in reversed(slow)))
+
+
+def render_requests(requests: list[dict] | None, out: list[str]) -> None:
+    if not requests:
+        out.append("  (no in-flight engine requests recorded)")
+        return
+    out.append(f"  {'seq':>5} {'request_id':<18} {'prompt':>7} {'gen':>5} "
+               f"{'done':>5} {'age_s':>8} {'admit':>6} {'first':>6}")
+    for r in requests:
+        out.append(
+            f"  {r.get('seq_id', '—'):>5} "
+            f"{str(r.get('request_id') or 'n/a'):<18.18} "
+            f"{r.get('prompt_tokens', 0):>7} {r.get('generated_tokens', 0):>5} "
+            f"{str(bool(r.get('done'))):>5} {r.get('age_s', 0):>8} "
+            f"{'yes' if r.get('t_admit') is not None else 'no':>6} "
+            f"{'yes' if r.get('t_first') is not None else 'no':>6}")
+
+
+def render_logs(logs: list[dict] | None, out: list[str]) -> None:
+    if not logs:
+        out.append("  (no recent log events)")
+        return
+    for e in logs[-20:]:
+        line = (f"  {e.get('ts', '')} [{e.get('level', '?'):>7}] "
+                f"{e.get('event', '?')}")
+        if e.get("request_id"):
+            line += f" rid={e['request_id']}"
+        if e.get("error"):
+            line += f" error={e['error']}"
+        if e.get("fields"):
+            line += " " + json.dumps(e["fields"], default=str)
+        out.append(line[:160])
+
+
+def render_replica(bundle: dict, last: int, out: list[str],
+                   label: str = "") -> None:
+    if label:
+        out.append(f"-- replica {label} " + "-" * max(0, 50 - len(label)))
+    readiness = bundle.get("readiness")
+    if readiness is not None:
+        flags = {k: v for k, v in readiness.items() if k != "replicas"}
+        out.append(f"readiness: {json.dumps(flags, default=str)}")
+    inflight = bundle.get("inflight")
+    if inflight is not None:
+        out.append(f"in-flight submissions: {len(inflight)}")
+        for sub in inflight[:16]:
+            out.append(f"  rid={sub.get('request_id') or 'n/a'} "
+                       f"prompts={sub.get('prompts')} "
+                       f"tokens={sub.get('tokens')} "
+                       f"age={sub.get('age_s')}s "
+                       f"deadline_in={sub.get('deadline_in_s')}s "
+                       f"resolved={sub.get('resolved')}")
+    out.append("engine requests:")
+    render_requests(bundle.get("requests"), out)
+    spans = bundle.get("spans")
+    if spans:
+        out.append(f"span tail: {spans.get('total', 0)} events recorded, "
+                   f"{spans.get('dropped', 0)} dropped")
+    out.append("flight records:")
+    render_flight(bundle.get("flight") or [], last, out)
+
+
+def render(bundle: dict, last: int = 40) -> str:
+    out: list[str] = []
+    out.append(f"== postmortem: {bundle.get('reason', '?')} "
+               f"@ {bundle.get('iso', '?')} ==")
+    if bundle.get("error"):
+        out.append(f"error: {bundle['error']}")
+    if bundle.get("model"):
+        out.append(f"model: {bundle['model']}"
+                   + ("  (draining)" if bundle.get("draining") else ""))
+    fp = bundle.get("fingerprint") or {}
+    out.append(f"process: pid={fp.get('pid')} python={fp.get('python')} "
+               f"jax={fp.get('jax')} platform={fp.get('platform')}")
+    if fp.get("env"):
+        out.append(f"env: {json.dumps(fp['env'], default=str)}")
+    out.append("")
+    replicas = bundle.get("replicas")
+    if replicas:
+        for i, rep in enumerate(replicas):
+            render_replica(rep, last, out, label=str(i))
+            out.append("")
+    else:
+        render_replica(bundle, last, out)
+        out.append("")
+    out.append("recent structured-log events:")
+    render_logs(bundle.get("recent_logs"), out)
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="postmortem-*.json (or a saved /debugz "
+                                   "response body)")
+    ap.add_argument("--records", type=int, default=40,
+                    help="flight-record timeline rows (default 40)")
+    ap.add_argument("--all", action="store_true",
+                    help="render every retained flight record")
+    args = ap.parse_args(argv)
+    with open(args.bundle) as f:
+        bundle = json.load(f)
+    if not isinstance(bundle, dict) or "reason" not in bundle:
+        print(f"{args.bundle}: not a postmortem bundle (no 'reason' key)",
+              file=sys.stderr)
+        return 1
+    print(render(bundle, last=0 if args.all else args.records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,11 @@
 # tunnel window makes progress, and a long one completes everything.
 #
 # Artifacts land under tpu_watch/ (see chip_runbook.sh header).
+#
+# Python sibling: `python -m reval_tpu watch` babysits a SERVING endpoint
+# (polls /statusz + /debugz into a refreshing one-screen console —
+# throughput, queue depth, page pool, latency percentiles, last faults).
+# This script babysits the raw chip; use both on a serving host.
 cd /root/repo || exit 1
 mkdir -p tpu_watch
 
