@@ -343,7 +343,16 @@ def fail(metric: str, error: str, detail: str = "") -> None:
     except Exception:
         lk = None
     if lk:
+        # an unreachable chip is a STALE measurement, not a zero: the
+        # explicit marker + the carried value/commit make BENCH_r06+ read
+        # as "stale @ last_known" instead of a multi-round blind spot
+        out["status"] = "stale"
         out["last_known"] = lk
+        out["stale_probes_per_sec"] = lk["value"]
+        if lk.get("measured_at_commit"):
+            out["stale_commit"] = lk["measured_at_commit"]
+    else:
+        out["status"] = "failed"
     emit(out)
 
 

@@ -1,0 +1,34 @@
+"""reval-lint: codebase-native static analysis for the serving stack.
+
+The serving/observability arc (PRs 1–5) accumulated invariants that live
+only in prose: which fields each ``threading.Lock`` guards, which calls
+are allowed inside the ~µs drive-tick hot path, which exceptions may
+cross the HTTP boundary, and which ``REVAL_TPU_*`` env knobs exist.
+This package turns each of those contracts into an AST-level lint pass
+over the tree, plus a runtime lock sanitizer for what static analysis
+cannot see (acquisition ORDER, cross-thread writes at test time):
+
+- :mod:`.locks`       — lock-discipline / race detector over
+  ``# guarded-by:`` annotations;
+- :mod:`.hotpath`     — no blocking/allocating calls in ``# hot-path``
+  functions;
+- :mod:`.errboundary` — the serving layer raises only the
+  ``serving/errors.py`` taxonomy;
+- :mod:`.envreg`      — every ``REVAL_TPU_*`` read goes through the
+  declared ``reval_tpu/env.py::ENV`` spec, round-tripped against the
+  README table;
+- :mod:`.metrics_events` — the METRICS/EVENTS namespace checks that
+  previously lived in ``tools/check_metrics.py``, migrated into the
+  same pass framework (one driver, one report format);
+- :mod:`.lockcheck`   — the runtime sanitizer (``REVAL_TPU_LOCKCHECK=1``).
+
+Run everything with ``python tools/reval_lint.py`` or
+``python -m reval_tpu lint``; the framework lives in :mod:`.core` and
+the driver in :mod:`.driver`.
+"""
+
+from .core import Annotations, SourceFile, Suppression, Violation, collect_sources
+from .driver import PASSES, run_lint
+
+__all__ = ["Annotations", "SourceFile", "Suppression", "Violation",
+           "collect_sources", "PASSES", "run_lint"]

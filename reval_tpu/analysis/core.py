@@ -1,0 +1,307 @@
+"""Lint-framework core: parsed sources, annotations, suppressions.
+
+Every pass consumes :class:`SourceFile` objects — the parsed AST plus a
+line-indexed comment map (comments are where the contracts live: the
+``# guarded-by:`` / ``# lock-held:`` / ``# hot-path`` annotations and
+the ``# lint: allow(<pass>) — <reason>`` suppressions).  Comments come
+from :mod:`tokenize`, not regexes over raw lines, so a ``#`` inside a
+string literal can never masquerade as an annotation.
+
+Suppression policy (ISSUE 6): a finding is only silenced by an inline
+``# lint: allow(<pass>) — <reason>`` on the violating line or the
+contiguous comment block directly above it.  The REASON is mandatory —
+an allow without one is itself reported — and the driver counts every
+suppression used so the report always says how much of the tree is
+exempted, and why.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+__all__ = ["Violation", "Suppression", "SourceFile", "Annotations",
+           "collect_sources", "GuardSpec"]
+
+#: the suppression marker: allow(<passes>) followed by a mandatory reason
+#: (the regexes below are written so their OWN doc comments cannot be
+#: mistaken for annotations — never spell a full marker in a comment here)
+_ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*([a-z_,\s-]+?)\s*\)\s*(?:[—:–-]+\s*(\S.*))?$")
+
+#: the guarded-field marker, with an optional writes-only qualifier
+_GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)\s*(\(writes\))?")
+
+#: the deliberately-lock-free marker (reason after the colon)
+_UNGUARDED_RE = re.compile(r"#\s*unguarded\s*[:—]")
+
+#: the caller-holds-my-lock marker on a def line
+_LOCKHELD_RE = re.compile(r"#\s*lock-held:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: the hot-function marker on a def line
+_HOT_RE = re.compile(r"#\s*hot-path\b")
+
+
+@dataclass
+class Violation:
+    pass_name: str
+    path: str               # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        where = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{where}: [{self.pass_name}] {self.message}"
+
+
+@dataclass
+class Suppression:
+    """One *used* ``# lint: allow`` (driver-counted and reported)."""
+
+    pass_name: str
+    path: str
+    line: int
+    reason: str
+    message: str            # the finding it silenced
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}] "
+                f"allowed — {self.reason}")
+
+
+@dataclass
+class GuardSpec:
+    """One ``# guarded-by:`` declaration."""
+
+    fieldname: str
+    lock: str
+    writes_only: bool
+    line: int
+    owner: str              # class name, function name, or "<module>"
+
+
+@dataclass
+class Annotations:
+    """Everything the comment annotations of one file declare."""
+
+    guards: dict[str, GuardSpec] = field(default_factory=dict)
+    unguarded: set[str] = field(default_factory=set)
+    #: lock names owned per scope: {"ClassName" | "<module>": {lock, ...}}
+    locks: dict[str, set[str]] = field(default_factory=dict)
+    #: function qualnames marked ``# lock-held: L`` -> lock name
+    lock_held: dict[str, str] = field(default_factory=dict)
+    #: function qualnames marked ``# hot-path``
+    hot: set[str] = field(default_factory=set)
+    #: annotation problems found while extracting (duplicate guards, …)
+    problems: list[tuple[int, str]] = field(default_factory=list)
+
+
+class SourceFile:
+    """One parsed python file: text, AST, comments, suppressions."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self._lines = text.splitlines()
+        self.tree = ast.parse(text)
+        #: line -> raw comment text (without leading whitespace)
+        self.comments: dict[int, str] = {}
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                self.comments[tok.start[0]] = tok.string
+        #: line -> ({pass names} | {"*"}, reason or "")
+        self.allows: dict[int, tuple[set[str], str]] = {}
+        for line, comment in self.comments.items():
+            m = _ALLOW_RE.search(comment)
+            if m:
+                names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+                reason = (m.group(2) or "").strip()
+                # a reason may wrap onto following full-comment lines —
+                # the ledger must carry the whole explanation
+                nxt = line + 1
+                while (nxt in self.comments
+                       and nxt <= len(self._lines)
+                       and self._lines[nxt - 1].lstrip().startswith("#")
+                       and not _ALLOW_RE.search(self.comments[nxt])):
+                    reason = (reason + " "
+                              + self.comments[nxt].lstrip("# ").strip()).strip()
+                    nxt += 1
+                self.allows[line] = (names, reason)
+        self._annotations: Annotations | None = None
+
+    # -- comment lookups ---------------------------------------------------
+    def comment_block(self, line: int) -> list[tuple[int, str]]:
+        """The comment on ``line`` plus the contiguous comment block
+        directly above it (annotations may ride either)."""
+        out = []
+        if line in self.comments:
+            out.append((line, self.comments[line]))
+        above = line - 1
+        while above in self.comments:
+            # only count FULL comment lines above (a trailing comment on
+            # an unrelated statement must not leak downward)
+            if (0 < above <= len(self._lines)
+                    and self._lines[above - 1].lstrip().startswith("#")):
+                out.append((above, self.comments[above]))
+                above -= 1
+            else:
+                break
+        return out
+
+    def allowance(self, pass_name: str, line: int) -> tuple[str, int] | None:
+        """(reason, line) when an allow for ``pass_name`` covers ``line``
+        — same line or the contiguous comment block above."""
+        for ln, _ in self.comment_block(line):
+            hit = self.allows.get(ln)
+            if hit and (pass_name in hit[0] or "*" in hit[0]):
+                return hit[1], ln
+        return None
+
+    # -- annotations -------------------------------------------------------
+    def annotations(self) -> Annotations:
+        if self._annotations is None:
+            self._annotations = _extract_annotations(self)
+        return self._annotations
+
+
+def _target_name(node: ast.stmt) -> tuple[str | None, bool]:
+    """(name, is_self_attr) of a simple assignment statement target."""
+    targets: list[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    for t in targets:
+        if isinstance(t, ast.Name):
+            return t.id, False
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            return t.attr, True
+    return None, False
+
+
+def _is_lock_ctor(value: ast.expr | None) -> bool:
+    """Does this expression construct a threading Lock/RLock/Condition
+    (anywhere inside it — ``Lock() if x else nullcontext()`` counts)?"""
+    if value is None:
+        return False
+    for node in ast.walk(value):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("Lock", "RLock", "Condition")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("threading", "_threading")):
+            return True
+    return False
+
+
+def _extract_annotations(src: SourceFile) -> Annotations:
+    ann = Annotations()
+    ann.locks = {}
+
+    def scan_stmt(node: ast.stmt, class_owner: str,
+                  local_owner: str) -> None:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return
+        name, is_self = _target_name(node)
+        if name is None:
+            return
+        owner = class_owner if is_self else local_owner
+        value = getattr(node, "value", None)
+        if _is_lock_ctor(value):
+            ann.locks.setdefault(owner, set()).add(name)
+            return
+        block = src.comment_block(node.lineno)
+        for _, comment in block:
+            m = _GUARDED_RE.search(comment)
+            if m:
+                spec = GuardSpec(name, m.group(1), bool(m.group(2)),
+                                 node.lineno, owner)
+                prev = ann.guards.get(name)
+                if prev is not None and (prev.lock != spec.lock
+                                         or prev.writes_only != spec.writes_only):
+                    ann.problems.append(
+                        (node.lineno,
+                         f"field {name!r} declared guarded-by {spec.lock!r} "
+                         f"here but guarded-by {prev.lock!r} at line "
+                         f"{prev.line} — one field, one lock"))
+                ann.guards.setdefault(name, spec)
+                return
+            if _UNGUARDED_RE.search(comment):
+                ann.unguarded.add(name)
+                return
+
+    def scan_body(body: list[ast.stmt], class_owner: str,
+                  local_owner: str, qual: str) -> None:
+        for node in body:
+            scan_stmt(node, class_owner, local_owner)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fq = f"{qual}.{node.name}" if qual else node.name
+                for _, comment in src.comment_block(node.lineno):
+                    if _HOT_RE.search(comment):
+                        ann.hot.add(fq)
+                    m = _LOCKHELD_RE.search(comment)
+                    if m:
+                        ann.lock_held[fq] = m.group(1)
+                # inside a function: self.X stays with the class, plain
+                # names (dp_paged's local work queue) are function-scoped
+                scan_body(node.body, class_owner, fq, fq)
+            elif isinstance(node, ast.ClassDef):
+                scan_body(node.body, node.name, node.name, node.name)
+            else:
+                # annotated assignments may sit inside if/with/try/for
+                # blocks (conditional construction) — descend so their
+                # guards register with the same owners
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(node, attr, None)
+                    if sub:
+                        scan_body(sub, class_owner, local_owner, qual)
+                for handler in getattr(node, "handlers", []) or []:
+                    scan_body(handler.body, class_owner, local_owner, qual)
+
+    scan_body(src.tree.body, "<module>", "<module>", "")
+    return ann
+
+
+#: directories/files collected relative to the repo root
+SCAN_DIRS = ("reval_tpu", "tools")
+SCAN_FILES = ("bench.py", "__graft_entry__.py")
+
+
+def collect_sources(root: str,
+                    problems: list[tuple[str, str]] | None = None,
+                    ) -> dict[str, SourceFile]:
+    """rel-path -> SourceFile over the lintable tree (``reval_tpu/``,
+    ``tools/``, ``bench.py``).  A file that cannot be parsed is recorded
+    into ``problems`` (when given) — the driver turns those into
+    violations, because a skipped file is an UNLINTED file and
+    ``reval-lint: ok`` must never be printed over one silently."""
+    out: dict[str, SourceFile] = {}
+    paths: list[str] = [os.path.join(root, f) for f in SCAN_FILES]
+    for d in SCAN_DIRS:
+        for dirpath, _, filenames in os.walk(os.path.join(root, d)):
+            if "__pycache__" in dirpath:
+                continue
+            paths.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                         if f.endswith(".py"))
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as exc:
+            if problems is not None and os.path.exists(path):
+                problems.append((rel, f"cannot read: {exc}"))
+            continue
+        try:
+            out[rel] = SourceFile(path, rel, text)
+        except SyntaxError as exc:
+            if problems is not None:
+                problems.append((rel, f"cannot parse: {exc}"))
+    return out

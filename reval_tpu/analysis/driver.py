@@ -1,0 +1,154 @@
+"""reval-lint driver: run the passes, apply suppressions, report.
+
+One entry point for every namespace/discipline check in the tree —
+``python tools/reval_lint.py`` and ``python -m reval_tpu lint`` both
+land here, and the fast test tier pins the repo clean
+(``tests/test_lint.py``).
+
+Suppression policy: a violation is silenced only by an inline
+``# lint: allow(<pass>) — <reason>`` on the violating line (or the
+comment block directly above it).  The reason is mandatory; every used
+suppression is counted and printed, so the report always states how much
+of the tree is exempted and why.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from dataclasses import dataclass, field
+
+from . import envreg, errboundary, hotpath, locks
+from .core import Suppression, Violation, collect_sources
+from .metrics_events import run_events, run_metrics
+
+__all__ = ["PASSES", "LintReport", "run_lint", "main"]
+
+#: name -> pass callable ``(sources, root) -> [Violation]`` in run order
+PASSES = {
+    "locks": locks.run,
+    "hotpath": hotpath.run,
+    "errors": errboundary.run,
+    "env": envreg.run,
+    "metrics": run_metrics,
+    "events": run_events,
+}
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclass
+class LintReport:
+    root: str
+    violations: list[Violation] = field(default_factory=list)
+    suppressions: list[Suppression] = field(default_factory=list)
+    per_pass: dict[str, int] = field(default_factory=dict)
+    files: int = 0
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def run_lint(root: str | None = None,
+             passes: list[str] | None = None) -> LintReport:
+    """Run ``passes`` (default: all) over ``root`` (default: this repo)."""
+    root = os.path.abspath(root or _repo_root())
+    names = list(passes) if passes else list(PASSES)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown lint pass(es) {unknown}; "
+                         f"available: {sorted(PASSES)}")
+    t0 = time.perf_counter()
+    problems: list[tuple[str, str]] = []
+    sources = collect_sources(root, problems)
+    report = LintReport(root=root, files=len(sources))
+    for rel, msg in problems:
+        # an unparseable file is an UNLINTED file — never report "ok"
+        # over a tree a pass could not actually see
+        report.violations.append(Violation("parse", rel, 0, msg))
+    for name in names:
+        found = PASSES[name](sources, root)
+        kept = 0
+        for v in found:
+            src = sources.get(v.path)
+            allow = (src.allowance(name, v.line)
+                     if src is not None and v.line else None)
+            if allow is None:
+                report.violations.append(v)
+                kept += 1
+                continue
+            reason, allow_line = allow
+            if not reason:
+                # an allow with no stated reason is itself a violation:
+                # the suppression ledger is only useful if it explains
+                report.violations.append(Violation(
+                    name, v.path, allow_line,
+                    f"suppression without a reason for: {v.message}"))
+                kept += 1
+                continue
+            report.suppressions.append(Suppression(
+                name, v.path, v.line, reason, v.message))
+        report.per_pass[name] = kept
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def render(report: LintReport) -> str:
+    lines = [f"reval-lint: {len(report.per_pass)} pass(es) over "
+             f"{report.files} files in {report.elapsed_s:.2f}s"]
+    width = max(len(n) for n in report.per_pass)
+    for name, count in report.per_pass.items():
+        n_sup = sum(1 for s in report.suppressions if s.pass_name == name)
+        status = "ok" if count == 0 else f"{count} violation(s)"
+        sup = f", {n_sup} suppressed" if n_sup else ""
+        lines.append(f"  {name:<{width}}  {status}{sup}")
+    for v in report.violations:
+        lines.append(f"  - {v}")
+    if report.suppressions:
+        lines.append(f"suppressions in force "
+                     f"({len(report.suppressions)}):")
+        for s in report.suppressions:
+            lines.append(f"  * {s}")
+    lines.append("reval-lint: "
+                 + ("ok" if report.ok
+                    else f"FAIL ({len(report.violations)} violation(s))"))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="reval_tpu lint",
+        description="Codebase-native static analysis: lock discipline, "
+                    "hot-path purity, typed-error boundary, env registry, "
+                    "metric/event namespaces")
+    parser.add_argument("passes", nargs="*", metavar="PASS",
+                        help=f"passes to run (default: all of "
+                             f"{', '.join(PASSES)})")
+    parser.add_argument("--root", default=None,
+                        help="tree to lint (default: this repo).  NOTE: "
+                             "the spec-backed passes (env/metrics/events) "
+                             "always lint against THIS repo's in-process "
+                             "ENV/METRICS/EVENTS declarations — on a "
+                             "foreign tree their spec-vs-tree findings "
+                             "are expected noise; name the AST passes "
+                             "(locks/hotpath/errors) explicitly there")
+    parser.add_argument("--list", action="store_true",
+                        help="list available passes and exit")
+    args = parser.parse_args(argv)
+    if args.list:
+        for name in PASSES:
+            print(name)
+        return 0
+    try:
+        report = run_lint(args.root, args.passes or None)
+    except ValueError as exc:
+        print(f"reval-lint: {exc}")
+        return 2
+    print(render(report))
+    return 0 if report.ok else 1

@@ -1,0 +1,190 @@
+"""Env/config registry pass (``env``).
+
+``reval_tpu/env.py::ENV`` declares every ``REVAL_TPU_*`` knob once
+(mirroring METRICS/EVENTS).  This pass closes the loop in all four
+directions:
+
+1. **No raw reads.**  ``os.environ[...]`` / ``os.environ.get`` /
+   ``os.getenv`` of a ``REVAL_TPU_*`` literal anywhere in ``reval_tpu/``
+   outside ``env.py`` itself is a violation — reads go through the typed
+   accessors, which enforce declaration at runtime too.  WRITES
+   (``os.environ["REVAL_TPU_X"] = ...``) stay legal: tools and benches
+   set knobs for downstream readers.
+2. **Routed names are declared.**  Every ``env_str/int/float/flag/raw``
+   call with a string literal names a declared var (and a NON-literal
+   name is flagged — a computed env name defeats the registry).
+3. **README round-trip.**  The ENV spec and the README environment
+   table match, both directions (same contract as the metric/event
+   tables).
+4. **No zombies.**  A declared var referenced nowhere in the tree
+   (sources under lint plus ``tests/``) is dead config — delete it or
+   wire it up.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from .core import SourceFile, Violation
+
+PASS = "env"
+
+_ACCESSORS = {"env_raw", "env_str", "env_int", "env_float", "env_flag"}
+
+_SPEC_REL = os.path.join("reval_tpu", "env.py")
+
+_README_ROW_RE = re.compile(r"^\s*\|\s*`(REVAL_TPU_[A-Z0-9_]+)`")
+
+
+def _spec() -> dict:
+    from .. import env as env_mod
+
+    return env_mod.ENV
+
+
+def _env_name_arg(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _is_environ(expr: ast.expr) -> bool:
+    """``os.environ`` or a bare ``environ`` name."""
+    return ((isinstance(expr, ast.Attribute) and expr.attr == "environ")
+            or (isinstance(expr, ast.Name) and expr.id == "environ"))
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    env = _spec()
+    for rel, src in sorted(sources.items()):
+        posix = rel.replace("\\", "/")
+        if not posix.startswith("reval_tpu/") or posix == "reval_tpu/env.py":
+            continue
+        for node in ast.walk(src.tree):
+            # raw reads: os.environ.get("REVAL_TPU_X") / os.getenv(...)
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if (func.attr in ("get", "pop", "setdefault")
+                            and _is_environ(func.value)):
+                        name = _env_name_arg(node)
+                        if (name and name.startswith("REVAL_TPU_")
+                                and func.attr != "setdefault"):
+                            out.append(Violation(
+                                PASS, rel, node.lineno,
+                                f"raw os.environ.{func.attr}({name!r}) — "
+                                f"read it through reval_tpu.env "
+                                f"(env_str/env_int/env_float/env_flag)"))
+                    elif func.attr == "getenv":
+                        name = _env_name_arg(node)
+                        if name and name.startswith("REVAL_TPU_"):
+                            out.append(Violation(
+                                PASS, rel, node.lineno,
+                                f"raw os.getenv({name!r}) — read it "
+                                f"through reval_tpu.env"))
+                    if func.attr in _ACCESSORS:
+                        _check_routed(node, rel, env, out)
+                elif isinstance(func, ast.Name) and func.id in _ACCESSORS:
+                    _check_routed(node, rel, env, out)
+                elif isinstance(func, ast.Name) and func.id == "getenv":
+                    # `from os import getenv` must not evade the ban
+                    name = _env_name_arg(node)
+                    if name and name.startswith("REVAL_TPU_"):
+                        out.append(Violation(
+                            PASS, rel, node.lineno,
+                            f"raw getenv({name!r}) — read it through "
+                            f"reval_tpu.env"))
+            # raw subscript READ: os.environ["REVAL_TPU_X"] (stores are
+            # writes — configuring subprocesses/downstream readers)
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)
+                  and _is_environ(node.value)
+                  and isinstance(node.slice, ast.Constant)
+                  and isinstance(node.slice.value, str)
+                  and node.slice.value.startswith("REVAL_TPU_")):
+                out.append(Violation(
+                    PASS, rel, node.lineno,
+                    f"raw os.environ[{node.slice.value!r}] read — route "
+                    f"it through reval_tpu.env"))
+
+    out.extend(_check_readme(root, env))
+    out.extend(_check_zombies(root, sources, env))
+    return out
+
+
+def _check_routed(call: ast.Call, rel: str, env: dict,
+                  out: list[Violation]) -> None:
+    name = _env_name_arg(call)
+    if name is None:
+        out.append(Violation(
+            PASS, rel, call.lineno,
+            "env accessor called with a non-literal name — the registry "
+            "(and this lint) can only track literal REVAL_TPU_* names"))
+        return
+    if name not in env:
+        out.append(Violation(
+            PASS, rel, call.lineno,
+            f"env var {name!r} is not declared in reval_tpu.env.ENV"))
+
+
+def _readme_env_names(root: str) -> set[str] | None:
+    try:
+        with open(os.path.join(root, "README.md")) as f:
+            text = f.read()
+    except OSError:
+        return None
+    names = set()
+    for line in text.splitlines():
+        m = _README_ROW_RE.match(line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def _check_readme(root: str, env: dict) -> list[Violation]:
+    out: list[Violation] = []
+    documented = _readme_env_names(root)
+    if documented is None:
+        return [Violation(PASS, "README.md", 0, "cannot read README.md")]
+    for name in env:
+        if name not in documented:
+            out.append(Violation(
+                PASS, "README.md", 0,
+                f"{name}: declared in reval_tpu.env.ENV but missing from "
+                f"the README environment table"))
+    for name in documented:
+        if name not in env:
+            out.append(Violation(
+                PASS, "README.md", 0,
+                f"{name}: in the README environment table but not "
+                f"declared in reval_tpu.env.ENV"))
+    return out
+
+
+def _check_zombies(root: str, sources: dict[str, SourceFile],
+                   env: dict) -> list[Violation]:
+    """A declared var no source (lint tree + tests/) mentions is dead."""
+    corpus = [src.text for rel, src in sources.items()
+              if rel.replace("\\", "/") != "reval_tpu/env.py"]
+    for path in glob.glob(os.path.join(root, "tests", "*.py")):
+        try:
+            with open(path) as f:
+                corpus.append(f.read())
+        except OSError:
+            pass
+    blob = "\n".join(corpus)
+    out: list[Violation] = []
+    for name in env:
+        # word-boundary match: REVAL_TPU_LOG must not count a reference
+        # just because REVAL_TPU_LOG_LEVEL appears somewhere
+        if not re.search(re.escape(name) + r"(?![A-Z0-9_])", blob):
+            out.append(Violation(
+                PASS, _SPEC_REL, 0,
+                f"{name}: declared in reval_tpu.env.ENV but referenced "
+                f"nowhere in the tree — dead config"))
+    return out

@@ -1,0 +1,64 @@
+"""Typed-error boundary pass (``errors``).
+
+The HTTP boundary maps the ``serving/errors.py`` taxonomy to stable
+statuses and wire-safe bodies (429/503/504/500 + code); anything else a
+handler or the session driver raises reaches clients as a sanitized 500
+whose real cause exists only in the log.  The taxonomy only works if the
+serving layer actually speaks it, so this pass bans UNTYPED raises in
+``reval_tpu/serving/``:
+
+- ``raise RuntimeError(...)`` / ``raise Exception(...)`` /
+  ``raise BaseException(...)`` are violations — wrap the condition in a
+  taxonomy member (``EngineFailure`` exists precisely for "an untyped
+  engine fault crossed the handle");
+- bare ``raise`` (re-raise) is fine — propagation is classification's
+  job upstream;
+- ``ValueError``/``TypeError`` (client-input errors the server maps to
+  400) and ``TimeoutError`` (waiter contract) stay allowed, as do the
+  taxonomy members themselves and anything else typed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import SourceFile, Violation
+
+PASS = "errors"
+
+_BANNED = {"RuntimeError", "Exception", "BaseException"}
+
+#: the serving layer: HTTP handlers, the session driver, the mock engine
+_SCOPE = "reval_tpu/serving/"
+
+
+def _raised_name(node: ast.Raise) -> str | None:
+    exc = node.exc
+    if exc is None:
+        return None                     # bare re-raise
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return None
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, src in sorted(sources.items()):
+        if not rel.replace("\\", "/").startswith(_SCOPE):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Raise):
+                continue
+            name = _raised_name(node)
+            if name in _BANNED:
+                out.append(Violation(
+                    PASS, rel, node.lineno,
+                    f"bare `raise {name}` in the serving path — raise a "
+                    f"serving/errors.py taxonomy member (EngineFailure "
+                    f"wraps untyped engine faults) so the HTTP boundary "
+                    f"maps it to a stable status"))
+    return out

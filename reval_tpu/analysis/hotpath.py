@@ -1,0 +1,101 @@
+"""Hot-path purity pass (``hotpath``).
+
+Functions marked ``# hot-path`` on their ``def`` line run at drive-tick
+cadence — the paged engine's ``_drive_tick``/``_tick``, the
+chunk-processing half, the flight recorder's ``record``, the mock
+engine's tick.  PERF.md prices these in single-digit microseconds; one
+stray ``json.dumps`` or log format in them silently eats the whole
+budget, and a ``time.sleep``/file write turns a 2 µs tick into a stall
+the watchdog has to explain.
+
+The rule is lexical: the body of a hot function (nested defs included —
+they are usually per-tick callbacks) may not CALL a known
+blocking/allocating API: sleeps, file/socket/subprocess IO, json/pickle
+serialisation, ``print``, structured-log emission (``log_event``),
+``logging`` calls, registry rendering (``render_prometheus``/
+``snapshot``), or time formatting.  Exceptional branches that genuinely
+must log (a deadlock raise) carry an inline
+``# lint: allow(hotpath) — <reason>`` and are counted by the driver.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import SourceFile, Violation
+
+PASS = "hotpath"
+
+#: bare-name calls that never belong in a hot path
+_DENY_NAMES = {"open", "print", "input", "breakpoint", "sleep",
+               "log_event"}
+
+#: attribute-call TAILS denied regardless of receiver
+_DENY_TAILS = {"sleep", "render_prometheus", "snapshot", "strftime",
+               "format_exc", "urlopen", "makedirs", "system", "popen"}
+
+#: module roots whose every call is IO/serialisation by construction
+_DENY_MODULES = {"json", "pickle", "subprocess", "urllib", "requests",
+                 "socket", "logging", "shutil"}
+
+
+def _call_chain(func: ast.expr) -> list[str]:
+    """Dotted call chain, outermost first: ``a.b.c(...)`` -> [a, b, c];
+    non-name links truncate the front."""
+    parts: list[str] = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+    return list(reversed(parts))
+
+
+def _denied(chain: list[str]) -> str | None:
+    if not chain:
+        return None
+    name = ".".join(chain)
+    if len(chain) == 1 and chain[0] in _DENY_NAMES:
+        return name
+    if chain[-1] in _DENY_TAILS or chain[-1] in _DENY_NAMES:
+        return name
+    if chain[0] in _DENY_MODULES:
+        return name
+    return None
+
+
+def _check_function(src: SourceFile, node, qual: str,
+                    out: list[Violation]) -> None:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        denied = _denied(_call_chain(sub.func))
+        if denied is not None:
+            out.append(Violation(
+                PASS, src.rel, sub.lineno,
+                f"hot-path function {qual!r} calls blocking/allocating "
+                f"API {denied!r}"))
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, src in sorted(sources.items()):
+        if not rel.startswith("reval_tpu"):
+            continue
+        ann = src.annotations()
+        if not ann.hot:
+            continue
+
+        def walk(body, qual):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fq = f"{qual}.{node.name}" if qual else node.name
+                    if fq in ann.hot:
+                        _check_function(src, node, fq, out)
+                    else:
+                        walk(node.body, fq)
+                elif isinstance(node, ast.ClassDef):
+                    walk(node.body, node.name)
+
+        walk(src.tree.body, "")
+    return out

@@ -1,0 +1,294 @@
+"""Runtime lock sanitizer (``REVAL_TPU_LOCKCHECK=1``) — test-time only.
+
+The static ``locks`` pass proves LEXICAL discipline (guarded accesses
+sit inside the right ``with`` block); what it cannot see is dynamic:
+the ORDER locks are acquired in across call chains (an A→B path in one
+thread plus a B→A path in another is a deadlock waiting for the right
+schedule), and writes that reach a guarded field through an alias or
+helper the annotations never covered.  This module closes that gap at
+test time:
+
+- :class:`SanitizedLock` — a drop-in ``threading.Lock`` stand-in that
+  records, per thread, the stack of held sanitized locks.  Acquiring B
+  while holding A records the edge A→B (keyed by each lock's creation
+  site, with a unique serial per instance); if the REVERSED edge was
+  ever recorded, a ``lock-order-inversion`` violation is logged with
+  both sites.  Detection is by edge set, not by blocking — the planted
+  inversion in the tests is caught on a single thread, no deadlock
+  schedule required.
+- :func:`install` — patches ``threading.Lock`` so every lock created
+  AFTER it (sessions, registries, chaos injectors built inside tests)
+  is sanitized, and audits the annotated serving/obs classes:
+  ``__setattr__`` on a ``# guarded-by:`` field verifies the named lock
+  is held by the writing thread (constructors exempt, matching the
+  static pass).  Guard maps are derived from the SAME annotations the
+  static pass reads — one contract, two enforcement layers.
+- violations accumulate on the sanitizer (never raised mid-test: a
+  sanitizer must not change program behavior); the conftest wiring
+  fails the pytest session if any exist.
+
+Overhead is a couple of dict/list operations per acquire — fine for the
+fast tier, and the whole machinery only exists behind the env flag; no
+production path ever constructs it (PERF.md notes the flag is test-only).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+__all__ = ["SanitizedLock", "LockSanitizer", "install", "uninstall",
+           "audit_class", "audit_module"]
+
+#: the REAL factory, captured before any install() can patch it
+_REAL_LOCK = threading.Lock
+
+
+class SanitizedLock:
+    """``threading.Lock`` wrapper recording acquisition order + owner.
+
+    Implements the full lock protocol (``acquire``/``release``/context
+    manager/``locked``); ``threading.Condition`` works with it through
+    its documented fallbacks (no ``_release_save``/``_is_owned`` needed).
+    """
+
+    __slots__ = ("_lock", "name", "serial", "_owner", "_san")
+
+    def __init__(self, sanitizer: "LockSanitizer", name: str, serial: int):
+        self._lock = _REAL_LOCK()
+        self.name = name
+        self.serial = serial
+        self._owner: int | None = None
+        self._san = sanitizer
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._owner = threading.get_ident()
+            self._san._on_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._san._on_release(self)
+        self._owner = None
+        self._lock.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def _at_fork_reinit(self) -> None:
+        # stdlib locks grow this in 3.9+ and concurrent.futures calls it
+        # at import (os.register_at_fork) — a wrapper without it breaks
+        # `import concurrent.futures` under the sanitizer
+        self._lock._at_fork_reinit()
+        self._owner = None
+
+    def held_by_me(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __repr__(self) -> str:
+        return f"<SanitizedLock {self.name} #{self.serial}>"
+
+
+def _holds(lock) -> bool:
+    """Best-effort "does the current thread hold this lock?" across the
+    lock types a guarded class may own (SanitizedLock, Condition/RLock).
+    Unknown types answer True — the sanitizer must never false-positive
+    on a lock it cannot introspect."""
+    if isinstance(lock, SanitizedLock):
+        return lock.held_by_me()
+    is_owned = getattr(lock, "_is_owned", None)     # Condition / RLock
+    if is_owned is not None:
+        try:
+            return bool(is_owned())
+        except Exception:
+            return True
+    return True
+
+
+class LockSanitizer:
+    """Shared state for a set of sanitized locks: the per-thread held
+    stack, the acquisition-order edge set, and the violation ledger."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._state_lock = _REAL_LOCK()
+        self._serial = 0
+        #: (a_serial, b_serial) -> (a_name, b_name): a held while b taken
+        self._edges: dict[tuple[int, int], tuple[str, str]] = {}
+        self._reported: set = set()
+        self.violations: list[dict] = []
+
+    # -- lock factory ------------------------------------------------------
+    def wrap(self, name: str | None = None) -> SanitizedLock:
+        if name is None:
+            frame = sys._getframe(1)
+            name = (f"{os.path.basename(frame.f_code.co_filename)}:"
+                    f"{frame.f_lineno}")
+        with self._state_lock:
+            self._serial += 1
+            serial = self._serial
+        return SanitizedLock(self, name, serial)
+
+    # -- order tracking ----------------------------------------------------
+    def _held_stack(self) -> list[SanitizedLock]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _on_acquire(self, lock: SanitizedLock) -> None:
+        stack = self._held_stack()
+        if stack:
+            with self._state_lock:
+                for held in stack:
+                    if held.serial == lock.serial:
+                        continue
+                    edge = (held.serial, lock.serial)
+                    self._edges.setdefault(edge, (held.name, lock.name))
+                    rev = (lock.serial, held.serial)
+                    if rev in self._edges and frozenset(edge) not in self._reported:
+                        self._reported.add(frozenset(edge))
+                        self.violations.append({
+                            "kind": "lock-order-inversion",
+                            "a": held.name, "b": lock.name,
+                            "detail": f"{held.name} -> {lock.name} here, "
+                                      f"but {lock.name} -> {held.name} "
+                                      f"was also observed"})
+        stack.append(lock)
+
+    def _on_release(self, lock: SanitizedLock) -> None:
+        stack = self._held_stack()
+        # out-of-order releases are legal for locks; remove by identity
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                break
+
+    # -- guarded-attribute audit ------------------------------------------
+    def record_off_lock_write(self, cls_name: str, attr: str,
+                              lockname: str, caller: str) -> None:
+        self.violations.append({
+            "kind": "off-lock-write",
+            "a": f"{cls_name}.{attr}", "b": lockname,
+            "detail": f"{caller}() wrote {cls_name}.{attr} without "
+                      f"holding {lockname}"})
+
+
+def audit_class(cls, guarded: dict[str, str],
+                sanitizer: LockSanitizer):
+    """Wrap ``cls.__setattr__``: writing a guarded attribute without the
+    named lock held logs an ``off-lock-write`` violation.  Constructor
+    writes are exempt (the static pass's rule); the lock attribute not
+    existing yet (mid-``__init__`` ordering) also exempts.  Returns an
+    undo callable."""
+    orig = cls.__setattr__
+
+    def checked_setattr(self, attr, value):
+        lockname = guarded.get(attr)
+        if lockname is not None:
+            lock = getattr(self, lockname, None)
+            if lock is not None and not _holds(lock):
+                caller = sys._getframe(1).f_code.co_name
+                if caller not in ("__init__", "__post_init__"):
+                    sanitizer.record_off_lock_write(
+                        cls.__name__, attr, lockname, caller)
+        orig(self, attr, value)
+
+    cls.__setattr__ = checked_setattr
+
+    def undo():
+        cls.__setattr__ = orig
+
+    return undo
+
+
+def _module_guard_maps(module) -> dict[str, dict[str, str]]:
+    """class name -> {field: lock} derived from the module's static
+    ``# guarded-by:`` annotations (writes-only guards included — writes
+    always need the lock; ``# unguarded`` fields are skipped)."""
+    import inspect
+
+    from .core import SourceFile
+
+    try:
+        path = inspect.getsourcefile(module)
+        with open(path) as f:
+            src = SourceFile(path, os.path.basename(path), f.read())
+    except (OSError, TypeError, SyntaxError):
+        return {}
+    out: dict[str, dict[str, str]] = {}
+    for name, spec in src.annotations().guards.items():
+        owner = spec.owner
+        if owner == "<module>" or "." in owner or owner[:1].islower():
+            continue                    # module/function-local guards
+        out.setdefault(owner, {})[name] = spec.lock
+    return out
+
+
+def audit_module(module, sanitizer: LockSanitizer) -> list:
+    """Audit every annotated class of ``module``; returns undo callables."""
+    undos = []
+    for cls_name, guarded in _module_guard_maps(module).items():
+        cls = getattr(module, cls_name, None)
+        if cls is not None and isinstance(cls, type):
+            undos.append(audit_class(cls, guarded, sanitizer))
+    return undos
+
+
+#: modules whose annotated classes the conftest wiring audits — the
+#: threaded serving/obs surface (dp_paged would drag jax in; its shared
+#: state is function-local and covered by the static pass)
+AUDIT_MODULES = (
+    "reval_tpu.serving.session",
+    "reval_tpu.serving.server",
+    "reval_tpu.obs.metrics",
+    "reval_tpu.obs.trace",
+    "reval_tpu.resilience.chaos",
+)
+
+_installed: dict | None = None
+
+
+def install(audit: bool = True) -> LockSanitizer:
+    """Patch ``threading.Lock`` with the sanitizing factory and (with
+    ``audit=True``) wrap the annotated classes' ``__setattr__``.
+    Idempotent per process; returns the active sanitizer."""
+    global _installed
+    if _installed is not None:
+        return _installed["sanitizer"]
+    sanitizer = LockSanitizer()
+
+    def make_lock():
+        frame = sys._getframe(1)
+        name = (f"{os.path.basename(frame.f_code.co_filename)}:"
+                f"{frame.f_lineno}")
+        return sanitizer.wrap(name)
+
+    threading.Lock = make_lock
+    undos = []
+    if audit:
+        import importlib
+
+        for mod_name in AUDIT_MODULES:
+            undos.extend(audit_module(importlib.import_module(mod_name),
+                                      sanitizer))
+    _installed = {"sanitizer": sanitizer, "undos": undos}
+    return sanitizer
+
+
+def uninstall() -> None:
+    global _installed
+    if _installed is None:
+        return
+    threading.Lock = _REAL_LOCK
+    for undo in _installed["undos"]:
+        undo()
+    _installed = None

@@ -1,0 +1,238 @@
+"""Lock-discipline / race detector pass (``locks``).
+
+The contract is declared where the state lives: a shared field carries
+``# guarded-by: <lock>`` (optionally ``(writes)`` when lock-free reads
+are deliberate) on its initialising assignment, a deliberately lock-free
+field carries ``# unguarded: <why>``, and a helper that is only ever
+called with a lock already held carries ``# lock-held: <lock>`` on its
+``def`` line.  This pass then enforces, lexically, over every function
+in the file:
+
+1. every read/write of a guarded field is inside a ``with <base>.<lock>``
+   block whose BASE expression matches the access (``self._inflight``
+   under ``with self._acct_lock``, ``other._metrics`` under ``with
+   other._lock``), or inside a method declared lock-held for that lock;
+2. a declared guard names a lock that actually exists in its module
+   (a typo'd lock name is a silent no-op contract otherwise);
+3. every lock-owning class classifies its shared mutable containers:
+   each ``self.x = {}/[]/set()/deque()`` in ``__init__`` must be either
+   ``# guarded-by:`` one of the module's locks or explicitly
+   ``# unguarded: <reason>`` — unclassified shared mutable state in a
+   threaded class is exactly how the next data race ships.
+
+``__init__``/``__post_init__`` bodies are exempt from (1) for ``self``
+accesses (construction happens before publication), module-level
+statements run under the import lock and are likewise exempt, nested
+function bodies reset the held set (they execute later, outside the
+enclosing ``with``), and the declaring line itself never violates.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (_LOCKHELD_RE, _target_name, GuardSpec, SourceFile,
+                   Violation)
+
+PASS = "locks"
+
+#: constructors whose result is shared-mutable enough to demand a
+#: guarded-by / unguarded classification in lock-owning classes
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter"}
+
+_CTOR_NAMES = ("__init__", "__post_init__")
+
+
+def _is_function_owner(spec: GuardSpec) -> bool:
+    """Guards declared on plain names inside a function body (dp_paged's
+    local work queue) vs class fields / module globals."""
+    return spec.owner != "<module>" and ("." in spec.owner
+                                         or spec.owner[:1].islower())
+
+
+def _mutable_value(value: ast.expr | None) -> bool:
+    if value is None:
+        return False
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.BinOp):       # [None] * n
+        return _mutable_value(value.left) or _mutable_value(value.right)
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _decl_held(src: SourceFile, node) -> list[tuple[str, str]]:
+    """Initial held set from a ``# lock-held: L`` def annotation (the
+    caller holds SELF's lock; that is the only sane contract here)."""
+    for _, comment in src.comment_block(node.lineno):
+        m = _LOCKHELD_RE.search(comment)
+        if m:
+            return [("self", m.group(1))]
+    return []
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Walk ONE function body tracking which (base, lock) pairs are
+    lexically held."""
+
+    def __init__(self, src: SourceFile, attr_guards: dict[str, GuardSpec],
+                 name_guards: dict[str, GuardSpec], lock_names: set[str],
+                 out: list[Violation], initial_held, exempt_self: bool):
+        self.src = src
+        self.attr_guards = attr_guards
+        self.name_guards = name_guards
+        self.lock_names = lock_names
+        self.out = out
+        self.held: list[tuple[str, str]] = list(initial_held)
+        self.exempt_self = exempt_self
+
+    # -- lock scopes -------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.attr in self.lock_names):
+                acquired.append((expr.value.id, expr.attr))
+            elif isinstance(expr, ast.Name) and expr.id in self.lock_names:
+                acquired.append(("", expr.id))
+        self.held.extend(acquired)
+        for sub in node.body:
+            self.visit(sub)
+        for _ in acquired:
+            self.held.pop()
+
+    def _nested(self, node) -> None:
+        """A nested def's body runs LATER — fresh held set (its own
+        ``# lock-held`` annotation, if any, still applies)."""
+        sub = _FunctionChecker(self.src, self.attr_guards, self.name_guards,
+                               self.lock_names, self.out,
+                               _decl_held(self.src, node), exempt_self=False)
+        for stmt in node.body:
+            sub.visit(stmt)
+
+    def visit_FunctionDef(self, node) -> None:
+        self._nested(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass                # executes later; treated like a nested def
+
+    # -- guarded accesses --------------------------------------------------
+    def _flag(self, node, name: str, base: str, spec: GuardSpec) -> None:
+        verb = "read" if isinstance(node.ctx, ast.Load) else "write"
+        dotted = f"{base}.{name}" if base else name
+        lock = f"{base}.{spec.lock}" if base else spec.lock
+        self.out.append(Violation(
+            PASS, self.src.rel, node.lineno,
+            f"{verb} of {dotted} (guarded-by {spec.lock!r}) outside "
+            f"`with {lock}`"))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+        spec = self.attr_guards.get(node.attr)
+        if spec is None or not isinstance(node.value, ast.Name):
+            return
+        base = node.value.id
+        if spec.writes_only and isinstance(node.ctx, ast.Load):
+            return
+        if node.lineno == spec.line:
+            return                      # the declaring assignment
+        if self.exempt_self and base == "self":
+            return                      # constructor: pre-publication
+        if (base, spec.lock) not in self.held:
+            self._flag(node, node.attr, base, spec)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        spec = self.name_guards.get(node.id)
+        if spec is None:
+            return
+        if spec.writes_only and isinstance(node.ctx, ast.Load):
+            return
+        if node.lineno == spec.line:
+            return
+        if ("", spec.lock) not in self.held:
+            self._flag(node, node.id, "", spec)
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, src in sorted(sources.items()):
+        if not rel.startswith("reval_tpu"):
+            continue
+        ann = src.annotations()
+        for line, problem in ann.problems:
+            out.append(Violation(PASS, rel, line, problem))
+        if not ann.guards and not ann.locks:
+            continue
+        lock_names: set[str] = set()
+        for names in ann.locks.values():
+            lock_names |= names
+        for spec in ann.guards.values():
+            if spec.lock not in lock_names:
+                out.append(Violation(
+                    PASS, rel, spec.line,
+                    f"field {spec.fieldname!r} declared guarded-by "
+                    f"{spec.lock!r}, but no such lock is created in this "
+                    f"module (typo?)"))
+        attr_guards = {n: s for n, s in ann.guards.items()
+                       if not _is_function_owner(s) and s.owner != "<module>"}
+        name_guards = {n: s for n, s in ann.guards.items()
+                       if _is_function_owner(s) or s.owner == "<module>"}
+        out.extend(_check_containers(src, ann))
+        _walk_functions(src, src.tree.body, attr_guards, name_guards,
+                        lock_names, out)
+    return out
+
+
+def _check_containers(src: SourceFile, ann) -> list[Violation]:
+    out: list[Violation] = []
+    for node in src.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not ann.locks.get(node.name):
+            continue
+        ctor = next((n for n in node.body if isinstance(n, ast.FunctionDef)
+                     and n.name in _CTOR_NAMES), None)
+        if ctor is None:
+            continue
+        for stmt in ast.walk(ctor):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            name, is_self = _target_name(stmt)
+            if not is_self or name is None:
+                continue
+            if not _mutable_value(getattr(stmt, "value", None)):
+                continue
+            if name in ann.guards or name in ann.unguarded:
+                continue
+            out.append(Violation(
+                PASS, src.rel, stmt.lineno,
+                f"class {node.name} owns a lock but its shared mutable "
+                f"attribute {name!r} is neither '# guarded-by: <lock>' "
+                f"nor '# unguarded: <reason>'"))
+    return out
+
+
+def _walk_functions(src, body, attr_guards, name_guards, lock_names,
+                    out) -> None:
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            checker = _FunctionChecker(
+                src, attr_guards, name_guards, lock_names, out,
+                _decl_held(src, node),
+                exempt_self=node.name in _CTOR_NAMES)
+            for sub in node.body:
+                checker.visit(sub)
+        elif isinstance(node, ast.ClassDef):
+            _walk_functions(src, node.body, attr_guards, name_guards,
+                            lock_names, out)

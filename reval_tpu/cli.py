@@ -671,6 +671,13 @@ def main(argv: list[str] | None = None) -> int:
         from .watch import run_watch
 
         return run_watch(argv[1:])
+    if argv and argv[0] == "lint":
+        # the codebase-native static analysis suite (analysis/):
+        # lock discipline, hot-path purity, typed-error boundary,
+        # env registry, metric/event namespaces
+        from .analysis.driver import main as lint_main
+
+        return lint_main(argv[1:])
     if argv and argv[0] == "analyze":
         return run_analyze(argv[1:])
     if argv and argv[0] == "taskgen":

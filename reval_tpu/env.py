@@ -1,0 +1,183 @@
+"""Declared ``REVAL_TPU_*`` environment-variable namespace.
+
+The metrics registry (``obs/metrics.py::METRICS``) and the structured-log
+events (``obs/logging.py::EVENTS``) each declare their namespace ONCE and
+lint call sites against it.  Env vars were the last config surface
+without that discipline: knobs accreted per module (`os.environ.get`
+scattered through eight files), so a typo'd name read as "unset" forever
+and the README's knob documentation drifted silently — exactly the
+backend-invariant rot *The Silent Hyperparameter* (arxiv 2605.19537)
+warns turns into corrupted eval results.
+
+:data:`ENV` is the one declaration: every ``REVAL_TPU_*`` variable the
+tree reads, with its default and one-line meaning.  Runtime reads go
+through the typed accessors below (:func:`env_str` / :func:`env_int` /
+:func:`env_float` / :func:`env_flag`), which raise ``KeyError`` on an
+undeclared name — a typo fails loudly at the read site instead of
+silently returning the default.  The static side is the ``env`` lint
+pass (``reval_tpu/analysis/envreg.py``): no raw ``os.environ[...]`` /
+``getenv`` read of a ``REVAL_TPU_*`` literal may appear in ``reval_tpu/``
+outside this module, every routed name must be declared here, and this
+spec round-trips against the README environment table in both
+directions.
+
+Reads stay LAZY (each accessor hits ``os.environ`` at call time), so
+test fixtures that ``monkeypatch.setenv`` keep working unchanged; the
+handful of import-time reads (e.g. the deadline-storm threshold) keep
+their historical timing at their call sites.
+
+Writes (``os.environ["REVAL_TPU_X"] = ...``) are out of scope: tools and
+benches legitimately *set* knobs for downstream readers and subprocesses.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["ENV", "env_raw", "env_str", "env_int", "env_float", "env_flag"]
+
+#: falsy spellings for boolean knobs (the historical convention every
+#: flag in the tree already used — keep them in one place)
+_OFF = ("0", "false", "off")
+
+#: The canonical env namespace: name -> {"default", "help"}.  ``default``
+#: is the DOCUMENTED default (what an unset variable behaves like);
+#: ``help``/``default`` are documentation the README table paraphrases.
+#: The ``env`` lint pass round-trips the NAMES against that table in
+#: both directions (defaults/meanings are prose, not machine-checked).
+ENV: dict[str, dict] = {
+    # -- kernel / backend selection (ops/pallas_attention.py) -------------
+    "REVAL_TPU_PAGED_BACKEND": {
+        "default": "autotune",
+        "help": "decode-attention kernel: pallas | pallas_seq | xla "
+                "(default: the persisted autotune decision, else pallas "
+                "on TPU / xla elsewhere)"},
+    "REVAL_TPU_KERNEL_DOT": {
+        "default": "swap",
+        "help": "Pallas decode-kernel dot mode: swap | wide"},
+    "REVAL_TPU_FORCE_MOSAIC": {
+        "default": "0",
+        "help": "force compiled (non-interpret) Pallas lowering even "
+                "off-TPU — AOT capture tooling"},
+    "REVAL_TPU_AUTOTUNE_FILE": {
+        "default": "tpu_watch/autotune.json",
+        "help": "path of the persisted autotune decision consulted for "
+                "kernel defaults"},
+    # -- engine ------------------------------------------------------------
+    "REVAL_TPU_PIPELINE": {
+        "default": "1",
+        "help": "one-deep decode-chunk pipelining (0 disables — the A/B)"},
+    "REVAL_TPU_PROFILE": {
+        "default": "",
+        "help": "when set to a directory, each generate() writes a "
+                "jax.profiler trace into it"},
+    # -- observability -----------------------------------------------------
+    "REVAL_TPU_OBS": {
+        "default": "1",
+        "help": "latency-histogram observation (0 disables; counters "
+                "stay on — bench --no-obs sets this)"},
+    "REVAL_TPU_FLIGHTREC": {
+        "default": "1",
+        "help": "per-tick flight-recorder ring (0 disables — the A/B)"},
+    "REVAL_TPU_POSTMORTEM_DIR": {
+        "default": "tpu_watch",
+        "help": "where crash-dump postmortem bundles land"},
+    "REVAL_TPU_LOG_LEVEL": {
+        "default": "info",
+        "help": "structured-log emission floor: debug | info | warning "
+                "| error"},
+    "REVAL_TPU_LOG": {
+        "default": "1",
+        "help": "structured-log stderr emission (0 silences; the "
+                "in-process ring still records)"},
+    # -- serving lifecycle (serving/session.py) ----------------------------
+    "REVAL_TPU_MAX_QUEUED_TOKENS": {
+        "default": "0",
+        "help": "admission-control watermark in pending prompt tokens "
+                "(0 = 4 x slots x max_seq_len)"},
+    "REVAL_TPU_WATCHDOG_S": {
+        "default": "120",
+        "help": "no-progress watchdog threshold in seconds (0 disables)"},
+    "REVAL_TPU_DEADLINE_STORM": {
+        "default": "3",
+        "help": "deadline expiries in one driver sweep that trigger a "
+                "postmortem bundle"},
+    # -- multi-host rig (parallel/distributed.py) --------------------------
+    "REVAL_TPU_COORDINATOR": {
+        "default": "",
+        "help": "jax.distributed coordinator address for manual "
+                "multi-host launches"},
+    "REVAL_TPU_NUM_PROCESSES": {
+        "default": "",
+        "help": "jax.distributed process count for manual multi-host "
+                "launches"},
+    "REVAL_TPU_PROCESS_ID": {
+        "default": "",
+        "help": "this host's jax.distributed process id for manual "
+                "multi-host launches"},
+    # -- tools / bench / tests ---------------------------------------------
+    "REVAL_TPU_TOKENIZER": {
+        "default": "",
+        "help": "tokenizer dir (or tokenizer.json) bench.py prefers over "
+                "cached HF snapshots"},
+    "REVAL_TPU_DRYRUN_34B": {
+        "default": "0",
+        "help": "opt into the ~17 GB 34B-shape dryrun (graft entry + "
+                "test_northstar_34b)"},
+    "REVAL_TPU_DRYRUN_70B": {
+        "default": "0",
+        "help": "opt into the 70B-shape sharded-compile dryrun"},
+    "REVAL_TPU_LOCKCHECK": {
+        "default": "0",
+        "help": "1 = run tests under the runtime lock sanitizer "
+                "(acquisition-order inversions, off-lock guarded writes "
+                "— analysis/lockcheck.py; test-only, never in prod "
+                "paths)"},
+}
+
+
+def _spec(name: str) -> dict:
+    spec = ENV.get(name)
+    if spec is None:
+        raise KeyError(
+            f"env var {name!r} is not declared in reval_tpu.env.ENV — "
+            f"declare it there (and in the README environment table) first")
+    return spec
+
+
+def env_raw(name: str) -> str | None:
+    """The raw value, or None when unset.  ``name`` must be declared."""
+    _spec(name)
+    return os.environ.get(name)
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    """String knob: the set value, else ``default`` exactly as given
+    (callers keep their own ``or``-chains for empty-string semantics)."""
+    value = env_raw(name)
+    return value if value is not None else default
+
+
+def env_int(name: str, default: int | None = None) -> int | None:
+    """Integer knob; unset OR empty falls back to ``default``."""
+    value = env_raw(name)
+    if value is None or value == "":
+        return default
+    return int(value)
+
+
+def env_float(name: str, default: float | None = None) -> float | None:
+    """Float knob; unset OR empty falls back to ``default``."""
+    value = env_raw(name)
+    if value is None or value == "":
+        return default
+    return float(value)
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """Boolean knob with the tree's historical falsy spellings
+    (``0``/``false``/``off``, case-insensitive)."""
+    value = env_raw(name)
+    if value is None:
+        return default
+    return value.lower() not in _OFF

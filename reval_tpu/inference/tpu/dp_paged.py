@@ -138,8 +138,10 @@ class DataParallelPagedEngine:
         # prefills early keeps that tail a short prompt, not a long one
         order = sorted(range(len(prompts)),
                        key=lambda i: len(encoded[i]), reverse=True)
-        work = deque(order)
+        work = deque(order)             # guarded-by: lock
         lock = threading.Lock()
+        # unguarded: replicas write DISJOINT indices (each prompt is pulled
+        # by exactly one replica); futures_wait publishes before the read
         out: list[str] = [""] * len(prompts)
 
         # one call-level key set shared by every replica: request i samples

@@ -58,7 +58,9 @@ def profile_trace():
     call under the flag writes one trace into the directory; inspect with
     TensorBoard or ``jax.profiler`` tooling.  Without the flag this is a
     no-op nullcontext — zero cost on the hot path."""
-    trace_dir = os.environ.get("REVAL_TPU_PROFILE")
+    from ...env import env_str
+
+    trace_dir = env_str("REVAL_TPU_PROFILE")
     if not trace_dir:
         return contextlib.nullcontext()
     return jax.profiler.trace(trace_dir)
@@ -222,12 +224,11 @@ class EngineStats:
     on."""
 
     def __init__(self, registry=None):
+        from ...env import env_flag
         from ...obs.metrics import MetricsRegistry
 
         if registry is None:
-            enabled = (os.environ.get("REVAL_TPU_OBS", "1").lower()
-                       not in ("0", "false", "off"))
-            registry = MetricsRegistry(enabled=enabled)
+            registry = MetricsRegistry(enabled=env_flag("REVAL_TPU_OBS", True))
         self.registry = registry
         for _, metric, _ in _STAT_FIELDS:
             registry.counter(metric)

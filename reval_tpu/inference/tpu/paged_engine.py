@@ -35,7 +35,6 @@ parallelism for paged decode is one engine replica per host/dp-group
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -44,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...env import env_flag
 from ...models import (
     ModelConfig,
     init_kv_cache,
@@ -202,8 +202,7 @@ class PagedTPUEngine:
         self.page_size = page_size
         self.prefix_sharing = prefix_sharing
         if pipeline is None:
-            pipeline = os.environ.get(
-                "REVAL_TPU_PIPELINE", "1").lower() not in ("0", "false", "off")
+            pipeline = env_flag("REVAL_TPU_PIPELINE", True)
         self.pipeline = bool(pipeline)
         self.max_pages_per_seq = max_seq_len // page_size
         if memory_utilization is not None and not (0.0 < memory_utilization <= 1.0):
@@ -616,7 +615,8 @@ class PagedTPUEngine:
         # loop cannot exit with one in flight; drain as a safety net
         self._process_pending(reqs, st)
 
-    def _drive_tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
+    def _drive_tick(self, reqs: dict[int, _Request],  # hot-path
+                    st: _DriveState) -> None:
         """One engine step (see :meth:`_tick`), timed into the
         ``reval_engine_step_seconds`` histogram — the per-step half of
         the measurement loop (FlashInfer-Bench's point: scheduler and
@@ -648,7 +648,7 @@ class PagedTPUEngine:
                     time.monotonic() - self.heartbeat,
                     tuple(st.active.values()))
 
-    def _tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:
+    def _tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:  # hot-path
         """ONE admission + prefill + decode-chunk round over ``reqs``.
 
         Loop state (tables, lens, pending token, per-slot temperature)
@@ -710,6 +710,8 @@ class PagedTPUEngine:
                     req.notify(req)
         if not st.active:
             if any(not r.done for r in reqs.values()):
+                # lint: allow(hotpath) — the deadlock raise is the tick's
+                # terminal path; the steady-state loop never reaches it
                 log_event("engine.deadlock", level="error",
                           waiting=self.rt.num_waiting,
                           free_pages=self.rt.free_pages)
@@ -889,14 +891,14 @@ class PagedTPUEngine:
                                        self._dev(jnp.asarray(tables)))
         self.stats.patched_tables += 1
 
-    def _process_pending(self, reqs: dict[int, _Request],
+    def _process_pending(self, reqs: dict[int, _Request],  # hot-path
                          st: _DriveState) -> None:
         chunk, st.pending = st.pending, None
         if chunk is not None:
             self._process_chunk(reqs, st, chunk)
 
-    def _process_chunk(self, reqs: dict[int, _Request], st: _DriveState,
-                       chunk: tuple) -> None:
+    def _process_chunk(self, reqs: dict[int, _Request],  # hot-path
+                       st: _DriveState, chunk: tuple) -> None:
         """Host half of a dispatched chunk: fetch tokens, append,
         stop-scan, retire, notify.  In pipelined mode this runs one chunk
         behind dispatch; a sequence retired here may have one further

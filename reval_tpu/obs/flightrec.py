@@ -42,6 +42,8 @@ import os
 import sys
 import time
 
+from ..env import env_flag, env_str
+
 __all__ = ["CAPACITY", "KEEP", "FIELDS", "FlightRecorder",
            "PostmortemWriter", "build_bundle", "env_fingerprint"]
 
@@ -79,14 +81,13 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = CAPACITY, enabled: bool | None = None):
         if enabled is None:
-            enabled = os.environ.get("REVAL_TPU_FLIGHTREC", "1").lower() \
-                not in ("0", "false", "off")
+            enabled = env_flag("REVAL_TPU_FLIGHTREC", True)
         self.capacity = int(capacity)
         self.enabled = bool(enabled)
         self.total = 0                       # records ever written
         self._buf: list = [None] * self.capacity
 
-    def record(self, running: int, queued: int, free_pages: int,
+    def record(self, running: int, queued: int, free_pages: int,  # hot-path
                cached_pages: int, pinned_pages: int, prefix_hit_tokens: int,
                chunk_steps: int, step_s: float, hb_age: float,
                seq_ids: tuple) -> None:
@@ -189,7 +190,7 @@ class PostmortemWriter:
     def __init__(self, directory: str | None = None, keep: int = KEEP,
                  min_interval_s: float = 2.0):
         self.directory = (directory
-                          or os.environ.get("REVAL_TPU_POSTMORTEM_DIR")
+                          or env_str("REVAL_TPU_POSTMORTEM_DIR")
                           or "tpu_watch")
         self.keep = int(keep)
         self.min_interval_s = float(min_interval_s)

@@ -42,10 +42,11 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import threading
 import time
 from collections import deque
+
+from ..env import env_flag, env_str
 
 __all__ = ["EVENTS", "RING_CAPACITY", "log_event", "recent"]
 
@@ -86,9 +87,11 @@ RING_CAPACITY = 512
 _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "warning": logging.WARNING, "error": logging.ERROR}
 
-_ring: deque = deque(maxlen=RING_CAPACITY)
+_ring: deque = deque(maxlen=RING_CAPACITY)      # guarded-by: _ring_lock
 _ring_lock = threading.Lock()
 _logger = logging.getLogger("reval_tpu.events")
+# unguarded: worst case two racing first calls both configure the (idempotent)
+# sink; the handler-presence check keeps it single
 _configured = False
 
 
@@ -103,10 +106,9 @@ def _ensure_sink() -> logging.Logger:
             handler.setFormatter(logging.Formatter("%(message)s"))
             _logger.addHandler(handler)
         _logger.propagate = False
-        level = os.environ.get("REVAL_TPU_LOG_LEVEL", "info").lower()
+        level = env_str("REVAL_TPU_LOG_LEVEL", "info").lower()
         _logger.setLevel(_LEVELS.get(level, logging.INFO))
-        if os.environ.get("REVAL_TPU_LOG", "1").lower() in ("0", "false",
-                                                            "off"):
+        if not env_flag("REVAL_TPU_LOG", True):
             _logger.setLevel(logging.CRITICAL + 1)
         _configured = True
     return _logger

@@ -153,6 +153,9 @@ class Counter:
 
     def __init__(self, name: str):
         self.name = name
+        # guarded-by: _lock (writes) — the ``value`` read is deliberately
+        # lock-free: a float load is atomic under the GIL, and a scrape
+        # racing an ``add`` may see either side of it
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -178,8 +181,8 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._value = 0.0
-        self.updated = False
+        self._value = 0.0       # guarded-by: _lock (writes)
+        self.updated = False    # guarded-by: _lock (writes)
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
@@ -204,9 +207,12 @@ class Histogram:
         assert buckets == tuple(sorted(buckets)), "bucket bounds must ascend"
         self.name = name
         self.buckets = tuple(float(b) for b in buckets)
+        # guarded-by: _lock — reads go through _read() so a merge/render
+        # racing an observe never sees a count that disagrees with its
+        # buckets (counts is mutated in place, sum/count alongside)
         self.counts = [0] * (len(self.buckets) + 1)   # +1: the +Inf bucket
-        self.sum = 0.0
-        self.count = 0
+        self.sum = 0.0          # guarded-by: _lock
+        self.count = 0          # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _bucket_index(self, v: float) -> int:
@@ -320,7 +326,7 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = True, strict: bool = True):
         self.enabled = enabled
         self.strict = strict
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- registration ------------------------------------------------------

@@ -52,10 +52,11 @@ class Tracer:
 
     def __init__(self, max_events: int = MAX_EVENTS):
         self._lock = threading.Lock()
-        self._events: list[dict] = []
-        self._tids: dict[str, int] = {}
-        self._serial = 0
+        self._events: list[dict] = []   # guarded-by: _lock
+        self._tids: dict[str, int] = {}  # guarded-by: _lock
+        self._serial = 0                # guarded-by: _lock
         self.max_events = int(max_events)
+        # guarded-by: _lock (writes) — save()/bundles read the count racily
         self.dropped = 0
 
     def _append(self, event: dict) -> bool:

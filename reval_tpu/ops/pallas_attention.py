@@ -75,7 +75,9 @@ def _autotune_defaults() -> dict:
     import json
     import os
 
-    path = os.environ.get("REVAL_TPU_AUTOTUNE_FILE") or os.path.join(
+    from ..env import env_str
+
+    path = env_str("REVAL_TPU_AUTOTUNE_FILE") or os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))), "tpu_watch", "autotune.json")
     if path not in _AUTOTUNE_CACHE:
@@ -621,9 +623,9 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     the driver's official bench and any engine user run the winning
     config without a live session flipping constants.
     """
-    import os
+    from ..env import env_str
 
-    choice = (os.environ.get("REVAL_TPU_PAGED_BACKEND")
+    choice = (env_str("REVAL_TPU_PAGED_BACKEND")
               or _autotune_defaults().get("REVAL_TPU_PAGED_BACKEND"))
     if choice not in (None, "", "pallas", "pallas_seq", "xla"):
         # a typo here would silently bench the wrong backend under the
@@ -642,10 +644,10 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         # an explicitly-chosen Pallas kernel off-TPU runs in interpret
         # mode: slow, but it lets the whole engine path execute the real
         # kernel on CPU (end-to-end validation without a chip)
-        force = os.environ.get("REVAL_TPU_FORCE_MOSAIC", "").lower()
+        force = (env_str("REVAL_TPU_FORCE_MOSAIC") or "").lower()
         kw["interpret"] = (jax.default_backend() != "tpu"
                            and force not in ("1", "true"))
-        dot = (os.environ.get("REVAL_TPU_KERNEL_DOT")
+        dot = (env_str("REVAL_TPU_KERNEL_DOT")
                or _autotune_defaults().get("REVAL_TPU_KERNEL_DOT") or "swap")
         if dot not in ("swap", "wide"):
             raise ValueError(f"unknown REVAL_TPU_KERNEL_DOT {dot!r}; "

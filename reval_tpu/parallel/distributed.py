@@ -59,22 +59,23 @@ def ensure_initialized(coordinator_address: str | None = None,
     global _initialized
     if _initialized:
         return
-    import os
-
     import jax
+
+    from ..env import env_int, env_str
 
     if jax.distributed.is_initialized():
         # the embedding process brought up jax.distributed before calling
         # us — a second initialize() would raise; their topology stands
         _initialized = True
         return
-    # each field resolves independently: explicit argument, then env
+    # each field resolves independently: explicit argument, then the
+    # declared env rig (reval_tpu/env.py)
     if coordinator_address is None:
-        coordinator_address = os.environ.get("REVAL_TPU_COORDINATOR")
-    if num_processes is None and os.environ.get("REVAL_TPU_NUM_PROCESSES"):
-        num_processes = int(os.environ["REVAL_TPU_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("REVAL_TPU_PROCESS_ID"):
-        process_id = int(os.environ["REVAL_TPU_PROCESS_ID"])
+        coordinator_address = env_str("REVAL_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = env_int("REVAL_TPU_NUM_PROCESSES")
+    if process_id is None:
+        process_id = env_int("REVAL_TPU_PROCESS_ID")
     if num_processes == 1:
         _initialized = True
         return

@@ -73,7 +73,8 @@ class EngineStepChaos:
         self.stall_s = float(stall_s)
         self.max_faults = max_faults
         self.sleep = sleep
-        self.steps = 0
+        self.steps = 0                  # guarded-by: _lock
+        # guarded-by: _lock (writes) — callers read the ledger after joining
         self.injected: list[tuple[str, int]] = []   # (mode, step ordinal)
         # a MultiSession shares one injector across replica drivers: the
         # ordinal/ledger must not tear (the stall/raise happens OUTSIDE

@@ -19,15 +19,23 @@ only its request id leaves the server.
 - :class:`DeadlineExceeded` — the request's client-supplied budget ran
   out mid-service (504); the engine-side sequence was cancelled and its
   pages/prefix pins freed.
+- :class:`EngineFailure` — an engine/driver fault surfaced through a
+  pending handle (500).  The message is the UNDERLYING exception's text
+  (engine internals, device paths) and therefore ``wire_safe = False``:
+  the HTTP boundary logs it and puts only the stable code + request id
+  on the wire, exactly like any other unexpected 500.
 
 All subclass ``RuntimeError`` so pre-existing callers that caught the
-untyped failures keep working.
+untyped failures keep working.  The typed-error lint pass
+(``reval_tpu/analysis/errboundary.py``) enforces that the serving layer
+raises nothing outside this taxonomy (plus client-error ``ValueError``
+and waiter ``TimeoutError``).
 """
 
 from __future__ import annotations
 
 __all__ = ["ServingError", "Overloaded", "Draining", "EngineWedged",
-           "DeadlineExceeded"]
+           "DeadlineExceeded", "EngineFailure"]
 
 
 class ServingError(RuntimeError):
@@ -35,6 +43,10 @@ class ServingError(RuntimeError):
 
     status: int = 500
     code: str = "serving_error"
+    #: True = the message was authored by the serving layer and may go on
+    #: the wire verbatim; False = it carries engine internals, so the
+    #: HTTP boundary must log it and send a sanitized body instead
+    wire_safe: bool = True
 
     def __init__(self, message: str, *, retry_after: float | None = None):
         super().__init__(message)
@@ -66,3 +78,14 @@ class EngineWedged(ServingError):
 class DeadlineExceeded(ServingError):
     status = 504
     code = "deadline_exceeded"
+
+
+class EngineFailure(ServingError):
+    """Typed wrapper for an untyped engine/driver fault: the serving
+    path never re-raises a bare ``RuntimeError``, but the original
+    message (NOT wire-safe — it is whatever the engine raised) is
+    preserved for in-process callers like the fleet's retry loop."""
+
+    status = 500
+    code = "internal_error"
+    wire_safe = False

@@ -82,7 +82,7 @@ class MockStepEngine:
     def close(self) -> None:
         pass
 
-    def _drive_tick(self, reqs: dict, st) -> None:
+    def _drive_tick(self, reqs: dict, st) -> None:   # hot-path
         """One mock decode step: every live request gains up to
         ``tokens_per_step`` tokens of the canned response, then EOS.
         Stamps the same lifecycle fields the paged engine keeps
@@ -92,6 +92,8 @@ class MockStepEngine:
         t0 = time.perf_counter()
         self.heartbeat = time.monotonic()
         if self.step_s:
+            # lint: allow(hotpath) — step_s is the mock's deliberate pacing
+            # knob (deadline/drain tests need a controllable step interval)
             time.sleep(self.step_s)
         now = time.perf_counter()
         for seq_id, req in list(reqs.items()):

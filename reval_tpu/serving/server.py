@@ -108,6 +108,20 @@ def _err(code: str, message: str, request_id: str | None = None) -> dict:
     return {"error": body}
 
 
+def _wire_payload(exc: ServingError, rid: str) -> dict:
+    """Error body for a typed serving failure: the authored message
+    verbatim — or, for wire-UNSAFE taxonomy members (EngineFailure
+    carries whatever the engine raised), a sanitized stand-in with the
+    real text logged server-side.  Every typed-error response (plain and
+    SSE) goes through here, so the no-internals-on-the-wire invariant
+    has exactly one enforcement point."""
+    if exc.wire_safe:
+        return _err(exc.code, str(exc), rid)
+    log_event("server.request_error", level="error", request_id=rid,
+              exc=exc, where="serving")
+    return _err(exc.code, "internal error (see server log)", rid)
+
+
 _RID_RE = re.compile(r"[^A-Za-z0-9._-]")
 
 
@@ -237,13 +251,13 @@ class EngineServer:
         self.drain_timeout_s = float(drain_timeout_s)
         self._draining = threading.Event()
         self._shutdown_lock = threading.Lock()
-        self._shutdown_started = False
+        self._shutdown_started = False      # guarded-by: _shutdown_lock
         self._shutdown_complete = threading.Event()
         # in-flight POST handlers + SSE worker threads, tracked so a
         # graceful drain can wait for them before tearing anything down
         self._inflight_cv = threading.Condition()
-        self._inflight_http = 0
-        self._workers: set[threading.Thread] = set()
+        self._inflight_http = 0             # guarded-by: _inflight_cv
+        self._workers: set[threading.Thread] = set()    # guarded-by: _workers_lock
         self._workers_lock = threading.Lock()
         outer = self
 
@@ -285,7 +299,7 @@ class EngineServer:
                 if exc.retry_after is not None:
                     headers = {"Retry-After":
                                str(int(math.ceil(exc.retry_after)))}
-                self._send(exc.status, _err(exc.code, str(exc), rid), headers,
+                self._send(exc.status, _wire_payload(exc, rid), headers,
                            request_id=rid)
 
             def do_GET(self):
@@ -481,7 +495,7 @@ class EngineServer:
                         for i, t in enumerate(texts):
                             q.put((i, t, "stop"))
                     except ServingError as exc:
-                        q.put(("error", _err(exc.code, str(exc), rid), None))
+                        q.put(("error", _wire_payload(exc, rid), None))
                     except Exception as exc:
                         log_event("server.request_error", level="error",
                                   request_id=rid, exc=exc, where="stream",

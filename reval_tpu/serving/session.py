@@ -67,15 +67,16 @@ Lifecycle hardening (on top of the batching):
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
 
+from ..env import env_float, env_int
 from ..obs.flightrec import PostmortemWriter, build_bundle
 from ..obs.logging import log_event
-from .errors import DeadlineExceeded, Draining, EngineWedged, Overloaded, ServingError
+from .errors import (DeadlineExceeded, Draining, EngineFailure, EngineWedged,
+                     Overloaded, ServingError)
 
 __all__ = ["ContinuousSession", "MultiSession"]
 
@@ -83,21 +84,23 @@ __all__ = ["ContinuousSession", "MultiSession"]
 #: trigger a postmortem bundle (env ``REVAL_TPU_DEADLINE_STORM``) — one
 #: slow request missing its budget is business as usual; a whole batch
 #: expiring together means the engine, not the request, is the story
-DEADLINE_STORM_N = int(os.environ.get("REVAL_TPU_DEADLINE_STORM", "3"))
+DEADLINE_STORM_N = env_int("REVAL_TPU_DEADLINE_STORM", 3)
 
 
 class _Pending:
     """Caller-side handle for one submitted prompt batch."""
 
     def __init__(self, n: int):
+        # unguarded: single writer (the driver) fills slots; readers wait
+        # on the event, which publishes the writes (happens-before)
         self.texts: list[str | None] = [None] * n
         self._remaining = n
         self._event = threading.Event()
         self._error: str | None = None
         self._exc: ServingError | None = None
         self._cb_lock = threading.Lock()
-        self._callbacks: list = []
-        self._fired = False
+        self._callbacks: list = []      # guarded-by: _cb_lock
+        self._fired = False             # guarded-by: _cb_lock
 
     def _fire(self) -> None:
         """Resolve the handle (success or error) exactly once.  Done-
@@ -127,7 +130,10 @@ class _Pending:
         if self._exc is not None:
             raise self._exc
         if self._error is not None:
-            raise RuntimeError(self._error)
+            # typed wrapper for an UNTYPED engine/driver fault: still a
+            # RuntimeError for old callers, but the HTTP boundary sees a
+            # taxonomy member whose message it knows is NOT wire-safe
+            raise EngineFailure(self._error)
         return self.texts  # type: ignore[return-value]
 
     def done(self) -> bool:
@@ -208,8 +214,8 @@ class ContinuousSession:
         #: the driver's live request/origin tables, published by _run so
         #: a postmortem (or /debugz) can read the in-flight lifecycle
         #: stamps — read-only, racy by design (diagnostics, not control)
-        self._driver_reqs: dict = {}
-        self._driver_origin: dict = {}
+        self._driver_reqs: dict = {}        # unguarded: racy diagnostics reads by design
+        self._driver_origin: dict = {}      # unguarded: racy diagnostics reads by design
         #: optional :class:`~reval_tpu.obs.trace.Tracer` — one span tree
         #: per (request id, prompt) at completion; None = zero cost
         self._tracer = tracer
@@ -228,18 +234,20 @@ class ContinuousSession:
                    * getattr(engine, "page_size", 128))
         if max_queued_tokens is None:
             max_queued_tokens = (
-                int(os.environ.get("REVAL_TPU_MAX_QUEUED_TOKENS", 0))
+                env_int("REVAL_TPU_MAX_QUEUED_TOKENS", 0)
                 or 4 * getattr(engine, "max_slots", 8) * max_seq)
         self.max_queued_tokens = int(max_queued_tokens)
         self._acct_lock = threading.Lock()
-        self._queued_tokens = 0
+        self._queued_tokens = 0             # guarded-by: _acct_lock
         #: submissions whose handle has not resolved yet — what the
         #: watchdog fails on a trip (the driver's reqs/origin are locals)
-        self._inflight: set[_Submission] = set()
+        self._inflight: set[_Submission] = set()    # guarded-by: _acct_lock
         # -- watchdog -------------------------------------------------------
         if watchdog_s is None:
-            watchdog_s = float(os.environ.get("REVAL_TPU_WATCHDOG_S", "120"))
+            watchdog_s = env_float("REVAL_TPU_WATCHDOG_S", 120.0)
         self.watchdog_s = max(0.0, float(watchdog_s))
+        # unguarded: one writer (the driver) stamps a monotonic float;
+        # the watchdog's read tolerates any stale-but-well-formed value
         self._heartbeat = time.monotonic()
         self._watch_stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
@@ -309,7 +317,7 @@ class ContinuousSession:
             self._inbox.put(sub)
         return sub.pending
 
-    def _retry_after_locked(self) -> float:
+    def _retry_after_locked(self) -> float:   # lock-held: _acct_lock
         """Retry-After hint under ``_acct_lock``: ~0.5 s per 2k queued
         tokens — rough, but it scales the fleet's backoff with the
         backlog instead of hammering a saturated server."""
@@ -322,7 +330,7 @@ class ContinuousSession:
                 self._queued_tokens -= sub.tokens
                 self._set_queue_gauge()
 
-    def _set_queue_gauge(self) -> None:
+    def _set_queue_gauge(self) -> None:       # lock-held: _acct_lock
         """Mirror the admission backlog into the obs registry (called
         under ``_acct_lock``) so ``/metrics`` and ``/statusz`` expose
         the same number ``/readyz`` decides on."""
@@ -780,6 +788,7 @@ class MultiSession:
                  tracer=None, postmortem_dir: str | None = None):
         # one shared tracer: replica placement is an `args` detail, the
         # span tree is per request id either way
+        # unguarded: built once here, read-only thereafter
         self.sessions = [ContinuousSession(e, autostart=autostart,
                                            max_queued_tokens=max_queued_tokens,
                                            watchdog_s=watchdog_s,
@@ -792,7 +801,7 @@ class MultiSession:
         #: session (replica-level trips use each session's own writer —
         #: same directory, separate per-reason rate windows)
         self._postmortem = PostmortemWriter(postmortem_dir)
-        self._load = [0] * len(self.sessions)
+        self._load = [0] * len(self.sessions)   # guarded-by: _lock
         self._lock = threading.Lock()
 
     def start(self) -> "MultiSession":

@@ -25,6 +25,30 @@ jax.config.update("jax_platforms", "cpu")
 # Repo root on sys.path so `import reval_tpu` works without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime lock sanitizer (REVAL_TPU_LOCKCHECK=1): every threading.Lock
+# created after this point records acquisition order (lock-order
+# inversions) and the annotated serving/obs classes verify guarded-field
+# writes happen lock-held.  Violations accumulate silently and fail the
+# session at the end — a sanitizer must never change test behavior.
+_LOCK_SANITIZER = None
+# same falsy convention as reval_tpu.env.env_flag (default off when unset)
+if os.environ.get("REVAL_TPU_LOCKCHECK", "0").lower() not in ("0", "false",
+                                                              "off"):
+    from reval_tpu.analysis import lockcheck as _lockcheck  # noqa: E402
+
+    _LOCK_SANITIZER = _lockcheck.install(audit=True)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _LOCK_SANITIZER is None or not _LOCK_SANITIZER.violations:
+        return
+    import sys as _sys
+
+    print("\nlockcheck: runtime lock-sanitizer violations:", file=_sys.stderr)
+    for v in _LOCK_SANITIZER.violations:
+        print(f"  - [{v['kind']}] {v['detail']}", file=_sys.stderr)
+    session.exitstatus = 1
+
 # Crash-dump bundles default to ./tpu_watch — tests that trip watchdogs or
 # inject faults would litter the repo's scratch dir; send them to a tmp dir
 # instead (tests asserting on bundles pass an explicit postmortem_dir,

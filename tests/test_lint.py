@@ -1,0 +1,562 @@
+"""reval-lint: the static analysis suite + runtime lock sanitizer.
+
+Three layers under test (ISSUE 6):
+
+1. the repo at HEAD is CLEAN under every pass (the tier-1 wiring — the
+   analog of the old check_metrics test, now covering locks/hotpath/
+   errors/env/metrics/events through one driver);
+2. each pass BITES: a planted violating snippet is flagged (and its
+   clean twin is not) — a lint that cannot fail is documentation;
+3. the runtime lock sanitizer catches a planted lock-order inversion
+   and an off-lock guarded write, and derives its audit maps from the
+   same ``# guarded-by:`` annotations the static pass reads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from reval_tpu.analysis import lockcheck  # noqa: E402
+from reval_tpu.analysis.driver import PASSES, run_lint  # noqa: E402
+from reval_tpu.env import ENV, env_flag, env_int, env_str  # noqa: E402
+
+
+def plant(tmp_path, rel, text):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+    return path
+
+
+def messages(report, pass_name=None):
+    return [v.message for v in report.violations
+            if pass_name is None or v.pass_name == pass_name]
+
+
+# ---------------------------------------------------------------------------
+# the repo at HEAD is clean (tier-1 entry point)
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean_under_all_passes():
+    report = run_lint(REPO)
+    assert report.ok, "\n".join(str(v) for v in report.violations)
+    # the suppression ledger exists and every entry carries a reason
+    assert all(s.reason for s in report.suppressions)
+
+
+def test_driver_runs_fast():
+    report = run_lint(REPO)
+    assert report.elapsed_s < 10.0, (
+        f"reval-lint took {report.elapsed_s:.1f}s — the <10s acceptance "
+        f"bar exists so it stays cheap enough for tier 1")
+    assert report.files > 50          # it actually walked the tree
+
+
+def test_unknown_pass_rejected():
+    with pytest.raises(ValueError, match="unknown lint pass"):
+        run_lint(REPO, ["nonsense"])
+
+
+# ---------------------------------------------------------------------------
+# locks pass bites
+# ---------------------------------------------------------------------------
+
+LOCKY = '''import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []            # guarded-by: _lock
+        self._count = 0             # guarded-by: _lock
+
+    def good(self):
+        with self._lock:
+            self._items.append(1)
+            self._count += 1
+
+    def helper(self):               # lock-held: _lock
+        return self._count
+'''
+
+
+def test_locks_clean_class_passes(tmp_path):
+    plant(tmp_path, "reval_tpu/locky.py", LOCKY)
+    report = run_lint(str(tmp_path), ["locks"])
+    assert report.ok, messages(report)
+
+
+def test_locks_flags_off_lock_access(tmp_path):
+    plant(tmp_path, "reval_tpu/locky.py",
+          LOCKY + '''
+    def racy(self):
+        return len(self._items)
+''')
+    report = run_lint(str(tmp_path), ["locks"])
+    assert any("_items" in m and "outside" in m for m in messages(report))
+
+
+def test_locks_flags_unclassified_mutable_state(tmp_path):
+    plant(tmp_path, "reval_tpu/locky.py", LOCKY.replace(
+        "self._count = 0             # guarded-by: _lock",
+        "self._table = {}"))
+    report = run_lint(str(tmp_path), ["locks"])
+    assert any("_table" in m and "neither" in m for m in messages(report))
+
+
+def test_locks_flags_typoed_lock_name(tmp_path):
+    plant(tmp_path, "reval_tpu/locky.py", LOCKY.replace(
+        "# guarded-by: _lock\n        self._count",
+        "# guarded-by: _lokc\n        self._count"))
+    report = run_lint(str(tmp_path), ["locks"])
+    assert any("no such lock" in m for m in messages(report))
+
+
+def test_locks_nested_function_resets_held_set(tmp_path):
+    # a callback defined INSIDE a with block runs later: holding the
+    # lock at definition time must not exempt the body
+    plant(tmp_path, "reval_tpu/locky.py", LOCKY + '''
+    def schedule(self):
+        with self._lock:
+            def later():
+                return len(self._items)
+            return later
+''')
+    report = run_lint(str(tmp_path), ["locks"])
+    assert any("_items" in m and "outside" in m for m in messages(report))
+
+
+def test_locks_writes_only_mode(tmp_path):
+    plant(tmp_path, "reval_tpu/locky.py", '''import threading
+
+
+class Stat:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = 0.0       # guarded-by: _lock (writes)
+
+    def add(self):
+        with self._lock:
+            self._v += 1
+
+    def read(self):
+        return self._v      # lock-free read is the declared contract
+''')
+    report = run_lint(str(tmp_path), ["locks"])
+    assert report.ok, messages(report)
+
+
+# ---------------------------------------------------------------------------
+# hotpath pass bites
+# ---------------------------------------------------------------------------
+
+def test_hotpath_flags_blocking_calls(tmp_path):
+    plant(tmp_path, "reval_tpu/hot.py", '''import json
+import time
+
+
+def tick(state):   # hot-path
+    time.sleep(0.1)
+    return json.dumps(state)
+
+
+def cold(state):
+    return json.dumps(state)
+''')
+    report = run_lint(str(tmp_path), ["hotpath"])
+    msgs = messages(report)
+    assert any("time.sleep" in m for m in msgs)
+    assert any("json.dumps" in m for m in msgs)
+    assert all("'cold'" not in m for m in msgs)     # unmarked = uncovered
+
+
+def test_hotpath_suppression_requires_reason(tmp_path):
+    plant(tmp_path, "reval_tpu/hot.py", '''import time
+
+
+def tick():   # hot-path
+    # lint: allow(hotpath)
+    time.sleep(0.1)
+''')
+    report = run_lint(str(tmp_path), ["hotpath"])
+    assert any("without a reason" in m for m in messages(report))
+
+
+def test_hotpath_suppression_with_reason_is_counted(tmp_path):
+    plant(tmp_path, "reval_tpu/hot.py", '''import time
+
+
+def tick():   # hot-path
+    # lint: allow(hotpath) — deliberate pacing knob for tests
+    time.sleep(0.1)
+''')
+    report = run_lint(str(tmp_path), ["hotpath"])
+    assert report.ok
+    assert len(report.suppressions) == 1
+    assert "pacing knob" in report.suppressions[0].reason
+
+
+# ---------------------------------------------------------------------------
+# errors pass bites
+# ---------------------------------------------------------------------------
+
+def test_errors_flags_bare_runtimeerror_in_serving(tmp_path):
+    plant(tmp_path, "reval_tpu/serving/handler.py", '''
+def handle(req):
+    if not req:
+        raise ValueError("bad request")      # client error: allowed
+    raise RuntimeError("engine fell over")   # untyped: banned
+''')
+    report = run_lint(str(tmp_path), ["errors"])
+    msgs = messages(report)
+    assert len(msgs) == 1 and "raise RuntimeError" in msgs[0]
+
+
+def test_errors_ignores_non_serving_modules(tmp_path):
+    plant(tmp_path, "reval_tpu/other.py",
+          'def f():\n    raise RuntimeError("fine here")\n')
+    report = run_lint(str(tmp_path), ["errors"])
+    assert report.ok
+
+
+# ---------------------------------------------------------------------------
+# env pass bites + registry round-trip
+# ---------------------------------------------------------------------------
+
+def test_env_flags_raw_read_and_undeclared_name(tmp_path):
+    plant(tmp_path, "reval_tpu/cfg.py", '''import os
+
+from .env import env_str
+
+A = os.environ.get("REVAL_TPU_WATCHDOG_S", "120")
+B = env_str("REVAL_TPU_NOT_A_REAL_KNOB")
+os.environ["REVAL_TPU_OBS"] = "0"            # a WRITE: legal
+''')
+    report = run_lint(str(tmp_path), ["env"])
+    msgs = messages(report)
+    assert any("raw os.environ.get('REVAL_TPU_WATCHDOG_S')" in m
+               for m in msgs)
+    assert any("REVAL_TPU_NOT_A_REAL_KNOB" in m and "not declared" in m
+               for m in msgs)
+    assert not any("REVAL_TPU_OBS" in m and "raw" in m for m in msgs)
+
+
+def test_env_readme_round_trip_bites(tmp_path):
+    # a planted README documenting a ghost var AND missing the real ones
+    plant(tmp_path, "reval_tpu/mod.py", "x = 1\n")
+    plant(tmp_path, "README.md",
+          "| `REVAL_TPU_GHOST_KNOB` | 1 | not a real knob |\n")
+    report = run_lint(str(tmp_path), ["env"])
+    msgs = messages(report)
+    assert any("REVAL_TPU_GHOST_KNOB" in m and "not declared" in m
+               for m in msgs)
+    assert any("missing from the README environment table" in m
+               for m in msgs)
+
+
+def test_env_flags_bare_getenv_import(tmp_path):
+    plant(tmp_path, "reval_tpu/cfg.py", '''from os import getenv
+
+A = getenv("REVAL_TPU_WATCHDOG_S")
+''')
+    report = run_lint(str(tmp_path), ["env"])
+    assert any("raw getenv('REVAL_TPU_WATCHDOG_S')" in m
+               for m in messages(report))
+
+
+def test_unparseable_file_is_reported_not_skipped(tmp_path):
+    plant(tmp_path, "reval_tpu/serving/bad.py",
+          "def broken(:\n    raise RuntimeError('x')\n")
+    report = run_lint(str(tmp_path), ["errors"])
+    assert not report.ok
+    assert any(v.pass_name == "parse" and "bad.py" in v.path
+               for v in report.violations)
+
+
+def test_locks_annotation_inside_conditional_registers(tmp_path):
+    plant(tmp_path, "reval_tpu/locky.py", '''import threading
+
+
+class Box:
+    def __init__(self, cached):
+        self._lock = threading.Lock()
+        if cached:
+            self._cache = {}        # guarded-by: _lock
+        else:
+            self._cache = None
+
+    def get(self, k):
+        with self._lock:
+            return self._cache.get(k) if self._cache else None
+''')
+    report = run_lint(str(tmp_path), ["locks"])
+    assert report.ok, messages(report)
+
+
+def test_env_zombie_check_is_word_boundary(tmp_path):
+    """A var whose name prefixes another declared var must still be
+    flagged when its only 'reference' is the longer name."""
+    from reval_tpu.analysis import envreg
+    from reval_tpu.analysis.core import SourceFile
+
+    src = SourceFile("x.py", "reval_tpu/x.py",
+                     'A = env_str("REVAL_TPU_LOG_LEVEL")\n')
+    fake_env = {"REVAL_TPU_LOG": {}, "REVAL_TPU_LOG_LEVEL": {}}
+    out = envreg._check_zombies(str(tmp_path), {"reval_tpu/x.py": src},
+                                fake_env)
+    flagged = {v.message.split(":")[0] for v in out}
+    assert "REVAL_TPU_LOG" in flagged
+    assert "REVAL_TPU_LOG_LEVEL" not in flagged
+
+
+def test_env_registry_runtime_contract(monkeypatch):
+    with pytest.raises(KeyError, match="not declared"):
+        env_str("REVAL_TPU_TYPO_KNOB")
+    monkeypatch.setenv("REVAL_TPU_OBS", "off")
+    assert env_flag("REVAL_TPU_OBS", True) is False
+    monkeypatch.setenv("REVAL_TPU_OBS", "1")
+    assert env_flag("REVAL_TPU_OBS", True) is True
+    monkeypatch.setenv("REVAL_TPU_MAX_QUEUED_TOKENS", "")
+    assert env_int("REVAL_TPU_MAX_QUEUED_TOKENS", 7) == 7
+    monkeypatch.setenv("REVAL_TPU_MAX_QUEUED_TOKENS", "4096")
+    assert env_int("REVAL_TPU_MAX_QUEUED_TOKENS", 7) == 4096
+    # every declared var documents itself
+    for name, spec in ENV.items():
+        assert name.startswith("REVAL_TPU_") and spec["help"], name
+
+
+# ---------------------------------------------------------------------------
+# driver plumbing: CLI exit codes, shim compatibility
+# ---------------------------------------------------------------------------
+
+def test_cli_exits_nonzero_on_planted_violation(tmp_path):
+    plant(tmp_path, "reval_tpu/serving/bad.py",
+          'def f():\n    raise RuntimeError("boom")\n')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "reval_lint.py"),
+         "--root", str(tmp_path), "errors"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1 and "raise RuntimeError" in r.stdout
+
+
+def test_cli_lists_all_passes():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "reval_lint.py"),
+         "--list"], capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    assert set(r.stdout.split()) == set(PASSES)
+
+
+def test_check_metrics_shim_still_delegates():
+    """The historical entry point keeps working (docs/invocations), now
+    through the migrated passes."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_metrics.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "metrics" in r.stdout and "events" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime lock sanitizer
+# ---------------------------------------------------------------------------
+
+def test_lockcheck_detects_lock_order_inversion():
+    san = lockcheck.LockSanitizer()
+    a = san.wrap("lock-A")
+    b = san.wrap("lock-B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    kinds = [v["kind"] for v in san.violations]
+    assert "lock-order-inversion" in kinds
+    v = next(v for v in san.violations if v["kind"] == "lock-order-inversion")
+    assert {"lock-A", "lock-B"} == {v["a"], v["b"]}
+
+
+def test_lockcheck_consistent_order_is_clean():
+    san = lockcheck.LockSanitizer()
+    a, b = san.wrap("A"), san.wrap("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert san.violations == []
+
+
+def test_lockcheck_inversion_across_threads():
+    san = lockcheck.LockSanitizer()
+    a, b = san.wrap("A"), san.wrap("B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    ba()
+    assert any(v["kind"] == "lock-order-inversion" for v in san.violations)
+
+
+def test_lockcheck_catches_off_lock_write():
+    san = lockcheck.LockSanitizer()
+
+    class Box:
+        def __init__(self):
+            self._lock = san.wrap("box-lock")
+            self._val = 0               # constructor write: exempt
+
+        def bump_locked(self):
+            with self._lock:
+                self._val += 1
+
+        def bump_racy(self):
+            self._val += 1
+
+    undo = lockcheck.audit_class(Box, {"_val": "_lock"}, san)
+    try:
+        box = Box()
+        box.bump_locked()
+        assert san.violations == []
+        box.bump_racy()
+        assert any(v["kind"] == "off-lock-write"
+                   and "bump_racy" in v["detail"] for v in san.violations)
+    finally:
+        undo()
+
+
+def test_lockcheck_audit_maps_derive_from_annotations():
+    """One contract, two enforcement layers: the runtime audit reads the
+    SAME ``guarded-by`` comments the static pass does."""
+    import reval_tpu.serving.session as session_mod
+
+    maps = lockcheck._module_guard_maps(session_mod)
+    assert maps["ContinuousSession"]["_queued_tokens"] == "_acct_lock"
+    assert maps["ContinuousSession"]["_inflight"] == "_acct_lock"
+    assert maps["_Pending"]["_fired"] == "_cb_lock"
+    assert maps["MultiSession"]["_load"] == "_lock"
+
+
+def test_lockcheck_lock_survives_fork_protocol():
+    """concurrent.futures registers _at_fork_reinit on its module lock at
+    import; a sanitized lock must speak that protocol or the sanitizer
+    breaks `import concurrent.futures` (dp_paged, ThreadPoolExecutor)."""
+    san = lockcheck.LockSanitizer()
+    lk = san.wrap("forky")
+    lk.acquire()
+    lk._at_fork_reinit()
+    assert not lk.locked() and not lk.held_by_me()
+
+
+def test_lockcheck_sanitized_lock_speaks_lock_protocol():
+    san = lockcheck.LockSanitizer()
+    lk = san.wrap("proto")
+    assert lk.acquire(False) is True
+    assert lk.locked() and lk.held_by_me()
+    lk.release()
+    assert not lk.locked()
+    # a Condition built over it works through the stdlib fallbacks
+    cond = threading.Condition(san.wrap("cond-lock"))
+    with cond:
+        cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# typed-error boundary: the fix the pass forced (EngineFailure)
+# ---------------------------------------------------------------------------
+
+def test_engine_failure_is_typed_and_wire_unsafe():
+    from reval_tpu.serving.errors import EngineFailure, ServingError
+
+    exc = EngineFailure("secret /opt/x token=abc")
+    assert isinstance(exc, RuntimeError) and isinstance(exc, ServingError)
+    assert exc.status == 500 and exc.code == "internal_error"
+    assert exc.wire_safe is False and ServingError.wire_safe is True
+
+
+def test_server_sanitizes_engine_failure_body():
+    import urllib.error
+    import urllib.request
+
+    from reval_tpu.serving.errors import EngineFailure
+    from reval_tpu.serving.server import EngineServer
+
+    def boom(prompts, *, max_tokens, temperature, stop):
+        raise EngineFailure("secret internal path /opt/x token=abc123")
+
+    srv = EngineServer(boom, model_id="m", port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=json.dumps({"prompt": "p"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 500
+        raw = err.value.read().decode()
+        body = json.loads(raw)
+        assert body["error"]["code"] == "internal_error"
+        assert "secret" not in raw and "token=abc123" not in raw
+    finally:
+        srv.shutdown()
+
+
+def test_session_driver_fault_raises_engine_failure():
+    """The session's untyped-fault path now crosses the handle typed
+    (still a RuntimeError for old callers, message preserved)."""
+    from reval_tpu.resilience import EngineStepChaos
+    from reval_tpu.serving.errors import EngineFailure
+    from reval_tpu.serving.mock_engine import MockStepEngine
+    from reval_tpu.serving.session import ContinuousSession
+
+    chaos = EngineStepChaos(rate=1.0, modes=("error",), max_faults=1)
+    eng = MockStepEngine()
+    session = ContinuousSession(eng, step_chaos=chaos, watchdog_s=0)
+    try:
+        h = session.submit(["x"], max_new_tokens=8)
+        with pytest.raises(EngineFailure, match="chaos"):
+            h.result(timeout=30)
+    finally:
+        session.close()
+
+
+# ---------------------------------------------------------------------------
+# bench: the stale marker (ROADMAP item 5, small slice)
+# ---------------------------------------------------------------------------
+
+def test_bench_failure_emits_stale_marker():
+    import io
+    from contextlib import redirect_stdout
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.fail("m", "tpu-unreachable", "probe timed out")
+    out = json.loads(buf.getvalue())
+    assert out["error"] == "tpu-unreachable"
+    # the repo carries committed clean artifacts, so the wedge round
+    # reads as STALE @ last_known instead of a blind 0.0
+    assert out["status"] == "stale"
+    assert out["stale_probes_per_sec"] == out["last_known"]["value"] > 0
+    assert out["stale_commit"] == out["last_known"]["measured_at_commit"]

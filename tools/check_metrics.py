@@ -1,203 +1,43 @@
 #!/usr/bin/env python3
-"""Metric- and event-namespace lint: obs registries vs README vs code.
+"""Metric/event-namespace lint — THIN SHIM over reval-lint.
 
-Five invariants, each of which has historically rotted silently in
-serving stacks:
+The checks themselves moved into the lint framework
+(``reval_tpu/analysis/metrics_events.py``; ISSUE 6 migrated them so the
+repo has one driver and one report format — run ``python
+tools/reval_lint.py`` for the whole suite).  This shim keeps the
+historical entry points alive:
 
-1. **Docs complete.**  Every metric declared in
-   ``reval_tpu.obs.metrics.METRICS`` appears in the README
-   "Observability" metric table (and the table names no metric that no
-   longer exists) — a metric cannot ship undocumented or stay documented
-   after removal.
-2. **No namespace collisions.**  The exposition series a histogram
-   expands to (``<name>_bucket``/``_sum``/``_count``) must not collide
-   with any other metric's series; duplicate declarations are impossible
-   by construction (dict keys) but cross-type shadowing is not.
-3. **No rogue literals.**  Any ``reval_*`` metric-shaped string literal
-   in the source tree outside ``obs/metrics.py`` must be a declared
-   name — registering metrics by ad-hoc literal is how a name typo
-   becomes a silent second time series.
-4. **Events declared, both directions.**  Every ``log_event("...")``
-   literal in the tree must name an event declared in
-   ``reval_tpu.obs.logging.EVENTS``, and every declared event must have
-   at least one live call site — the structured-log namespace cannot
-   grow typos or keep zombie entries.
-5. **Events documented.**  The EVENTS table and the README events table
-   match, both directions (same contract as the metric table).
-
-Run directly (exit 1 + report on failure) or through the fast test tier
-(tests/test_obs.py wires ``run_checks``).
+- ``python tools/check_metrics.py`` still exits non-zero with the same
+  per-violation lines;
+- ``run_checks(root) -> [str]`` (plus ``_spec``/``_events_spec``) keeps
+  the existing bite tests and any external invocation working.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 
-#: files scanned for rogue metric literals (source that could register
-#: or render metrics; docs and tests may discuss hypothetical names)
-SCAN_DIRS = ("reval_tpu", "tools")
-SCAN_FILES = ("bench.py",)
-
-_LITERAL_RE = re.compile(r'["\'](reval_[a-z0-9_]+)["\']')
-#: a log_event call site's event-name literal (first positional arg)
-_EVENT_CALL_RE = re.compile(
-    r'log_event\(\s*["\']([a-z0-9_]+(?:\.[a-z0-9_]+)+)["\']')
-
-
-def _spec():
-    sys.path.insert(0, ROOT)
-    from reval_tpu.obs.metrics import METRICS
-    return METRICS
-
-
-def _events_spec():
-    sys.path.insert(0, ROOT)
-    from reval_tpu.obs.logging import EVENTS
-    return EVENTS
-
-
-def _readme_metric_names(readme_text: str) -> set[str]:
-    """Names from the README metric table: first backticked token per
-    table row (``| `reval_...` | ... |``)."""
-    names = set()
-    for line in readme_text.splitlines():
-        m = re.match(r"\s*\|\s*`(reval_[a-z0-9_]+)`", line)
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def _readme_event_names(readme_text: str) -> set[str]:
-    """Names from the README events table: first backticked dotted token
-    per table row (``| `component.event` | ... |``)."""
-    names = set()
-    for line in readme_text.splitlines():
-        m = re.match(r"\s*\|\s*`([a-z0-9_]+\.[a-z0-9_.]+)`", line)
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def _series_names(name: str, mtype: str) -> set[str]:
-    if mtype == "histogram":
-        return {name, f"{name}_bucket", f"{name}_sum", f"{name}_count"}
-    return {name}
+from reval_tpu.analysis.metrics_events import (  # noqa: E402,F401
+    _events_spec,
+    _metrics_spec as _spec,
+    run_checks as _run_checks,
+)
 
 
 def run_checks(root: str = ROOT) -> list[str]:
     """Returns a list of human-readable violations (empty = clean)."""
-    errors: list[str] = []
-    metrics = _spec()
-
-    # 1. docs completeness, both directions
-    readme_path = os.path.join(root, "README.md")
-    try:
-        with open(readme_path) as f:
-            documented = _readme_metric_names(f.read())
-    except OSError:
-        return [f"cannot read {readme_path}"]
-    for name in metrics:
-        if name not in documented:
-            errors.append(f"{name}: declared in obs.metrics.METRICS but "
-                          f"missing from the README metric table")
-    for name in documented:
-        if name not in metrics:
-            errors.append(f"{name}: in the README metric table but not "
-                          f"declared in obs.metrics.METRICS")
-
-    # 2. cross-metric series collisions
-    owner: dict[str, str] = {}
-    for name, spec in metrics.items():
-        for series in _series_names(name, spec["type"]):
-            if series in owner and owner[series] != name:
-                errors.append(f"series {series!r} generated by both "
-                              f"{owner[series]!r} and {name!r}")
-            owner.setdefault(series, name)
-
-    # 3. rogue literals outside the central spec.  Scoped to the metric
-    # namespaces METRICS declares (reval_request_*, reval_engine_*, ...)
-    # so unrelated reval_* symbols (the package name, the runtime's
-    # reval_rt_* C ABI) stay out of scope; a histogram's derived series
-    # (_bucket/_sum/_count) count as declared.
-    namespaces = {name.split("_")[1] for name in metrics}
-    declared_series = {s for name, spec in metrics.items()
-                       for s in _series_names(name, spec["type"])}
-    targets = [os.path.join(root, f) for f in SCAN_FILES]
-    for d in SCAN_DIRS:
-        for dirpath, _, filenames in os.walk(os.path.join(root, d)):
-            if "__pycache__" in dirpath:
-                continue
-            targets.extend(os.path.join(dirpath, f) for f in filenames
-                           if f.endswith(".py"))
-    spec_file = os.path.join(root, "reval_tpu", "obs", "metrics.py")
-    events = _events_spec()
-    events_file = os.path.join(root, "reval_tpu", "obs", "logging.py")
-    used_events: set[str] = set()
-    for path in targets:
-        if os.path.abspath(path) == spec_file:
-            continue
-        try:
-            with open(path) as f:
-                text = f.read()
-        except OSError:
-            continue
-        for m in _LITERAL_RE.finditer(text):
-            lit = m.group(1)
-            parts = lit.split("_")
-            if len(parts) < 3 or parts[1] not in namespaces:
-                continue            # not in a declared metric namespace
-            if lit not in declared_series:
-                line = text[:m.start()].count("\n") + 1
-                errors.append(f"{os.path.relpath(path, root)}:{line}: "
-                              f"metric-shaped literal {lit!r} is not "
-                              f"declared in obs.metrics.METRICS")
-        # 4. structured-log events: every call-site literal is declared…
-        if os.path.abspath(path) == events_file:
-            continue
-        for m in _EVENT_CALL_RE.finditer(text):
-            name = m.group(1)
-            used_events.add(name)
-            if name not in events:
-                line = text[:m.start()].count("\n") + 1
-                errors.append(f"{os.path.relpath(path, root)}:{line}: "
-                              f"log_event({name!r}) is not declared in "
-                              f"obs.logging.EVENTS")
-    # …and every declared event has at least one live call site
-    for name in events:
-        if name not in used_events:
-            errors.append(f"{name}: declared in obs.logging.EVENTS but "
-                          f"never emitted by any log_event call site")
-
-    # 5. events documented, both directions (same README contract as
-    # the metric table)
-    with open(readme_path) as f:
-        documented_events = _readme_event_names(f.read())
-    for name in events:
-        if name not in documented_events:
-            errors.append(f"{name}: declared in obs.logging.EVENTS but "
-                          f"missing from the README events table")
-    for name in documented_events:
-        if name not in events:
-            errors.append(f"{name}: in the README events table but not "
-                          f"declared in obs.logging.EVENTS")
-    return errors
+    return _run_checks(root)
 
 
 def main() -> int:
-    errors = run_checks()
-    if errors:
-        print(f"check_metrics: {len(errors)} violation(s)")
-        for e in errors:
-            print(f"  - {e}")
-        return 1
-    print(f"check_metrics: ok ({len(_spec())} metrics + "
-          f"{len(_events_spec())} events documented, no collisions, "
-          f"no rogue literals)")
-    return 0
+    from reval_tpu.analysis.driver import main as lint_main
+
+    # one driver, one report format: delegate to the migrated passes
+    return lint_main(["metrics", "events"])
 
 
 if __name__ == "__main__":

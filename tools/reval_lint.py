@@ -1,0 +1,31 @@
+#!/usr/bin/env python3
+"""reval-lint CLI: the repo's codebase-native static analysis suite.
+
+Thin launcher over :mod:`reval_tpu.analysis.driver` — the passes are:
+
+- ``locks``   lock-discipline / race detector (``# guarded-by:``)
+- ``hotpath`` no blocking/allocating calls in ``# hot-path`` functions
+- ``errors``  serving layer raises only the serving/errors.py taxonomy
+- ``env``     REVAL_TPU_* reads go through reval_tpu/env.py::ENV
+- ``metrics`` METRICS spec <-> README <-> literals (ex check_metrics)
+- ``events``  EVENTS spec <-> call sites <-> README (ex check_metrics)
+
+Usage::
+
+    python tools/reval_lint.py              # all passes, this repo
+    python tools/reval_lint.py locks env    # a subset
+    python tools/reval_lint.py --root DIR   # a planted tree (tests)
+
+Exit status 1 on any unsuppressed violation; suppressions
+(``# lint: allow(<pass>) — <reason>``) are counted and reported.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reval_tpu.analysis.driver import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
