@@ -70,7 +70,7 @@ def hbm_bw_for(device_kind: str) -> float:
 # -- pre-flight ------------------------------------------------------------
 
 def probe_devices(timeout_s: int = 60, retries: int = 6, wait_s: int = 60,
-                  force_cpu: bool = False,
+                  force_cpu: bool = False, runner=None, sleep=None,
                   ) -> tuple[tuple[int, str, str] | None, str]:
     """(n_devices, device_kind, platform) via a KILLABLE subprocess.
 
@@ -79,28 +79,50 @@ def probe_devices(timeout_s: int = 60, retries: int = 6, wait_s: int = 60,
     so the probe must be a separate process we can kill.  ``force_cpu``
     uses ``jax.config`` (the env var does NOT override this image's site
     hook that pins the TPU plugin).
+
+    Self-heal: the attempts run under the resilience layer's
+    :class:`RetryPolicy` — exponential backoff with jitter from
+    ``wait_s`` up, capped at four minutes — instead of the old fixed
+    one-minute sleep, so a tunnel that wedges for a couple of minutes
+    (the common transient, BENCH_r02–r05's blind spot) gets probed again
+    PAST its wedge window before the round is declared ``stale``.  A
+    wedged probe raises ``TimeoutError`` and a crashed probe
+    ``ConnectionError``, both transport-shaped for the policy's
+    classification; ``runner``/``sleep`` are injectable so the backoff
+    schedule is unit-testable without subprocesses or real waits.
     """
+    from reval_tpu.resilience import RetryPolicy
+
     cpu = ("jax.config.update('jax_platforms', 'cpu'); " if force_cpu else "")
     code = ("import jax; " + cpu + "ds = jax.devices(); "
             "print(len(ds), ds[0].device_kind, ds[0].platform, sep='|')")
-    last_error = ""
-    for attempt in range(retries):
+    run = runner if runner is not None else subprocess.run
+
+    def attempt() -> tuple[int, str, str]:
         try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True,
-                               timeout=timeout_s)
-            line = (r.stdout.strip().splitlines() or [""])[-1]
-            if r.returncode == 0 and line.count("|") == 2:
-                n, kind, platform = line.split("|")
-                return (int(n), kind, platform), ""
-            # crash, not a wedge: keep the real cause for the error JSON
-            last_error = (f"probe exited rc={r.returncode}: "
-                          f"{r.stderr.strip()[-800:]}")
+            r = run([sys.executable, "-c", code], capture_output=True,
+                    text=True, timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            last_error = "timeout"
-        if attempt < retries - 1:
-            time.sleep(wait_s)
-    return None, last_error
+            raise TimeoutError("timeout") from None
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        if r.returncode == 0 and line.count("|") == 2:
+            n, kind, platform = line.split("|")
+            return int(n), kind, platform
+        # crash, not a wedge: keep the real cause for the error JSON
+        # (still retried — a tunnel mid-recovery can crash the plugin)
+        raise ConnectionError(f"probe exited rc={r.returncode}: "
+                              f"{r.stderr.strip()[-800:]}")
+
+    policy = RetryPolicy(max_attempts=max(1, int(retries)),
+                         base_delay=float(wait_s), max_delay=240.0,
+                         multiplier=2.0, jitter=0.25,
+                         **({"sleep": sleep} if sleep is not None else {}))
+    try:
+        return policy.call(attempt, label="bench.device-probe"), ""
+    except TimeoutError:
+        return None, "timeout"
+    except ConnectionError as exc:
+        return None, str(exc)
 
 
 def emit(obj: dict) -> None:

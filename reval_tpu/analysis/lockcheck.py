@@ -249,6 +249,7 @@ def audit_module(module, sanitizer: LockSanitizer) -> list:
 AUDIT_MODULES = (
     "reval_tpu.serving.session",
     "reval_tpu.serving.server",
+    "reval_tpu.serving.router",
     "reval_tpu.obs.metrics",
     "reval_tpu.obs.trace",
     "reval_tpu.resilience.chaos",

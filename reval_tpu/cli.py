@@ -649,6 +649,173 @@ def run_serve(argv: list[str]) -> int:
     return 0
 
 
+def _router_smoke(router, servers, n: int, kill_one: bool) -> int:
+    """Router self-test (the tier-1 canary for the fleet tier, the
+    routing sibling of ``serve --smoke``): post ``n`` prompts spread over
+    two distinct long templates through the resilient HTTP client —
+    half, then (with ``kill_one`` and ≥2 replicas) hard-kill one replica
+    WITHOUT drain, then the rest, so the second half exercises
+    re-route/ejection — scrape and verify the federated ``/metrics``
+    (exposition parses, the router accounted every request, ejections
+    registered when a replica died), and print one JSON summary line."""
+    import urllib.request
+
+    from .inference.client import HTTPClientBackend
+    from .obs import metrics as obs_metrics
+    from .obs.metrics import parse_prometheus
+
+    client = HTTPClientBackend(
+        model_id="router-smoke", port=router.port, temp=0.0,
+        prompt_type="direct", wait_for_server_s=30,
+        retry={"max_attempts": 10, "base_delay": 0.05,
+               "max_delay": 0.5, "jitter": 0.1})
+    template_a = "TEMPLATE-A " * 40
+    template_b = "TEMPLATE-B " * 40
+    prompts = [(template_a if i % 2 == 0 else template_b) + f"probe {i}"
+               for i in range(n)]
+    outs: dict[int, str] = {}
+    errors: list[str] = []
+
+    def post(i: int) -> None:
+        try:
+            outs[i] = client.infer_one(prompts[i])
+        except Exception as exc:  # noqa: BLE001 — summarised below
+            errors.append(f"prompt {i}: {exc!r}")
+
+    import threading
+
+    def run_batch(indices) -> None:
+        threads = [threading.Thread(target=post, args=(i,)) for i in indices]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+    killed = False
+    run_batch(range(n // 2))
+    if kill_one and len(servers) >= 2:
+        # a crash, not a drain: in-flight sockets die, the router must
+        # eject the corpse and re-route the rest of the smoke
+        victim = servers[0]
+        victim._httpd.shutdown()
+        victim._httpd.server_close()
+        killed = True
+    run_batch(range(n // 2, n))
+    if killed:
+        # give the health poller its consecutive-failure window so the
+        # corpse's ejection lands in the scraped counters
+        import time as _time
+
+        deadline = _time.monotonic() + 10.0
+        while (_time.monotonic() < deadline
+               and not router._obs.counter(
+                   obs_metrics.ROUTER_EJECTIONS).value):
+            _time.sleep(0.05)
+    obs = {"metrics_ok": False, "router_requests": 0, "ejections": 0,
+           "failovers": 0}
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/metrics", timeout=10) as r:
+            samples = parse_prometheus(r.read().decode())
+        obs.update(
+            metrics_ok=True,
+            router_requests=int(samples.get(obs_metrics.ROUTER_REQUESTS, 0)),
+            ejections=int(samples.get(obs_metrics.ROUTER_EJECTIONS, 0)),
+            failovers=int(samples.get(obs_metrics.ROUTER_FAILOVERS, 0)))
+    except Exception as exc:  # noqa: BLE001 — summarised below
+        errors.append(f"/metrics: {exc!r}")
+    router.shutdown()
+    for srv in (servers[1:] if killed else servers):
+        srv.shutdown()
+    summary = {"served": len(outs), "errors": len(errors),
+               "killed_replica": killed, **obs}
+    print(json.dumps(summary))
+    bad = (errors or len(outs) != n or not obs["metrics_ok"]
+           or obs["router_requests"] < n
+           or (killed and obs["ejections"] < 1))
+    if bad:
+        print(f"[router-smoke] failures: {errors[:3]}")
+        return 1
+    return 0
+
+
+def run_router(argv: list[str]) -> int:
+    """Fleet router: consistent-hash prefix-affinity routing over N
+    `reval_tpu serve` replicas, with health tracking, failover, and
+    /metrics federation (serving/router.py)."""
+    from .serving import FleetRouter, serve_config
+
+    parser = argparse.ArgumentParser(
+        prog="reval_tpu router",
+        description="Route completions across a fleet of engine servers")
+    parser.add_argument("--replicas", default=None,
+                        help="comma-separated replica endpoints "
+                             "(host:port or bare ports)")
+    parser.add_argument("--port", type=int, default=3100,
+                        help="router listen port (default 3100; 0 = any)")
+    parser.add_argument("--affinity-table", default=None, metavar="PATH",
+                        help="hash-ring seed from `tools/prefix_stats.py "
+                             "--json` (sets the affinity window and names "
+                             "the template keys)")
+    parser.add_argument("--window-chars", type=int, default=None,
+                        help="affinity-key prefix window in chars (default "
+                             "env REVAL_TPU_ROUTER_AFFINITY_WINDOW or 1024)")
+    parser.add_argument("--eject-fails", type=int, default=None,
+                        help="consecutive failures before ejecting a replica")
+    parser.add_argument("--cooldown-s", type=float, default=None,
+                        help="ejection cooldown before a half-open probe")
+    parser.add_argument("--health-interval-s", type=float, default=None,
+                        help="/readyz poll interval per replica")
+    parser.add_argument("--mock", type=int, default=None, metavar="N",
+                        help="spawn N in-process mock replicas (host-only "
+                             "fleet; the smoke/drill target)")
+    parser.add_argument("--smoke", type=int, default=None, metavar="M",
+                        help="self-test: M prompts through the resilient "
+                             "client with a mid-smoke replica kill (when "
+                             "≥2 replicas), verify the federated /metrics, "
+                             "print a JSON summary, exit")
+    parser.add_argument("--no-kill", action="store_true",
+                        help="smoke only: skip the mid-smoke replica kill")
+    args = parser.parse_args(argv)
+    servers = []
+    replicas = []
+    if args.mock:
+        for _ in range(args.mock):
+            srv = serve_config({"mock": True, "mock_echo": True}, port=0)
+            srv.start()
+            servers.append(srv)
+            replicas.append(f"127.0.0.1:{srv.port}")
+    if args.replicas:
+        replicas.extend(r.strip() for r in args.replicas.split(",")
+                        if r.strip())
+    if not replicas:
+        print("Error: no replicas (--replicas and/or --mock N)")
+        return 1
+    router = FleetRouter(
+        replicas, port=args.port if args.smoke is None else 0,
+        window_chars=args.window_chars, eject_fails=args.eject_fails,
+        cooldown_s=args.cooldown_s,
+        health_interval_s=(args.health_interval_s
+                           if args.health_interval_s is not None
+                           else (0.1 if args.smoke is not None else None)),
+        affinity_table=args.affinity_table)
+    router.start()
+    if args.smoke is not None:
+        return _router_smoke(router, servers, args.smoke,
+                             kill_one=not args.no_kill)
+    print(f"routing {len(replicas)} replicas on :{router.port} "
+          f"(POST /v1/completions; GET /healthz /readyz /metrics /statusz; "
+          f"POST /admin/drain /admin/rejoin)")
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    router.shutdown()
+    for srv in servers:
+        srv.shutdown()
+    return 0
+
+
 def run_analyze(argv: list[str]) -> int:
     """Valid-test-case statistics (reference analyze_testcases.py)."""
     from .analyze import analyze_valid_test_cases
@@ -667,6 +834,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_fleet(argv[1:])
     if argv and argv[0] == "serve":
         return run_serve(argv[1:])
+    if argv and argv[0] == "router":
+        return run_router(argv[1:])
     if argv and argv[0] == "watch":
         from .watch import run_watch
 

@@ -102,6 +102,26 @@ ENV: dict[str, dict] = {
         "default": "3",
         "help": "deadline expiries in one driver sweep that trigger a "
                 "postmortem bundle"},
+    # -- fleet router (serving/router.py) ----------------------------------
+    "REVAL_TPU_ROUTER_VNODES": {
+        "default": "64",
+        "help": "virtual nodes per replica on the router's "
+                "consistent-hash ring"},
+    "REVAL_TPU_ROUTER_EJECT_FAILS": {
+        "default": "3",
+        "help": "consecutive forward/health failures before the router "
+                "ejects a replica"},
+    "REVAL_TPU_ROUTER_COOLDOWN_S": {
+        "default": "5",
+        "help": "seconds an ejected replica sits out before a half-open "
+                "probe may rejoin it"},
+    "REVAL_TPU_ROUTER_AFFINITY_WINDOW": {
+        "default": "1024",
+        "help": "prompt-prefix window (chars) hashed into the routing "
+                "affinity key (an --affinity-table overrides it)"},
+    "REVAL_TPU_ROUTER_HEALTH_INTERVAL_S": {
+        "default": "1",
+        "help": "router /readyz poll interval per replica, in seconds"},
     # -- multi-host rig (parallel/distributed.py) --------------------------
     "REVAL_TPU_COORDINATOR": {
         "default": "",

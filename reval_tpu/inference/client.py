@@ -17,6 +17,14 @@ connection resets, timeouts, 5xx responses, truncated JSON bodies, and
 429 load sheds are retried with exponential backoff, honoring the
 server's ``Retry-After`` hint when one is sent.
 
+The endpoint may equally be a :class:`~reval_tpu.serving.FleetRouter`:
+its ``/readyz`` aggregates the replica set (200 while ANY replica is
+ready — "some replicas ready" IS ready, so the handshake completes on a
+degraded fleet), its 429/503 sheds carry the same ``Retry-After``
+contract through the extra hop, and ``X-Request-Id`` passes through
+both directions — one id names the request in this client's retry log,
+the router's failover log, and the serving replica's spans.
+
 Deadlines: each completion request carries ``deadline_s`` — this client's
 remaining per-request budget (``request_timeout``) — so a server that
 cannot finish in time cancels the work engine-side (freeing its batch
@@ -68,10 +76,17 @@ class HTTPClientBackend(InferenceBackend):
             # /models probe.  The default budget is 10 minutes because the
             # engine really does spend minutes loading + compiling a big
             # checkpoint before readiness flips.
-            wait_for_server(lambda: self._request_once("/readyz", timeout=5),
-                            timeout=wait_for_server_s,
-                            retry_statuses=READYZ_WAIT_STATUSES,
-                            describe=f"server at {self.base_url}")
+            ready = wait_for_server(
+                lambda: self._request_once("/readyz", timeout=5),
+                timeout=wait_for_server_s,
+                retry_statuses=READYZ_WAIT_STATUSES,
+                describe=f"server at {self.base_url}")
+            if isinstance(ready, dict) and "replicas_ready" in ready:
+                # a fleet router answered: say how degraded the fleet is
+                # (the handshake completes on ANY ready replica)
+                print(f"router at {self.base_url}: "
+                      f"{ready['replicas_ready']}/{ready['replicas_total']} "
+                      f"replicas ready")
             models = self._get("/models")
             self._server_model = models["data"][0]["id"]
             print(f"user-side model_id: {model_id}, server-side model_id: {self._server_model}")

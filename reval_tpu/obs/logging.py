@@ -75,6 +75,14 @@ EVENTS: dict[str, str] = {
     "server.drained": "graceful drain finished; lifecycle counters attached",
     "server.trace_written": "the span trace file was written at drain",
     "server.trace_error": "writing the span trace file failed",
+    # fleet router (serving/router.py)
+    "router.eject": "a replica was ejected after consecutive failures",
+    "router.recover": "an ejected replica rejoined (half-open probe or "
+                      "clean health poll)",
+    "router.failover": "a forward was re-routed to a non-primary replica",
+    "router.shed": "the router shed a request fleet-wide (no replica "
+                   "could take it)",
+    "router.drain": "an operator drained or rejoined a replica",
     # fleet (fleet.py)
     "fleet.resume_skip": "a journaled (repeat, task) chunk was skipped",
     "fleet.lost_prompts": "prompts exhausted retries and took the sentinel",

@@ -43,6 +43,9 @@ __all__ = [
     "REQUESTS", "QUEUE_WAIT", "TTFT", "TPOT", "E2E",
     "ENGINE_STEP", "DECODE_CHUNK", "PREFILL_BATCH",
     "QUEUED_TOKENS", "FREE_PAGES", "HTTP_REQUESTS",
+    "ROUTER_REQUESTS", "ROUTER_ROUTED", "ROUTER_FAILOVERS",
+    "ROUTER_EJECTIONS", "ROUTER_RECOVERIES", "ROUTER_SHEDS",
+    "ROUTER_REPLICAS_READY",
 ]
 
 # Log-spaced seconds buckets spanning sub-ms host paths (mock engine,
@@ -68,6 +71,13 @@ PREFILL_BATCH = "reval_prefill_batch_seconds"
 QUEUED_TOKENS = "reval_session_queued_tokens"
 FREE_PAGES = "reval_engine_free_pages"
 HTTP_REQUESTS = "reval_http_requests_total"
+ROUTER_REQUESTS = "reval_router_requests_total"
+ROUTER_ROUTED = "reval_router_routed_total"
+ROUTER_FAILOVERS = "reval_router_failovers_total"
+ROUTER_EJECTIONS = "reval_router_ejections_total"
+ROUTER_RECOVERIES = "reval_router_recoveries_total"
+ROUTER_SHEDS = "reval_router_sheds_total"
+ROUTER_REPLICAS_READY = "reval_router_replicas_ready"
 
 #: The canonical metric namespace: name -> (type, help[, buckets]).
 #: ``tools/check_metrics.py`` lints this dict against the README table.
@@ -139,6 +149,32 @@ METRICS: dict[str, dict] = {
     HTTP_REQUESTS: {"type": "counter",
                     "help": "Completion POSTs received by the HTTP server "
                             "(any outcome, incl. shed/drain rejections)"},
+    # fleet router (serving/router.py) — the standalone tier's own view;
+    # a federated /metrics scrape shows these next to the summed replica
+    # counters
+    ROUTER_REQUESTS: {"type": "counter",
+                      "help": "Completion POSTs received by the fleet "
+                              "router (any outcome)"},
+    ROUTER_ROUTED: {"type": "counter",
+                    "help": "Forwards that landed on the hash-ring "
+                            "primary replica (warm prefix cache)"},
+    ROUTER_FAILOVERS: {"type": "counter",
+                       "help": "Forwards re-routed to a non-primary "
+                               "replica (primary unhealthy or forward "
+                               "failed)"},
+    ROUTER_EJECTIONS: {"type": "counter",
+                       "help": "Replica ejections (consecutive "
+                               "forward/health failures)"},
+    ROUTER_RECOVERIES: {"type": "counter",
+                        "help": "Replicas rejoined after a half-open "
+                                "probe or clean health poll"},
+    ROUTER_SHEDS: {"type": "counter",
+                   "help": "Requests the router shed fleet-wide (every "
+                           "replica saturated or unavailable)"},
+    ROUTER_REPLICAS_READY: {"type": "gauge",
+                            "help": "Replicas currently healthy and "
+                                    "passing /readyz (router poller "
+                                    "view)"},
 }
 
 

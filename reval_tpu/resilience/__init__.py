@@ -29,7 +29,8 @@ everything with nothing written.  The pieces compose:
 from .chaos import CHAOS_MODES, ENGINE_STEP_MODES, ChaosBackend, EngineStepChaos
 from .checkpoint import FleetCheckpoint
 from .resilient import INFER_FAILED, ResilientBackend
-from .retry import RetryPolicy, retry_after_hint, retryable_error, wait_for_server
+from .retry import (RetryPolicy, retry_after_from_headers, retry_after_hint,
+                    retryable_error, wait_for_server)
 
 __all__ = [
     "CHAOS_MODES",
@@ -40,6 +41,7 @@ __all__ = [
     "INFER_FAILED",
     "ResilientBackend",
     "RetryPolicy",
+    "retry_after_from_headers",
     "retry_after_hint",
     "retryable_error",
     "wait_for_server",

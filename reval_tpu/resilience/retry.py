@@ -23,21 +23,19 @@ from typing import Callable
 from ..obs.logging import log_event
 
 __all__ = ["RetryPolicy", "retryable_error", "retry_after_hint",
-           "wait_for_server"]
+           "retry_after_from_headers", "wait_for_server"]
 
 # Status codes worth retrying: request timeout, throttling, and the 5xx
 # family a restarting or overloaded server emits.
 RETRYABLE_HTTP_CODES = frozenset({408, 425, 429, 500, 502, 503, 504})
 
 
-def retry_after_hint(exc: BaseException) -> float | None:
-    """The server's ``Retry-After`` header on an HTTP error, in seconds
-    (None when absent/unparseable).  The serving layer sends it with 429
-    load sheds and 503 drain responses; honoring it beats blind
-    exponential backoff — the server KNOWS how deep its queue is.
-    HTTP-date forms are ignored (the in-tree server only sends seconds).
-    """
-    headers = getattr(exc, "headers", None)
+def retry_after_from_headers(headers) -> float | None:
+    """``Retry-After`` out of any headers-shaped object (something with
+    ``.get``), in seconds; None when absent/unparseable.  THE one parse
+    of this wire contract — :func:`retry_after_hint` and the fleet
+    router's failover accounting both call it.  HTTP-date forms are
+    ignored (the in-tree servers only send seconds)."""
     get = getattr(headers, "get", None)
     if get is None:
         return None
@@ -45,6 +43,15 @@ def retry_after_hint(exc: BaseException) -> float | None:
         return float(get("Retry-After"))
     except (TypeError, ValueError):
         return None
+
+
+def retry_after_hint(exc: BaseException) -> float | None:
+    """The server's ``Retry-After`` header on an HTTP error, in seconds
+    (None when absent/unparseable).  The serving layer sends it with 429
+    load sheds and 503 drain responses; honoring it beats blind
+    exponential backoff — the server KNOWS how deep its queue is.
+    """
+    return retry_after_from_headers(getattr(exc, "headers", None))
 
 
 def retryable_error(exc: BaseException) -> bool:

@@ -20,14 +20,16 @@ from .errors import (
     DeadlineExceeded,
     Draining,
     EngineWedged,
+    FleetUnavailable,
     Overloaded,
     ServingError,
 )
 from .mock_engine import MockStepEngine
+from .router import FleetRouter
 from .server import EngineServer, serve_config, warmup_engine
 from .session import ContinuousSession, MultiSession
 
 __all__ = ["EngineServer", "serve_config", "warmup_engine",
            "ContinuousSession", "MultiSession", "MockStepEngine",
-           "ServingError", "Overloaded", "Draining", "EngineWedged",
-           "DeadlineExceeded"]
+           "FleetRouter", "ServingError", "Overloaded", "Draining",
+           "EngineWedged", "DeadlineExceeded", "FleetUnavailable"]

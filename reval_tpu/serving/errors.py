@@ -24,6 +24,10 @@ only its request id leaves the server.
   (engine internals, device paths) and therefore ``wire_safe = False``:
   the HTTP boundary logs it and puts only the stable code + request id
   on the wire, exactly like any other unexpected 500.
+- :class:`FleetUnavailable` — the fleet router exhausted its replica
+  candidates (every replica ejected, draining, or dead in transport)
+  (503 + ``Retry-After``).  Distinct from :class:`Overloaded`, which the
+  router raises when replicas are alive but all shedding.
 
 All subclass ``RuntimeError`` so pre-existing callers that caught the
 untyped failures keep working.  The typed-error lint pass
@@ -35,7 +39,7 @@ and waiter ``TimeoutError``).
 from __future__ import annotations
 
 __all__ = ["ServingError", "Overloaded", "Draining", "EngineWedged",
-           "DeadlineExceeded", "EngineFailure"]
+           "DeadlineExceeded", "EngineFailure", "FleetUnavailable"]
 
 
 class ServingError(RuntimeError):
@@ -78,6 +82,19 @@ class EngineWedged(ServingError):
 class DeadlineExceeded(ServingError):
     status = 504
     code = "deadline_exceeded"
+
+
+class FleetUnavailable(ServingError):
+    """Router: no replica could take the request — every candidate was
+    ejected, draining, or died in transport.  Transient by construction
+    (ejection cooldowns are bounded and half-open probes rejoin
+    recovered replicas), so clients back off and retry."""
+
+    status = 503
+    code = "fleet_unavailable"
+
+    def __init__(self, message: str, *, retry_after: float | None = 2.0):
+        super().__init__(message, retry_after=retry_after)
 
 
 class EngineFailure(ServingError):

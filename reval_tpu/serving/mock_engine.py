@@ -13,11 +13,18 @@ behave exactly as they would around a real decode step.
 
 ``step_s`` inserts a per-tick sleep — the knob deadline/drain tests use
 to make "mid-decode" a real, controllable interval.
+
+``echo=True`` makes the canned response a deterministic function of the
+PROMPT (a crc32 tag over its token ids) instead of one fixed string —
+the knob the fleet-router chaos drill turns so "bit-identical greedy
+outputs regardless of which replica answered" is a real assertion, not
+a tautology over identical constants.
 """
 
 from __future__ import annotations
 
 import time
+import zlib
 from types import SimpleNamespace
 
 import numpy as np
@@ -33,13 +40,14 @@ class MockStepEngine:
 
     def __init__(self, response: str = "mock_model_gen", step_s: float = 0.0,
                  tokens_per_step: int = 16, max_slots: int = 8,
-                 max_seq_len: int = 8192):
+                 max_seq_len: int = 8192, echo: bool = False):
         from ..inference.tpu.engine import EngineStats
         from ..inference.tpu.tokenizer import ByteTokenizer
 
         self.tokenizer = ByteTokenizer()
         self.stats = EngineStats()
         self.response = response
+        self.echo = bool(echo)
         self.step_s = float(step_s)
         self.tokens_per_step = int(tokens_per_step)
         self.max_slots = int(max_slots)
@@ -79,6 +87,18 @@ class MockStepEngine:
     def new_drive_state(self):
         return SimpleNamespace(active={}, dirty=True, pending=None)
 
+    def _resp_ids_for(self, req) -> list[int]:
+        """The response token stream for one request: the fixed canned
+        string, or (``echo``) a deterministic crc32 tag over the prompt
+        ids — any two replicas given the same prompt produce the same
+        bytes, so cross-replica failover is output-checkable."""
+        if not self.echo:
+            return self._resp_ids
+        tag = zlib.crc32("|".join(map(str, req.ids)).encode())
+        text = f"{self.response}-echo-{tag:08x}"
+        return [t for t in self.tokenizer.encode(text)
+                if t != self.tokenizer.bos_id]
+
     def close(self) -> None:
         pass
 
@@ -102,7 +122,7 @@ class MockStepEngine:
             if req.t_admit is None:
                 req.t_admit = now
             pos = len(req.generated)
-            chunk = self._resp_ids[pos:pos + self.tokens_per_step]
+            chunk = self._resp_ids_for(req)[pos:pos + self.tokens_per_step]
             if not chunk:
                 chunk = [self.tokenizer.eos_id]
             chunk = chunk[:max(1, req.max_new - pos)]

@@ -1,0 +1,877 @@
+"""Prefix-affinity fleet router: one HTTP tier in front of N EngineServers.
+
+Scale-out past one box (ROADMAP item 3).  ``MultiSession`` already routes
+around unready replicas *inside* one process; this module is the
+standalone tier for a fleet of separately-launched ``reval_tpu serve``
+processes — the vLLM/TGI serving comparison (PAPERS.md, arxiv
+2511.17593) shows routing and overload policy, not raw kernels, dominate
+tail behavior at that scale.
+
+**Routing.**  REval's workload is millions of tiny probe requests whose
+prompts share long per-dataset×template prefixes (50-72% of every
+prompt's tokens are few-shot template — ``tools/prefix_stats.py``
+measures it).  The router consistent-hashes the *affinity key* — a crc32
+of the prompt's first ``window_chars`` characters, i.e. of its template
+prefix — onto a ring of virtual nodes, so every request carrying one
+template lands on the replica whose radix prefix cache is warm for it.
+A ``prefix_stats.py --json`` affinity table seeds the window (the
+shortest template length, so one window fits every task's template) and
+names each template's key for ``/statusz`` placement inspection.
+
+**Robustness is the headline.**
+
+- Per-replica health: a poller drives ``GET /readyz`` per replica;
+  passive accounting counts consecutive forward failures.  Either path
+  ejects a replica (``eject_fails`` strikes); an ejected replica sits
+  out ``cooldown_s`` and then admits ONE half-open probe (or a
+  successful health poll) to rejoin.  One bad replica degrades
+  capacity, never availability.
+- Failover: a forward that dies in transport (connection refused/reset,
+  timeout) or returns a retry-shaped status (429/500/502/503) moves to
+  the next replica on the hash ring — bounded by the replica count, one
+  forward per candidate.  Client-shaped responses (400/404/413/504)
+  pass through verbatim: a bad request or a spent deadline is not the
+  replica's fault.
+- Fleet-wide admission: when every replica sheds (429), the router
+  sheds with ``429`` + the largest replica ``Retry-After`` hint; when
+  no replica is reachable at all it answers ``503``/``fleet_unavailable``
+  + ``Retry-After`` — both through the typed
+  :mod:`~reval_tpu.serving.errors` contract the client's
+  :class:`~reval_tpu.resilience.RetryPolicy` already honors.
+- Drain/rejoin: ``POST /admin/drain`` takes a replica out of rotation
+  without touching its in-flight forwards (they complete; ``/statusz``
+  shows the count draining to zero); ``POST /admin/rejoin`` restores it.
+
+**Federation.**  ``GET /metrics`` scrapes every replica's exposition,
+merges by the registry rule (counters and histogram buckets SUM, gauges
+take last), folds in the router's own counters
+(``reval_router_*``), and re-renders one parseable exposition — one
+scrape sees the whole fleet.  ``GET /statusz`` is the JSON twin with
+per-replica state (health, in-flight, last error, cached ``/readyz``
+detail).  ``GET /readyz`` aggregates: the fleet is ready while ANY
+replica is (the client handshake treats "some replicas ready" as ready).
+
+Request ids pass through untouched in both directions (``X-Request-Id``
+in, echoed out), so a client retry, a router failover, and the serving
+replica's logs all name the same request.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..env import env_float, env_int
+from ..obs import metrics as obs_metrics
+from ..obs.logging import log_event
+from ..obs.metrics import MetricsRegistry, parse_prometheus
+from ..resilience.retry import retry_after_from_headers
+from .errors import FleetUnavailable, Overloaded, ServingError
+
+__all__ = ["FleetRouter", "HashRing", "affinity_key", "federate_metrics",
+           "load_affinity_table"]
+
+#: statuses a *different* replica may be able to serve: shed (429),
+#: internal fault (500), bad gateway (502), draining/wedged (503).
+#: 400/404/413 are the request's fault and 504 is the request's own
+#: deadline — re-spending it elsewhere would only double the damage.
+FAILOVER_STATUSES = frozenset({429, 500, 502, 503})
+
+_RID_RE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _h32(text: str) -> int:
+    return zlib.crc32(text.encode("utf-8", "replace")) & 0xFFFFFFFF
+
+
+def affinity_key(prompt: str, window_chars: int) -> int:
+    """The consistent-hash key for one prompt: crc32 of its first
+    ``window_chars`` characters — the few-shot template prefix, which is
+    what the replica-side radix prefix cache keys on.  Requests sharing
+    a template share a key and therefore a (healthy) replica."""
+    return _h32(prompt[:max(1, int(window_chars))])
+
+
+class HashRing:
+    """Consistent hashing with virtual nodes.  ``order(key)`` walks the
+    ring clockwise from the key and returns every member once, nearest
+    first — the failover candidate order.  Removing a member (ejection
+    skips it at lookup time; membership itself is fixed) moves only the
+    keys that hashed to it, which is the point: a replica loss must not
+    reshuffle every other replica's warm prefix cache."""
+
+    def __init__(self, members: list[str], vnodes: int = 64):
+        self.members = list(members)
+        self.vnodes = int(vnodes)
+        points = []
+        for m in self.members:
+            for v in range(self.vnodes):
+                points.append((_h32(f"{m}#{v}"), m))
+        points.sort()
+        self._points = points
+
+    def order(self, key: int) -> list[str]:
+        if not self._points:
+            return []
+        import bisect
+
+        i = bisect.bisect_left(self._points, (key & 0xFFFFFFFF, ""))
+        seen: set[str] = set()
+        out: list[str] = []
+        n = len(self._points)
+        for j in range(n):
+            member = self._points[(i + j) % n][1]
+            if member not in seen:
+                seen.add(member)
+                out.append(member)
+                if len(out) == len(self.members):
+                    break
+        return out
+
+
+def load_affinity_table(source) -> dict:
+    """Validate an affinity table (``tools/prefix_stats.py --json``) —
+    a path or an already-parsed dict — and return it.  Raises
+    ``ValueError`` on anything that is not a v1 table (a wrong file
+    silently setting a 4-char window would scatter every template)."""
+    table = source
+    if isinstance(source, str):
+        with open(source) as f:
+            table = json.load(f)
+    if (not isinstance(table, dict)
+            or table.get("format") != "reval-affinity-v1"):
+        raise ValueError(
+            "affinity table must be the reval-affinity-v1 JSON that "
+            "`tools/prefix_stats.py --json` emits")
+    window = table.get("window_chars")
+    if not isinstance(window, int) or window < 1:
+        raise ValueError(f"affinity table window_chars must be a positive "
+                         f"integer, got {window!r}")
+    return table
+
+
+class _Replica:
+    """One routed endpoint and its health state machine:
+
+    ``healthy`` → (``eject_fails`` consecutive failures) → ``ejected``
+    → (``cooldown_s`` elapses; ONE half-open probe or a clean health
+    poll succeeds) → ``healthy``.  ``draining`` is an operator state
+    (admin drain/rejoin) orthogonal to health: no new forwards, the
+    in-flight ones finish.
+
+    All transitions go through the methods below; callers never touch
+    the fields directly (the lock discipline the ``locks`` lint pass
+    enforces).  Transition *events* are returned to the caller so the
+    counting/logging happens outside the lock."""
+
+    def __init__(self, rid: str, base_url: str, *, eject_fails: int,
+                 cooldown_s: float, clock=time.monotonic):
+        self.id = rid
+        self.base_url = base_url.rstrip("/")
+        self.eject_fails = int(eject_fails)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "healthy"      # guarded-by: _lock
+        self.fails = 0              # guarded-by: _lock — consecutive FORWARD failures
+        self.poll_fails = 0         # guarded-by: _lock — consecutive dead health polls
+        self.inflight = 0           # guarded-by: _lock
+        self.probing = False        # guarded-by: _lock
+        self.ready = False          # guarded-by: _lock — poller's last verdict
+        self.ready_detail: dict = {}    # guarded-by: _lock
+        self.last_error: str | None = None  # guarded-by: _lock
+        self.ejected_at = 0.0       # guarded-by: _lock
+
+    # -- routing side ------------------------------------------------------
+    def try_acquire(self) -> str | None:
+        """May this replica take a forward right now?  Returns the grant
+        kind — ``"normal"``, or ``"probe"`` when this forward IS the one
+        admitted half-open probe of an ejected replica past its cooldown
+        (pass it back to :meth:`release`) — or None for no.  Draining
+        replicas take nothing."""
+        with self._lock:
+            if self.state == "draining":
+                return None
+            grant = "normal"
+            if self.state == "ejected":
+                if (self._clock() - self.ejected_at < self.cooldown_s
+                        or self.probing):
+                    return None
+                self.probing = True
+                grant = "probe"
+            self.inflight += 1
+            return grant
+
+    def release(self, grant: str, outcome: str,
+                error: str | None = None) -> tuple:
+        """Record a forward's outcome: ``ok`` (served), ``busy`` (HTTP
+        answered 429/503 — alive, just loaded), ``fail`` (transport
+        death or 5xx fault).  ``grant`` is what :meth:`try_acquire`
+        returned — only the probe forward may close the half-open gate
+        (a pre-ejection forward finishing must not re-open it to a
+        thundering herd of concurrent "probes").  Returns transition
+        events (``"ejected"``/``"recovered"``) for the router to
+        count."""
+        events = []
+        with self._lock:
+            self.inflight -= 1
+            if grant == "probe":
+                self.probing = False
+            if outcome in ("ok", "busy"):
+                # an HTTP answer of any status is proof of life: reset
+                # the strike counts; a half-open probe that got through
+                # (even shedding) rejoins the rotation
+                self.fails = 0
+                self.poll_fails = 0
+                self.last_error = None if outcome == "ok" else error
+                if self.state == "ejected":
+                    self.state = "healthy"
+                    events.append("recovered")
+            else:
+                self.fails += 1
+                self.last_error = error
+                if self.state == "ejected":
+                    self.ejected_at = self._clock()     # re-arm cooldown
+                elif self.state == "healthy" and self.fails >= self.eject_fails:
+                    self.state = "ejected"
+                    self.ejected_at = self._clock()
+                    events.append("ejected")
+        return tuple(events)
+
+    # -- health-poller side ------------------------------------------------
+    def note_health(self, alive: bool, ready: bool,
+                    detail: dict | None = None,
+                    error: str | None = None) -> tuple:
+        """Fold one ``/readyz`` poll result in.  ``alive`` means the
+        replica answered HTTP at all (a 503-unready replica is alive).
+        Poll strikes are counted SEPARATELY from forward strikes: a
+        replica whose listener answers health checks while its forwards
+        fail must still eject on the forward count — a clean poll only
+        resets its own counter, never the forwards'."""
+        events = []
+        with self._lock:
+            self.ready = bool(alive and ready)
+            if detail is not None:
+                self.ready_detail = detail
+            if alive:
+                self.poll_fails = 0
+                if (self.state == "ejected" and not self.probing
+                        and self._clock() - self.ejected_at >= self.cooldown_s):
+                    self.state = "healthy"
+                    self.fails = 0
+                    events.append("recovered")
+            else:
+                self.last_error = error
+                if self.state == "healthy":
+                    self.poll_fails += 1
+                    if self.poll_fails >= self.eject_fails:
+                        self.state = "ejected"
+                        self.ejected_at = self._clock()
+                        events.append("ejected")
+        return tuple(events)
+
+    # -- operator side -----------------------------------------------------
+    def set_draining(self, draining: bool) -> None:
+        with self._lock:
+            if draining:
+                self.state = "draining"
+            elif self.state == "draining":
+                self.state = "healthy"
+                self.fails = 0
+
+    def is_ready(self) -> bool:
+        with self._lock:
+            return self.ready and self.state == "healthy"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"id": self.id, "url": self.base_url,
+                    "state": self.state, "ready": self.ready,
+                    "fails": self.fails, "poll_fails": self.poll_fails,
+                    "inflight": self.inflight,
+                    "last_error": self.last_error,
+                    "readyz": self.ready_detail}
+
+
+# -- metrics federation ------------------------------------------------------
+
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (\w+)$")
+_LABEL_RE = re.compile(r"\{.*\}$")
+
+
+def _series_base(series: str, types: dict[str, str]) -> str:
+    """The declaring metric of one sample series: strip labels, then the
+    histogram suffix when the stripped prefix is a declared histogram."""
+    name = _LABEL_RE.sub("", series)
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return name
+
+
+def federate_metrics(texts: list[str]) -> str:
+    """Merge N Prometheus expositions into one, by the registry merge
+    rule: counters and histogram series SUM across replicas, gauges take
+    the LAST merged value.  Series order follows first appearance, so
+    bucket lines stay in their (ascending, cumulative) order and the
+    result re-parses with :func:`~reval_tpu.obs.metrics.parse_prometheus`.
+    Unparseable inputs raise — a scrape must fail loudly, not merge
+    garbage into the fleet view."""
+    types: dict[str, str] = {}
+    values: dict[str, float] = {}
+    bases: dict[str, str] = {}
+    order: list[str] = []
+    for text in texts:
+        local_types: dict[str, str] = {}
+        for line in text.splitlines():
+            m = _TYPE_RE.match(line)
+            if m:
+                local_types[m.group(1)] = m.group(2)
+        for series, value in parse_prometheus(text).items():
+            base = _series_base(series, local_types)
+            mtype = local_types.get(base, "untyped")
+            types.setdefault(base, mtype)
+            if series not in values:
+                order.append(series)
+                values[series] = value
+                bases[series] = base
+            elif types[base] == "gauge":
+                values[series] = value
+            else:
+                values[series] += value
+    lines: list[str] = []
+    emitted: set[str] = set()
+    spec = obs_metrics.METRICS
+    for series in order:
+        base = bases[series]
+        if base not in emitted:
+            emitted.add(base)
+            help_text = spec.get(base, {}).get("help", "")
+            lines.append(f"# HELP {base} {help_text}")
+            lines.append(f"# TYPE {base} {types[base]}")
+        v = values[series]
+        rendered = str(int(v)) if float(v).is_integer() else repr(float(v))
+        lines.append(f"{series} {rendered}")
+    return "\n".join(lines) + "\n"
+
+
+# -- the router --------------------------------------------------------------
+
+class FleetRouter:
+    """HTTP front tier over ``replicas`` (``["host:port", ...]`` or bare
+    ports).  ``start()`` serves on a daemon thread; ``shutdown()`` stops
+    the poller and listener (replica servers are not this tier's to
+    stop).
+
+    Knobs (constructor args override the ``REVAL_TPU_ROUTER_*`` env
+    defaults): ``vnodes`` per replica on the hash ring, ``eject_fails``
+    consecutive failures before ejection, ``cooldown_s`` before a
+    half-open probe, ``window_chars`` for the affinity key,
+    ``health_interval_s`` between ``/readyz`` polls.
+    ``affinity_table`` (path or dict from ``prefix_stats.py --json``)
+    overrides ``window_chars`` and names the expected template keys."""
+
+    def __init__(self, replicas: list, port: int = 0,
+                 host: str = "127.0.0.1", *, model_id: str = "reval-fleet",
+                 vnodes: int | None = None, eject_fails: int | None = None,
+                 cooldown_s: float | None = None,
+                 window_chars: int | None = None,
+                 health_interval_s: float | None = None,
+                 affinity_table=None, forward_timeout_s: float = 600.0,
+                 max_body_bytes: int = 64 << 20, clock=time.monotonic):
+        self.model_id = model_id
+        vnodes = vnodes if vnodes is not None else \
+            env_int("REVAL_TPU_ROUTER_VNODES", 64)
+        eject_fails = eject_fails if eject_fails is not None else \
+            env_int("REVAL_TPU_ROUTER_EJECT_FAILS", 3)
+        cooldown_s = cooldown_s if cooldown_s is not None else \
+            env_float("REVAL_TPU_ROUTER_COOLDOWN_S", 5.0)
+        self.window_chars = window_chars if window_chars is not None else \
+            env_int("REVAL_TPU_ROUTER_AFFINITY_WINDOW", 1024)
+        self.health_interval_s = (
+            health_interval_s if health_interval_s is not None
+            else env_float("REVAL_TPU_ROUTER_HEALTH_INTERVAL_S", 1.0))
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.affinity: dict = {}
+        if affinity_table is not None:
+            table = load_affinity_table(affinity_table)
+            self.window_chars = int(table["window_chars"])
+            self.affinity = table
+        # unguarded: built once here, read-only thereafter (membership is
+        # fixed; per-replica mutable state lives behind each _Replica's lock)
+        self._replicas: dict[str, _Replica] = {}
+        for rep in replicas:
+            rid = str(rep) if ":" in str(rep) else f"127.0.0.1:{rep}"
+            self._replicas[rid] = _Replica(
+                rid, f"http://{rid}", eject_fails=eject_fails,
+                cooldown_s=cooldown_s, clock=clock)
+        self._ring = HashRing(list(self._replicas), vnodes=vnodes)
+        #: router-level counters/gauges, merged into the federation
+        self._obs = MetricsRegistry()
+        self._poll_stop = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+        self._thread: threading.Thread | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload: dict,
+                      headers: dict | None = None,
+                      request_id: str | None = None) -> None:
+                self._send_bytes(code, json.dumps(payload).encode(),
+                                 "application/json", headers, request_id)
+
+            def _send_bytes(self, code: int, body: bytes, ctype: str,
+                            headers: dict | None = None,
+                            request_id: str | None = None) -> None:
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    if request_id is not None:
+                        self.send_header("X-Request-Id", request_id)
+                    for key, value in (headers or {}).items():
+                        self.send_header(key, value)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass        # client hung up; nothing shared is harmed
+
+            def do_GET(self):
+                path = self.path.rstrip("/")
+                rid = (_RID_RE.sub("", self.headers.get("X-Request-Id", ""))
+                       [:64] or None)
+                if path in ("/healthz", "/v1/healthz"):
+                    self._send(200, {"status": "ok", "router": True,
+                                     "model": outer.model_id},
+                               request_id=rid)
+                elif path in ("/readyz", "/v1/readyz"):
+                    body = outer.readiness()
+                    self._send(200 if body["ready"] else 503, body,
+                               None if body["ready"] else {"Retry-After": "1"},
+                               request_id=rid)
+                elif path in ("/metrics", "/v1/metrics"):
+                    self._send_bytes(
+                        200, outer.metrics_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        request_id=rid)
+                elif path in ("/statusz", "/v1/statusz"):
+                    self._send(200, outer.statusz(), request_id=rid)
+                elif path == "/v1/models":
+                    self._proxy_models(rid)
+                else:
+                    self._send(404, {"error": {
+                        "code": "not_found",
+                        "message": f"unknown route {self.path}"}},
+                        request_id=rid)
+
+            def _proxy_models(self, rid) -> None:
+                for rep in outer._candidates(0):
+                    grant = rep.try_acquire()
+                    if grant is None:
+                        continue
+                    try:
+                        req = urllib.request.Request(rep.base_url + "/v1/models")
+                        with urllib.request.urlopen(req, timeout=10) as resp:
+                            body = resp.read()
+                        # a successful models proxy can BE the half-open
+                        # probe: count/log the recovery like any forward
+                        outer._note(rep.release(grant, "ok"), rep)
+                        self._send_bytes(200, body, "application/json",
+                                         request_id=rid)
+                        return
+                    except Exception as exc:    # noqa: BLE001 — any dead
+                        # replica just moves the proxy to the next one
+                        outer._note(rep.release(grant, "fail", repr(exc)),
+                                    rep)
+                self._send(503, {"error": {
+                    "code": FleetUnavailable.code,
+                    "message": "no replica answered /v1/models"}},
+                    {"Retry-After": "1"}, request_id=rid)
+
+            def do_POST(self):
+                rid = (_RID_RE.sub("", self.headers.get("X-Request-Id", ""))
+                       [:64] or None)
+                path = self.path.rstrip("/")
+                if path == "/admin/drain":
+                    self._admin(rid, draining=True)
+                    return
+                if path == "/admin/rejoin":
+                    self._admin(rid, draining=False)
+                    return
+                if path != "/v1/completions":
+                    self._send(404, {"error": {
+                        "code": "not_found",
+                        "message": f"unknown route {self.path}"}},
+                        request_id=rid)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = -1
+                if length < 0 or length > outer.max_body_bytes:
+                    self._send(413 if length > 0 else 400, {"error": {
+                        "code": "request_too_large" if length > 0
+                                else "invalid_request",
+                        "message": "bad or oversized request body"}},
+                        request_id=rid)
+                    return
+                body = self.rfile.read(length)
+                try:
+                    outer._route_completion(self, body, rid)
+                except ServingError as exc:
+                    headers = None
+                    if exc.retry_after is not None:
+                        headers = {"Retry-After":
+                                   str(int(math.ceil(exc.retry_after)))}
+                    self._send(exc.status, {"error": {
+                        "code": exc.code, "message": str(exc),
+                        **({"request_id": rid} if rid else {})}},
+                        headers, request_id=rid)
+
+            def _admin(self, rid, *, draining: bool) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(max(0, length)) or b"{}")
+                    target = str(req.get("replica", ""))
+                except Exception:
+                    target = ""
+                rep = outer._replicas.get(target)
+                if rep is None:
+                    self._send(404, {"error": {
+                        "code": "not_found",
+                        "message": f"no such replica {target!r}"}},
+                        request_id=rid)
+                    return
+                rep.set_draining(draining)
+                log_event("router.drain", replica=rep.id,
+                          draining=draining)
+                self._send(200, {"replica": rep.snapshot()}, request_id=rid)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    # -- candidate selection ----------------------------------------------
+    def _candidates(self, key: int) -> list[_Replica]:
+        """Replicas in failover order for one affinity key: the hash
+        ring's clockwise walk, with READY replicas ahead of merely-alive
+        ones (an unready replica would only shed or stall a request a
+        ready sibling has room for)."""
+        ordered = [self._replicas[rid] for rid in self._ring.order(key)]
+        ready, rest = [], []
+        for rep in ordered:
+            # ONE is_ready() per replica: a readiness flip between two
+            # passes must not land the same replica in both lists (the
+            # loop would then forward to it twice for one request)
+            (ready if rep.is_ready() else rest).append(rep)
+        return ready + rest
+
+    def _note(self, events: tuple, rep: _Replica) -> None:
+        """Count + log replica state transitions (outside replica locks)."""
+        for event in events:
+            if event == "ejected":
+                self._obs.counter(obs_metrics.ROUTER_EJECTIONS).add(1)
+                log_event("router.eject", level="warning", replica=rep.id,
+                          error=rep.snapshot()["last_error"])
+            elif event == "recovered":
+                self._obs.counter(obs_metrics.ROUTER_RECOVERIES).add(1)
+                log_event("router.recover", replica=rep.id)
+        if events:
+            self._set_ready_gauge()
+
+    def _set_ready_gauge(self) -> None:
+        self._obs.gauge(obs_metrics.ROUTER_REPLICAS_READY).set(
+            sum(1 for r in self._replicas.values() if r.is_ready()))
+
+    # -- the forward path ---------------------------------------------------
+    def _route_completion(self, handler, body: bytes, rid: str | None) -> None:
+        self._obs.counter(obs_metrics.ROUTER_REQUESTS).add(1)
+        try:
+            req = json.loads(body or b"{}")
+        except Exception:
+            req = {}
+        prompts = req.get("prompt", "") if isinstance(req, dict) else ""
+        first = prompts if isinstance(prompts, str) else \
+            (prompts[0] if isinstance(prompts, list) and prompts
+             and isinstance(prompts[0], str) else "")
+        key = affinity_key(first, self.window_chars)
+        stream = bool(isinstance(req, dict) and req.get("stream"))
+        deadline_s = req.get("deadline_s") if isinstance(req, dict) else None
+        timeout = (min(float(deadline_s) + 30.0, self.forward_timeout_s)
+                   if isinstance(deadline_s, (int, float)) and deadline_s > 0
+                   else self.forward_timeout_s)
+        ring_order = self._ring.order(key)
+        primary = ring_order[0] if ring_order else None
+        attempted = 0
+        all_busy = True
+        retry_hint = 0.0
+        last_error = "no eligible replica (ejected/draining/cooldown)"
+        for rep in self._candidates(key):
+            grant = rep.try_acquire()
+            if grant is None:
+                continue
+            attempted += 1
+            if rep.id == primary and attempted == 1:
+                self._obs.counter(obs_metrics.ROUTER_ROUTED).add(1)
+            else:
+                self._obs.counter(obs_metrics.ROUTER_FAILOVERS).add(1)
+                log_event("router.failover", request_id=rid,
+                          replica=rep.id, attempt=attempted,
+                          reason=last_error)
+            headers = {"Content-Type": "application/json"}
+            if rid:
+                headers["X-Request-Id"] = rid
+            fwd = urllib.request.Request(
+                rep.base_url + "/v1/completions", data=body,
+                headers=headers, method="POST")
+            try:
+                resp = urllib.request.urlopen(fwd, timeout=timeout)
+            except urllib.error.HTTPError as exc:
+                err_body = exc.read()
+                hint = retry_after_from_headers(exc.headers)
+                if exc.code in FAILOVER_STATUSES:
+                    busy = exc.code in (429, 503)
+                    outcome = "busy" if busy else "fail"
+                    all_busy = all_busy and busy
+                    retry_hint = max(retry_hint, hint or 0.0)
+                    last_error = f"HTTP {exc.code} from {rep.id}"
+                    self._note(rep.release(grant, outcome, last_error), rep)
+                    continue
+                # client-shaped response (400/404/413/504): the verdict
+                # stands wherever it runs — pass it through verbatim
+                self._note(rep.release(grant, "ok"), rep)
+                pass_headers = {}
+                if hint is not None:
+                    pass_headers["Retry-After"] = str(int(math.ceil(hint)))
+                handler._send_bytes(
+                    exc.code, err_body, "application/json", pass_headers,
+                    request_id=rid or exc.headers.get("X-Request-Id"))
+                return
+            except Exception as exc:    # noqa: BLE001 — transport death
+                # (refused/reset/timeout) is exactly what failover is for
+                all_busy = False
+                last_error = repr(exc)
+                self._note(rep.release(grant, "fail", last_error), rep)
+                continue
+            try:
+                if stream:
+                    upstream_err = self._pipe_stream(handler, resp, rid)
+                else:
+                    out = resp.read()
+                    # the replica mints an id when the caller sent none:
+                    # surface it so the one-request-one-id contract holds
+                    # through the extra hop
+                    handler._send_bytes(
+                        resp.status, out, "application/json",
+                        request_id=rid or resp.headers.get("X-Request-Id"))
+                    upstream_err = None
+            except Exception as exc:    # noqa: BLE001 — the replica died
+                # between accepting the forward and delivering the body
+                # (reset mid-read, pre-headers stream death): NOTHING has
+                # reached the client yet, so the next candidate may serve
+                resp.close()
+                all_busy = False
+                last_error = repr(exc)
+                self._note(rep.release(grant, "fail", last_error), rep)
+                continue
+            resp.close()
+            if upstream_err is not None:
+                # bytes already reached the client (no retransmit), but
+                # the truncation is the REPLICA's strike — a replica that
+                # keeps resetting mid-stream must accumulate toward
+                # ejection, not read as healthy
+                self._note(rep.release(grant, "fail", upstream_err), rep)
+            else:
+                self._note(rep.release(grant, "ok"), rep)
+            return
+        # every candidate was unavailable, saturated, or failed
+        self._obs.counter(obs_metrics.ROUTER_SHEDS).add(1)
+        log_event("router.shed", level="warning", request_id=rid,
+                  attempted=attempted, reason=last_error)
+        if attempted and all_busy:
+            raise Overloaded(
+                f"all {len(self._replicas)} replicas are saturated",
+                retry_after=max(1.0, retry_hint))
+        raise FleetUnavailable(
+            f"no replica could take the request "
+            f"({attempted} forwards failed; last: {last_error})")
+
+    @staticmethod
+    def _pipe_stream(handler, resp, rid: str | None) -> str | None:
+        """Byte-transparent SSE proxy.  Returns None when the stream
+        completed (or the CLIENT hung up — not the replica's fault), or
+        an error string when the UPSTREAM died mid-stream: the client
+        got a truncated 200 (append-only SSE cannot retract), and the
+        caller records the strike against the replica.  An upstream
+        death BEFORE the first byte raises instead, so the caller can
+        still fail over — nothing has touched the client socket yet."""
+        def read_chunk() -> bytes:
+            return (resp.read1(65536) if hasattr(resp, "read1")
+                    else resp.read(65536))
+
+        chunk = read_chunk()    # pre-headers: a death here propagates
+        try:
+            handler.send_response(resp.status)
+            handler.send_header("Content-Type",
+                                resp.headers.get("Content-Type",
+                                                 "text/event-stream"))
+            handler.send_header("Cache-Control", "no-cache")
+            rid_out = rid or resp.headers.get("X-Request-Id")
+            if rid_out:
+                handler.send_header("X-Request-Id", rid_out)
+            handler.end_headers()
+        except OSError:
+            return None         # client gone before headers; replica fine
+        while chunk:
+            try:
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+            except OSError:
+                return None     # client hung up: stream done, replica fine
+            try:
+                chunk = read_chunk()
+            except Exception as exc:    # noqa: BLE001 — the replica reset
+                # under an in-flight stream
+                return f"upstream died mid-stream: {exc!r}"
+        return None
+
+    # -- health poller ------------------------------------------------------
+    def _each_replica(self, fn, join_timeout_s: float = 10.0) -> None:
+        """Run ``fn(replica)`` for every replica CONCURRENTLY (one
+        short-lived thread each — replica counts are small) so one hung
+        socket cannot stretch every sibling's health cadence or stall a
+        fleet scrape behind serial 5 s timeouts."""
+        threads = [threading.Thread(target=fn, args=(rep,), daemon=True)
+                   for rep in self._replicas.values()]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + join_timeout_s
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+
+    def _poll_one(self, rep: _Replica) -> None:
+        try:
+            with urllib.request.urlopen(rep.base_url + "/readyz",
+                                        timeout=5) as resp:
+                detail = json.loads(resp.read())
+            events = rep.note_health(True, True, detail)
+        except urllib.error.HTTPError as exc:
+            try:
+                detail = json.loads(exc.read())
+            except Exception:
+                detail = {}
+            events = rep.note_health(True, False, detail,
+                                     f"HTTP {exc.code}")
+        except Exception as exc:    # noqa: BLE001 — a dead poll is
+            # exactly the health signal being collected
+            events = rep.note_health(False, False, None, repr(exc))
+        self._note(events, rep)
+
+    def _poll(self) -> None:
+        while not self._poll_stop.wait(self.health_interval_s):
+            self._each_replica(self._poll_one)
+            self._set_ready_gauge()
+
+    # -- introspection ------------------------------------------------------
+    def readiness(self) -> dict:
+        """The aggregate ``/readyz`` body: ready while ANY replica is —
+        degraded capacity still serves (the client handshake treats
+        "some replicas ready" as ready)."""
+        reps = [r.snapshot() for r in self._replicas.values()]
+        ready_n = sum(1 for r in reps if r["ready"] and r["state"] == "healthy")
+        return {"status": "ready" if ready_n else "unready",
+                "ready": ready_n > 0, "router": True,
+                "replicas_ready": ready_n, "replicas_total": len(reps),
+                "replicas": reps}
+
+    def statusz(self) -> dict:
+        out = {"router": True, "model": self.model_id,
+               "window_chars": self.window_chars,
+               "ring": {"members": self._ring.members,
+                        "vnodes": self._ring.vnodes},
+               "replicas": [r.snapshot() for r in self._replicas.values()],
+               "metrics": self._obs.snapshot()}
+        if self.affinity:
+            placement = {}
+            for task, row in (self.affinity.get("tasks") or {}).items():
+                try:
+                    key = int(str(row.get("key")), 16)
+                except (TypeError, ValueError):
+                    continue
+                order = self._ring.order(key)
+                placement[task] = {"key": row.get("key"),
+                                   "replica": order[0] if order else None}
+            out["affinity"] = {"window_chars": self.window_chars,
+                               "placement": placement}
+        return out
+
+    def metrics_text(self) -> str:
+        """The federated exposition: every reachable replica's scrape +
+        the router's own counters, merged by the registry rule.  A
+        replica that cannot be scraped — or whose text does not PARSE
+        (a proxy error page, a foreign exposition dialect) — contributes
+        nothing this round (its last state is visible in ``/statusz``);
+        replicas are scraped concurrently so one hung socket cannot
+        stall the whole fleet view."""
+        texts = [self._obs.render_prometheus()]
+        texts_lock = threading.Lock()
+
+        def scrape(rep: _Replica) -> None:
+            try:
+                with urllib.request.urlopen(rep.base_url + "/metrics",
+                                            timeout=5) as resp:
+                    text = resp.read().decode()
+                parse_prometheus(text)      # reject garbage BEFORE merge
+            except Exception:   # noqa: BLE001 — an unscrapeable replica
+                return          # must not take the fleet view down
+            with texts_lock:
+                texts.append(text)
+
+        self._each_replica(scrape)
+        return federate_metrics(texts)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="fleet-router")
+            self._thread.start()
+        if self._poll_thread is None:
+            self._poll_thread = threading.Thread(
+                target=self._poll, daemon=True, name="fleet-router-poller")
+            self._poll_thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while self._thread is not None and self._thread.is_alive():
+                self._thread.join(timeout=1.0)
+        except KeyboardInterrupt:
+            pass
+
+    def shutdown(self) -> None:
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10)
+            self._poll_thread = None
+        if self._thread is not None:
+            # only a RUNNING serve loop can acknowledge shutdown();
+            # calling it on a never-started server would block forever
+            self._httpd.shutdown()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._httpd.server_close()

@@ -854,7 +854,8 @@ def serve_config(cfg: dict, *, port: int | None = None,
 
         engine = MockStepEngine(
             response=cfg.get("mock_response", "mock_model_gen"),
-            step_s=float(cfg.get("mock_step_s", 0.0)))
+            step_s=float(cfg.get("mock_step_s", 0.0)),
+            echo=bool(cfg.get("mock_echo", False)))
         session = ContinuousSession(engine, step_chaos=step_chaos,
                                     **lifecycle)
         server = EngineServer(session.generate_fn(), model_id=model_id,
@@ -873,8 +874,8 @@ def serve_config(cfg: dict, *, port: int | None = None,
                             if k not in ("task", "backend", "port", "mock",
                                          "max_queued_tokens", "watchdog_s",
                                          "max_body_bytes", "trace_out",
-                                         "postmortem_dir",
-                                         "mock_response", "mock_step_s")})
+                                         "postmortem_dir", "mock_response",
+                                         "mock_step_s", "mock_echo")})
     if warmup:
         secs = warmup_engine(backend.engine)
         print(f"warmup: generation programs compiled in {secs:.1f}s")

@@ -560,3 +560,48 @@ def test_bench_failure_emits_stale_marker():
     assert out["status"] == "stale"
     assert out["stale_probes_per_sec"] == out["last_known"]["value"] > 0
     assert out["stale_commit"] == out["last_known"]["measured_at_commit"]
+
+
+def test_bench_probe_self_heals_with_retry_backoff():
+    """ROADMAP item 5 remainder: the pre-flight tunnel probe retries
+    under the resilience layer's RetryPolicy — exponential backoff, not
+    a fixed sleep — before a round is ever declared stale, and the
+    schedule is unit-testable via the injectable runner/sleep."""
+    from types import SimpleNamespace
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = {"n": 0}
+
+    def flaky(cmd, **kw):
+        # two wedged probes (the transient-tunnel shape), then recovery
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 1))
+        return SimpleNamespace(returncode=0,
+                               stdout="4|TPU v5 lite|tpu\n", stderr="")
+
+    delays: list[float] = []
+    health, err = bench.probe_devices(retries=6, wait_s=1.0,
+                                      runner=flaky, sleep=delays.append)
+    assert health == (4, "TPU v5 lite", "tpu") and err == ""
+    assert calls["n"] == 3
+    assert len(delays) == 2
+    assert delays[1] > delays[0] * 1.5      # backoff grows, no lockstep
+
+    def wedged(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 1))
+
+    delays2: list[float] = []
+    health, err = bench.probe_devices(retries=3, wait_s=1.0,
+                                      runner=wedged, sleep=delays2.append)
+    assert health is None and err == "timeout"  # the stale-marker verdict
+    assert len(delays2) == 2                    # bounded budget
+
+    def crashing(cmd, **kw):
+        return SimpleNamespace(returncode=1, stdout="", stderr="boom")
+
+    health, err = bench.probe_devices(retries=2, wait_s=0.1, runner=crashing,
+                                      sleep=lambda s: None)
+    assert health is None and "rc=1" in err and "boom" in err

@@ -1,0 +1,754 @@
+"""Fleet router: prefix-affinity routing, failover, and the chaos drill.
+
+Everything runs host-only: mock replicas (``serve --mock`` servers in
+``echo`` mode — responses are a deterministic function of the prompt, so
+"bit-identical regardless of which replica answered" is a real check)
+behind a real :class:`FleetRouter` over real HTTP.
+
+The headline is the chaos drill (ISSUE 7 acceptance): kill one of two
+replicas mid-``fleet``, watch the router re-route, finish with ZERO lost
+prompts, run ``fleet --resume`` against the intact journal, and diff the
+task logs byte-for-byte against a single-replica run — plus the
+federated ``/metrics`` accounting the ejection and failover.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reval_tpu.inference.client import HTTPClientBackend
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.obs.metrics import parse_prometheus
+from reval_tpu.serving import FleetRouter, serve_config
+from reval_tpu.serving.router import (HashRing, affinity_key,
+                                      federate_metrics, load_affinity_table)
+
+TEMPLATE_A = "few-shot template alpha | " * 40
+TEMPLATE_B = "few-shot template bravo | " * 40
+
+FAST_RETRY = {"max_attempts": 10, "base_delay": 0.02,
+              "max_delay": 0.3, "jitter": 0.1}
+
+
+def make_replica(port=0, **cfg):
+    base = {"mock": True, "mock_echo": True}
+    base.update(cfg)
+    return serve_config(base, port=port).start()
+
+
+def make_router(servers, **kw):
+    kw.setdefault("health_interval_s", 0.05)
+    kw.setdefault("cooldown_s", 0.4)
+    kw.setdefault("eject_fails", 2)
+    router = FleetRouter([f"127.0.0.1:{s.port}" for s in servers],
+                         port=0, **kw)
+    return router.start()
+
+
+def wait_router_ready(router, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.readiness()["ready"]:
+            return
+        time.sleep(0.02)
+    raise AssertionError("router never became ready")
+
+
+def hard_kill(server) -> None:
+    """A crash, not a drain: the listener dies under its in-flight
+    sockets; the session driver is left running (daemon) like a real
+    kill -9 leaves no one to clean up."""
+    server._httpd.shutdown()
+    server._httpd.server_close()
+
+
+def post_router(router, prompt, rid=None, max_tokens=64, timeout=30,
+                extra=None):
+    body = {"prompt": prompt, "max_tokens": max_tokens}
+    body.update(extra or {})
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/v1/completions",
+        data=json.dumps(body).encode(), headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def router_samples(router):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics", timeout=10) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def prompt_targeting(router, replica_id) -> str:
+    """A prompt whose hash-ring PRIMARY is ``replica_id`` — the
+    variation sits INSIDE the affinity window (a distinct "template"
+    per candidate), because anything past the window cannot move the
+    key by construction."""
+    window = router.window_chars
+    for i in range(4096):
+        p = f"targeted template {i} | " + TEMPLATE_A
+        if router._ring.order(affinity_key(p, window))[0] == replica_id:
+            return p
+    raise AssertionError(f"no prompt hashes to {replica_id}")
+
+
+# ---------------------------------------------------------------------------
+# Pure pieces: ring, affinity key, federation, table loading
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_orders_all_members_and_is_stable_under_loss():
+    members = [f"127.0.0.1:{3000 + i}" for i in range(4)]
+    ring = HashRing(members, vnodes=64)
+    keys = [affinity_key(f"template {i} " * 30, 512) for i in range(200)]
+    lost = members[1]
+    for key in keys:
+        order = ring.order(key)
+        assert sorted(order) == sorted(members)     # every member, once
+        assert order == ring.order(key)             # deterministic
+        # consistent hashing: removing one member must not move any key
+        # whose primary was someone else
+        survivors = [m for m in order if m != lost]
+        if order[0] != lost:
+            assert survivors[0] == order[0]
+
+
+def test_affinity_key_windows_the_template():
+    window = len(TEMPLATE_A) - 10
+    a1 = affinity_key(TEMPLATE_A + "probe one", window)
+    a2 = affinity_key(TEMPLATE_A + "a completely different suffix", window)
+    b = affinity_key(TEMPLATE_B + "probe one", window)
+    assert a1 == a2                 # same template → same replica
+    assert a1 != b                  # distinct templates spread
+
+
+def test_federate_metrics_sums_counters_and_buckets_takes_last_gauge():
+    a = ("# HELP reval_requests_total x\n# TYPE reval_requests_total counter\n"
+         "reval_requests_total 3\n"
+         "# HELP g x\n# TYPE g gauge\ng 5\n"
+         "# HELP h x\n# TYPE h histogram\n"
+         'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 3\nh_sum 1.5\nh_count 3\n')
+    b = ("# HELP reval_requests_total x\n# TYPE reval_requests_total counter\n"
+         "reval_requests_total 4\n"
+         "# HELP g x\n# TYPE g gauge\ng 9\n"
+         "# HELP h x\n# TYPE h histogram\n"
+         'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 1\nh_sum 0.25\nh_count 1\n')
+    merged = federate_metrics([a, b])
+    samples = parse_prometheus(merged)      # must re-parse cleanly
+    assert samples["reval_requests_total"] == 7
+    assert samples["g"] == 9                # gauge: last merged wins
+    assert samples['h_bucket{le="1"}'] == 3
+    assert samples['h_bucket{le="+Inf"}'] == 4
+    assert samples["h_sum"] == 1.75
+    assert samples["h_count"] == 4
+    with pytest.raises(ValueError):
+        federate_metrics(["not an exposition {{{"])
+
+
+def test_affinity_table_validation_and_placement():
+    table = {"format": "reval-affinity-v1", "window_chars": 200,
+             "tasks": {"coverage": {"template_chars": 400, "key": "0a1b2c3d"},
+                       "path": {"template_chars": 250, "key": "deadbeef"}}}
+    assert load_affinity_table(dict(table))["window_chars"] == 200
+    for bad in ({}, {"format": "v0"}, {"format": "reval-affinity-v1",
+                                       "window_chars": 0}):
+        with pytest.raises(ValueError):
+            load_affinity_table(bad)
+    srv = make_replica()
+    try:
+        router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                             affinity_table=table)
+        assert router.window_chars == 200
+        status = router.statusz()
+        placement = status["affinity"]["placement"]
+        assert set(placement) == {"coverage", "path"}
+        assert placement["coverage"]["replica"] == f"127.0.0.1:{srv.port}"
+        router.shutdown()
+    finally:
+        srv.shutdown()
+
+
+def test_replica_forward_strikes_survive_clean_health_polls():
+    """A replica whose listener answers /readyz while its forwards die
+    must still eject on the forward strike count — clean polls reset
+    only their own counter."""
+    from reval_tpu.serving.router import _Replica
+
+    rep = _Replica("r", "http://x", eject_fails=3, cooldown_s=1.0)
+    for i in range(2):
+        grant = rep.try_acquire()
+        assert rep.release(grant, "fail", "boom") == ()
+        assert rep.note_health(True, True, {}) == ()    # poll must not heal
+    grant = rep.try_acquire()
+    assert rep.release(grant, "fail", "boom") == ("ejected",)
+    assert rep.snapshot()["state"] == "ejected"
+    # conversely, poll strikes accumulate on their own counter
+    rep2 = _Replica("r2", "http://x", eject_fails=2, cooldown_s=1.0)
+    assert rep2.note_health(False, False, None, "dead") == ()
+    assert rep2.note_health(False, False, None, "dead") == ("ejected",)
+
+
+def test_half_open_gate_admits_exactly_one_probe():
+    """A pre-ejection forward finishing must not re-open the half-open
+    gate: only the probe's own release closes it."""
+    from reval_tpu.serving.router import _Replica
+
+    clock = {"t": 0.0}
+    rep = _Replica("r", "http://x", eject_fails=1, cooldown_s=5.0,
+                   clock=lambda: clock["t"])
+    old = rep.try_acquire()             # long-running pre-ejection forward
+    assert old == "normal"
+    bad = rep.try_acquire()
+    rep.release(bad, "fail", "boom")    # ejects (eject_fails=1)
+    assert rep.snapshot()["state"] == "ejected"
+    clock["t"] = 10.0                   # cooldown elapsed
+    probe = rep.try_acquire()
+    assert probe == "probe"
+    # the OLD forward dying must NOT clear the probe gate: with the gate
+    # wrongly re-opened, every request past cooldown would be admitted
+    # as an extra concurrent "probe" against the possibly-dead replica
+    rep.release(old, "fail", "old forward died")
+    clock["t"] = 20.0
+    assert rep.try_acquire() is None    # the one probe is still out
+    # only the probe's own resolution closes the gate
+    rep.release(probe, "ok")
+    assert rep.snapshot()["state"] == "healthy"
+    assert rep.try_acquire() == "normal"
+
+
+def test_metrics_federation_skips_unparseable_replica():
+    """One replica answering /metrics with garbage (a proxy error page)
+    must not take the fleet scrape down."""
+    import http.server
+
+    class Garbage(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            # every route, /readyz included, answers an HTML error page:
+            # the replica never reads as ready (so the POST below routes
+            # to the real one) and its /metrics text must be SKIPPED by
+            # the federation, not crash it
+            body = b"<html>502 Bad Gateway</html>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    garbage = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Garbage)
+    g_thread = threading.Thread(target=garbage.serve_forever, daemon=True)
+    g_thread.start()
+    srv = make_replica()
+    router = FleetRouter(
+        [f"127.0.0.1:{srv.port}", f"127.0.0.1:{garbage.server_address[1]}"],
+        port=0, health_interval_s=0.05).start()
+    try:
+        wait_router_ready(router)
+        post_router(router, "one real request")
+        samples = router_samples(router)        # must parse: garbage skipped
+        assert samples["reval_requests_total"] >= 1
+        assert samples[obs_metrics.ROUTER_REQUESTS] >= 1
+    finally:
+        router.shutdown()
+        garbage.shutdown()
+        garbage.server_close()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Routing behavior over live replicas
+# ---------------------------------------------------------------------------
+
+def test_template_affinity_pins_each_template_to_one_replica():
+    servers = [make_replica() for _ in range(2)]
+    router = make_router(servers, window_chars=len(TEMPLATE_A) - 5)
+    try:
+        wait_router_ready(router)
+        for template in (TEMPLATE_A, TEMPLATE_B):
+            before = [s._session.engine.stats.prompts for s in servers]
+            for i in range(4):
+                post_router(router, template + f"probe {i}")
+            served = [s._session.engine.stats.prompts - b
+                      for s, b in zip(servers, before)]
+            # one replica took all four; the other none — the warm-cache
+            # invariant routing exists for
+            assert sorted(served) == [0, 4], served
+        samples = router_samples(router)
+        assert samples[obs_metrics.ROUTER_REQUESTS] == 8
+        assert samples[obs_metrics.ROUTER_ROUTED] == 8
+        assert samples.get(obs_metrics.ROUTER_FAILOVERS, 0) == 0
+    finally:
+        router.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def test_request_id_passes_through_and_is_minted_when_absent():
+    servers = [make_replica()]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        _, headers = post_router(router, "p", rid="drill-rid-42")
+        assert headers.get("X-Request-Id") == "drill-rid-42"
+        _, headers = post_router(router, "p")
+        # the replica minted one; the router must surface it
+        assert headers.get("X-Request-Id")
+    finally:
+        router.shutdown()
+        servers[0].shutdown()
+
+
+def test_client_error_passes_through_without_failover():
+    servers = [make_replica() for _ in range(2)]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_router(router, "p", extra={"max_tokens": -1})
+        assert err.value.code == 400
+        assert json.loads(err.value.read())["error"]["code"] == \
+            "invalid_request"
+        assert router_samples(router).get(
+            obs_metrics.ROUTER_FAILOVERS, 0) == 0
+    finally:
+        router.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def test_replica_kill_fails_over_and_ejects_then_half_open_recovers():
+    servers = [make_replica() for _ in range(2)]
+    router = make_router(servers, eject_fails=2, cooldown_s=0.3)
+    try:
+        wait_router_ready(router)
+        victim = servers[0]
+        victim_id = f"127.0.0.1:{victim.port}"
+        target = prompt_targeting(router, victim_id)
+        out1, _ = post_router(router, target)
+        hard_kill(victim)
+        # the same prompt must still serve — transport failover — and
+        # produce the same bytes (echo mode) from the surviving replica
+        out2, _ = post_router(router, target)
+        assert out2["choices"][0]["text"] == out1["choices"][0]["text"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            states = {r["id"]: r["state"]
+                      for r in router.statusz()["replicas"]}
+            if states[victim_id] == "ejected":
+                break
+            time.sleep(0.02)
+        assert states[victim_id] == "ejected"
+        samples = router_samples(router)
+        assert samples[obs_metrics.ROUTER_EJECTIONS] >= 1
+        assert samples[obs_metrics.ROUTER_FAILOVERS] >= 1
+        # resurrect the replica ON THE SAME PORT; after the cooldown the
+        # health poller (or a half-open probe) must rejoin it
+        revived = make_replica(port=victim.port)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                states = {r["id"]: r["state"]
+                          for r in router.statusz()["replicas"]}
+                if states[victim_id] == "healthy":
+                    break
+                time.sleep(0.05)
+            assert states[victim_id] == "healthy"
+            assert router_samples(router)[obs_metrics.ROUTER_RECOVERIES] >= 1
+            out3, _ = post_router(router, target)
+            assert out3["choices"][0]["text"] == out1["choices"][0]["text"]
+        finally:
+            revived.shutdown()
+    finally:
+        router.shutdown()
+        for s in servers[1:]:
+            s.shutdown()
+
+
+def test_all_replicas_dead_sheds_503_fleet_unavailable_with_retry_after():
+    servers = [make_replica()]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        hard_kill(servers[0])
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_router(router, "p")
+        assert err.value.code == 503
+        body = json.loads(err.value.read())
+        assert body["error"]["code"] == "fleet_unavailable"
+        assert err.value.headers.get("Retry-After")
+        samples = router_samples(router)
+        assert samples[obs_metrics.ROUTER_SHEDS] >= 1
+        # /readyz goes unready with Retry-After — the handshake keeps
+        # polling instead of treating the 503 as arrival
+        with pytest.raises(urllib.error.HTTPError) as rdy:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/readyz", timeout=5)
+        assert rdy.value.code == 503
+        assert rdy.value.headers.get("Retry-After")
+    finally:
+        router.shutdown()
+
+
+def test_saturated_fleet_sheds_429_with_retry_after_and_recovers():
+    # one slow replica with a 1-token watermark: while a long request
+    # holds the queue, the next submission sheds 429 replica-side and the
+    # router (sole replica busy) sheds fleet-wide with the same contract
+    servers = [make_replica(mock_step_s=0.1, max_queued_tokens=1)]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        slow = threading.Thread(
+            target=lambda: post_router(router, "hold " * 50,
+                                       max_tokens=200, timeout=60))
+        slow.start()
+        time.sleep(0.15)    # the hold request is mid-decode (the mock
+                            # needs ≥3 ticks of 0.1 s for its response)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_router(router, "shed me")
+        assert err.value.code == 429
+        assert json.loads(err.value.read())["error"]["code"] == "overloaded"
+        assert float(err.value.headers.get("Retry-After")) >= 1
+        slow.join(timeout=60)
+        # under a retrying client, concurrent pressure converges: every
+        # prompt eventually serves through the shed/backoff loop
+        client = HTTPClientBackend(model_id="m", port=router.port, temp=0.0,
+                                   prompt_type="direct",
+                                   wait_for_server_s=15, retry=FAST_RETRY)
+        outs = {}
+        threads = [threading.Thread(
+            target=lambda i=i: outs.update(
+                {i: client.infer_one(f"pressure {i}")}))
+            for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(outs) == 6
+        assert router_samples(router)[obs_metrics.ROUTER_SHEDS] >= 1
+    finally:
+        router.shutdown()
+        servers[0].shutdown()
+
+
+def test_admin_drain_takes_replica_out_and_rejoin_restores_it():
+    servers = [make_replica() for _ in range(2)]
+    router = make_router(servers, window_chars=len(TEMPLATE_A) - 5)
+    try:
+        wait_router_ready(router)
+        drained = f"127.0.0.1:{servers[0].port}"
+        target = prompt_targeting(router, drained)
+
+        def admin(route):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}{route}",
+                data=json.dumps({"replica": drained}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        assert admin("/admin/drain")["replica"]["state"] == "draining"
+        before = servers[0]._session.engine.stats.prompts
+        post_router(router, target)     # primary drained → sibling serves
+        assert servers[0]._session.engine.stats.prompts == before
+        assert router_samples(router)[obs_metrics.ROUTER_FAILOVERS] >= 1
+        assert admin("/admin/rejoin")["replica"]["state"] == "healthy"
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and not router._replicas[drained].is_ready()):
+            time.sleep(0.02)
+        post_router(router, target)
+        assert servers[0]._session.engine.stats.prompts > before
+    finally:
+        router.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def test_streaming_passes_through_the_router():
+    servers = [make_replica()]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/v1/completions",
+            data=json.dumps({"prompt": "stream me", "max_tokens": 32,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            raw = resp.read().decode()
+        deltas = [json.loads(line[len("data: "):])
+                  for line in raw.splitlines()
+                  if line.startswith("data: ") and "[DONE]" not in line]
+        text = "".join(c["text"] for d in deltas for c in d["choices"])
+        direct, _ = post_router(router, "stream me", max_tokens=32)
+        assert text == direct["choices"][0]["text"]
+        assert "data: [DONE]" in raw
+    finally:
+        router.shutdown()
+        servers[0].shutdown()
+
+
+class _FakeResp:
+    status = 200
+
+    def __init__(self, chunks):
+        self.headers = {"Content-Type": "text/event-stream",
+                        "X-Request-Id": "minted-by-replica"}
+        self._chunks = list(chunks)
+
+    def read1(self, n):
+        item = self._chunks.pop(0) if self._chunks else b""
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+class _FakeHandler:
+    def __init__(self, die_on_write=False):
+        self.sent: list[bytes] = []
+        self.headers: dict = {}
+        self.die_on_write = die_on_write
+        outer = self
+
+        class _W:
+            def write(self, b):
+                if outer.die_on_write:
+                    raise OSError("client gone")
+                outer.sent.append(b)
+
+            def flush(self):
+                pass
+
+        self.wfile = _W()
+
+    def send_response(self, status):
+        self.status = status
+
+    def send_header(self, k, v):
+        self.headers[k] = v
+
+    def end_headers(self):
+        pass
+
+
+def test_pipe_stream_outcome_semantics():
+    """The strike accounting behind mid-stream failures: an upstream
+    death BEFORE the first byte raises (the caller fails over — the
+    client saw nothing); mid-stream it returns an error string (the
+    replica takes the strike for the truncated 200); a CLIENT hangup is
+    None (not the replica's fault); the replica-minted request id falls
+    through to the stream headers."""
+    pipe = FleetRouter._pipe_stream
+
+    with pytest.raises(ConnectionResetError):
+        pipe(_FakeHandler(), _FakeResp([ConnectionResetError("boom")]), None)
+
+    h = _FakeHandler()
+    err = pipe(h, _FakeResp([b"data: a\n\n",
+                             ConnectionResetError("boom")]), None)
+    assert err is not None and "mid-stream" in err
+    assert h.sent == [b"data: a\n\n"]       # the truncated 200 went out
+    assert h.headers["X-Request-Id"] == "minted-by-replica"
+
+    h = _FakeHandler()
+    assert pipe(h, _FakeResp([b"data: a\n\n", b""]), "caller-rid") is None
+    assert h.headers["X-Request-Id"] == "caller-rid"
+
+    assert pipe(_FakeHandler(die_on_write=True),
+                _FakeResp([b"data: a\n\n", b""]), None) is None
+
+
+def test_client_handshake_reports_router_degradation(capsys):
+    servers = [make_replica() for _ in range(2)]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        hard_kill(servers[0])
+        # wait for the poller to see the corpse: the handshake line must
+        # report the degraded count, and the fleet must still be READY
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and router.readiness()["replicas_ready"] != 1):
+            time.sleep(0.02)
+        client = HTTPClientBackend(model_id="m", port=router.port, temp=0.0,
+                                   prompt_type="direct",
+                                   wait_for_server_s=15, retry=FAST_RETRY)
+        assert "1/2 replicas ready" in capsys.readouterr().out
+        assert client.infer_many(["a", "b"])
+    finally:
+        router.shutdown()
+        servers[1].shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The chaos drill (the ISSUE 7 acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def _run_fleet(results_dir, port, repeats=2, resume=False):
+    from reval_tpu.fleet import FleetRunner
+
+    backend = HTTPClientBackend(model_id="drill", port=port, temp=0.0,
+                                prompt_type="direct", wait_for_server_s=30,
+                                retry=FAST_RETRY)
+    fleet = FleetRunner(dataset="humaneval", prompt_type="direct",
+                        repeats=repeats, backend=backend,
+                        results_dir=str(results_dir), progress=False,
+                        run_consistency=False, max_items=2,
+                        tasks=("coverage", "path"), resume=resume)
+    try:
+        return fleet.run()
+    finally:
+        backend.close()
+
+
+def _task_logs(results_dir):
+    """Per-task log CONTENTS, creation-ordered (filenames carry wall
+    timestamps, so two identical runs differ in names, never bytes)."""
+    logs = {}
+    for task in ("coverage", "path"):
+        d = os.path.join(str(results_dir), f"{task}@drill_direct_temp0.0")
+        paths = sorted((os.path.join(d, f) for f in os.listdir(d)),
+                       key=os.path.getctime)
+        logs[task] = [open(p).read() for p in paths]
+    return logs
+
+
+def test_chaos_drill_replica_kill_zero_lost_prompts_bit_identical(tmp_path):
+    """Kill one of two replicas mid-fleet: the run must finish with zero
+    lost prompts (client retry + router failover), ``--resume`` must find
+    a fully-journaled checkpoint, the task logs must be byte-identical
+    to a single-replica run, and the federated /metrics must account the
+    ejection + failover."""
+    # -- baseline: single replica behind the same router topology --------
+    base_srv = make_replica()
+    base_router = make_router([base_srv])
+    wait_router_ready(base_router)
+    try:
+        base_result = _run_fleet(tmp_path / "base", base_router.port)
+    finally:
+        base_router.shutdown()
+        base_srv.shutdown()
+    assert "lost_prompts" not in base_result
+
+    # -- the drill: two replicas, one dies while the fleet is running ----
+    servers = [make_replica() for _ in range(2)]
+    router = make_router(servers, eject_fails=2, cooldown_s=30.0)
+    wait_router_ready(router)
+    killed = {}
+
+    def assassin():
+        # strike as soon as ANY replica has served a prompt — mid-run by
+        # construction (the fleet still has prompts and a whole second
+        # repeat to go)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            for srv in servers:
+                if srv._session.engine.stats.prompts > 0:
+                    hard_kill(srv)
+                    killed["id"] = f"127.0.0.1:{srv.port}"
+                    return
+            time.sleep(0.002)
+
+    try:
+        hit = threading.Thread(target=assassin)
+        hit.start()
+        drill_result = _run_fleet(tmp_path / "drill", router.port)
+        hit.join(timeout=60)
+
+        # zero lost prompts: nothing took the INFER_FAILED sentinel
+        assert "lost_prompts" not in drill_result
+        assert killed, "the assassin never fired — drill exercised nothing"
+
+        # resume against the intact journal: every chunk already scored,
+        # so the resumed run skips straight through (no new inference,
+        # no new log files)
+        before_logs = _task_logs(tmp_path / "drill")
+        resumed = _run_fleet(tmp_path / "drill", router.port, resume=True)
+        assert len(resumed["repeats"]) == 2
+        assert resumed["repeats"] == drill_result["repeats"]
+        assert _task_logs(tmp_path / "drill") == before_logs
+
+        # bit-identical greedy outputs regardless of which replica
+        # answered (echo-mode responses are prompt-determined)
+        assert _task_logs(tmp_path / "drill") == _task_logs(tmp_path / "base")
+        assert drill_result["repeats"] == base_result["repeats"]
+
+        # a forward whose ring-primary is the corpse must count a
+        # failover (deterministic even after ejection)
+        post_router(router, prompt_targeting(router, killed["id"]))
+        samples = router_samples(router)     # federation still parses
+        assert samples[obs_metrics.ROUTER_EJECTIONS] >= 1
+        assert samples[obs_metrics.ROUTER_FAILOVERS] >= 1
+        # one fused POST per repeat + the targeted probe (client retries
+        # of a killed-mid-flight POST only add to this)
+        assert samples[obs_metrics.ROUTER_REQUESTS] >= 3
+        states = {r["id"]: r["state"] for r in router.statusz()["replicas"]}
+        assert states[killed["id"]] == "ejected"
+    finally:
+        router.shutdown()
+        for srv in servers:
+            if killed.get("id") != f"127.0.0.1:{srv.port}":
+                srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke (the tier-1 canary) + affinity-table tool round trip
+# ---------------------------------------------------------------------------
+
+def test_router_cli_mock_smoke_with_replica_kill():
+    r = subprocess.run(
+        [sys.executable, "-m", "reval_tpu", "router", "--mock", "2",
+         "--smoke", "8"],
+        capture_output=True, text=True, timeout=150,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["served"] == 8
+    assert summary["errors"] == 0
+    assert summary["metrics_ok"] is True
+    assert summary["killed_replica"] is True
+    assert summary["ejections"] >= 1
+    assert summary["router_requests"] >= 8
+
+
+def test_prefix_stats_json_affinity_table_seeds_the_router(tmp_path):
+    out_path = tmp_path / "affinity.json"
+    r = subprocess.run(
+        [sys.executable, "tools/prefix_stats.py", "--tiny",
+         "--json", str(out_path)],
+        capture_output=True, text=True, timeout=150,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stdout + r.stderr
+    table = json.loads(out_path.read_text())
+    assert table["format"] == "reval-affinity-v1"
+    assert table["window_chars"] >= 16
+    assert set(table["tasks"]) == {"coverage", "path", "state", "output"}
+    for row in table["tasks"].values():
+        assert row["template_chars"] >= 0
+        int(row["key"], 16)
+    # the stdout report carries the same block
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["affinity"]["window_chars"] == table["window_chars"]
+    # and the router loads it as its ring seed
+    srv = make_replica()
+    try:
+        router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                             affinity_table=str(out_path))
+        assert router.window_chars == table["window_chars"]
+        placement = router.statusz()["affinity"]["placement"]
+        assert set(placement) == set(table["tasks"])
+        router.shutdown()
+    finally:
+        srv.shutdown()

@@ -18,10 +18,20 @@ programs the scoring pipeline sends):
 - ``cold_hit_rate``: in-batch sharing on the FIRST pass (later prompts
   hitting pages inserted by earlier ones, task-contiguous order).
 
+With ``--json PATH`` it additionally writes a machine-readable
+**affinity table** (``reval-affinity-v1``): per task, the character
+length of its template prefix and the crc32 affinity key the fleet
+router (``reval_tpu router --affinity-table``) would compute for that
+template, plus the fleet-wide ``window_chars`` (the shortest template —
+one window that fits inside every task's template, so same-task prompts
+always share a key).  The same block rides the stdout JSON under
+``"affinity"``.
+
 Prints ONE JSON line.  Examples:
 
     python tools/prefix_stats.py --dataset humaneval --prompt-type direct
     python tools/prefix_stats.py --tiny          # CPU smoke (tiny counts)
+    python tools/prefix_stats.py --tiny --json /tmp/affinity.json
 """
 
 from __future__ import annotations
@@ -62,6 +72,42 @@ def lcp_tokens(encoded: list[list[int]]) -> int:
             i += 1
         lcp = i
     return lcp
+
+
+def lcp_chars(prompts: list[str]) -> int:
+    """Character-level longest common prefix — the router hashes CHAR
+    windows (it sees wire prompts, not token ids)."""
+    if not prompts:
+        return 0
+    first = prompts[0]
+    lcp = min(len(p) for p in prompts)
+    for p in prompts[1:]:
+        i, n = 0, min(lcp, len(p))
+        while i < n and p[i] == first[i]:
+            i += 1
+        lcp = i
+    return lcp
+
+
+def affinity_table(by_task: dict[str, list[str]],
+                   floor_chars: int = 16) -> dict:
+    """The ``reval-affinity-v1`` hash-ring seed the fleet router loads:
+    one window that fits inside EVERY task's template (the minimum
+    char-LCP, floored so a degenerate task cannot collapse routing to a
+    couple of characters), and each template's crc32 key under that
+    window."""
+    import zlib
+
+    lcps = {t: lcp_chars(ps) for t, ps in by_task.items() if ps}
+    window = max(floor_chars, min(lcps.values())) if lcps else floor_chars
+    tasks = {}
+    for t, ps in by_task.items():
+        if not ps:
+            continue
+        key = zlib.crc32(ps[0][:window].encode("utf-8", "replace")) & 0xFFFFFFFF
+        tasks[t] = {"template_chars": lcps[t], "key": f"{key:08x}"}
+    return {"format": "reval-affinity-v1", "window_chars": window,
+            "tasks": tasks}
 
 
 def radix_stats(encoded: list[list[int]], page: int) -> tuple[int, int, int]:
@@ -106,6 +152,9 @@ def main() -> None:
                          "BPE trained on the prompt corpus, like bench.py")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny counts: CPU smoke of the tool itself")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the reval-affinity-v1 table (the "
+                         "fleet router's hash-ring seed) to PATH")
     args = ap.parse_args()
 
     per = 4 if args.tiny else args.per_task
@@ -159,6 +208,12 @@ def main() -> None:
     out["cold_hit_rate"] = round(total_cold / total_tokens, 4)
     out["warm_hit_rate"] = round(total_warm / total_tokens, 4)
     out["value"] = out["warm_hit_rate"]
+    affinity = affinity_table(by_task)
+    affinity.update(dataset=args.dataset, prompt_type=args.prompt_type)
+    out["affinity"] = affinity
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(affinity, f, indent=1)
     print(json.dumps(out))
 
 
