@@ -716,6 +716,11 @@ def main() -> None:
                          "(REVAL_TPU_OBS=0) — the A/B that prices the "
                          "observability layer's hot-path cost (PERF.md); "
                          "counters stay on (engine accounting needs them)")
+    ap.add_argument("--no-determinism", action="store_true",
+                    help="skip the determinism slice (the reference-cell "
+                         "greedy fingerprint recorded so BENCH history "
+                         "detects silent cross-commit drift — "
+                         "obs/determinism.py)")
     ap.add_argument("--no-autotune", action="store_true",
                     help="ignore tpu_watch/autotune.json — REQUIRED for "
                          "A/B candidate runs, which must measure exactly "
@@ -967,6 +972,28 @@ def main() -> None:
             except Exception as e:
                 extras["ab_error"] = type(e).__name__
                 note(f'prefix-cache A/B failed ({type(e).__name__}); '
+                     'keeping the measured headline')
+
+        # Determinism garnish: run the tiny seeded probe slice through
+        # reference + static + seq-kernel cells and record the reference
+        # cell's greedy-token fingerprint.  The probe model/set is FIXED
+        # (independent of bench flags), so the fingerprint only moves
+        # when a commit changes numerics — tools/obs_report.py
+        # --determinism diffs it across BENCH rounds and names the first
+        # round it changed.  Garnish rules apply: a failure here records
+        # an error and keeps the measured headline.
+        if not args.no_determinism:
+            note('determinism slice (reference-cell fingerprint)')
+            try:
+                from reval_tpu.obs.determinism import bench_block
+
+                extras["determinism"] = bench_block()
+                if extras["determinism"]["gate_failures"]:
+                    note('determinism slice DIVERGED: '
+                         + '; '.join(extras["determinism"]["gate_failures"]))
+            except Exception as e:
+                extras["determinism_error"] = type(e).__name__
+                note(f'determinism slice failed ({type(e).__name__}); '
                      'keeping the measured headline')
 
         vs_baseline = 0.0

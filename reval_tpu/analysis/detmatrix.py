@@ -1,0 +1,51 @@
+"""``detmatrix`` pass: determinism-matrix artifacts conform to schema.
+
+The determinism observatory's whole value is *coverage you can trust*:
+a backend silently missing from ``tpu_watch/determinism-<ts>.json``
+reads as "everything agrees" when it means "nobody looked".  This pass
+validates every matrix artifact on disk against the declared schema
+(``obs/determinism.py::validate_matrix`` — ONE checker shared with the
+CLI's pre-write self-check and the tests):
+
+- the schema version is the one this tree writes;
+- the declared reference cell is present with status ``ref``;
+- every cell of the declared taxonomy (``default_cells()``) appears,
+  either executed or skipped WITH a reason — a cell can be unloadable,
+  filtered, or broken, but never silently absent;
+- run cells carry their observables (tokens/answers/fingerprint/
+  logits fingerprint) and compared cells carry their diff.
+
+No artifacts on disk = nothing to lint (clean): the artifacts are
+generated, untracked scratch.  An unreadable or truncated artifact IS a
+violation — a half-written report must never pass for a clean audit.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from .core import Violation
+
+__all__ = ["run"]
+
+
+def run(sources, root: str) -> list[Violation]:
+    from ..obs.determinism import validate_matrix
+
+    out: list[Violation] = []
+    pattern = os.path.join(root, "tpu_watch", "determinism-*.json")
+    for path in sorted(glob.glob(pattern)):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append(Violation("detmatrix", rel, 0,
+                                 f"unreadable matrix artifact: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        for err in validate_matrix(obj):
+            out.append(Violation("detmatrix", rel, 0, err))
+    return out

@@ -19,7 +19,7 @@ import os
 import time
 from dataclasses import dataclass, field
 
-from . import envreg, errboundary, hotpath, locks
+from . import detmatrix, envreg, errboundary, hotpath, locks
 from .core import Suppression, Violation, collect_sources
 from .metrics_events import run_events, run_metrics
 
@@ -33,6 +33,7 @@ PASSES = {
     "env": envreg.run,
     "metrics": run_metrics,
     "events": run_events,
+    "detmatrix": detmatrix.run,
 }
 
 
@@ -126,7 +127,7 @@ def main(argv: list[str] | None = None) -> int:
         prog="reval_tpu lint",
         description="Codebase-native static analysis: lock discipline, "
                     "hot-path purity, typed-error boundary, env registry, "
-                    "metric/event namespaces")
+                    "metric/event namespaces, determinism-matrix schema")
     parser.add_argument("passes", nargs="*", metavar="PASS",
                         help=f"passes to run (default: all of "
                              f"{', '.join(PASSES)})")

@@ -122,6 +122,22 @@ ENV: dict[str, dict] = {
     "REVAL_TPU_ROUTER_HEALTH_INTERVAL_S": {
         "default": "1",
         "help": "router /readyz poll interval per replica, in seconds"},
+    # -- determinism observatory (obs/determinism.py) ----------------------
+    "REVAL_TPU_DETERMINISM_REF": {
+        "default": "paged-xla-fp32-b2",
+        "help": "reference cell every divergence-matrix cell diffs "
+                "against (a taxonomy cell name)"},
+    "REVAL_TPU_DETERMINISM_TOPK": {
+        "default": "8",
+        "help": "logit-fingerprint width: top-k ids + quantized values "
+                "recorded per probe per cell"},
+    "REVAL_TPU_DETERMINISM_DIR": {
+        "default": "tpu_watch",
+        "help": "where determinism-<ts>.json matrix artifacts land"},
+    "REVAL_TPU_DETERMINISM_PERTURB": {
+        "default": "",
+        "help": "chaos hook: inject an lm_head logit perturbation into "
+                "the named cell so the parity gate trips (tests only)"},
     # -- multi-host rig (parallel/distributed.py) --------------------------
     "REVAL_TPU_COORDINATOR": {
         "default": "",

@@ -41,7 +41,7 @@ import jax
 
 from ...models import load_checkpoint
 from ...parallel import make_mesh
-from .engine import EngineStats, StopScanner, finalize_text
+from .engine import EngineStats, StopScanner, finalize_ids, finalize_text
 from .paged_engine import PagedTPUEngine, _Request
 from .tokenizer import HFTokenizer
 
@@ -123,9 +123,9 @@ class DataParallelPagedEngine:
                  temperature: float = 0.0,
                  stop: list[str] | None = None,
                  top_k: int = 0, top_p: float = 1.0,
-                 on_progress=None) -> list[str]:
+                 on_progress=None, return_ids: bool = False):
         if not prompts:
-            return []
+            return ([], []) if return_ids else []
         stop = stop or []
         # latency stamps anchor at CALL time, not queue-pull time: a
         # prompt that waits in the shared work queue must show that wait
@@ -143,6 +143,8 @@ class DataParallelPagedEngine:
         # unguarded: replicas write DISJOINT indices (each prompt is pulled
         # by exactly one replica); futures_wait publishes before the read
         out: list[str] = [""] * len(prompts)
+        # unguarded: same disjoint-index / futures_wait contract as `out`
+        out_ids: list[list[int]] = [[] for _ in prompts]
 
         # one call-level key set shared by every replica: request i samples
         # from fold_in(call_key, i) wherever it lands, so dp output at
@@ -186,6 +188,8 @@ class DataParallelPagedEngine:
                         req = reqs.pop(seq)
                         out[req.index] = finalize_text(
                             eng.tokenizer, req.generated, stop)
+                        out_ids[req.index] = finalize_ids(eng.tokenizer,
+                                                          req.generated)
                         eng.stats.prompts += 1
             except Exception:
                 for seq, req in reqs.items():
@@ -201,6 +205,8 @@ class DataParallelPagedEngine:
         futures_wait(futures)
         for f in futures:
             f.result()          # propagate replica faults
+        if return_ids:
+            return out, out_ids
         return out
 
     def close(self) -> None:

@@ -180,6 +180,21 @@ def finalize_text(tokenizer, ids: list[int], stop: list[str]) -> str:
     return truncate_at_stop(tokenizer.decode(ids), stop)
 
 
+def finalize_ids(tokenizer, ids: list[int]) -> list[int]:
+    """Generated ids cut just PAST the EOS (inclusive) — the
+    schedule-invariant raw stream behind ``generate(return_ids=True)``.
+    Chunked engines legitimately compute tokens beyond EOS (the chunk
+    finishes; static batches keep stepping until every row is done), and
+    those overrun tails differ by engine/chunking, so they are discarded
+    exactly as ``finalize_text`` discards them — but the EOS itself is
+    KEPT: "stopped here" vs "kept going with token X" is a real
+    divergence the determinism matrix must see (text alone cannot: ids
+    outside the byte range decode to nothing)."""
+    if tokenizer.eos_id in ids:
+        ids = ids[: ids.index(tokenizer.eos_id) + 1]
+    return list(ids)
+
+
 #: (attribute, metric name, python type) — the EngineStats counter set.
 #: Attribute access keeps the historical dataclass field names (every
 #: caller, test, and JSON surface reads ``stats.prompts`` etc.); the
@@ -487,25 +502,34 @@ class TPUEngine:
     # -- generation --------------------------------------------------------
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None,
-                 top_k: int = 0, top_p: float = 1.0) -> list[str]:
+                 top_k: int = 0, top_p: float = 1.0,
+                 return_ids: bool = False):
         """Generate completions for every prompt (any count); order
         preserved.  ``top_k``/``top_p`` filter the sampling distribution
         (0 / 1.0 = off — the defaults compile no filter into the chunk
-        program)."""
+        program).  ``return_ids``: also return the raw generated token
+        streams (``finalize_ids`` semantics — EOS-cut, pre-stop) as a
+        second list, for consumers that must see divergence text hides
+        (the determinism matrix)."""
         if not prompts:
-            return []
+            return ([], []) if return_ids else []
         stop = stop or []
         ids = [self.tokenizer.encode(p) for p in prompts]
         order = sorted(range(len(ids)), key=lambda i: len(ids[i]), reverse=True)
         out: list[str | None] = [None] * len(prompts)
+        out_ids: list[list[int]] = [[] for _ in prompts]
         with profile_trace():
             for start in range(0, len(order), self.batch_size):
                 batch_idx = order[start:start + self.batch_size]
                 batch_ids = [ids[i] for i in batch_idx]
-                texts = self._generate_batch(batch_ids, max_new_tokens, temperature, stop,
-                                             top_k=top_k, top_p=top_p)
-                for i, text in zip(batch_idx, texts):
+                texts, raw = self._generate_batch(batch_ids, max_new_tokens,
+                                                  temperature, stop,
+                                                  top_k=top_k, top_p=top_p)
+                for i, text, row_ids in zip(batch_idx, texts, raw):
                     out[i] = text
+                    out_ids[i] = finalize_ids(self.tokenizer, row_ids)
+        if return_ids:
+            return out, out_ids  # type: ignore[return-value]
         return out  # type: ignore[return-value]
 
     def _host_read(self, arr) -> np.ndarray:
@@ -519,7 +543,8 @@ class TPUEngine:
 
     def _generate_batch(self, batch_ids: list[list[int]], max_new_tokens: int,
                         temperature: float, stop: list[str],
-                        top_k: int = 0, top_p: float = 1.0) -> list[str]:
+                        top_k: int = 0, top_p: float = 1.0,
+                        ) -> tuple[list[str], list[list[int]]]:
         n_real = len(batch_ids)
         # greedy (temp 0) never needs the filter: masking can't change
         # the argmax, and the filtered program pays a [B, V] sort per step
@@ -598,5 +623,6 @@ class TPUEngine:
         self.stats.generated_tokens += int(generated[:n_real].size)
         self.stats.prompts += n_real
 
-        return [finalize_text(self.tokenizer, generated[row].tolist(), stop)
-                for row in range(n_real)]
+        raw = [generated[row].tolist() for row in range(n_real)]
+        return ([finalize_text(self.tokenizer, row_ids, stop)
+                 for row_ids in raw], raw)

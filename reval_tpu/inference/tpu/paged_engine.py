@@ -63,6 +63,7 @@ from ...runtime import PagedRuntime
 from .engine import (
     EngineStats,
     StopScanner,
+    finalize_ids,
     finalize_text,
     pow2_bucket,
     profile_trace,
@@ -440,7 +441,7 @@ class PagedTPUEngine:
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None,
                  top_k: int = 0, top_p: float = 1.0,
-                 on_progress=None) -> list[str]:
+                 on_progress=None, return_ids: bool = False):
         """``on_progress(index, text)``: streaming hook, called at every
         decode-chunk boundary with the prompt's index and its finalised
         text so far (stop/EOS truncation already applied).  The text
@@ -448,9 +449,15 @@ class PagedTPUEngine:
         not strictly prefix-stable at chunk edges — consumers should
         diff defensively.  Costs one detokenisation of the generated ids
         per chunk per live request — only paid when a callback is
-        installed."""
+        installed.
+
+        ``return_ids``: also return the raw generated token streams
+        (``finalize_ids`` semantics — EOS-cut, pre-stop) as a second
+        list; the determinism matrix compares these, because ids outside
+        the byte range (EOS, vocab padding) decode to nothing and their
+        divergence is invisible in text."""
         if not prompts:
-            return []
+            return ([], []) if return_ids else []
         stop = stop or []
         encoded = [self.encode_clipped(p, max_new_tokens) for p in prompts]
 
@@ -488,9 +495,13 @@ class PagedTPUEngine:
             raise
 
         out: list[str] = [""] * len(prompts)
+        out_ids: list[list[int]] = [[] for _ in prompts]
         for req in reqs.values():
             out[req.index] = finalize_text(self.tokenizer, req.generated, stop)
+            out_ids[req.index] = finalize_ids(self.tokenizer, req.generated)
         self.stats.prompts += len(prompts)
+        if return_ids:
+            return out, out_ids
         return out
 
     def submit_request(self, ids: list[int], max_new_tokens: int

@@ -7,9 +7,14 @@ Chrome-trace/Perfetto span trees per served request (``serve
 --trace-out``); ``logging`` is the structured JSON event log (one
 declared-namespace event per line, ``EVENTS`` linted like ``METRICS``);
 ``flightrec`` is the always-on per-step ring buffer behind crash-dump
-postmortem bundles.  The serving server exposes all of it: ``GET
-/metrics`` (Prometheus text), ``GET /statusz`` (JSON snapshot), and
-``GET /debugz`` (a live postmortem bundle).
+postmortem bundles; ``determinism`` is the cross-backend divergence
+matrix (the determinism observatory — ``tools/determinism_matrix.py``
+is its CLI).  The serving server exposes all of it: ``GET /metrics``
+(Prometheus text), ``GET /statusz`` (JSON snapshot), and ``GET
+/debugz`` (a live postmortem bundle).
+
+``determinism`` is imported lazily (it pulls engines at run time, not
+import time) — ``from reval_tpu.obs import determinism`` when needed.
 """
 
 from .flightrec import FlightRecorder, PostmortemWriter

@@ -1,0 +1,706 @@
+"""Determinism observatory: the cross-backend divergence matrix engine.
+
+*The Silent Hyperparameter* (arxiv 2605.19537) measured what serving
+folklore suspected: the inference backend is a hyperparameter — switch
+the kernel, the scheduler, the parallelism layout, or the weight dtype
+and eval scores move, silently.  This repo is exactly that risk surface:
+one REval reproduction with direct/paged/dp/pp/sp/quant execution paths
+and xla/pallas kernel variants, any of which could perturb the probe
+answers the whole reproduction stands on.
+
+This module turns the risk into an *instrument*.  A **cell** is one
+point in the backend taxonomy (engine × kernel × parallelism × dtype ×
+batch width).  The matrix runs a fixed, seeded probe set through every
+loadable cell and captures three observables per cell:
+
+- **greedy tokens** — the RAW generated id stream of each probe's
+  greedy generation (temperature 0; ``generate(return_ids=True)``,
+  EOS-cut but EOS kept): the bit-identity observable, sensitive to
+  every cell axis because it runs through the cell's real engine and
+  kernel.  Raw ids, not re-encoded text — EOS and vocab-padding ids
+  decode to nothing, so a text round-trip would be blind to argmax
+  flips among them.  A diff names the first divergent token.
+- **logits fingerprint** — top-k ids + quantized logit values at the
+  last prompt position from a full-sequence forward with the cell's
+  params.  This is the *weight-dtype axis* magnitude observable (how
+  far bf16/int8 move the logits): it is engine/kernel-independent by
+  construction (one shared forward per dtype), so same-dtype cells
+  always fingerprint identically — kernel/engine divergence is the
+  greedy stream's job.
+- **answers** — the decoded generation text per probe (what the REval
+  scorers would consume; with a real checkpoint these are the scored
+  task answers, so an answer digest is the score-relevant observable).
+
+Every cell diffs against a declared **reference cell** (default
+``paged-xla-fp32-b2`` — the production engine with the XLA oracle
+kernel; override ``REVAL_TPU_DETERMINISM_REF``).  Cells declare an
+expectation: ``bit_identical`` cells (kernel variants, paged-vs-static,
+dp widths, batch widths) are greedy-parity contracts the tier-1 gate
+enforces; ``drift_allowed`` cells (bf16, int8 weights, int8 KV) are
+telemetry — their measured drift is the product, not a failure.
+
+Unloadable cells are SKIPPED with a reason (never a crash): the matrix
+must render on a CPU dev host, a one-chip v5e, and a dp pod alike, and a
+cell silently missing from the report is itself a divergence hazard —
+the ``detmatrix`` reval-lint pass pins every taxonomy cell to appear as
+run or skipped-with-reason.
+
+``REVAL_TPU_DETERMINISM_PERTURB=<cell>`` injects a logit perturbation
+(an lm_head column boost) into that cell when it is built — the chaos
+hook the tier-1 gate test uses to prove a perturbed kernel fails loudly
+with a named cell and first divergent token.
+
+Entry points: ``tools/determinism_matrix.py`` (CLI, writes
+``tpu_watch/determinism-<ts>.json`` + the rendered parity table),
+``bench.py`` (the ``determinism`` block: reference-cell fingerprint per
+round, so BENCH history detects drift across *commits*), and
+``tests/test_determinism.py`` (the tier-1 parity slice).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ..env import env_int, env_raw, env_str
+from . import metrics as obs_metrics
+from .metrics import MetricsRegistry
+
+__all__ = [
+    "SCHEMA", "CellSpec", "PROBES", "DEFAULT_MAX_NEW", "DEFAULT_REFERENCE",
+    "PARITY_SLICE", "BENCH_SLICE",
+    "default_cells", "discover_cells", "run_matrix", "diff_tokens",
+    "gate_failures", "render_table", "write_matrix", "record_matrix",
+    "reference_fingerprint", "bench_block", "validate_matrix",
+]
+
+#: matrix artifact schema id — bump on breaking layout changes; the
+#: ``detmatrix`` lint pass and ``tools/obs_report.py --determinism``
+#: both refuse unknown versions rather than misread them
+SCHEMA = "reval-determinism-v1"
+
+#: the fixed probe set: REval-probe-shaped snippets (coverage / state /
+#: output / path flavours).  NEVER edit casually — the bench
+#: ``determinism`` block fingerprints the reference cell's greedy tokens
+#: on these exact strings each round, and an edit here reads as silent
+#: drift in BENCH history.
+PROBES = (
+    "def add(a, b):\n    return a + b\n# [QUESTION] is line 2 executed? ",
+    "x = 1\nwhile x < 9:\n    x *= 2\n# [STATE] x = ",
+    "y = [k * k for k in range(5)]\nassert y[3] == ",
+    "def f(n):\n    if n % 2:\n        return 'odd'\n    return 'even'\n# f(7) -> ",
+)
+
+DEFAULT_MAX_NEW = 12
+DEFAULT_REFERENCE = "paged-xla-fp32-b2"
+
+#: the tier-1 parity slice: every bit_identical fp32 cell — kernel
+#: oracle (xla vs both Pallas formulations), paged vs static, dp2 vs
+#: dp1, batch width.  CPU-runnable; a kernel PR that perturbs greedy
+#: outputs fails this slice with a named cell + first divergent token.
+PARITY_SLICE = ("paged-xla-fp32-b2", "static-fp32-b2",
+                "paged-pallas_seq-fp32-b2", "paged-pallas-fp32-b2",
+                "paged-xla-fp32-dp2-b2", "paged-xla-fp32-b4")
+
+#: the bench garnish slice: cheap cross-backend sanity (reference +
+#: static engine + seq kernel) — the fingerprint is the cross-COMMIT
+#: drift detector, so it must stay affordable every round
+BENCH_SLICE = ("paged-xla-fp32-b2", "static-fp32-b2",
+               "paged-pallas_seq-fp32-b2")
+
+_DTYPE_ARG = {"fp32": "float32", "bf16": "bfloat16", "int8": "int8"}
+
+#: the lm_head column boosted by the perturbation hook (byte 'A') and
+#: the boost size — large enough that the perturbed cell's greedy
+#: argmax flips deterministically, so the gate test is not flaky
+_PERTURB_TOKEN = 65
+_PERTURB_BOOST = 8.0
+
+
+@dataclass(frozen=True)
+class CellSpec:
+    """One point in the backend taxonomy.
+
+    ``expect="bit_identical"`` cells are parity contracts (the tier-1
+    gate fails when they diverge from the reference);
+    ``expect="drift_allowed"`` cells measure numeric drift that is
+    expected to exist (dtype changes move logits by design)."""
+
+    name: str
+    engine: str                 # static | paged | dp_paged
+    kernel: str = "-"           # xla | pallas | pallas_seq | "-" (static
+    #                             full attention has no paged kernel)
+    dp: int = 1
+    dtype: str = "fp32"         # fp32 | bf16 | int8 (weights)
+    kv_dtype: str = ""          # "" | int8 (paged KV pool)
+    batch: int = 2              # max_slots / static batch width
+    expect: str = "bit_identical"
+
+    def axes(self) -> dict:
+        return {"engine": self.engine, "kernel": self.kernel,
+                "dp": self.dp, "dtype": self.dtype,
+                "kv_dtype": self.kv_dtype, "batch": self.batch}
+
+
+def default_cells() -> list[CellSpec]:
+    """The full taxonomy, reference first.  Order is presentation order
+    in the rendered table; names are stable identifiers (BENCH history,
+    the lint pass, and ``REVAL_TPU_DETERMINISM_REF`` all key on them)."""
+    return [
+        # the declared reference: production engine, oracle kernel
+        CellSpec("paged-xla-fp32-b2", "paged", "xla"),
+        # engine axis: rectangular static batches vs continuous batching
+        CellSpec("static-fp32-b2", "static"),
+        # kernel axis: the two Pallas formulations vs the XLA oracle
+        CellSpec("paged-pallas_seq-fp32-b2", "paged", "pallas_seq"),
+        CellSpec("paged-pallas-fp32-b2", "paged", "pallas"),
+        # parallelism axis: dp=2 replicas vs dp=1
+        CellSpec("paged-xla-fp32-dp2-b2", "dp_paged", "xla", dp=2),
+        # batch-width axis: wider slot count must not change greedy
+        CellSpec("paged-xla-fp32-b4", "paged", "xla", batch=4),
+        # dtype axis: numeric drift is expected; its SIZE is telemetry
+        CellSpec("paged-xla-bf16-b2", "paged", "xla", dtype="bf16",
+                 expect="drift_allowed"),
+        CellSpec("static-bf16-b2", "static", dtype="bf16",
+                 expect="drift_allowed"),
+        CellSpec("paged-xla-int8-b2", "paged", "xla", dtype="int8",
+                 expect="drift_allowed"),
+        CellSpec("paged-xla-fp32-kvint8-b2", "paged", "xla",
+                 kv_dtype="int8", expect="drift_allowed"),
+    ]
+
+
+def discover_cells(specs: list[CellSpec] | None = None,
+                   ) -> tuple[list[CellSpec], dict[str, str]]:
+    """Partition the taxonomy into (loadable-here, {name: skip reason}).
+
+    Static constraints only (device count); a cell that passes discovery
+    can still fail to build — ``run_matrix`` degrades that to a skip
+    with the error as the reason, because the matrix must never crash on
+    a host where one backend is broken: a broken backend is a FINDING."""
+    import jax
+
+    specs = list(specs if specs is not None else default_cells())
+    have = len(jax.devices())
+    avail: list[CellSpec] = []
+    skipped: dict[str, str] = {}
+    for spec in specs:
+        need = spec.dp
+        if need > have:
+            skipped[spec.name] = (f"needs >= {need} devices, have {have} "
+                                  f"(set --xla_force_host_platform_"
+                                  f"device_count on CPU)")
+            continue
+        avail.append(spec)
+    return avail, skipped
+
+
+@contextmanager
+def _cell_env(spec: CellSpec):
+    """Pin the kernel-dispatch env for one cell's whole lifetime (build
+    → trace → generate): the backend choice is read at *trace* time, so
+    it must cover the first ``generate`` call, not just construction."""
+    name = "REVAL_TPU_PAGED_BACKEND"
+    old = env_raw("REVAL_TPU_PAGED_BACKEND")
+    if spec.engine in ("paged", "dp_paged"):
+        os.environ[name] = spec.kernel
+    try:
+        yield
+    finally:
+        if spec.engine in ("paged", "dp_paged"):
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+
+
+def _tiny_cfg():
+    from ..inference.tpu.tokenizer import ByteTokenizer
+    from ..models import ModelConfig
+
+    # head_dim 128 keeps the Pallas kernels lane-aligned in interpret
+    # mode (the same geometry the kernel parity tests pin on CPU)
+    return ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,  # 320
+                       hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       head_dim=128)
+
+
+def _perturb_params(params: dict, cell: str) -> dict:
+    """The injected-divergence hook: boost one lm_head column so the
+    cell's greedy argmax flips deterministically.  Quantized lm_head
+    (int8 cells) perturbs the scale column instead — same effect."""
+    import jax.numpy as jnp
+
+    out = dict(params)
+    lm = out.get("lm_head")
+    if lm is not None and jnp.issubdtype(lm.dtype, jnp.floating):
+        out["lm_head"] = lm.at[:, _PERTURB_TOKEN].add(
+            jnp.asarray(_PERTURB_BOOST, lm.dtype))
+    elif "lm_head_scale" in out:
+        out["lm_head_scale"] = out["lm_head_scale"].at[_PERTURB_TOKEN].mul(4.0)
+    else:   # tied embeddings: perturb the shared table's row
+        out["embed"] = out["embed"].at[_PERTURB_TOKEN].add(_PERTURB_BOOST)
+    return out
+
+
+class _MatrixRunner:
+    """Owns the shared probe model (one seeded draw per weight dtype)
+    and builds/runs/closes one engine per cell."""
+
+    def __init__(self, probes, max_new_tokens: int, perturb: str):
+        from ..inference.tpu.tokenizer import ByteTokenizer
+
+        self.probes = list(probes)
+        self.max_new = max_new_tokens
+        self.perturb = perturb
+        self.tokenizer = ByteTokenizer()
+        self.cfg = _tiny_cfg()
+        self._params: dict[str, dict] = {}      # dtype -> tree
+        self._logits_rows: dict[tuple, list] = {}   # (dtype, k) -> rows
+
+    def params_for(self, dtype: str) -> dict:
+        if dtype not in self._params:
+            from ..models import init_random_params
+
+            self._params[dtype] = init_random_params(
+                self.cfg, seed=0, dtype=_DTYPE_ARG[dtype])
+        return self._params[dtype]
+
+    def _build(self, spec: CellSpec):
+        params = self.params_for(spec.dtype)
+        if self.perturb and self.perturb == spec.name:
+            params = _perturb_params(params, spec.name)
+        if spec.engine == "static":
+            from ..inference.tpu.engine import TPUEngine
+
+            return TPUEngine(params, self.cfg, self.tokenizer,
+                             batch_size=spec.batch, max_seq_len=256)
+        if spec.engine == "dp_paged":
+            from ..inference.tpu.dp_paged import DataParallelPagedEngine
+
+            return DataParallelPagedEngine(
+                params, self.cfg, self.tokenizer, dp_size=spec.dp,
+                tp_size=1, max_slots=spec.batch, page_size=128,
+                max_seq_len=256, kv_dtype=spec.kv_dtype)
+        from ..inference.tpu.paged_engine import PagedTPUEngine
+
+        return PagedTPUEngine(params, self.cfg, self.tokenizer,
+                              max_slots=spec.batch, page_size=128,
+                              max_seq_len=256, kv_dtype=spec.kv_dtype)
+
+    def _logits_topk(self, spec: CellSpec, k: int) -> list[dict]:
+        """Top-k ids + quantized logit values at the last prompt
+        position, one row per probe — the WEIGHT-DTYPE observable.  One
+        full-sequence forward per dtype, shared by every cell at that
+        dtype (it is engine/kernel-independent by construction, so
+        recomputing per cell would only waste compiles); a perturbed
+        cell gets its own rows so the injected lm_head boost shows up
+        in the fingerprint too."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models import logits_for_tokens
+
+        perturbed = bool(self.perturb) and self.perturb == spec.name
+        key = (spec.dtype, perturbed, k)
+        if key in self._logits_rows:
+            return self._logits_rows[key]
+        params = self.params_for(spec.dtype)
+        if perturbed:
+            params = _perturb_params(params, spec.name)
+        rows = []
+        for probe in self.probes:
+            ids = self.tokenizer.encode(probe)
+            logits = logits_for_tokens(params, self.cfg,
+                                       jnp.asarray([ids], jnp.int32))
+            last = np.asarray(logits[0, -1], np.float32)
+            top = np.argsort(-last)[:k]
+            rows.append({"ids": [int(i) for i in top],
+                         "vals": [round(float(last[i]), 5) for i in top]})
+        self._logits_rows[key] = rows
+        return rows
+
+    def run_cell(self, spec: CellSpec, topk: int) -> dict:
+        """One cell end-to-end.  Any failure degrades to a skip row
+        carrying the error — a broken backend is a report finding, not
+        a crash."""
+        try:
+            with _cell_env(spec):
+                eng = self._build(spec)
+                try:
+                    # raw id streams, not re-encoded text: EOS and
+                    # vocab-padding ids are invisible in text, and an
+                    # argmax flip between two of them is exactly the
+                    # silent divergence this instrument exists to catch
+                    answers, tokens = eng.generate(
+                        list(self.probes), max_new_tokens=self.max_new,
+                        temperature=0.0, return_ids=True)
+                finally:
+                    if hasattr(eng, "close"):
+                        eng.close()
+            return {"axes": spec.axes(), "expect": spec.expect,
+                    "status": "run", "answers": answers, "tokens": tokens,
+                    "fingerprint": _fingerprint(tokens),
+                    "logits_topk": self._logits_topk(spec, topk)}
+        except Exception as e:  # noqa: BLE001 — per-cell isolation is
+            # the contract: discovery is static, load failures land here
+            return {"axes": spec.axes(), "expect": spec.expect,
+                    "status": "skipped",
+                    "reason": f"load/run failed: {type(e).__name__}: {e}"}
+
+
+def _fingerprint(tokens: list[list[int]]) -> str:
+    blob = json.dumps(tokens, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def diff_tokens(ref: list[list[int]], got: list[list[int]]
+                ) -> dict | None:
+    """First divergence between two per-probe token streams: the probe
+    and token index of the earliest mismatch (earliest token index wins
+    across probes — the DEPTH of the divergence is the signal), with the
+    differing ids.  ``None`` when the streams are identical."""
+    best: dict | None = None
+    for p, (a, b) in enumerate(zip(ref, got)):
+        n = max(len(a), len(b))
+        for t in range(n):
+            ra = a[t] if t < len(a) else None
+            rb = b[t] if t < len(b) else None
+            if ra != rb:
+                if best is None or t < best["token"]:
+                    best = {"probe": p, "token": t, "ref": ra, "got": rb}
+                break
+    if len(ref) != len(got) and best is None:
+        best = {"probe": min(len(ref), len(got)), "token": 0,
+                "ref": None, "got": None}
+    return best
+
+
+def _topk_drift(ra: dict, rb: dict) -> float:
+    """Drift between two top-k fingerprints: the max over (a) per-id
+    deltas for ids BOTH rows rank (the same token's logit moved) and
+    (b) rank-aligned order-statistic deltas (the k-th largest logit
+    moved — catches a new entrant whose counterpart value the other row
+    never recorded, e.g. a perturbed column storming the top).  A naive
+    positional diff alone would subtract logits of unrelated tokens
+    whenever the id lists reorder."""
+    drift = 0.0
+    for va, vb in zip(ra["vals"], rb["vals"]):      # sorted descending
+        drift = max(drift, abs(va - vb))
+    av = dict(zip(ra["ids"], ra["vals"]))
+    bv = dict(zip(rb["ids"], rb["vals"]))
+    for i in set(av) & set(bv):
+        drift = max(drift, abs(av[i] - bv[i]))
+    return drift
+
+
+def _diff_cell(ref_row: dict, row: dict) -> dict:
+    first = diff_tokens(ref_row["tokens"], row["tokens"])
+    drift = 0.0
+    ids_equal = True
+    for ra, rb in zip(ref_row["logits_topk"], row["logits_topk"]):
+        ids_equal = ids_equal and (ra["ids"] == rb["ids"])
+        drift = max(drift, _topk_drift(ra, rb))
+    return {"tokens_equal": first is None,
+            "first_divergence": first,
+            "logit_drift": round(drift, 6),
+            "topk_ids_equal": ids_equal,
+            "answers_equal": ref_row["answers"] == row["answers"]}
+
+
+def run_matrix(specs: list[CellSpec] | None = None, *,
+               probes=None, max_new_tokens: int | None = None,
+               reference: str | None = None, select=None,
+               registry: MetricsRegistry | None = None) -> dict:
+    """Run the divergence matrix and return the artifact dict (see
+    :data:`SCHEMA`).  ``registry`` (optional) receives the
+    ``reval_determinism_*`` telemetry via :func:`record_matrix`; the
+    returned artifact embeds a snapshot either way, so ``/metrics``-less
+    consumers (``tools/obs_report.py``) read the same numbers.
+
+    ``select`` (names) narrows which cells EXECUTE without narrowing the
+    report: unselected cells are recorded as skipped with a "not
+    selected" reason, so a filtered run can never masquerade as a clean
+    full audit — the vanished-cell lint rule stays enforceable."""
+    import jax
+
+    t0 = time.time()
+    probes = list(probes if probes is not None else PROBES)
+    max_new = (max_new_tokens if max_new_tokens is not None
+               else DEFAULT_MAX_NEW)
+    reference = (reference or env_str("REVAL_TPU_DETERMINISM_REF")
+                 or DEFAULT_REFERENCE)
+    topk = env_int("REVAL_TPU_DETERMINISM_TOPK", 8)
+    perturb = env_str("REVAL_TPU_DETERMINISM_PERTURB", "") or ""
+    avail, skipped = discover_cells(specs)
+    names = {s.name for s in avail} | set(skipped)
+    if reference not in names:
+        raise ValueError(f"reference cell {reference!r} is not in the "
+                         f"taxonomy {sorted(names)}")
+    if reference in skipped:
+        raise RuntimeError(f"reference cell {reference!r} is not loadable "
+                           f"here: {skipped[reference]}")
+    if select is not None:
+        chosen = set(select) | {reference}
+        unknown = chosen - names
+        if unknown:
+            raise ValueError(f"unknown cell(s) {sorted(unknown)}; "
+                             f"taxonomy: {sorted(names)}")
+        for spec in list(avail):
+            if spec.name not in chosen:
+                avail.remove(spec)
+                skipped[spec.name] = "not selected for this run (--cells)"
+
+    runner = _MatrixRunner(probes, max_new, perturb)
+    cells: dict[str, dict] = {}
+    order = sorted(avail, key=lambda s: s.name != reference)  # ref first
+    for spec in order:
+        cells[spec.name] = runner.run_cell(spec, topk)
+    for name, reason in skipped.items():
+        spec = next(s for s in (specs or default_cells()) if s.name == name)
+        cells[name] = {"axes": spec.axes(), "expect": spec.expect,
+                       "status": "skipped", "reason": reason}
+
+    ref_row = cells[reference]
+    if ref_row["status"] != "run":
+        raise RuntimeError(f"reference cell {reference!r} failed to run: "
+                           f"{ref_row.get('reason')}")
+    ref_row["status"] = "ref"
+    for name, row in cells.items():
+        if name == reference or row["status"] != "run":
+            continue
+        row["diff"] = _diff_cell(ref_row, row)
+        agree = row["diff"]["tokens_equal"] and row["diff"]["topk_ids_equal"]
+        row["status"] = "agree" if agree else "diverged"
+
+    diverged = [(n, r) for n, r in cells.items() if r["status"] == "diverged"]
+    depths = [r["diff"]["first_divergence"]["token"] for _, r in diverged
+              if r["diff"]["first_divergence"] is not None]
+    matrix = {
+        "schema": SCHEMA,
+        "created_unix": round(t0, 3),
+        "elapsed_s": round(time.time() - t0, 3),
+        "host": {"platform": jax.default_backend(),
+                 "device": str(jax.devices()[0].device_kind),
+                 "devices": len(jax.devices()),
+                 "jax": jax.__version__},
+        "reference": reference,
+        "probes": {"n": len(probes), "max_new_tokens": max_new,
+                   "digest": hashlib.sha256(
+                       "\x1e".join(probes).encode()).hexdigest()[:16]},
+        "perturb": perturb or None,
+        "cells": cells,
+        "summary": {
+            "cells_run": sum(1 for r in cells.values()
+                             if r["status"] in ("ref", "agree", "diverged")),
+            "cells_agree": sum(1 for r in cells.values()
+                               if r["status"] == "agree"),
+            "cells_diverged": len(diverged),
+            "cells_skipped": sum(1 for r in cells.values()
+                                 if r["status"] == "skipped"),
+            "divergence_depth": max(depths) if depths else None,
+        },
+    }
+    matrix["summary"]["gate_failures"] = gate_failures(matrix)
+    reg = registry if registry is not None else MetricsRegistry()
+    record_matrix(matrix, reg)
+    matrix["metrics"] = reg.snapshot()
+    return matrix
+
+
+def gate_failures(matrix: dict) -> list[str]:
+    """The tier-1 parity verdict: every ``bit_identical`` cell that
+    diverged from the reference, with the first divergent token named —
+    the loud failure a kernel PR that perturbs greedy outputs must hit."""
+    out = []
+    ref = matrix["reference"]
+    for name, row in sorted(matrix["cells"].items()):
+        if row["status"] != "diverged" or row["expect"] != "bit_identical":
+            continue
+        first = row["diff"]["first_divergence"]
+        if first is not None:
+            out.append(
+                f"cell {name}: greedy tokens diverge from {ref} at "
+                f"probe {first['probe']} token {first['token']} "
+                f"(ref {first['ref']!r} != got {first['got']!r})")
+        else:
+            out.append(f"cell {name}: top-{len(row['logits_topk'][0]['ids'])}"
+                       f" logit ids diverge from {ref} "
+                       f"(greedy tokens still agree)")
+    return out
+
+
+def record_matrix(matrix: dict, registry: MetricsRegistry) -> None:
+    """Fold one matrix run into a registry: the ``reval_determinism_*``
+    telemetry the README table documents.  Counters accumulate across
+    runs (a long-lived registry sums repeated audits); the depth gauge
+    keeps the newest run's reading."""
+    s = matrix["summary"]
+    registry.counter(obs_metrics.DET_CELLS).add(s["cells_run"])
+    registry.counter(obs_metrics.DET_AGREE).add(s["cells_agree"])
+    registry.counter(obs_metrics.DET_DIVERGED).add(s["cells_diverged"])
+    registry.counter(obs_metrics.DET_SKIPPED).add(s["cells_skipped"])
+    registry.gauge(obs_metrics.DET_DEPTH).set(
+        float(s["divergence_depth"] if s["divergence_depth"] is not None
+              else -1.0))
+    hist = registry.histogram(obs_metrics.DET_DRIFT)
+    for row in matrix["cells"].values():
+        if "diff" in row:
+            hist.observe(row["diff"]["logit_drift"])
+
+
+def reference_fingerprint(matrix: dict) -> str:
+    return matrix["cells"][matrix["reference"]]["fingerprint"]
+
+
+def bench_block(select=BENCH_SLICE) -> dict:
+    """The ``determinism`` block ``bench.py`` embeds in every round's
+    artifact: the reference cell's greedy-token fingerprint (the
+    cross-commit silent-drift detector ``tools/obs_report.py
+    --determinism`` diffs over BENCH history) plus the slice's
+    divergence counts."""
+    m = run_matrix(select=list(select))
+    return {"schema": m["schema"],
+            "reference": m["reference"],
+            "fingerprint": reference_fingerprint(m),
+            "probes_digest": m["probes"]["digest"],
+            "cells_run": m["summary"]["cells_run"],
+            "cells_diverged": m["summary"]["cells_diverged"],
+            "gate_failures": m["summary"]["gate_failures"],
+            # a leftover REVAL_TPU_DETERMINISM_PERTURB must be traceable
+            # in BENCH history, or its fingerprint change reads as a
+            # phantom cross-commit numerics drift
+            "perturb": m["perturb"]}
+
+
+def render_table(matrix: dict) -> str:
+    """The generated parity table (markdown) — the machine-written
+    successor of PARITY.md's hand-maintained backend rows."""
+    ref = matrix["reference"]
+    host = matrix["host"]
+    lines = [
+        "# Determinism matrix — generated by tools/determinism_matrix.py",
+        "",
+        f"Reference cell: `{ref}` · host: {host['platform']} "
+        f"({host['device']} ×{host['devices']}, jax {host['jax']}) · "
+        f"probes: {matrix['probes']['n']} × "
+        f"{matrix['probes']['max_new_tokens']} new tokens · schema "
+        f"`{matrix['schema']}`",
+        "",
+        "| cell | engine | kernel | dp | dtype | kv | batch | expect | "
+        "verdict | first divergence | logit drift |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for name, row in sorted(matrix["cells"].items(),
+                            key=lambda kv: (kv[1]["status"] != "ref",
+                                            kv[0])):
+        ax = row["axes"]
+        if row["status"] == "skipped":
+            verdict, first, drift = "skipped", row["reason"], "—"
+        elif row["status"] == "ref":
+            verdict, first, drift = "REFERENCE", "—", "—"
+        else:
+            verdict = ("agree" if row["status"] == "agree"
+                       else ("DIVERGED" if row["expect"] == "bit_identical"
+                             else "drift"))
+            fd = row["diff"]["first_divergence"]
+            first = (f"probe {fd['probe']} token {fd['token']}"
+                     if fd else "—")
+            drift = f"{row['diff']['logit_drift']:g}"
+        lines.append(
+            f"| `{name}` | {ax['engine']} | {ax['kernel']} | {ax['dp']} "
+            f"| {ax['dtype']} | {ax['kv_dtype'] or '—'} | {ax['batch']} "
+            f"| {row['expect']} | {verdict} | {first} | {drift} |")
+    s = matrix["summary"]
+    lines += ["",
+              f"{s['cells_run']} run · {s['cells_agree']} agree · "
+              f"{s['cells_diverged']} diverged · {s['cells_skipped']} "
+              f"skipped"
+              + (f" · max divergence depth {s['divergence_depth']}"
+                 if s["divergence_depth"] is not None else "")]
+    if s["gate_failures"]:
+        lines += ["", "**PARITY GATE FAILURES:**", ""]
+        lines += [f"- {msg}" for msg in s["gate_failures"]]
+    return "\n".join(lines) + "\n"
+
+
+def validate_matrix(obj: dict, taxonomy: list[CellSpec] | None = None
+                    ) -> list[str]:
+    """Schema check shared by the ``detmatrix`` lint pass, the CLI's
+    self-check before writing, and the tests.  Returns human-readable
+    errors (empty = valid).  The vanished-cell rule: every taxonomy cell
+    must appear, as run/agree/diverged/ref or skipped WITH a reason."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return ["matrix artifact is not a JSON object"]
+    if obj.get("schema") != SCHEMA:
+        return [f"schema {obj.get('schema')!r} != expected {SCHEMA!r}"]
+    cells = obj.get("cells")
+    if not isinstance(cells, dict) or not cells:
+        return ["no cells in report"]
+    ref = obj.get("reference")
+    if ref not in cells:
+        errors.append(f"reference cell {ref!r} missing from cells")
+    elif cells[ref].get("status") != "ref":
+        errors.append(f"reference cell {ref!r} has status "
+                      f"{cells[ref].get('status')!r}, expected 'ref'")
+    for name, row in sorted(cells.items()):
+        status = row.get("status")
+        if status not in ("ref", "agree", "diverged", "skipped"):
+            errors.append(f"cell {name}: unknown status {status!r}")
+            continue
+        if row.get("expect") not in ("bit_identical", "drift_allowed"):
+            errors.append(f"cell {name}: unknown expect "
+                          f"{row.get('expect')!r}")
+        if not isinstance(row.get("axes"), dict):
+            errors.append(f"cell {name}: missing axes")
+        if status == "skipped":
+            if not row.get("reason"):
+                errors.append(f"cell {name}: skipped without a reason")
+            continue
+        for key in ("tokens", "answers", "fingerprint", "logits_topk"):
+            if key not in row:
+                errors.append(f"cell {name}: run cell missing {key!r}")
+        if status in ("agree", "diverged") and "diff" not in row:
+            errors.append(f"cell {name}: compared cell missing diff")
+    for key in ("summary", "probes", "host"):
+        if not isinstance(obj.get(key), dict):
+            errors.append(f"missing {key!r} block")
+    expected = {s.name for s in (taxonomy if taxonomy is not None
+                                 else default_cells())}
+    for name in sorted(expected - set(cells)):
+        errors.append(f"cell {name}: in the declared taxonomy but absent "
+                      f"from the report (cells must be run or skipped "
+                      f"with a reason, never dropped)")
+    return errors
+
+
+def write_matrix(matrix: dict, out_dir: str | None = None) -> str:
+    """Atomically write ``determinism-<ts>.json`` into ``out_dir``
+    (default ``REVAL_TPU_DETERMINISM_DIR``, else ``tpu_watch/``) and
+    return the path."""
+    out_dir = (out_dir or env_str("REVAL_TPU_DETERMINISM_DIR")
+               or _default_dir())
+    os.makedirs(out_dir, exist_ok=True)
+    ts = time.strftime("%Y%m%d-%H%M%S", time.gmtime(matrix["created_unix"]))
+    path = os.path.join(out_dir, f"determinism-{ts}.json")
+    n = 1
+    while os.path.exists(path):     # two runs in one second must not
+        # clobber an audit record — a vanished report reads as clean
+        path = os.path.join(out_dir, f"determinism-{ts}.{n}.json")
+        n += 1
+    with open(path + ".tmp", "w") as f:
+        json.dump(matrix, f, indent=1)
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def _default_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tpu_watch")

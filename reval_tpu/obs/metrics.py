@@ -46,6 +46,8 @@ __all__ = [
     "ROUTER_REQUESTS", "ROUTER_ROUTED", "ROUTER_FAILOVERS",
     "ROUTER_EJECTIONS", "ROUTER_RECOVERIES", "ROUTER_SHEDS",
     "ROUTER_REPLICAS_READY",
+    "DET_CELLS", "DET_AGREE", "DET_DIVERGED", "DET_SKIPPED",
+    "DET_DEPTH", "DET_DRIFT", "DRIFT_BUCKETS",
 ]
 
 # Log-spaced seconds buckets spanning sub-ms host paths (mock engine,
@@ -58,6 +60,14 @@ LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 # Engine-step / chunk timings sit in the 0.1 ms – 10 s band.
 STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+# Logit-drift magnitudes (obs/determinism.py, the weight-dtype
+# observable): same-dtype cells read exactly 0, bf16 weights sit near
+# 1e-2, int8 near 0.2, an injected perturbation above 1 — the decades
+# between those are what the histogram must resolve.  Fingerprint
+# values are quantized at 1e-5, so that is the smallest resolvable
+# bucket.
+DRIFT_BUCKETS = (1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0)
 
 # -- metric name constants (import these; never inline the literals) -------
 REQUESTS = "reval_requests_total"
@@ -78,6 +88,12 @@ ROUTER_EJECTIONS = "reval_router_ejections_total"
 ROUTER_RECOVERIES = "reval_router_recoveries_total"
 ROUTER_SHEDS = "reval_router_sheds_total"
 ROUTER_REPLICAS_READY = "reval_router_replicas_ready"
+DET_CELLS = "reval_determinism_cells_total"
+DET_AGREE = "reval_determinism_cells_agree_total"
+DET_DIVERGED = "reval_determinism_cells_diverged_total"
+DET_SKIPPED = "reval_determinism_cells_skipped_total"
+DET_DEPTH = "reval_determinism_divergence_depth"
+DET_DRIFT = "reval_determinism_logit_drift"
 
 #: The canonical metric namespace: name -> (type, help[, buckets]).
 #: ``tools/check_metrics.py`` lints this dict against the README table.
@@ -175,6 +191,30 @@ METRICS: dict[str, dict] = {
                             "help": "Replicas currently healthy and "
                                     "passing /readyz (router poller "
                                     "view)"},
+    # determinism observatory (obs/determinism.py) — one matrix run
+    # increments the counters once per cell; the snapshot rides the
+    # determinism-<ts>.json artifact and merges into any registry
+    DET_CELLS: {"type": "counter",
+                "help": "Divergence-matrix cells executed (ref + "
+                        "compared; skipped cells excluded)"},
+    DET_AGREE: {"type": "counter",
+                "help": "Cells bit-identical with the reference cell "
+                        "(greedy tokens and top-k logit ids)"},
+    DET_DIVERGED: {"type": "counter",
+                   "help": "Cells that diverged from the reference cell "
+                           "(incl. expected drift_allowed divergence)"},
+    DET_SKIPPED: {"type": "counter",
+                  "help": "Taxonomy cells not loadable on this host "
+                          "(each carries a reason in the matrix JSON)"},
+    DET_DEPTH: {"type": "gauge",
+                "help": "Deepest first-divergent greedy-token index "
+                        "across diverged cells, newest matrix run "
+                        "(-1 = no divergence observed)"},
+    DET_DRIFT: {"type": "histogram", "buckets": DRIFT_BUCKETS,
+                "help": "Max abs top-k logit delta vs the reference "
+                        "cell (weight-dtype observable; shared-id + "
+                        "rank-aligned), one observation per compared "
+                        "cell"},
 }
 
 

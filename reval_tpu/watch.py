@@ -16,6 +16,14 @@ against a down/unready server renders a waiting banner and keeps
 polling — the console is exactly for watching a server come up, drain,
 or die.
 
+Pointing it at a **fleet router** (``reval_tpu router``) works too: the
+router's ``/statusz`` carries ``"router": true``, and the console
+switches to the federated fleet view — per-replica health
+(healthy/ejected/half-open, ready, in-flight forwards, strikes, last
+error), fleet request rate and routing counters from the router's own
+registry, and the hash-ring/affinity placement.  The router serves no
+``/debugz`` (it owns no engine), so that fetch is skipped.
+
 Usage::
 
     python -m reval_tpu watch [--host H] [--port P] [--interval S]
@@ -36,7 +44,7 @@ import urllib.request
 from .obs import metrics as obs_metrics
 from .obs.metrics import snapshot_percentile
 
-__all__ = ["run_watch", "render_screen"]
+__all__ = ["run_watch", "render_screen", "render_router_screen"]
 
 CLEAR = "\x1b[H\x1b[2J"
 
@@ -143,6 +151,58 @@ def render_screen(status: dict, debug: dict, prev_counters: dict | None,
     return "\n".join(lines) + "\n"
 
 
+#: router counters whose running totals headline the fleet view
+_ROUTER_COUNTERS = (("routed", obs_metrics.ROUTER_ROUTED),
+                    ("failovers", obs_metrics.ROUTER_FAILOVERS),
+                    ("ejections", obs_metrics.ROUTER_EJECTIONS),
+                    ("recoveries", obs_metrics.ROUTER_RECOVERIES),
+                    ("sheds", obs_metrics.ROUTER_SHEDS))
+
+
+def render_router_screen(status: dict, prev_counters: dict | None,
+                         dt: float, target: str) -> str:
+    """The federated fleet view from a router's /statusz body: the
+    router's own counters headline, one row per replica underneath."""
+    metrics = status.get("metrics", {})
+    counters = metrics.get("counters", {})
+    replicas = status.get("replicas") or []
+    ready_n = sum(1 for r in replicas
+                  if r.get("ready") and r.get("state") == "healthy")
+    lines = [f"== reval_tpu watch · {target} · ROUTER · "
+             f"{status.get('model', '?')} · {ready_n}/{len(replicas)} "
+             f"replicas ready · {time.strftime('%H:%M:%S')} =="]
+
+    name = obs_metrics.ROUTER_REQUESTS
+    cur = counters.get(name, 0)
+    if prev_counters is None or dt <= 0:
+        rate = "req/s —"
+    else:
+        rate = f"req/s {max(0.0, (cur - prev_counters.get(name, 0)) / dt):.1f}"
+    lines.append(f"fleet        {rate}  requests {int(cur)}  "
+                 + "  ".join(f"{label} {int(counters.get(m, 0))}"
+                             for label, m in _ROUTER_COUNTERS))
+    ring = status.get("ring") or {}
+    affinity = status.get("affinity") or {}
+    lines.append(f"ring         {len(ring.get('members') or ())} members × "
+                 f"{ring.get('vnodes', '?')} vnodes"
+                 f"  affinity_window {status.get('window_chars', '?')} chars"
+                 + (f"  pinned_templates {len(affinity.get('placement') or ())}"
+                    if affinity else ""))
+
+    lines.append(f"replicas     {'id':<18} {'state':<10} {'ready':<6} "
+                 f"{'inflight':>8} {'strikes':>8}  last_error")
+    for rep in replicas:
+        err = (rep.get("last_error") or "")[:40]
+        lines.append(f"             {str(rep.get('id', '?')):<18} "
+                     f"{str(rep.get('state', '?')):<10} "
+                     f"{('yes' if rep.get('ready') else 'NO'):<6} "
+                     f"{rep.get('inflight', 0):>8} "
+                     f"{rep.get('fails', 0):>8}  {err}")
+    if not replicas:
+        lines.append("             (no replicas registered)")
+    return "\n".join(lines) + "\n"
+
+
 def run_watch(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="reval_tpu watch",
@@ -170,7 +230,10 @@ def run_watch(argv: list[str] | None = None) -> int:
             n += 1
             try:
                 status = _fetch_json(f"{base}/statusz")
-                debug = _fetch_json(f"{base}/debugz")
+                # a fleet router has no engine, hence no /debugz — its
+                # /statusz self-identifies and gets the federated view
+                debug = ({} if status.get("router")
+                         else _fetch_json(f"{base}/debugz"))
             except (urllib.error.URLError, TimeoutError, ConnectionError,
                     json.JSONDecodeError, OSError) as exc:
                 if not args.no_clear:
@@ -180,8 +243,12 @@ def run_watch(argv: list[str] | None = None) -> int:
                       f"  (retrying every {args.interval:g}s)")
                 continue
             now = time.monotonic()
-            screen = render_screen(status, debug, prev_counters,
-                                   now - prev_t, target)
+            if status.get("router"):
+                screen = render_router_screen(status, prev_counters,
+                                              now - prev_t, target)
+            else:
+                screen = render_screen(status, debug, prev_counters,
+                                       now - prev_t, target)
             prev_counters = dict(
                 status.get("metrics", {}).get("counters", {}))
             prev_t = now

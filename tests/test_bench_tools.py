@@ -47,6 +47,13 @@ def test_bench_tiny_emits_one_json_line():
             "warm_prefill_reduction"} <= set(pc)
     assert pc["warm_prefill_reduction"] > 0
     assert "no_prefix_cache_speedup" in d
+    # the determinism block: reference-cell greedy fingerprint recorded
+    # every round so BENCH history detects silent cross-commit drift
+    det = d["determinism"]
+    assert det["reference"] == "paged-xla-fp32-b2"
+    assert len(det["fingerprint"]) == 16
+    assert det["cells_run"] >= 3
+    assert det["gate_failures"] == []
 
 
 def test_bench_failure_carries_last_known():

@@ -1,0 +1,394 @@
+"""Determinism observatory: the tier-1 parity gate + matrix engine tests.
+
+The fast tier runs the REAL parity slice once (the CLI with
+``--cells <PARITY_SLICE>`` — xla vs both Pallas kernels, paged vs
+static, dp2 vs dp1, batch width) and pins:
+
+- the slice is CLEAN at HEAD (a kernel PR that perturbs greedy outputs
+  turns this red with a named cell + first divergent token);
+- the artifact round-trips its schema, the detmatrix lint pass accepts
+  it (and bites on a vanished cell), and ``tools/obs_report.py`` reads
+  it;
+- the ``reval_determinism_*`` telemetry renders through the existing
+  Prometheus/snapshot machinery;
+- an injected logit perturbation (``REVAL_TPU_DETERMINISM_PERTURB``) is
+  caught with correct first-divergent-token attribution.
+
+Unit tests (no engines) cover diff attribution, discovery skip reasons,
+and per-cell failure degradation.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import importlib.util
+import json
+import os
+
+import pytest
+
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.obs.determinism import (BENCH_SLICE, PARITY_SLICE, PROBES,
+                                       SCHEMA, CellSpec, _MatrixRunner,
+                                       default_cells, diff_tokens,
+                                       discover_cells, gate_failures,
+                                       record_matrix, reference_fingerprint,
+                                       render_table, run_matrix,
+                                       validate_matrix)
+from reval_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name: str):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"{name}_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# units — no engines
+# ---------------------------------------------------------------------------
+
+class TestUnits:
+    def test_taxonomy_names_unique_and_reference_present(self):
+        cells = default_cells()
+        names = [c.name for c in cells]
+        assert len(names) == len(set(names))
+        from reval_tpu.obs.determinism import DEFAULT_REFERENCE
+
+        assert DEFAULT_REFERENCE in names
+        assert set(PARITY_SLICE) <= set(names)
+        assert set(BENCH_SLICE) <= set(names)
+        # the parity slice is exactly the bit-identical contract cells
+        for c in cells:
+            if c.name in PARITY_SLICE:
+                assert c.expect == "bit_identical", c.name
+
+    def test_diff_tokens_earliest_token_index_wins_across_probes(self):
+        ref = [[1, 2, 3, 4], [5, 6, 7, 8]]
+        got = [[1, 2, 3, 9], [5, 6, 0, 8]]     # probe0 @3, probe1 @2
+        first = diff_tokens(ref, got)
+        assert first == {"probe": 1, "token": 2, "ref": 7, "got": 0}
+
+    def test_diff_tokens_handles_length_mismatch_and_equality(self):
+        assert diff_tokens([[1, 2]], [[1, 2]]) is None
+        first = diff_tokens([[1, 2, 3]], [[1, 2]])
+        assert first == {"probe": 0, "token": 2, "ref": 3, "got": None}
+
+    def test_discovery_skips_oversized_dp_with_reason(self):
+        specs = default_cells() + [
+            CellSpec("paged-xla-fp32-dp99-b2", "dp_paged", "xla", dp=99)]
+        avail, skipped = discover_cells(specs)
+        assert "paged-xla-fp32-dp99-b2" in skipped
+        assert "devices" in skipped["paged-xla-fp32-dp99-b2"]
+        assert all(s.name != "paged-xla-fp32-dp99-b2" for s in avail)
+
+    def test_run_cell_degrades_build_failure_to_skip_with_reason(self,
+                                                                 monkeypatch):
+        """A broken backend is a report finding, never a crash."""
+        runner = _MatrixRunner(PROBES, 4, "")
+
+        def boom(spec):
+            raise RuntimeError("backend exploded on load")
+
+        monkeypatch.setattr(runner, "_build", boom)
+        row = runner.run_cell(CellSpec("paged-xla-fp32-b2", "paged", "xla"),
+                              topk=4)
+        assert row["status"] == "skipped"
+        assert "backend exploded on load" in row["reason"]
+        assert row["axes"]["engine"] == "paged"
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 parity slice — ONE real run shared by the module
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def parity_cli(tmp_path_factory):
+    """Run the CLI over the parity slice once; share (rc, artifact)."""
+    out = str(tmp_path_factory.mktemp("detmatrix"))
+    tool = _load_tool("determinism_matrix")
+    rc = tool.main(["--tiny", "--cells", ",".join(PARITY_SLICE),
+                    "--out", out,
+                    "--table", os.path.join(out, "table.md")])
+    arts = sorted(glob.glob(os.path.join(out, "determinism-*.json")))
+    assert arts, "CLI wrote no matrix artifact"
+    with open(arts[0]) as f:
+        matrix = json.load(f)
+    return rc, out, arts[0], matrix
+
+
+class TestParityGateAtHead:
+    def test_cli_exits_clean_and_covers_the_slice(self, parity_cli):
+        rc, _, _, matrix = parity_cli
+        assert rc == 0, matrix["summary"]["gate_failures"]
+        assert matrix["summary"]["cells_run"] >= 6
+        assert matrix["summary"]["gate_failures"] == []
+
+    def test_every_parity_cell_is_bit_identical_at_head(self, parity_cli):
+        """THE gate: xla vs pallas vs pallas_seq kernels, paged vs
+        static engines, dp2 vs dp1, slot width — all greedy-identical."""
+        _, _, _, matrix = parity_cli
+        for name in PARITY_SLICE:
+            row = matrix["cells"][name]
+            if name == matrix["reference"]:
+                assert row["status"] == "ref"
+                continue
+            assert row["status"] == "agree", (
+                f"{name}: {row.get('diff', row.get('reason'))}")
+            assert row["diff"]["tokens_equal"]
+            assert row["diff"]["topk_ids_equal"]
+            assert row["diff"]["answers_equal"]
+
+    def test_unselected_cells_are_skipped_with_reason_never_dropped(
+            self, parity_cli):
+        _, _, _, matrix = parity_cli
+        assert set(matrix["cells"]) == {c.name for c in default_cells()}
+        for name, row in matrix["cells"].items():
+            if row["status"] == "skipped":
+                assert row["reason"], name
+
+    def test_rendered_table_names_every_cell(self, parity_cli):
+        _, out, _, matrix = parity_cli
+        with open(os.path.join(out, "table.md")) as f:
+            table = f.read()
+        for name in matrix["cells"]:
+            assert f"`{name}`" in table
+        assert "REFERENCE" in table
+
+
+class TestArtifactSchema:
+    def test_schema_validates_and_round_trips(self, parity_cli):
+        _, _, path, matrix = parity_cli
+        assert matrix["schema"] == SCHEMA
+        assert validate_matrix(matrix) == []
+        # byte round trip through disk preserved validity
+        assert validate_matrix(json.loads(json.dumps(matrix))) == []
+        assert reference_fingerprint(matrix)
+
+    def test_validate_bites_on_vanished_cell_and_reasonless_skip(
+            self, parity_cli):
+        _, _, _, matrix = parity_cli
+        broken = copy.deepcopy(matrix)
+        del broken["cells"]["static-fp32-b2"]
+        errs = validate_matrix(broken)
+        assert any("static-fp32-b2" in e and "absent" in e for e in errs)
+
+        broken = copy.deepcopy(matrix)
+        skipped = next(n for n, r in broken["cells"].items()
+                       if r["status"] == "skipped")
+        broken["cells"][skipped].pop("reason")
+        assert any("without a reason" in e for e in validate_matrix(broken))
+
+        assert validate_matrix({"schema": "bogus"})[0].startswith("schema")
+
+    def test_detmatrix_lint_pass_accepts_head_and_bites(self, parity_cli,
+                                                        tmp_path):
+        from reval_tpu.analysis.detmatrix import run as lint_run
+
+        _, _, path, matrix = parity_cli
+        root = str(tmp_path)
+        os.makedirs(os.path.join(root, "tpu_watch"))
+        good = os.path.join(root, "tpu_watch", "determinism-good.json")
+        with open(good, "w") as f:
+            json.dump(matrix, f)
+        assert lint_run({}, root) == []
+
+        broken = copy.deepcopy(matrix)
+        del broken["cells"][sorted(broken["cells"])[0]]
+        with open(os.path.join(root, "tpu_watch",
+                               "determinism-zbad.json"), "w") as f:
+            json.dump(broken, f)
+        msgs = [str(v) for v in lint_run({}, root)]
+        assert any("absent from the report" in m for m in msgs)
+        assert all("determinism-good" not in m for m in msgs)
+
+        # a truncated artifact is a violation, not a silent skip
+        with open(os.path.join(root, "tpu_watch",
+                               "determinism-zbad.json"), "w") as f:
+            f.write('{"schema": "reval-det')
+        assert any("unreadable" in str(v) for v in lint_run({}, root))
+
+    def test_obs_report_reads_the_artifact(self, parity_cli, capsys):
+        """The matrix embeds a registry snapshot under "metrics" — the
+        existing snapshot renderer reads it unmodified."""
+        tool = _load_tool("obs_report")
+        _, _, path, _ = parity_cli
+        snap = tool.load_snapshot(path)
+        out = tool.render(snap, "matrix")
+        assert obs_metrics.DET_CELLS in out
+        assert obs_metrics.DET_DRIFT in out
+
+
+class TestTelemetry:
+    def test_record_matrix_feeds_declared_metrics(self, parity_cli):
+        _, _, _, matrix = parity_cli
+        reg = MetricsRegistry()
+        record_matrix(matrix, reg)
+        s = matrix["summary"]
+        assert reg.counter(obs_metrics.DET_CELLS).value == s["cells_run"]
+        assert reg.counter(obs_metrics.DET_AGREE).value == s["cells_agree"]
+        assert (reg.counter(obs_metrics.DET_DIVERGED).value
+                == s["cells_diverged"])
+        assert (reg.counter(obs_metrics.DET_SKIPPED).value
+                == s["cells_skipped"])
+        # one drift observation per compared cell
+        n_compared = sum(1 for r in matrix["cells"].values() if "diff" in r)
+        assert reg.histogram(obs_metrics.DET_DRIFT).count == n_compared
+
+    def test_determinism_metrics_render_through_prometheus(self, parity_cli):
+        """Surfacing contract: the registry the matrix feeds renders on
+        the same exposition path /metrics uses, and the grammar checker
+        accepts it — any server/router merge therefore exposes it."""
+        _, _, _, matrix = parity_cli
+        reg = MetricsRegistry()
+        record_matrix(matrix, reg)
+        text = reg.render_prometheus()
+        samples = parse_prometheus(text)
+        assert samples[obs_metrics.DET_CELLS] == matrix["summary"]["cells_run"]
+        assert f"{obs_metrics.DET_DRIFT}_count" in samples
+        assert samples[obs_metrics.DET_DEPTH] == -1.0  # clean slice
+
+    def test_snapshot_in_artifact_matches_summary(self, parity_cli):
+        _, _, _, matrix = parity_cli
+        counters = matrix["metrics"]["counters"]
+        assert (counters[obs_metrics.DET_CELLS]
+                == matrix["summary"]["cells_run"])
+
+
+# ---------------------------------------------------------------------------
+# injected perturbation — the gate must trip with correct attribution
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def perturbed_matrix():
+    """Perturb the static cell's lm_head and run ref + static only."""
+    target = "static-fp32-b2"
+    os.environ["REVAL_TPU_DETERMINISM_PERTURB"] = target
+    try:
+        matrix = run_matrix(select=[target])
+    finally:
+        os.environ.pop("REVAL_TPU_DETERMINISM_PERTURB", None)
+    return target, matrix
+
+
+class TestInjectedPerturbation:
+    def test_gate_fails_loudly_naming_cell_and_first_token(
+            self, perturbed_matrix):
+        target, matrix = perturbed_matrix
+        row = matrix["cells"][target]
+        assert row["status"] == "diverged"
+        failures = matrix["summary"]["gate_failures"]
+        assert failures, "perturbed bit-identical cell must fail the gate"
+        assert any(target in msg and "probe" in msg and "token" in msg
+                   for msg in failures)
+        # gate_failures() recomputes identically from the artifact
+        assert gate_failures(matrix) == failures
+
+    def test_first_divergence_attribution_is_correct(self, perturbed_matrix):
+        """The named (probe, token) really is the earliest mismatch of
+        the recorded streams — recomputed independently here."""
+        target, matrix = perturbed_matrix
+        ref_tokens = matrix["cells"][matrix["reference"]]["tokens"]
+        got_tokens = matrix["cells"][target]["tokens"]
+        first = matrix["cells"][target]["diff"]["first_divergence"]
+        assert first == diff_tokens(ref_tokens, got_tokens)
+        probe, tok = first["probe"], first["token"]
+        assert ref_tokens[probe][:tok] == got_tokens[probe][:tok]
+        assert ref_tokens[probe][tok] != got_tokens[probe][tok]
+        assert matrix["summary"]["divergence_depth"] == tok
+
+    def test_perturbation_moves_logit_drift_histogram(self, perturbed_matrix):
+        target, matrix = perturbed_matrix
+        drift = matrix["cells"][target]["diff"]["logit_drift"]
+        assert drift > 1.0     # the boost is ~8 on one column
+        assert matrix["summary"]["cells_diverged"] >= 1
+        assert render_table(matrix).count("PARITY GATE FAILURES") == 1
+        # traceability: the artifact records WHICH cell was perturbed
+        assert matrix["perturb"] == target
+
+
+# ---------------------------------------------------------------------------
+# obs_report --determinism: cross-round drift detection
+# ---------------------------------------------------------------------------
+
+class TestObsReportDeterminismMode:
+    def _round(self, tmp_path, name, fp, diverged=0, block=True,
+               perturb=None):
+        obj = {"metric": "m", "value": 1.0}
+        if block:
+            obj["determinism"] = {
+                "reference": "paged-xla-fp32-b2", "fingerprint": fp,
+                "probes_digest": "d", "cells_run": 3,
+                "cells_diverged": diverged, "gate_failures": [],
+                "perturb": perturb}
+        path = os.path.join(str(tmp_path), name)
+        with open(path, "w") as f:
+            json.dump(obj, f)
+        return path
+
+    def test_names_first_round_whose_fingerprint_changed(self, tmp_path,
+                                                         capsys):
+        tool = _load_tool("obs_report")
+        paths = [self._round(tmp_path, "BENCH_r01.json", "aaaa"),
+                 self._round(tmp_path, "BENCH_r02.json", "aaaa"),
+                 self._round(tmp_path, "BENCH_r03.json", None, block=False),
+                 self._round(tmp_path, "BENCH_r04.json", "bbbb", diverged=2),
+                 self._round(tmp_path, "BENCH_r05.json", "bbbb")]
+        rc = tool.main(["--determinism", *paths])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "first drift: BENCH_r04.json" in out
+        assert "was aaaa in BENCH_r02.json" in out
+        assert "no determinism block" in out          # r03 named, not hidden
+        assert out.count("fingerprint CHANGED") == 1  # r05 matches r04
+
+    def test_no_drift_reads_clean(self, tmp_path, capsys):
+        tool = _load_tool("obs_report")
+        paths = [self._round(tmp_path, "BENCH_r01.json", "cccc"),
+                 self._round(tmp_path, "BENCH_r02.json", "cccc")]
+        rc = tool.main(["--determinism", *paths])
+        assert rc == 0
+        assert "no fingerprint drift" in capsys.readouterr().out
+
+    def test_stray_non_object_json_degrades_to_one_row(self, tmp_path,
+                                                       capsys):
+        """A globbed-in array/string artifact must cost one unreadable
+        row, never the whole report."""
+        tool = _load_tool("obs_report")
+        stray = os.path.join(str(tmp_path), "stray.json")
+        with open(stray, "w") as f:
+            f.write("[1, 2, 3]")
+        paths = [self._round(tmp_path, "BENCH_r01.json", "cccc"), stray,
+                 self._round(tmp_path, "BENCH_r02.json", "cccc")]
+        rc = tool.main(["--determinism", *paths])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "unreadable" in out
+        assert "no fingerprint drift" in out    # the cccc rows survive
+
+    def test_perturbed_round_is_flagged_not_phantom_drift(self, tmp_path,
+                                                          capsys):
+        """A leftover REVAL_TPU_DETERMINISM_PERTURB run must be visibly
+        marked in drift history, or its fingerprint change reads as a
+        phantom cross-commit numerics change."""
+        tool = _load_tool("obs_report")
+        paths = [self._round(tmp_path, "BENCH_r01.json", "cccc"),
+                 self._round(tmp_path, "BENCH_r02.json", "dddd",
+                             perturb="static-fp32-b2")]
+        rc = tool.main(["--determinism", *paths])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "PERTURBED: static-fp32-b2" in out
+
+    def test_reads_raw_matrix_artifacts_too(self, parity_cli, capsys):
+        tool = _load_tool("obs_report")
+        _, _, path, matrix = parity_cli
+        rc = tool.main(["--determinism", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert reference_fingerprint(matrix) in out

@@ -752,3 +752,40 @@ def test_prefix_stats_json_affinity_table_seeds_the_router(tmp_path):
         router.shutdown()
     finally:
         srv.shutdown()
+
+
+def test_watch_console_renders_router_fleet_view(capsys):
+    """`reval_tpu watch` pointed at the ROUTER endpoint must render the
+    federated fleet view (per-replica ready/ejected state, fleet req/s
+    from the router's own counters) instead of failing on the router's
+    /statusz shape (routers serve no /debugz)."""
+    from reval_tpu.watch import run_watch
+
+    servers = [make_replica(), make_replica()]
+    router = make_router(servers)
+    try:
+        wait_router_ready(router)
+        hard_kill(servers[1])          # one replica dies; poller ejects it
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            reps = {r["id"]: r for r in router.statusz()["replicas"]}
+            if any(r["state"] == "ejected" for r in reps.values()):
+                break
+            time.sleep(0.05)
+        post_router(router, "watch me", max_tokens=8)
+        rc = run_watch(["--port", str(router.port), "--interval", "0.01",
+                        "--iterations", "2", "--no-clear"])
+    finally:
+        router.shutdown()
+        for srv in servers:
+            srv.shutdown()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ROUTER" in out and "replicas ready" in out
+    assert "req/s" in out and "failovers" in out and "ejections" in out
+    # both replica rows render, with the dead one visibly not healthy
+    assert "healthy" in out and "ejected" in out
+    assert out.count("reval_tpu watch") == 2
+    # per-replica rows name both replica ids
+    for rep in router.statusz()["replicas"]:
+        assert str(rep["id"]) in out

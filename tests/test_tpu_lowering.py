@@ -18,11 +18,26 @@ structure, not size.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import pytest
+
+# jax 0.4.x does not re-export the submodule lazily: `jax.export` is an
+# AttributeError until someone imports it explicitly.  Probe it here and
+# SKIP (never fail) when this host's jax cannot run the lowering pass at
+# all — a skip names the environment gap; a failure must mean a kernel
+# regression.
+try:
+    import jax.export  # noqa: F401
+    _EXPORT_SKIP = None
+except ImportError as _e:  # pragma: no cover — depends on host jax build
+    _EXPORT_SKIP = f"jax.export unavailable on this host ({_e})"
+
+pytestmark = pytest.mark.skipif(_EXPORT_SKIP is not None,
+                                reason=_EXPORT_SKIP or "")
 
 from reval_tpu.ops.pallas_attention import (
     paged_decode_attention_pallas,
@@ -30,6 +45,49 @@ from reval_tpu.ops.pallas_attention import (
 )
 
 B, P, NPAGES, SPAN, D = 4, 128, 24, 6, 128
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_lowering_skip() -> str | None:
+    """Capability canary for the DIRECT kernel exports: both decode
+    kernels transpose a K/V page in VMEM (``jnp.swapaxes(k, 0, 1)``, the
+    ``swap`` dot formulation), and older jax builds' Mosaic TPU lowering
+    has no rule for a (1, 0, 2) transpose — the chip's jax does.  Export
+    a minimal Pallas program using exactly that construct: if THIS fails,
+    the host cannot lower the real kernels either, and the kernel-level
+    tests skip with the environment named.  If the canary passes, a
+    kernel-test failure is a real regression (or a new gap worth triage),
+    so it stays a failure.  The whole-program exports below don't take
+    this skip: they lower today and must keep lowering.
+
+    Cached + called from test bodies (not at import), so collection and
+    deselected runs never pay the multi-second canary export."""
+    if _EXPORT_SKIP is not None:    # module already skipped wholesale
+        return _EXPORT_SKIP
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = jnp.swapaxes(x_ref[...], 0, 1)
+
+    fn = pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(
+        (8, 2, 128), jnp.float32))
+    try:
+        jax.export.export(jax.jit(fn), platforms=["tpu"])(
+            jnp.zeros((2, 8, 128), jnp.float32))
+        return None
+    except Exception as e:  # noqa: BLE001 — any lowering error means
+        # the host toolchain, not the kernel, is what cannot lower
+        return ("jax.export unavailable for the Pallas kernel exports on "
+                "this host: this jax build's Mosaic TPU lowering lacks the "
+                f"kernels' baseline (1,0,2) transpose "
+                f"({type(e).__name__})")
+
+
+@pytest.fixture()
+def kernel_exports_supported():
+    reason = _kernel_lowering_skip()
+    if reason is not None:
+        pytest.skip(reason)
 
 KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_seq]
 
@@ -48,7 +106,7 @@ def _operands(h, h_kv, store_dtype=jnp.bfloat16):
 
 @pytest.mark.parametrize("kernel", KERNELS)
 @pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4), (8, 1)])
-def test_lowers_bf16(kernel, h, h_kv):
+def test_lowers_bf16(kernel_exports_supported, kernel, h, h_kv):
     q, kp, bt, sl = _operands(h, h_kv)
 
     def f(q, kp, vp, bt, sl):
@@ -59,7 +117,7 @@ def test_lowers_bf16(kernel, h, h_kv):
 
 @pytest.mark.parametrize("kernel", KERNELS)
 @pytest.mark.parametrize("h,h_kv", [(16, 16), (16, 4)])   # MHA + GQA folding
-def test_lowers_int8_pool(kernel, h, h_kv):
+def test_lowers_int8_pool(kernel_exports_supported, kernel, h, h_kv):
     q, kp, bt, sl = _operands(h, h_kv, jnp.int8)
     scales = jnp.ones((NPAGES * P, h_kv), jnp.float32)
 
@@ -70,7 +128,7 @@ def test_lowers_int8_pool(kernel, h, h_kv):
 
 
 @pytest.mark.parametrize("kernel", KERNELS)
-def test_lowers_window_softcap(kernel):
+def test_lowers_window_softcap(kernel_exports_supported, kernel):
     q, kp, bt, sl = _operands(16, 4)
 
     def f(q, kp, vp, bt, sl):

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Run the cross-backend determinism matrix and publish the parity table.
+
+The CLI front of ``reval_tpu/obs/determinism.py``: runs a fixed, seeded
+probe set through every loadable backend×kernel×parallelism×dtype×batch
+cell, diffs each against the declared reference cell, and writes
+
+- ``tpu_watch/determinism-<ts>.json`` — the machine-readable matrix
+  (schema ``reval-determinism-v1``; linted by the ``detmatrix``
+  reval-lint pass so cells can never silently vanish from the report);
+- ``tpu_watch/determinism_table.md`` — the rendered parity table
+  PARITY.md points at (supersedes its hand-written backend rows).
+
+Exit codes: 0 = all ``bit_identical`` cells agree with the reference;
+1 = PARITY GATE FAILURE (a bit-identical cell diverged — the message
+names the cell and the first divergent token); 2 = the matrix could not
+run (reference unloadable, bad arguments).
+
+Usage:
+    python tools/determinism_matrix.py --tiny            # CPU dev host
+    python tools/determinism_matrix.py                   # on-chip audit
+    python tools/determinism_matrix.py --cells paged-xla-fp32-b2,static-fp32-b2
+    python tools/determinism_matrix.py --tiny --json     # matrix to stdout
+
+``--tiny`` pins jax to CPU and exposes 2 virtual host devices (so the
+dp=2 cell is loadable) BEFORE jax initialises — the same probe model is
+toy-sized either way, so --tiny changes the platform, not the cells.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU smoke: force the cpu platform + 2 virtual "
+                         "devices (dp cell stays loadable)")
+    ap.add_argument("--cells", default=None,
+                    help="comma-separated cell names to execute "
+                         "(unselected cells are reported as skipped, "
+                         "never dropped); default: all")
+    ap.add_argument("--reference", default=None,
+                    help="reference cell override "
+                         "(env REVAL_TPU_DETERMINISM_REF)")
+    ap.add_argument("--max-new", type=int, default=None,
+                    help="greedy tokens per probe (default 12)")
+    ap.add_argument("--out", default=None,
+                    help="artifact directory (default env "
+                         "REVAL_TPU_DETERMINISM_DIR, else tpu_watch/)")
+    ap.add_argument("--table", default=None,
+                    help="rendered markdown table path (default "
+                         "<out>/determinism_table.md; 'none' disables)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full matrix JSON to stdout")
+    args = ap.parse_args(argv)
+
+    if args.tiny:
+        # must land before jax initialises a backend
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+
+    from reval_tpu.obs.determinism import (default_cells, render_table,
+                                           run_matrix, validate_matrix,
+                                           write_matrix)
+
+    select = ([c.strip() for c in args.cells.split(",") if c.strip()]
+              if args.cells else None)
+    try:
+        matrix = run_matrix(select=select, reference=args.reference,
+                            max_new_tokens=args.max_new)
+    except (ValueError, RuntimeError) as e:
+        print(f"determinism_matrix: {e}", file=sys.stderr)
+        return 2
+
+    problems = validate_matrix(matrix, default_cells())
+    if problems:    # a malformed artifact must never be written quietly
+        for p in problems:
+            print(f"determinism_matrix: self-check: {p}", file=sys.stderr)
+        return 2
+
+    path = write_matrix(matrix, args.out)
+    table = render_table(matrix)
+    table_path = args.table
+    if table_path != "none":
+        table_path = table_path or os.path.join(
+            os.path.dirname(path), "determinism_table.md")
+        with open(table_path + ".tmp", "w") as f:
+            f.write(table)
+        os.replace(table_path + ".tmp", table_path)
+
+    if args.json:
+        print(json.dumps(matrix, indent=1))
+    else:
+        print(table, end="")
+        print(f"\nmatrix: {path}"
+              + (f"\ntable:  {table_path}" if table_path != "none" else ""))
+
+    failures = matrix["summary"]["gate_failures"]
+    if failures:
+        print("\nPARITY GATE FAILURE:", file=sys.stderr)
+        for msg in failures:
+            print(f"  {msg}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

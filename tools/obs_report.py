@@ -11,8 +11,16 @@ distribution of exactly the requests that happened between the two
 scrapes, which is how you price a scheduler change without restarting
 the server.
 
+With ``--determinism`` the inputs are BENCH round artifacts (or
+determinism matrix files) in chronological order: the report lists each
+round's reference-cell greedy fingerprint + diverged-cell count and
+names the FIRST round whose fingerprint changed — the cross-commit
+silent-drift detector (obs/determinism.py writes the block, bench.py
+embeds it every round).
+
 Usage:
     python tools/obs_report.py SNAP.json [SNAP2.json]
+    python tools/obs_report.py --determinism BENCH_r*.json
 """
 
 from __future__ import annotations
@@ -116,22 +124,92 @@ def render(snap: dict, title: str) -> str:
     return "\n".join(lines)
 
 
+def determinism_block(path: str) -> dict | None:
+    """The determinism block of one artifact: a BENCH round's embedded
+    ``determinism`` dict, or a matrix file's own reference-cell row
+    (both carry the same keys this report reads)."""
+    from reval_tpu.obs.determinism import SCHEMA
+
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):   # a stray array/string artifact must
+        # degrade to one unreadable row, not kill the whole report
+        raise ValueError("not a JSON object")
+    det = obj.get("determinism")
+    if isinstance(det, dict):
+        return det
+    if obj.get("schema") == SCHEMA:     # a raw matrix artifact
+        ref = obj["reference"]
+        return {"reference": ref,
+                "fingerprint": obj["cells"][ref].get("fingerprint"),
+                "cells_run": obj["summary"]["cells_run"],
+                "cells_diverged": obj["summary"]["cells_diverged"],
+                "gate_failures": obj["summary"].get("gate_failures", [])}
+    return None
+
+
+def render_determinism(paths: list[str]) -> str:
+    """The cross-round drift report: one row per artifact, the first
+    fingerprint CHANGE named loudly (that is the commit range where the
+    numerics moved)."""
+    lines = ["== determinism drift across rounds ==", "",
+             f"{'round':<28} {'reference cell':<24} {'fingerprint':<18} "
+             f"{'cells':>5} {'diverged':>8}"]
+    prev: tuple[str, str] | None = None     # (path, fingerprint)
+    first_change: str | None = None
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            det = determinism_block(path)
+        except (OSError, ValueError, KeyError) as e:
+            lines.append(f"{name:<28} (unreadable: {type(e).__name__})")
+            continue
+        if det is None or not det.get("fingerprint"):
+            lines.append(f"{name:<28} (no determinism block)")
+            continue
+        fp = det["fingerprint"]
+        changed = prev is not None and fp != prev[1]
+        mark = "  <-- fingerprint CHANGED" if changed else ""
+        if det.get("perturb"):      # a chaos-hook run is not evidence
+            mark += f"  [PERTURBED: {det['perturb']}]"
+        if changed and first_change is None:
+            first_change = (f"first drift: {name} (was {prev[1]} in "
+                            f"{os.path.basename(prev[0])}, now {fp})")
+        lines.append(f"{name:<28} {det.get('reference', '?'):<24} "
+                     f"{fp:<18} {det.get('cells_run', '?'):>5} "
+                     f"{det.get('cells_diverged', '?'):>8}{mark}")
+        for msg in det.get("gate_failures") or ():
+            lines.append(f"{'':<28}   gate: {msg}")
+        prev = (path, fp)
+    lines.append("")
+    lines.append(first_change if first_change
+                 else "no fingerprint drift across these rounds")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("snapshot", help="metrics snapshot JSON (registry "
-                                     "snapshot, fleet_metrics.json, or a "
-                                     "/statusz body)")
-    ap.add_argument("snapshot_b", nargs="?", default=None,
-                    help="second snapshot: report the DELTA (b - a), "
-                         "percentiles recomputed from bucket deltas")
+    ap.add_argument("snapshot", nargs="+",
+                    help="metrics snapshot JSON (registry snapshot, "
+                         "fleet_metrics.json, or a /statusz body); with "
+                         "--determinism: BENCH/matrix artifacts in "
+                         "chronological order")
+    ap.add_argument("--determinism", action="store_true",
+                    help="report reference-cell fingerprint drift across "
+                         "BENCH rounds instead of metric snapshots")
     args = ap.parse_args(argv)
-    a = load_snapshot(args.snapshot)
-    if args.snapshot_b is None:
-        print(render(a, args.snapshot))
+    if args.determinism:
+        print(render_determinism(args.snapshot))
         return 0
-    b = load_snapshot(args.snapshot_b)
+    if len(args.snapshot) > 2:
+        ap.error("snapshot mode takes one file (render) or two (delta)")
+    a = load_snapshot(args.snapshot[0])
+    if len(args.snapshot) == 1:
+        print(render(a, args.snapshot[0]))
+        return 0
+    b = load_snapshot(args.snapshot[1])
     print(render(diff_snapshots(a, b),
-                 f"{args.snapshot_b} - {args.snapshot}"))
+                 f"{args.snapshot[1]} - {args.snapshot[0]}"))
     return 0
 
 
