@@ -333,6 +333,47 @@ class StallWatchdog:
 
     @staticmethod
     def _probe_device() -> bool:
+        from reval_tpu.env import env_str
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        alive = os.path.join(root, "tpu_watch", "ALIVE")
+        probe_log = os.path.join(root, "tpu_watch", "probe.log")
+        mode = (env_str("REVAL_TPU_EXCLUSIVE_DEVICE") or "auto").lower()
+
+        def _fresh(path: str) -> bool:
+            try:
+                return time.time() - os.path.getmtime(path) < 1800.0
+            except OSError:
+                return False
+
+        # A watcher verdict only counts while the watcher is demonstrably
+        # RUNNING — freshness, not mere existence, of its marker files.
+        # probe.log accumulates forever and ALIVE is removed on a wedge,
+        # so a leftover stale probe.log from a long-dead watcher must not
+        # flip a process-exclusive setup into "watcher says wedged" and
+        # resurrect the false _exit(3) this logic exists to prevent.
+        alive_fresh = _fresh(alive)
+        watcher = alive_fresh or _fresh(probe_log)
+        if mode in ("1", "true", "on") or (mode not in ("0", "false", "off")
+                                           and not watcher):
+            # Process-exclusive device ownership (plain TPU VM libtpu
+            # lock, unlike the tunneled setup): a second jax-initializing
+            # process fails against a HEALTHY chip, so a subprocess probe
+            # would read any long zero-stat-progress window (a first
+            # compile, say) as a dead device and falsely _exit(3)
+            # (ADVICE r5).  No out-of-process health signal exists here;
+            # report healthy and leave wedge-abort to the runbook timeout.
+            return True
+        if watcher:
+            # Tunneled setup with tools/tpu_watch.sh running: its loop
+            # touches tpu_watch/ALIVE on every good probe and removes it
+            # when the tunnel wedges — that heartbeat IS the tunnel
+            # health endpoint, no second jax process needed.  A fresh
+            # probe.log with ALIVE gone/stale is the live watcher's
+            # wedged verdict.
+            return alive_fresh
+        # explicit tunneled/shared mode with no live watcher: the
+        # tunneled runtime tolerates a second client — subprocess probe
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
@@ -512,7 +553,7 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
               max_slots=32, max_seq_len=2048, num_pages=None, kv_dtype="",
-              progress_path=None):
+              progress_path=None, metric=""):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
@@ -570,6 +611,20 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
                     note("stall watchdog: no progress for "
                          f"{wd.stall_s:.0f}s and {wd.probe_fails} device "
                          "probes failed — tunnel wedged, exiting")
+                    # os._exit skips finally/atexit: emit the structured
+                    # fail() artifact FIRST, so a tripped watchdog still
+                    # records a stale/failed JSON on stdout instead of
+                    # leaving only the .partial.json sidecar (ADVICE r5)
+                    try:
+                        fail(metric or "DREval coverage probes/sec/chip",
+                             "stall-watchdog-tripped",
+                             f"no engine-stat progress for "
+                             f">={wd.stall_s:.0f}s and {wd.probe_fails} "
+                             f"consecutive device probes failed during "
+                             f"the {phase['name']} phase")
+                        sys.stdout.flush()
+                    except Exception:
+                        pass
                     os._exit(3)
 
         thr = threading.Thread(target=_sample, daemon=True)
@@ -638,8 +693,12 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
             if cold_prefill_tokens else 0.0,
             **eng.prefix_cache.counters(),
         }
+    # compile-variant counts per jit entry point (analysis/jitcheck.py):
+    # the bench "jit" block, and the per-path baseline PERF.md pins —
+    # cache_misses > 0 means a post-warmup recompile happened in-run
+    jit_row = eng.jit_counters()
     eng.close()
-    return wall, stats, prefix_cache
+    return wall, stats, prefix_cache, jit_row
 
 
 def run_serial(params, cfg, tok, prompts, max_new, *, max_seq_len=4096):
@@ -859,12 +918,12 @@ def main() -> None:
         progress = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "tpu_watch", "bench_inflight.json")
         os.makedirs(os.path.dirname(progress), exist_ok=True)
-        wall, stats, cache_row = run_paged(
+        wall, stats, cache_row, jit_row = run_paged(
             params, cfg, tok, prompts, max_new,
             prefix_sharing=not args.no_prefix_cache, max_slots=args.slots,
             max_seq_len=args.max_seq_len,
             num_pages=num_pages, kv_dtype=args.kv_dtype,
-            progress_path=progress)
+            progress_path=progress, metric=metric)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -921,6 +980,11 @@ def main() -> None:
             # TTFT/TPOT/e2e/queue-wait p50/p95/p99 — the SLO lens the
             # serving studies use (empty under --no-obs)
             "latency": stats.latency_summary(),
+            # compile-variant counts per tracked jit entry (warmup pass
+            # included — compiles land there by design); cache_misses > 0
+            # means a POST-warmup recompile fired mid-run, the silent
+            # perf cliff the jitcheck sanitizer pins (PERF.md PR-9)
+            "jit": jit_row,
         }
         if args.no_obs:
             extras["obs_disabled"] = True
@@ -956,13 +1020,13 @@ def main() -> None:
             note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); '
                  'prefix-cache-off A/B')
             try:
-                wall_nopre, _, _ = run_paged(params, cfg, tok, prompts,
-                                             max_new,
-                                             prefix_sharing=False,
-                                             max_slots=args.slots,
-                                             max_seq_len=args.max_seq_len,
-                                             num_pages=num_pages,
-                                             kv_dtype=args.kv_dtype)
+                wall_nopre, _, _, _ = run_paged(params, cfg, tok, prompts,
+                                                max_new,
+                                                prefix_sharing=False,
+                                                max_slots=args.slots,
+                                                max_seq_len=args.max_seq_len,
+                                                num_pages=num_pages,
+                                                kv_dtype=args.kv_dtype)
                 # legacy key (sharing and the cache are one mechanism now)
                 extras["prefix_sharing_speedup"] = round(wall_nopre / wall, 3)
                 # the --no-prefix-cache A/B row: what this exact run would

@@ -12,6 +12,16 @@ cannot see (acquisition ORDER, cross-thread writes at test time):
   ``# guarded-by:`` annotations;
 - :mod:`.hotpath`     — no blocking/allocating calls in ``# hot-path``
   functions;
+- :mod:`.jitreg`      — every ``jax.jit``/``shard_map`` constructor in
+  the compiled core declares its ``# jit-entry:`` contract (static
+  args, pow2-bucketed axes, warmup budget); no traced-value Python
+  branching in annotated bodies;
+- :mod:`.hostsync`    — no implicit device→host syncs in ``# hot-path``
+  regions or jit-entry bodies (deliberate fetches carry
+  ``# host-sync: <why>``);
+- :mod:`.tilecontract` — every ``pallas_call`` in ``ops/`` declares a
+  ``# tile: (sublane, lane)`` contract; resolvable BlockSpec/VMEM dims
+  are lane/sublane-aligned;
 - :mod:`.errboundary` — the serving layer raises only the
   ``serving/errors.py`` taxonomy;
 - :mod:`.envreg`      — every ``REVAL_TPU_*`` read goes through the
@@ -20,15 +30,34 @@ cannot see (acquisition ORDER, cross-thread writes at test time):
 - :mod:`.metrics_events` — the METRICS/EVENTS namespace checks that
   previously lived in ``tools/check_metrics.py``, migrated into the
   same pass framework (one driver, one report format);
-- :mod:`.lockcheck`   — the runtime sanitizer (``REVAL_TPU_LOCKCHECK=1``).
+- :mod:`.lockcheck`   — the runtime lock sanitizer
+  (``REVAL_TPU_LOCKCHECK=1``);
+- :mod:`.jitcheck`    — the runtime recompile sanitizer + always-on
+  compile-variant tracker (``REVAL_TPU_JITCHECK=1``).
 
 Run everything with ``python tools/reval_lint.py`` or
 ``python -m reval_tpu lint``; the framework lives in :mod:`.core` and
 the driver in :mod:`.driver`.
 """
 
-from .core import Annotations, SourceFile, Suppression, Violation, collect_sources
-from .driver import PASSES, run_lint
+# The production engines import the runtime half of this package
+# (``analysis.jitcheck`` wraps their jit entry points), so the package
+# __init__ must NOT eagerly pull in the lint framework — PEP 562 lazy
+# attribute access keeps ``import reval_tpu.analysis.jitcheck`` free of
+# the nine pass modules and the argparse/ast driver machinery.
+_EXPORTS = {
+    "Annotations": "core", "SourceFile": "core", "Suppression": "core",
+    "Violation": "core", "collect_sources": "core",
+    "PASSES": "driver", "run_lint": "driver",
+}
 
-__all__ = ["Annotations", "SourceFile", "Suppression", "Violation",
-           "collect_sources", "PASSES", "run_lint"]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+
+    return getattr(import_module(f".{mod}", __name__), name)

@@ -25,7 +25,7 @@ import tokenize
 from dataclasses import dataclass, field
 
 __all__ = ["Violation", "Suppression", "SourceFile", "Annotations",
-           "collect_sources", "GuardSpec"]
+           "collect_sources", "GuardSpec", "call_chain"]
 
 #: the suppression marker: allow(<passes>) followed by a mandatory reason
 #: (the regexes below are written so their OWN doc comments cannot be
@@ -305,3 +305,18 @@ def collect_sources(root: str,
             if problems is not None:
                 problems.append((rel, f"cannot parse: {exc}"))
     return out
+
+
+def call_chain(func) -> list:
+    """Dotted call chain, outermost first: ``a.b.c(...)`` -> [a, b, c];
+    non-name links truncate the front.  Shared by every AST pass that
+    pattern-matches call sites (hotpath/hostsync/jit/tilecontract)."""
+    import ast
+
+    parts: list = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+    return list(reversed(parts))

@@ -19,7 +19,8 @@ import os
 import time
 from dataclasses import dataclass, field
 
-from . import detmatrix, envreg, errboundary, hotpath, locks
+from . import (detmatrix, envreg, errboundary, hostsync, hotpath, jitreg,
+               locks, tilecontract)
 from .core import Suppression, Violation, collect_sources
 from .metrics_events import run_events, run_metrics
 
@@ -29,6 +30,9 @@ __all__ = ["PASSES", "LintReport", "run_lint", "main"]
 PASSES = {
     "locks": locks.run,
     "hotpath": hotpath.run,
+    "jit": jitreg.run,
+    "hostsync": hostsync.run,
+    "tilecontract": tilecontract.run,
     "errors": errboundary.run,
     "env": envreg.run,
     "metrics": run_metrics,
@@ -126,8 +130,10 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="reval_tpu lint",
         description="Codebase-native static analysis: lock discipline, "
-                    "hot-path purity, typed-error boundary, env registry, "
-                    "metric/event namespaces, determinism-matrix schema")
+                    "hot-path purity, jit-entry registry, host-sync "
+                    "discipline, Pallas tile contracts, typed-error "
+                    "boundary, env registry, metric/event namespaces, "
+                    "determinism-matrix schema")
     parser.add_argument("passes", nargs="*", metavar="PASS",
                         help=f"passes to run (default: all of "
                              f"{', '.join(PASSES)})")

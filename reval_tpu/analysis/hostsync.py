@@ -1,0 +1,153 @@
+"""Host-sync discipline pass (``hostsync``).
+
+The paged engine's throughput design is "one dispatch, one fetch per
+chunk": every OTHER device→host transfer inside a drive tick is a
+hidden synchronization point that stalls the dispatch pipeline for a
+full tunnel RTT (~100 ms on the tunneled v5e — PERF.md round 5 measured
+the per-chunk host cost dominating decode).  The same APIs inside a
+JITTED body are worse: forcing a tracer concrete either crashes at
+trace time or constant-folds a device value into the compiled program.
+
+Scope (lexical, nested defs included):
+
+- functions marked ``# hot-path`` — the host half of the drive loop;
+- jit-entry bodies — the ``def`` a ``# jit-entry:`` annotation compiles
+  (the decorated function, or the same-file target a ``jax.jit(f)`` /
+  ``partial(f, ...)`` / ``shard_map(f)`` names).
+
+Banned calls:
+
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` on anything —
+  each is a synchronous device→host round trip;
+- ``jax.device_get`` and ``np.asarray`` / ``np.array`` /
+  ``np.ascontiguousarray`` — the explicit transfer spellings; legal at
+  the few deliberate fetch points, which must say so (below);
+- inside jit bodies only: bare ``float()`` / ``int()`` / ``bool()``
+  applied to a traced parameter — Python-level concretization of a
+  tracer (static and partial-bound parameters are exempt: those are
+  Python values at trace time).
+
+Suppression: the deliberate sites carry an inline
+``# host-sync: <why>`` (same line or the comment block above).  The
+reason is mandatory — a bare marker is itself a violation — mirroring
+the driver's ``# lint: allow`` policy but keeping the hot-path fetch
+points self-documenting at the call site.  The runtime twin is the
+jitcheck sanitizer's ``jax.transfer_guard`` over the drive tick
+(``REVAL_TPU_JITCHECK=1``): what this pass cannot see lexically (a
+transfer reached through a helper) trips the guard at test time.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import SourceFile, Violation
+from .core import call_chain as _call_chain
+from . import jitreg
+
+PASS = "hostsync"
+
+_HOSTSYNC_RE = re.compile(r"#\s*host-sync\s*(?:[:—])\s*(\S.*)?$")
+
+#: attribute tails that are a device→host sync on any receiver
+_SYNC_TAILS = {"item", "tolist", "block_until_ready"}
+
+#: (module root, tail) explicit-transfer spellings
+_TRANSFER_CALLS = {("jax", "device_get"), ("np", "asarray"), ("np", "array"),
+                   ("np", "ascontiguousarray"), ("numpy", "asarray"),
+                   ("numpy", "array"), ("numpy", "ascontiguousarray")}
+
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+
+def _suppressed(src: SourceFile, line: int,
+                out: list[Violation]) -> bool:
+    """True when a reasoned ``# host-sync:`` covers ``line``; a marker
+    WITHOUT a reason reports and still suppresses nothing."""
+    for ln, comment in src.comment_block(line):
+        m = _HOSTSYNC_RE.search(comment)
+        if m:
+            if not (m.group(1) or "").strip():
+                out.append(Violation(
+                    PASS, src.rel, ln,
+                    "host-sync suppression without a reason — say WHY "
+                    "this transfer is deliberate"))
+                return False
+            return True
+    return False
+
+
+def _check_body(src: SourceFile, fn, label: str, traced: set,
+                out: list[Violation]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _call_chain(node.func)
+        if not chain:
+            continue
+        denied = None
+        if chain[-1] in _SYNC_TAILS and len(chain) > 1:
+            denied = ".".join(chain)
+        elif len(chain) >= 2 and (chain[0], chain[-1]) in _TRANSFER_CALLS:
+            denied = ".".join(chain)
+        elif (traced and len(chain) == 1 and chain[0] in _CONCRETIZERS
+              and node.args):
+            hit = sorted({n.id for n in ast.walk(node.args[0])
+                          if isinstance(n, ast.Name) and n.id in traced})
+            if hit:
+                denied = (f"{chain[0]}() on traced parameter(s) "
+                          f"{', '.join(hit)}")
+        if denied is None:
+            continue
+        if _suppressed(src, node.lineno, out):
+            continue
+        out.append(Violation(
+            PASS, src.rel, node.lineno,
+            f"{label} performs an implicit device->host sync via "
+            f"{denied} — move it off the hot path or mark the "
+            f"deliberate fetch with '# host-sync: <why>'"))
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, src in sorted(sources.items()):
+        if not rel.replace("\\", "/").startswith("reval_tpu"):
+            continue
+        ann = src.annotations()
+        checked: set[int] = set()
+
+        # hot-path host functions: the explicit-transfer APIs are the
+        # hazard; Python float()/int() on host numpy values are fine
+        if ann.hot:
+            def walk(body, qual):
+                for node in body:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fq = f"{qual}.{node.name}" if qual else node.name
+                        if fq in ann.hot and id(node) not in checked:
+                            checked.add(id(node))
+                            _check_body(src, node,
+                                        f"hot-path function {fq!r}",
+                                        set(), out)
+                        else:
+                            walk(node.body, fq)
+                    elif isinstance(node, ast.ClassDef):
+                        walk(node.body, node.name)
+
+            walk(src.tree.body, "")
+
+        # jit-entry bodies: also ban Python concretization of tracers
+        if jitreg.in_scope(rel):
+            for entry in jitreg.collect_entries(src, None):
+                fn = entry.target
+                if fn is None or id(fn) in checked:
+                    continue
+                checked.add(id(fn))
+                named, structural = jitreg._param_names(fn)
+                traced = (named - set(entry.static or ())
+                          - entry.bound - structural)
+                _check_body(src, fn, f"jit entry {entry.name!r} body",
+                            traced, out)
+    return out

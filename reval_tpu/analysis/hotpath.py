@@ -23,6 +23,7 @@ from __future__ import annotations
 import ast
 
 from .core import SourceFile, Violation
+from .core import call_chain as _call_chain
 
 PASS = "hotpath"
 
@@ -38,17 +39,6 @@ _DENY_TAILS = {"sleep", "render_prometheus", "snapshot", "strftime",
 _DENY_MODULES = {"json", "pickle", "subprocess", "urllib", "requests",
                  "socket", "logging", "shutil"}
 
-
-def _call_chain(func: ast.expr) -> list[str]:
-    """Dotted call chain, outermost first: ``a.b.c(...)`` -> [a, b, c];
-    non-name links truncate the front."""
-    parts: list[str] = []
-    while isinstance(func, ast.Attribute):
-        parts.append(func.attr)
-        func = func.value
-    if isinstance(func, ast.Name):
-        parts.append(func.id)
-    return list(reversed(parts))
 
 
 def _denied(chain: list[str]) -> str | None:

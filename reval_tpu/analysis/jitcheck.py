@@ -1,0 +1,362 @@
+"""Runtime recompile sanitizer (``REVAL_TPU_JITCHECK=1``) + the always-on
+compile-variant tracker behind ``reval_jit_*``.
+
+The static ``jit`` pass proves the DECLARED compile contracts (static
+args, bucketed axes, warmup budgets); what it cannot see is dynamic:
+whether the decode loop actually stays inside its budget once real
+shapes flow.  A silent recompile storm is the classic paged-engine perf
+cliff — every new (steps, span, batch) combination retraces, the tick
+stalls for seconds, and throughput craters with nothing in the logs.
+Two layers close the gap (mirroring ``lockcheck``):
+
+- :class:`TrackedJit` — ALWAYS ON, a thin wrapper the engines put
+  around their jit entry points.  Per call it derives a shape-key
+  signature (leaf shapes/dtypes + hashable statics via one
+  ``tree_flatten``, ~µs at chunk cadence — never per token) and counts
+  distinct variants:
+
+  * every NEW signature bumps ``reval_jit_compiles_total``;
+  * a new signature PAST the entry's declared ``warmup`` budget bumps
+    ``reval_jit_cache_misses_total`` and emits a ``jit.recompile`` log
+    event — so a post-warmup recompile is visible in ``/metrics``,
+    bench JSON, and (via the log ring every postmortem bundle carries)
+    the flight recorder, in production too.
+
+- :class:`JitSanitizer` — test-time (``REVAL_TPU_JITCHECK=1`` via
+  conftest, or ``install()`` directly).  While installed, every
+  post-warmup variant is also recorded as a violation, and
+  :func:`drive_guard` arms a device→host transfer guard over the paged
+  engine's drive tick, so an implicit sync the ``hostsync`` pass could
+  not see lexically (reached through a helper) raises loudly inside
+  the tick that performed it.  The guard is two-layered because the
+  CPU test backend's device→host "transfers" are zero-copy and
+  invisible to jax's own guard machinery:
+
+  * ``jax.transfer_guard_device_to_host("disallow")`` — the real
+    backend guard; bites on an actual TPU.  Device→host ONLY: the tick
+    legitimately feeds fresh host tokens INTO jitted entries every
+    chunk, so a full ``transfer_guard("disallow")`` would outlaw the
+    engine's own design.
+  * a process-wide patch of the concrete ``jax.Array``'s
+    ``item``/``tolist``/``__array__`` — the lockcheck approach (patch
+    the primitive, observe every caller); trips on any backend, but
+    only lexically INSIDE a guarded tick (thread-local depth), so
+    tests and cold paths fetch freely.  (On CPU, numpy reads jax
+    arrays zero-copy through the buffer protocol without calling
+    ``__array__`` — ``np.asarray`` leaks are a TPU-guard catch; the
+    patch's CPU bite surface is ``.item()``/``.tolist()``.)
+
+  The one deliberate fetch per chunk is marked at the call site with
+  :func:`deliberate_fetch` — the runtime twin of the static pass's
+  ``# host-sync: <why>`` annotation.  Violations accumulate (a
+  sanitizer must not change program behavior) and the conftest wiring
+  fails the pytest session if any exist; a tripped guard ALSO raises,
+  because silently continuing past an unplanned sync would time the
+  wrong thing.
+
+A new variant's signature is counted, not hashed away: ``variants``
+per entry ride :meth:`PagedTPUEngine.jit_counters` into the bench
+``jit`` block, which is what PERF.md's per-path compile-count baseline
+pins.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager, nullcontext
+
+from ..obs.logging import log_event
+from ..obs.metrics import JIT_CACHE_MISSES, JIT_COMPILES
+
+__all__ = ["TrackedJit", "tracked_jit", "JitSanitizer", "install",
+           "uninstall", "current", "scoped", "drive_guard",
+           "deliberate_fetch"]
+
+
+class JitSanitizer:
+    """Violation ledger for post-warmup recompiles and in-tick syncs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock (writes)
+        # (conftest reads the ledger once, after the session drained)
+        self.violations: list[dict] = []
+
+    def record(self, entry: str, variants: int, warmup: int,
+               signature) -> None:
+        with self._lock:
+            self.violations.append({
+                "kind": "post-warmup-recompile",
+                "entry": entry,
+                "detail": f"jit entry {entry!r} compiled variant "
+                          f"#{variants} past its warmup budget of "
+                          f"{warmup} — signature {str(signature)[:300]}"})
+
+    def record_transfer(self, detail: str) -> None:
+        with self._lock:
+            self.violations.append({
+                "kind": "implicit-device-host-transfer",
+                "entry": "<drive-tick>",
+                "detail": detail})
+
+
+_current: JitSanitizer | None = None
+
+#: per-thread (guard depth, deliberate-fetch depth) for the d2h patch
+_tls = threading.local()
+
+#: (cls, attr, original) triples the d2h patch replaced
+_PATCHED: list = []
+
+
+def _guard_depth() -> int:
+    return getattr(_tls, "guard_depth", 0)
+
+
+def _fetch_depth() -> int:
+    return getattr(_tls, "fetch_depth", 0)
+
+
+def _d2h_wrapper(orig, label: str):
+    def wrapper(self, *args, **kwargs):
+        if _guard_depth() > 0 and _fetch_depth() == 0:
+            detail = (f"implicit device->host transfer via "
+                      f"Array.{label}() inside a guarded drive tick — "
+                      f"mark a deliberate fetch with "
+                      f"jitcheck.deliberate_fetch() and a "
+                      f"'# host-sync: <why>' annotation")
+            san = _current
+            if san is not None:
+                san.record_transfer(detail)
+            raise RuntimeError(f"jitcheck: {detail}")
+        return orig(self, *args, **kwargs)
+
+    wrapper.__name__ = getattr(orig, "__name__", label)
+    return wrapper
+
+
+def _patch_d2h() -> None:
+    """Patch the concrete jax.Array's device→host entry points (CPU
+    d2h is zero-copy, so jax's own transfer guard never fires on the
+    test backend — the patch keeps the sanitizer's bite
+    backend-independent)."""
+    if _PATCHED:
+        return
+    try:
+        from jax._src.array import ArrayImpl
+    except Exception:        # pragma: no cover — jax internals moved
+        return
+    for name in ("item", "tolist", "__array__"):
+        orig = getattr(ArrayImpl, name, None)
+        if orig is None:     # pragma: no cover — jax internals moved
+            continue
+        setattr(ArrayImpl, name, _d2h_wrapper(orig, name))
+        _PATCHED.append((ArrayImpl, name, orig))
+
+
+def _unpatch_d2h() -> None:
+    while _PATCHED:
+        cls, name, orig = _PATCHED.pop()
+        setattr(cls, name, orig)
+
+
+def install() -> JitSanitizer:
+    """Activate the sanitizer (idempotent per process): post-warmup
+    variants become violations, :func:`drive_guard` arms the transfer
+    guards, and the d2h call surface is patched."""
+    global _current
+    if _current is None:
+        _current = JitSanitizer()
+        _patch_d2h()
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+    _unpatch_d2h()
+
+
+def current() -> JitSanitizer | None:
+    return _current
+
+
+@contextmanager
+def scoped(active: bool = True):
+    """Temporarily swap the process-global sanitizer: a FRESH ledger
+    when ``active`` (or none at all when not), restoring whatever was
+    installed before on exit.  This is how test_jitcheck exercises the
+    sanitizer without polluting a session-level install — under
+    ``REVAL_TPU_JITCHECK=1`` the conftest ledger must neither receive a
+    test's deliberately-seeded violations nor be uninstalled mid-session
+    by a fixture teardown."""
+    global _current
+    prev = _current
+    _current = JitSanitizer() if active else None
+    if active:
+        _patch_d2h()
+    else:
+        _unpatch_d2h()
+    try:
+        yield _current
+    finally:
+        _current = prev
+        if prev is not None:
+            _patch_d2h()
+        else:
+            _unpatch_d2h()
+
+
+class _DriveGuard:
+    """Device→host guard over one drive tick (see module docstring)."""
+
+    def __enter__(self):
+        _tls.guard_depth = _guard_depth() + 1
+        import jax
+
+        self._tg = jax.transfer_guard_device_to_host("disallow")
+        self._tg.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        out = self._tg.__exit__(*exc)
+        _tls.guard_depth = _guard_depth() - 1
+        return out
+
+
+class _FetchAllow:
+    """The ONE deliberate fetch inside a guarded tick."""
+
+    def __enter__(self):
+        _tls.fetch_depth = _fetch_depth() + 1
+        import jax
+
+        self._tg = jax.transfer_guard_device_to_host("allow")
+        self._tg.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        out = self._tg.__exit__(*exc)
+        _tls.fetch_depth = _fetch_depth() - 1
+        return out
+
+
+def drive_guard():
+    """Arm the device→host guards while the sanitizer is installed,
+    else a free nullcontext — the paged engine wraps each drive tick in
+    this, so the threaded test modules (session-driven drives included)
+    run the whole loop under the guard with no per-module wiring."""
+    if _current is None:
+        return nullcontext()
+    return _DriveGuard()
+
+
+def deliberate_fetch():
+    """Mark an INTENDED device→host fetch inside a guarded tick — the
+    runtime twin of the static ``# host-sync: <why>`` annotation (both
+    belong at the same call site).  Free nullcontext when the sanitizer
+    is off."""
+    if _current is None:
+        return nullcontext()
+    return _FetchAllow()
+
+
+def _signature(args: tuple, kwargs: dict):
+    """Hashable shape-key of one call: array leaves become
+    (shape, dtype); other hashable leaves ride as values; the treedef
+    captures structure (None vs array operands retrace by contract)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and hasattr(leaf, "dtype"):
+            sig.append((tuple(shape), str(leaf.dtype)))
+            continue
+        try:
+            hash(leaf)
+            sig.append(leaf)
+        except TypeError:
+            sig.append(str(type(leaf)))
+    return treedef, tuple(sig)
+
+
+class TrackedJit:
+    """Compile-variant counter around one jitted callable (see module
+    docstring).  Jit attributes (``lower``, ``clear_cache``, ...)
+    delegate to the wrapped function."""
+
+    __slots__ = ("_fn", "name", "warmup", "_sigs", "_misses", "_registry",
+                 "_san", "_lock")
+
+    def __init__(self, name: str, fn, registry=None,
+                 warmup: int | None = None, sanitizer=None):
+        self._fn = fn
+        self.name = name
+        self.warmup = warmup
+        # guarded-by: _lock (writes)
+        # (the pre-lock membership read is a benign double-checked
+        # fast path: a miss re-checks under the lock before adding)
+        self._sigs: set = set()
+        # guarded-by: _lock (writes)
+        self._misses = 0
+        # registry may be the MetricsRegistry itself or a zero-arg
+        # callable returning it — engines hand a callable because their
+        # stats (and with them the registry) are replaced wholesale by
+        # bench A/B phases; a captured registry would go stale and the
+        # reval_jit_* counters would silently stop moving
+        self._registry = registry
+        self._san = sanitizer
+        self._lock = threading.Lock()
+
+    @property
+    def variants(self) -> int:
+        return len(self._sigs)
+
+    @property
+    def misses(self) -> int:
+        """Post-warmup recompiles this entry observed (reset-proof:
+        survives an ``EngineStats`` swap, unlike the registry counter)."""
+        return self._misses
+
+    def __call__(self, *args, **kwargs):
+        key = _signature(args, kwargs)
+        if key not in self._sigs:
+            is_new = miss = False
+            with self._lock:
+                if key not in self._sigs:
+                    self._sigs.add(key)
+                    is_new = True
+                    n = len(self._sigs)
+                    if self.warmup is not None and n > self.warmup:
+                        self._misses += 1
+                        miss = True
+            if is_new:
+                reg = self._registry
+                if callable(reg):
+                    reg = reg()
+                if reg is not None:
+                    reg.counter(JIT_COMPILES).add(1)
+                if miss:
+                    if reg is not None:
+                        reg.counter(JIT_CACHE_MISSES).add(1)
+                    log_event("jit.recompile", level="warning",
+                              entry=self.name, variants=n,
+                              warmup=self.warmup)
+                    san = self._san if self._san is not None else _current
+                    if san is not None:
+                        san.record(self.name, n, self.warmup, key)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def tracked_jit(name: str, fn, registry=None, warmup: int | None = None,
+                sanitizer=None) -> TrackedJit:
+    """Wrap one jit entry point.  ``name``/``warmup`` must mirror the
+    site's ``# jit-entry:`` annotation — the static ``jit`` pass
+    cross-checks the literals."""
+    return TrackedJit(name, fn, registry=registry, warmup=warmup,
+                      sanitizer=sanitizer)

@@ -1,0 +1,424 @@
+"""Jit-entry registry pass (``jit``).
+
+Every ``jax.jit`` / ``jax.shard_map`` constructor in the compiled core
+(``models/``, ``ops/``, ``inference/tpu/``, ``parallel/``) is a COMPILE
+BOUNDARY: its static arguments and input-shape buckets decide how many
+programs XLA builds and when the decode loop silently recompiles.  Those
+contracts lived only in prose (PERF.md's "bounded compile variants"
+folklore); this pass makes them annotations:
+
+    # jit-entry: paged.decode_chunk static=(steps, filtered) bucketed=(span) warmup=64
+
+on the statement that constructs the jit (or on the decorator of a
+jitted ``def``) — ONE line, the parser does not follow backslash
+continuations.  The grammar:
+
+- ``<shape-key>`` (mandatory) — a dotted slug, unique across the tree;
+  the runtime recompile sanitizer (:mod:`.jitcheck`) and the
+  ``reval_jit_*`` metrics report per-entry variant counts under this
+  name.
+- ``static=(a, b)`` — the argument names traced as Python values.  Must
+  round-trip EXACTLY with the call's ``static_argnames`` literal: the
+  annotation cannot promise fewer (an undeclared static is an implicit
+  recompile axis) or more (a ghost static is stale documentation).
+- ``bucketed=(c, d)`` — the shape axes the host quantises to powers of
+  two before dispatch (``pow2_bucket``); prose-checked documentation of
+  WHY the variant count is bounded.
+- ``warmup=N`` — the entry's compile-variant budget: the runtime
+  sanitizer flags the N+1-th distinct lowering as a post-warmup
+  recompile.  Must match the ``tracked_jit(..., warmup=N)`` literal when
+  the entry is runtime-tracked.
+
+Rules enforced:
+
+1. every ``jax.jit`` / ``shard_map`` / ``partial(jax.jit, ...)``
+   constructor in scope carries a ``# jit-entry:`` annotation;
+2. shape-keys are unique (one entry, one name — the metrics/sanitizer
+   would silently merge two entries otherwise);
+3. ``static=`` ↔ ``static_argnames`` round-trips both directions, and
+   ``static_argnames`` must be a literal (a computed value defeats the
+   registry); ``static_argnums`` is banned outright — positional static
+   indices go stale silently when a signature gains a parameter;
+4. annotated bodies (the jitted ``def`` itself, or a same-file function
+   the jit/``partial`` names) contain no data-dependent Python ``if`` /
+   ``while`` on a traced parameter — branching on a tracer either
+   crashes at trace time or, worse, bakes one branch into the compiled
+   program and silently recompiles per value.  ``x is (not) None``
+   structural tests and static/partial-bound parameters are exempt;
+5. ``warmup=`` ↔ the ``tracked_jit`` wrapper's name/warmup literals.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .core import SourceFile, Violation
+from .core import call_chain as _call_chain
+
+PASS = "jit"
+
+#: directories whose jit constructors must be declared
+SCOPE_PREFIXES = ("reval_tpu/models/", "reval_tpu/ops/",
+                  "reval_tpu/inference/tpu/", "reval_tpu/parallel/")
+
+_ENTRY_RE = re.compile(r"#\s*jit-entry:\s*(\S+)(.*)$")
+_PART_RE = re.compile(r"(static|bucketed)=\(([^)]*)\)|warmup=(\d+)")
+
+
+@dataclass
+class JitEntry:
+    """One parsed ``# jit-entry:`` annotation bound to its constructor."""
+
+    name: str
+    line: int                      # annotation line
+    call_line: int                 # the jit/shard_map constructor line
+    static: tuple | None = None
+    bucketed: tuple | None = None
+    warmup: int | None = None
+    #: same-file FunctionDef the entry compiles, when resolvable
+    target: ast.FunctionDef | None = None
+    #: kwargs bound by a ``partial`` (Python constants at trace time)
+    bound: set = field(default_factory=set)
+
+
+def _names(csv: str) -> tuple:
+    return tuple(n.strip() for n in csv.split(",") if n.strip())
+
+
+def parse_entry(comment: str, line: int) -> tuple[JitEntry | None, str | None]:
+    """(entry, error) from one comment line; (None, None) when the line
+    carries no jit-entry marker at all."""
+    m = _ENTRY_RE.search(comment)
+    if not m:
+        return None, None
+    name, tail = m.group(1), m.group(2)
+    entry = JitEntry(name=name, line=line, call_line=line)
+    for pm in _PART_RE.finditer(tail):
+        if pm.group(1) == "static":
+            entry.static = _names(pm.group(2))
+        elif pm.group(1) == "bucketed":
+            entry.bucketed = _names(pm.group(2))
+        else:
+            entry.warmup = int(pm.group(3))
+    leftover = _PART_RE.sub("", tail).strip()
+    if leftover:
+        return None, (f"jit-entry annotation has unparseable tail "
+                      f"{leftover!r} (grammar: static=(..) bucketed=(..) "
+                      f"warmup=N)")
+    if not re.fullmatch(r"[A-Za-z_][\w.-]*", name):
+        return None, f"jit-entry shape-key {name!r} is not a dotted slug"
+    return entry, None
+
+
+
+def _is_jax_jit_ref(expr: ast.expr) -> bool:
+    """``jax.jit`` (or bare ``jit``) used as a VALUE (partial's arg)."""
+    chain = _call_chain(expr)
+    return chain in (["jax", "jit"], ["jit"])
+
+
+def _jit_ctor_kind(call: ast.Call) -> str | None:
+    """"jit" | "shard_map" | "partial_jit" when ``call`` constructs a
+    compile boundary; None otherwise."""
+    chain = _call_chain(call.func)
+    if not chain:
+        return None
+    if chain in (["jax", "jit"], ["jit"]):
+        return "jit"
+    if chain[-1].endswith("shard_map"):
+        return "shard_map"
+    if chain[-1] == "partial" and call.args and _is_jax_jit_ref(call.args[0]):
+        return "partial_jit"
+    return None
+
+
+def _literal_str_tuple(node: ast.expr) -> tuple | None:
+    """A literal str or tuple/list-of-str, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _static_argnames(call: ast.Call) -> tuple[tuple | None, bool, bool]:
+    """(names, present, literal) for the call's ``static_argnames``."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _literal_str_tuple(kw.value)
+            return names, True, names is not None
+    return None, False, True
+
+
+def _has_static_argnums(call: ast.Call) -> bool:
+    return any(kw.arg == "static_argnums" for kw in call.keywords)
+
+
+def _target_ref(call: ast.Call, kind: str
+                ) -> tuple[str | None, set]:
+    """(function name the ctor compiles, partial-bound kwarg names).
+
+    ``jax.jit(f)`` / ``jax.jit(partial(f, cfg=cfg))`` / ``shard_map(f)``
+    — ``f`` as a Name or ``self.X`` attribute; lambdas and foreign
+    values return None."""
+    if kind == "partial_jit" or not call.args:
+        return None, set()
+    arg = call.args[0]
+    bound: set = set()
+    if isinstance(arg, ast.Call) and _call_chain(arg.func)[-1:] == ["partial"]:
+        bound = {kw.arg for kw in arg.keywords if kw.arg}
+        if not arg.args:
+            return None, bound
+        arg = arg.args[0]
+    if isinstance(arg, ast.Name):
+        return arg.id, bound
+    if isinstance(arg, ast.Attribute):
+        return arg.attr, bound
+    return None, bound
+
+
+def _param_names(fn: ast.FunctionDef) -> tuple[set, set]:
+    """(named params, structural varargs/kwargs names)."""
+    a = fn.args
+    named = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    structural = set()
+    if a.vararg:
+        structural.add(a.vararg.arg)
+    if a.kwarg:
+        structural.add(a.kwarg.arg)
+    return named, structural
+
+
+def _own_exprs(stmt: ast.stmt):
+    """Expression nodes belonging to ``stmt`` ITSELF — stopping at
+    nested statements (a class/function body's jit calls must anchor
+    their annotation search at their OWN assignment, not the enclosing
+    ClassDef line)."""
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if not isinstance(c, ast.stmt)]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(c for c in ast.iter_child_nodes(node)
+                     if not isinstance(c, ast.stmt))
+
+
+def _defs_by_name(tree: ast.Module) -> dict[str, list[ast.FunctionDef]]:
+    out: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _tracked_jit_literals(call: ast.Call) -> tuple[str | None, int | None]:
+    """(name, warmup) literals of an enclosing ``tracked_jit(...)``."""
+    name = None
+    if (call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        name = call.args[0].value
+    warmup = None
+    for kw in call.keywords:
+        if (kw.arg == "warmup" and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, int)):
+            warmup = kw.value.value
+    return name, warmup
+
+
+def _check_traced_branches(src: SourceFile, entry: JitEntry,
+                           out: list[Violation]) -> None:
+    fn = entry.target
+    if fn is None:
+        return
+    named, structural = _param_names(fn)
+    static = set(entry.static or ())
+    traced = named - static - entry.bound - structural
+    if not traced:
+        return
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        # `x is None` / `x is not None` tests argument STRUCTURE
+        # (retrace per structure is jit's documented contract) — exempt
+        # only the NAME OCCURRENCES inside those comparisons, never the
+        # name everywhere in the test: `if x is not None and x > 2:`
+        # must still flag the data-dependent `x > 2` clause
+        structural_occ: set[int] = set()
+        for sub in ast.walk(node.test):
+            if (isinstance(sub, ast.Compare)
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in sub.ops)):
+                for piece in [sub.left] + sub.comparators:
+                    structural_occ.update(
+                        id(n) for n in ast.walk(piece))
+        hit = sorted({n.id for n in ast.walk(node.test)
+                      if isinstance(n, ast.Name)
+                      and n.id in traced and id(n) not in structural_occ})
+        if hit:
+            out.append(Violation(
+                PASS, src.rel, node.lineno,
+                f"jit entry {entry.name!r}: Python "
+                f"{'if' if isinstance(node, ast.If) else 'while'} on "
+                f"traced parameter(s) {', '.join(hit)} — branch in jax "
+                f"(jnp.where/lax.cond) or declare the name in "
+                f"static=(...)"))
+
+
+def collect_entries(src: SourceFile, out: list[Violation] | None = None
+                    ) -> list[JitEntry]:
+    """Every jit/shard_map constructor in ``src`` with its annotation
+    (entries lacking one are reported into ``out`` and skipped)."""
+    violations = out if out is not None else []
+    defs = _defs_by_name(src.tree)
+    entries: list[JitEntry] = []
+    seen_calls: set[int] = set()
+
+    def anchor_lines(stmt: ast.stmt, call: ast.Call) -> list[int]:
+        lines = [stmt.lineno, call.lineno]
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lines.extend(d.lineno for d in stmt.decorator_list)
+        return sorted(set(lines))
+
+    def find_annotation(stmt: ast.stmt, call: ast.Call
+                        ) -> tuple[JitEntry | None, bool]:
+        for line in anchor_lines(stmt, call):
+            for ln, comment in src.comment_block(line):
+                entry, err = parse_entry(comment, ln)
+                if err:
+                    violations.append(Violation(PASS, src.rel, ln, err))
+                    return None, True
+                if entry is not None:
+                    return entry, True
+        return None, False
+
+    def visit_stmt(stmt: ast.stmt) -> None:
+        for call in _own_exprs(stmt):
+            if not isinstance(call, ast.Call) or id(call) in seen_calls:
+                continue
+            kind = _jit_ctor_kind(call)
+            if kind is None:
+                continue
+            # a partial(jax.jit, ...) decorator also exposes the inner
+            # jax.jit Name — mark the whole subtree visited once
+            for sub in ast.walk(call):
+                if isinstance(sub, ast.Call) and _jit_ctor_kind(sub):
+                    seen_calls.add(id(sub))
+            entry, had_marker = find_annotation(stmt, call)
+            if entry is None:
+                if not had_marker:
+                    violations.append(Violation(
+                        PASS, src.rel, call.lineno,
+                        f"undeclared jit entry point "
+                        f"({'.'.join(_call_chain(call.func)) or 'jit'}) — "
+                        f"annotate the statement with "
+                        f"'# jit-entry: <shape-key> ...'"))
+                continue
+            entry.call_line = call.lineno
+            _check_call_contract(src, entry, stmt, call, kind, defs,
+                                 violations)
+            entries.append(entry)
+
+    def walk_body(body: list[ast.stmt]) -> None:
+        for stmt in body:
+            visit_stmt(stmt)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    walk_body(sub)
+            for handler in getattr(stmt, "handlers", []) or []:
+                walk_body(handler.body)
+
+    walk_body(src.tree.body)
+    return entries
+
+
+def _check_call_contract(src: SourceFile, entry: JitEntry, stmt: ast.stmt,
+                         call: ast.Call, kind: str,
+                         defs: dict[str, list[ast.FunctionDef]],
+                         out: list[Violation]) -> None:
+    if _has_static_argnums(call):
+        out.append(Violation(
+            PASS, src.rel, call.lineno,
+            f"jit entry {entry.name!r} uses static_argnums — positional "
+            f"static indices silently go stale; use static_argnames"))
+    declared, present, literal = _static_argnames(call)
+    if present and not literal:
+        out.append(Violation(
+            PASS, src.rel, call.lineno,
+            f"jit entry {entry.name!r}: static_argnames is not a string "
+            f"literal/tuple — the registry cannot verify a computed "
+            f"static set"))
+    elif present and set(declared or ()) != set(entry.static or ()):
+        out.append(Violation(
+            PASS, src.rel, entry.line,
+            f"jit entry {entry.name!r}: annotation static="
+            f"{tuple(sorted(entry.static or ()))} does not match the "
+            f"call's static_argnames={tuple(sorted(declared or ()))}"))
+    elif not present and entry.static:
+        out.append(Violation(
+            PASS, src.rel, entry.line,
+            f"jit entry {entry.name!r} declares static="
+            f"{tuple(entry.static)} but the call has no static_argnames"))
+
+    # resolve the compiled body for the traced-branch check
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+            call is d or any(call is sub for sub in ast.walk(d))
+            for d in stmt.decorator_list):
+        entry.target = stmt
+    else:
+        name, bound = _target_ref(call, kind)
+        entry.bound = bound
+        if name is not None and len(defs.get(name, [])) == 1:
+            entry.target = defs[name][0]
+    _check_traced_branches(src, entry, out)
+
+    # tracked_jit(name, jax.jit(...), warmup=N) cross-check: one entry,
+    # one name, one budget — in the annotation AND the wrapper literal
+    for outer in ast.walk(stmt):
+        if (isinstance(outer, ast.Call)
+                and _call_chain(outer.func)[-1:] == ["tracked_jit"]
+                and any(call is sub for sub in ast.walk(outer))):
+            tname, twarm = _tracked_jit_literals(outer)
+            if tname is not None and tname != entry.name:
+                out.append(Violation(
+                    PASS, src.rel, outer.lineno,
+                    f"tracked_jit name {tname!r} does not match the "
+                    f"jit-entry shape-key {entry.name!r}"))
+            if twarm != entry.warmup:
+                out.append(Violation(
+                    PASS, src.rel, outer.lineno,
+                    f"jit entry {entry.name!r}: tracked_jit warmup="
+                    f"{twarm!r} does not match the annotation's warmup="
+                    f"{entry.warmup!r}"))
+            break
+
+
+def in_scope(rel: str) -> bool:
+    return rel.replace("\\", "/").startswith(SCOPE_PREFIXES)
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    by_name: dict[str, tuple[str, int]] = {}
+    for rel, src in sorted(sources.items()):
+        if not in_scope(rel):
+            continue
+        for entry in collect_entries(src, out):
+            prev = by_name.get(entry.name)
+            if prev is not None:
+                out.append(Violation(
+                    PASS, rel, entry.line,
+                    f"duplicate jit-entry shape-key {entry.name!r} "
+                    f"(also declared at {prev[0]}:{prev[1]}) — the "
+                    f"sanitizer and metrics would merge two entries"))
+            else:
+                by_name[entry.name] = (rel, entry.line)
+    return out

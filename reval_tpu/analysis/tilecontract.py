@@ -1,0 +1,189 @@
+"""Pallas tile-contract pass (``tilecontract``).
+
+TPU vector memory is tiled (sublane, lane) = (8, 128) for f32: a
+BlockSpec or VMEM scratch whose minor dim is not lane-aligned (or whose
+second-minor dim breaks sublane alignment) either fails Mosaic lowering
+with an opaque "must be aligned to tiling" error — found the hard way
+on this repo's first real-chip compile, PERF.md round 5 — or silently
+pads, burning VMEM.  The ragged paged-attention kernel (ROADMAP item 1)
+will rewrite the most shape-sensitive BlockSpecs in the tree; this pass
+pins the discipline BEFORE that rewrite so a misaligned tile is a lint
+failure, not a chip-session debugging night.
+
+Contract: every ``pl.pallas_call`` in ``ops/`` carries
+
+    # tile: (8, 128)
+
+on its statement (or the comment block above) declaring the
+(sublane, lane) tiling the kernel was shaped for.  The pass checks:
+
+1. the annotation exists — an unannotated kernel has no declared shape
+   discipline for reviewers or the ragged rewrite to inherit;
+2. the declared tile is itself legal: sublane a positive multiple of 8,
+   lane a positive multiple of 128 (the f32 native tile; bf16/int8
+   kernels still address VMEM in f32-tile multiples in this codebase —
+   head_dim rides the lane dim at 128+);
+3. every ``pl.BlockSpec`` / ``pltpu.VMEM`` shape in the enclosing
+   function whose minor (or second-minor) dim is a RESOLVABLE integer —
+   a literal, or a name bound to an integer constant at module or
+   function scope — satisfies ``minor % lane == 0`` and
+   ``second_minor % sublane == 0``.  Symbolic dims (``page_size``,
+   ``head_dim`` parameters) are runtime-shaped and stay out of lint
+   scope; the kernel parity tests cover them.
+
+Suppression: ``# lint: allow(tilecontract) — <reason>`` (driver
+policy, reason mandatory) for a deliberately sub-tile shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import SourceFile, Violation
+from .core import call_chain as _call_chain
+
+PASS = "tilecontract"
+
+SCOPE_PREFIX = "reval_tpu/ops/"
+
+_TILE_RE = re.compile(r"#\s*tile:\s*\(\s*(\d+)\s*,\s*(\d+)\s*\)")
+
+#: call tails whose first (or ``block_shape=``) tuple is a tiled shape
+_SHAPE_CALLS = {"BlockSpec", "VMEM"}
+
+
+
+def _const_env(tree: ast.Module, fn: ast.FunctionDef) -> dict[str, int]:
+    """Names bound to a single integer constant at module scope or in
+    ``fn``'s body (simple ``NAME = <int>`` assignments only)."""
+    env: dict[str, int] = {}
+    rebound: set[str] = set()
+
+    def scan(body):
+        for node in body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                    and not isinstance(node.value.value, bool)):
+                name = node.targets[0].id
+                if name in env:
+                    rebound.add(name)
+                env[name] = node.value.value
+
+    scan(tree.body)
+    scan(fn.body)
+    for name in rebound:
+        env.pop(name, None)
+    return env
+
+
+def _resolve(node: ast.expr, env: dict[str, int]) -> int | None:
+    if (isinstance(node, ast.Constant) and isinstance(node.value, int)
+            and not isinstance(node.value, bool)):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    return None
+
+
+def _shape_tuple(call: ast.Call) -> ast.Tuple | None:
+    for kw in call.keywords:
+        if kw.arg == "block_shape" and isinstance(kw.value, ast.Tuple):
+            return kw.value
+    if call.args and isinstance(call.args[0], ast.Tuple):
+        return call.args[0]
+    return None
+
+
+def _check_shapes(src: SourceFile, fn: ast.FunctionDef, env: dict[str, int],
+                  sublane: int, lane: int, out: list[Violation]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _call_chain(node.func)
+        if not chain or chain[-1] not in _SHAPE_CALLS:
+            continue
+        shape = _shape_tuple(node)
+        if shape is None or not shape.elts:
+            continue
+        minor = _resolve(shape.elts[-1], env)
+        if minor is not None and minor % lane:
+            out.append(Violation(
+                PASS, src.rel, node.lineno,
+                f"{chain[-1]} minor dim {minor} is not a multiple of "
+                f"the declared lane tile {lane}"))
+        if len(shape.elts) >= 2:
+            second = _resolve(shape.elts[-2], env)
+            if second is not None and second != 1 and second % sublane:
+                out.append(Violation(
+                    PASS, src.rel, node.lineno,
+                    f"{chain[-1]} second-minor dim {second} is not a "
+                    f"multiple of the declared sublane tile {sublane}"))
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, src in sorted(sources.items()):
+        if not rel.replace("\\", "/").startswith(SCOPE_PREFIX):
+            continue
+        seen: set[int] = set()
+
+        def enclosing_walk(body, fn):
+            for stmt in body:
+                cur = stmt if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn
+                for call in (ast.walk(stmt)
+                             if not isinstance(stmt, (ast.FunctionDef,
+                                                      ast.AsyncFunctionDef,
+                                                      ast.ClassDef))
+                             else ()):
+                    if (isinstance(call, ast.Call)
+                            and _call_chain(call.func)[-1:] == ["pallas_call"]
+                            and id(call) not in seen):
+                        seen.add(id(call))
+                        check_call(stmt, call, fn)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        enclosing_walk(sub, cur)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    enclosing_walk(handler.body, cur)
+
+        def check_call(stmt, call, fn):
+            tile = None
+            for line in sorted({stmt.lineno, call.lineno}):
+                for ln, comment in src.comment_block(line):
+                    m = _TILE_RE.search(comment)
+                    if m:
+                        tile = (int(m.group(1)), int(m.group(2)), ln)
+                        break
+                if tile:
+                    break
+            if tile is None:
+                out.append(Violation(
+                    PASS, src.rel, call.lineno,
+                    "pallas_call without a '# tile: (sublane, lane)' "
+                    "contract — declare the tiling the kernel's "
+                    "BlockSpecs were shaped for"))
+                return
+            sublane, lane, ln = tile
+            if sublane <= 0 or sublane % 8:
+                out.append(Violation(
+                    PASS, src.rel, ln,
+                    f"declared sublane tile {sublane} is not a positive "
+                    f"multiple of 8"))
+                return
+            if lane <= 0 or lane % 128:
+                out.append(Violation(
+                    PASS, src.rel, ln,
+                    f"declared lane tile {lane} is not a positive "
+                    f"multiple of 128"))
+                return
+            if fn is not None:
+                _check_shapes(src, fn, _const_env(src.tree, fn),
+                              sublane, lane, out)
+
+        enclosing_walk(src.tree.body, None)
+    return out

@@ -842,8 +842,9 @@ def main(argv: list[str] | None = None) -> int:
         return run_watch(argv[1:])
     if argv and argv[0] == "lint":
         # the codebase-native static analysis suite (analysis/):
-        # lock discipline, hot-path purity, typed-error boundary,
-        # env registry, metric/event namespaces
+        # lock discipline, hot-path purity, jit-entry registry,
+        # host-sync discipline, Pallas tile contracts, typed-error
+        # boundary, env registry, metric/event namespaces
         from .analysis.driver import main as lint_main
 
         return lint_main(argv[1:])

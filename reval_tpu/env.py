@@ -163,6 +163,23 @@ ENV: dict[str, dict] = {
     "REVAL_TPU_DRYRUN_70B": {
         "default": "0",
         "help": "opt into the 70B-shape sharded-compile dryrun"},
+    "REVAL_TPU_JITCHECK": {
+        "default": "0",
+        "help": "1 = run tests under the runtime recompile sanitizer "
+                "(post-warmup jit variants fail the session; "
+                "jax.transfer_guard over the paged drive tick — "
+                "analysis/jitcheck.py; test-only, the reval_jit_* "
+                "counters stay on regardless)"},
+    "REVAL_TPU_EXCLUSIVE_DEVICE": {
+        "default": "auto",
+        "help": "bench stall-watchdog device ownership: 1 = this "
+                "process owns the chip exclusively (never spawn a "
+                "second jax process to probe; consult the tpu_watch "
+                "tunnel-health marker instead), 0 = tunneled/shared "
+                "(a LIVE watcher's heartbeat verdict takes precedence; "
+                "subprocess probe only without one), auto = exclusive "
+                "unless the tunnel watcher's marker files are fresh "
+                "(<30 min)"},
     "REVAL_TPU_LOCKCHECK": {
         "default": "0",
         "help": "1 = run tests under the runtime lock sanitizer "

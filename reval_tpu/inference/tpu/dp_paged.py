@@ -108,6 +108,19 @@ class DataParallelPagedEngine:
             agg.merge(rep.stats)
         return agg
 
+    def jit_counters(self) -> dict:
+        """Compile-variant snapshot summed over replicas (same shape as
+        :meth:`PagedTPUEngine.jit_counters`; per-entry variant counts add
+        — each replica compiles its own programs)."""
+        out = {"compiles": 0, "cache_misses": 0, "entries": {}}
+        for rep in self.replicas:
+            row = rep.jit_counters()
+            out["compiles"] += row["compiles"]
+            out["cache_misses"] += row["cache_misses"]
+            for name, n in row["entries"].items():
+                out["entries"][name] = out["entries"].get(name, 0) + n
+        return out
+
     def prefix_cache_counters(self) -> dict:
         """Prefix-cache gauge snapshot summed over replicas (counters ride
         the aggregated ``stats``)."""

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...analysis.jitcheck import tracked_jit
 from ...models import (
     KVCache,
     ModelConfig,
@@ -74,6 +75,7 @@ def _sharded_zeros(shape, dtype, sharding):
     each entry pins its NamedSharding's mesh (and devices) plus a
     compiled executable, so an unbounded cache would leak meshes from
     closed engines in a long-lived server cycling cache-length buckets."""
+    # jit-entry: engine.sharded_zeros bucketed=(shape)
     return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
 
 
@@ -380,6 +382,7 @@ class TPUEngine:
             # all-gather over ICI/DCN, a few KB per decode chunk.
             if any(d.process_index != jax.process_index()
                    for d in mesh.devices.flat):
+                # jit-entry: engine.replicate bucketed=(shape)
                 self._replicate = jax.jit(
                     lambda x: x, out_shardings=NamedSharding(mesh, P()))
             if sizes.get("sp", 1) > 1:
@@ -391,6 +394,7 @@ class TPUEngine:
 
                 self._cache_sharding = NamedSharding(
                     mesh, sp_kv_cache_spec(cfg, mesh))
+                # jit-entry: engine.sp_prefill bucketed=(rows, tokens)
                 sp_prefill = jax.jit(partial(
                     sequence_parallel_prefill, cfg=cfg, mesh=mesh))
             else:
@@ -398,13 +402,32 @@ class TPUEngine:
                 sp_prefill = None
         else:
             sp_prefill = None
-        self._jit_prefill = sp_prefill or jax.jit(
-            partial(prefill, cfg=cfg, logits_mode="last"))
-        self._jit_decode_chunk = jax.jit(
-            partial(self._decode_chunk, cfg=cfg),
-            static_argnames=("steps", "filtered"),
-            donate_argnames=("cache",),
-        )
+        # compile-variant tracking mirrors the paged engine (budgets =
+        # worst-case legitimate bucket counts; see analysis/jitcheck.py)
+        # jit-entry: engine.prefill bucketed=(rows, tokens) warmup=16
+        self._jit_prefill = tracked_jit(
+            "engine.prefill",
+            sp_prefill or jax.jit(
+                partial(prefill, cfg=cfg, logits_mode="last")),
+            registry=lambda: self.stats.registry, warmup=16)
+        # jit-entry: engine.decode_chunk static=(steps, filtered) bucketed=(tokens) warmup=48
+        self._jit_decode_chunk = tracked_jit(
+            "engine.decode_chunk",
+            jax.jit(
+                partial(self._decode_chunk, cfg=cfg),
+                static_argnames=("steps", "filtered"),
+                donate_argnames=("cache",),
+            ),
+            registry=lambda: self.stats.registry, warmup=48)
+        self._jit_trackers = (self._jit_prefill, self._jit_decode_chunk)
+
+    def jit_counters(self) -> dict:
+        """Compile-variant snapshot of the tracked jit entry points —
+        same shape as :meth:`PagedTPUEngine.jit_counters` (the serial
+        engine path's row in the PERF.md compile-count baseline)."""
+        return {"compiles": sum(t.variants for t in self._jit_trackers),
+                "cache_misses": sum(t.misses for t in self._jit_trackers),
+                "entries": {t.name: t.variants for t in self._jit_trackers}}
 
     # -- construction ------------------------------------------------------
     @classmethod

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...analysis.jitcheck import deliberate_fetch, drive_guard, tracked_jit
 from ...env import env_flag
 from ...models import (
     ModelConfig,
@@ -160,7 +161,11 @@ class _DriveState:
     slot_temp: np.ndarray        # [B] per-slot sampling temperature
     slot_topk: np.ndarray = None  # [B] per-slot top-k (0 = off)
     slot_topp: np.ndarray = None  # [B] per-slot top-p (1 = off)
-    dev_state: object = None     # packed [B, span+2] device array
+    #: packed [B, span+5] int32 device array: block tables first (span
+    #: columns — patch_state_tables depends on the tables-first layout),
+    #: then seq_lens, the pending input token, the per-request PRNG key
+    #: (2 bitcast words), and the generated-token position
+    dev_state: object = None
     dev_samp: object = None      # [B, 3] float32 (temp, top_p, top_k)
     dirty: bool = True
     span: int = 0
@@ -260,10 +265,29 @@ class PagedTPUEngine:
                 lambda c: jax.device_put(
                     c, self._cache_sharding if c.ndim == 3 else scale_sharding),
                 self.cache)
-        self._jit_prefill = jax.jit(partial(prefill, cfg=cfg, logits_mode="last"))
-        self._jit_prefill_pctx = jax.jit(
-            partial(prefill_with_paged_context, cfg=cfg, logits_mode="last"))
-        self._jit_commit = jax.jit(commit_prefill, donate_argnums=(0,))
+        # Compile-variant budgets (warmup=N): the worst-case count of
+        # legitimate shape buckets per entry at the flagship bench shape
+        # (rows/token pow2 buckets for prefill, steps x filtered x span
+        # buckets for the chunk).  The jitcheck tracker flags variant
+        # N+1 as a post-warmup recompile (reval_jit_cache_misses_total +
+        # a jit.recompile log event); the static jit pass cross-checks
+        # these literals against the annotations.
+        reg = lambda: self.stats.registry  # noqa: E731 — see TrackedJit
+        # jit-entry: paged.prefill bucketed=(rows, tokens) warmup=24
+        self._jit_prefill = tracked_jit(
+            "paged.prefill",
+            jax.jit(partial(prefill, cfg=cfg, logits_mode="last")),
+            registry=reg, warmup=24)
+        # jit-entry: paged.prefill_pctx bucketed=(rows, tokens, ctx_pages) warmup=24
+        self._jit_prefill_pctx = tracked_jit(
+            "paged.prefill_pctx",
+            jax.jit(partial(prefill_with_paged_context, cfg=cfg,
+                            logits_mode="last")),
+            registry=reg, warmup=24)
+        # jit-entry: paged.commit bucketed=(rows, tokens) warmup=24
+        self._jit_commit = tracked_jit(
+            "paged.commit", jax.jit(commit_prefill, donate_argnums=(0,)),
+            registry=reg, warmup=24)
         # persistent radix prefix cache: page-aligned prompt prefixes live
         # in refcounted pool pages ACROSS generate() calls and entry
         # points (fleet repeats, serve-mode requests).  The watermark
@@ -274,15 +298,25 @@ class PagedTPUEngine:
                                               watermark=max_slots,
                                               stats=lambda: self.stats)
                              if prefix_sharing else None)
-        self._jit_chunk = jax.jit(
-            partial(self._decode_chunk, cfg=cfg, mesh=mesh),
-            static_argnames=("steps", "filtered"),
-            donate_argnames=("cache",))
+        # jit-entry: paged.decode_chunk static=(steps, filtered) bucketed=(span) warmup=64
+        self._jit_chunk = tracked_jit(
+            "paged.decode_chunk",
+            jax.jit(
+                partial(self._decode_chunk, cfg=cfg, mesh=mesh),
+                static_argnames=("steps", "filtered"),
+                donate_argnames=("cache",)),
+            registry=reg, warmup=64)
         # in-place update of the packed state's table columns (the first
         # ``span`` columns) — lets a page-boundary crossing ride the
         # chunk pipeline instead of flushing it (tables are host-known;
         # lens/token/pos keep flowing device-side untouched)
-        self._jit_patch = jax.jit(patch_state_tables)
+        # jit-entry: paged.patch_tables bucketed=(span) warmup=16
+        self._jit_patch = tracked_jit(
+            "paged.patch_tables", jax.jit(patch_state_tables),
+            registry=reg, warmup=16)
+        self._jit_trackers = (self._jit_prefill, self._jit_prefill_pctx,
+                              self._jit_commit, self._jit_chunk,
+                              self._jit_patch)
 
     @staticmethod
     def _pages_for_budget(params, cfg, mesh, page_size: int, kv_dtype: str,
@@ -607,6 +641,17 @@ class PagedTPUEngine:
         return (self.prefix_cache.counters()
                 if self.prefix_cache is not None else {})
 
+    def jit_counters(self) -> dict:
+        """Compile-variant snapshot of the tracked jit entry points —
+        the bench ``jit`` block and the PERF.md per-path compile-count
+        baseline.  Summed from the trackers themselves (reset-proof
+        against bench's ``EngineStats`` swaps); the same totals ride
+        ``/metrics`` as ``reval_jit_compiles_total`` /
+        ``reval_jit_cache_misses_total``."""
+        return {"compiles": sum(t.variants for t in self._jit_trackers),
+                "cache_misses": sum(t.misses for t in self._jit_trackers),
+                "entries": {t.name: t.variants for t in self._jit_trackers}}
+
     def new_drive_state(self) -> _DriveState:
         return _DriveState(active={},
                            slot_token=np.zeros((self.max_slots, 1), np.int32),
@@ -634,7 +679,14 @@ class PagedTPUEngine:
         kernel work only compound when the engine itself measures)."""
         t0 = time.perf_counter()
         try:
-            self._tick(reqs, st)
+            # REVAL_TPU_JITCHECK: device->host transfer guard over the
+            # whole tick, so an implicit sync anywhere in the drive loop
+            # (helpers included) raises loudly at test time; the one
+            # intended fetch is marked deliberate_fetch() in
+            # _process_chunk.  A free nullcontext when the sanitizer is
+            # off.
+            with drive_guard():
+                self._tick(reqs, st)
         finally:
             dt = time.perf_counter() - t0
             free = self.rt.free_pages if self.rt is not None else 0
@@ -755,16 +807,13 @@ class PagedTPUEngine:
             # must land before any page is freed for reuse.  (Span
             # bucket growth is handled at the dispatch path, which
             # flushes and rebuilds when it detects the shape change.)
-            nxt = _floor_pow2(min(CHUNK, self._chunk_budget(reqs, st)))
-            need = self._pages_needed_next(st, nxt)
+            need = self._pages_needed_next(st, self._next_chunk_steps(reqs, st))
             if need and self.rt.free_pages < need:
                 self._process_pending(reqs, st)
         if not st.active:
             return                    # a flush retired the last runner
 
-        budget = self._chunk_budget(reqs, st)
-        cap = FIRST_CHUNK if st.since_admit == 0 else CHUNK
-        steps = _floor_pow2(min(cap, budget))
+        steps = self._next_chunk_steps(reqs, st)
         st.since_admit += 1
 
         # every active sequence must have pages for the whole chunk
@@ -845,6 +894,20 @@ class PagedTPUEngine:
         else:
             self._process_chunk(reqs, st, chunk)
 
+    def _next_chunk_steps(self, reqs: dict[int, _Request],
+                          st: _DriveState) -> int:
+        """Steps the NEXT dispatched chunk will run: the admission-aware
+        cap (short first chunk after an admission wave, full CHUNK at
+        steady state) floored to a power of two within the remaining
+        token budget.  The ONE definition shared by the page-cross gate
+        and the dispatch path — they used to duplicate it, coupled only
+        by the unasserted invariant that a pending chunk implies
+        ``since_admit >= 1``; a drift would let the gate underestimate
+        pages and reintroduce a preempting reserve under an in-flight
+        chunk (ADVICE r5)."""
+        cap = FIRST_CHUNK if st.since_admit == 0 else CHUNK
+        return _floor_pow2(min(cap, self._chunk_budget(reqs, st)))
+
     def _chunk_budget(self, reqs: dict[int, _Request],
                       st: _DriveState) -> int:
         """Smallest remaining new-token budget over the running slots,
@@ -918,7 +981,11 @@ class PagedTPUEngine:
         Its pages stay allocated until this retire runs, so the in-flight
         writes always land in still-owned pages."""
         toks_dev, steps, rows, t0 = chunk
-        toks_host = np.asarray(toks_dev)
+        with deliberate_fetch():
+            # host-sync: the chunk's ONE deliberate fetch — stop scanning
+            # and retirement need ground-truth tokens (everything else in
+            # the tick rides device-resident state)
+            toks_host = np.asarray(toks_dev)
         # the fetch returned: the device demonstrably made progress
         self.heartbeat = time.monotonic()
         now = time.perf_counter()
@@ -928,7 +995,16 @@ class PagedTPUEngine:
         self.stats.decode_seconds += span
         self.stats.registry.histogram(obs_metrics.DECODE_CHUNK).observe(span)
         st.t_mark = now
-        self.stats.generated_tokens += steps * len(rows)
+        # generated_tokens counts DELIVERED work: rows whose sequence
+        # retired while this chunk was in flight computed `steps` tokens
+        # that are discarded below, and folding them in would inflate
+        # the pipelined tok/s (and bench.py's tokens_per_sec, derived as
+        # generated_tokens / decode_seconds) relative to delivered
+        # output.  In-chunk overrun past a stop string still counts —
+        # the row was live when the chunk was cut.
+        delivered = sum(1 for slot, seq_id in rows
+                        if st.active.get(slot) == seq_id)
+        self.stats.generated_tokens += steps * delivered
         self.stats.decode_chunks += 1
         self.stats.decode_steps += steps
 
@@ -1072,7 +1148,11 @@ class PagedTPUEngine:
 
     @staticmethod
     def _harvest_first(group, first_dev, firsts: dict[int, int]) -> None:
-        first_host = np.asarray(first_dev)
+        with deliberate_fetch():
+            # host-sync: the prefill wave's ONE deliberate fetch per
+            # group — the first sampled tokens; the pipelined caller
+            # overlaps this fetch with the next group's dispatch
+            first_host = np.asarray(first_dev)
         for row, (_, slot) in enumerate(group):
             firsts[slot] = int(first_host[row])
 

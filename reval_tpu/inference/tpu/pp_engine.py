@@ -19,6 +19,7 @@ from functools import partial
 
 import jax
 
+from ...analysis.jitcheck import tracked_jit
 from ...models import ModelConfig
 from ...parallel.mesh import mesh_axis_sizes
 from ...parallel.pipeline import (
@@ -65,12 +66,25 @@ class PipelinedTPUEngine(TPUEngine):
 
         self._input_sharding = NamedSharding(mesh, P())
         self._cache_sharding = NamedSharding(mesh, P("pp"))
-        self._jit_prefill = jax.jit(partial(
-            pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro))
-        self._jit_decode_chunk = jax.jit(
-            partial(self._pp_decode_chunk, cfg=cfg, mesh=mesh),
-            static_argnames=("steps", "filtered"),
-            donate_argnames=("cache",))
+        # rebind the entries the base ctor tracked — keep _jit_trackers
+        # pointing at the LIVE wrappers, or the pp path's compiles would
+        # vanish from jit_counters()/reval_jit_* while the API still
+        # reports the discarded base-engine trackers
+        # jit-entry: pp.prefill bucketed=(rows, tokens) warmup=16
+        self._jit_prefill = tracked_jit(
+            "pp.prefill",
+            jax.jit(partial(
+                pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro)),
+            registry=lambda: self.stats.registry, warmup=16)
+        # jit-entry: pp.decode_chunk static=(steps, filtered) bucketed=(tokens) warmup=48
+        self._jit_decode_chunk = tracked_jit(
+            "pp.decode_chunk",
+            jax.jit(
+                partial(self._pp_decode_chunk, cfg=cfg, mesh=mesh),
+                static_argnames=("steps", "filtered"),
+                donate_argnames=("cache",)),
+            registry=lambda: self.stats.registry, warmup=48)
+        self._jit_trackers = (self._jit_prefill, self._jit_decode_chunk)
 
     @classmethod
     def from_pretrained(cls, model_path: str, *, dtype: str = "bfloat16",

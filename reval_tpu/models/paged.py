@@ -198,12 +198,14 @@ def _attention_tp_manual(q2, ki, vi, block_tables, attn_lens, ks_i, vs_i,
     # a manual region; correctness here is by construction (head-parallel,
     # no cross-shard dataflow)
     if hasattr(jax, "shard_map"):
+        # jit-entry: paged.attn_tp_shard bucketed=(rows)
         return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
                              out_specs=q_spec, check_vma=False)(*args)
     # jax 0.4.x spells it jax.experimental.shard_map with check_rep (the
     # same replication checker check_vma renamed)
     from jax.experimental.shard_map import shard_map as _shard_map
 
+    # jit-entry: paged.attn_tp_shard_jax04 bucketed=(rows)
     return _shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
                       out_specs=q_spec, check_rep=False)(*args)
 

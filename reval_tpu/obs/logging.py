@@ -61,6 +61,9 @@ EVENTS: dict[str, str] = {
     # engine (inference/tpu/paged_engine.py)
     "engine.preempt": "a running sequence was preempted on pool exhaustion",
     "engine.deadlock": "nothing running or admissible while work remains",
+    # jit-discipline tracker (analysis/jitcheck.py)
+    "jit.recompile": "a tracked jit entry compiled a new variant past "
+                     "its declared warmup budget",
     # serving session (serving/session.py)
     "session.watchdog_trip": "no engine progress past watchdog_s; "
                              "pending submissions failed typed",

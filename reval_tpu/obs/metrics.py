@@ -46,6 +46,7 @@ __all__ = [
     "ROUTER_REQUESTS", "ROUTER_ROUTED", "ROUTER_FAILOVERS",
     "ROUTER_EJECTIONS", "ROUTER_RECOVERIES", "ROUTER_SHEDS",
     "ROUTER_REPLICAS_READY",
+    "JIT_COMPILES", "JIT_CACHE_MISSES",
     "DET_CELLS", "DET_AGREE", "DET_DIVERGED", "DET_SKIPPED",
     "DET_DEPTH", "DET_DRIFT", "DRIFT_BUCKETS",
 ]
@@ -88,6 +89,8 @@ ROUTER_EJECTIONS = "reval_router_ejections_total"
 ROUTER_RECOVERIES = "reval_router_recoveries_total"
 ROUTER_SHEDS = "reval_router_sheds_total"
 ROUTER_REPLICAS_READY = "reval_router_replicas_ready"
+JIT_COMPILES = "reval_jit_compiles_total"
+JIT_CACHE_MISSES = "reval_jit_cache_misses_total"
 DET_CELLS = "reval_determinism_cells_total"
 DET_AGREE = "reval_determinism_cells_agree_total"
 DET_DIVERGED = "reval_determinism_cells_diverged_total"
@@ -121,7 +124,9 @@ METRICS: dict[str, dict] = {
     "reval_engine_prompts_total": {
         "type": "counter", "help": "Prompts completed by generate()/serve"},
     "reval_engine_generated_tokens_total": {
-        "type": "counter", "help": "Decode tokens produced (incl. overrun)"},
+        "type": "counter",
+        "help": "Decode tokens delivered to live rows (in-chunk overrun "
+                "included; chunks fetched after retirement discarded)"},
     "reval_engine_prefill_tokens_total": {
         "type": "counter", "help": "Prompt tokens prefilled"},
     "reval_engine_decode_seconds_total": {
@@ -191,6 +196,17 @@ METRICS: dict[str, dict] = {
                             "help": "Replicas currently healthy and "
                                     "passing /readyz (router poller "
                                     "view)"},
+    # jit-discipline (analysis/jitcheck.py) — compile-variant tracking
+    # over the engines' declared jit entry points
+    JIT_COMPILES: {"type": "counter",
+                   "help": "Distinct compile variants observed across "
+                           "tracked jit entry points (one per new "
+                           "shape-key signature)"},
+    JIT_CACHE_MISSES: {"type": "counter",
+                       "help": "Compile variants observed PAST an "
+                               "entry's declared warmup budget "
+                               "(post-warmup recompiles; each also "
+                               "logs jit.recompile)"},
     # determinism observatory (obs/determinism.py) — one matrix run
     # increments the counters once per cell; the snapshot rides the
     # determinism-<ts>.json artifact and merges into any registry

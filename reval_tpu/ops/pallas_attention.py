@@ -272,6 +272,7 @@ def _decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
+# jit-entry: ops.paged_attn_pallas static=(page_size, scale, interpret, window, softcap, dot_mode) bucketed=(batch, pages)
 @functools.partial(
     jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
                               "softcap", "dot_mode"))
@@ -344,6 +345,8 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                                window=window, softcap=softcap, h_kv=h_kv,
                                g=g, quantized=quantized,
                                wide=dot_mode == "wide")
+    # tile: (8, 128) — f32 native VMEM tiling; head_dim rides the lane
+    # dim (the 128-wide scratch rows), page rows ride the sublane dim
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -467,6 +470,7 @@ def _decode_kernel_seq(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm,
     o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
+# jit-entry: ops.paged_attn_pallas_seq static=(page_size, scale, interpret, window, softcap, dot_mode) bucketed=(batch, pages)
 @functools.partial(
     jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
                               "softcap", "dot_mode"))
@@ -542,6 +546,8 @@ def paged_decode_attention_pallas_seq(q, k_pages, v_pages, block_tables,
                                scale=scale, window=window, softcap=softcap,
                                h_kv=h_kv, g=g, quantized=quantized,
                                wide=dot_mode == "wide")
+    # tile: (8, 128) — f32 native VMEM tiling; the double-buffered page
+    # scratch keeps head_dim on the lane dim, page rows on the sublane
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

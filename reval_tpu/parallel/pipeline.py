@@ -191,6 +191,7 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
             0, m_count + pp - 1, tick, (h0, ck, cv, outbuf))
         return lax.psum(outbuf[:m_count], "pp"), ck, cv
 
+    # jit-entry: pp.prefill_stage bucketed=(rows, tokens)
     outbuf, ck, cv = jax.shard_map(
         staged, mesh=mesh, axis_names={"pp"},
         in_specs=(P("pp"), P("pp"), P(), P(), P("pp"), P("pp")),
@@ -324,6 +325,7 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
             0, n_total + pp - 1, tick, (h0, ck, cv, tokbuf))
         return lax.psum(tokbuf[:n_total], "pp"), ck, cv
 
+    # jit-entry: pp.decode_stage bucketed=(rows, steps)
     tokbuf, ck, cv = jax.shard_map(
         staged, mesh=mesh, axis_names={"pp"},
         in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P(), P("pp"),

@@ -171,6 +171,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, window=None, *,
         # not a closure — shard_map wants traced values as explicit args
         args.append(jnp.asarray(window))
         specs.append(P())
+    # jit-entry: ring.attn_shard bucketed=(rows, tokens)
     return jax.shard_map(
         body, mesh=mesh, in_specs=tuple(specs),
         out_specs=spec, check_vma=False)(*args)

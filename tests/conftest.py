@@ -39,15 +39,32 @@ if os.environ.get("REVAL_TPU_LOCKCHECK", "0").lower() not in ("0", "false",
     _LOCK_SANITIZER = _lockcheck.install(audit=True)
 
 
-def pytest_sessionfinish(session, exitstatus):
-    if _LOCK_SANITIZER is None or not _LOCK_SANITIZER.violations:
-        return
-    import sys as _sys
+# Runtime recompile sanitizer (REVAL_TPU_JITCHECK=1): engine jit entry
+# points count distinct compile variants; a variant past an entry's
+# declared warmup budget is a violation, and the paged drive tick runs
+# under a device->host transfer guard (jax's own + the Array.item/
+# tolist/__array__ patch that still bites on the zero-copy CPU
+# backend) so implicit syncs raise loudly.  Same accumulate-then-fail
+# contract as lockcheck.
+_JIT_SANITIZER = None
+if os.environ.get("REVAL_TPU_JITCHECK", "0").lower() not in ("0", "false",
+                                                             "off"):
+    from reval_tpu.analysis import jitcheck as _jitcheck  # noqa: E402
 
-    print("\nlockcheck: runtime lock-sanitizer violations:", file=_sys.stderr)
-    for v in _LOCK_SANITIZER.violations:
-        print(f"  - [{v['kind']}] {v['detail']}", file=_sys.stderr)
-    session.exitstatus = 1
+    _JIT_SANITIZER = _jitcheck.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    for label, san in (("lockcheck", _LOCK_SANITIZER),
+                       ("jitcheck", _JIT_SANITIZER)):
+        if san is None or not san.violations:
+            continue
+        import sys as _sys
+
+        print(f"\n{label}: runtime sanitizer violations:", file=_sys.stderr)
+        for v in san.violations:
+            print(f"  - [{v['kind']}] {v['detail']}", file=_sys.stderr)
+        session.exitstatus = 1
 
 # Crash-dump bundles default to ./tpu_watch — tests that trip watchdogs or
 # inject faults would litter the repo's scratch dir; send them to a tmp dir

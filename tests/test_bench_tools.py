@@ -149,6 +149,65 @@ def test_stall_watchdog_state_machine(monkeypatch):
     assert wd.stalled_and_dead((3, 0)) is False
 
 
+def test_probe_device_ownership_modes(monkeypatch):
+    """REVAL_TPU_EXCLUSIVE_DEVICE semantics: an exclusive-ownership chip
+    is never probed by a second jax process; a watcher verdict only
+    counts while the watcher's markers are FRESH (a leftover stale
+    probe.log from a dead watcher must not read as 'wedged')."""
+    import bench
+
+    now = 1_000_000.0
+    mtimes: dict[str, float] = {}
+    spawned = []
+    monkeypatch.setattr(bench.time, "time", lambda: now)
+
+    def fake_getmtime(p):
+        try:
+            return mtimes[bench.os.path.basename(p)]
+        except KeyError:
+            raise OSError(2, "No such file", p)
+
+    monkeypatch.setattr(bench.os.path, "getmtime", fake_getmtime)
+
+    class _R:
+        returncode = 1
+
+    def fake_run(*a, **kw):
+        spawned.append(a)
+        return _R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+
+    # explicit exclusive: healthy, never spawns — markers irrelevant
+    monkeypatch.setenv("REVAL_TPU_EXCLUSIVE_DEVICE", "1")
+    assert bench.StallWatchdog._probe_device() is True
+    # auto + no watcher markers at all: exclusive assumption
+    monkeypatch.setenv("REVAL_TPU_EXCLUSIVE_DEVICE", "auto")
+    assert bench.StallWatchdog._probe_device() is True
+    # auto + live watcher, fresh ALIVE heartbeat: healthy
+    mtimes["ALIVE"] = mtimes["probe.log"] = now - 10
+    assert bench.StallWatchdog._probe_device() is True
+    # auto + live watcher (fresh probe.log) with ALIVE gone: the
+    # watcher's wedged verdict
+    del mtimes["ALIVE"]
+    assert bench.StallWatchdog._probe_device() is False
+    # auto + DEAD watcher (only a stale probe.log left behind): not a
+    # verdict — exclusive assumption again, never a false 'wedged'
+    mtimes["probe.log"] = now - 7200
+    assert bench.StallWatchdog._probe_device() is True
+    assert spawned == []               # no second jax process, ever
+    # explicit tunneled/shared: a LIVE watcher's verdict takes
+    # precedence over the subprocess probe...
+    monkeypatch.setenv("REVAL_TPU_EXCLUSIVE_DEVICE", "0")
+    mtimes["ALIVE"] = now - 10
+    assert bench.StallWatchdog._probe_device() is True
+    assert spawned == []
+    # ...and only without one does mode 0 spawn the probe
+    del mtimes["ALIVE"]
+    assert bench.StallWatchdog._probe_device() is False
+    assert len(spawned) == 1
+
+
 def test_chip_lock_serializes_and_never_deadlocks():
     import bench
 

@@ -1,0 +1,270 @@
+"""Runtime recompile sanitizer + compile-variant tracker (ISSUE 9).
+
+Three layers under test:
+
+1. :class:`TrackedJit` — distinct-signature counting, warmup budgets,
+   the ``reval_jit_*`` counters, and the lazy-registry contract (bench
+   swaps ``EngineStats`` mid-run);
+2. the sanitizer — post-warmup recompiles and in-tick device→host
+   transfers become violations, the drive guard trips on an injected
+   ``.item()`` and stands down outside a guarded tick;
+3. the real paged engine on the tiny config runs CLEAN under the
+   sanitizer (zero post-warmup recompiles, zero unplanned transfers) —
+   the compile-count baseline PERF.md PR-9 pins.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from reval_tpu.analysis import jitcheck
+from reval_tpu.analysis.jitcheck import tracked_jit
+from reval_tpu.obs.metrics import (JIT_CACHE_MISSES, JIT_COMPILES,
+                                   MetricsRegistry)
+
+
+@pytest.fixture
+def sanitizer():
+    """A FRESH scoped sanitizer, with whatever was installed before
+    (e.g. the conftest session ledger under ``REVAL_TPU_JITCHECK=1``)
+    restored afterwards — a test's deliberately-seeded violations must
+    never land in the session ledger, and the teardown must never
+    uninstall the session sanitizer."""
+    with jitcheck.scoped() as san:
+        yield san
+
+
+# ---------------------------------------------------------------------------
+# TrackedJit: variant counting + metrics
+# ---------------------------------------------------------------------------
+
+def test_tracker_counts_distinct_shape_signatures():
+    reg = MetricsRegistry()
+    t = tracked_jit("t.f", lambda x: x, registry=reg, warmup=8)
+    t(jnp.zeros((2, 4)))
+    t(jnp.zeros((2, 4)))          # same shape/dtype: no new variant
+    t(jnp.zeros((4, 4)))          # new shape
+    t(jnp.zeros((4, 4), jnp.int32))   # same shape, new dtype
+    assert t.variants == 3 and t.misses == 0
+    assert reg.counter(JIT_COMPILES).value == 3
+    assert reg.counter(JIT_CACHE_MISSES).value == 0
+
+
+def test_tracker_statics_and_structure_are_variant_axes():
+    t = tracked_jit("t.g", lambda x, **kw: x, warmup=8)
+    t(jnp.zeros((2,)), steps=4)
+    t(jnp.zeros((2,)), steps=8)       # hashable static changed
+    t(jnp.zeros((2,)), steps=8, mask=None)   # treedef changed
+    assert t.variants == 3
+
+
+def test_tracker_delegates_wrapped_attributes():
+    def fn(x):
+        return x
+
+    fn.lower = lambda *a: "lowered"
+    t = tracked_jit("t.d", fn)
+    assert t.lower() == "lowered"
+    assert t.name == "t.d"
+
+
+def test_tracker_registry_may_be_lazy_callable():
+    # bench swaps eng.stats (and with it the registry) between warmup
+    # and the timed pass — a captured registry would go stale
+    box = {"reg": MetricsRegistry()}
+    t = tracked_jit("t.lazy", lambda x: x, registry=lambda: box["reg"])
+    t(jnp.zeros((2,)))
+    assert box["reg"].counter(JIT_COMPILES).value == 1
+    box["reg"] = MetricsRegistry()     # the swap
+    t(jnp.zeros((4,)))
+    assert box["reg"].counter(JIT_COMPILES).value == 1
+    assert t.variants == 2             # tracker-side counts are reset-proof
+
+
+def test_tracker_thread_safe_variant_counting():
+    t = tracked_jit("t.mt", lambda x: x, warmup=64)
+
+    def hammer(i):
+        for n in range(1, 9):
+            t(jnp.zeros((n,)))
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.variants == 8             # 8 shapes, counted exactly once each
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: post-warmup recompiles
+# ---------------------------------------------------------------------------
+
+def test_post_warmup_recompile_is_a_violation():
+    san = jitcheck.JitSanitizer()
+    reg = MetricsRegistry()
+    t = tracked_jit("t.hot", lambda x: x, registry=reg, warmup=1,
+                    sanitizer=san)
+    t(jnp.zeros((2,)))                 # within budget
+    assert not san.violations
+    t(jnp.zeros((4,)))                 # variant #2 past warmup=1
+    assert t.misses == 1
+    assert reg.counter(JIT_CACHE_MISSES).value == 1
+    (v,) = san.violations
+    assert v["kind"] == "post-warmup-recompile"
+    assert v["entry"] == "t.hot" and "warmup budget of 1" in v["detail"]
+
+
+def test_shape_bucket_churn_detected_unbucketed_vs_bucketed():
+    san = jitcheck.JitSanitizer()
+    churn = tracked_jit("t.churn", lambda x: x, warmup=2, sanitizer=san)
+    for n in range(1, 7):
+        churn(jnp.zeros((n,)))         # every raw length is a new program
+    assert churn.variants == 6 and churn.misses == 4
+    assert sum(1 for v in san.violations
+               if v["entry"] == "t.churn") == 4
+
+    bucketed = tracked_jit("t.bucketed", lambda x: x, warmup=4,
+                           sanitizer=san)
+    for n in range(1, 9):
+        b = 1 << (n - 1).bit_length()  # pow2 bucket, the engine contract
+        bucketed(jnp.zeros((max(1, b),)))
+    assert bucketed.variants == 4 and bucketed.misses == 0
+    assert not any(v["entry"] == "t.bucketed" for v in san.violations)
+
+
+def test_installed_sanitizer_is_the_default_sink(sanitizer):
+    t = tracked_jit("t.global", lambda x: x, warmup=0)
+    t(jnp.zeros((1,)))                 # warmup=0: first variant is a miss
+    assert any(v["entry"] == "t.global" for v in sanitizer.violations)
+
+
+def test_no_sanitizer_no_violation_still_counts():
+    with jitcheck.scoped(active=False):
+        assert jitcheck.current() is None
+        reg = MetricsRegistry()
+        t = tracked_jit("t.prod", lambda x: x, registry=reg, warmup=0)
+        t(jnp.zeros((1,)))             # production mode: counted, not fatal
+        assert t.misses == 1
+        assert reg.counter(JIT_CACHE_MISSES).value == 1
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: the drive guard (device→host discipline)
+# ---------------------------------------------------------------------------
+
+def test_drive_guard_trips_on_injected_item(sanitizer):
+    x = jnp.arange(4)
+    x.block_until_ready()
+    with pytest.raises(RuntimeError, match="device->host"):
+        with jitcheck.drive_guard():
+            x.sum().item()             # the injected implicit sync
+    assert any(v["kind"] == "implicit-device-host-transfer"
+               for v in sanitizer.violations)
+
+
+def test_drive_guard_trips_on_tolist(sanitizer):
+    # (np.asarray reads CPU jax arrays zero-copy through the buffer
+    # protocol, never calling __array__ — on this backend only the real
+    # TPU transfer guard sees it; .item()/.tolist() are the patchable
+    # CPU bite surface)
+    x = jnp.arange(4)
+    with pytest.raises(RuntimeError, match="tolist"):
+        with jitcheck.drive_guard():
+            x.tolist()
+
+
+def test_deliberate_fetch_is_the_escape_hatch(sanitizer):
+    x = jnp.arange(4)
+    with jitcheck.drive_guard():
+        with jitcheck.deliberate_fetch():
+            got = np.asarray(x)        # the engine's one intended fetch
+    assert got.tolist() == [0, 1, 2, 3]
+    assert not any(v["kind"] == "implicit-device-host-transfer"
+                   for v in sanitizer.violations)
+
+
+def test_guard_inert_outside_drive_ticks(sanitizer):
+    # tests and cold paths fetch freely even while the patch is live
+    x = jnp.arange(3)
+    assert np.asarray(x).sum() == 3
+    assert x.tolist() == [0, 1, 2]
+    assert x.sum().item() == 3
+
+
+def test_guard_free_when_sanitizer_off():
+    from contextlib import nullcontext
+
+    with jitcheck.scoped(active=False):
+        assert jitcheck.current() is None
+        assert isinstance(jitcheck.drive_guard(), nullcontext)
+        assert isinstance(jitcheck.deliberate_fetch(), nullcontext)
+        with jitcheck.drive_guard():
+            assert jnp.arange(2).tolist() == [0, 1]
+
+
+def test_uninstall_restores_the_patched_surface():
+    with jitcheck.scoped(active=False):   # park any session sanitizer
+        jitcheck.install()
+        jitcheck.uninstall()
+        x = jnp.arange(2)
+        # patched methods restored: no wrapper frames left behind
+        assert type(x).tolist is not None
+        assert "_d2h_wrapper" not in type(x).tolist.__qualname__
+        assert np.asarray(x).tolist() == [0, 1]
+
+
+def test_scoped_restores_prior_sanitizer():
+    with jitcheck.scoped() as outer:      # stands in for the session install
+        with jitcheck.scoped() as inner:
+            t = tracked_jit("t.scoped", lambda x: x, warmup=0)
+            t(jnp.zeros((1,)))
+            assert any(v["entry"] == "t.scoped" for v in inner.violations)
+        # the seeded violation stayed in the inner ledger, and the outer
+        # sanitizer (with its d2h patch) is back in force
+        assert jitcheck.current() is outer
+        assert not outer.violations
+        with pytest.raises(RuntimeError, match="tolist"):
+            with jitcheck.drive_guard():
+                jnp.arange(2).tolist()
+        outer.violations.clear()          # the trip above was deliberate
+
+
+# ---------------------------------------------------------------------------
+# the real paged engine, tiny config, under the sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_paged_engine_tiny_config_runs_clean(sanitizer):
+    """The acceptance gate: the paged drive loop on the tiny config has
+    ZERO post-warmup recompiles and ZERO unplanned device→host syncs —
+    every tick ran under the guard (drive_guard is wired inside
+    _drive_tick, not the test), and the one fetch is declared."""
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                         page_size=128, max_seq_len=512)
+    prompts = ["x = 1", "def f(a):\n    return a",
+               "for i in range(3):\n    print(i)"]
+    outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+    assert len(outs) == len(prompts)
+    assert sanitizer.violations == []
+    row = eng.jit_counters()
+    assert row["cache_misses"] == 0
+    assert row["compiles"] > 0
+    # every tracked entry stayed inside its declared warmup budget
+    assert set(row["entries"]) == {"paged.prefill", "paged.prefill_pctx",
+                                   "paged.commit", "paged.decode_chunk",
+                                   "paged.patch_tables"}
+    eng.close()

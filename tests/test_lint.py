@@ -605,3 +605,346 @@ def test_bench_probe_self_heals_with_retry_backoff():
     health, err = bench.probe_devices(retries=2, wait_s=0.1, runner=crashing,
                                       sleep=lambda s: None)
     assert health is None and "rc=1" in err and "boom" in err
+
+
+# ---------------------------------------------------------------------------
+# jit pass bites (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+JITTY = '''import jax
+
+
+def compute(x, steps):
+    if steps > 2:               # steps is static: a Python value
+        return x * 2.0
+    return x + steps
+
+
+# jit-entry: toy.compute static=(steps) bucketed=(rows) warmup=4
+fn = jax.jit(compute, static_argnames=("steps",))
+'''
+
+
+def test_jit_clean_annotated_site_passes(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY)
+    report = run_lint(str(tmp_path), ["jit"])
+    assert report.ok, messages(report)
+
+
+def test_jit_flags_undeclared_site(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+fn = jax.jit(lambda x: x * 2)
+''')
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("undeclared jit entry point" in m for m in messages(report))
+
+
+def test_jit_out_of_scope_dirs_uncovered(tmp_path):
+    # the serving layer may jit freely — only the compiled core declares
+    plant(tmp_path, "reval_tpu/serving/toy.py", '''import jax
+
+fn = jax.jit(lambda x: x * 2)
+''')
+    report = run_lint(str(tmp_path), ["jit"])
+    assert report.ok, messages(report)
+
+
+def test_jit_flags_traced_value_branch(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY.replace(
+        "if steps > 2:               # steps is static: a Python value",
+        "if x > 2:"))
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("traced parameter(s) x" in m for m in messages(report))
+
+
+def test_jit_is_none_structural_branch_exempt(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+
+def compute(x, mask):
+    if mask is not None:        # structure, not data: retrace contract
+        return x * mask
+    return x
+
+
+# jit-entry: toy.compute bucketed=(rows)
+fn = jax.jit(compute)
+''')
+    report = run_lint(str(tmp_path), ["jit"])
+    assert report.ok, messages(report)
+
+
+def test_jit_guard_then_compare_still_bites(tmp_path):
+    # the `is not None` clause exempts only ITS OWN occurrence of x —
+    # the data-dependent `x > 2` in the same test must still flag
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+
+def compute(x, mask):
+    if mask is not None and mask > 2:
+        return x * mask
+    return x
+
+
+# jit-entry: toy.guarded bucketed=(rows)
+fn = jax.jit(compute)
+''')
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("traced parameter(s) mask" in m for m in messages(report))
+
+
+def test_jit_static_round_trip_bites_both_directions(tmp_path):
+    # annotation promises FEWER statics than the call declares
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY.replace(
+        "static=(steps) ", ""))
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("does not match the call's static_argnames" in m
+               for m in messages(report))
+    # annotation promises MORE statics than the call declares
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY.replace(
+        'fn = jax.jit(compute, static_argnames=("steps",))',
+        'fn = jax.jit(compute)'))
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("no static_argnames" in m for m in messages(report))
+
+
+def test_jit_bans_static_argnums(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY.replace(
+        'static_argnames=("steps",)', "static_argnums=(1,)"))
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("static_argnums" in m and "silently go stale" in m
+               for m in messages(report))
+
+
+def test_jit_bans_computed_static_argnames(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py",
+          "NAMES = (\"steps\",)\n" + JITTY.replace(
+              'static_argnames=("steps",)', "static_argnames=NAMES"))
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("not a string literal" in m for m in messages(report))
+
+
+def test_jit_duplicate_shape_key(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY + '''
+
+# jit-entry: toy.compute static=(steps) bucketed=(rows) warmup=4
+fn2 = jax.jit(compute, static_argnames=("steps",))
+''')
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("duplicate jit-entry shape-key" in m for m in messages(report))
+
+
+def test_jit_tracked_jit_literals_cross_checked(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+from reval_tpu.analysis.jitcheck import tracked_jit
+
+
+def compute(x):
+    return x * 2.0
+
+
+# jit-entry: toy.compute warmup=4
+fn = tracked_jit("toy.other", jax.jit(compute), warmup=3)
+''')
+    report = run_lint(str(tmp_path), ["jit"])
+    msgs = messages(report)
+    assert any("tracked_jit name 'toy.other'" in m for m in msgs)
+    assert any("warmup=3 does not match" in m for m in msgs)
+
+
+def test_jit_unparseable_annotation_tail_reported(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", JITTY.replace(
+        "warmup=4", "warmup=soon"))
+    report = run_lint(str(tmp_path), ["jit"])
+    assert any("unparseable tail" in m for m in messages(report))
+
+
+# ---------------------------------------------------------------------------
+# hostsync pass bites (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_hostsync_flags_transfer_in_hot_path(tmp_path):
+    plant(tmp_path, "reval_tpu/eng.py", '''import numpy as np
+
+
+def tick(state):   # hot-path
+    toks = np.asarray(state.tokens)
+    return toks.tolist()
+''')
+    report = run_lint(str(tmp_path), ["hostsync"])
+    msgs = messages(report)
+    assert any("np.asarray" in m for m in msgs)
+    assert any("toks.tolist" in m for m in msgs)
+
+
+def test_hostsync_reasoned_suppression_passes(tmp_path):
+    plant(tmp_path, "reval_tpu/eng.py", '''import numpy as np
+
+
+def tick(state):   # hot-path
+    # host-sync: the chunk's one deliberate ground-truth fetch
+    return np.asarray(state.tokens)
+''')
+    report = run_lint(str(tmp_path), ["hostsync"])
+    assert report.ok, messages(report)
+
+
+def test_hostsync_bare_marker_is_itself_a_violation(tmp_path):
+    plant(tmp_path, "reval_tpu/eng.py", '''import numpy as np
+
+
+def tick(state):   # hot-path
+    # host-sync:
+    return np.asarray(state.tokens)
+''')
+    report = run_lint(str(tmp_path), ["hostsync"])
+    msgs = messages(report)
+    assert any("without a reason" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)   # nothing was silenced
+
+
+def test_hostsync_flags_tracer_concretization_in_jit_body(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+
+def compute(x, n):
+    return x * float(n)
+
+
+# jit-entry: toy.compute bucketed=(rows)
+fn = jax.jit(compute)
+''')
+    report = run_lint(str(tmp_path), ["hostsync"])
+    assert any("float() on traced parameter(s) n" in m
+               for m in messages(report))
+
+
+def test_hostsync_static_param_concretization_is_fine(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+
+def compute(x, n):
+    return x * float(n)        # n is static: a Python value here
+
+
+# jit-entry: toy.compute static=(n) bucketed=(rows)
+fn = jax.jit(compute, static_argnames=("n",))
+''')
+    report = run_lint(str(tmp_path), ["hostsync"])
+    assert report.ok, messages(report)
+
+
+def test_hostsync_flags_device_get_in_jit_body(tmp_path):
+    plant(tmp_path, "reval_tpu/models/toy.py", '''import jax
+
+
+def compute(x):
+    return jax.device_get(x)
+
+
+# jit-entry: toy.compute bucketed=(rows)
+fn = jax.jit(compute)
+''')
+    report = run_lint(str(tmp_path), ["hostsync"])
+    assert any("jax.device_get" in m for m in messages(report))
+
+
+# ---------------------------------------------------------------------------
+# tilecontract pass bites (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_tile_missing_contract_bites(tmp_path):
+    plant(tmp_path, "reval_tpu/ops/kern.py", '''from jax.experimental import pallas as pl
+
+
+def run(q, kernel):
+    return pl.pallas_call(kernel, out_shape=q)(q)
+''')
+    report = run_lint(str(tmp_path), ["tilecontract"])
+    assert any("without a '# tile:" in m for m in messages(report))
+
+
+def test_tile_misaligned_minor_dim_bites(tmp_path):
+    plant(tmp_path, "reval_tpu/ops/kern.py", '''from jax.experimental import pallas as pl
+
+
+def run(q, kernel):
+    # tile: (8, 128)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+        out_shape=q,
+    )(q)
+''')
+    report = run_lint(str(tmp_path), ["tilecontract"])
+    assert any("minor dim 100" in m and "128" in m for m in messages(report))
+
+
+def test_tile_misaligned_second_minor_bites(tmp_path):
+    plant(tmp_path, "reval_tpu/ops/kern.py", '''from jax.experimental import pallas as pl
+
+
+def run(q, kernel):
+    # tile: (8, 128)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec((12, 256), lambda i: (i, 0))],
+        out_shape=q,
+    )(q)
+''')
+    report = run_lint(str(tmp_path), ["tilecontract"])
+    assert any("second-minor dim 12" in m for m in messages(report))
+
+
+def test_tile_illegal_declared_tile_bites(tmp_path):
+    plant(tmp_path, "reval_tpu/ops/kern.py", '''from jax.experimental import pallas as pl
+
+
+def run(q, kernel):
+    # tile: (5, 128)
+    return pl.pallas_call(kernel, out_shape=q)(q)
+''')
+    report = run_lint(str(tmp_path), ["tilecontract"])
+    assert any("sublane tile 5" in m for m in messages(report))
+
+
+def test_tile_clean_kernel_with_symbolic_dims_passes(tmp_path):
+    plant(tmp_path, "reval_tpu/ops/kern.py", '''from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import jax.numpy as jnp
+
+LANES = 256
+
+
+def run(q, kernel, h, d):
+    # tile: (8, 128)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec((1, h, d), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((8, LANES), lambda i: (i, 0))],
+        scratch_shapes=[pltpu.VMEM((h, 128), jnp.float32)],
+        out_shape=q,
+    )(q)
+''')
+    report = run_lint(str(tmp_path), ["tilecontract"])
+    assert report.ok, messages(report)
+
+
+def test_tile_suppression_with_reason_is_counted(tmp_path):
+    plant(tmp_path, "reval_tpu/ops/kern.py", '''from jax.experimental import pallas as pl
+
+
+def run(q, kernel):
+    # tile: (8, 128)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],  # lint: allow(tilecontract) — deliberately sub-tile scalar row, padding measured acceptable
+        out_shape=q,
+    )(q)
+''')
+    report = run_lint(str(tmp_path), ["tilecontract"])
+    assert report.ok, messages(report)
+    assert len(report.suppressions) == 1
+    assert "sub-tile" in report.suppressions[0].reason

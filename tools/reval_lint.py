@@ -3,12 +3,22 @@
 
 Thin launcher over :mod:`reval_tpu.analysis.driver` — the passes are:
 
-- ``locks``   lock-discipline / race detector (``# guarded-by:``)
-- ``hotpath`` no blocking/allocating calls in ``# hot-path`` functions
-- ``errors``  serving layer raises only the serving/errors.py taxonomy
-- ``env``     REVAL_TPU_* reads go through reval_tpu/env.py::ENV
-- ``metrics`` METRICS spec <-> README <-> literals (ex check_metrics)
-- ``events``  EVENTS spec <-> call sites <-> README (ex check_metrics)
+- ``locks``        lock-discipline / race detector (``# guarded-by:``)
+- ``hotpath``      no blocking/allocating calls in ``# hot-path`` functions
+- ``jit``          every jax.jit/shard_map ctor declares ``# jit-entry:``
+                   (static args, bucketed axes, warmup budget); no
+                   traced-value Python branching in annotated bodies
+- ``hostsync``     no implicit device->host syncs in hot-path regions or
+                   jit-entry bodies (``# host-sync: <why>`` at the few
+                   deliberate fetches)
+- ``tilecontract`` every ``pallas_call`` in ops/ declares
+                   ``# tile: (sublane, lane)``; resolvable BlockSpec/VMEM
+                   dims are lane/sublane-aligned
+- ``errors``       serving layer raises only the serving/errors.py taxonomy
+- ``env``          REVAL_TPU_* reads go through reval_tpu/env.py::ENV
+- ``metrics``      METRICS spec <-> README <-> literals (ex check_metrics)
+- ``events``       EVENTS spec <-> call sites <-> README (ex check_metrics)
+- ``detmatrix``    determinism-matrix artifacts conform to the schema
 
 Usage::
 
