@@ -557,9 +557,11 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
+    t_build0 = time.perf_counter()
     eng = PagedTPUEngine(params, cfg, tok, max_slots=max_slots,
                          max_seq_len=max_seq_len, num_pages=num_pages,
                          prefix_sharing=prefix_sharing, kv_dtype=kv_dtype)
+    build_wall = time.perf_counter() - t_build0
     # warmup = one full identical run: prefill buckets, decode span buckets,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
     # pair, so a reduced warmup would leave XLA compiles inside the timed
@@ -697,8 +699,17 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     # the bench "jit" block, and the per-path baseline PERF.md pins —
     # cache_misses > 0 means a post-warmup recompile happened in-run
     jit_row = eng.jit_counters()
+    # warm-restart economics (inference/tpu/aot_cache.py): cache
+    # hits/misses + compile seconds the cache skipped this boot, and —
+    # when the cache is on — engine-build+warmup wall as the measured
+    # restart-to-ready (what a restarted server pays before /readyz;
+    # the BENCH_r* trajectory shows the cold→warm collapse once the
+    # chip tunnel is back)
+    restart_row = eng.aot_counters()
+    if restart_row.get("enabled"):
+        restart_row["restart_to_ready_s"] = round(build_wall + warmup_wall, 2)
     eng.close()
-    return wall, stats, prefix_cache, jit_row
+    return wall, stats, prefix_cache, jit_row, restart_row
 
 
 def run_serial(params, cfg, tok, prompts, max_new, *, max_seq_len=4096):
@@ -918,7 +929,7 @@ def main() -> None:
         progress = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "tpu_watch", "bench_inflight.json")
         os.makedirs(os.path.dirname(progress), exist_ok=True)
-        wall, stats, cache_row, jit_row = run_paged(
+        wall, stats, cache_row, jit_row, restart_row = run_paged(
             params, cfg, tok, prompts, max_new,
             prefix_sharing=not args.no_prefix_cache, max_slots=args.slots,
             max_seq_len=args.max_seq_len,
@@ -985,6 +996,12 @@ def main() -> None:
             # means a POST-warmup recompile fired mid-run, the silent
             # perf cliff the jitcheck sanitizer pins (PERF.md PR-9)
             "jit": jit_row,
+            # warm-restart block: AOT executable-cache hits/misses +
+            # compile seconds skipped this boot, and restart_to_ready_s
+            # (engine build + warmup wall) when the cache is enabled —
+            # {"enabled": false} otherwise, so the BENCH_r* trajectory
+            # shows exactly when the cold-start win lands (PR-10)
+            "restart": restart_row,
         }
         if args.no_obs:
             extras["obs_disabled"] = True
@@ -1020,8 +1037,8 @@ def main() -> None:
             note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); '
                  'prefix-cache-off A/B')
             try:
-                wall_nopre, _, _, _ = run_paged(params, cfg, tok, prompts,
-                                                max_new,
+                wall_nopre, _, _, _, _ = run_paged(params, cfg, tok, prompts,
+                                                   max_new,
                                                 prefix_sharing=False,
                                                 max_slots=args.slots,
                                                 max_seq_len=args.max_seq_len,

@@ -321,6 +321,16 @@ class TrackedJit:
         return self._misses
 
     def __call__(self, *args, **kwargs):
+        self.note_call(args, kwargs)
+        return self._fn(*args, **kwargs)
+
+    def note_call(self, args: tuple, kwargs: dict):
+        """Run the variant accounting for one call WITHOUT executing the
+        wrapped function, and return the call's signature key.  The AOT
+        cache wrapper (inference/tpu/aot_cache.py) dispatches to its own
+        deserialized executables — it must keep the ``reval_jit_*``
+        counting identical without paying the underlying jit a second
+        compile."""
         key = _signature(args, kwargs)
         if key not in self._sigs:
             is_new = miss = False
@@ -347,7 +357,7 @@ class TrackedJit:
                     san = self._san if self._san is not None else _current
                     if san is not None:
                         san.record(self.name, n, self.warmup, key)
-        return self._fn(*args, **kwargs)
+        return key
 
     def __getattr__(self, item):
         return getattr(self._fn, item)

@@ -576,6 +576,19 @@ def run_serve(argv: list[str]) -> int:
                              "driver fault, deadline storm, SIGUSR1, SIGTERM "
                              "drain; default env REVAL_TPU_POSTMORTEM_DIR or "
                              "tpu_watch/)")
+    parser.add_argument("--snapshot-path", default=None, metavar="PATH",
+                        help="warm-state snapshot file: graceful drain "
+                             "writes the prefix-cache token tree there, the "
+                             "next boot replays it through prefill before "
+                             "/readyz flips (default env "
+                             "REVAL_TPU_SNAPSHOT_PATH; unset disables)")
+    parser.add_argument("--supervise", action="store_true",
+                        help="crash-loop supervisor: respawn this server "
+                             "when it dies, with bounded exponential "
+                             "backoff, a postmortem bundle per death, and "
+                             "sticky-failed after REVAL_TPU_SUPERVISE_"
+                             "MAX_DEATHS rapid deaths (never flaps the "
+                             "router)")
     args = parser.parse_args(argv)
     cfg = {}
     if os.path.exists(args.input):
@@ -586,6 +599,43 @@ def run_serve(argv: list[str]) -> int:
         return 1
     if args.mock:
         cfg["mock"] = True
+    if args.snapshot_path:
+        cfg["snapshot_path"] = args.snapshot_path
+    if args.supervise:
+        # parent process: never builds an engine — it spawns `serve`
+        # children (same argv minus --supervise) and respawns them per
+        # the supervisor policy (serving/supervisor.py)
+        import subprocess
+
+        from .serving.supervisor import Supervisor
+
+        import signal
+
+        cmd = ([sys.executable, "-m", "reval_tpu", "serve"]
+               + [a for a in argv if a != "--supervise"])
+        supervisor = Supervisor(spawn=lambda: subprocess.Popen(cmd),
+                                postmortem_dir=args.postmortem_dir)
+        print(f"[supervise] respawning `{' '.join(cmd[2:])}` on death "
+              f"(sticky-failed after {supervisor.max_deaths} rapid deaths)")
+        # SIGTERM is the fleet's clean-stop signal (systemd/k8s/operator
+        # kill): without a handler the default action kills only the
+        # supervisor, orphaning a child that keeps holding the port —
+        # the next supervisor's children then die EADDRINUSE into
+        # sticky-failed while the orphan serves stale config.  Route it
+        # through the same stop path as Ctrl-C.
+        def _term(_signum, _frame):
+            raise KeyboardInterrupt
+
+        signal.signal(signal.SIGTERM, _term)
+        try:
+            return supervisor.run()
+        except KeyboardInterrupt:
+            supervisor.stop()
+            child = supervisor.child
+            if child is not None and child.poll() is None:
+                child.terminate()   # SIGTERM → the child's graceful drain
+                child.wait()
+            return 0
     if args.trace_out:
         cfg["trace_out"] = args.trace_out
     if args.postmortem_dir:

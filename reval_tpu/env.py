@@ -90,6 +90,36 @@ ENV: dict[str, dict] = {
         "default": "1",
         "help": "structured-log stderr emission (0 silences; the "
                 "in-process ring still records)"},
+    # -- warm restarts (inference/tpu/aot_cache.py, serving/session.py,
+    #    serving/supervisor.py) -------------------------------------------
+    "REVAL_TPU_AOT_CACHE_DIR": {
+        "default": "",
+        "help": "persistent AOT executable-cache directory (empty "
+                "disables; engines serialize tracked-jit variants there "
+                "and restarts load them instead of recompiling; also "
+                "enables jax's own persistent compilation cache under "
+                "<dir>/xla)"},
+    "REVAL_TPU_AOT_CACHE_MAX_MB": {
+        "default": "2048",
+        "help": "AOT cache size bound in MB; LRU entries past it are "
+                "GC'd after each store"},
+    "REVAL_TPU_SNAPSHOT_PATH": {
+        "default": "",
+        "help": "warm-state snapshot file (empty disables): graceful "
+                "drain writes the prefix-cache token tree there, boot "
+                "replays it through prefill before /readyz flips"},
+    "REVAL_TPU_SUPERVISE_MAX_DEATHS": {
+        "default": "5",
+        "help": "child deaths inside the rapid-death window before the "
+                "supervisor goes sticky-failed instead of respawning"},
+    "REVAL_TPU_SUPERVISE_WINDOW_S": {
+        "default": "60",
+        "help": "the supervisor's rapid-death window in seconds (deaths "
+                "older than this age out of the budget)"},
+    "REVAL_TPU_SUPERVISE_BACKOFF_S": {
+        "default": "0.5",
+        "help": "base respawn backoff in seconds (doubles per rapid "
+                "death, jittered, capped at 30 s — RetryPolicy schedule)"},
     # -- serving lifecycle (serving/session.py) ----------------------------
     "REVAL_TPU_MAX_QUEUED_TOKENS": {
         "default": "0",

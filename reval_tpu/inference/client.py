@@ -10,8 +10,10 @@ topology, SURVEY §3.3).
 Resilience: construction no longer races the server.  A wait-for-server
 handshake polls ``/readyz`` — not just "the port answers" but "the engine
 is loaded, the driver is stepping, and the queue has room"; a 503
-(draining, wedged, still loading) keeps polling, while a 404 from an
-older server without the route still counts as up.  Every request
+(draining, wedged, still loading, or ``warming`` — a restarted server
+replaying its warm-state snapshot through prefill before readiness
+flips) keeps polling, while a 404 from an older server without the
+route still counts as up.  Every request
 afterwards runs under a :class:`~reval_tpu.resilience.RetryPolicy` —
 connection resets, timeouts, 5xx responses, truncated JSON bodies, and
 429 load sheds are retried with exponential backoff, honoring the

@@ -1,0 +1,738 @@
+"""Persistent AOT executable cache: warm restarts skip XLA compilation.
+
+A restarted ``EngineServer`` pays the full jit cost before ``/readyz``
+flips — AOT_CERT_r05.json measures 64.7 s for the flagship decode chunk
+and 1060.5 s for the 34B north-star program, so every deploy or crash is
+minutes of lost capacity.  This module makes the compile a one-time cost
+per (program, shape, environment): every :class:`~reval_tpu.analysis.
+jitcheck.TrackedJit` variant an engine compiles is serialized to disk
+via ``jax.export`` and the NEXT process boot loads the serialized
+executable instead of tracing + lowering again.
+
+Layering (all additive — unset ``REVAL_TPU_AOT_CACHE_DIR`` disables the
+whole module and engines behave exactly as before):
+
+- :class:`AOTCache` — the directory: fingerprint-keyed entries (one
+  ``.json`` meta + one ``.bin`` payload per compile variant), atomic
+  tmp+rename writes with the meta as the commit point, sha256 payload
+  checksums, a size-bounded LRU GC (``REVAL_TPU_AOT_CACHE_MAX_MB``), and
+  the ``reval_aot_*`` counters.  Enabling the cache also points jax's
+  own persistent compilation cache at ``<dir>/xla`` so the backend
+  compile of a deserialized module is cached too.
+- :class:`AotJit` — the per-entry wrapper around a ``TrackedJit``.  Per
+  call it runs the tracker's variant accounting (``note_call`` — the
+  ``reval_jit_*`` counters stay identical), then dispatches to the
+  deserialized executable when the variant is cached, or compiles fresh
+  through the underlying jit and serializes the result.  Static args
+  are baked into the exported module, so the wrapper strips them when
+  dispatching a loaded executable.
+
+**Never a crash.**  Every degraded path — corrupt or truncated payload,
+checksum or fingerprint mismatch, an unwritable cache directory, a jax
+build that cannot export the program (Mosaic canary) — falls back to a
+fresh compile with a typed event (``aot.cache_error`` /
+``aot.unsupported``) and a counter; the serving path is never taken
+down by its own cache.
+
+**Fingerprint.**  Entries are keyed by a sha256 over the engine's
+context (model config, dtypes, kernel backend, mesh, page geometry) plus
+the jax/jaxlib versions (:func:`runtime_context`); a payload whose
+recorded fingerprint disagrees with the booting engine's is stale — it
+degrades to a fresh compile, never a wrong program.
+
+``tools/aot_cache.py`` is the operator CLI (``ls`` / ``verify`` /
+``gc``) over the same directory format.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import time
+
+from ...env import env_int, env_str
+from ...obs import metrics as obs_metrics
+from ...obs.logging import log_event
+
+__all__ = ["AOTCache", "AotJit", "cache_from_env", "fingerprint",
+           "runtime_context", "kernel_export_skip", "FORMAT"]
+
+FORMAT = "reval-aot-v1"
+
+_MB = 1 << 20
+
+#: age before GC reaps a meta-less payload or leftover tmp file — long
+#: enough for a live writer's commit (payload rename → meta rename) to
+#: finish, short enough that a crash's debris goes at the next store
+_ORPHAN_GRACE_S = 60.0
+
+
+def runtime_context(**extra) -> dict:
+    """The environment half of a cache fingerprint: jax/jaxlib versions
+    (an executable serialized by one toolchain must not be fed to
+    another) plus whatever engine context the caller adds."""
+    import jax
+
+    ctx = {"jax": jax.__version__}
+    try:
+        import jaxlib
+
+        ctx["jaxlib"] = jaxlib.__version__
+    except Exception:       # pragma: no cover — jaxlib always ships with jax
+        pass
+    ctx.update(extra)
+    return ctx
+
+
+def fingerprint(context: dict) -> str:
+    """Canonical sha256 over a context dict (sorted-key JSON, everything
+    stringified so dtypes/meshes/config reprs key stably)."""
+    blob = json.dumps({str(k): str(v) for k, v in context.items()},
+                      sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+@functools.lru_cache(maxsize=None)
+def kernel_export_skip() -> str | None:
+    """Capability canary for Pallas-kernel exports, shared with
+    tests/test_tpu_lowering.py: both decode kernels transpose a K/V page
+    in VMEM (``jnp.swapaxes(k, 0, 1)``), and older jax builds' Mosaic
+    TPU lowering has no rule for a (1, 0, 2) transpose — the chip's jax
+    does.  Exports a minimal Pallas program using exactly that
+    construct; a failure names the ENVIRONMENT gap (the host toolchain
+    cannot lower the real kernels either), so kernel-program exports
+    report ``unsupported`` instead of raising.  Cached — the probe costs
+    seconds; callers that never export kernels never pay it."""
+    try:
+        import jax
+        import jax.export  # noqa: F401 — jax 0.4.x needs the explicit import
+    except ImportError as e:    # pragma: no cover — host jax build
+        return f"jax.export unavailable on this host ({e})"
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = jnp.swapaxes(x_ref[...], 0, 1)
+
+    fn = pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(
+        (8, 2, 128), jnp.float32))
+    try:
+        # jit-entry: aot.canary warmup=1
+        probe = jax.jit(fn)
+        jax.export.export(probe, platforms=["tpu"])(
+            jnp.zeros((2, 8, 128), jnp.float32))
+        return None
+    except Exception as e:  # noqa: BLE001 — any lowering error means the
+        # host toolchain, not the kernel, is what cannot lower
+        return ("jax.export unavailable for the Pallas kernel exports on "
+                "this host: this jax build's Mosaic TPU lowering lacks the "
+                f"kernels' baseline (1,0,2) transpose "
+                f"({type(e).__name__})")
+
+
+@functools.lru_cache(maxsize=None)
+def _register_tree_serialization() -> None:
+    """Register the engine's custom pytree containers with
+    ``jax.export`` (serialize/deserialize walks treedefs): KVCache is a
+    NamedTuple, PagedKVCache a registered dataclass whose only auxdata
+    is its static ``page_size``.  Idempotent (cached); a best-effort
+    no-op on jax builds without the registration API — the export then
+    reports its own ``unsupported`` verdict."""
+    try:
+        import jax.export
+
+        from ...models.model import KVCache
+        from ...models.paged import PagedKVCache
+
+        jax.export.register_namedtuple_serialization(
+            KVCache, serialized_name="reval_tpu.KVCache")
+        # auxdata for a registered dataclass is the meta-field tuple —
+        # here just (page_size,)
+        jax.export.register_pytree_node_serialization(
+            PagedKVCache, serialized_name="reval_tpu.PagedKVCache",
+            serialize_auxdata=lambda aux: json.dumps(
+                [int(v) for v in aux]).encode(),
+            deserialize_auxdata=lambda data: tuple(
+                json.loads(data.decode())))
+    except Exception:   # noqa: BLE001 — registration is an enabler, not
+        # a requirement; the export path reports its own verdict
+        pass
+
+
+def _jax_deserialize(payload: bytes, donate_argnums: tuple = ()):
+    """The default payload codec: a ``jax.export`` serialized module →
+    a callable dispatching the deserialized executable.
+
+    ``donate_argnums`` RE-APPLIES the original jit's buffer donation —
+    serialization does not preserve it, and the engines' commit/decode
+    programs update the paged KV pool in place through exactly that
+    aliasing: without re-donation a warm restart would allocate a fresh
+    copy of the whole pool per call and OOM a flagship-sized config
+    that boots cold just fine (verified: a donated input survives the
+    round trip unless the loader re-declares it)."""
+    import jax
+    import jax.export
+
+    _register_tree_serialization()
+    exported = jax.export.deserialize(bytearray(payload))
+    # jit-entry: aot.exec warmup=8
+    return jax.jit(exported.call,
+                   donate_argnums=tuple(donate_argnums) or None)
+
+
+def _sig_hash(sig_key) -> str:
+    """Stable 16-hex digest of one TrackedJit signature key (leaf shapes
+    and dtypes render as plain tuples/strings; the treedef repr is
+    structural, so two processes tracing the same call agree)."""
+    return hashlib.sha256(repr(sig_key).encode()).hexdigest()[:16]
+
+
+def _entry_slug(entry: str) -> str:
+    return entry.replace(".", "_")
+
+
+class AOTCache:
+    """One persistent executable-cache directory (see module docstring).
+
+    Single-owner like the engines that hold it: one engine drives one
+    cache instance from its own threads' serialized call path (the
+    session driver); the CLI tool reads the directory out-of-band and
+    tolerates concurrent writers through the atomic commit protocol
+    (payload first, meta last)."""
+
+    def __init__(self, cache_dir: str, *, max_mb: int | None = None,
+                 registry=None):
+        self.dir = cache_dir
+        self.max_mb = (max_mb if max_mb is not None
+                       else env_int("REVAL_TPU_AOT_CACHE_MAX_MB", 2048))
+        # zero-arg callable returning the live MetricsRegistry (engines
+        # swap stats wholesale between bench passes, same contract as
+        # TrackedJit), or None for the internal counters only
+        self._registry = registry
+        self._disabled_store = False    # sticky after an unwritable dir
+        #: process-local counter twin of the reval_aot_* metrics — the
+        #: bench ``restart`` block and engine.aot_counters() read these
+        #: (reset-proof against EngineStats swaps)
+        self.hits = 0
+        self.misses = 0
+        self.errors = 0
+        self.unsupported = 0
+        self.compile_s_saved = 0.0
+        # (monotonic stamp, bytes) memo for the <dir>/xla walk — see
+        # _xla_bytes()
+        self._xla_scan = (0.0, 0)
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+        except OSError as exc:
+            self._disabled_store = True
+            self._error("mkdir", str(self.dir), exc)
+        # seed the directory gauges once — a warm boot may never store,
+        # and load hits deliberately skip the (walking) refresh
+        self._touch_gauges()
+
+    def bind_registry(self, registry) -> None:
+        """Point the reval_aot_* counters at an engine's registry (a
+        zero-arg callable returning it) so they ride that engine's
+        ``/metrics``."""
+        self._registry = registry
+        # a warm boot may never store: seed the directory gauges once
+        # here instead of walking the directory per load hit
+        self._touch_gauges()
+
+    def _reg(self):
+        reg = self._registry
+        return reg() if callable(reg) else reg
+
+    def _count(self, metric: str, n: float = 1) -> None:
+        reg = self._reg()
+        if reg is not None:
+            reg.counter(metric).add(n)
+
+    def _error(self, where: str, detail: str, exc=None) -> None:
+        self.errors += 1
+        self._count(obs_metrics.AOT_ERRORS)
+        log_event("aot.cache_error", level="warning", where=where,
+                  detail=detail, exc=exc)
+
+    # -- directory layout ---------------------------------------------------
+    def _base(self, entry: str, sig_key, fp: str) -> str:
+        # the fingerprint is part of the FILE key: two engine configs
+        # with identical call signatures (say xla- and pallas-backed
+        # boots alternating over one shared dir) must coexist as
+        # separate entries — a fp-free key would make each config's
+        # store clobber the other's and every boot of either a cold
+        # compile.  The meta's full-fingerprint check stays as defense
+        # in depth against prefix collisions and hand-moved files.
+        return os.path.join(
+            self.dir,
+            f"{_entry_slug(entry)}-{fp[:16]}-{_sig_hash(sig_key)}")
+
+    def entries(self) -> list[dict]:
+        """Meta rows for every committed entry (a ``.json`` whose
+        payload exists), oldest-touched first — the LRU order GC reaps
+        in.  Unreadable metas surface as ``{"error": ...}`` rows."""
+        rows = []
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.dir, name)
+            row = {"file": name, "path": path}
+            try:
+                with open(path) as f:
+                    meta = json.load(f)
+                if not isinstance(meta, dict):
+                    raise ValueError("meta is not a JSON object")
+                row.update(meta)
+                payload = path[:-5] + ".bin"
+                row["payload_present"] = os.path.exists(payload)
+                row["mtime"] = os.path.getmtime(path)
+            except Exception as exc:    # noqa: BLE001 — an unreadable meta
+                # is a report row, never a crash
+                row["error"] = repr(exc)
+                row.setdefault("mtime", 0.0)
+            rows.append(row)
+        rows.sort(key=lambda r: r.get("mtime", 0.0))
+        return rows
+
+    def _touch_gauges(self, usage: tuple | None = None) -> None:
+        reg = self._reg()
+        if reg is None:
+            return
+        n, total = usage if usage is not None else self._usage()
+        reg.gauge(obs_metrics.AOT_ENTRIES).set(n)
+        reg.gauge(obs_metrics.AOT_BYTES).set(total)
+
+    def _usage(self) -> tuple[int, int]:
+        n = total = 0
+        try:
+            for name in os.listdir(self.dir):
+                path = os.path.join(self.dir, name)
+                if name.endswith(".json"):
+                    n += 1
+                if name.endswith((".json", ".bin")):
+                    try:
+                        total += os.path.getsize(path)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        # jax's own persistent compilation cache lives under <dir>/xla
+        # (cache_from_env points it there): it is part of the directory
+        # the size bound promises to keep sane, so it counts
+        return n, total + self._xla_bytes()
+
+    _XLA_SCAN_TTL_S = 30.0
+
+    def _xla_bytes(self) -> int:
+        """Bytes under ``<dir>/xla``, walked at most once per TTL: jax's
+        cache holds thousands of files for flagship models and a cold
+        boot stores many variants back-to-back — re-walking the tree per
+        store would add exactly the IO this module exists to avoid."""
+        now = time.monotonic()
+        stamp, cached = self._xla_scan
+        if stamp and now - stamp < self._XLA_SCAN_TTL_S:
+            return cached
+        total = 0
+        for root, _dirs, names in os.walk(os.path.join(self.dir, "xla")):
+            for name in names:
+                try:
+                    total += os.path.getsize(os.path.join(root, name))
+                except OSError:
+                    pass
+        self._xla_scan = (now, total)
+        return total
+
+    # -- load / store -------------------------------------------------------
+    def load(self, entry: str, sig_key, fp: str, deserialize=None):
+        """The deserialized executable for one variant, or None (cold,
+        stale, corrupt — every miss shape is counted + logged, never
+        raised).  A hit refreshes the entry's LRU stamp and credits the
+        recorded compile cost to ``reval_aot_compile_seconds_saved``.
+
+        ``deserialize`` is the payload codec (bytes → callable/object);
+        default is the ``jax.export`` module codec the engines store.
+        The mock engine passes its own, so the whole degraded-path state
+        machine is exercised host-only through the real cache."""
+        base = self._base(entry, sig_key, fp)
+        meta_path, payload_path = base + ".json", base + ".bin"
+        if not os.path.exists(meta_path):
+            self._miss(entry, "cold")
+            return None
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if not isinstance(meta, dict) or meta.get("format") != FORMAT:
+                raise ValueError(f"not a {FORMAT} meta")
+            if meta.get("fingerprint") != fp:
+                self._error("fingerprint", f"{entry}: cached fingerprint "
+                            f"{str(meta.get('fingerprint'))[:16]}… does not "
+                            f"match this engine's {fp[:16]}…")
+                self._miss(entry, "fingerprint_mismatch")
+                return None
+            with open(payload_path, "rb") as f:
+                payload = f.read()
+            digest = hashlib.sha256(payload).hexdigest()
+            if digest != meta.get("payload_sha256"):
+                raise ValueError("payload checksum mismatch (truncated or "
+                                 "corrupt write)")
+            fn = (deserialize or _jax_deserialize)(payload)
+        except Exception as exc:    # noqa: BLE001 — every load failure
+            # shape degrades to a fresh compile
+            self._error("load", f"{entry}: {type(exc).__name__}", exc)
+            self._miss(entry, "load_error")
+            return None
+        saved = float(meta.get("compile_s") or 0.0)
+        self.hits += 1
+        self.compile_s_saved += saved
+        self._count(obs_metrics.AOT_HITS)
+        if saved:
+            self._count(obs_metrics.AOT_SAVED_SECONDS, saved)
+        log_event("aot.cache_hit", entry=entry, compile_s_saved=round(saved, 3),
+                  file=os.path.basename(meta_path))
+        try:
+            now = time.time()
+            os.utime(meta_path, (now, now))     # LRU freshness
+        except OSError:
+            pass
+        # no gauge touch here: a hit changes no sizes, and a warm boot
+        # loads many variants back-to-back — bind_registry/gc/store own
+        # the (directory-walking) gauge refresh
+        return fn
+
+    def _miss(self, entry: str, reason: str) -> None:
+        self.misses += 1
+        self._count(obs_metrics.AOT_MISSES)
+        log_event("aot.cache_miss", entry=entry, reason=reason)
+
+    def note_unsupported(self, entry: str, reason: str) -> None:
+        """This jax build cannot export ``entry``'s program (Mosaic
+        canary failed, ``jax.export`` absent, or the export itself
+        raised) — counted and logged ONCE per entry by the wrapper,
+        never raised into the serving path."""
+        self.unsupported += 1
+        self._count(obs_metrics.AOT_UNSUPPORTED)
+        log_event("aot.unsupported", level="warning", entry=entry,
+                  reason=reason[:300])
+
+    def store(self, entry: str, sig_key, fp: str, payload: bytes,
+              compile_s: float, signature_repr: str = "") -> bool:
+        """Commit one serialized executable: payload first, meta last
+        (the loader requires the meta, so a torn write is invisible),
+        both atomic tmp+rename.  An unwritable directory disables
+        further stores for this process (counted + logged once)."""
+        if self._disabled_store:
+            return False
+        base = self._base(entry, sig_key, fp)
+        meta = {"format": FORMAT, "entry": entry,
+                "fingerprint": fp,
+                "signature": signature_repr[:2000],
+                "payload_sha256": hashlib.sha256(payload).hexdigest(),
+                "payload_bytes": len(payload),
+                "compile_s": round(float(compile_s), 3),
+                "created_ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+        try:
+            with open(base + ".bin.tmp", "wb") as f:
+                f.write(payload)
+            os.replace(base + ".bin.tmp", base + ".bin")
+            with open(base + ".json.tmp", "w") as f:
+                json.dump(meta, f)
+            os.replace(base + ".json.tmp", base + ".json")
+        except OSError as exc:
+            self._disabled_store = True
+            self._error("store", f"{entry}: cache dir unwritable — "
+                        f"disabling stores for this process", exc)
+            return False
+        self.gc()
+        return True
+
+    # -- GC -----------------------------------------------------------------
+    def gc(self, max_mb: int | None = None) -> int:
+        """Evict least-recently-touched entries until the directory fits
+        the size bound.  Returns entries evicted."""
+        bound = (max_mb if max_mb is not None else self.max_mb) * _MB
+        evicted = 0
+        if max_mb is not None:
+            # an explicit bound (CLI / tests) expects a FRESH directory
+            # view, not the store path's TTL-memoised xla size
+            self._xla_scan = (0.0, 0)
+        # orphan payloads (a crash inside the payload-first commit
+        # window leaves a .bin whose meta never landed) and stale .tmp
+        # files count against the bound but are invisible to entries()
+        # — left alone, one orphan past the bound would make every
+        # store evict the whole live cache and still never fit.  Reap
+        # them first; the grace period keeps a concurrent writer's
+        # just-renamed payload safe until its meta commits.
+        orphans = 0
+        now = time.time()
+        try:
+            names = list(os.listdir(self.dir))
+        except OSError:
+            names = []
+        for name in names:
+            path = os.path.join(self.dir, name)
+            stale = name.endswith((".bin.tmp", ".json.tmp")) or (
+                name.endswith(".bin")
+                and not os.path.exists(path[:-4] + ".json"))
+            if not stale:
+                continue
+            try:
+                if now - os.path.getmtime(path) > _ORPHAN_GRACE_S:
+                    os.remove(path)
+                    orphans += 1
+            except OSError:
+                pass
+        n, total = self._usage()
+        # reap jax's xla compilation-cache files (oldest first) BEFORE
+        # touching AOT entries: a backend re-compile of a deserialized
+        # module is far cheaper than re-paying the trace+lower an
+        # evicted entry represents
+        xla_reaped = 0
+        if total > bound:
+            xla_files = []
+            for root, _dirs, names in os.walk(
+                    os.path.join(self.dir, "xla")):
+                for name in names:
+                    path = os.path.join(root, name)
+                    try:
+                        xla_files.append((os.path.getmtime(path),
+                                          os.path.getsize(path), path))
+                    except OSError:
+                        pass
+            xla_files.sort()
+            xla_left = sum(size for _m, size, _p in xla_files)
+            for _mtime, size, path in xla_files:
+                if total <= bound:
+                    break
+                try:
+                    os.remove(path)
+                except OSError:
+                    continue
+                total -= size
+                xla_left -= size
+                xla_reaped += 1
+            self._xla_scan = (time.monotonic(), max(0, xla_left))
+        if total > bound:
+            # only now pay the meta-parsing entries() pass — the common
+            # under-bound store skips it entirely
+            for row in self.entries():
+                if total <= bound:
+                    break
+                meta_path = row["path"]
+                payload_path = meta_path[:-5] + ".bin"
+                freed = 0
+                for path in (meta_path, payload_path):
+                    try:
+                        freed += os.path.getsize(path)
+                        os.remove(path)
+                    except OSError:
+                        pass
+                total -= freed
+                evicted += 1
+        if evicted or orphans or xla_reaped:
+            log_event("aot.gc", evicted=evicted, orphans=orphans,
+                      xla_files=xla_reaped,
+                      bound_mb=bound // _MB, bytes_now=max(0, total))
+        self._touch_gauges((n - evicted, max(0, total)))
+        return evicted
+
+    # -- introspection -------------------------------------------------------
+    def verify_entry(self, row: dict, deep: bool = False) -> str | None:
+        """Integrity verdict for one :meth:`entries` row: None = ok,
+        else the problem.  ``deep`` also round-trips the payload through
+        ``jax.export.deserialize``."""
+        if row.get("error"):
+            return f"unreadable meta: {row['error']}"
+        if row.get("format") != FORMAT:
+            return f"wrong format {row.get('format')!r}"
+        payload_path = row["path"][:-5] + ".bin"
+        if not row.get("payload_present"):
+            return "payload missing"
+        try:
+            with open(payload_path, "rb") as f:
+                payload = f.read()
+        except OSError as exc:
+            return f"payload unreadable: {exc}"
+        if hashlib.sha256(payload).hexdigest() != row.get("payload_sha256"):
+            return "payload checksum mismatch"
+        if deep:
+            try:
+                import jax.export
+
+                # same treedef registrations as the load path — without
+                # them a fresh CLI process reads every KVCache-carrying
+                # payload as broken
+                _register_tree_serialization()
+                jax.export.deserialize(bytearray(payload))
+            except Exception as exc:    # noqa: BLE001 — the verdict IS
+                # the point of a deep verify
+                return f"payload does not deserialize: {type(exc).__name__}"
+        return None
+
+    def counters(self) -> dict:
+        """The bench ``restart`` block / ``engine.aot_counters()`` row."""
+        n, total = self._usage()
+        return {"hits": self.hits, "misses": self.misses,
+                "errors": self.errors, "unsupported": self.unsupported,
+                "compile_s_saved": round(self.compile_s_saved, 3),
+                "entries": n, "bytes": total, "dir": self.dir}
+
+
+class AotJit:
+    """AOT-cache wrapper around one :class:`TrackedJit` entry.
+
+    Call path: run the tracker's variant accounting (``note_call`` — the
+    ``reval_jit_*`` counters and the jitcheck sanitizer see exactly the
+    calls they would without the cache), then:
+
+    - variant already loaded → dispatch to the deserialized executable;
+    - variant on disk → deserialize once, count a hit, dispatch;
+    - cold/stale/corrupt → compile fresh through the underlying jit
+      (timed), then export + store the serialized module for the next
+      process.  An export failure marks the entry ``unsupported`` (once)
+      and the wrapper degrades to a plain TrackedJit.
+
+    ``static`` names the entry's static argnames: their values are baked
+    into each exported variant, so dispatch to a loaded executable
+    strips them from the call.
+
+    ``canary`` is an optional zero-arg capability probe returning a skip
+    reason (or None): engines whose programs embed Pallas kernels pass
+    :func:`kernel_export_skip`, so a jax build whose Mosaic lowering
+    cannot export the kernels reports ``unsupported`` up front — cheap,
+    with the environment gap named — instead of paying a doomed export
+    per variant.  The degraded entry serves through the plain TrackedJit
+    exactly as if the cache were off.
+    """
+
+    def __init__(self, tracked, cache: AOTCache, context: dict,
+                 static: tuple = (), canary=None, donate: tuple = ()):
+        self._tracked = tracked
+        self._cache = cache
+        self._static = tuple(static)
+        self._canary = canary
+        #: positional indices (at THIS wrapper's call site) whose buffers
+        #: the original jit donates — re-applied to the deserialized
+        #: executable, because serialization drops donation and the
+        #: engines' in-place KV-pool updates depend on it
+        self._donate = tuple(donate)
+        self._fp = fingerprint(runtime_context(**context))
+        self._loaded: dict = {}         # sig key -> deserialized callable
+        self._probed: set = set()       # sig keys already checked on disk
+        self._unsupported = False
+        #: fresh XLA compiles this process actually paid for this entry —
+        #: the drill's "zero compilations of already-cached entries"
+        self.fresh_compiles = 0
+
+    # the tracker surface jit_counters()/tests read, unchanged
+    @property
+    def name(self) -> str:
+        return self._tracked.name
+
+    @property
+    def warmup(self):
+        return self._tracked.warmup
+
+    @property
+    def variants(self) -> int:
+        return self._tracked.variants
+
+    @property
+    def misses(self) -> int:
+        return self._tracked.misses
+
+    def _strip_static(self, kwargs: dict) -> dict:
+        if not self._static:
+            return kwargs
+        return {k: v for k, v in kwargs.items() if k not in self._static}
+
+    def __call__(self, *args, **kwargs):
+        key = self._tracked.note_call(args, kwargs)
+        fn = self._loaded.get(key)
+        if fn is not None:
+            return fn(*args, **self._strip_static(kwargs))
+        if self._unsupported or key in self._probed:
+            return self._tracked._fn(*args, **kwargs)
+        self._probed.add(key)
+        fn = self._cache.load(
+            self.name, key, self._fp,
+            deserialize=lambda payload: _jax_deserialize(
+                payload, donate_argnums=self._donate))
+        if fn is not None:
+            self._loaded[key] = fn
+            return fn(*args, **self._strip_static(kwargs))
+        # fresh compile (the first call traces + lowers + runs; its wall
+        # is the upper bound of what the next boot's hit will save)
+        t0 = time.perf_counter()
+        out = self._tracked._fn(*args, **kwargs)
+        compile_s = time.perf_counter() - t0
+        self.fresh_compiles += 1
+        self._export_store(key, args, kwargs, compile_s)
+        return out
+
+    def _export_store(self, key, args, kwargs, compile_s: float) -> None:
+        if self._cache._disabled_store:
+            # the dir already proved unwritable (sticky): skip the
+            # export — jax.export on a real program costs compile-scale
+            # seconds, and store() would drop the bytes anyway
+            return
+        if self._canary is not None:
+            reason = self._canary()
+            if reason is not None:
+                # the environment, not this entry, cannot export: report
+                # unsupported (counted + logged once) and degrade to the
+                # plain TrackedJit — never raise into the serving path
+                self._unsupported = True
+                self._cache.note_unsupported(self.name, reason)
+                return
+        try:
+            import jax.export
+
+            _register_tree_serialization()
+            exported = jax.export.export(self._tracked._fn)(*args, **kwargs)
+            payload = bytes(exported.serialize())
+        except Exception as exc:    # noqa: BLE001 — a program this jax
+            # build cannot export (Mosaic gap, unsupported primitive) is
+            # an environment verdict, not a serving fault
+            if not self._unsupported:
+                self._unsupported = True
+                self._cache.note_unsupported(
+                    self.name, f"{type(exc).__name__}: {exc}")
+            return
+        self._cache.store(self.name, key, self._fp, payload, compile_s,
+                          signature_repr=repr(key))
+
+    def __getattr__(self, item):
+        return getattr(self._tracked, item)
+
+
+def cache_from_env(registry=None) -> AOTCache | None:
+    """The process's AOT cache per ``REVAL_TPU_AOT_CACHE_DIR`` (empty/
+    unset disables), with jax's own persistent compilation cache pointed
+    at ``<dir>/xla`` so the backend compile of a deserialized module is
+    cached across processes too."""
+    cache_dir = env_str("REVAL_TPU_AOT_CACHE_DIR", "") or ""
+    if not cache_dir:
+        return None
+    _enable_jax_persistent_cache(os.path.join(cache_dir, "xla"))
+    return AOTCache(cache_dir, registry=registry)
+
+
+@functools.lru_cache(maxsize=None)
+def _enable_jax_persistent_cache(xla_dir: str) -> None:
+    try:
+        os.makedirs(xla_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:    # noqa: BLE001 — jax's own cache is a
+        # bonus layer; its absence must not disable the AOT cache
+        log_event("aot.cache_error", level="warning", where="xla_cache",
+                  detail="could not enable jax persistent compilation "
+                         "cache", exc=exc)

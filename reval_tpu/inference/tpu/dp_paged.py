@@ -121,6 +121,29 @@ class DataParallelPagedEngine:
                 out["entries"][name] = out["entries"].get(name, 0) + n
         return out
 
+    def aot_counters(self) -> dict:
+        """AOT-cache snapshot merged over replicas (same shape as
+        :meth:`PagedTPUEngine.aot_counters`).  Per-process work counters
+        (hits/misses/errors/compile seconds) sum; ``entries``/``bytes``
+        describe the ONE shared directory every replica's cache instance
+        sits on, so they take the max — summing would report the
+        directory dp× too large and mis-size REVAL_TPU_AOT_CACHE_MAX_MB
+        tuning."""
+        rows = [rep.aot_counters() for rep in self.replicas]
+        if not any(r.get("enabled") for r in rows):
+            return {"enabled": False}
+        out: dict = {"enabled": True}
+        for row in rows:
+            for k, v in row.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    if k in ("entries", "bytes"):
+                        out[k] = max(out.get(k, 0), v)
+                    else:
+                        out[k] = out.get(k, 0) + v
+                elif k != "enabled":
+                    out.setdefault(k, v)
+        return out
+
     def prefix_cache_counters(self) -> dict:
         """Prefix-cache gauge snapshot summed over replicas (counters ride
         the aggregated ``stats``)."""

@@ -197,6 +197,39 @@ def finalize_ids(tokenizer, ids: list[int]) -> list[int]:
     return list(ids)
 
 
+#: bound on the per-template affinity-stat dict (warm snapshots carry
+#: it whole, so it must not grow with workload diversity)
+TEMPLATE_STATS_CAP = 4096
+
+
+def bump_template_stats(stats: dict, tag: int, n: int = 1) -> None:
+    """Bounded bump of the per-template affinity counters (the template
+    mix warm snapshots and the placement view report): past the cap the
+    lightest half folds away — heavy templates ARE the signal, and a
+    high-diversity workload (every distinct first prompt page is a new
+    tag) would otherwise grow the dict, and every drain's snapshot, for
+    the life of the replica."""
+    stats[tag] = stats.get(tag, 0) + n
+    if len(stats) > TEMPLATE_STATS_CAP:
+        keep = sorted(stats.items(), key=lambda kv: kv[1],
+                      reverse=True)[:TEMPLATE_STATS_CAP // 2]
+        stats.clear()
+        stats.update(keep)
+
+
+def restore_template_stats(stats: dict, mapping) -> None:
+    """Merge one snapshot's ``template_stats`` document into the live
+    dict (bounded, via :func:`bump_template_stats`).  Keys AND counts
+    both came off disk: either failing to parse skips just that row — a
+    corrupt stat must never abort a restore whose chains already
+    replayed."""
+    for key, count in (mapping or {}).items():
+        try:
+            bump_template_stats(stats, int(key), int(count))
+        except (TypeError, ValueError):
+            continue
+
+
 #: (attribute, metric name, python type) — the EngineStats counter set.
 #: Attribute access keeps the historical dataclass field names (every
 #: caller, test, and JSON surface reads ``stats.prompts`` etc.); the

@@ -36,6 +36,7 @@ parallelism for paged decode is one engine replica per host/dp-group
 from __future__ import annotations
 
 import time
+import zlib
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -64,10 +65,12 @@ from ...runtime import PagedRuntime
 from .engine import (
     EngineStats,
     StopScanner,
+    bump_template_stats,
     finalize_ids,
     finalize_text,
     pow2_bucket,
     profile_trace,
+    restore_template_stats,
 )
 from .prefix_cache import RadixPrefixCache
 from .sampling import filter_logits, sample_token_rows
@@ -314,6 +317,56 @@ class PagedTPUEngine:
         self._jit_patch = tracked_jit(
             "paged.patch_tables", jax.jit(patch_state_tables),
             registry=reg, warmup=16)
+        #: per-template request counts: crc32 of the first prompt PAGE's
+        #: token ids — the token-space analog of the router's char-window
+        #: affinity key (same intent, DIFFERENT domain: the two hashes
+        #: are not joinable).  Rides the warm-state snapshot so a
+        #: restarted replica still reports its template mix
+        #: (single-owner, like the runtime: one driver thread mutates it)
+        self._template_stats: dict[int, int] = {}
+        # persistent AOT executable cache (aot_cache.py): when
+        # REVAL_TPU_AOT_CACHE_DIR is set, every tracked jit variant this
+        # engine compiles is serialized to disk and the next process
+        # boot dispatches the deserialized executable instead of paying
+        # the trace+lower again.  Off (None) → the trackers above serve
+        # calls exactly as before.
+        from .aot_cache import AotJit, cache_from_env, kernel_export_skip
+
+        self._aot_cache = cache_from_env(registry=reg)
+        if self._aot_cache is not None:
+            from ...ops.pallas_attention import (resolved_kernel_knobs,
+                                                resolved_paged_backend)
+
+            kernel_backend = resolved_paged_backend()
+            ctx = {"engine": "paged", "model": str(cfg),
+                   "weights_dtype": str(dtype), "kv_dtype": kv_dtype or "bf16",
+                   "page_size": page_size, "max_slots": max_slots,
+                   "max_seq_len": max_seq_len,
+                   "mesh": str(mesh) if mesh is not None else "none",
+                   "platform": jax.default_backend(),
+                   "kernel_backend": kernel_backend,
+                   # trace-time kernel knobs (dot formulation, interpret
+                   # mode): same backend label, different traced program
+                   **resolved_kernel_knobs()}
+            # the decode chunk embeds the paged-attention kernel: on a
+            # pallas backend its export needs Mosaic lowering support —
+            # the canary names the environment gap (unsupported, counted)
+            # instead of raising a doomed export per variant
+            chunk_canary = (kernel_export_skip
+                            if kernel_backend != "xla" else None)
+            # donate= re-applies the original jits' buffer donation to
+            # deserialized executables (serialization drops it; the
+            # commit/chunk programs update the KV pool in place through
+            # that aliasing — positional index at the call site)
+            self._jit_prefill = AotJit(self._jit_prefill, self._aot_cache, ctx)
+            self._jit_prefill_pctx = AotJit(self._jit_prefill_pctx,
+                                            self._aot_cache, ctx)
+            self._jit_commit = AotJit(self._jit_commit, self._aot_cache, ctx,
+                                      donate=(0,))
+            self._jit_chunk = AotJit(self._jit_chunk, self._aot_cache, ctx,
+                                     static=("steps", "filtered"),
+                                     canary=chunk_canary, donate=(2,))
+            self._jit_patch = AotJit(self._jit_patch, self._aot_cache, ctx)
         self._jit_trackers = (self._jit_prefill, self._jit_prefill_pctx,
                               self._jit_commit, self._jit_chunk,
                               self._jit_patch)
@@ -550,6 +603,13 @@ class PagedTPUEngine:
         against the node's refcounted pages.  Returns ``(seq_id, node)``;
         the node is pinned until :meth:`release_request`.
         """
+        # per-template accounting: crc32 of the first prompt page's
+        # token ids (token-space analog of the router's affinity key,
+        # not the same hash) — the warm-state snapshot carries the
+        # replica's template mix across a restart
+        tag = zlib.crc32(np.asarray(ids[:self.page_size],
+                                    np.int32).tobytes())
+        bump_template_stats(self._template_stats, tag)
         node = None
         if self.prefix_cache is not None:
             node, new_from = self.prefix_cache.acquire(ids)
@@ -651,6 +711,92 @@ class PagedTPUEngine:
         return {"compiles": sum(t.variants for t in self._jit_trackers),
                 "cache_misses": sum(t.misses for t in self._jit_trackers),
                 "entries": {t.name: t.variants for t in self._jit_trackers}}
+
+    def aot_counters(self) -> dict:
+        """AOT executable-cache snapshot — the bench ``restart`` block
+        and the drill's "zero compilations of already-cached entries"
+        assertion.  ``fresh_compiles`` counts the XLA compiles THIS
+        process actually paid across the wrapped entries (0 on a fully
+        warm restart)."""
+        if self._aot_cache is None:
+            return {"enabled": False}
+        return {"enabled": True,
+                "fresh_compiles": sum(
+                    getattr(t, "fresh_compiles", 0)
+                    for t in self._jit_trackers),
+                **self._aot_cache.counters()}
+
+    # -- warm-restart state (serving/snapshot.py rides these) --------------
+    def warm_state(self) -> dict:
+        """The engine half of a warm-state snapshot: every cached
+        prefix chain as its full token list (leaf-to-root concatenated
+        page keys — what a restarted engine must replay through prefill)
+        plus the per-template affinity stats the fleet router's
+        placement view keys on."""
+        chains: list[list[int]] = []
+        if self.prefix_cache is not None:
+            stack = [(n, []) for n in self.prefix_cache.children.values()]
+            while stack:
+                node, prefix = stack.pop()
+                chain = prefix + list(node.key)
+                if node.children:
+                    stack.extend((c, chain)
+                                 for c in node.children.values())
+                else:
+                    chains.append(chain)
+        return {"prefix_chains": chains,
+                "template_stats": {str(k): v
+                                   for k, v in self._template_stats.items()}}
+
+    def rewarm(self, state: dict) -> int:
+        """Replay a snapshot's prefix chains through REAL prefill so the
+        radix cache (and its committed KV pages) is warm before
+        ``/readyz`` flips.  Single-owner: run from the thread that owns
+        the engine (the session driver does, before its drive loop).
+        Each chain degrades independently — a chain the pool cannot hold
+        (or that fails mid-prefill) is skipped, never fatal.  Returns
+        chains replayed."""
+        warmed = 0
+        for chain in state.get("prefix_chains") or []:
+            if (not isinstance(chain, list) or not chain
+                    or len(chain) % self.page_size
+                    or self.prefix_cache is None):
+                continue
+            try:
+                # one token past the final page so acquire() covers every
+                # page of the chain (its cap is (len-1) // page_size)
+                ids = [int(t) for t in chain] + [self.tokenizer.pad_id]
+                node, new_from = self.prefix_cache.acquire(ids)
+                if node is None:
+                    continue
+                if new_from < node.tok_len:
+                    try:
+                        self._prefill_prefix_pages(ids, node, new_from)
+                    except Exception:
+                        # same rollback as submit_request: the new nodes
+                        # hold uncommitted (garbage) KV — left alive they
+                        # would serve a later rider silently wrong, and
+                        # the pin (which rode down to the dropped tail)
+                        # would keep the chain unevictable forever
+                        self.stats.prefix_hit_tokens -= new_from
+                        self.prefix_cache.drop_tail(node, new_from)
+                        raise
+                self.prefix_cache.unpin(node)
+                warmed += 1
+            except Exception:   # noqa: BLE001 — a cold chain beats a
+                # wedged boot; the remaining chains still replay
+                continue
+            finally:
+                # stamp PER CHAIN: an on-chip replay can compile per
+                # prefill bucket (minutes), and a submission arriving
+                # mid-warmup makes the session busy — a stale heartbeat
+                # would trip the sticky watchdog and wedge the very boot
+                # this replay exists to speed up
+                self.heartbeat = time.monotonic()
+        self.heartbeat = time.monotonic()
+        restore_template_stats(self._template_stats,
+                               state.get("template_stats"))
+        return warmed
 
     def new_drive_state(self) -> _DriveState:
         return _DriveState(active={},

@@ -64,6 +64,18 @@ EVENTS: dict[str, str] = {
     # jit-discipline tracker (analysis/jitcheck.py)
     "jit.recompile": "a tracked jit entry compiled a new variant past "
                      "its declared warmup budget",
+    # persistent AOT executable cache (inference/tpu/aot_cache.py)
+    "aot.cache_hit": "a tracked jit variant loaded from the persistent "
+                     "AOT cache (compile skipped)",
+    "aot.cache_miss": "a tracked jit variant compiled fresh (cold, "
+                      "stale, or mismatched cache entry)",
+    "aot.cache_error": "an AOT cache entry failed to load or store "
+                       "(corrupt/mismatched/unwritable); degraded to a "
+                       "fresh compile",
+    "aot.unsupported": "AOT serialize/export declined: this jax build "
+                       "cannot export the program (Mosaic canary/"
+                       "jax.export)",
+    "aot.gc": "the AOT cache evicted LRU entries past its size bound",
     # serving session (serving/session.py)
     "session.watchdog_trip": "no engine progress past watchdog_s; "
                              "pending submissions failed typed",
@@ -73,6 +85,18 @@ EVENTS: dict[str, str] = {
     "session.deadline_storm": "several deadlines expired in one sweep",
     "session.drain_stuck": "the driver did not exit within the close timeout",
     "session.postmortem": "a postmortem bundle was written (or failed)",
+    "session.snapshot_written": "a warm-state snapshot was written at drain",
+    "session.snapshot_restored": "a warm-state snapshot was replayed "
+                                 "through prefill at boot",
+    "session.snapshot_error": "a warm-state snapshot could not be "
+                              "written or read (corrupt/unwritable); "
+                              "the engine boots cold",
+    # crash-loop supervisor (serving/supervisor.py)
+    "supervisor.spawn": "the supervisor (re)spawned the child server",
+    "supervisor.death": "the supervised child server died; a postmortem "
+                        "bundle was written",
+    "supervisor.sticky_failed": "the rapid-death budget was spent; the "
+                                "supervisor stopped respawning",
     # HTTP server (serving/server.py)
     "server.request_error": "a completions request failed server-side",
     "server.drained": "graceful drain finished; lifecycle counters attached",

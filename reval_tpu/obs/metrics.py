@@ -49,6 +49,10 @@ __all__ = [
     "JIT_COMPILES", "JIT_CACHE_MISSES",
     "DET_CELLS", "DET_AGREE", "DET_DIVERGED", "DET_SKIPPED",
     "DET_DEPTH", "DET_DRIFT", "DRIFT_BUCKETS",
+    "AOT_HITS", "AOT_MISSES", "AOT_ERRORS", "AOT_UNSUPPORTED",
+    "AOT_SAVED_SECONDS", "AOT_ENTRIES", "AOT_BYTES",
+    "RESTART_TO_READY", "RESTART_WARM_PREFIXES",
+    "RESTART_DEATHS", "RESTART_RESPAWNS",
 ]
 
 # Log-spaced seconds buckets spanning sub-ms host paths (mock engine,
@@ -91,6 +95,17 @@ ROUTER_SHEDS = "reval_router_sheds_total"
 ROUTER_REPLICAS_READY = "reval_router_replicas_ready"
 JIT_COMPILES = "reval_jit_compiles_total"
 JIT_CACHE_MISSES = "reval_jit_cache_misses_total"
+AOT_HITS = "reval_aot_cache_hits_total"
+AOT_MISSES = "reval_aot_cache_misses_total"
+AOT_ERRORS = "reval_aot_cache_errors_total"
+AOT_UNSUPPORTED = "reval_aot_unsupported_total"
+AOT_SAVED_SECONDS = "reval_aot_compile_seconds_saved_total"
+AOT_ENTRIES = "reval_aot_cache_entries"
+AOT_BYTES = "reval_aot_cache_bytes"
+RESTART_TO_READY = "reval_restart_to_ready_seconds"
+RESTART_WARM_PREFIXES = "reval_restart_warm_prefixes_total"
+RESTART_DEATHS = "reval_restart_deaths_total"
+RESTART_RESPAWNS = "reval_restart_respawns_total"
 DET_CELLS = "reval_determinism_cells_total"
 DET_AGREE = "reval_determinism_cells_agree_total"
 DET_DIVERGED = "reval_determinism_cells_diverged_total"
@@ -207,6 +222,56 @@ METRICS: dict[str, dict] = {
                                "entry's declared warmup budget "
                                "(post-warmup recompiles; each also "
                                "logs jit.recompile)"},
+    # persistent AOT executable cache (inference/tpu/aot_cache.py) —
+    # warm restarts skip XLA compilation when a fingerprint-keyed
+    # serialized executable already exists on disk
+    AOT_HITS: {"type": "counter",
+               "help": "Tracked jit variants loaded from the persistent "
+                       "AOT executable cache (no XLA compile paid)"},
+    AOT_MISSES: {"type": "counter",
+                 "help": "Tracked jit variants compiled fresh and "
+                         "serialized into the AOT cache (cold entry, "
+                         "corrupt/stale payload, or fingerprint miss)"},
+    AOT_ERRORS: {"type": "counter",
+                 "help": "AOT cache entries that failed to load or "
+                         "store (corrupt payload, checksum/fingerprint "
+                         "mismatch, unwritable dir) — each degrades to "
+                         "a fresh compile, never a crash"},
+    AOT_UNSUPPORTED: {"type": "counter",
+                      "help": "AOT serialize/export requests declined "
+                              "because this host's jax build cannot "
+                              "export the program (Mosaic kernel canary "
+                              "failed or jax.export absent)"},
+    AOT_SAVED_SECONDS: {"type": "counter",
+                        "help": "Compile wall seconds skipped by AOT "
+                                "cache hits (the stored entry's "
+                                "measured compile cost)"},
+    AOT_ENTRIES: {"type": "gauge",
+                  "help": "Entries currently in the AOT cache directory "
+                          "(last touch, this process's view)"},
+    AOT_BYTES: {"type": "gauge",
+                "help": "Total payload bytes in the AOT cache directory "
+                        "(last touch, this process's view)"},
+    # warm restarts (serving/session.py + serving/supervisor.py)
+    RESTART_TO_READY: {"type": "histogram", "buckets": LATENCY_BUCKETS,
+                       "help": "Session boot to /readyz-ready wall "
+                               "seconds, observed when a warm restore "
+                               "finishes (the restart SLO)"},
+    RESTART_WARM_PREFIXES: {"type": "counter",
+                            "help": "Prefix chains replayed through "
+                                    "prefill from a warm-state snapshot "
+                                    "at boot"},
+    RESTART_DEATHS: {"type": "counter",
+                     "help": "Child server deaths observed by the "
+                             "crash-loop supervisor (supervisor-process "
+                             "registry: rides its postmortem bundles "
+                             "and logs, not the child's /metrics)"},
+    RESTART_RESPAWNS: {"type": "counter",
+                       "help": "Child servers (re)spawned by the "
+                               "crash-loop supervisor (supervisor-"
+                               "process registry: rides its postmortem "
+                               "bundles and logs, not the child's "
+                               "/metrics)"},
     # determinism observatory (obs/determinism.py) — one matrix run
     # increments the counters once per cell; the snapshot rides the
     # determinism-<ts>.json artifact and merges into any registry

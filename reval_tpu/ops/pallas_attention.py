@@ -60,6 +60,7 @@ __all__ = [
     "paged_decode_attention_xla",
     "paged_decode_attention_pallas",
     "paged_decode_attention_pallas_seq",
+    "resolved_paged_backend",
 ]
 
 _NEG_INF = -1e30
@@ -662,3 +663,39 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     return fn(q, k_pages, v_pages, block_tables, seq_lens,
               page_size=page_size, scale=scale, window=window,
               softcap=softcap, k_scales=k_scales, v_scales=v_scales, **kw)
+
+
+def resolved_paged_backend() -> str:
+    """The decode-attention backend :func:`paged_decode_attention` will
+    actually trace right now — env override, else the persisted autotune
+    pick, else pallas-on-TPU/xla-elsewhere.  The AOT executable cache
+    keys its fingerprint on this (and only arms the Mosaic export canary
+    for pallas programs — an xla-resolved chunk exports anywhere)."""
+    from ..env import env_str
+
+    choice = (env_str("REVAL_TPU_PAGED_BACKEND")
+              or _autotune_defaults().get("REVAL_TPU_PAGED_BACKEND"))
+    if choice in ("pallas", "pallas_seq", "xla"):
+        return choice
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def resolved_kernel_knobs() -> dict:
+    """The trace-time kernel knobs that bind per compiled program beyond
+    the backend label — dot formulation (``REVAL_TPU_KERNEL_DOT`` or the
+    autotune pick) and interpret mode (``REVAL_TPU_FORCE_MOSAIC`` ×
+    platform).  The AOT executable cache folds these into its
+    fingerprint: under one backend label they change the traced program,
+    so a warm restart must not serve an executable traced under
+    different knobs.  The xla formulation reads neither — stable
+    constants, so xla-resolved programs cache across knob changes."""
+    from ..env import env_str
+
+    if resolved_paged_backend() == "xla":
+        return {"dot_mode": "n/a", "interpret": "n/a"}
+    force = (env_str("REVAL_TPU_FORCE_MOSAIC") or "").lower()
+    return {"dot_mode": (env_str("REVAL_TPU_KERNEL_DOT")
+                         or _autotune_defaults().get("REVAL_TPU_KERNEL_DOT")
+                         or "swap"),
+            "interpret": (jax.default_backend() != "tpu"
+                          and force not in ("1", "true"))}

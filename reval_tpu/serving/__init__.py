@@ -28,8 +28,10 @@ from .mock_engine import MockStepEngine
 from .router import FleetRouter
 from .server import EngineServer, serve_config, warmup_engine
 from .session import ContinuousSession, MultiSession
+from .supervisor import Supervisor
 
 __all__ = ["EngineServer", "serve_config", "warmup_engine",
            "ContinuousSession", "MultiSession", "MockStepEngine",
-           "FleetRouter", "ServingError", "Overloaded", "Draining",
-           "EngineWedged", "DeadlineExceeded", "FleetUnavailable"]
+           "FleetRouter", "Supervisor", "ServingError", "Overloaded",
+           "Draining", "EngineWedged", "DeadlineExceeded",
+           "FleetUnavailable"]

@@ -19,10 +19,21 @@ PROMPT (a crc32 tag over its token ids) instead of one fixed string —
 the knob the fleet-router chaos drill turns so "bit-identical greedy
 outputs regardless of which replica answered" is a real assertion, not
 a tautology over identical constants.
+
+Warm restarts ride the mock too (the rolling-restart drill is
+host-only): with ``REVAL_TPU_AOT_CACHE_DIR`` set, boot loads its two
+simulated programs ("mock.prefill", "mock.decode_chunk") through the
+REAL :class:`~reval_tpu.inference.tpu.aot_cache.AOTCache` — a cold
+boot "compiles" (counted in ``fresh_compiles``) and stores; a warm
+restart loads both (cache hits, zero fresh compiles) — and
+``warm_state()`` / ``rewarm()`` give the session's snapshot/restore
+path a host-only engine to drive (``rewarm_s`` paces the replay so the
+``warming`` readiness state is observable in tests).
 """
 
 from __future__ import annotations
 
+import json
 import time
 import zlib
 from types import SimpleNamespace
@@ -40,7 +51,8 @@ class MockStepEngine:
 
     def __init__(self, response: str = "mock_model_gen", step_s: float = 0.0,
                  tokens_per_step: int = 16, max_slots: int = 8,
-                 max_seq_len: int = 8192, echo: bool = False):
+                 max_seq_len: int = 8192, echo: bool = False,
+                 rewarm_s: float = 0.0):
         from ..inference.tpu.engine import EngineStats
         from ..inference.tpu.tokenizer import ByteTokenizer
 
@@ -49,6 +61,7 @@ class MockStepEngine:
         self.response = response
         self.echo = bool(echo)
         self.step_s = float(step_s)
+        self.rewarm_s = float(rewarm_s)
         self.tokens_per_step = int(tokens_per_step)
         self.max_slots = int(max_slots)
         self.max_pages_per_seq = max(1, int(max_seq_len) // self.page_size)
@@ -62,6 +75,93 @@ class MockStepEngine:
         #: same per-step ring the paged engine feeds — serve --mock
         #: exercises the flight-recorder/postmortem path host-only
         self.flightrec = FlightRecorder()
+        #: warm-state the snapshot carries: page-aligned prompt prefixes
+        #: seen (the mock's stand-in for the radix tree) + per-template
+        #: tags (crc32 of the first prompt page's token ids — the same
+        #: token-space key the paged engine keeps; NOT the router's
+        #: char-window hash)
+        self._warm_chains: list[list[int]] = []
+        self._template_stats: dict[int, int] = {}
+        self._boot_aot()
+
+    # -- warm restarts ------------------------------------------------------
+    def _boot_aot(self) -> None:
+        """Boot the two simulated programs through the REAL AOT cache
+        (when ``REVAL_TPU_AOT_CACHE_DIR`` is set): a variant on disk is
+        a hit (no "compile" paid); a cold/corrupt/mismatched one is
+        counted+logged by the cache and "compiled" fresh (stored for
+        the next boot).  ``fresh_compiles`` is the drill's "zero
+        compilations of already-cached entries" observable."""
+        from ..inference.tpu.aot_cache import (cache_from_env, fingerprint,
+                                               runtime_context)
+
+        self.fresh_compiles = 0
+        self._aot_cache = cache_from_env(
+            registry=lambda: self.stats.registry)
+        if self._aot_cache is None:
+            return
+        fp = fingerprint(runtime_context(
+            engine="mock", response=self.response,
+            tokens_per_step=self.tokens_per_step,
+            max_slots=self.max_slots))
+        for entry in ("mock.prefill", "mock.decode_chunk"):
+            sig = (entry, ("tokens_per_step", self.tokens_per_step))
+            fn = self._aot_cache.load(entry, sig, fp,
+                                      deserialize=self._mock_codec)
+            if fn is None:
+                # the mock's stand-in for trace+lower: pay the "compile"
+                # and serialize it so the NEXT boot loads instead
+                self.fresh_compiles += 1
+                payload = json.dumps({"entry": entry}).encode()
+                self._aot_cache.store(entry, sig, fp, payload,
+                                      compile_s=0.5,
+                                      signature_repr=repr(sig))
+
+    @staticmethod
+    def _mock_codec(payload: bytes):
+        """The mock payload codec: a JSON blob → a callable naming its
+        program.  Raises on garbage exactly like ``jax.export.
+        deserialize`` would, so the cache's corrupt-entry degradation is
+        exercisable host-only."""
+        doc = json.loads(payload)
+        if not isinstance(doc, dict) or "entry" not in doc:
+            raise ValueError("not a mock AOT payload")
+        return lambda: doc["entry"]
+
+    def aot_counters(self) -> dict:
+        """Same shape as :meth:`PagedTPUEngine.aot_counters`."""
+        if self._aot_cache is None:
+            return {"enabled": False}
+        return {"enabled": True, "fresh_compiles": self.fresh_compiles,
+                **self._aot_cache.counters()}
+
+    def warm_state(self) -> dict:
+        return {"prefix_chains": list(self._warm_chains),
+                "template_stats": {str(k): v
+                                   for k, v in self._template_stats.items()}}
+
+    def rewarm(self, state: dict) -> int:
+        """Replay a snapshot's chains: each re-registers as a warm
+        prefix (and counts as prefilled tokens — the mock's analog of
+        committing KV).  ``rewarm_s`` paces each chain so tests can
+        observe the ``warming`` readiness window."""
+        warmed = 0
+        for chain in state.get("prefix_chains") or []:
+            if not isinstance(chain, list) or not chain:
+                continue
+            if self.rewarm_s:
+                time.sleep(self.rewarm_s)
+            ids = [int(t) for t in chain]
+            if ids not in self._warm_chains:
+                self._warm_chains.append(ids)
+            self.stats.prefill_tokens += len(ids)
+            self.heartbeat = time.monotonic()
+            warmed += 1
+        from ..inference.tpu.engine import restore_template_stats
+
+        restore_template_stats(self._template_stats,
+                               state.get("template_stats"))
+        return warmed
 
     # -- the session driver contract --------------------------------------
     def encode_clipped(self, prompt: str, max_new_tokens: int) -> list[int]:
@@ -77,6 +177,18 @@ class MockStepEngine:
         self._next_seq += 1
         self.live += 1
         self.stats.prefill_tokens += len(ids)
+        # warm-state accounting (same token-space keys as the paged
+        # engine): the first prompt page is both the template tag and
+        # the "prefix chain" a snapshot carries across a restart
+        from ..inference.tpu.engine import bump_template_stats
+
+        tag = zlib.crc32(np.asarray(ids[:self.page_size],
+                                    np.int32).tobytes())
+        bump_template_stats(self._template_stats, tag)
+        chain = [int(t) for t in ids[:self.page_size]]
+        if len(ids) >= self.page_size and chain not in self._warm_chains \
+                and len(self._warm_chains) < 64:
+            self._warm_chains.append(chain)
         return self._next_seq, None
 
     def release_request(self, seq_id: int, req) -> None:

@@ -294,6 +294,11 @@ class _Replica:
         with self._lock:
             return {"id": self.id, "url": self.base_url,
                     "state": self.state, "ready": self.ready,
+                    # a restarting replica replaying its warm-state
+                    # snapshot: alive (the poll answers, no strikes
+                    # accumulate), just not ready yet — the poller flips
+                    # it ready the moment the rewarm finishes
+                    "warming": bool(self.ready_detail.get("warming")),
                     "fails": self.fails, "poll_fails": self.poll_fails,
                     "inflight": self.inflight,
                     "last_error": self.last_error,

@@ -329,9 +329,15 @@ class EngineServer:
                     info = (outer.ready_fn() if outer.ready_fn is not None
                             else {"ready": True})
                     ready = bool(info.get("ready"))
+                    # "warming" is its own not-ready state (boot replaying
+                    # a warm-state snapshot — distinct from "draining"):
+                    # the client handshake and the router health poller
+                    # both keep polling a 503 + Retry-After
+                    status = ("ready" if ready
+                              else "warming" if info.get("warming")
+                              else "unready")
                     self._send(200 if ready else 503,
-                               {"status": "ready" if ready else "unready",
-                                **info},
+                               {"status": status, **info},
                                None if ready else {"Retry-After": "1"},
                                request_id=rid)
                 elif path in ("/metrics", "/v1/metrics"):
@@ -845,7 +851,10 @@ def serve_config(cfg: dict, *, port: int | None = None,
         tracer = Tracer()
     lifecycle = {"max_queued_tokens": cfg.get("max_queued_tokens"),
                  "watchdog_s": cfg.get("watchdog_s"), "tracer": tracer,
-                 "postmortem_dir": cfg.get("postmortem_dir")}
+                 "postmortem_dir": cfg.get("postmortem_dir"),
+                 # warm restarts: drain writes the snapshot here, boot
+                 # replays it (default env REVAL_TPU_SNAPSHOT_PATH)
+                 "snapshot_path": cfg.get("snapshot_path")}
     body_cap = int(cfg.get("max_body_bytes", MAX_BODY_BYTES))
     obs_kw = {"tracer": tracer, "trace_out": trace_out,
               "postmortem_dir": cfg.get("postmortem_dir")}
@@ -855,7 +864,8 @@ def serve_config(cfg: dict, *, port: int | None = None,
         engine = MockStepEngine(
             response=cfg.get("mock_response", "mock_model_gen"),
             step_s=float(cfg.get("mock_step_s", 0.0)),
-            echo=bool(cfg.get("mock_echo", False)))
+            echo=bool(cfg.get("mock_echo", False)),
+            rewarm_s=float(cfg.get("mock_rewarm_s", 0.0)))
         session = ContinuousSession(engine, step_chaos=step_chaos,
                                     **lifecycle)
         server = EngineServer(session.generate_fn(), model_id=model_id,
@@ -875,7 +885,8 @@ def serve_config(cfg: dict, *, port: int | None = None,
                                          "max_queued_tokens", "watchdog_s",
                                          "max_body_bytes", "trace_out",
                                          "postmortem_dir", "mock_response",
-                                         "mock_step_s", "mock_echo")})
+                                         "mock_step_s", "mock_echo",
+                                         "mock_rewarm_s", "snapshot_path")})
     if warmup:
         secs = warmup_engine(backend.engine)
         print(f"warmup: generation programs compiled in {secs:.1f}s")

@@ -67,14 +67,16 @@ Lifecycle hardening (on top of the batching):
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
 
-from ..env import env_float, env_int
+from ..env import env_float, env_int, env_str
 from ..obs.flightrec import PostmortemWriter, build_bundle
 from ..obs.logging import log_event
+from .snapshot import read_snapshot, write_snapshot
 from .errors import (DeadlineExceeded, Draining, EngineFailure, EngineWedged,
                      Overloaded, ServingError)
 
@@ -205,8 +207,26 @@ class ContinuousSession:
     def __init__(self, engine, autostart: bool = True, *,
                  max_queued_tokens: int | None = None,
                  watchdog_s: float | None = None, step_chaos=None,
-                 tracer=None, postmortem_dir: str | None = None):
+                 tracer=None, postmortem_dir: str | None = None,
+                 snapshot_path: str | None = None):
         self.engine = engine
+        # -- warm restarts (serving/snapshot.py) -----------------------------
+        #: where the graceful drain lands its warm-state snapshot and
+        #: boot looks for the previous process's (default env
+        #: REVAL_TPU_SNAPSHOT_PATH; empty disables the whole feature)
+        self.snapshot_path = (snapshot_path
+                              if snapshot_path is not None
+                              else (env_str("REVAL_TPU_SNAPSHOT_PATH", "")
+                                    or None))
+        self._t_boot = time.perf_counter()
+        self._snapshot_once = threading.Event()     # drain writes ONE snapshot
+        #: boot is replaying a warm-state snapshot through prefill:
+        #: /readyz answers 503 "warming" (+ Retry-After, distinct from
+        #: draining) until the driver finishes the restore
+        self._warming = threading.Event()
+        if self.snapshot_path and os.path.exists(self.snapshot_path) \
+                and hasattr(engine, "rewarm"):
+            self._warming.set()
         #: crash-dump sink: watchdog trips, driver faults, and deadline
         #: storms dump a bundle here (obs/flightrec.py; default
         #: REVAL_TPU_POSTMORTEM_DIR or tpu_watch/)
@@ -352,7 +372,12 @@ class ContinuousSession:
     def readiness(self) -> dict:
         """Readiness snapshot for ``/readyz``: engine loaded (a session
         implies it), driver alive, heartbeat fresh, queue below the
-        watermark, not draining or wedged."""
+        watermark, not warming from a snapshot, not draining or
+        wedged.  ``warming`` is a DISTINCT not-ready state (the boot
+        replaying a warm-state snapshot through prefill): the server
+        answers 503 ``warming`` + Retry-After, which the client
+        handshake and the router health poller both keep polling
+        through — alive, just not serving yet."""
         hb = max(self._heartbeat, getattr(self.engine, "heartbeat", 0.0))
         hb_age = time.monotonic() - hb
         alive = self._thread is not None and self._thread.is_alive()
@@ -360,10 +385,12 @@ class ContinuousSession:
             queued = self._queued_tokens
             busy = bool(self._inflight)
         stale = bool(busy and self.watchdog_s and hb_age > self.watchdog_s)
-        ready = (alive and self._accepting() and not stale
+        warming = self._warming.is_set()
+        ready = (alive and self._accepting() and not stale and not warming
                  and queued < self.max_queued_tokens)
         return {"ready": ready, "driver_alive": alive,
                 "wedged": self._wedged.is_set(),
+                "warming": warming,
                 "draining": self._closed.is_set(),
                 "heartbeat_age_s": round(hb_age, 3),
                 "queued_tokens": queued,
@@ -510,6 +537,12 @@ class ContinuousSession:
             self._closed.set()
             self._inbox.put(None)       # wake a blocked driver
         joined = True
+        # a session whose driver never ran has nothing worth snapshotting
+        # — its engine is cold (the rewarm happens in _run), and writing
+        # would clobber the previous process's good snapshot with an
+        # empty one (_snapshot_once keeps the double-drain idempotence
+        # for sessions that DID run and already snapshotted)
+        started = self._thread is not None
         if self._thread is not None:
             self._thread.join(timeout=120)
             if self._thread.is_alive():
@@ -532,6 +565,11 @@ class ContinuousSession:
             self._watch_stop.set()
             self._watch_thread.join(timeout=5)
             self._watch_thread = None
+        if started and joined and not self._wedged.is_set():
+            # the driver exited cleanly: the engine is quiescent and
+            # single-owner safe to snapshot (a wedged engine's state is
+            # exactly what NOT to rewarm the next process with)
+            self._write_snapshot()
 
     def __enter__(self) -> "ContinuousSession":
         return self.start()
@@ -539,8 +577,66 @@ class ContinuousSession:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _restore_warm(self) -> None:
+        """Replay the previous process's warm-state snapshot through the
+        engine (driver thread — it owns the engine) and flip ``warming``
+        off; every failure shape boots cold with a warning event.  The
+        restore interval lands in ``reval_restart_to_ready_seconds`` —
+        the restart SLO this whole subsystem exists to shrink."""
+        from ..obs import metrics as obs_metrics
+
+        try:
+            doc = read_snapshot(self.snapshot_path)
+            if doc is not None:
+                warmed = self.engine.rewarm(doc.get("engine") or {})
+                reg = self.engine.stats.registry
+                if warmed:
+                    reg.counter(
+                        obs_metrics.RESTART_WARM_PREFIXES).add(warmed)
+                reg.histogram(obs_metrics.RESTART_TO_READY).observe(
+                    time.perf_counter() - self._t_boot)
+                log_event("session.snapshot_restored",
+                          path=self.snapshot_path, prefix_chains=warmed,
+                          unfinished=len(doc.get("unfinished_request_ids")
+                                         or []),
+                          restore_s=round(
+                              time.perf_counter() - self._t_boot, 3))
+        except Exception as exc:   # noqa: BLE001 — a failed restore is
+            # a cold boot, never a wedged one
+            log_event("session.snapshot_error", level="warning",
+                      path=self.snapshot_path, where="restore", exc=exc)
+        finally:
+            self._warming.clear()
+
+    def _write_snapshot(self) -> None:
+        """The drain-side half: land ONE warm-state snapshot (idempotent
+        across double drains), carrying the engine's warm state plus the
+        request ids the drain left unfinished (journal refs — ``fleet
+        --resume`` re-runs those chunks)."""
+        if (not self.snapshot_path or self._snapshot_once.is_set()
+                or not hasattr(self.engine, "warm_state")):
+            return
+        self._snapshot_once.set()
+        try:
+            state = self.engine.warm_state()
+        except Exception as exc:   # noqa: BLE001 — a drain must finish
+            # whether or not its snapshot lands
+            log_event("session.snapshot_error", level="warning",
+                      path=self.snapshot_path, where="warm_state", exc=exc)
+            return
+        with self._acct_lock:
+            unfinished = [sub.request_id for sub in self._inflight
+                          if not sub.pending.done()]
+        write_snapshot(self.snapshot_path, state,
+                       unfinished_request_ids=unfinished)
+
     def _run(self) -> None:
         eng = self.engine
+        if self._warming.is_set():
+            # rewarm BEFORE the drive loop: the driver owns the engine,
+            # and /readyz stays 503 "warming" until this returns (early
+            # submissions just wait in the inbox)
+            self._restore_warm()
         reqs: dict[int, object] = {}
         # seq_id -> (submission, position of this prompt in it)
         origin: dict[int, tuple[_Submission, int]] = {}
@@ -785,7 +881,13 @@ class MultiSession:
     def __init__(self, engines, autostart: bool = True, *,
                  max_queued_tokens: int | None = None,
                  watchdog_s: float | None = None, step_chaos=None,
-                 tracer=None, postmortem_dir: str | None = None):
+                 tracer=None, postmortem_dir: str | None = None,
+                 snapshot_path: str | None = None):
+        if snapshot_path is None:
+            # resolve the env default HERE so replicas get distinct
+            # files — each falling back independently would collide on
+            # one path ("" disables explicitly)
+            snapshot_path = env_str("REVAL_TPU_SNAPSHOT_PATH", "") or None
         # one shared tracer: replica placement is an `args` detail, the
         # span tree is per request id either way
         # unguarded: built once here, read-only thereafter
@@ -794,8 +896,14 @@ class MultiSession:
                                            watchdog_s=watchdog_s,
                                            step_chaos=step_chaos,
                                            tracer=tracer,
-                                           postmortem_dir=postmortem_dir)
-                         for e in engines]
+                                           postmortem_dir=postmortem_dir,
+                                           # one snapshot file per replica:
+                                           # each driver owns its own
+                                           # engine's warm state
+                                           snapshot_path=(
+                                               f"{snapshot_path}.r{i}"
+                                               if snapshot_path else ""))
+                         for i, e in enumerate(engines)]
         #: the server's SIGUSR1/SIGTERM dumps use this writer, so a dp
         #: set honors the configured directory exactly like a single
         #: session (replica-level trips use each session's own writer —
@@ -858,7 +966,9 @@ class MultiSession:
         """Per-replica readiness; the set is ready while ANY replica is
         (degraded capacity still serves)."""
         reps = [s.readiness() for s in self.sessions]
-        return {"ready": any(r["ready"] for r in reps), "replicas": reps}
+        return {"ready": any(r["ready"] for r in reps),
+                "warming": any(r.get("warming") for r in reps),
+                "replicas": reps}
 
     def engine_stats(self) -> list:
         return [s.engine.stats for s in self.sessions]

@@ -1,0 +1,89 @@
+"""Warm-state snapshots: what a graceful drain leaves for the next boot.
+
+The restart story has two halves.  The AOT executable cache
+(``inference/tpu/aot_cache.py``) makes the next process skip XLA
+compilation; this module makes it skip the COLD CACHE: at drain the
+session writes one atomic JSON snapshot — the radix prefix-cache token
+tree (every cached chain as its full token list), the per-template
+affinity stats the fleet router's placement view keys on, and the
+request ids of submissions that were still unfinished when the drain
+cut them off (journal refs: ``fleet --resume`` re-runs those chunks) —
+and at boot the engine replays the token tree through real prefill
+before ``/readyz`` flips, surfacing the interval as the distinct
+``warming`` readiness state.  (The template stats are keyed in TOKEN
+space — crc32 of the first prompt page's ids, the engine-side analog
+of the router's char-window affinity key, not the same hash.)
+
+Degradation contract (mirrors the AOT cache): a truncated, garbage, or
+wrong-format snapshot file boots a COLD engine with one
+``session.snapshot_error`` warning event — never a wedged startup; a
+directory the drain cannot write gets the same event and the drain
+completes anyway.  Writes are tmp+rename atomic with a sticky
+once-guard in the session, so a double drain writes exactly one
+snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..obs.logging import log_event
+
+__all__ = ["read_snapshot", "write_snapshot", "FORMAT"]
+
+FORMAT = "reval-warm-snapshot-v1"
+
+
+def write_snapshot(path: str, engine_state: dict,
+                   unfinished_request_ids: list | None = None) -> bool:
+    """Atomically land one warm-state snapshot; True on success.  Every
+    failure shape (unwritable dir, full disk) degrades to a
+    ``session.snapshot_error`` warning — a drain must finish whether or
+    not its snapshot lands."""
+    doc = {"format": FORMAT,
+           "created_ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "pid": os.getpid(),
+           "engine": engine_state or {},
+           "unfinished_request_ids": list(unfinished_request_ids or [])}
+    tmp = f"{path}.tmp"
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        log_event("session.snapshot_error", level="warning", path=path,
+                  where="write", exc=exc)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+    chains = len((engine_state or {}).get("prefix_chains") or [])
+    log_event("session.snapshot_written", path=path, prefix_chains=chains,
+              unfinished=len(doc["unfinished_request_ids"]))
+    return True
+
+
+def read_snapshot(path: str) -> dict | None:
+    """The snapshot document, or None: absent is a silent cold boot,
+    while corrupt/truncated/wrong-format warns (``session.snapshot_error``)
+    and STILL boots cold — a bad snapshot must never wedge startup."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+            raise ValueError(f"not a {FORMAT} document")
+        if not isinstance(doc.get("engine"), dict):
+            raise ValueError("snapshot carries no engine state object")
+    except Exception as exc:    # noqa: BLE001 — every unreadable shape
+        # is the same verdict: boot cold, say why
+        log_event("session.snapshot_error", level="warning", path=path,
+                  where="read", exc=exc)
+        return None
+    return doc

@@ -1,0 +1,136 @@
+"""Crash-loop supervisor: fast rebirth without flapping the router.
+
+``reval_tpu serve --supervise`` wraps the server process in this loop:
+spawn the child, wait for it to die, land a postmortem bundle naming
+the death, back off (the existing :class:`~reval_tpu.resilience.
+RetryPolicy` exponential schedule — base ``REVAL_TPU_SUPERVISE_BACKOFF_S``,
+doubling per rapid death, jittered, capped), and respawn.  Combined
+with the AOT executable cache and the warm-state snapshot, the respawn
+is seconds-to-ready instead of a full compile — which is what makes
+supervised respawn a *good* policy: a replica that takes minutes to
+come back should stay dead and let the router re-balance instead.
+
+**Sticky-failed beats flapping.**  Deaths inside the rapid-death window
+(``REVAL_TPU_SUPERVISE_WINDOW_S``) accumulate; at
+``REVAL_TPU_SUPERVISE_MAX_DEATHS`` the supervisor STOPS respawning and
+exits nonzero (``supervisor.sticky_failed``).  A crash-looping replica
+that kept respawning would oscillate the router's health state machine
+(eject → half-open probe → accept → die → eject …) and smear failures
+over live traffic; sticky-failed leaves it cleanly ejected until an
+operator (or orchestrator) intervenes.  Deaths older than the window
+age out, so a long-lived server that dies once a day respawns forever.
+
+A child exiting 0 is a GRACEFUL shutdown (SIGTERM drain, operator
+stop): the supervisor exits 0 without respawning — a deliberate stop
+must stay stopped.
+
+Everything process-shaped is injectable (``spawn`` returns any object
+with ``wait() -> returncode``; clock/sleep likewise), so the whole
+state machine is unit-testable without real subprocesses.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..env import env_float, env_int
+from ..obs import metrics as obs_metrics
+from ..obs.flightrec import PostmortemWriter, build_bundle
+from ..obs.logging import log_event
+from ..obs.metrics import MetricsRegistry
+from ..resilience import RetryPolicy
+
+__all__ = ["Supervisor"]
+
+
+class Supervisor:
+    """Respawn loop around one child server (see module docstring).
+
+    ``spawn``: zero-arg callable returning a child handle —
+    ``subprocess.Popen`` or any object with ``wait() -> returncode``
+    (and optionally ``pid``).  Constructor knobs default to the
+    ``REVAL_TPU_SUPERVISE_*`` env vars.  Single-owner: one thread runs
+    :meth:`run`; :meth:`stop` (any thread) makes the loop exit after
+    the current child dies instead of respawning."""
+
+    def __init__(self, spawn, *, max_deaths: int | None = None,
+                 window_s: float | None = None,
+                 base_backoff_s: float | None = None,
+                 max_backoff_s: float = 30.0,
+                 postmortem_dir: str | None = None,
+                 clock=time.monotonic, sleep=time.sleep, rng=None):
+        self.spawn = spawn
+        self.max_deaths = (max_deaths if max_deaths is not None
+                           else env_int("REVAL_TPU_SUPERVISE_MAX_DEATHS", 5))
+        self.window_s = (window_s if window_s is not None
+                         else env_float("REVAL_TPU_SUPERVISE_WINDOW_S", 60.0))
+        base = (base_backoff_s if base_backoff_s is not None
+                else env_float("REVAL_TPU_SUPERVISE_BACKOFF_S", 0.5))
+        #: the one backoff schedule in the tree — delay_for(n) doubles
+        #: per rapid death, jitters, and caps at max_backoff_s
+        self._retry = RetryPolicy(base_delay=base, max_delay=max_backoff_s,
+                                  rng=rng)
+        self._clock = clock
+        self._sleep = sleep
+        self._deaths: deque = deque()       # unguarded: run()-thread only
+        self._stopping = False              # unguarded: latch read by run()
+        self._obs = MetricsRegistry()
+        self._postmortem = PostmortemWriter(postmortem_dir,
+                                            min_interval_s=0.0)
+        #: "idle" → "running" → "stopped" | "sticky_failed"
+        self.state = "idle"
+        self.child = None
+        self.respawns = 0
+
+    def counters(self) -> dict:
+        return {"deaths": len(self._deaths), "respawns": self.respawns,
+                "state": self.state}
+
+    def stop(self) -> None:
+        """Make :meth:`run` exit once the current child dies (callers
+        kill the child themselves — the supervisor never owns signal
+        delivery, so tests and the CLI can each do it their way)."""
+        self._stopping = True
+
+    def _note_death(self, rc) -> int:
+        """Fold one child death into the rapid-death window; returns the
+        deaths currently inside it."""
+        now = self._clock()
+        self._deaths.append(now)
+        while self._deaths and now - self._deaths[0] > self.window_s:
+            self._deaths.popleft()
+        self._obs.counter(obs_metrics.RESTART_DEATHS).add(1)
+        log_event("supervisor.death", level="warning", exit_code=rc,
+                  rapid_deaths=len(self._deaths),
+                  window_s=self.window_s)
+        self._postmortem.dump(build_bundle(
+            "supervisor_child_death", exit_code=rc,
+            rapid_deaths=len(self._deaths), window_s=self.window_s,
+            respawns=self.respawns, metrics=self._obs.snapshot()))
+        return len(self._deaths)
+
+    def run(self) -> int:
+        """Supervise until the child exits gracefully (0), :meth:`stop`
+        is called (0), or the rapid-death budget is spent (1)."""
+        self.state = "running"
+        while True:
+            self.child = self.spawn()
+            self.respawns += 1
+            self._obs.counter(obs_metrics.RESTART_RESPAWNS).add(1)
+            log_event("supervisor.spawn",
+                      pid=getattr(self.child, "pid", None),
+                      respawns=self.respawns)
+            rc = self.child.wait()
+            if self._stopping or rc == 0:
+                # graceful: a deliberate stop must stay stopped
+                self.state = "stopped"
+                return 0
+            rapid = self._note_death(rc)
+            if rapid >= self.max_deaths:
+                self.state = "sticky_failed"
+                log_event("supervisor.sticky_failed", level="error",
+                          rapid_deaths=rapid, window_s=self.window_s,
+                          max_deaths=self.max_deaths)
+                return 1
+            self._sleep(self._retry.delay_for(rapid - 1))

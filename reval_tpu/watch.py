@@ -95,7 +95,8 @@ def render_screen(status: dict, debug: dict, prev_counters: dict | None,
     readiness = status.get("readiness", {}) or {}
     lines = []
     state = ("DRAINING" if status.get("draining")
-             else "READY" if readiness.get("ready") else "UNREADY")
+             else "READY" if readiness.get("ready")
+             else "WARMING" if readiness.get("warming") else "UNREADY")
     lines.append(f"== reval_tpu watch · {target} · "
                  f"{status.get('model', '?')} · {state} · "
                  f"{time.strftime('%H:%M:%S')} ==")
@@ -140,6 +141,20 @@ def render_screen(status: dict, debug: dict, prev_counters: dict | None,
     hb = readiness.get("heartbeat_age_s")
     lines.append("lifecycle    " + lifecycle
                  + (f"  hb_age {hb}s" if hb is not None else ""))
+
+    # warm-restart row: only when the AOT cache / snapshot restore has
+    # anything to say (a cold-configured server keeps the screen short)
+    aot_hits = counters.get(obs_metrics.AOT_HITS, 0)
+    aot_miss = counters.get(obs_metrics.AOT_MISSES, 0)
+    warm = counters.get(obs_metrics.RESTART_WARM_PREFIXES, 0)
+    if aot_hits or aot_miss or warm:
+        saved = counters.get(obs_metrics.AOT_SAVED_SECONDS, 0.0)
+        lines.append(
+            f"warm restart aot hits {int(aot_hits)}"
+            f"  misses {int(aot_miss)}"
+            f"  compile_s_saved {saved:.1f}"
+            f"  warm_prefixes {int(warm)}"
+            f"  cache_entries {int(gauges.get(obs_metrics.AOT_ENTRIES, 0))}")
 
     faults = [e for e in (debug.get("recent_logs") or ())
               if e.get("level") in ("error", "warning")][-4:]

@@ -47,6 +47,12 @@ def test_bench_tiny_emits_one_json_line():
             "warm_prefill_reduction"} <= set(pc)
     assert pc["warm_prefill_reduction"] > 0
     assert "no_prefix_cache_speedup" in d
+    # warm-restart block: ALWAYS present ({"enabled": false} without
+    # REVAL_TPU_AOT_CACHE_DIR), so the BENCH_r* trajectory shows exactly
+    # when the cold-start win lands
+    assert "enabled" in d["restart"]
+    if d["restart"]["enabled"]:
+        assert "restart_to_ready_s" in d["restart"]
     # the determinism block: reference-cell greedy fingerprint recorded
     # every round so BENCH history detects silent cross-commit drift
     det = d["determinism"]

@@ -49,38 +49,25 @@ B, P, NPAGES, SPAN, D = 4, 128, 24, 6, 128
 
 @functools.lru_cache(maxsize=None)
 def _kernel_lowering_skip() -> str | None:
-    """Capability canary for the DIRECT kernel exports: both decode
-    kernels transpose a K/V page in VMEM (``jnp.swapaxes(k, 0, 1)``, the
-    ``swap`` dot formulation), and older jax builds' Mosaic TPU lowering
-    has no rule for a (1, 0, 2) transpose — the chip's jax does.  Export
-    a minimal Pallas program using exactly that construct: if THIS fails,
-    the host cannot lower the real kernels either, and the kernel-level
-    tests skip with the environment named.  If the canary passes, a
-    kernel-test failure is a real regression (or a new gap worth triage),
-    so it stays a failure.  The whole-program exports below don't take
-    this skip: they lower today and must keep lowering.
+    """Capability canary for the DIRECT kernel exports — THE shared
+    probe (``reval_tpu.inference.tpu.aot_cache.kernel_export_skip``):
+    both decode kernels transpose a K/V page in VMEM, and older jax
+    builds' Mosaic TPU lowering has no rule for that (1, 0, 2)
+    transpose — the chip's jax does.  One definition serves both
+    consumers: these kernel-level tests skip with the environment named,
+    and the AOT executable cache reports ``aot.unsupported`` (counted,
+    logged, degraded to a fresh compile) instead of raising a doomed
+    export per variant.  If the canary passes, a kernel-test failure is
+    a real regression.  The whole-program exports below don't take this
+    skip: they lower today and must keep lowering.
 
     Cached + called from test bodies (not at import), so collection and
     deselected runs never pay the multi-second canary export."""
     if _EXPORT_SKIP is not None:    # module already skipped wholesale
         return _EXPORT_SKIP
-    from jax.experimental import pallas as pl
+    from reval_tpu.inference.tpu.aot_cache import kernel_export_skip
 
-    def kern(x_ref, o_ref):
-        o_ref[...] = jnp.swapaxes(x_ref[...], 0, 1)
-
-    fn = pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(
-        (8, 2, 128), jnp.float32))
-    try:
-        jax.export.export(jax.jit(fn), platforms=["tpu"])(
-            jnp.zeros((2, 8, 128), jnp.float32))
-        return None
-    except Exception as e:  # noqa: BLE001 — any lowering error means
-        # the host toolchain, not the kernel, is what cannot lower
-        return ("jax.export unavailable for the Pallas kernel exports on "
-                "this host: this jax build's Mosaic TPU lowering lacks the "
-                f"kernels' baseline (1,0,2) transpose "
-                f"({type(e).__name__})")
+    return kernel_export_skip()
 
 
 @pytest.fixture()

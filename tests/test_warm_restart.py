@@ -1,0 +1,1120 @@
+"""Warm restarts: AOT executable cache, snapshot/restore, supervisor,
+and the rolling-restart drill (ISSUE 10).
+
+Everything here is host-only.  The AOT cache's degraded-path state
+machine (corrupt payload, fingerprint mismatch, unwritable dir, GC) is
+exercised through the real :class:`AOTCache` with a mock payload codec;
+the real ``jax.export`` round trip runs against a tiny jitted function;
+and the headline drill drives mock replicas behind a real
+:class:`FleetRouter`: drain → graceful stop (snapshot) → supervised
+restart → ``/readyz`` flips via ``warming`` with AOT hits and ZERO
+fresh compiles → router rejoin → second-replica hard-kill mid-fleet →
+zero lost prompts, task logs byte-identical to a no-restart run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reval_tpu.inference.client import HTTPClientBackend
+from reval_tpu.inference.tpu.aot_cache import (AOTCache, AotJit, FORMAT,
+                                               fingerprint,
+                                               kernel_export_skip,
+                                               runtime_context)
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.serving import FleetRouter, Supervisor, serve_config
+from reval_tpu.serving.snapshot import (FORMAT as SNAP_FORMAT,
+                                        read_snapshot, write_snapshot)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TEMPLATE_A = "few-shot warm template alpha | " * 40
+TEMPLATE_B = "few-shot warm template bravo | " * 40
+
+FAST_RETRY = {"max_attempts": 10, "base_delay": 0.02,
+              "max_delay": 0.3, "jitter": 0.1}
+
+
+def mock_codec(payload: bytes):
+    doc = json.loads(payload)
+    if not isinstance(doc, dict) or "entry" not in doc:
+        raise ValueError("not a mock AOT payload")
+    return lambda: doc["entry"]
+
+
+def store_mock(cache: AOTCache, entry: str, fp: str, sig=("s",),
+               compile_s: float = 0.5) -> None:
+    cache.store(entry, sig, fp, json.dumps({"entry": entry}).encode(),
+                compile_s, signature_repr=repr(sig))
+
+
+# ---------------------------------------------------------------------------
+# AOTCache: the degraded-path state machine (mock codec, host-only)
+# ---------------------------------------------------------------------------
+
+def test_aot_cache_store_load_hit_counts_and_saves(tmp_path):
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"m": "tiny"})
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec) is None
+    assert cache.misses == 1                    # cold
+    store_mock(cache, "prog.a", fp, compile_s=2.5)
+    fn = cache.load("prog.a", ("s",), fp, deserialize=mock_codec)
+    assert fn is not None and fn() == "prog.a"
+    assert cache.hits == 1
+    assert cache.compile_s_saved == 2.5
+    row = cache.counters()
+    assert row["entries"] == 1 and row["bytes"] > 0
+
+
+def test_aot_cache_corrupt_payload_degrades_to_miss(tmp_path):
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"m": "tiny"})
+    store_mock(cache, "prog.a", fp)
+    payload = [p for p in os.listdir(cache.dir) if p.endswith(".bin")][0]
+    with open(os.path.join(cache.dir, payload), "wb") as f:
+        f.write(b"garbage not the payload")    # checksum now wrong
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec) is None
+    assert cache.errors == 1 and cache.misses == 1
+
+
+def test_aot_cache_fingerprint_mismatch_degrades_to_miss(tmp_path):
+    # a DIFFERENT fingerprint normally resolves to a different file
+    # (the fp is part of the file key — configs coexist, see below), so
+    # the meta-level check is defense in depth: tamper the stored
+    # meta's fingerprint in place to exercise it
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"jax": "0.4.0"})
+    store_mock(cache, "prog.a", fp)
+    meta_path = cache._base("prog.a", ("s",), fp) + ".json"
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["fingerprint"] = fingerprint({"jax": "0.5.0"})     # toolchain moved
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    assert cache.load("prog.a", ("s",), fp,
+                      deserialize=mock_codec) is None
+    assert cache.errors == 1 and cache.misses == 1
+
+
+def test_aot_cache_distinct_fingerprints_coexist(tmp_path):
+    # two engine configs with IDENTICAL call signatures over one shared
+    # dir (e.g. xla- and pallas-backed boots alternating) must not
+    # clobber each other's entries: the fingerprint is part of the file
+    # key, so both stay warm
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp_a = fingerprint({"kernel_backend": "xla"})
+    fp_b = fingerprint({"kernel_backend": "pallas"})
+    store_mock(cache, "prog.a", fp_a)
+    store_mock(cache, "prog.a", fp_b)
+    assert cache.counters()["entries"] == 2
+    assert cache.load("prog.a", ("s",), fp_a, deserialize=mock_codec)
+    assert cache.load("prog.a", ("s",), fp_b, deserialize=mock_codec)
+    assert cache.hits == 2 and cache.misses == 0
+
+
+def test_aot_cache_wrong_format_meta_degrades(tmp_path):
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"m": "tiny"})
+    store_mock(cache, "prog.a", fp)
+    meta = [p for p in os.listdir(cache.dir) if p.endswith(".json")][0]
+    with open(os.path.join(cache.dir, meta), "w") as f:
+        json.dump({"format": "something-else-v9"}, f)
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec) is None
+    assert cache.errors == 1
+    # and a TRUNCATED meta (torn write outside the commit protocol)
+    with open(os.path.join(cache.dir, meta), "w") as f:
+        f.write('{"format": "reval-ao')
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec) is None
+    assert cache.errors == 2
+
+
+def test_aot_cache_unwritable_dir_disables_stores_never_raises(tmp_path):
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the cache dir should be")
+    cache = AOTCache(str(blocker / "aot"))     # mkdir fails: parent is a file
+    assert cache._disabled_store
+    assert cache.errors == 1
+    fp = fingerprint({"m": "tiny"})
+    assert not cache.store("prog.a", ("s",), fp, b"payload", 0.1)
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec) is None
+    # the serving path survives: counters, gauges, GC all no-op cleanly
+    assert cache.gc() == 0
+    assert cache.counters()["entries"] == 0
+
+
+def test_aot_cache_gc_evicts_lru_until_bound(tmp_path):
+    cache = AOTCache(str(tmp_path / "aot"), max_mb=2048)
+    fp = fingerprint({"m": "tiny"})
+    for i, entry in enumerate(("prog.a", "prog.b", "prog.c")):
+        store_mock(cache, entry, fp)
+        mtime = time.time() - 300 + i * 100    # distinct LRU stamps
+        base = cache._base(entry, ("s",), fp)
+        os.utime(base + ".json", (mtime, mtime))
+    # a hit refreshes prog.a's stamp: it must survive the GC below
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec)
+    evicted = cache.gc(max_mb=0)
+    assert evicted >= 2
+    names = " ".join(os.listdir(cache.dir))
+    assert "prog_b" not in names and "prog_c" not in names
+
+
+def test_aot_cache_gc_reaps_stale_orphan_payloads(tmp_path):
+    """A crash inside the payload-first commit window leaves a ``.bin``
+    whose meta never landed: invisible to ``entries()`` but charged
+    against the size bound — GC must reap it (after a grace period)
+    instead of uselessly evicting the live cache around it."""
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"m": "tiny"})
+    store_mock(cache, "prog.a", fp)
+    orphan = os.path.join(cache.dir, "prog_dead-ffff-0000.bin")
+    with open(orphan, "wb") as f:
+        f.write(b"x" * 4096)
+    fresh_tmp = os.path.join(cache.dir, "prog_live-ffff-0000.bin.tmp")
+    with open(fresh_tmp, "wb") as f:
+        f.write(b"y")               # a writer mid-commit: must survive
+    old = time.time() - 3600
+    os.utime(orphan, (old, old))
+    cache.gc()                      # generous bound: no LRU eviction...
+    assert not os.path.exists(orphan)       # ...but the orphan is gone
+    assert os.path.exists(fresh_tmp)        # grace period protects it
+    assert cache.counters()["entries"] == 1  # live entry untouched
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec)
+
+
+def test_aot_cache_gc_covers_jax_xla_subdir(tmp_path):
+    """jax's persistent compilation cache under ``<dir>/xla`` is part of
+    the directory REVAL_TPU_AOT_CACHE_MAX_MB promises to bound: its
+    bytes must count, and GC must reap its (cheaper-to-rebuild) files
+    BEFORE evicting AOT entries."""
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"m": "tiny"})
+    store_mock(cache, "prog.a", fp)
+    _, aot_only = cache._usage()
+    xla = tmp_path / "aot" / "xla"
+    xla.mkdir()
+    (xla / "module_big").write_bytes(b"z" * (2 * 1024 * 1024))
+    cache._xla_scan = (0.0, 0)      # drop the TTL memo: fresh view
+    _, total = cache._usage()
+    assert total >= aot_only + 2 * 1024 * 1024      # xla bytes counted
+    assert cache.gc(max_mb=1) == 0                  # no AOT entry evicted...
+    assert not (xla / "module_big").exists()        # ...the xla file went
+    assert cache.counters()["entries"] == 1
+    assert cache.load("prog.a", ("s",), fp, deserialize=mock_codec)
+
+
+def test_template_stats_stay_bounded():
+    """The per-template affinity dict rides every snapshot whole — a
+    high-diversity workload must not grow it (and the snapshot) without
+    bound; the heavy templates survive the fold."""
+    from reval_tpu.inference.tpu.engine import (TEMPLATE_STATS_CAP,
+                                                bump_template_stats)
+
+    stats: dict = {}
+    bump_template_stats(stats, 424242, 1000)     # the heavy hitter
+    for tag in range(TEMPLATE_STATS_CAP * 2):
+        bump_template_stats(stats, tag)
+    assert len(stats) <= TEMPLATE_STATS_CAP
+    assert stats[424242] == 1000
+
+
+def test_restore_template_stats_tolerates_garbage():
+    """Keys AND counts come off disk: one corrupt row (non-numeric
+    either side) skips that row only — it must never abort a restore
+    whose chains already replayed (both engines share this helper)."""
+    from reval_tpu.inference.tpu.engine import restore_template_stats
+
+    stats: dict = {}
+    restore_template_stats(stats, {"12": 3, "x": 1, "13": None, "14": "2"})
+    assert stats == {12: 3, 14: 2}
+    restore_template_stats(stats, None)         # absent doc: no-op
+    assert stats == {12: 3, 14: 2}
+
+
+def test_dp_aot_counters_directory_gauges_take_max():
+    """dp replicas share ONE cache directory: the merged ``entries``/
+    ``bytes`` must describe that directory once, not dp× it, while the
+    per-process work counters still sum."""
+    from types import SimpleNamespace
+
+    from reval_tpu.inference.tpu.dp_paged import DataParallelPagedEngine
+
+    rows = [{"enabled": True, "hits": 3, "misses": 1, "entries": 10,
+             "bytes": 500, "dir": "/d"},
+            {"enabled": True, "hits": 2, "misses": 0, "entries": 10,
+             "bytes": 500, "dir": "/d"}]
+    reps = [SimpleNamespace(aot_counters=lambda r=r: dict(r)) for r in rows]
+    out = DataParallelPagedEngine.aot_counters(
+        SimpleNamespace(replicas=reps))
+    assert out["hits"] == 5 and out["misses"] == 1
+    assert out["entries"] == 10 and out["bytes"] == 500
+
+
+def test_resolved_kernel_knobs_ride_the_fingerprint(monkeypatch):
+    """REVAL_TPU_KERNEL_DOT / REVAL_TPU_FORCE_MOSAIC bind at trace time
+    under one backend label — two knob settings must fingerprint (and so
+    cache) differently, while the xla formulation (which reads neither)
+    stays knob-invariant."""
+    from reval_tpu.ops.pallas_attention import resolved_kernel_knobs
+
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "xla")
+    monkeypatch.setenv("REVAL_TPU_KERNEL_DOT", "wide")
+    assert resolved_kernel_knobs() == {"dot_mode": "n/a",
+                                       "interpret": "n/a"}
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "pallas")
+    wide = resolved_kernel_knobs()
+    assert wide["dot_mode"] == "wide"
+    monkeypatch.setenv("REVAL_TPU_KERNEL_DOT", "swap")
+    swap = resolved_kernel_knobs()
+    assert swap["dot_mode"] == "swap"
+    assert fingerprint({**{"kernel_backend": "pallas"}, **wide}) \
+        != fingerprint({**{"kernel_backend": "pallas"}, **swap})
+
+
+def test_aot_cache_verify_entry_verdicts(tmp_path):
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint({"m": "tiny"})
+    store_mock(cache, "prog.ok", fp)
+    store_mock(cache, "prog.bad", fp)
+    bad_payload = cache._base("prog.bad", ("s",), fp) + ".bin"
+    with open(bad_payload, "wb") as f:
+        f.write(b"x")
+    verdicts = {row["entry"]: cache.verify_entry(row)
+                for row in cache.entries()}
+    assert verdicts["prog.ok"] is None
+    assert "checksum" in verdicts["prog.bad"]
+
+
+# ---------------------------------------------------------------------------
+# AotJit: the real jax.export round trip + degraded environments
+# ---------------------------------------------------------------------------
+
+class _FakeTracked:
+    """Minimal TrackedJit surface for wrapper-level tests."""
+
+    def __init__(self, fn, name="t.prog", warmup=8):
+        self._fn = fn
+        self.name = name
+        self.warmup = warmup
+        self.calls = 0
+
+    def note_call(self, args, kwargs):
+        self.calls += 1
+        shapes = tuple(getattr(a, "shape", a) for a in args)
+        statics = tuple(sorted(kwargs.items())) if kwargs else ()
+        return (shapes, statics)
+
+    @property
+    def variants(self):
+        return 0
+
+    @property
+    def misses(self):
+        return 0
+
+
+def test_aot_jit_real_export_round_trip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    cache = AOTCache(str(tmp_path / "aot"))
+    ctx = {"prog": "double"}
+    x = jnp.arange(8, dtype=jnp.float32)
+
+    wrapped = AotJit(_FakeTracked(jax.jit(lambda v: v * 2)), cache, ctx)
+    out = wrapped(x)
+    assert (out == x * 2).all()
+    assert wrapped.fresh_compiles == 1 and cache.misses == 1
+    assert cache.counters()["entries"] == 1     # exported + stored
+
+    # a NEW wrapper (new process's view) over the same directory loads
+    # the serialized executable: zero fresh compiles, identical output
+    wrapped2 = AotJit(_FakeTracked(jax.jit(lambda v: v * 2)), cache, ctx)
+    out2 = wrapped2(x)
+    assert (out2 == out).all()
+    assert wrapped2.fresh_compiles == 0 and cache.hits == 1
+    # and the loaded executable serves repeat calls without re-probing
+    assert (wrapped2(x) == out).all()
+    assert cache.hits == 1
+
+
+def test_aot_jit_static_args_bake_into_separate_variants(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    cache = AOTCache(str(tmp_path / "aot"))
+
+    def f(v, *, steps):
+        return v + steps
+
+    jitted = jax.jit(f, static_argnames=("steps",))
+    w1 = AotJit(_FakeTracked(jitted), cache, {"prog": "s"},
+                static=("steps",))
+    x = jnp.arange(4, dtype=jnp.float32)
+    assert (w1(x, steps=2) == x + 2).all()
+    assert (w1(x, steps=5) == x + 5).all()
+    assert cache.counters()["entries"] == 2     # one per static value
+    w2 = AotJit(_FakeTracked(jax.jit(f, static_argnames=("steps",))),
+                cache, {"prog": "s"}, static=("steps",))
+    # dispatch to the LOADED executable strips the baked static
+    assert (w2(x, steps=2) == x + 2).all()
+    assert (w2(x, steps=5) == x + 5).all()
+    assert w2.fresh_compiles == 0 and cache.hits == 2
+
+
+def test_aot_jit_canary_reports_unsupported_never_raises(tmp_path):
+    """The degraded-env satellite: when the Mosaic canary says kernel
+    export is unavailable, the cache reports ``unsupported`` (counted,
+    logged ONCE) and the entry serves through the plain tracker — the
+    serving path never sees the doomed export."""
+    import jax
+    import jax.numpy as jnp
+
+    cache = AOTCache(str(tmp_path / "aot"))
+    probes = {"n": 0}
+
+    def canary():
+        probes["n"] += 1
+        return "mosaic lowering unavailable on this host (canary)"
+
+    w = AotJit(_FakeTracked(jax.jit(lambda v: v * 3)), cache,
+               {"prog": "k"}, canary=canary)
+    x = jnp.arange(4, dtype=jnp.float32)
+    assert (w(x) == x * 3).all()
+    assert (w(x) == x * 3).all()
+    assert cache.unsupported == 1               # counted once
+    assert probes["n"] == 1                     # probed once
+    assert cache.counters()["entries"] == 0     # nothing stored
+    # the shared canary itself returns a stable verdict (None on a chip
+    # jax; a named environment gap here) — same probe
+    # tests/test_tpu_lowering.py skips its kernel exports on
+    verdict = kernel_export_skip()
+    assert verdict is None or "jax" in verdict
+
+
+def test_aot_jit_export_failure_degrades_to_unsupported(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    cache = AOTCache(str(tmp_path / "aot"))
+
+    def impure(v):
+        # jax.export rejects host callbacks — a program this build
+        # cannot export, without a canary to predict it
+        import jax.debug
+
+        jax.debug.callback(lambda *_: None, v)
+        return v * 2
+
+    w = AotJit(_FakeTracked(jax.jit(impure)), cache, {"prog": "cb"})
+    x = jnp.arange(4, dtype=jnp.float32)
+    assert (w(x) == x * 2).all()                # the call itself served
+    assert cache.unsupported == 1
+    assert cache.counters()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Warm-state snapshots
+# ---------------------------------------------------------------------------
+
+def test_snapshot_write_read_round_trip_atomic(tmp_path):
+    path = str(tmp_path / "snap.json")
+    state = {"prefix_chains": [[1, 2, 3]], "template_stats": {"9": 4}}
+    assert write_snapshot(path, state, unfinished_request_ids=["rid-1"])
+    assert not os.path.exists(path + ".tmp")    # atomic: tmp renamed away
+    doc = read_snapshot(path)
+    assert doc["format"] == SNAP_FORMAT
+    assert doc["engine"] == state
+    assert doc["unfinished_request_ids"] == ["rid-1"]
+
+
+def test_snapshot_corrupt_and_garbage_read_cold(tmp_path):
+    path = tmp_path / "snap.json"
+    assert read_snapshot(str(path)) is None     # absent: silent cold boot
+    path.write_text('{"format": "reval-warm-sn')     # truncated
+    assert read_snapshot(str(path)) is None
+    path.write_text(json.dumps({"format": "wrong-v0", "engine": {}}))
+    assert read_snapshot(str(path)) is None
+    path.write_text(json.dumps({"format": SNAP_FORMAT, "engine": "nope"}))
+    assert read_snapshot(str(path)) is None
+
+
+def test_rewarm_failed_prefill_rolls_back_chain(monkeypatch):
+    """A chain whose replay prefill dies mid-boot must not survive as
+    uncommitted (garbage) KV — a later rider would decode against it
+    silently wrong — nor stay pinned (unevictable forever).  Same
+    rollback contract as the submit path."""
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "xla")
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    eng = PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                         page_size=128, max_seq_len=512)
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("device fell over mid-replay")
+
+        monkeypatch.setattr(eng, "_prefill_prefix_pages", boom)
+        warmed = eng.rewarm({"prefix_chains": [list(range(1, 129))],
+                             "template_stats": {}})
+        assert warmed == 0
+        assert eng.prefix_cache.nodes == 0          # nothing survived
+        assert eng.prefix_cache.pinned_pages == 0   # nothing left pinned
+        assert eng.stats.prefix_hit_tokens == 0     # credit rolled back
+    finally:
+        eng.close()
+
+
+def test_close_without_start_preserves_previous_snapshot(tmp_path):
+    """A session whose driver never ran (autostart=False, or a boot
+    that died before start()) has a COLD engine — its close() must not
+    clobber the previous process's good snapshot with an empty one."""
+    from reval_tpu.serving import ContinuousSession, MockStepEngine
+
+    snap = str(tmp_path / "snap.json")
+    good = {"prefix_chains": [[7] * 128], "template_stats": {"5": 2}}
+    assert write_snapshot(snap, good)
+    session = ContinuousSession(MockStepEngine(), autostart=False,
+                                snapshot_path=snap)
+    session.close()
+    doc = read_snapshot(snap)
+    assert doc is not None and doc["engine"] == good
+
+
+def test_snapshot_unwritable_dir_degrades(tmp_path):
+    blocker = tmp_path / "f"
+    blocker.write_text("file, not dir")
+    assert not write_snapshot(str(blocker / "deep" / "snap.json"),
+                              {"prefix_chains": []})
+
+
+def test_corrupt_snapshot_boots_cold_server_still_serves(tmp_path):
+    """A truncated/garbage snapshot file must boot a COLD engine with a
+    warning event — never wedge startup behind ``warming``."""
+    snap = tmp_path / "snap.json"
+    snap.write_text('{"format": "reval-warm-snapshot-v1", "engine": {"pre')
+    srv = serve_config({"mock": True,
+                        "snapshot_path": str(snap)}, port=0).start()
+    try:
+        deadline = time.monotonic() + 10
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/readyz",
+                        timeout=5) as r:
+                    status = json.loads(r.read())["status"]
+                    break
+            except urllib.error.HTTPError:
+                time.sleep(0.02)
+        assert status == "ready"
+        body = json.dumps({"prompt": "p", "max_tokens": 8}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["choices"][0]["text"]
+    finally:
+        srv.shutdown()
+
+
+def test_double_drain_writes_one_snapshot(tmp_path, monkeypatch):
+    import reval_tpu.serving.session as session_mod
+
+    writes = []
+    real = session_mod.write_snapshot
+    monkeypatch.setattr(session_mod, "write_snapshot",
+                        lambda *a, **kw: (writes.append(a[0]),
+                                          real(*a, **kw))[1])
+    snap = str(tmp_path / "snap.json")
+    srv = serve_config({"mock": True, "snapshot_path": snap},
+                       port=0).start()
+    body = json.dumps({"prompt": "T " * 200, "max_tokens": 8}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=10).read()
+    srv.shutdown()
+    srv._session.close()                        # drain AGAIN, directly
+    srv._session.close()
+    assert writes == [snap]                     # exactly one write
+    doc = read_snapshot(snap)
+    assert doc is not None and len(doc["engine"]["prefix_chains"]) >= 1
+    assert not os.path.exists(snap + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# Warming readiness: server, client handshake, router poller
+# ---------------------------------------------------------------------------
+
+def _warm_server(tmp_path, rewarm_s=0.4, port=0, **cfg):
+    """A mock server whose boot replays a seeded snapshot slowly enough
+    that the ``warming`` window is observable."""
+    snap = str(tmp_path / "warm.snap")
+    if not os.path.exists(snap):
+        write_snapshot(snap, {"prefix_chains": [[7] * 128, [9] * 128],
+                              "template_stats": {"1": 2}})
+    return serve_config({"mock": True, "snapshot_path": snap,
+                         "mock_rewarm_s": rewarm_s, **cfg},
+                        port=port).start(), snap
+
+
+def _poll_readyz_until_ready(port, timeout=15.0):
+    """(statuses seen, final body) polling /readyz until 200."""
+    seen = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=5) as r:
+                body = json.loads(r.read())
+                seen.append(body["status"])
+                return seen, body
+        except urllib.error.HTTPError as exc:
+            body = json.loads(exc.read())
+            seen.append(body["status"])
+            assert exc.headers.get("Retry-After")
+            time.sleep(0.03)
+        except urllib.error.URLError:
+            time.sleep(0.03)
+    raise AssertionError(f"never ready; statuses: {seen[-5:]}")
+
+
+def test_readyz_warming_distinct_from_draining(tmp_path):
+    srv, _ = _warm_server(tmp_path)
+    try:
+        seen, body = _poll_readyz_until_ready(srv.port)
+        assert "warming" in seen                # the 503-warming window
+        assert seen[-1] == "ready"
+        assert body["warming"] is False
+        # restart-to-ready observed + warm prefixes counted
+        snap = srv._session.engine.stats.registry.snapshot()
+        assert snap["histograms"][
+            obs_metrics.RESTART_TO_READY]["count"] >= 1
+        assert snap["counters"][obs_metrics.RESTART_WARM_PREFIXES] == 2
+        # draining is a DIFFERENT status on the same route
+        srv._draining.set()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/readyz", timeout=5)
+        assert json.loads(err.value.read())["status"] == "draining"
+        srv._draining.clear()
+    finally:
+        srv.shutdown()
+
+
+def test_client_handshake_waits_through_warming(tmp_path):
+    srv, _ = _warm_server(tmp_path, rewarm_s=0.3)
+    try:
+        client = HTTPClientBackend(model_id="m", port=srv.port, temp=0.0,
+                                   prompt_type="direct",
+                                   wait_for_server_s=20, retry=FAST_RETRY)
+        assert client.infer_one("hello")        # arrived after the warm-up
+    finally:
+        srv.shutdown()
+
+
+def test_router_poller_polls_through_warming_no_strikes(tmp_path):
+    srv, _ = _warm_server(tmp_path, rewarm_s=0.4)
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         health_interval_s=0.05, eject_fails=2).start()
+    try:
+        # while warming: alive (no strikes, never ejected), not ready
+        deadline = time.monotonic() + 10
+        saw_warming = False
+        while time.monotonic() < deadline:
+            rep = router.statusz()["replicas"][0]
+            assert rep["state"] != "ejected"
+            assert rep["poll_fails"] == 0
+            if rep.get("warming"):
+                saw_warming = True
+            if rep["ready"]:
+                break
+            time.sleep(0.03)
+        assert saw_warming
+        assert router.readiness()["ready"]
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Crash-loop supervisor
+# ---------------------------------------------------------------------------
+
+class _FakeChild:
+    def __init__(self, rc):
+        self._rc = rc
+        self.pid = 4242
+
+    def wait(self):
+        return self._rc
+
+
+def _script_supervisor(codes, tmp_path, **kw):
+    """A supervisor whose children exit with ``codes`` in order."""
+    queue = list(codes)
+    sleeps = []
+    sup = Supervisor(spawn=lambda: _FakeChild(queue.pop(0)),
+                     postmortem_dir=str(tmp_path / "pm"),
+                     sleep=sleeps.append, **kw)
+    return sup, sleeps
+
+
+def test_supervisor_respawns_with_backoff_then_graceful_stop(tmp_path):
+    sup, sleeps = _script_supervisor([1, 1, 1, 0], tmp_path,
+                                     max_deaths=5, window_s=60.0,
+                                     base_backoff_s=0.25)
+    assert sup.run() == 0
+    assert sup.state == "stopped"
+    assert sup.respawns == 4
+    assert len(sleeps) == 3                     # one backoff per death
+    assert sleeps[0] < sleeps[1] < sleeps[2]    # exponential schedule
+    # one postmortem bundle per death
+    bundles = [p for p in os.listdir(tmp_path / "pm")
+               if p.startswith("postmortem-")]
+    assert len(bundles) == 3
+    with open(tmp_path / "pm" / bundles[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "supervisor_child_death"
+    assert doc["exit_code"] == 1
+
+
+def test_supervisor_goes_sticky_failed_after_rapid_death_budget(tmp_path):
+    sup, _ = _script_supervisor([1] * 10, tmp_path, max_deaths=3,
+                                window_s=60.0, base_backoff_s=0.01)
+    assert sup.run() == 1                       # stopped respawning
+    assert sup.state == "sticky_failed"
+    assert sup.respawns == 3                    # never flapped past budget
+    snap = sup._obs.snapshot()["counters"]
+    assert snap[obs_metrics.RESTART_DEATHS] == 3
+    assert snap[obs_metrics.RESTART_RESPAWNS] == 3
+
+
+def test_supervisor_deaths_age_out_of_the_window(tmp_path):
+    clock = {"t": 0.0}
+
+    def tick():
+        clock["t"] += 100.0                     # every observation is
+        return clock["t"]                       # 100 s after the last
+
+    sup = Supervisor(spawn=lambda: _FakeChild(1), max_deaths=2,
+                     window_s=60.0, base_backoff_s=0.01,
+                     postmortem_dir=str(tmp_path / "pm"),
+                     clock=tick, sleep=lambda s: None)
+    done = {}
+
+    def run():
+        done["rc"] = sup.run()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and sup.respawns < 8:
+        time.sleep(0.01)
+    assert sup.respawns >= 8                    # far past max_deaths=2:
+    sup.stop()                                  # deaths aged out each time
+    thread.join(timeout=10)
+    assert done["rc"] == 0 and sup.state == "stopped"
+
+
+def test_supervisor_graceful_child_exit_is_not_respawned(tmp_path):
+    sup, sleeps = _script_supervisor([0], tmp_path, max_deaths=3)
+    assert sup.run() == 0
+    assert sup.respawns == 1 and sleeps == []
+
+
+def test_serve_supervise_cli_runs_child_to_graceful_exit():
+    """`serve --supervise --mock --smoke N`: the child runs the smoke
+    and exits 0; the supervisor must treat that as a deliberate stop
+    (exit 0, no respawn loop)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "reval_tpu", "serve", "--supervise",
+         "--mock", "--smoke", "2"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[supervise]" in r.stdout
+    assert "--supervise" not in r.stdout.split("[supervise]")[1].split(
+        "\n")[0].replace("respawning `", "")   # child argv drops the flag
+
+
+# ---------------------------------------------------------------------------
+# tools/aot_cache.py CLI
+# ---------------------------------------------------------------------------
+
+def test_aot_cache_cli_ls_verify_gc_json_round_trip(tmp_path):
+    cache = AOTCache(str(tmp_path / "aot"))
+    fp = fingerprint(runtime_context(engine="cli-test"))
+    store_mock(cache, "prog.a", fp, compile_s=1.5)
+    store_mock(cache, "prog.b", fp, compile_s=2.5)
+
+    def run_cli(*argv):
+        return subprocess.run(
+            [sys.executable, "tools/aot_cache.py", *argv,
+             "--dir", str(tmp_path / "aot"), "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+
+    r = run_cli("ls")
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["command"] == "ls" and len(doc["entries"]) == 2
+    assert {e["entry"] for e in doc["entries"]} == {"prog.a", "prog.b"}
+    assert all(e["payload_bytes"] > 0 for e in doc["entries"])
+
+    r = run_cli("verify")
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["broken"] == 0
+    # corrupt one payload: verify must exit 1 and name the problem
+    bad = cache._base("prog.a", ("s",), fp) + ".bin"
+    with open(bad, "wb") as f:
+        f.write(b"zzz")
+    r = run_cli("verify")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["broken"] == 1
+    broken = [e for e in doc["entries"] if not e["ok"]][0]
+    assert broken["entry"] == "prog.a" and "checksum" in broken["problem"]
+
+    r = run_cli("gc", "--max-mb", "0")
+    assert r.returncode == 0
+    doc = json.loads(r.stdout)
+    assert doc["evicted"] == 2 and doc["entries_left"] == 0
+
+    # no directory at all → usage error, not a crash
+    r = subprocess.run(
+        [sys.executable, "tools/aot_cache.py", "ls", "--dir",
+         str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# The rolling-restart drill (the ISSUE 10 acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def make_replica(port=0, **cfg):
+    base = {"mock": True, "mock_echo": True}
+    base.update(cfg)
+    return serve_config(base, port=port).start()
+
+
+def make_router(servers, **kw):
+    kw.setdefault("health_interval_s", 0.05)
+    kw.setdefault("cooldown_s", 0.3)
+    kw.setdefault("eject_fails", 2)
+    return FleetRouter([f"127.0.0.1:{s.port}" for s in servers],
+                       port=0, **kw).start()
+
+
+def wait_router_ready(router, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.readiness()["ready"]:
+            return
+        time.sleep(0.02)
+    raise AssertionError("router never became ready")
+
+
+def hard_kill(server) -> None:
+    server._httpd.shutdown()
+    server._httpd.server_close()
+
+
+def admin(router, route, replica_id):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}{route}",
+        data=json.dumps({"replica": replica_id}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def post_completion(port, prompt, max_tokens=32):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps({"prompt": prompt,
+                         "max_tokens": max_tokens}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def post_router(router, prompt, max_tokens=32):
+    return post_completion(router.port, prompt, max_tokens)
+
+
+def replica_states(router):
+    return {r["id"]: r for r in router.statusz()["replicas"]}
+
+
+def _run_fleet(results_dir, port, repeats=2, resume=False):
+    from reval_tpu.fleet import FleetRunner
+
+    backend = HTTPClientBackend(model_id="drill", port=port, temp=0.0,
+                                prompt_type="direct", wait_for_server_s=30,
+                                retry=FAST_RETRY)
+    fleet = FleetRunner(dataset="humaneval", prompt_type="direct",
+                        repeats=repeats, backend=backend,
+                        results_dir=str(results_dir), progress=False,
+                        run_consistency=False, max_items=2,
+                        tasks=("coverage", "path"), resume=resume)
+    try:
+        return fleet.run()
+    finally:
+        backend.close()
+
+
+def _task_logs(results_dir):
+    logs = {}
+    for task in ("coverage", "path"):
+        d = os.path.join(str(results_dir), f"{task}@drill_direct_temp0.0")
+        paths = sorted((os.path.join(d, f) for f in os.listdir(d)),
+                       key=os.path.getctime)
+        logs[task] = [open(p).read() for p in paths]
+    return logs
+
+
+def test_rolling_restart_drill(tmp_path, monkeypatch):
+    """Drain A → graceful stop (snapshot) → supervised restart on the
+    same port → /readyz flips via ``warming`` with AOT hits > 0 and
+    ZERO fresh compiles → router rejoin → hard-kill B mid-fleet → zero
+    lost prompts, task logs byte-identical to a no-restart run."""
+    monkeypatch.setenv("REVAL_TPU_AOT_CACHE_DIR", str(tmp_path / "aot"))
+
+    # -- baseline: no restart, same router topology ----------------------
+    base_srv = make_replica(snapshot_path=str(tmp_path / "base.snap"))
+    base_router = make_router([base_srv])
+    wait_router_ready(base_router)
+    try:
+        base_result = _run_fleet(tmp_path / "base", base_router.port)
+    finally:
+        base_router.shutdown()
+        base_srv.shutdown()
+    assert "lost_prompts" not in base_result
+    # the baseline replica's cold boot populated the shared AOT dir
+    assert base_srv._session.engine.aot_counters()["fresh_compiles"] == 2
+
+    # -- the drill topology ----------------------------------------------
+    snap_a = str(tmp_path / "a.snap")
+    rep_a = make_replica(snapshot_path=snap_a)
+    rep_b = make_replica(snapshot_path=str(tmp_path / "b.snap"))
+    # every later boot hits the baseline's cached programs
+    assert rep_a._session.engine.aot_counters()["fresh_compiles"] == 0
+    router = make_router([rep_a, rep_b])
+    wait_router_ready(router)
+    a_id = f"127.0.0.1:{rep_a.port}"
+    supervisor = sup_thread = None
+    restarted: dict = {}
+    killed: dict = {}
+    try:
+        # seed A with traffic so its snapshot carries warm state —
+        # DIRECTLY, not through the router: the ring's template
+        # placement depends on the replicas' ephemeral ports, so a
+        # routed seed can land every template on B and leave A's
+        # snapshot chainless (no chains → nothing to replay → the
+        # warming window below is too short to observe)
+        post_completion(rep_a.port, TEMPLATE_A + "seed probe")
+        post_router(router, TEMPLATE_B + "seed probe")
+        assert rep_a._session.engine.warm_state()["prefix_chains"]
+
+        # 1. drain A through the router, then stop it gracefully: the
+        # drain writes the warm-state snapshot
+        assert admin(router, "/admin/drain", a_id)[
+            "replica"]["state"] == "draining"
+        rep_a.shutdown()
+        assert os.path.exists(snap_a)
+        # 2. rejoin the (now dead) replica: the health poller must see
+        # the corpse and eject it — the state the half-open recovery
+        # path rejoins from
+        admin(router, "/admin/rejoin", a_id)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if replica_states(router)[a_id]["state"] == "ejected":
+                break
+            time.sleep(0.02)
+        assert replica_states(router)[a_id]["state"] == "ejected"
+
+        # 3. supervised restart on the SAME port, warm: the supervisor's
+        # first spawn IS the restart
+        class _ReplicaChild:
+            def __init__(self):
+                self.server = make_replica(port=rep_a.port,
+                                           snapshot_path=snap_a,
+                                           mock_rewarm_s=0.6)
+                restarted["server"] = self.server
+                self.pid = os.getpid()
+                self.dead = threading.Event()
+
+            def wait(self):
+                self.dead.wait()
+                return 0
+
+        supervisor = Supervisor(spawn=_ReplicaChild, max_deaths=3,
+                                base_backoff_s=0.01,
+                                postmortem_dir=str(tmp_path / "pm"))
+        sup_thread = threading.Thread(target=supervisor.run, daemon=True)
+        sup_thread.start()
+        deadline = time.monotonic() + 10
+        while "server" not in restarted and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert supervisor.respawns == 1
+
+        # 4. /readyz flips via WARMING, with AOT hits and zero fresh
+        # compiles of the already-cached entries
+        seen, _ = _poll_readyz_until_ready(rep_a.port)
+        assert "warming" in seen and seen[-1] == "ready"
+        eng = restarted["server"]._session.engine
+        aot = eng.aot_counters()
+        assert aot["hits"] >= 2, aot
+        assert aot["fresh_compiles"] == 0, aot
+        reg = eng.stats.registry.snapshot()
+        assert reg["histograms"][obs_metrics.RESTART_TO_READY]["count"] >= 1
+        assert reg["counters"][obs_metrics.RESTART_WARM_PREFIXES] >= 1
+
+        # 5. the router rejoins the restarted replica (clean health poll
+        # out of ejection — the half-open recovery family) and routes
+        # through it again
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rep = replica_states(router)[a_id]
+            if rep["state"] == "healthy" and rep["ready"]:
+                break
+            time.sleep(0.03)
+        rep = replica_states(router)[a_id]
+        assert rep["state"] == "healthy" and rep["ready"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/metrics", timeout=10) as r:
+            from reval_tpu.obs.metrics import parse_prometheus
+
+            samples = parse_prometheus(r.read().decode())
+        assert samples[obs_metrics.ROUTER_EJECTIONS] >= 1
+        assert samples[obs_metrics.ROUTER_RECOVERIES] >= 1
+        # the federation carries the fleet's aot/restart counters too
+        assert samples[obs_metrics.AOT_HITS] >= 2
+        assert samples[
+            obs_metrics.RESTART_TO_READY + "_count"] >= 1
+
+        # 6. hard-kill the second replica mid-fleet: client retry +
+        # router failover must finish with zero lost prompts.  "Second"
+        # means whichever live replica the fleet's affinity actually
+        # lands traffic on — killing an idle replica would test nothing
+        live = {f"127.0.0.1:{rep_b.port}": rep_b,
+                f"127.0.0.1:{rep_a.port}": restarted["server"]}
+        before = {rid: srv._session.engine.stats.prompts
+                  for rid, srv in live.items()}
+
+        def assassin():
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                for rid, srv in live.items():
+                    if srv._session.engine.stats.prompts > before[rid]:
+                        hard_kill(srv)
+                        killed["id"] = rid
+                        return
+                time.sleep(0.002)
+
+        hit = threading.Thread(target=assassin)
+        hit.start()
+        drill_result = _run_fleet(tmp_path / "drill", router.port)
+        hit.join(timeout=60)
+        assert "lost_prompts" not in drill_result
+
+        # byte-identical task logs vs the no-restart baseline (echo-mode
+        # responses are prompt-determined, so this is a real check)
+        assert _task_logs(tmp_path / "drill") == _task_logs(
+            tmp_path / "base")
+        assert drill_result["repeats"] == base_result["repeats"]
+    finally:
+        router.shutdown()
+        if supervisor is not None:
+            supervisor.stop()
+            child = supervisor.child
+            if child is not None:
+                child.dead.set()
+            if sup_thread is not None:
+                sup_thread.join(timeout=10)
+        if ("server" in restarted
+                and killed.get("id") != f"127.0.0.1:{rep_a.port}"):
+            hard_kill(restarted["server"])
+        if killed.get("id") != f"127.0.0.1:{rep_b.port}":
+            rep_b.shutdown()
+    assert supervisor.state == "stopped"
+    assert killed, "the assassin never fired — the drill tested nothing"
+
+
+# ---------------------------------------------------------------------------
+# The real paged engine (slow tier): jax.export round trip + warm session
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_paged_engine_aot_and_snapshot_round_trip(tmp_path, monkeypatch):
+    """The real thing, tiny scale: a paged engine under a serving
+    session exports its compiled programs and snapshots its prefix tree
+    at drain; the next engine+session boots with ZERO fresh compiles
+    (all programs deserialized), replays the tree through real prefill,
+    and produces bit-identical greedy output."""
+    monkeypatch.setenv("REVAL_TPU_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    # pin the xla decode kernel: this host's Mosaic lowering cannot
+    # export the Pallas kernels (the canary would report unsupported)
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", "xla")
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+    from reval_tpu.serving import ContinuousSession
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    snap = str(tmp_path / "snap.json")
+    prompts = ["def add(a, b):\n    return a + b\n" * 8, "x = 1"]
+
+    def build():
+        return PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=2,
+                              page_size=128, max_seq_len=512)
+
+    e1 = build()
+    s1 = ContinuousSession(e1, snapshot_path=snap)
+    out1 = s1.submit(prompts, max_new_tokens=8).result()
+    aot1 = e1.aot_counters()
+    assert aot1["fresh_compiles"] >= 3 and aot1["unsupported"] == 0
+    s1.close()
+    e1.close()
+    doc = read_snapshot(snap)
+    assert doc is not None and doc["engine"]["prefix_chains"]
+
+    e2 = build()
+    s2 = ContinuousSession(e2, snapshot_path=snap)
+    deadline = time.monotonic() + 60
+    while s2.readiness()["warming"] and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not s2.readiness()["warming"]
+    # the warm restore itself already loaded the prefill/commit programs
+    aot2 = e2.aot_counters()
+    assert aot2["fresh_compiles"] == 0, aot2    # every program from disk
+    assert aot2["hits"] >= 2 and aot2["compile_s_saved"] > 0
+    reg = e2.stats.registry.snapshot()
+    assert reg["counters"][obs_metrics.RESTART_WARM_PREFIXES] >= 1
+    out2 = s2.submit(prompts, max_new_tokens=8).result()
+    assert out2 == out1                 # bit-identical via deserialized
+    aot2 = e2.aot_counters()
+    assert aot2["fresh_compiles"] == 0, aot2    # decode chunk cached too
+    assert aot2["hits"] >= 3
+    assert e2.stats.prefix_hit_tokens > 0       # the replayed tree serves
+    s2.close()
+    e2.close()

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Operator CLI over the persistent AOT executable cache.
+
+Out-of-band inspection/pruning of the directory the engines populate
+(``reval_tpu/inference/tpu/aot_cache.py`` — fingerprint-keyed serialized
+executables, one ``.json`` meta + one ``.bin`` payload per compile
+variant):
+
+    python tools/aot_cache.py ls     [--dir D] [--json]
+    python tools/aot_cache.py verify [--dir D] [--deep] [--json]
+    python tools/aot_cache.py gc     [--dir D] [--max-mb N] [--json]
+
+- ``ls``     — every committed entry: program name, payload bytes, the
+  compile seconds a hit saves, fingerprint prefix, age.
+- ``verify`` — integrity verdicts per entry (meta parses, payload
+  present, sha256 matches; ``--deep`` also round-trips the payload
+  through ``jax.export.deserialize``).  Exit 1 when anything is broken —
+  broken entries are safe (the loader degrades to a fresh compile), but
+  an operator pruning disk wants to know.
+- ``gc``     — evict least-recently-used entries until the directory
+  fits ``--max-mb`` (default ``REVAL_TPU_AOT_CACHE_MAX_MB``).
+
+Reads tolerate a concurrently writing engine: the commit protocol is
+payload-first + atomic meta rename, so a half-written entry shows up as
+"payload missing"/unreadable at worst, never as a torn load.
+
+``--json`` emits one machine-readable document (round-tripped in
+tests/test_warm_restart.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reval_tpu.env import env_str  # noqa: E402
+from reval_tpu.inference.tpu.aot_cache import AOTCache  # noqa: E402
+
+
+def _open_cache(args) -> AOTCache | None:
+    cache_dir = args.dir or env_str("REVAL_TPU_AOT_CACHE_DIR", "") or ""
+    if not cache_dir:
+        print("error: no cache directory (--dir or REVAL_TPU_AOT_CACHE_DIR)",
+              file=sys.stderr)
+        return None
+    if not os.path.isdir(cache_dir):
+        print(f"error: {cache_dir} is not a directory", file=sys.stderr)
+        return None
+    return AOTCache(cache_dir, max_mb=args.max_mb)
+
+
+def _row(entry: dict, now: float) -> dict:
+    return {"file": entry.get("file"),
+            "entry": entry.get("entry"),
+            "payload_bytes": entry.get("payload_bytes"),
+            "compile_s": entry.get("compile_s"),
+            "fingerprint": str(entry.get("fingerprint") or "")[:16],
+            "age_s": round(max(0.0, now - float(entry.get("mtime") or 0)), 1),
+            **({"error": entry["error"]} if entry.get("error") else {})}
+
+
+def cmd_ls(cache: AOTCache, args) -> int:
+    now = time.time()
+    rows = [_row(e, now) for e in cache.entries()]
+    _, total = cache._usage()
+    doc = {"command": "ls", "dir": cache.dir, "entries": rows,
+           "total_bytes": total}
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    print(f"AOT cache {cache.dir}: {len(rows)} entries, "
+          f"{total / (1 << 20):.1f} MB")
+    for r in rows:
+        mark = f"  [{r['error']}]" if r.get("error") else ""
+        print(f"  {str(r['entry']):<28} {str(r['payload_bytes']):>10}B "
+              f"compile {r['compile_s']}s  age {r['age_s']}s  "
+              f"fp {r['fingerprint']}…{mark}")
+    return 0
+
+
+def cmd_verify(cache: AOTCache, args) -> int:
+    now = time.time()
+    rows = []
+    bad = 0
+    for entry in cache.entries():
+        verdict = cache.verify_entry(entry, deep=args.deep)
+        row = _row(entry, now)
+        row["ok"] = verdict is None
+        if verdict is not None:
+            bad += 1
+            row["problem"] = verdict
+        rows.append(row)
+    doc = {"command": "verify", "dir": cache.dir, "deep": bool(args.deep),
+           "entries": rows, "checked": len(rows), "broken": bad}
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(f"AOT cache {cache.dir}: {len(rows)} checked, {bad} broken")
+        for r in rows:
+            status = "ok" if r["ok"] else f"BROKEN: {r['problem']}"
+            print(f"  {str(r['entry']):<28} {status}")
+    return 1 if bad else 0
+
+
+def cmd_gc(cache: AOTCache, args) -> int:
+    evicted = cache.gc(args.max_mb)
+    n, total = cache._usage()
+    doc = {"command": "gc", "dir": cache.dir, "evicted": evicted,
+           "entries_left": n, "total_bytes": total,
+           "bound_mb": args.max_mb if args.max_mb is not None
+           else cache.max_mb}
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(f"AOT cache {cache.dir}: evicted {evicted}, "
+              f"{n} entries / {total / (1 << 20):.1f} MB left "
+              f"(bound {doc['bound_mb']} MB)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/aot_cache.py",
+        description="Inspect / verify / prune the persistent AOT "
+                    "executable cache")
+    parser.add_argument("command", choices=("ls", "verify", "gc"))
+    parser.add_argument("--dir", default=None,
+                        help="cache directory (default "
+                             "REVAL_TPU_AOT_CACHE_DIR)")
+    parser.add_argument("--max-mb", type=int, default=None,
+                        help="gc size bound in MB (default "
+                             "REVAL_TPU_AOT_CACHE_MAX_MB)")
+    parser.add_argument("--deep", action="store_true",
+                        help="verify: also round-trip payloads through "
+                             "jax.export.deserialize")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+    cache = _open_cache(args)
+    if cache is None:
+        return 2
+    return {"ls": cmd_ls, "verify": cmd_verify, "gc": cmd_gc}[args.command](
+        cache, args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # `ls | head` closing stdout is not an error
+        os._exit(0)
