@@ -22,6 +22,17 @@ cannot see (acquisition ORDER, cross-thread writes at test time):
 - :mod:`.tilecontract` — every ``pallas_call`` in ``ops/`` declares a
   ``# tile: (sublane, lane)`` contract; resolvable BlockSpec/VMEM dims
   are lane/sublane-aligned;
+- :mod:`.meshreg`     — every ``Mesh``/``NamedSharding``/
+  ``PartitionSpec``/``shard_map`` constructor in the sharded core is
+  covered by a ``# mesh: axes=(..)`` contract resolved against the
+  ``parallel/mesh.py::AXES`` registry; shard_map ``in=``/``out=``
+  specs round-trip; collectives name a contract axis;
+- :mod:`.reshard`     — ``with_sharding_constraint`` / hot-region
+  ``device_put`` / zero-arg ``PartitionSpec()`` carry a reasoned
+  ``# reshard: <why>``;
+- :mod:`.enginezoo`   — every engine class implements, delegates, or
+  reasons away (``# not-supported:``) each declared surface member;
+  the committed ``ENGINE_SURFACE.md`` parity matrix stays fresh;
 - :mod:`.errboundary` — the serving layer raises only the
   ``serving/errors.py`` taxonomy;
 - :mod:`.envreg`      — every ``REVAL_TPU_*`` read goes through the
@@ -33,7 +44,9 @@ cannot see (acquisition ORDER, cross-thread writes at test time):
 - :mod:`.lockcheck`   — the runtime lock sanitizer
   (``REVAL_TPU_LOCKCHECK=1``);
 - :mod:`.jitcheck`    — the runtime recompile sanitizer + always-on
-  compile-variant tracker (``REVAL_TPU_JITCHECK=1``).
+  compile-variant tracker (``REVAL_TPU_JITCHECK=1``);
+- :mod:`.shardcheck`  — the runtime sharding sanitizer + always-on
+  declared-vs-actual sharding counters (``REVAL_TPU_SHARDCHECK=1``).
 
 Run everything with ``python tools/reval_lint.py`` or
 ``python -m reval_tpu lint``; the framework lives in :mod:`.core` and

@@ -9,18 +9,38 @@ Suppression policy: a violation is silenced only by an inline
 ``# lint: allow(<pass>) — <reason>`` on the violating line (or the
 comment block directly above it).  The reason is mandatory; every used
 suppression is counted and printed, so the report always states how much
-of the tree is exempted and why.
+of the tree is exempted and why.  A suppression whose pass reports NO
+violation at that site is a ZOMBIE (the code it excused is gone or
+fixed) and is itself reported — reasoned waivers cannot outlive their
+reason.
+
+Exit codes (stable, documented for pre-commit hooks):
+
+- ``0`` — clean (no unsuppressed violations);
+- ``1`` — at least one violation;
+- ``2`` — unrunnable: unknown pass name, ``--changed-only`` outside a
+  git work tree, or other usage errors.
+
+``--json`` emits one machine-readable object (per-pass violation counts
+and wall time, every violation/suppression, the zombie list) instead of
+the human report; ``--changed-only`` scopes the REPORTED violations to
+files touched per ``git status`` (all passes still run — cross-file
+checks need the whole tree — so this trades nothing but output noise;
+registry-level findings anchored at unchanged files are filtered, which
+is why the full run stays the tier-1 authority).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import subprocess
 import time
 from dataclasses import dataclass, field
 
-from . import (detmatrix, envreg, errboundary, hostsync, hotpath, jitreg,
-               locks, tilecontract)
+from . import (detmatrix, enginezoo, envreg, errboundary, hostsync, hotpath,
+               jitreg, locks, meshreg, reshard, tilecontract)
 from .core import Suppression, Violation, collect_sources
 from .metrics_events import run_events, run_metrics
 
@@ -33,6 +53,9 @@ PASSES = {
     "jit": jitreg.run,
     "hostsync": hostsync.run,
     "tilecontract": tilecontract.run,
+    "mesh": meshreg.run,
+    "reshard": reshard.run,
+    "enginezoo": enginezoo.run,
     "errors": errboundary.run,
     "env": envreg.run,
     "metrics": run_metrics,
@@ -52,6 +75,9 @@ class LintReport:
     violations: list[Violation] = field(default_factory=list)
     suppressions: list[Suppression] = field(default_factory=list)
     per_pass: dict[str, int] = field(default_factory=dict)
+    #: per-pass wall time, seconds (``--json`` surfaces it so slow-pass
+    #: regressions are visible before they threaten the <10 s bar)
+    pass_seconds: dict[str, float] = field(default_factory=dict)
     files: int = 0
     elapsed_s: float = 0.0
 
@@ -77,7 +103,11 @@ def run_lint(root: str | None = None,
         # an unparseable file is an UNLINTED file — never report "ok"
         # over a tree a pass could not actually see
         report.violations.append(Violation("parse", rel, 0, msg))
+    #: (path, allow-line) pairs that silenced (or failed to reason for)
+    #: at least one finding — everything else with an allow is a zombie
+    used_allows: set[tuple[str, int]] = set()
     for name in names:
+        p0 = time.perf_counter()
         found = PASSES[name](sources, root)
         kept = 0
         for v in found:
@@ -89,6 +119,7 @@ def run_lint(root: str | None = None,
                 kept += 1
                 continue
             reason, allow_line = allow
+            used_allows.add((v.path, allow_line))
             if not reason:
                 # an allow with no stated reason is itself a violation:
                 # the suppression ledger is only useful if it explains
@@ -100,8 +131,80 @@ def run_lint(root: str | None = None,
             report.suppressions.append(Suppression(
                 name, v.path, v.line, reason, v.message))
         report.per_pass[name] = kept
+        report.pass_seconds[name] = time.perf_counter() - p0
+    _check_zombie_allows(sources, names, used_allows, report)
     report.elapsed_s = time.perf_counter() - t0
     return report
+
+
+def _check_zombie_allows(sources, names_run: list[str],
+                         used: set[tuple[str, int]],
+                         report: LintReport) -> None:
+    """Stale-suppression detection: an ``# lint: allow`` whose pass(es)
+    all ran and reported nothing at that site excused code that no
+    longer needs excusing — flag it so the waiver dies with the code.
+    An allow naming an unknown pass can never be used and is flagged
+    outright (the classic typo'd-pass-name silent no-op)."""
+    ran = set(names_run)
+    all_passes = set(PASSES)
+    for rel, src in sorted(sources.items()):
+        for line, (names, _reason) in sorted(src.allows.items()):
+            unknown = names - all_passes - {"*"}
+            for bad in sorted(unknown):
+                report.violations.append(Violation(
+                    "suppression", rel, line,
+                    f"allow names unknown pass {bad!r} — it can never "
+                    f"match a finding (available: {sorted(PASSES)})"))
+            if (rel, line) in used:
+                continue
+            covered = names - unknown
+            if "*" in names:
+                eligible = ran == all_passes
+            else:
+                eligible = bool(covered) and covered <= ran
+            if eligible:
+                report.violations.append(Violation(
+                    "suppression", rel, line,
+                    f"zombie suppression: no "
+                    f"{'/'.join(sorted(covered)) or 'lint'} violation at "
+                    f"this site — the code it excused is gone; remove "
+                    f"the stale allow"))
+
+
+def scope_to_files(report: LintReport, files: set[str]) -> LintReport:
+    """A copy of ``report`` with violations/suppressions restricted to
+    ``files`` (repo-relative, posix-normalised) — the ``--changed-only``
+    fast path.  Per-pass counts are recomputed; files/timing stay."""
+    norm = {f.replace("\\", "/") for f in files}
+    scoped = LintReport(root=report.root, files=report.files,
+                        elapsed_s=report.elapsed_s,
+                        pass_seconds=dict(report.pass_seconds))
+    scoped.violations = [v for v in report.violations
+                         if v.path.replace("\\", "/") in norm]
+    scoped.suppressions = [s for s in report.suppressions
+                           if s.path.replace("\\", "/") in norm]
+    for name, _count in report.per_pass.items():
+        scoped.per_pass[name] = sum(1 for v in scoped.violations
+                                    if v.pass_name == name)
+    return scoped
+
+
+def changed_files(root: str) -> set[str]:
+    """Files touched vs HEAD (staged + unstaged) plus untracked ones —
+    the pre-commit scope.  Raises ``RuntimeError`` outside a git tree."""
+    out: set[str] = set()
+    for args in (["diff", "--name-only", "HEAD"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(["git", "-C", root] + args,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed "
+                f"({proc.stderr.strip() or 'not a git work tree?'}) — "
+                f"--changed-only needs a git checkout")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def render(report: LintReport) -> str:
@@ -126,14 +229,60 @@ def render(report: LintReport) -> str:
     return "\n".join(lines)
 
 
+def render_json(report: LintReport) -> str:
+    """One machine-readable object: per-pass counts + wall time, every
+    violation/suppression — the pre-commit/CI consumption format."""
+    doc = {
+        "ok": report.ok,
+        "files": report.files,
+        "elapsed_s": round(report.elapsed_s, 4),
+        "passes": {
+            name: {
+                "violations": report.per_pass.get(name, 0),
+                "suppressed": sum(1 for s in report.suppressions
+                                  if s.pass_name == name),
+                "elapsed_s": round(report.pass_seconds.get(name, 0.0), 4),
+            } for name in report.per_pass
+        },
+        "violations": [
+            {"pass": v.pass_name, "path": v.path, "line": v.line,
+             "message": v.message} for v in report.violations
+        ],
+        "suppressions": [
+            {"pass": s.pass_name, "path": s.path, "line": s.line,
+             "reason": s.reason, "message": s.message}
+            for s in report.suppressions
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def write_engine_matrix(root: str | None = None) -> str:
+    """(Re)generate the committed engine feature-parity matrix
+    (``ENGINE_SURFACE.md``); returns the path written."""
+    root = os.path.abspath(root or _repo_root())
+    sources = collect_sources(root)
+    problems: list[Violation] = []
+    infos = enginezoo.collect(sources, problems)
+    if problems or not infos:
+        raise RuntimeError("cannot build the engine matrix: "
+                           + "; ".join(v.message for v in problems))
+    path = os.path.join(root, enginezoo.ARTIFACT)
+    with open(path, "w") as f:
+        f.write(enginezoo.render_matrix(infos))
+    return path
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="reval_tpu lint",
         description="Codebase-native static analysis: lock discipline, "
                     "hot-path purity, jit-entry registry, host-sync "
-                    "discipline, Pallas tile contracts, typed-error "
-                    "boundary, env registry, metric/event namespaces, "
-                    "determinism-matrix schema")
+                    "discipline, Pallas tile contracts, mesh/sharding "
+                    "contracts, reshard reasoning, engine-surface "
+                    "conformance, typed-error boundary, env registry, "
+                    "metric/event namespaces, determinism-matrix schema. "
+                    "Exit codes: 0 clean, 1 violations, 2 unrunnable.")
     parser.add_argument("passes", nargs="*", metavar="PASS",
                         help=f"passes to run (default: all of "
                              f"{', '.join(PASSES)})")
@@ -147,15 +296,42 @@ def main(argv: list[str] | None = None) -> int:
                              "(locks/hotpath/errors) explicitly there")
     parser.add_argument("--list", action="store_true",
                         help="list available passes and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one machine-readable JSON object "
+                             "(per-pass violations + wall time) instead "
+                             "of the human report")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only violations in files touched "
+                             "per git status (fast pre-commit scope; "
+                             "all passes still run — the full report "
+                             "remains the authority)")
+    parser.add_argument("--write-engine-matrix", action="store_true",
+                        help="(re)generate ENGINE_SURFACE.md from the "
+                             "tree and exit (the enginezoo pass fails "
+                             "when the committed artifact is stale)")
     args = parser.parse_args(argv)
     if args.list:
         for name in PASSES:
             print(name)
+        return 0
+    if args.write_engine_matrix:
+        try:
+            print(write_engine_matrix(args.root))
+        except RuntimeError as exc:
+            print(f"reval-lint: {exc}")
+            return 2
         return 0
     try:
         report = run_lint(args.root, args.passes or None)
     except ValueError as exc:
         print(f"reval-lint: {exc}")
         return 2
-    print(render(report))
+    if args.changed_only:
+        try:
+            changed = changed_files(report.root)
+        except RuntimeError as exc:
+            print(f"reval-lint: {exc}")
+            return 2
+        report = scope_to_files(report, changed)
+    print(render_json(report) if args.json else render(report))
     return 0 if report.ok else 1

@@ -1,0 +1,272 @@
+"""Engine-surface conformance pass (``enginezoo``).
+
+MULTICHIP dry-runs nine parallelism legs but each is its own engine
+class, so every feature (prefix cache, AOT cache, warm restarts, spec
+decode) lands N times or not at all — ROADMAP item 3 exists to collapse
+the zoo into ONE mesh-native engine.  Until that lands, this pass makes
+the zoo's feature skew EXPLICIT: the shared engine surface is declared
+once (:data:`SURFACE`), and every engine class must *implement* each
+member, *delegate* it (inherit from a registered base), or carry a
+reasoned ``# not-supported: <member> — <why>`` marker in its class
+body.  A new engine method that is not part of the declared surface is
+an ORPHAN — the "lands in one engine out of nine" failure mode — and
+must either join :data:`SURFACE` (forcing a zoo-wide decision) or be
+marked ``# engine-local: <why>`` at its ``def``.
+
+The resulting engine × member matrix is COMMITTED as
+``ENGINE_SURFACE.md`` (regenerate with
+``python tools/reval_lint.py --write-engine-matrix``); the pass fails
+when the artifact goes stale, so item-3 collapse progress — and any new
+skew — is visible in every diff.
+
+Suppression: ``# lint: allow(enginezoo) — <reason>`` (driver policy).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import SourceFile, Violation
+
+PASS = "enginezoo"
+
+#: the artifact the matrix is committed as, repo-relative
+ARTIFACT = "ENGINE_SURFACE.md"
+
+#: engine class -> defining file (repo-relative)
+ENGINES: dict[str, str] = {
+    "TPUEngine": "reval_tpu/inference/tpu/engine.py",
+    "PagedTPUEngine": "reval_tpu/inference/tpu/paged_engine.py",
+    "DataParallelPagedEngine": "reval_tpu/inference/tpu/dp_paged.py",
+    "PipelinedTPUEngine": "reval_tpu/inference/tpu/pp_engine.py",
+    "MockStepEngine": "reval_tpu/serving/mock_engine.py",
+}
+
+#: the shared engine surface: member -> one-line meaning.  Adding a
+#: member here forces a zoo-wide decision (implement / delegate /
+#: reasoned not-supported) for EVERY engine.
+SURFACE: dict[str, str] = {
+    "from_pretrained": "construct from a checkpoint path",
+    "generate": "whole-batch generation entry point",
+    "close": "release driver threads / pools / native runtime state",
+    "stats": "the EngineStats counters/histograms surface",
+    "jit_counters": "compile-variant snapshot of the tracked jit entries",
+    "aot_counters": "persistent AOT executable-cache counters",
+    "prefix_cache_counters": "radix prefix-cache hit/eviction counters",
+    "warm_state": "warm-restart snapshot (prefix chains, template stats)",
+    "rewarm": "replay a warm-state snapshot through real prefill",
+    "submit_request": "continuous-batching request admission",
+    "release_request": "continuous-batching request teardown",
+    "new_drive_state": "fresh per-driver drive-loop state",
+    "encode_clipped": "tokenize a prompt clipped to the engine's budget",
+    "request_keys": "per-request PRNG keys for sampled decode",
+}
+
+_NOT_SUPPORTED_RE = re.compile(
+    r"#\s*not-supported:\s*([A-Za-z_][A-Za-z0-9_]*)\s*(?:[—:–-]+\s*(\S.*))?$")
+_ENGINE_LOCAL_RE = re.compile(r"#\s*engine-local\s*(?:[:—])\s*(\S.*)?$")
+
+
+class EngineInfo:
+    def __init__(self, name: str, rel: str, node: ast.ClassDef):
+        self.name = name
+        self.rel = rel
+        self.node = node
+        self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        #: member -> def line (methods, properties, self.X ctor attrs)
+        self.members: dict[str, int] = {}
+        #: member -> (reason, line) from ``# not-supported:`` markers
+        self.not_supported: dict[str, tuple[str, int]] = {}
+        #: public defs in the class body: name -> (line, has engine-local)
+        self.public_defs: dict[str, tuple[int, bool]] = {}
+
+
+def _collect_engine(src: SourceFile, name: str) -> EngineInfo | None:
+    for node in src.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            break
+    else:
+        return None
+    info = EngineInfo(name, src.rel, node)
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.members[stmt.name] = stmt.lineno
+            if not stmt.name.startswith("_"):
+                local = any(_ENGINE_LOCAL_RE.search(c)
+                            for _, c in src.comment_block(stmt.lineno))
+                info.public_defs[stmt.name] = (stmt.lineno, local)
+            # attributes assigned in the ctor count as implemented
+            # (EngineStats rides ``self.stats = ...``)
+            if stmt.name == "__init__":
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                info.members.setdefault(t.attr, sub.lineno)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    info.members[t.id] = stmt.lineno
+    end = getattr(node, "end_lineno", None) or node.lineno
+    for line in range(node.lineno, end + 1):
+        comment = src.comments.get(line)
+        if not comment:
+            continue
+        m = _NOT_SUPPORTED_RE.search(comment)
+        if m:
+            info.not_supported[m.group(1)] = ((m.group(2) or "").strip(),
+                                              line)
+    return info
+
+
+def _resolve(member: str, info: EngineInfo,
+             infos: dict[str, EngineInfo]) -> tuple[str, str]:
+    """('implemented' | 'delegated' | 'not-supported' | 'missing',
+    detail) for one engine × member cell."""
+    if member in info.members:
+        return "implemented", ""
+    if member in info.not_supported:
+        return "not-supported", info.not_supported[member][0]
+    for base in info.bases:
+        base_info = infos.get(base)
+        if base_info is None:
+            continue
+        status, detail = _resolve(member, base_info, infos)
+        if status == "implemented" or status == "delegated":
+            return "delegated", base
+        if status == "not-supported":
+            return "not-supported", f"via {base}: {detail}" if detail else \
+                f"via {base}"
+    return "missing", ""
+
+
+def collect(sources: dict[str, SourceFile], out: list[Violation]
+            ) -> dict[str, EngineInfo]:
+    infos: dict[str, EngineInfo] = {}
+    for name, rel in ENGINES.items():
+        src = sources.get(rel)
+        if src is None:
+            out.append(Violation(
+                PASS, rel, 0,
+                f"engine file for {name} not found — update the "
+                f"enginezoo ENGINES registry"))
+            continue
+        info = _collect_engine(src, name)
+        if info is None:
+            out.append(Violation(
+                PASS, rel, 0,
+                f"engine class {name} not found in {rel} — update the "
+                f"enginezoo ENGINES registry"))
+            continue
+        infos[name] = info
+    return infos
+
+
+def check(infos: dict[str, EngineInfo], out: list[Violation]) -> None:
+    for name, info in infos.items():
+        for member in SURFACE:
+            status, _ = _resolve(member, info, infos)
+            if status == "missing":
+                out.append(Violation(
+                    PASS, info.rel, info.node.lineno,
+                    f"engine {name} neither implements, inherits, nor "
+                    f"declares '# not-supported: {member} — <why>' for "
+                    f"surface member {member!r}"))
+        for member, (reason, line) in info.not_supported.items():
+            if member not in SURFACE:
+                out.append(Violation(
+                    PASS, info.rel, line,
+                    f"not-supported marker for {member!r}, which is not "
+                    f"a declared surface member"))
+            elif not reason:
+                out.append(Violation(
+                    PASS, info.rel, line,
+                    f"not-supported marker for {member!r} without a "
+                    f"reason — say WHY this engine lacks it"))
+            elif member in info.members:
+                out.append(Violation(
+                    PASS, info.rel, line,
+                    f"zombie not-supported marker: {name} DOES "
+                    f"implement {member!r} (line "
+                    f"{info.members[member]}) — remove the marker"))
+        for member, (line, local) in info.public_defs.items():
+            if member in SURFACE or local:
+                continue
+            out.append(Violation(
+                PASS, info.rel, line,
+                f"orphan engine method {name}.{member}: public but not "
+                f"a declared surface member — add it to "
+                f"analysis/enginezoo.py::SURFACE (zoo-wide decision) or "
+                f"mark the def '# engine-local: <why>'"))
+
+
+def render_matrix(infos: dict[str, EngineInfo]) -> str:
+    """The committed feature-parity matrix (ENGINE_SURFACE.md)."""
+    names = [n for n in ENGINES if n in infos]
+    lines = [
+        "# Engine feature-parity matrix",
+        "",
+        "Generated by the `enginezoo` lint pass — DO NOT EDIT.",
+        "Regenerate with `python tools/reval_lint.py "
+        "--write-engine-matrix`.",
+        "",
+        "Legend: `yes` implemented here, `-> Base` delegated to a base "
+        "class, `NO: <why>` a reasoned gap.  Every `NO` is a feature "
+        "the ROADMAP item-3 engine collapse erases; the per-engine "
+        "coverage row is the collapse-progress metric.",
+        "",
+        "| member | " + " | ".join(names) + " |",
+        "|" + "---|" * (len(names) + 1),
+    ]
+    coverage = {n: 0 for n in names}
+    for member, meaning in SURFACE.items():
+        cells = []
+        for n in names:
+            status, detail = _resolve(member, infos[n], infos)
+            if status == "implemented":
+                cells.append("yes")
+                coverage[n] += 1
+            elif status == "delegated":
+                cells.append(f"-> {detail}")
+                coverage[n] += 1
+            elif status == "not-supported":
+                cells.append(f"NO: {detail}" if detail else "NO")
+            else:
+                cells.append("MISSING")
+        lines.append(f"| `{member}` — {meaning} | " + " | ".join(cells)
+                     + " |")
+    total = len(SURFACE)
+    lines.append("| **coverage** | " + " | ".join(
+        f"{coverage[n]}/{total}" for n in names) + " |")
+    return "\n".join(lines) + "\n"
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    import os
+
+    out: list[Violation] = []
+    infos = collect(sources, out)
+    if not infos:
+        return out
+    check(infos, out)
+    # the committed artifact must match the tree it describes
+    expected = render_matrix(infos)
+    path = os.path.join(root, ARTIFACT)
+    try:
+        with open(path) as f:
+            actual = f.read()
+    except OSError:
+        out.append(Violation(
+            PASS, ARTIFACT, 0,
+            f"feature-parity matrix artifact {ARTIFACT} missing — "
+            f"generate it with tools/reval_lint.py --write-engine-matrix"))
+        return out
+    if actual != expected:
+        out.append(Violation(
+            PASS, ARTIFACT, 0,
+            f"{ARTIFACT} is stale — the engine surface changed; "
+            f"regenerate with tools/reval_lint.py --write-engine-matrix"))
+    return out

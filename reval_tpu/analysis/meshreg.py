@@ -1,0 +1,579 @@
+"""Mesh-discipline pass (``mesh``).
+
+ROADMAP item 3 collapses the nine-engine parallelism zoo into ONE
+mesh-native paged engine over an explicit ``Mesh`` +
+``NamedSharding``/``shard_map`` — a refactor that rewrites exactly the
+axis names, partition specs, and collectives this pass pins down.
+Today those contracts live in scattered string literals: a typo'd axis
+(``"ttp"``) surfaces as a runtime XLA "unbound axis name" error deep in
+a trace, and a spec drifting from its ``shard_map``'s ``in_specs`` is
+the silent-resharding divergence the backend-reproducibility study
+(PAPERS.md, arxiv 2605.19537) shows corrupting bit-identical parity.
+
+The one registry is ``reval_tpu/parallel/mesh.py::AXES`` — a literal
+dict of the canonical axis names (dp/pp/sp/ep/tp), read from the AST so
+lint stays jax-free.  Every ``Mesh`` / ``NamedSharding`` /
+``PartitionSpec`` / ``*shard_map`` constructor in the sharded core
+(``parallel/``, ``models/``, ``inference/tpu/``) must be covered by a
+one-line contract:
+
+    # mesh: axes=(pp) in=(P(pp), P()) out=(P(),) via=(axis_name)
+
+anchored on the constructor's statement (or the comment block above
+it), or on the enclosing ``def`` — a def-level contract covers every
+constructor and collective in the function body, which is how spec-rule
+tables (``parallel/sharding.py``) declare once instead of per line.
+
+Grammar (one line, statement-level wins over def-level):
+
+- ``axes=(a, b)`` — mandatory.  The axis names this region may place or
+  reduce over; each must be registered in ``AXES``, and every literal
+  axis string inside a covered constructor must be in this set (a
+  literal under ``axes=()`` is a violation).
+- ``in=(...)`` / ``out=(...)`` — mandatory for ``shard_map``
+  constructors.  Either the literal spec list, which must round-trip
+  EXACTLY against the call's ``in_specs``/``out_specs`` literals
+  (quotes and whitespace are normalised: ``P(pp)`` ≡ ``P("pp")``), or
+  the word ``dynamic`` when the call computes its specs — but declaring
+  ``dynamic`` over literal call specs (or literal specs over a computed
+  call) is a violation: the annotation must be as precise as the code
+  allows.
+- ``via=(p, q)`` — parameter names through which axis names flow at
+  call time (ring attention's ``axis_name``).  A collective whose axis
+  argument is one of these names is accepted; any other non-literal
+  axis is a violation.
+
+Collectives (``lax.psum`` / ``pmean`` / ``pmax`` / ``pmin`` /
+``all_gather`` / ``ppermute`` / ``all_to_all`` / ``pshuffle`` /
+``psum_scatter`` / ``axis_index`` / ``pcast``) must sit inside a
+contract and name an axis from it — literally, or through ``via=``.  A
+collective outside any contract, or naming an undeclared axis, is a
+lint violation instead of a runtime XLA error.
+
+Suppression: ``# lint: allow(mesh) — <reason>`` (driver policy).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import SourceFile, Violation
+from .core import call_chain as _call_chain
+
+PASS = "mesh"
+
+#: directories whose mesh constructors must be declared
+SCOPE_PREFIXES = ("reval_tpu/parallel/", "reval_tpu/models/",
+                  "reval_tpu/inference/tpu/")
+
+#: where the axis registry lives (parsed from the AST, never imported)
+AXES_FILE = "reval_tpu/parallel/mesh.py"
+
+#: jax.lax collective tails and where their axis argument sits
+#: (positional index; kwarg fallbacks are handled uniformly)
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "ppermute": 1, "all_to_all": 1, "pshuffle": 1, "psum_scatter": 1,
+    "axis_index": 0, "pcast": 1,
+}
+
+#: constructor class names (attribute tails); bare-name calls count
+#: only when the file imports the class (possibly aliased)
+_CTOR_NAMES = {"PartitionSpec", "NamedSharding", "Mesh"}
+
+_MESH_RE = re.compile(r"#\s*mesh:\s*(.*)$")
+_KEY_RE = re.compile(r"(axes|in|out|via)=\(")
+
+
+class Contract:
+    """One parsed ``# mesh:`` annotation."""
+
+    def __init__(self, line: int):
+        self.line = line
+        self.axes: set[str] | None = None
+        self.in_specs: list[str] | str | None = None    # list | "dynamic"
+        self.out_specs: list[str] | str | None = None
+        self.via: set[str] = set()
+
+
+def _split_top(text: str) -> list[str]:
+    """Split on top-level commas (parens nest)."""
+    parts, depth, cur = [], 0, []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def _canon(spec: str) -> str:
+    """Canonical spec text: quotes and whitespace stripped, the
+    PartitionSpec spelling collapsed to ``P``."""
+    out = re.sub(r"[\s'\"]", "", spec)
+    return re.sub(r"^PartitionSpec\(", "P(", out)
+
+
+def parse_contract(comment: str, line: int
+                   ) -> tuple[Contract | None, str | None]:
+    """(contract, error) from one comment line; (None, None) when the
+    line carries no mesh marker at all."""
+    m = _MESH_RE.search(comment)
+    if not m:
+        return None, None
+    tail = m.group(1)
+    contract = Contract(line)
+    consumed: list[tuple[int, int]] = []
+    for km in _KEY_RE.finditer(tail):
+        depth, end = 1, km.end()
+        while end < len(tail) and depth:
+            if tail[end] == "(":
+                depth += 1
+            elif tail[end] == ")":
+                depth -= 1
+            end += 1
+        if depth:
+            return None, (f"mesh contract: unbalanced parens in "
+                          f"{km.group(1)}=(...)")
+        body = tail[km.end():end - 1]
+        consumed.append((km.start(), end))
+        key = km.group(1)
+        if key == "axes":
+            names = _split_top(body)
+            bad = [n for n in names if not re.fullmatch(r"[a-z][a-z0-9_]*", n)]
+            if bad:
+                return None, f"mesh contract: malformed axis name(s) {bad}"
+            contract.axes = set(names)
+        elif key == "via":
+            contract.via = set(_split_top(body))
+        else:
+            if body.strip() == "dynamic":
+                value: list[str] | str = "dynamic"
+            else:
+                value = [_canon(s) for s in _split_top(body)]
+            if key == "in":
+                contract.in_specs = value
+            else:
+                contract.out_specs = value
+    leftover = "".join(ch for i, ch in enumerate(tail)
+                       if not any(a <= i < b for a, b in consumed)).strip()
+    if leftover:
+        return None, (f"mesh contract has unparseable tail {leftover!r} "
+                      f"(grammar: axes=(..) in=(..) out=(..) via=(..))")
+    if contract.axes is None:
+        return None, "mesh contract missing the mandatory axes=(...) part"
+    return contract, None
+
+
+def registry_axes(sources: dict[str, SourceFile],
+                  out: list[Violation]) -> set[str] | None:
+    """The AXES names, parsed literally from parallel/mesh.py."""
+    src = sources.get(AXES_FILE)
+    if src is None:
+        out.append(Violation(
+            PASS, AXES_FILE, 0,
+            "AXES registry file not found — the mesh pass needs the "
+            "literal axis-name dict in parallel/mesh.py"))
+        return None
+    for node in src.tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if not (isinstance(target, ast.Name) and target.id == "AXES"):
+            continue
+        value = getattr(node, "value", None)
+        if not isinstance(value, ast.Dict) or not all(
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                for k in value.keys):
+            out.append(Violation(
+                PASS, AXES_FILE, node.lineno,
+                "AXES must be a literal dict of axis-name strings — the "
+                "pass reads it from the AST"))
+            return None
+        return {k.value for k in value.keys}
+    out.append(Violation(
+        PASS, AXES_FILE, 0,
+        "no module-level AXES dict found in parallel/mesh.py"))
+    return None
+
+
+def _ctor_aliases(src: SourceFile) -> set[str]:
+    """Bare names that refer to the sharding constructor classes in this
+    file (``from jax.sharding import PartitionSpec as P`` → {'P', ...})."""
+    names: set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax.sharding"
+                or node.module.endswith(".sharding")):
+            for alias in node.names:
+                if alias.name in _CTOR_NAMES:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _ctor_kind(call: ast.Call, aliases: set[str]) -> str | None:
+    chain = _call_chain(call.func)
+    if not chain:
+        return None
+    tail = chain[-1]
+    if tail.endswith("shard_map"):
+        return "shard_map"
+    if tail in _CTOR_NAMES:
+        return tail
+    if len(chain) == 1 and tail in aliases:
+        return "PartitionSpec"
+    return None
+
+
+def _literal_axis_strings(call: ast.Call) -> list[tuple[int, str]]:
+    """(line, name) for every string constant in an axis position inside
+    the call subtree.  Subscript slices (``div["kv_heads"]``) and dict
+    keys are data lookups, not axis names, and stay out."""
+    excluded: set[int] = set()
+    for node in ast.walk(call):
+        if isinstance(node, ast.Subscript):
+            excluded.update(id(n) for n in ast.walk(node.slice))
+        elif isinstance(node, ast.Dict):
+            for key in node.keys:
+                if key is not None:
+                    excluded.update(id(n) for n in ast.walk(key))
+    out = []
+    for node in ast.walk(call):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in excluded):
+            out.append((node.lineno, node.value))
+    return out
+
+
+def _literal_spec(node: ast.expr, aliases: set[str]) -> str | None:
+    """Canonical text of one literal P(...) spec, else None."""
+    if not (isinstance(node, ast.Call)
+            and _ctor_kind(node, aliases) == "PartitionSpec"):
+        return None
+    parts = []
+    for arg in node.args:
+        if isinstance(arg, ast.Constant) and (
+                arg.value is None or isinstance(arg.value, str)):
+            parts.append("None" if arg.value is None else str(arg.value))
+        elif isinstance(arg, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in arg.elts):
+            parts.append("(" + ",".join(e.value for e in arg.elts) + ")")
+        else:
+            return None
+    return "P(" + ",".join(parts) + ")"
+
+
+def _literal_spec_list(node: ast.expr, aliases: set[str]
+                       ) -> list[str] | None:
+    """Canonical spec list of a literal in_specs/out_specs expression:
+    a tuple/list of literal P(...) calls, or one bare literal P(...)."""
+    single = _literal_spec(node, aliases)
+    if single is not None:
+        return [single]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            spec = _literal_spec(el, aliases)
+            if spec is None:
+                return None
+            out.append(spec)
+        return out
+    return None
+
+
+def _spec_axes(canon: str) -> list[str]:
+    """Axis names inside one canonical ``P(...)`` spec string —
+    ``None`` entries (and nested-tuple parens) are placement syntax,
+    not axes."""
+    body = canon.removeprefix("P(").removesuffix(")")
+    return [part for part in re.split(r"[,()]", body)
+            if part and part != "None"]
+
+
+def _lax_aliases(src: SourceFile) -> dict[str, str]:
+    """Bare names bound to jax.lax collectives in this file
+    (``from jax.lax import psum as ps`` → {'ps': 'psum'})."""
+    out: dict[str, str] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax.lax" or node.module.endswith(".lax")):
+            for alias in node.names:
+                if alias.name in _COLLECTIVES:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _collective_tail(call: ast.Call,
+                     lax_aliases: dict[str, str]) -> str | None:
+    chain = _call_chain(call.func)
+    if len(chain) >= 2 and chain[-2] == "lax" and chain[-1] in _COLLECTIVES:
+        return chain[-1]
+    if len(chain) == 1 and chain[0] in lax_aliases:
+        return lax_aliases[chain[0]]
+    return None
+
+
+class _FileChecker:
+    def __init__(self, src: SourceFile, axes_registry: set[str] | None,
+                 out: list[Violation]):
+        self.src = src
+        self.registry = axes_registry
+        self.out = out
+        self.aliases = _ctor_aliases(src)
+        self.lax_aliases = _lax_aliases(src)
+        self.seen: set[int] = set()
+        #: def line -> parsed contract (cached; None = parsed, absent)
+        self._def_contracts: dict[int, Contract | None] = {}
+
+    # -- contract lookup ---------------------------------------------------
+    def _contract_at(self, lines: list[int]) -> Contract | None:
+        for line in sorted(set(lines)):
+            for ln, comment in self.src.comment_block(line):
+                contract, err = parse_contract(comment, ln)
+                if err:
+                    self.out.append(Violation(PASS, self.src.rel, ln, err))
+                    return None
+                if contract is not None:
+                    self._check_axes_registered(contract)
+                    return contract
+        return None
+
+    def find_contract(self, stmt: ast.stmt, call: ast.Call,
+                      def_stack: list) -> Contract | None:
+        """Statement-level contract, else the nearest enclosing def's."""
+        anchor = [stmt.lineno, call.lineno]
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            anchor.extend(d.lineno for d in stmt.decorator_list)
+        contract = self._contract_at(anchor)
+        if contract is not None:
+            return contract
+        for fn in reversed(def_stack):
+            if fn.lineno not in self._def_contracts:
+                lines = [fn.lineno] + [d.lineno for d in fn.decorator_list]
+                self._def_contracts[fn.lineno] = self._contract_at(lines)
+            if self._def_contracts[fn.lineno] is not None:
+                return self._def_contracts[fn.lineno]
+        return None
+
+    def _check_axes_registered(self, contract: Contract) -> None:
+        if self.registry is None:
+            return
+        for axis in sorted((contract.axes or set()) - self.registry):
+            self.out.append(Violation(
+                PASS, self.src.rel, contract.line,
+                f"mesh contract names axis {axis!r} which is not "
+                f"registered in parallel/mesh.py::AXES"))
+
+    # -- constructor checks ------------------------------------------------
+    def check_ctor(self, stmt: ast.stmt, call: ast.Call, kind: str,
+                   def_stack: list) -> None:
+        contract = self.find_contract(stmt, call, def_stack)
+        if contract is None:
+            self.out.append(Violation(
+                PASS, self.src.rel, call.lineno,
+                f"{kind} constructor without a '# mesh: axes=(..)' "
+                f"contract — declare the axes this site may place "
+                f"(statement- or def-level)"))
+            return
+        for line, name in _literal_axis_strings(call):
+            if kind == "shard_map":
+                break       # specs checked structurally below
+            if name not in (contract.axes or set()):
+                self.out.append(Violation(
+                    PASS, self.src.rel, line,
+                    f"axis {name!r} is not declared in the covering "
+                    f"mesh contract axes="
+                    f"{tuple(sorted(contract.axes or ()))} "
+                    f"(line {contract.line})"))
+        if kind == "shard_map":
+            self._check_shard_map(call, contract)
+
+    def _check_shard_map(self, call: ast.Call, contract: Contract) -> None:
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        # axis_names literal strings must be declared
+        axis_names = kwargs.get("axis_names")
+        if axis_names is not None:
+            for node in ast.walk(axis_names):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value not in (contract.axes or set())):
+                    self.out.append(Violation(
+                        PASS, self.src.rel, node.lineno,
+                        f"shard_map axis_names names {node.value!r} "
+                        f"outside the contract's axes=()"))
+        for key, attr in (("in_specs", "in_specs"), ("out_specs",
+                                                     "out_specs")):
+            declared = getattr(contract, attr)
+            label = "in" if key == "in_specs" else "out"
+            if declared is None:
+                self.out.append(Violation(
+                    PASS, self.src.rel, contract.line,
+                    f"shard_map contract must declare {label}=(...) "
+                    f"(literal specs, or 'dynamic' for computed ones)"))
+                continue
+            expr = kwargs.get(key)
+            if expr is None:
+                self.out.append(Violation(
+                    PASS, self.src.rel, call.lineno,
+                    f"shard_map call has no {key}= keyword the contract "
+                    f"can round-trip against"))
+                continue
+            literal = _literal_spec_list(expr, self.aliases)
+            if literal is None and declared != "dynamic":
+                self.out.append(Violation(
+                    PASS, self.src.rel, contract.line,
+                    f"mesh contract declares literal {label}=(...) but "
+                    f"the call's {key} is computed — declare "
+                    f"{label}=(dynamic) or make the specs literal"))
+            elif literal is not None and declared == "dynamic":
+                self.out.append(Violation(
+                    PASS, self.src.rel, contract.line,
+                    f"mesh contract declares {label}=(dynamic) but the "
+                    f"call's {key} is literal — declare the specs so "
+                    f"they are checked"))
+            elif literal is not None and list(declared) != literal:
+                self.out.append(Violation(
+                    PASS, self.src.rel, contract.line,
+                    f"mesh contract {label}=({', '.join(declared)}) does "
+                    f"not round-trip against the call's {key}="
+                    f"({', '.join(literal)})"))
+            if literal is not None:
+                for spec in literal:
+                    for axis in _spec_axes(spec):
+                        if axis not in (contract.axes or set()):
+                            self.out.append(Violation(
+                                PASS, self.src.rel, call.lineno,
+                                f"{key} places axis {axis!r} outside "
+                                f"the contract's axes=()"))
+
+    # -- collective checks -------------------------------------------------
+    def check_collective(self, stmt: ast.stmt, call: ast.Call, tail: str,
+                         def_stack: list) -> None:
+        contract = self.find_contract(stmt, call, def_stack)
+        if contract is None:
+            self.out.append(Violation(
+                PASS, self.src.rel, call.lineno,
+                f"collective lax.{tail} outside any '# mesh:' contract "
+                f"— annotate the enclosing function with the axes it "
+                f"reduces over"))
+            return
+        pos = _COLLECTIVES[tail]
+        axis_expr = None
+        if len(call.args) > pos:
+            axis_expr = call.args[pos]
+        else:
+            for kw in call.keywords:
+                if kw.arg in ("axis_name", "axis", "axes"):
+                    axis_expr = kw.value
+                    break
+        if axis_expr is None:
+            self.out.append(Violation(
+                PASS, self.src.rel, call.lineno,
+                f"collective lax.{tail} has no resolvable axis argument"))
+            return
+        elements = (list(axis_expr.elts)
+                    if isinstance(axis_expr, (ast.Tuple, ast.List))
+                    else [axis_expr])
+        axes = contract.axes or set()
+        for el in elements:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                if el.value not in axes:
+                    self.out.append(Violation(
+                        PASS, self.src.rel, call.lineno,
+                        f"collective lax.{tail} names axis {el.value!r} "
+                        f"outside the contract's axes="
+                        f"{tuple(sorted(axes))} (line {contract.line})"))
+            elif isinstance(el, ast.Name):
+                if el.id not in contract.via:
+                    self.out.append(Violation(
+                        PASS, self.src.rel, call.lineno,
+                        f"collective lax.{tail} takes its axis from "
+                        f"{el.id!r}, which the contract does not declare "
+                        f"in via=(...) — axis names flowing through "
+                        f"parameters must be declared"))
+            else:
+                self.out.append(Violation(
+                    PASS, self.src.rel, call.lineno,
+                    f"collective lax.{tail} axis argument is not a "
+                    f"literal or a declared via=() parameter"))
+
+    # -- walk --------------------------------------------------------------
+    def run(self) -> None:
+        def own_exprs(stmt: ast.stmt):
+            """Expressions belonging to ``stmt`` ITSELF — stopping at
+            nested statements, so a call anchors its contract search at
+            its OWN statement, never an enclosing block's."""
+            stack = [c for c in ast.iter_child_nodes(stmt)
+                     if not isinstance(c, ast.stmt)]
+            while stack:
+                node = stack.pop()
+                yield node
+                stack.extend(c for c in ast.iter_child_nodes(node)
+                             if not isinstance(c, ast.stmt))
+
+        def visit_stmt(stmt: ast.stmt, def_stack: list) -> None:
+            for node in own_exprs(stmt):
+                if not isinstance(node, ast.Call) or id(node) in self.seen:
+                    continue
+                kind = _ctor_kind(node, self.aliases)
+                tail = _collective_tail(node, self.lax_aliases)
+                if kind is None and tail is None:
+                    continue
+                self.seen.add(id(node))
+                if kind is not None:
+                    # nested ctors (P inside NamedSharding, specs inside
+                    # shard_map) are part of this construct — one check
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Call)
+                                and _ctor_kind(sub, self.aliases)):
+                            self.seen.add(id(sub))
+                    self.check_ctor(stmt, node, kind, def_stack)
+                else:
+                    self.check_collective(stmt, node, tail, def_stack)
+
+        def walk_body(body: list[ast.stmt], def_stack: list) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    visit_stmt(stmt, def_stack)     # decorators/defaults
+                    walk_body(stmt.body, def_stack + [stmt])
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    visit_stmt(stmt, def_stack)     # decorators/bases
+                    walk_body(stmt.body, def_stack)
+                    continue
+                visit_stmt(stmt, def_stack)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        walk_body(sub, def_stack)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    walk_body(handler.body, def_stack)
+                for case in getattr(stmt, "cases", []) or []:
+                    walk_body(case.body, def_stack)
+
+        walk_body(self.src.tree.body, [])
+
+
+def in_scope(rel: str) -> bool:
+    return rel.replace("\\", "/").startswith(SCOPE_PREFIXES)
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    registry = registry_axes(sources, out)
+    for rel, src in sorted(sources.items()):
+        if not in_scope(rel):
+            continue
+        _FileChecker(src, registry, out).run()
+    return out

@@ -1,0 +1,152 @@
+"""Resharding-discipline pass (``reshard``).
+
+A ``with_sharding_constraint`` is a compiled-in data movement order: the
+wrong spec (or a bare ``P()``) makes XLA all-gather a sharded activation
+onto every chip — gigabytes of ICI traffic that look like "the model got
+slower" with nothing in any log.  ``device_put`` and zero-arg
+``PartitionSpec()`` (full replication) inside the latency-critical
+regions are the same hazard one level up: a replicated transient on a
+hot path costs mesh-size× HBM and a transfer per tick.  This pass makes
+every such site carry its reasoning, the way ``hostsync`` forces
+``# host-sync: <why>`` on deliberate device→host fetches:
+
+- ``jax.lax.with_sharding_constraint`` ANYWHERE in the sharded core
+  (``parallel/``, ``models/``, ``inference/tpu/``) needs an inline
+  ``# reshard: <why>`` (same line or the comment block above; the
+  reason is mandatory — a bare marker reports and silences nothing);
+- inside ``# hot-path`` functions and jit-entry bodies (the same
+  regions :mod:`.hostsync` guards), ``jax.device_put`` and zero-arg
+  ``PartitionSpec()``/``P()`` constructors need one too — an accidental
+  full replication in a drive tick or compiled chunk is exactly the
+  silent resharding the runtime shardcheck sanitizer
+  (``REVAL_TPU_SHARDCHECK=1``) counts at test time.
+
+Suppression: the reasoned ``# reshard: <why>`` IS the suppression (the
+reason lands in the report's annotation, not the driver ledger);
+``# lint: allow(reshard) — <reason>`` also works (driver policy).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import SourceFile, Violation
+from .core import call_chain as _call_chain
+from . import jitreg
+
+PASS = "reshard"
+
+SCOPE_PREFIXES = ("reval_tpu/parallel/", "reval_tpu/models/",
+                  "reval_tpu/inference/tpu/")
+
+_RESHARD_RE = re.compile(r"#\s*reshard\s*(?:[:—])\s*(\S.*)?$")
+
+
+def _reasoned(src: SourceFile, line: int, out: list[Violation]) -> bool:
+    """True when ANY ``# reshard:`` marker covers ``line``.  A marker
+    with no reason is itself reported (ONE violation, anchored at the
+    marker — never a second 'marker missing' report at the call site,
+    which would misdirect the fix toward adding a duplicate marker)."""
+    for ln, comment in src.comment_block(line):
+        m = _RESHARD_RE.search(comment)
+        if m:
+            if not (m.group(1) or "").strip():
+                out.append(Violation(
+                    PASS, src.rel, ln,
+                    "reshard marker without a reason — say WHY this "
+                    "data movement is intended"))
+            return True
+    return False
+
+
+def _spec_aliases(src: SourceFile) -> set[str]:
+    names = {"PartitionSpec"}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax.sharding"
+                or node.module.endswith(".sharding")):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _check_region(src: SourceFile, fn, label: str, aliases: set[str],
+                  out: list[Violation], seen: set[int]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) or id(node) in seen:
+            continue
+        chain = _call_chain(node.func)
+        if not chain:
+            continue
+        denied = None
+        if chain[-1] == "device_put":
+            denied = f"{'.'.join(chain)} (host→device placement)"
+        elif (chain[-1] in aliases and not node.args
+              and not node.keywords):
+            denied = "zero-arg PartitionSpec() (full replication)"
+        if denied is None:
+            continue
+        seen.add(id(node))
+        if _reasoned(src, node.lineno, out):
+            continue
+        out.append(Violation(
+            PASS, src.rel, node.lineno,
+            f"{label} performs {denied} — an unintended reshard/"
+            f"replication here is a silent all-gather; mark the "
+            f"deliberate movement with '# reshard: <why>'"))
+
+
+def run(sources: dict[str, SourceFile], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, src in sorted(sources.items()):
+        if not rel.replace("\\", "/").startswith(SCOPE_PREFIXES):
+            continue
+        aliases = _spec_aliases(src)
+        seen: set[int] = set()
+
+        # 1. every with_sharding_constraint in scope carries a reason
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and _call_chain(node.func)[-1:]
+                    == ["with_sharding_constraint"]):
+                seen.add(id(node))
+                if not _reasoned(src, node.lineno, out):
+                    out.append(Violation(
+                        PASS, src.rel, node.lineno,
+                        "with_sharding_constraint without a "
+                        "'# reshard: <why>' — a constraint is a "
+                        "compiled-in data movement order; say what it "
+                        "prevents"))
+
+        # 2. hot-path functions + jit-entry bodies: device_put and
+        # zero-arg PartitionSpec need a reason too
+        ann = src.annotations()
+        checked: set[int] = set()
+        if ann.hot:
+            def walk(body, qual):
+                for node in body:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fq = f"{qual}.{node.name}" if qual else node.name
+                        if fq in ann.hot and id(node) not in checked:
+                            checked.add(id(node))
+                            _check_region(src, node,
+                                          f"hot-path function {fq!r}",
+                                          aliases, out, seen)
+                        else:
+                            walk(node.body, fq)
+                    elif isinstance(node, ast.ClassDef):
+                        walk(node.body, node.name)
+
+            walk(src.tree.body, "")
+        if jitreg.in_scope(rel):
+            for entry in jitreg.collect_entries(src, None):
+                fn = entry.target
+                if fn is None or id(fn) in checked:
+                    continue
+                checked.add(id(fn))
+                _check_region(src, fn, f"jit entry {entry.name!r} body",
+                              aliases, out, seen)
+    return out

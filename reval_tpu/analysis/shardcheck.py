@@ -1,0 +1,230 @@
+"""Runtime sharding sanitizer (``REVAL_TPU_SHARDCHECK=1``) + the
+always-on sharding-mismatch counters behind ``reval_shard_*``.
+
+The static ``mesh``/``reshard`` passes prove the DECLARED placement
+contracts (axis names, shard_map specs, reasoned reshards); what they
+cannot see is dynamic: whether the arrays flowing through the engines'
+jit entries actually CARRY the declared shardings once real shapes and
+donation run.  A silently-resharded operand is the worst kind of perf
+bug — XLA inserts the all-gather for you, results stay correct, and the
+only symptom is a mesh-size× step time — and per the backend-
+reproducibility study (PAPERS.md, arxiv 2605.19537) implicit
+replication differences are exactly what corrupts cross-backend parity.
+Two layers close the gap (mirroring ``lockcheck``/``jitcheck``):
+
+- :class:`ShardGuard` — ALWAYS ON where an engine has a mesh: a thin
+  wrapper around a tracked jit entry that, per call, compares selected
+  input/output arrays' actual ``.sharding`` against the engine's
+  declared :class:`~jax.sharding.NamedSharding` via
+  ``Sharding.is_equivalent_to`` (attribute reads only — never a sync).
+  Every comparison bumps ``reval_shard_checks_total``; every divergence
+  bumps ``reval_shard_respec_total`` (each mismatched call is one
+  unintended cross-device transfer) and emits ONE ``shard.respec``
+  warning event per distinct (entry, site, actual) signature, so a
+  steady-state respec storm is a counter slope, not a log flood.
+
+- :class:`ShardSanitizer` — test-time (``REVAL_TPU_SHARDCHECK=1`` via
+  conftest, or :func:`install` directly).  While installed, each
+  distinct divergence is also recorded as a violation naming the
+  DECLARED spec and the ACTUAL sharding; violations accumulate (a
+  sanitizer must not change program behavior) and the conftest wiring
+  fails the pytest session if any exist — the same
+  accumulate-then-fail contract as lockcheck/jitcheck.  Use
+  :func:`scoped` in tests that seed violations deliberately, so a
+  session-level install never inherits them.
+
+Pytree values (the paged KV cache) are checked leaf-wise: every jax
+array leaf whose rank can carry the declared spec is compared; lower-
+rank leaves (int8 scale arrays under a pool spec) are skipped — their
+placement is derived from the checked pool arrays at construction.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..obs.logging import log_event
+from ..obs.metrics import SHARD_CHECKS, SHARD_RESPECS
+
+__all__ = ["ShardSanitizer", "ShardGuard", "install", "uninstall",
+           "current", "scoped"]
+
+
+class ShardSanitizer:
+    """Violation ledger for declared-vs-actual sharding divergences."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock (writes)
+        # (conftest reads the ledger once, after the session drained)
+        self.violations: list[dict] = []
+
+    def record(self, entry: str, site: str, declared: str,
+               actual: str) -> None:
+        with self._lock:
+            self.violations.append({
+                "kind": "sharding-respec",
+                "entry": entry,
+                "detail": f"entry {entry!r} {site}: declared sharding "
+                          f"{declared} but the array actually carries "
+                          f"{actual} — an unintended cross-device "
+                          f"reshard (XLA inserts the transfer silently)"})
+
+
+_current: ShardSanitizer | None = None
+
+
+def install() -> ShardSanitizer:
+    """Activate the sanitizer (idempotent per process): every distinct
+    divergence a :class:`ShardGuard` observes becomes a violation."""
+    global _current
+    if _current is None:
+        _current = ShardSanitizer()
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+
+
+def current() -> ShardSanitizer | None:
+    return _current
+
+
+class scoped:
+    """Temporarily swap the process-global sanitizer: a FRESH ledger
+    when ``active`` (or none at all when not), restoring whatever was
+    installed before on exit — how tests seed violations without
+    polluting a session-level ``REVAL_TPU_SHARDCHECK=1`` install."""
+
+    def __init__(self, active: bool = True):
+        self._active = active
+        self._prev: ShardSanitizer | None = None
+
+    def __enter__(self) -> ShardSanitizer | None:
+        global _current
+        self._prev = _current
+        _current = ShardSanitizer() if self._active else None
+        return _current
+
+    def __exit__(self, *exc):
+        global _current
+        _current = self._prev
+        return False
+
+
+def _describe(sharding) -> str:
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        return f"NamedSharding({spec})"
+    return type(sharding).__name__
+
+
+def _leaves(value):
+    import jax
+
+    return [leaf for leaf in jax.tree_util.tree_leaves(value)
+            if hasattr(leaf, "sharding") and hasattr(leaf, "ndim")]
+
+
+class ShardGuard:
+    """Declared-sharding check around one jit entry (see module
+    docstring).  ``in_checks``: {positional index | kwarg name →
+    expected NamedSharding}; ``out_checks``: {output tuple index →
+    expected NamedSharding} (index 0 checks a non-tuple result).
+    Attribute access delegates to the wrapped entry, so ``variants``/
+    ``misses``/``name`` keep riding ``jit_counters()`` unchanged."""
+
+    __slots__ = ("_fn", "name", "_in", "_out", "_registry", "_seen",
+                 "_lock")
+
+    def __init__(self, name: str, fn, in_checks=None, out_checks=None,
+                 registry=None):
+        self._fn = fn
+        self.name = name
+        # unguarded: written once at construction, read-only afterwards
+        self._in = dict(in_checks or {})
+        # unguarded: written once at construction, read-only afterwards
+        self._out = dict(out_checks or {})
+        # registry may be the MetricsRegistry or a zero-arg callable
+        # returning it (engines hand a callable — see TrackedJit)
+        self._registry = registry
+        # guarded-by: _lock (writes)
+        # distinct (site, actual) signatures already eventted/recorded
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        checks = respecs = 0
+        for key, expected in self._in.items():
+            value = (kwargs.get(key) if isinstance(key, str)
+                     else (args[key] if key < len(args) else None))
+            c, r = self._check(f"input {key!r}", value, expected)
+            checks += c
+            respecs += r
+        outs = out if isinstance(out, tuple) else (out,)
+        for idx, expected in self._out.items():
+            value = outs[idx] if idx < len(outs) else None
+            c, r = self._check(f"output [{idx}]", value, expected)
+            checks += c
+            respecs += r
+        reg = self._registry
+        if callable(reg):
+            reg = reg()
+        if reg is not None and checks:
+            reg.counter(SHARD_CHECKS).add(checks)
+            if respecs:
+                reg.counter(SHARD_RESPECS).add(respecs)
+        return out
+
+    def _check(self, site: str, value, expected) -> tuple[int, int]:
+        """(comparisons, mismatches) for one declared site."""
+        if value is None:
+            # a declared check that does not resolve against the actual
+            # call shape (arg index past len(args), kwarg absent, output
+            # index past the tuple) means the call site drifted from the
+            # guard's wiring — an inert guard reads exactly like a clean
+            # one, so say so loudly (once per site) instead of silently
+            # checking nothing forever
+            self._flag(site, "unresolved — the declared check did not "
+                             "match the call shape (argument/output "
+                             "absent); the guard is inert at this site")
+            return 0, 0
+        checks = respecs = 0
+        rank = len(expected.spec)
+        for leaf in _leaves(value):
+            if leaf.ndim < rank:
+                continue        # derived lower-rank leaf (scales)
+            try:
+                ok = leaf.sharding.is_equivalent_to(expected, leaf.ndim)
+            except Exception:
+                continue        # foreign sharding type — unverifiable
+            checks += 1
+            if ok:
+                continue
+            respecs += 1
+            self._flag(site, _describe(leaf.sharding),
+                       declared=_describe(expected))
+        return checks, respecs
+
+    def _flag(self, site: str, actual: str,
+              declared: str | None = None) -> None:
+        """Report one divergence (or an unresolved check) ONCE per
+        distinct (site, actual) signature: event + sanitizer ledger."""
+        sig = (site, actual)
+        with self._lock:
+            if sig in self._seen:
+                return
+            self._seen.add(sig)
+        log_event("shard.respec", level="warning", entry=self.name,
+                  site=site, declared=declared or "<check wiring>",
+                  actual=actual)
+        san = _current
+        if san is not None:
+            san.record(self.name, site, declared or "<check wiring>",
+                       actual)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)

@@ -210,6 +210,13 @@ ENV: dict[str, dict] = {
                 "subprocess probe only without one), auto = exclusive "
                 "unless the tunnel watcher's marker files are fresh "
                 "(<30 min)"},
+    "REVAL_TPU_SHARDCHECK": {
+        "default": "0",
+        "help": "1 = run tests under the runtime sharding sanitizer "
+                "(declared-vs-actual sharding divergences on guarded "
+                "jit entries fail the session — analysis/shardcheck.py; "
+                "test-only, the reval_shard_* counters stay on "
+                "regardless)"},
     "REVAL_TPU_LOCKCHECK": {
         "default": "0",
         "help": "1 = run tests under the runtime lock sanitizer "

@@ -49,6 +49,14 @@ __all__ = ["DataParallelPagedEngine"]
 
 
 class DataParallelPagedEngine:
+    # Engine-surface gaps (enginezoo pass; ROADMAP item 3 erases them):
+    # not-supported: submit_request — replicas own the request lifecycle (work stealing)
+    # not-supported: release_request — replicas own request teardown
+    # not-supported: new_drive_state — per-replica drive loops (MultiSession)
+    # not-supported: encode_clipped — per-replica tokenize budgets
+    # not-supported: request_keys — per-replica PRNG keys
+    # not-supported: warm_state — snapshot/restore is per-replica (.r<i> suffixes)
+    # not-supported: rewarm — restore is per-replica (see warm_state)
     def __init__(self, params, cfg, tokenizer, *, dp_size: int,
                  tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,

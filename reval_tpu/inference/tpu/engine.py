@@ -375,6 +375,18 @@ del _name, _metric, _cast
 
 
 class TPUEngine:
+    # Engine-surface gaps (enginezoo pass; ROADMAP item 3 erases them):
+    # not-supported: close — no driver thread or pool; generate() leaves nothing running
+    # not-supported: submit_request — static whole-batch engine, no request lifecycle
+    # not-supported: release_request — static whole-batch engine, no request lifecycle
+    # not-supported: new_drive_state — no session drive loop; fleet fuses batches
+    # not-supported: encode_clipped — request-level API is session-driver-only
+    # not-supported: request_keys — per-request PRNG is a continuous-batching feature
+    # not-supported: aot_counters — AOT executable cache wraps paged entries only
+    # not-supported: prefix_cache_counters — no radix prefix cache on the static path
+    # not-supported: warm_state — nothing to snapshot without a prefix cache
+    # not-supported: rewarm — nothing to replay without a prefix cache
+    # mesh: axes=(dp)
     def __init__(self, params, cfg: ModelConfig, tokenizer, *, batch_size: int = 8,
                  max_seq_len: int = 8192, mesh=None, seed: int = 0):
         self.cfg = cfg
@@ -428,20 +440,32 @@ class TPUEngine:
                 self._cache_sharding = NamedSharding(
                     mesh, sp_kv_cache_spec(cfg, mesh))
                 # jit-entry: engine.sp_prefill bucketed=(rows, tokens)
-                sp_prefill = jax.jit(partial(
-                    sequence_parallel_prefill, cfg=cfg, mesh=mesh))
+                sp_prefill = jax.jit(
+                    partial(sequence_parallel_prefill, cfg=cfg, mesh=mesh),
+                    out_shardings=(None, self._cache_sharding))
             else:
                 self._cache_sharding = NamedSharding(mesh, kv_cache_spec(cfg, mesh))
                 sp_prefill = None
         else:
             sp_prefill = None
+        # Cache-returning entries pin out_shardings to the declared spec
+        # on a mesh: XLA's propagation is otherwise free to pick another
+        # cache layout (the shardcheck guard caught dp-mesh prefill
+        # returning a GSPMD-resharded cache over the declared
+        # kv_cache_spec), and every later chunk then pays a silent
+        # re-gather back to the operand shardings.
+        prefill_kw = ({"out_shardings": (None, self._cache_sharding)}
+                      if mesh is not None else {})
+        chunk_kw = ({"out_shardings": (None, self._cache_sharding, None)}
+                    if mesh is not None else {})
         # compile-variant tracking mirrors the paged engine (budgets =
         # worst-case legitimate bucket counts; see analysis/jitcheck.py)
         # jit-entry: engine.prefill bucketed=(rows, tokens) warmup=16
         self._jit_prefill = tracked_jit(
             "engine.prefill",
             sp_prefill or jax.jit(
-                partial(prefill, cfg=cfg, logits_mode="last")),
+                partial(prefill, cfg=cfg, logits_mode="last"),
+                **prefill_kw),
             registry=lambda: self.stats.registry, warmup=16)
         # jit-entry: engine.decode_chunk static=(steps, filtered) bucketed=(tokens) warmup=48
         self._jit_decode_chunk = tracked_jit(
@@ -450,8 +474,29 @@ class TPUEngine:
                 partial(self._decode_chunk, cfg=cfg),
                 static_argnames=("steps", "filtered"),
                 donate_argnames=("cache",),
+                **chunk_kw,
             ),
             registry=lambda: self.stats.registry, warmup=48)
+        # runtime mesh discipline (analysis/shardcheck.py): on a mesh,
+        # assert the batch inputs stay dp-sharded and the KV cache keeps
+        # kv_cache_spec (sp_kv_cache_spec under sp) through every entry
+        # — a silently-resharded cache is a mesh-size× chunk-time cliff
+        if mesh is not None:
+            from ...analysis.shardcheck import ShardGuard
+
+            self._jit_prefill = ShardGuard(
+                "engine.prefill", self._jit_prefill,
+                registry=lambda: self.stats.registry,
+                in_checks={"tokens": self._input_sharding,
+                           "pad_len": self._input_sharding,
+                           "cache": self._cache_sharding},
+                out_checks={1: self._cache_sharding})
+            self._jit_decode_chunk = ShardGuard(
+                "engine.decode_chunk", self._jit_decode_chunk,
+                registry=lambda: self.stats.registry,
+                in_checks={2: self._input_sharding,
+                           3: self._cache_sharding},
+                out_checks={1: self._cache_sharding})
         self._jit_trackers = (self._jit_prefill, self._jit_decode_chunk)
 
     def jit_counters(self) -> dict:

@@ -180,6 +180,7 @@ class _DriveState:
 
 
 class PagedTPUEngine:
+    # mesh: axes=()
     def __init__(self, params, cfg: ModelConfig, tokenizer, *,
                  max_slots: int = 8, page_size: int = PAGE_SIZE,
                  max_seq_len: int = 8192, num_pages: int | None = None,
@@ -256,6 +257,7 @@ class PagedTPUEngine:
                                self.max_pages_per_seq)
         self.cache = init_paged_cache(cfg, self.num_pages, page_size,
                                       dtype=dtype, kv_dtype=kv_dtype)
+        cache_out_shardings = None
         if self._cache_sharding is not None:
             # pool arrays are [rows, H_kv, D]; int8 scale arrays [rows, H_kv]
             # shard the same H_kv axis one rank down
@@ -267,6 +269,17 @@ class PagedTPUEngine:
             self.cache = jax.tree.map(
                 lambda c: jax.device_put(
                     c, self._cache_sharding if c.ndim == 3 else scale_sharding),
+                self.cache)
+            # pin the cache-RETURNING entries to the same placement:
+            # without out_shardings XLA's propagation is free to pick a
+            # different pool layout (found via the shardcheck guard on a
+            # kv-indivisible tp mesh: commit came back H_kv-sharded over
+            # a declared-replicated pool), and every later chunk then
+            # re-gathers the pool against the attention shard_map's
+            # declared specs — a silent per-chunk all-gather
+            cache_out_shardings = jax.tree.map(
+                lambda c: (self._cache_sharding if c.ndim == 3
+                           else scale_sharding),
                 self.cache)
         # Compile-variant budgets (warmup=N): the worst-case count of
         # legitimate shape buckets per entry at the flagship bench shape
@@ -289,7 +302,10 @@ class PagedTPUEngine:
             registry=reg, warmup=24)
         # jit-entry: paged.commit bucketed=(rows, tokens) warmup=24
         self._jit_commit = tracked_jit(
-            "paged.commit", jax.jit(commit_prefill, donate_argnums=(0,)),
+            "paged.commit",
+            jax.jit(commit_prefill, donate_argnums=(0,),
+                    **({"out_shardings": cache_out_shardings}
+                       if cache_out_shardings is not None else {})),
             registry=reg, warmup=24)
         # persistent radix prefix cache: page-aligned prompt prefixes live
         # in refcounted pool pages ACROSS generate() calls and entry
@@ -307,7 +323,9 @@ class PagedTPUEngine:
             jax.jit(
                 partial(self._decode_chunk, cfg=cfg, mesh=mesh),
                 static_argnames=("steps", "filtered"),
-                donate_argnames=("cache",)),
+                donate_argnames=("cache",),
+                **({"out_shardings": (None, cache_out_shardings, None)}
+                   if cache_out_shardings is not None else {})),
             registry=reg, warmup=64)
         # in-place update of the packed state's table columns (the first
         # ``span`` columns) — lets a page-boundary crossing ride the
@@ -367,6 +385,22 @@ class PagedTPUEngine:
                                      static=("steps", "filtered"),
                                      canary=chunk_canary, donate=(2,))
             self._jit_patch = AotJit(self._jit_patch, self._aot_cache, ctx)
+        # runtime mesh discipline (analysis/shardcheck.py): on a mesh,
+        # the chunk/commit entries carry the KV pool — assert its actual
+        # sharding still matches paged_cache_spec after every dispatch
+        # (a silently-resharded pool is a mesh-size× step-time cliff).
+        # Wrapped OUTERMOST so the AOT dispatch path is checked too.
+        if self._cache_sharding is not None:
+            from ...analysis.shardcheck import ShardGuard
+
+            self._jit_chunk = ShardGuard(
+                "paged.decode_chunk", self._jit_chunk, registry=reg,
+                in_checks={2: self._cache_sharding},
+                out_checks={1: self._cache_sharding})
+            self._jit_commit = ShardGuard(
+                "paged.commit", self._jit_commit, registry=reg,
+                in_checks={0: self._cache_sharding},
+                out_checks={0: self._cache_sharding})
         self._jit_trackers = (self._jit_prefill, self._jit_prefill_pctx,
                               self._jit_commit, self._jit_chunk,
                               self._jit_patch)

@@ -33,6 +33,7 @@ __all__ = ["PipelinedTPUEngine"]
 
 
 class PipelinedTPUEngine(TPUEngine):
+    # mesh: axes=(pp)
     def __init__(self, params, cfg: ModelConfig, tokenizer, *,
                  batch_size: int = 8, max_seq_len: int = 8192, mesh,
                  n_micro: int | None = None, seed: int = 0):
@@ -70,11 +71,17 @@ class PipelinedTPUEngine(TPUEngine):
         # pointing at the LIVE wrappers, or the pp path's compiles would
         # vanish from jit_counters()/reval_jit_* while the API still
         # reports the discarded base-engine trackers
+        # out_shardings pins the returned cache to its declared pp
+        # placement — XLA propagation is otherwise free to pick another
+        # layout (the mechanism the shardcheck guard caught on the
+        # paged/static engines), and a respec here lands the full
+        # [L, B+mb, S, H_kv, D] cache on one stage's chip
         # jit-entry: pp.prefill bucketed=(rows, tokens) warmup=16
         self._jit_prefill = tracked_jit(
             "pp.prefill",
             jax.jit(partial(
-                pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro)),
+                pipeline_prefill, cfg=cfg, mesh=mesh, n_micro=self.n_micro),
+                out_shardings=(None, self._cache_sharding)),
             registry=lambda: self.stats.registry, warmup=16)
         # jit-entry: pp.decode_chunk static=(steps, filtered) bucketed=(tokens) warmup=48
         self._jit_decode_chunk = tracked_jit(
@@ -82,8 +89,27 @@ class PipelinedTPUEngine(TPUEngine):
             jax.jit(
                 partial(self._pp_decode_chunk, cfg=cfg, mesh=mesh),
                 static_argnames=("steps", "filtered"),
-                donate_argnames=("cache",)),
+                donate_argnames=("cache",),
+                out_shardings=(None, self._cache_sharding, None)),
             registry=lambda: self.stats.registry, warmup=48)
+        # runtime mesh discipline (analysis/shardcheck.py): the base
+        # ctor saw mesh=None, so guard the rebound pp entries here — the
+        # KV cache's layer dim must stay pp-sharded through every chunk
+        # (a respec would land a full [L, B+mb, S, H_kv, D] buffer on
+        # one stage's chip, the exact transient pipelining exists to
+        # avoid)
+        from ...analysis.shardcheck import ShardGuard
+
+        self._jit_prefill = ShardGuard(
+            "pp.prefill", self._jit_prefill,
+            registry=lambda: self.stats.registry,
+            in_checks={"cache": self._cache_sharding},
+            out_checks={1: self._cache_sharding})
+        self._jit_decode_chunk = ShardGuard(
+            "pp.decode_chunk", self._jit_decode_chunk,
+            registry=lambda: self.stats.registry,
+            in_checks={3: self._cache_sharding},
+            out_checks={1: self._cache_sharding})
         self._jit_trackers = (self._jit_prefill, self._jit_decode_chunk)
 
     @classmethod

@@ -134,6 +134,7 @@ def _layer_scales(cache: PagedKVCache, i: int):
     return None, None
 
 
+# mesh: axes=(tp)
 def _attention_tp_manual(q2, ki, vi, block_tables, attn_lens, ks_i, vs_i,
                          *, page: int, cfg: ModelConfig, win, mesh):
     """Dispatch paged attention, manually sharded over ``tp`` when a mesh
@@ -189,25 +190,22 @@ def _attention_tp_manual(q2, ki, vi, block_tables, attn_lens, ks_i, vs_i,
 
     # Manual over ALL mesh axes (the default), not just {"tp"}: Mosaic
     # rejects custom calls whose manual axes are any strict subset of the
-    # mesh's axis names, and make_mesh keeps singleton (dp, pp, sp, ep)
-    # axes — a partial-manual region over {"tp"} compiles only on
-    # single-axis meshes.  The specs place only "tp"; every other axis is
-    # replicated (the paged engine is tp-only by contract).
+    # mesh's manual axis names, and make_mesh keeps singleton (dp, pp,
+    # sp, ep) axes — a partial-manual region over {"tp"} compiles only
+    # on single-axis meshes.  The specs place only "tp"; every other
+    # axis is replicated (the paged engine is tp-only by contract).
     # check_vma=False: pallas_call's out_shape is a plain ShapeDtypeStruct
     # with no varying-axes metadata, which the vma checker rejects inside
     # a manual region; correctness here is by construction (head-parallel,
-    # no cross-shard dataflow)
-    if hasattr(jax, "shard_map"):
-        # jit-entry: paged.attn_tp_shard bucketed=(rows)
-        return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                             out_specs=q_spec, check_vma=False)(*args)
-    # jax 0.4.x spells it jax.experimental.shard_map with check_rep (the
-    # same replication checker check_vma renamed)
-    from jax.experimental.shard_map import shard_map as _shard_map
+    # no cross-shard dataflow).  compat_shard_map handles the 0.4.x
+    # spelling (check_rep) — the shim models/paged.py used to carry
+    # privately, now shared with the pp/sp ring paths.
+    from ..parallel.mesh import compat_shard_map
 
-    # jit-entry: paged.attn_tp_shard_jax04 bucketed=(rows)
-    return _shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                      out_specs=q_spec, check_rep=False)(*args)
+    # jit-entry: paged.attn_tp_shard bucketed=(rows)
+    # mesh: axes=(tp) in=(dynamic) out=(dynamic)
+    return compat_shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                            out_specs=q_spec, check_vma=False)(*args)
 
 
 def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,

@@ -72,6 +72,7 @@ def _slices(key: tuple[tuple[int, int, int], ...]) -> tuple[slice, ...]:
     return tuple(slice(*t) for t in key)
 
 
+# mesh: axes=()
 def load_checkpoint_sharded(model_path: str | Path, mesh: Mesh,
                             dtype: str = "bfloat16",
                             cfg: ModelConfig | None = None,

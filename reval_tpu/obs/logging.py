@@ -64,6 +64,10 @@ EVENTS: dict[str, str] = {
     # jit-discipline tracker (analysis/jitcheck.py)
     "jit.recompile": "a tracked jit entry compiled a new variant past "
                      "its declared warmup budget",
+    # mesh-discipline guard (analysis/shardcheck.py)
+    "shard.respec": "a guarded jit entry saw an array whose actual "
+                    "sharding diverged from the declared spec "
+                    "(unintended cross-device reshard)",
     # persistent AOT executable cache (inference/tpu/aot_cache.py)
     "aot.cache_hit": "a tracked jit variant loaded from the persistent "
                      "AOT cache (compile skipped)",

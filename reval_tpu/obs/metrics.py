@@ -47,6 +47,7 @@ __all__ = [
     "ROUTER_EJECTIONS", "ROUTER_RECOVERIES", "ROUTER_SHEDS",
     "ROUTER_REPLICAS_READY",
     "JIT_COMPILES", "JIT_CACHE_MISSES",
+    "SHARD_CHECKS", "SHARD_RESPECS",
     "DET_CELLS", "DET_AGREE", "DET_DIVERGED", "DET_SKIPPED",
     "DET_DEPTH", "DET_DRIFT", "DRIFT_BUCKETS",
     "AOT_HITS", "AOT_MISSES", "AOT_ERRORS", "AOT_UNSUPPORTED",
@@ -95,6 +96,8 @@ ROUTER_SHEDS = "reval_router_sheds_total"
 ROUTER_REPLICAS_READY = "reval_router_replicas_ready"
 JIT_COMPILES = "reval_jit_compiles_total"
 JIT_CACHE_MISSES = "reval_jit_cache_misses_total"
+SHARD_CHECKS = "reval_shard_checks_total"
+SHARD_RESPECS = "reval_shard_respec_total"
 AOT_HITS = "reval_aot_cache_hits_total"
 AOT_MISSES = "reval_aot_cache_misses_total"
 AOT_ERRORS = "reval_aot_cache_errors_total"
@@ -222,6 +225,17 @@ METRICS: dict[str, dict] = {
                                "entry's declared warmup budget "
                                "(post-warmup recompiles; each also "
                                "logs jit.recompile)"},
+    # mesh-discipline (analysis/shardcheck.py) — declared-vs-actual
+    # sharding comparisons over the engines' guarded jit entries
+    SHARD_CHECKS: {"type": "counter",
+                   "help": "Declared-vs-actual sharding comparisons "
+                           "over guarded jit entries (ShardGuard; "
+                           "attribute reads only, never a sync)"},
+    SHARD_RESPECS: {"type": "counter",
+                    "help": "Arrays whose actual sharding diverged "
+                            "from the declared spec (each is an "
+                            "unintended cross-device reshard; also "
+                            "logs shard.respec once per signature)"},
     # persistent AOT executable cache (inference/tpu/aot_cache.py) —
     # warm restarts skip XLA compilation when a fingerprint-keyed
     # serialized executable already exists on disk

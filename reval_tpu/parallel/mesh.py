@@ -19,7 +19,26 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "init_distributed", "mesh_axis_sizes"]
+__all__ = ["AXES", "make_mesh", "init_distributed", "mesh_axis_sizes",
+           "compat_shard_map"]
+
+#: The canonical mesh-axis namespace: name -> one-line meaning, in mesh
+#: axis order (outermost/slowest links first).  This dict is THE
+#: registry the ``mesh`` lint pass (analysis/meshreg.py) resolves every
+#: ``# mesh: axes=(...)`` contract against — an axis name used in a
+#: ``PartitionSpec``/collective that is not declared here is a lint
+#: violation, not a runtime XLA "unbound axis name" error.  Keep it a
+#: PURE LITERAL: the pass reads it from the AST (lint stays jax-free).
+AXES: dict[str, str] = {
+    "dp": "data parallel — prompt batches; syncs only at boundaries, "
+          "may ride DCN multi-host",
+    "pp": "pipeline parallel — contiguous layer stages; one activation "
+          "per microbatch tick",
+    "sp": "sequence parallel — ring attention over sequence blocks",
+    "ep": "expert parallel — MoE expert shards (all-to-all per block)",
+    "tp": "tensor parallel — heads/ffn/vocab; heaviest traffic, "
+          "innermost (fastest ICI)",
+}
 
 
 def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, pp: int = 1, ep: int = 1,
@@ -38,7 +57,44 @@ def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, pp: int = 1, ep: int = 1,
         raise ValueError(f"mesh needs {need} devices (tp={tp} dp={dp} sp={sp} "
                          f"pp={pp} ep={ep}), have {len(devices)}")
     arr = np.array(devices[:need]).reshape(dp, pp, sp, ep, tp)
-    return Mesh(arr, ("dp", "pp", "sp", "ep", "tp"))
+    # mesh: axes=(dp, pp, sp, ep, tp)
+    return Mesh(arr, tuple(AXES))
+
+
+def compat_shard_map(f, *, mesh: Mesh, in_specs, out_specs,
+                     axis_names=None, check_vma: bool = True):
+    """``jax.shard_map`` across jax generations — the ONE compat shim.
+
+    jax >= 0.6 spells the API ``jax.shard_map(..., axis_names=...,
+    check_vma=...)``; jax 0.4.x spells it
+    ``jax.experimental.shard_map.shard_map`` where the replication
+    checker is ``check_rep`` and partial-manual regions are expressed as
+    ``auto`` (the COMPLEMENT of ``axis_names``).  Every shard_map in the
+    tree routes through here (models/paged.py carried a private copy of
+    this branch since PR 2 while the pp/sp ring paths called
+    ``jax.shard_map`` directly and were env-broken on 0.4.x hosts).
+
+    ``axis_names``: the axes the region is manual over (None = all mesh
+    axes, the jax default).  0.4.x raises ``NotImplementedError`` on
+    real partial-manual (``auto``) programs, so there a partial request
+    degrades to manual over ALL axes: the specs still place only the
+    named axes, every other axis is replicated at region entry —
+    correct, at worst redundant compute on multi-axis meshes — and the
+    replication checker goes off (it would demand the ``lax.pcast``
+    varying-marking the 0.4 API lacks)."""
+    new_sm = getattr(jax, "shard_map", None)
+    if new_sm is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return new_sm(f, **kwargs)
+    # jax 0.4.x: check_rep is the same replication checker check_vma
+    # renamed
+    from jax.experimental.shard_map import shard_map as _sm04
+
+    return _sm04(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                 check_rep=False if axis_names is not None else check_vma)
 
 
 def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:

@@ -45,7 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.configs import ModelConfig
 from ..models.model import KVCache, _block, _embed, _norm, _unembed
 from ..ops import decode_attention, prefill_attention, rope_angles
-from .mesh import mesh_axis_sizes
+from .mesh import compat_shard_map, mesh_axis_sizes
 from .sharding import param_specs
 
 __all__ = ["pp_param_specs", "shard_params_pp", "pipeline_prefill",
@@ -56,6 +56,7 @@ def pp_size(mesh: Mesh) -> int:
     return mesh_axis_sizes(mesh).get("pp", 1)
 
 
+# mesh: axes=(pp)
 def pp_param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     """The tp/replication rules of ``parallel.sharding`` with the stacked
     layer dim additionally sharded over ``pp`` (stage = contiguous block of
@@ -74,6 +75,7 @@ def pp_param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     return specs
 
 
+# mesh: axes=()
 def shard_params_pp(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     specs = pp_param_specs(params, cfg, mesh)
     if jax.default_backend() == "cpu":
@@ -102,9 +104,15 @@ def _ring(n: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+# mesh: axes=(pp) via=(axis)
 def _varying(x, axis: str = "pp"):
     """Mark a replicated value as device-varying over ``axis`` so it can
-    seed a loop carry whose body output is varying (shard_map VMA rule)."""
+    seed a loop carry whose body output is varying (shard_map VMA rule).
+    jax 0.4.x has no ``lax.pcast`` — there the compat shard_map runs
+    with the replication checker off (partial-manual forces it), so the
+    marking is unnecessary and the value passes through unchanged."""
+    if not hasattr(lax, "pcast"):
+        return x
     return lax.pcast(x, (axis,), to="varying")
 
 
@@ -133,6 +141,7 @@ def _run_local_layers_prefill(h, layers, wins, pad, cfg, kv_dtype):
     return lax.scan(layer_step, h, (layers, wins))
 
 
+# mesh: axes=(pp)
 def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
                      pad_len: jnp.ndarray, cache: KVCache, mesh: Mesh,
                      n_micro: int) -> tuple[jnp.ndarray, KVCache]:
@@ -192,8 +201,9 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
         return lax.psum(outbuf[:m_count], "pp"), ck, cv
 
     # jit-entry: pp.prefill_stage bucketed=(rows, tokens)
-    outbuf, ck, cv = jax.shard_map(
-        staged, mesh=mesh, axis_names={"pp"},
+    # mesh: axes=(pp) in=(P(pp), P(pp), P(), P(), P(pp), P(pp)) out=(P(), P(pp), P(pp))
+    outbuf, ck, cv = compat_shard_map(
+        staged, mesh=mesh, axis_names=("pp",),
         in_specs=(P("pp"), P("pp"), P(), P(), P("pp"), P("pp")),
         out_specs=(P(), P("pp"), P("pp")),
     )(layers, wins, hm, padm, cache.k, cache.v)
@@ -204,6 +214,7 @@ def pipeline_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
     return logits[:, None, :], KVCache(ck, cv)
 
 
+# mesh: axes=(pp)
 def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
                           pad_len: jnp.ndarray, cache: KVCache,
                           start_pos: jnp.ndarray, temperature, key,
@@ -326,8 +337,9 @@ def pipeline_decode_chunk(params, cfg: ModelConfig, first_token: jnp.ndarray,
         return lax.psum(tokbuf[:n_total], "pp"), ck, cv
 
     # jit-entry: pp.decode_stage bucketed=(rows, steps)
-    tokbuf, ck, cv = jax.shard_map(
-        staged, mesh=mesh, axis_names={"pp"},
+    # mesh: axes=(pp) in=(P(pp), P(pp), P(), P(), P(), P(), P(), P(pp), P(pp)) out=(P(), P(pp), P(pp))
+    tokbuf, ck, cv = compat_shard_map(
+        staged, mesh=mesh, axis_names=("pp",),
         in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P(), P("pp"),
                   P("pp")),
         out_specs=(P(), P("pp"), P("pp")),

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import compat_shard_map
+
 __all__ = ["ring_self_attention", "ring_attention_sharded"]
 
 _NEG_INF = -1e30
@@ -72,6 +74,7 @@ def _block_update(q, k, v, q_pos, k_pos, m, l, acc, scale, pad_len=None,
     return m_new, l_new, acc_new
 
 
+# mesh: axes=(sp) via=(axis_name)
 def _ring_body(q, k, v, pad_len, window=None, *, axis_name: str | None,
                axis_size: int, scale, softcap=None):
     """Local ring-attention body.  q: [B, Tl, H, D]; k/v: [B, Tl, H_kv, D];
@@ -133,6 +136,7 @@ def ring_self_attention(q, k, v, pad_len=None, window=None, *,
                       axis_size=axis_size, scale=scale, softcap=softcap)
 
 
+# mesh: axes=(dp, sp, tp) via=(sp_axis, head_axis, batch_axis)
 def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, window=None, *,
                            sp_axis: str = "sp", head_axis: str | None = None,
                            batch_axis: str | None = "dp",
@@ -172,6 +176,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, pad_len=None, window=None, *,
         args.append(jnp.asarray(window))
         specs.append(P())
     # jit-entry: ring.attn_shard bucketed=(rows, tokens)
-    return jax.shard_map(
+    # mesh: axes=(dp, sp, tp) in=(dynamic) out=(dynamic)
+    return compat_shard_map(
         body, mesh=mesh, in_specs=tuple(specs),
         out_specs=spec, check_vma=False)(*args)

@@ -52,6 +52,7 @@ def resolve_moe_impl(cfg: ModelConfig, mesh: Mesh | None) -> ModelConfig:
     return cfg
 
 # leaf name → spec for stacked [L, ...] layer weights
+# mesh: axes=(ep, tp)
 _LAYER_RULES = {
     "q_w": P(None, None, "tp"),
     "k_w": P(None, None, "tp"),
@@ -115,6 +116,7 @@ _LAYER_RULES = {
     "mlp_norm_b": P(),
 }
 
+# mesh: axes=(tp)
 _TOP_RULES = {
     "embed": P("tp", None),       # vocab-sharded; also the tied lm head
     "lm_head": P(None, "tp"),
@@ -138,6 +140,7 @@ def _divisible(cfg: ModelConfig, mesh: Mesh) -> dict[str, bool]:
     }
 
 
+# mesh: axes=(ep, tp)
 def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     """PartitionSpec tree mirroring ``params``.
 
@@ -212,6 +215,7 @@ def param_specs(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     return specs
 
 
+# mesh: axes=()
 def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the rules above."""
     specs = param_specs(params, cfg, mesh)
@@ -235,17 +239,20 @@ def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     )
 
 
+# mesh: axes=(dp)
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [B, ...] host arrays (tokens, pad lengths)."""
     return NamedSharding(mesh, P("dp"))
 
 
+# mesh: axes=(dp, tp)
 def kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
     """[L, B, S, H_kv, D] — batch over dp, kv heads over tp if divisible."""
     div = _divisible(cfg, mesh)
     return P(None, "dp", None, "tp" if div["kv_heads"] else None, None)
 
 
+# mesh: axes=(tp)
 def paged_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
     """Per-layer flat pool arrays ``[N_pages * P, H_kv, D]`` — kv heads
     over tp if divisible.  The page pool is shared across the whole decode

@@ -30,6 +30,7 @@ from .sharding import _divisible, kv_cache_spec
 __all__ = ["sequence_parallel_prefill", "sp_kv_cache_spec"]
 
 
+# mesh: axes=(dp, sp, tp)
 def sp_kv_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
     """[L, B, S, H_kv, D]: the contiguous cache rules (batch over dp, kv
     heads over tp when divisible — ONE policy, defined in
@@ -60,9 +61,13 @@ def sequence_parallel_prefill(params, cfg: ModelConfig, tokens: jnp.ndarray,
                  and div["heads"] and div["kv_heads"] else None)
     # batch stays dp-sharded end to end (replication would run dp-fold
     # redundant prefill)
+    # mesh: axes=(dp, sp)
     seq_sharding = NamedSharding(mesh, P("dp", "sp", None))
 
     def constrain(h):
+        # reshard: pin prefill activations (dp, sp)-sharded — without the
+        # constraint XLA all-gathers the full T dim at the first norm,
+        # exactly the working set sp exists to shrink
         return jax.lax.with_sharding_constraint(h, seq_sharding)
 
     def attend_fn(q, k, v, win):

@@ -47,6 +47,11 @@ __all__ = ["MockStepEngine"]
 
 
 class MockStepEngine:
+    # Engine-surface gaps (enginezoo pass):
+    # not-supported: from_pretrained — host-only mock: the canned string IS the model
+    # not-supported: generate — speaks only the session driver contract (submit/tick)
+    # not-supported: jit_counters — no jitted programs; AOT simulation reports via aot_counters
+    # not-supported: prefix_cache_counters — warm chains are a list, not a radix cache
     page_size = 128
 
     def __init__(self, response: str = "mock_model_gen", step_s: float = 0.0,

@@ -54,9 +54,24 @@ if os.environ.get("REVAL_TPU_JITCHECK", "0").lower() not in ("0", "false",
     _JIT_SANITIZER = _jitcheck.install()
 
 
+# Runtime sharding sanitizer (REVAL_TPU_SHARDCHECK=1): engines with a
+# mesh guard their jit entries with declared-vs-actual sharding checks
+# (ShardGuard); with the sanitizer installed every divergence is a
+# violation naming the declared spec and the actual sharding.  Same
+# accumulate-then-fail contract as lockcheck/jitcheck; the
+# reval_shard_* counters stay on regardless.
+_SHARD_SANITIZER = None
+if os.environ.get("REVAL_TPU_SHARDCHECK", "0").lower() not in ("0", "false",
+                                                               "off"):
+    from reval_tpu.analysis import shardcheck as _shardcheck  # noqa: E402
+
+    _SHARD_SANITIZER = _shardcheck.install()
+
+
 def pytest_sessionfinish(session, exitstatus):
     for label, san in (("lockcheck", _LOCK_SANITIZER),
-                       ("jitcheck", _JIT_SANITIZER)):
+                       ("jitcheck", _JIT_SANITIZER),
+                       ("shardcheck", _SHARD_SANITIZER)):
         if san is None or not san.violations:
             continue
         import sys as _sys

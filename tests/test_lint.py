@@ -948,3 +948,581 @@ def run(q, kernel):
     assert report.ok, messages(report)
     assert len(report.suppressions) == 1
     assert "sub-tile" in report.suppressions[0].reason
+
+
+# ---------------------------------------------------------------------------
+# mesh pass bites (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+MINI_AXES = '''AXES = {
+    "dp": "data parallel",
+    "pp": "pipeline parallel",
+    "sp": "sequence parallel",
+    "ep": "expert parallel",
+    "tp": "tensor parallel",
+}
+'''
+
+
+def plant_axes(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/mesh.py", MINI_AXES)
+
+
+def test_mesh_flags_undeclared_constructor(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/shards.py",
+          '''from jax.sharding import PartitionSpec as P
+
+SPEC = P("dp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("without a '# mesh:" in m for m in messages(report))
+
+
+def test_mesh_clean_contract_passes(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/shards.py",
+          '''from jax.sharding import PartitionSpec as P
+
+# mesh: axes=(dp)
+SPEC = P("dp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert report.ok, messages(report)
+
+
+def test_mesh_flags_unregistered_axis(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/shards.py",
+          '''from jax.sharding import PartitionSpec as P
+
+# mesh: axes=(zz)
+SPEC = P("zz")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("not registered" in m for m in messages(report))
+
+
+def test_mesh_flags_typoed_literal_axis(tmp_path):
+    # the headline failure mode: "ttp" would surface as a runtime XLA
+    # unbound-axis error deep inside a trace; here it is a lint line
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/shards.py",
+          '''from jax.sharding import PartitionSpec as P
+
+# mesh: axes=(dp, tp)
+SPEC = P("ttp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("'ttp'" in m and "not declared" in m
+               for m in messages(report))
+
+
+def test_mesh_missing_axes_registry_is_reported(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/shards.py",
+          '''from jax.sharding import PartitionSpec as P
+
+# mesh: axes=(dp)
+SPEC = P("dp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("AXES registry" in m for m in messages(report))
+
+
+def test_mesh_shard_map_requires_in_out(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/sm.py",
+          '''import jax
+from jax.sharding import PartitionSpec as P
+
+
+def f(m, fn, x):
+    # mesh: axes=(dp)
+    return jax.shard_map(fn, mesh=m, in_specs=(P("dp"),),
+                         out_specs=P("dp"))(x)
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("must declare in=" in m for m in messages(report))
+    assert any("must declare out=" in m for m in messages(report))
+
+
+def test_mesh_shard_map_spec_roundtrip_mismatch(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/sm.py",
+          '''import jax
+from jax.sharding import PartitionSpec as P
+
+
+def f(m, fn, x):
+    # mesh: axes=(dp, tp) in=(P(dp)) out=(P(dp))
+    return jax.shard_map(fn, mesh=m, in_specs=(P("tp"),),
+                         out_specs=P("dp"))(x)
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("does not round-trip" in m for m in messages(report))
+
+
+def test_mesh_shard_map_literal_roundtrip_clean(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/sm.py",
+          '''import jax
+from jax.sharding import PartitionSpec as P
+
+
+def f(m, fn, x):
+    # mesh: axes=(dp, tp) in=(P(dp), P(None, tp)) out=(P(dp))
+    return jax.shard_map(fn, mesh=m,
+                         in_specs=(P("dp"), P(None, "tp")),
+                         out_specs=P("dp"))(x)
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert report.ok, messages(report)
+
+
+def test_mesh_dynamic_annotation_over_literal_specs_flagged(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/sm.py",
+          '''import jax
+from jax.sharding import PartitionSpec as P
+
+
+def f(m, fn, x):
+    # mesh: axes=(dp) in=(dynamic) out=(dynamic)
+    return jax.shard_map(fn, mesh=m, in_specs=(P("dp"),),
+                         out_specs=P("dp"))(x)
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("declare the specs so they are checked" in m
+               for m in messages(report))
+
+
+def test_mesh_collective_outside_contract_flagged(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/coll.py",
+          '''from jax import lax
+
+
+def reduce_it(x):
+    return lax.psum(x, "dp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("outside any '# mesh:' contract" in m
+               for m in messages(report))
+
+
+def test_mesh_collective_axis_outside_contract_flagged(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/coll.py",
+          '''from jax import lax
+
+
+# mesh: axes=(tp)
+def reduce_it(x):
+    return lax.psum(x, "dp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("outside the contract's axes" in m for m in messages(report))
+
+
+def test_mesh_collective_via_parameter(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/coll.py",
+          '''from jax import lax
+
+
+# mesh: axes=(sp) via=(axis_name)
+def ok(x, axis_name):
+    return lax.ppermute(x, axis_name, [(0, 1)])
+
+
+# mesh: axes=(sp)
+def bad(x, axis_name):
+    return lax.ppermute(x, axis_name, [(0, 1)])
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    flagged = messages(report, "mesh")
+    assert len(flagged) == 1
+    assert "via=" in flagged[0]
+
+
+# ---------------------------------------------------------------------------
+# reshard pass bites
+# ---------------------------------------------------------------------------
+
+def test_reshard_constraint_needs_reason(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/sp.py",
+          '''import jax
+
+
+def constrain(h, s):
+    return jax.lax.with_sharding_constraint(h, s)
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert any("with_sharding_constraint" in m for m in messages(report))
+
+
+def test_reshard_reasoned_constraint_clean(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/sp.py",
+          '''import jax
+
+
+def constrain(h, s):
+    # reshard: keep activations sequence-sharded through the norms
+    return jax.lax.with_sharding_constraint(h, s)
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert report.ok, messages(report)
+
+
+def test_reshard_bare_marker_reports(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/sp.py",
+          '''import jax
+
+
+def constrain(h, s):
+    # reshard:
+    return jax.lax.with_sharding_constraint(h, s)
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert any("without a reason" in m for m in messages(report))
+
+
+def test_reshard_device_put_in_hot_path_needs_reason(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/hot.py",
+          '''import jax
+
+
+class Engine:
+    def _drive_tick(self, x, s):   # hot-path
+        return jax.device_put(x, s)
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert any("device_put" in m for m in messages(report))
+    plant(tmp_path, "reval_tpu/parallel/hot.py",
+          '''import jax
+
+
+class Engine:
+    def _drive_tick(self, x, s):   # hot-path
+        # reshard: tokens must land dp-sharded before the chunk dispatch
+        return jax.device_put(x, s)
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert report.ok, messages(report)
+
+
+def test_reshard_full_replication_in_hot_path_flagged(tmp_path):
+    plant(tmp_path, "reval_tpu/parallel/hot.py",
+          '''from jax.sharding import PartitionSpec
+
+
+class Engine:
+    def _drive_tick(self, x):   # hot-path
+        return PartitionSpec()
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert any("full replication" in m for m in messages(report))
+
+
+# ---------------------------------------------------------------------------
+# zombie-suppression detection (driver/core)
+# ---------------------------------------------------------------------------
+
+def test_zombie_suppression_flagged(tmp_path):
+    # an allow whose pass ran and found NOTHING at that site excused
+    # code that is gone — the waiver must die with it
+    plant(tmp_path, "reval_tpu/clean.py", '''import time
+
+
+def slow():   # not hot-path: nothing here violates anything
+    # lint: allow(hotpath) — this sleep used to sit on the drive tick
+    time.sleep(0.1)
+''')
+    report = run_lint(str(tmp_path), ["hotpath"])
+    assert any("zombie suppression" in m for m in messages(report))
+
+
+def test_zombie_not_flagged_when_pass_not_run(tmp_path):
+    plant(tmp_path, "reval_tpu/clean.py", '''import time
+
+
+def slow():
+    # lint: allow(hotpath) — this sleep used to sit on the drive tick
+    time.sleep(0.1)
+''')
+    report = run_lint(str(tmp_path), ["locks"])
+    assert not any("zombie" in m for m in messages(report))
+
+
+def test_used_suppression_not_zombie(tmp_path):
+    plant(tmp_path, "reval_tpu/hot.py", '''import time
+
+
+class E:
+    def _tick(self):   # hot-path
+        # lint: allow(hotpath) — deliberate pacing knob for tests
+        time.sleep(0.01)
+''')
+    report = run_lint(str(tmp_path), ["hotpath"])
+    assert report.ok, messages(report)
+    assert len(report.suppressions) == 1
+    assert not any("zombie" in m for m in messages(report))
+
+
+def test_allow_naming_unknown_pass_flagged(tmp_path):
+    plant(tmp_path, "reval_tpu/clean.py", '''X = 1
+# lint: allow(hotpth) — typo'd pass name silently never matches
+Y = 2
+''')
+    report = run_lint(str(tmp_path), ["locks"])
+    assert any("unknown pass 'hotpth'" in m for m in messages(report))
+
+
+# ---------------------------------------------------------------------------
+# enginezoo pass bites
+# ---------------------------------------------------------------------------
+
+def _real_sources():
+    from reval_tpu.analysis.core import collect_sources
+
+    return collect_sources(REPO)
+
+
+def _mutated(sources, rel, old, new):
+    from reval_tpu.analysis.core import SourceFile
+
+    src = sources[rel]
+    assert old in src.text, f"fixture drift: {old!r} not in {rel}"
+    out = dict(sources)
+    out[rel] = SourceFile(src.path, rel, src.text.replace(old, new))
+    return out
+
+
+def test_enginezoo_repo_matrix_is_complete():
+    """The committed artifact lists every engine × surface member as
+    implemented/delegated/not-supported-with-reason."""
+    from reval_tpu.analysis.enginezoo import ENGINES, SURFACE
+
+    with open(os.path.join(REPO, "ENGINE_SURFACE.md")) as f:
+        rows = [l for l in f.read().splitlines()
+                if l.startswith("| `")]
+    assert len(rows) == len(SURFACE)
+    for row in rows:
+        cells = [c.strip() for c in row.split("|")[2:-1]]
+        assert len(cells) == len(ENGINES)
+        for cell in cells:
+            assert (cell == "yes" or cell.startswith("->")
+                    or cell.startswith("NO: ")), f"bad cell {cell!r} in {row}"
+
+
+def test_enginezoo_orphan_method_bites(tmp_path):
+    from reval_tpu.analysis import enginezoo
+
+    sources = _mutated(
+        _real_sources(), "reval_tpu/serving/mock_engine.py",
+        "    def close(self) -> None:",
+        "    def brand_new_feature(self):\n"
+        "        return 1\n\n"
+        "    def close(self) -> None:")
+    out = enginezoo.run(sources, REPO)
+    assert any("orphan engine method MockStepEngine.brand_new_feature"
+               in v.message for v in out)
+
+
+def test_enginezoo_engine_local_marker_accepted(tmp_path):
+    from reval_tpu.analysis import enginezoo
+
+    sources = _mutated(
+        _real_sources(), "reval_tpu/serving/mock_engine.py",
+        "    def close(self) -> None:",
+        "    # engine-local: mock-only chaos knob, not an engine feature\n"
+        "    def brand_new_feature(self):\n"
+        "        return 1\n\n"
+        "    def close(self) -> None:")
+    out = enginezoo.run(sources, REPO)
+    assert not any("orphan" in v.message for v in out)
+    # (the artifact check still fires nothing: engine-local methods are
+    # not part of the matrix)
+    assert not any("stale" in v.message for v in out)
+
+
+def test_enginezoo_missing_member_bites():
+    from reval_tpu.analysis import enginezoo
+
+    sources = _mutated(
+        _real_sources(), "reval_tpu/inference/tpu/engine.py",
+        "    # not-supported: close — no driver thread or pool; "
+        "generate() leaves nothing running\n", "")
+    out = enginezoo.run(sources, REPO)
+    assert any("neither implements, inherits, nor declares" in v.message
+               and "'close'" in v.message for v in out)
+
+
+def test_enginezoo_zombie_not_supported_marker_bites():
+    from reval_tpu.analysis import enginezoo
+
+    sources = _mutated(
+        _real_sources(), "reval_tpu/inference/tpu/paged_engine.py",
+        "class PagedTPUEngine:",
+        "class PagedTPUEngine:\n"
+        "    # not-supported: generate — stale claim, it IS implemented")
+    out = enginezoo.run(sources, REPO)
+    assert any("zombie not-supported marker" in v.message for v in out)
+
+
+def test_enginezoo_stale_artifact_bites(tmp_path):
+    from reval_tpu.analysis import enginezoo
+
+    with open(os.path.join(REPO, "ENGINE_SURFACE.md")) as f:
+        doc = f.read()
+    plant(tmp_path, "ENGINE_SURFACE.md", doc.replace("yes", "maybe", 1))
+    out = enginezoo.run(_real_sources(), str(tmp_path))
+    assert any("stale" in v.message for v in out)
+
+
+def test_enginezoo_reasonless_marker_bites():
+    from reval_tpu.analysis import enginezoo
+
+    sources = _mutated(
+        _real_sources(), "reval_tpu/inference/tpu/dp_paged.py",
+        "    # not-supported: release_request — replicas own request teardown",
+        "    # not-supported: release_request")
+    out = enginezoo.run(sources, REPO)
+    assert any("without a reason" in v.message for v in out)
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json, --changed-only, exit codes (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def lint_cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "reval_lint.py"),
+         *args], capture_output=True, text=True, cwd=cwd or REPO)
+
+
+def test_cli_json_clean_tree(tmp_path):
+    plant(tmp_path, "reval_tpu/ok.py", "X = 1\n")
+    proc = lint_cli("--json", "locks", "hotpath", "--root", str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert set(doc["passes"]) == {"locks", "hotpath"}
+    for info in doc["passes"].values():
+        assert info["violations"] == 0
+        assert isinstance(info["elapsed_s"], float)
+
+
+def test_cli_json_violations_and_exit_code(tmp_path):
+    plant(tmp_path, "reval_tpu/bad.py", '''import time
+
+
+class E:
+    def _tick(self):   # hot-path
+        time.sleep(1)
+''')
+    proc = lint_cli("--json", "hotpath", "--root", str(tmp_path))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    assert doc["passes"]["hotpath"]["violations"] == 1
+    v = doc["violations"][0]
+    assert v["pass"] == "hotpath" and v["path"].endswith("bad.py")
+    assert v["line"] == 6
+
+
+def test_cli_unknown_pass_exit_2():
+    proc = lint_cli("nonsense")
+    assert proc.returncode == 2
+    assert "unknown lint pass" in proc.stdout
+
+
+def test_cli_changed_only_outside_git_exit_2(tmp_path):
+    plant(tmp_path, "reval_tpu/ok.py", "X = 1\n")
+    proc = lint_cli("--changed-only", "locks", "--root", str(tmp_path))
+    assert proc.returncode == 2
+    assert "git" in proc.stdout
+
+
+BAD_HOT = '''import time
+
+
+class E:
+    def _tick(self):   # hot-path
+        time.sleep(1)
+'''
+
+
+def test_cli_changed_only_scopes_to_git_diff(tmp_path):
+    # committed violating file + untracked violating file: the scoped
+    # run reports ONLY the untracked one; the full run reports both
+    plant(tmp_path, "reval_tpu/committed.py", BAD_HOT)
+    git = ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run(git[:3] + ["init", "-q"], check=True)
+    subprocess.run(git + ["add", "-A"], check=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+    plant(tmp_path, "reval_tpu/fresh.py", BAD_HOT)
+
+    full = lint_cli("--json", "hotpath", "--root", str(tmp_path))
+    assert json.loads(full.stdout)["passes"]["hotpath"]["violations"] == 2
+
+    scoped = lint_cli("--json", "--changed-only", "hotpath",
+                      "--root", str(tmp_path), cwd=str(tmp_path))
+    assert scoped.returncode == 1
+    doc = json.loads(scoped.stdout)
+    assert doc["passes"]["hotpath"]["violations"] == 1
+    assert doc["violations"][0]["path"].endswith("fresh.py")
+
+
+def test_thirteen_passes_registered():
+    assert len(PASSES) == 13
+    assert {"mesh", "reshard", "enginezoo"} <= set(PASSES)
+
+
+def test_mesh_collective_via_lax_import_alias(tmp_path):
+    # `from jax.lax import psum` must not bypass the pass
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/coll2.py",
+          '''from jax.lax import psum
+
+
+def reduce_it(x):
+    return psum(x, "dp")
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("outside any '# mesh:' contract" in m
+               for m in messages(report))
+
+
+def test_mesh_walks_match_case_bodies(tmp_path):
+    plant_axes(tmp_path)
+    plant(tmp_path, "reval_tpu/parallel/matchy.py",
+          '''from jax.sharding import PartitionSpec as P
+
+
+def pick(kind):
+    match kind:
+        case "a":
+            return P("ttp")
+        case _:
+            return P()
+''')
+    report = run_lint(str(tmp_path), ["mesh"])
+    assert any("without a '# mesh:" in m for m in messages(report))
+
+
+def test_reshard_bare_marker_reports_exactly_once(tmp_path):
+    # one defect, one violation — never a second 'marker missing'
+    # report at the call site pointing the fix the wrong way
+    plant(tmp_path, "reval_tpu/parallel/sp.py",
+          '''import jax
+
+
+def constrain(h, s):
+    # reshard:
+    return jax.lax.with_sharding_constraint(h, s)
+''')
+    report = run_lint(str(tmp_path), ["reshard"])
+    assert len(messages(report, "reshard")) == 1
+    assert "without a reason" in messages(report, "reshard")[0]

@@ -14,6 +14,18 @@ Thin launcher over :mod:`reval_tpu.analysis.driver` — the passes are:
 - ``tilecontract`` every ``pallas_call`` in ops/ declares
                    ``# tile: (sublane, lane)``; resolvable BlockSpec/VMEM
                    dims are lane/sublane-aligned
+- ``mesh``         every Mesh/NamedSharding/PartitionSpec/shard_map ctor
+                   in parallel/, models/, inference/tpu/ is covered by a
+                   ``# mesh: axes=(..) in=(..) out=(..) via=(..)``
+                   contract; axes resolve against parallel/mesh.py::AXES;
+                   shard_map specs round-trip; collectives name a
+                   contract axis
+- ``reshard``      with_sharding_constraint needs ``# reshard: <why>``;
+                   device_put / zero-arg PartitionSpec in hot-path/jit
+                   regions too
+- ``enginezoo``    every engine class implements/delegates/reasons away
+                   each declared surface member; orphan public methods
+                   flagged; ENGINE_SURFACE.md parity matrix kept fresh
 - ``errors``       serving layer raises only the serving/errors.py taxonomy
 - ``env``          REVAL_TPU_* reads go through reval_tpu/env.py::ENV
 - ``metrics``      METRICS spec <-> README <-> literals (ex check_metrics)
@@ -25,9 +37,15 @@ Usage::
     python tools/reval_lint.py              # all passes, this repo
     python tools/reval_lint.py locks env    # a subset
     python tools/reval_lint.py --root DIR   # a planted tree (tests)
+    python tools/reval_lint.py --json       # machine-readable report
+    python tools/reval_lint.py --changed-only   # git-diff-scoped output
+    python tools/reval_lint.py --write-engine-matrix   # ENGINE_SURFACE.md
 
-Exit status 1 on any unsuppressed violation; suppressions
-(``# lint: allow(<pass>) — <reason>``) are counted and reported.
+Exit codes: 0 clean, 1 any unsuppressed violation, 2 unrunnable
+(unknown pass, --changed-only outside git).  Suppressions
+(``# lint: allow(<pass>) — <reason>``) are counted and reported;
+zombie suppressions (pass ran, nothing found at the site) are
+violations themselves.
 """
 
 import os
