@@ -553,14 +553,15 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
               max_slots=32, max_seq_len=2048, num_pages=None, kv_dtype="",
-              progress_path=None, metric=""):
+              progress_path=None, metric="", grammar=None, speculative=None):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
     t_build0 = time.perf_counter()
     eng = PagedTPUEngine(params, cfg, tok, max_slots=max_slots,
                          max_seq_len=max_seq_len, num_pages=num_pages,
-                         prefix_sharing=prefix_sharing, kv_dtype=kv_dtype)
+                         prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
+                         speculative=speculative)
     build_wall = time.perf_counter() - t_build0
     # warmup = one full identical run: prefill buckets, decode span buckets,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
@@ -633,9 +634,10 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
         thr.start()
     note("  paged warmup pass (compiles land here)")
     t0 = time.perf_counter()
+    gkw = {"grammar": grammar} if grammar else {}
     try:
         eng.generate(prompts, max_new_tokens=max_new,
-                     temperature=0.0, stop=["[/ANSWER]"])
+                     temperature=0.0, stop=["[/ANSWER]"], **gkw)
         warmup_wall = time.perf_counter() - t0
         # the warmup pass is the COLD prefix-cache pass (fresh engine):
         # its prefill_tokens against the warm timed pass's measures the
@@ -648,7 +650,7 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
                      warmup_wall=warmup_wall)
         t0 = time.perf_counter()
         outs = eng.generate(prompts, max_new_tokens=max_new, temperature=0.0,
-                            stop=["[/ANSWER]"])
+                            stop=["[/ANSWER]"], **gkw)
     finally:
         if stop_evt is not None:
             stop_evt.set()
@@ -786,6 +788,9 @@ def main() -> None:
                          "(REVAL_TPU_OBS=0) — the A/B that prices the "
                          "observability layer's hot-path cost (PERF.md); "
                          "counters stay on (engine accounting needs them)")
+    ap.add_argument("--no-spec", action="store_true",
+                    help="skip the speculative-decoding A/B garnish "
+                         "(grammar-constrained probes, spec on vs off)")
     ap.add_argument("--no-determinism", action="store_true",
                     help="skip the determinism slice (the reference-cell "
                          "greedy fingerprint recorded so BENCH history "
@@ -1053,6 +1058,45 @@ def main() -> None:
             except Exception as e:
                 extras["ab_error"] = type(e).__name__
                 note(f'prefix-cache A/B failed ({type(e).__name__}); '
+                     'keeping the measured headline')
+
+        # Speculative garnish: the same probes decoded under their answer
+        # grammar with the self-drafting verify path on, then off — the
+        # `speculative` block carries accept-rate and the engine-steps-
+        # saved ratio (the probes/sec/chip lever ROADMAP item 2 names).
+        # The headline above stays grammar-less and spec-gated-off, so
+        # BENCH_r* history remains comparable.  Garnish rules apply.
+        if not args.no_spec:
+            note('speculative A/B (grammar-constrained, spec on vs off)')
+            try:
+                sg = "yesno" if args.mode == "direct" else "cot-yesno"
+                sp_prompts = prompts[: min(len(prompts), 16)]
+                w_on, st_on, _, _, _ = run_paged(
+                    params, cfg, tok, sp_prompts, max_new,
+                    prefix_sharing=not args.no_prefix_cache,
+                    max_slots=args.slots, max_seq_len=args.max_seq_len,
+                    num_pages=num_pages, kv_dtype=args.kv_dtype,
+                    grammar=sg, speculative=True)
+                w_off, st_off, _, _, _ = run_paged(
+                    params, cfg, tok, sp_prompts, max_new,
+                    prefix_sharing=not args.no_prefix_cache,
+                    max_slots=args.slots, max_seq_len=args.max_seq_len,
+                    num_pages=num_pages, kv_dtype=args.kv_dtype,
+                    grammar=sg, speculative=False)
+                extras["speculative"] = {
+                    **st_on.spec_counters(),
+                    "grammar": sg,
+                    "decode_steps": st_on.decode_steps,
+                    "decode_steps_no_spec": st_off.decode_steps,
+                    "steps_saved_ratio": round(
+                        st_off.decode_steps / st_on.decode_steps, 2)
+                    if st_on.decode_steps else 0.0,
+                    "no_spec_speedup": round(w_off / w_on, 3)
+                    if w_on else 0.0,
+                }
+            except Exception as e:
+                extras["spec_error"] = type(e).__name__
+                note(f'speculative A/B failed ({type(e).__name__}); '
                      'keeping the measured headline')
 
         # Determinism garnish: run the tiny seeded probe slice through

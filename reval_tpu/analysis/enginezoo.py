@@ -61,6 +61,9 @@ SURFACE: dict[str, str] = {
     "new_drive_state": "fresh per-driver drive-loop state",
     "encode_clipped": "tokenize a prompt clipped to the engine's budget",
     "request_keys": "per-request PRNG keys for sampled decode",
+    "spec_counters": "speculative-decoding accept/draft counter snapshot",
+    "grammar_state": "compile a grammar name into the engine's "
+                     "constraint tables, returning its start state",
 }
 
 _NOT_SUPPORTED_RE = re.compile(

@@ -281,6 +281,13 @@ def run_fleet(argv: list[str]) -> int:
                         help="seed for the chaos fault schedule (default 0)")
     parser.add_argument("--no-resilience", action="store_true",
                         help="disable retry + batch bisection around the backend")
+    parser.add_argument("--grammar", action="store_true",
+                        help="grammar-constrained decoding: each task decodes "
+                             "under its answer-shape automaton (coverage → "
+                             "yesno, path → line, state → value;type, output "
+                             "→ assert — reval_tpu/decoding/), which also "
+                             "feeds the speculative drafter; paged-engine "
+                             "backends only")
     parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                         help="override a config key (repeatable; JSON values accepted)")
     args = parser.parse_args(argv)
@@ -341,7 +348,8 @@ def run_fleet(argv: list[str]) -> int:
         # same dict for its per-request policy (other backends ignore it)
         backend_kwargs = {k: v for k, v in cfg.items()
                           if k not in ("task", "mock", "backend", "chaos",
-                                       "chaos_seed", "resume", "resilience")}
+                                       "chaos_seed", "resume", "resilience",
+                                       "grammar")}
         if multihost == "replicate":
             # each host runs a full replica on its OWN chips; without this
             # the engine would build its mesh over the global pod devices
@@ -376,7 +384,7 @@ def run_fleet(argv: list[str]) -> int:
     consumed = {"task", "backend", "mock", "custom_mock", "dataset",
                 "prompt_type", "results_dir", "repeats", "progress", "tasks",
                 "multihost", "run_consistency", "max_items", "chaos",
-                "chaos_seed", "resume", "resilience", "retry"}
+                "chaos_seed", "resume", "resilience", "retry", "grammar"}
     task_kwargs = {k: v for k, v in cfg.items() if k not in consumed}
     cfg_tasks = cfg.get("tasks", FLEET_TASKS)
     cfg_tasks = (cfg_tasks,) if isinstance(cfg_tasks, str) else tuple(cfg_tasks)
@@ -389,7 +397,8 @@ def run_fleet(argv: list[str]) -> int:
         progress=cfg.get("progress", True),
         tasks=cfg_tasks,
         multihost=multihost, resume=resume, resilience=resilience,
-        retry_policy=retry_policy, max_items=max_items, **task_kwargs)
+        retry_policy=retry_policy, max_items=max_items,
+        grammar=args.grammar or bool(cfg.get("grammar")), **task_kwargs)
     try:
         result = fleet.run()
     finally:

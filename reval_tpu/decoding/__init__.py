@@ -1,0 +1,29 @@
+"""Speculative + constrained decoding (ROADMAP item 2).
+
+Three layers over the paged engine:
+
+- :mod:`~reval_tpu.decoding.grammar` — REval answer shapes compiled to
+  token-level constraint automata, applied as logit masks inside the
+  decode step (a constrained row can never emit an out-of-grammar
+  token);
+- :mod:`~reval_tpu.decoding.draft` — self-drafting proposers
+  (grammar-forced tokens + prompt-lookup n-gram spans over the row's
+  own context);
+- the engine's batched verify path
+  (``inference/tpu/paged_engine.py::_verify_chunk``) — all K draft
+  positions scored in ONE dispatch, with bit-identical greedy accept
+  semantics: accepted tokens are provably the tokens plain greedy
+  decode would have emitted (certified by the determinism observatory's
+  ``spec-*`` parity cells every round).
+
+Kill switch: ``REVAL_TPU_SPEC=0`` restores plain decode byte-for-byte.
+"""
+
+from .draft import NgramIndex, propose
+from .grammar import (CLOSE_TAG, SHAPES, TASK_GRAMMARS, GrammarSet,
+                      compile_shape, validate_grammar)
+
+__all__ = [
+    "CLOSE_TAG", "SHAPES", "TASK_GRAMMARS", "GrammarSet", "NgramIndex",
+    "compile_shape", "propose", "validate_grammar",
+]

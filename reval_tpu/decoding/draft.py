@@ -1,0 +1,125 @@
+"""Self-drafting proposers for speculative decoding.
+
+No draft model: draft tokens come from the request's OWN structure —
+
+- **grammar forcing** — when the request's constraint automaton
+  (decoding/grammar.py) is in a state with exactly ONE legal token,
+  that token is a free draft: the verify step's masked argmax can only
+  ever produce it, so it is accepted by construction.  Structured
+  answers (``ES\\n[/ANSWER]`` after a ``Y``) draft themselves.
+- **prompt lookup (n-gram matching)** — REval probes quote the program
+  under test back at the model (the answer region echoes identifiers,
+  line text, values seen in the prompt), so the classic
+  prompt-lookup-decoding move applies: match the last ``n`` generated
+  tokens against the request's own context and propose the historical
+  continuation span.
+
+Both proposers are exact-verify-safe: a wrong draft costs one rejected
+verify position, never a wrong token (the batched verify step accepts
+only drafts equal to its own masked greedy argmax —
+``paged_engine._verify_chunk``).
+
+Host-side and allocation-light by design: one :class:`NgramIndex` per
+request, extended incrementally as tokens are accepted (never rebuilt),
+and a propose loop of dict lookups — this runs inside the engine's
+``# hot-path`` drive tick.
+"""
+
+from __future__ import annotations
+
+__all__ = ["NgramIndex", "propose"]
+
+
+class NgramIndex:
+    """Prompt-lookup index over one request's token stream.
+
+    Maps every gram of order ``2..n`` to the position FOLLOWING its most
+    recent occurrence (latest wins — recency is the best predictor under
+    repetitive probe text); a match tries the longest order first and
+    falls back, which is what survives BPE merge jitter at the
+    prompt/generation boundary.  ``extend`` registers the grams
+    *preceding* each appended token, so the stream's current tail is
+    never its own match.  Single-owner, like the request it belongs to.
+    """
+
+    __slots__ = ("n", "toks", "_maps")
+
+    MIN_ORDER = 2
+
+    def __init__(self, n: int, tokens=()):
+        self.n = max(self.MIN_ORDER, int(n))
+        self.toks: list[int] = []
+        self._maps: dict[int, dict[tuple, int]] = {
+            k: {} for k in range(self.MIN_ORDER, self.n + 1)}
+        if tokens:
+            self.extend(tokens)
+
+    def extend(self, tokens) -> None:
+        toks, maps = self.toks, self._maps
+        for t in tokens:
+            toks.append(int(t))
+            i = len(toks) - 1
+            for k, gram_map in maps.items():
+                if i >= k:
+                    gram_map[tuple(toks[i - k:i])] = i
+
+    def match(self, tail) -> int | None:
+        """Position whose history continues ``tail`` (the stream's last
+        tokens incl. any pending drafts), longest order first; None when
+        no order matches.  Slices BEFORE converting: this runs per
+        eligible row per drive tick (the spec gate's promising probe),
+        so it must not copy the whole stream."""
+        tail = [int(t) for t in tail[-self.n:]]
+        n_toks = len(self.toks)
+        for k in range(min(self.n, len(tail)), self.MIN_ORDER - 1, -1):
+            pos = self._maps[k].get(tuple(tail[-k:]))
+            if pos is not None and pos < n_toks:
+                return pos
+        return None
+
+
+def propose(index: NgramIndex | None, k: int, grammars=None,
+            state: int = 0) -> tuple[list[int], int]:
+    """Up to ``k`` draft tokens for one request.
+
+    ``grammars``: the engine's :class:`~.grammar.GrammarSet` (None for
+    an unconstrained row); ``state`` the row's current automaton state.
+    Per position: a grammar-forced token wins (guaranteed accept), else
+    the active n-gram span's next token — if it is grammar-legal —
+    else try a fresh n-gram match, else stop.  Returns ``(drafts,
+    n_forced)`` where ``n_forced`` counts the grammar-forced positions
+    (the ``reval_grammar_forced_tokens_total`` observable); every draft
+    is legal in sequence from ``state``.
+    """
+    drafts: list[int] = []
+    n_forced = 0
+    span_pos: int | None = None
+    constrained = grammars is not None and state != 0
+    while len(drafts) < k:
+        tok = -1
+        if constrained:
+            forced = int(grammars.forced[state])
+            if forced >= 0:
+                tok = forced
+                n_forced += 1
+        if tok < 0 and index is not None:
+            if span_pos is None or span_pos >= len(index.toks):
+                # only the last n tokens matter to match(): never concat
+                # the whole stream with the drafts (hot-path allocation)
+                tail = ((index.toks[-index.n:] + drafts)
+                        if drafts else index.toks)
+                span_pos = index.match(tail)
+            if span_pos is not None and span_pos < len(index.toks):
+                cand = index.toks[span_pos]
+                if not constrained or grammars.allowed(state, cand):
+                    tok = cand
+                    span_pos += 1
+                else:
+                    span_pos = None     # span went out-of-grammar: stop
+        if tok < 0:
+            break
+        drafts.append(tok)
+        if constrained:
+            state = int(grammars.next[state, tok])
+            constrained = state != 0
+    return drafts, n_forced

@@ -1,0 +1,436 @@
+"""Answer-shape grammars compiled to token-level constraint automata.
+
+REval's four probe tasks emit tiny, rigidly structured answers — a
+YES/NO verdict, a line of code (or ``-1``), a ``value; type`` state
+prediction, an assert completion — each wrapped in the benchmark's
+``[ANSWER]``/``[/ANSWER]`` tags (prompting/templates).  This module
+compiles each shape into a character-level automaton and *lifts* it to
+the engine's real tokenizer: for every automaton state, which token ids
+may be emitted next and which state each one leads to.  The paged
+engine applies that as a logit mask inside the jitted decode step
+(``paged_engine._decode_chunk`` / ``_verify_chunk``), so a constrained
+row can never emit an out-of-grammar token — and the drafter
+(decoding/draft.py) reads the same tables to propose grammar-forced
+tokens for free when a state has exactly one legal continuation.
+
+Layers:
+
+- **Patterns** — a tiny combinator set (``lit``/``seq``/``alt``/
+  ``star``/``plus``/``opt``/``cls``) compiled to a Thompson NFA.  The
+  token lift executes the NFA with *frozensets of nodes* as states
+  (lazy subset construction), so alternation/ambiguity (``Nil`` vs
+  ``value; type``) needs no hand-built DFA.
+- **Shapes** — the named grammars (:data:`SHAPES`): ``yesno``, ``int``,
+  ``line``, ``state``, ``assert``, plus the user syntax
+  ``lit:A|B|C`` (literal alternatives) and the ``cot-<shape>`` wrapper
+  (free chain-of-thought text, then ``[/THOUGHT]`` … ``[ANSWER]``,
+  then the shape body).  Every shape ends with the forced close
+  ``[/ANSWER]`` — after it the automaton enters the FREE state.
+- **TokenGrammar / GrammarSet** — the token-level tables.  A
+  :class:`GrammarSet` owns ONE combined table per engine (state 0 is
+  the shared FREE state: every token allowed, self-loop), with each
+  compiled grammar's states at an offset.  The engine uploads the
+  padded tables as jit operands; the host walks the same numpy tables
+  to track per-request states and to draft.
+
+Token-lift semantics (the contract the tests bite on):
+
+- a token is **allowed** in a state iff its decoded characters all
+  transition the automaton (reaching the accept node makes the rest of
+  the token — and every later token — unconstrained: accept ⇒ FREE);
+- tokens that decode to nothing (EOS, BOS, vocab padding, lone
+  non-UTF-8 bytes) are allowed only in the FREE state — a constrained
+  row cannot end or emit specials mid-answer;
+- a state whose row would otherwise allow NOTHING (a tokenizer that
+  cannot spell the next literal) degrades to EOS-only, so generation
+  ends instead of emitting an arbitrary masked-logit argmax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "SHAPES", "TASK_GRAMMARS", "CLOSE_TAG", "validate_grammar",
+    "compile_shape", "token_strings", "GrammarSet",
+]
+
+CLOSE_TAG = "[/ANSWER]"
+
+#: named answer shapes (see module docstring); ``lit:``/``cot-`` are
+#: syntax, not names, and are validated in :func:`validate_grammar`
+SHAPES = ("yesno", "int", "line", "state", "assert")
+
+#: the per-task default grammars the fleet selects when grammar-
+#: constrained decoding is enabled (direct templates; ``cot`` prompt
+#: types use the ``cot-`` wrapped variant)
+TASK_GRAMMARS = {"coverage": "yesno", "path": "line",
+                 "state": "state", "output": "assert"}
+
+
+# -- pattern combinators → Thompson NFA -----------------------------------
+class _Node:
+    __slots__ = ("eps", "trans")
+
+    def __init__(self):
+        self.eps: list[_Node] = []
+        self.trans: list[tuple[str, "_Node"]] = []   # (matcher, target)
+
+
+def _is_printable(c: str) -> bool:
+    return c.isprintable() or c in " \t"
+
+
+#: character classes usable in ``cls(name)`` — all exclude raw control
+#: bytes so NUL/other unprintables never satisfy a constrained state
+_CLASSES = {
+    "digit": lambda c: c in "0123456789",
+    "notnl": lambda c: c != "\n" and _is_printable(c),
+    "ws": lambda c: c in " \t\n\r",
+    "any": lambda c: c == "\n" or _is_printable(c),
+}
+
+
+def lit(s: str):
+    return ("lit", s)
+
+
+def seq(*ps):
+    return ("seq", ps)
+
+
+def alt(*ps):
+    return ("alt", ps)
+
+
+def star(p):
+    return ("star", p)
+
+
+def plus(p):
+    return ("seq", (p, ("star", p)))
+
+
+def opt(p):
+    return ("alt", (p, ("lit", "")))
+
+
+def cls(name: str):
+    assert name in _CLASSES, name
+    return ("cls", name)
+
+
+def _build(p, start: _Node, accept: _Node) -> None:
+    """Wire pattern ``p`` between ``start`` and ``accept`` (Thompson)."""
+    kind, arg = p
+    if kind == "lit":
+        cur = start
+        for ch in arg:
+            nxt = _Node()
+            cur.trans.append((ch, nxt))
+            cur = nxt
+        cur.eps.append(accept)
+    elif kind == "cls":
+        mid = _Node()
+        start.trans.append(("\x00" + arg, mid))   # class marker
+        mid.eps.append(accept)
+    elif kind == "seq":
+        cur = start
+        for sub in arg:
+            nxt = _Node()
+            _build(sub, cur, nxt)
+            cur = nxt
+        cur.eps.append(accept)
+    elif kind == "alt":
+        for sub in arg:
+            _build(sub, start, accept)
+    elif kind == "star":
+        hub = _Node()
+        start.eps.append(hub)
+        hub.eps.append(accept)
+        _build(arg, hub, hub)
+    else:   # pragma: no cover — combinator set is closed
+        raise AssertionError(kind)
+
+
+def _matches(matcher: str, c: str) -> bool:
+    if matcher.startswith("\x00"):
+        return _CLASSES[matcher[1:]](c)
+    return matcher == c
+
+
+class _CharNFA:
+    """One compiled pattern, executed with frozensets as states."""
+
+    def __init__(self, pattern):
+        self.start = _Node()
+        self.accept = _Node()
+        _build(pattern, self.start, self.accept)
+        self.start_set = self._closure({self.start})
+
+    @staticmethod
+    def _closure(nodes: set) -> frozenset:
+        stack, seen = list(nodes), set(nodes)
+        while stack:
+            for nxt in stack.pop().eps:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return frozenset(seen)
+
+    def advance(self, states: frozenset, c: str) -> frozenset:
+        out: set = set()
+        for node in states:
+            for matcher, target in node.trans:
+                if _matches(matcher, c):
+                    out.add(target)
+        return self._closure(out) if out else frozenset()
+
+    def forced_chars(self, states: frozenset, limit: int = 48) -> str:
+        """The deterministic character chain from ``states``: the
+        longest run where exactly ONE concrete character can come next
+        (a class transition or a literal fork ends it).  This is what
+        the drafter proposes for free — under a multi-char tokenizer the
+        chain spans several tokens, so forcing survives BPE."""
+        out: list[str] = []
+        cur = states
+        while len(out) < limit and self.accept not in cur:
+            chars: set[str] = set()
+            for node in cur:
+                for matcher, _ in node.trans:
+                    if matcher.startswith("\x00"):
+                        return "".join(out)     # class edge: not forced
+                    chars.add(matcher)
+            if len(chars) != 1:
+                break
+            c = chars.pop()
+            out.append(c)
+            cur = self.advance(cur, c)
+        return "".join(out)
+
+
+# -- named shapes ----------------------------------------------------------
+def _pre():
+    # at most ONE leading newline (the few-shot examples' spelling): an
+    # unbounded whitespace loop would let a greedy model burn its whole
+    # token budget on masked-in whitespace before the answer body
+    return opt(lit("\n"))
+
+
+def _close():
+    # canonical close: newline + tag, exactly the spelling every
+    # few-shot example shows.  Deliberately tighter than the parser
+    # tolerates (strip_answer_tags accepts any whitespace) — ONE
+    # canonical spelling keeps every post-body close state
+    # single-successor, which is what lets the drafter propose the
+    # whole close for free (decoding/draft.py grammar forcing)
+    return lit("\n" + CLOSE_TAG)
+
+
+def _body(name: str):
+    if name == "yesno":
+        return alt(lit("YES"), lit("NO"))
+    if name == "int":
+        return seq(opt(lit("-")), plus(cls("digit")))
+    if name == "line":
+        # one line of code, or the path task's -1 sentinel (an int IS a
+        # printable line, so the int case needs no alternative here)
+        return plus(cls("notnl"))
+    if name == "state":
+        # ``value; type`` — at least one semicolon on one line (the
+        # parser rfinds the LAST one, so values may contain more) — or
+        # the benchmark's Nil sentinel
+        return alt(lit("Nil"),
+                   seq(star(cls("notnl")), lit(";"), star(cls("notnl"))))
+    if name == "assert":
+        # assert completion: free line(s) that must contain an assert
+        # before the close tag may ever be emitted
+        return seq(star(cls("any")), lit("assert"), star(cls("any")))
+    if name.startswith("lit:"):
+        choices = [c for c in name[4:].split("|") if c]
+        if not choices:
+            raise ValueError(f"grammar {name!r}: lit: needs at least one "
+                             f"non-empty alternative (lit:A|B)")
+        return alt(*[lit(c) for c in choices])
+    raise ValueError(
+        f"unknown grammar {name!r} (shapes: {', '.join(SHAPES)}, "
+        f"lit:A|B, cot-<shape>)")
+
+
+def compile_shape(name: str) -> _CharNFA:
+    """Compile one grammar name to its character automaton.  Raises
+    ``ValueError`` for unknown names — the serving layer maps that to a
+    400 at submit."""
+    if name.startswith("cot-"):
+        inner = _body(name[4:])
+        pattern = seq(star(cls("any")), lit("[/THOUGHT]"), star(cls("ws")),
+                      lit("[ANSWER]"), _pre(), inner, _close())
+    else:
+        pattern = seq(_pre(), _body(name), _close())
+    return _CharNFA(pattern)
+
+
+def validate_grammar(name: str) -> str:
+    """Check a grammar name parses (no tokenizer needed); returns the
+    name.  The one validation rule every entry point shares — engine
+    submit, serving schema, the mock engine."""
+    if not isinstance(name, str) or not name:
+        raise ValueError("grammar must be a non-empty string")
+    compile_shape(name)
+    return name
+
+
+# -- token lift ------------------------------------------------------------
+def token_strings(tokenizer, vocab_size: int) -> list[str]:
+    """Per-id decoded strings for ids [0, vocab_size).  Ids the
+    tokenizer cannot decode (vocab padding) and ids that decode to
+    nothing (EOS/BOS/specials) come back as "" — the lift treats those
+    as FREE-state-only tokens."""
+    out: list[str] = []
+    for i in range(vocab_size):
+        try:
+            s = tokenizer.decode([i])
+        except Exception:   # noqa: BLE001 — padding ids past the real
+            # vocab are legitimately undecodable
+            s = ""
+        out.append(s if isinstance(s, str) else "")
+    return out
+
+
+class GrammarSet:
+    """The per-engine combined token-constraint tables.
+
+    State 0 is the FREE state (every token allowed, self-loop) — it is
+    both "no grammar on this row" and "grammar satisfied".  Each
+    compiled grammar occupies a contiguous state range; compiling a new
+    grammar bumps ``version`` so the engine re-uploads device tables.
+
+    Single-owner like the engine that holds it: the driver thread
+    compiles and walks; no locks.
+    """
+
+    def __init__(self, tokenizer, vocab_size: int):
+        self.tokenizer = tokenizer
+        self.vocab_size = int(vocab_size)
+        self.eos_id = int(tokenizer.eos_id)
+        self.version = 0
+        self._token_strs: list[str] | None = None   # built lazily, once
+        self._starts: dict[str, int] = {}
+        free_mask = np.ones((1, self.vocab_size), np.bool_)
+        free_next = np.zeros((1, self.vocab_size), np.int32)
+        self.mask = free_mask           # [S, V] token allowed in state
+        self.next = free_next           # [S, V] successor state
+        self.forced = np.full(1, -1, np.int32)  # exactly-one-legal token
+
+    def names(self) -> list[str]:
+        return sorted(self._starts)
+
+    @property
+    def n_states(self) -> int:
+        return self.mask.shape[0]
+
+    def _strings(self) -> list[str]:
+        if self._token_strs is None:
+            self._token_strs = token_strings(self.tokenizer, self.vocab_size)
+        return self._token_strs
+
+    def ensure(self, name: str) -> int:
+        """Compile ``name`` into the combined tables (idempotent);
+        returns its start state.  Raises ``ValueError`` on unknown
+        names."""
+        if name in self._starts:
+            return self._starts[name]
+        nfa = compile_shape(name)
+        strs = self._strings()
+        offset = self.n_states
+        # lazy subset construction over the token alphabet: discover
+        # reachable frozenset-states by walking every token string
+        idx: dict[frozenset, int] = {nfa.start_set: offset}
+        order: list[frozenset] = [nfa.start_set]
+        rows_mask: list[np.ndarray] = []
+        rows_next: list[np.ndarray] = []
+        cursor = 0
+        while cursor < len(order):
+            states = order[cursor]
+            cursor += 1
+            mask_row = np.zeros(self.vocab_size, np.bool_)
+            next_row = np.zeros(self.vocab_size, np.int32)
+            for tok, s in enumerate(strs):
+                if not s:
+                    continue        # specials/padding: FREE-state only
+                cur = states
+                dest = None
+                for ch in s:
+                    cur = nfa.advance(cur, ch)
+                    if not cur:
+                        break
+                    if nfa.accept in cur:
+                        dest = 0    # answer complete: rest is FREE
+                        break
+                else:
+                    if cur:
+                        if cur not in idx:
+                            idx[cur] = offset + len(order)
+                            order.append(cur)
+                        dest = idx[cur]
+                if dest is None:
+                    continue
+                mask_row[tok] = True
+                next_row[tok] = dest
+            if not mask_row.any():
+                # dead end (tokenizer cannot spell the continuation):
+                # degrade to EOS-only so the row ends instead of
+                # emitting an arbitrary all-masked argmax
+                mask_row[self.eos_id] = True
+                next_row[self.eos_id] = 0
+            rows_mask.append(mask_row)
+            rows_next.append(next_row)
+        self.mask = np.concatenate([self.mask, np.stack(rows_mask)], axis=0)
+        self.next = np.concatenate([self.next, np.stack(rows_next)], axis=0)
+        # canonical draft token per state: the only legal token when the
+        # mask leaves one (accepted by construction), else the LONGEST
+        # allowed token spelling a prefix of the state's deterministic
+        # character chain — multi-char tokenizers spell "\n[/ANSWER]" in
+        # one or two tokens, and a draft that merely segments the forced
+        # text differently than the model costs one rejected position,
+        # never a wrong token
+        forced = np.full(self.n_states, -1, np.int32)
+        forced[: len(self.forced)] = self.forced
+        for states, s in idx.items():
+            allowed = np.flatnonzero(self.mask[s])
+            if len(allowed) == 1:
+                forced[s] = allowed[0]
+                continue
+            chain = nfa.forced_chars(states)
+            if not chain:
+                continue
+            best, best_len = -1, 0
+            for tok in allowed:
+                t = strs[tok] if tok < len(strs) else ""
+                if t and len(t) > best_len and chain.startswith(t):
+                    best, best_len = int(tok), len(t)
+            forced[s] = best
+        self.forced = forced
+        self._starts[name] = offset
+        self.version += 1
+        return offset
+
+    def start_state(self, name: str) -> int:
+        return self.ensure(name)
+
+    def allowed(self, state: int, token: int) -> bool:
+        return bool(self.mask[state, token])
+
+    def walk(self, state: int, tokens) -> int:
+        """Advance a state along emitted tokens (host-side mirror of the
+        in-jit table walk).  An out-of-table token — impossible for a
+        masked row, possible for a FREE row — keeps/returns FREE."""
+        for t in tokens:
+            t = int(t)
+            if state == 0:
+                continue
+            if 0 <= t < self.vocab_size and self.mask[state, t]:
+                state = int(self.next[state, t])
+            else:
+                state = 0
+        return state

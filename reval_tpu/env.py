@@ -71,6 +71,22 @@ ENV: dict[str, dict] = {
         "default": "",
         "help": "when set to a directory, each generate() writes a "
                 "jax.profiler trace into it"},
+    # -- speculative + constrained decoding (reval_tpu/decoding/,
+    #    inference/tpu/paged_engine.py) ------------------------------------
+    "REVAL_TPU_SPEC": {
+        "default": "1",
+        "help": "speculative decoding master switch (0 restores plain "
+                "decode byte-for-byte; grammar logit masking is a "
+                "separate per-request feature and stays honored)"},
+    "REVAL_TPU_SPEC_K": {
+        "default": "8",
+        "help": "max draft tokens per verify window (the batched verify "
+                "scores K drafts + 1 bonus position per dispatch)"},
+    "REVAL_TPU_SPEC_NGRAM": {
+        "default": "3",
+        "help": "prompt-lookup n-gram order for the self-drafting "
+                "proposer (0 disables n-gram drafting; grammar-forced "
+                "drafting stays on)"},
     # -- observability -----------------------------------------------------
     "REVAL_TPU_OBS": {
         "default": "1",

@@ -50,7 +50,8 @@ class FleetRunner:
                  tasks: tuple[str, ...] = FLEET_TASKS,
                  multihost: str | None = None, resume: bool = False,
                  resilience: bool = True,
-                 retry_policy: RetryPolicy | None = None, **task_kwargs):
+                 retry_policy: RetryPolicy | None = None,
+                 grammar: bool = False, **task_kwargs):
         assert backend is not None or mock, "fleet needs a backend (or mock=True)"
         assert multihost in (None, "replicate", "global"), multihost
         # "global" shards one model across hosts: every infer_many is a
@@ -75,6 +76,19 @@ class FleetRunner:
         # prompts everywhere (70B-class); None = single host
         self.multihost = multihost
         self.resume = resume
+        #: grammar-constrained decoding: each task decodes under its
+        #: answer-shape automaton (decoding/grammar.py TASK_GRAMMARS;
+        #: cot prompt types get the cot- wrapped variant).  Requires a
+        #: backend that supports per-task grammars (set_task_grammar —
+        #: the paged-engine TPU backend); rejected up front otherwise so
+        #: a run can never silently score unconstrained generations as
+        #: constrained ones.
+        self.grammar = bool(grammar)
+        if self.grammar and backend is not None and not callable(
+                getattr(backend, "set_task_grammar", None)):
+            raise ValueError(
+                "grammar-constrained fleet runs need a backend with "
+                "per-task grammar support (the paged-engine TPU backend)")
         self.task_kwargs = task_kwargs
 
     def _model_info(self) -> str:
@@ -113,6 +127,14 @@ class FleetRunner:
         planned = [(task, *task._plan()) for task in tasks]
         shared = self.backend is not None and all(
             t.backend is self.backend for t in tasks)
+        if shared and self.grammar:
+            # per-TASK batches instead of the cross-task fused batch:
+            # each task decodes under its own answer-shape automaton,
+            # and the grammar is backend state per infer_many call.  The
+            # radix prefix cache persists ACROSS calls (PR 2), so the
+            # per-template insert-then-hit sequence is unchanged — the
+            # cost is only the per-task batch tail.
+            shared = False
         if shared:
             # task-major order is load-bearing, not incidental: each task's
             # prompts share one few-shot template, and grouping them keeps
@@ -136,12 +158,35 @@ class FleetRunner:
                     checkpoint.record(rep, task.name, metrics[task.name])
         else:
             for task, records, jobs in planned:
-                responses = task.backend.infer_many([j.prompt for j in jobs])
+                setter = (getattr(task.backend, "set_task_grammar", None)
+                          if self.grammar else None)
+                if setter is not None:
+                    setter(self.task_grammar(task.name))
+                try:
+                    responses = task.backend.infer_many(
+                        [j.prompt for j in jobs])
+                finally:
+                    if setter is not None:
+                        setter(None)    # never leak a task's constraint
                 self._check_aligned(len(responses), [(task, records, jobs)])
                 metrics[task.name] = task.score_and_write(records, jobs, responses)
                 if checkpoint is not None and self._should_write():
                     checkpoint.record(rep, task.name, metrics[task.name])
         return metrics
+
+    def task_grammar(self, task_name: str) -> str | None:
+        """The answer-shape grammar one task decodes under when
+        ``grammar=True`` (None = unconstrained — tasks outside the map,
+        or the feature off).  Chain-of-thought prompt types wrap the
+        shape so the free [THOUGHT] text stays unconstrained."""
+        if not self.grammar:
+            return None
+        from .decoding import TASK_GRAMMARS
+
+        shape = TASK_GRAMMARS.get(task_name)
+        if shape is None:
+            return None
+        return f"cot-{shape}" if self.prompt_type == "cot" else shape
 
     @staticmethod
     def _check_aligned(n_responses: int, planned) -> None:
@@ -228,6 +273,11 @@ class FleetRunner:
             result["serving"] = serving
             if self.progress:
                 print(f"[fleet] serving lifecycle: {serving}")
+        speculative = self._spec_trailer()
+        if speculative:
+            result["speculative"] = speculative
+            if self.progress:
+                print(f"[fleet] speculative decoding: {speculative}")
         latency = self._latency_trailer()
         if latency:
             result["latency"] = latency
@@ -268,6 +318,20 @@ class FleetRunner:
             return None
         trailer = counters()
         return trailer if any(trailer.values()) else None
+
+    def _spec_trailer(self) -> dict | None:
+        """Speculative-decoding counters for the run summary (accept
+        rate, drafted/accepted/rolled-back tokens — the SAME
+        ``EngineStats.spec_counters`` dict bench JSON renders).  Absent
+        when the backend exposes no instrumented engine or nothing was
+        drafted/constrained this run."""
+        stats = getattr(getattr(self.backend, "engine", None), "stats", None)
+        counters = getattr(stats, "spec_counters", None)
+        if not callable(counters):
+            return None
+        trailer = counters()
+        return (trailer if (trailer.get("rounds")
+                            or trailer.get("grammar_requests")) else None)
 
     def _latency_trailer(self) -> dict | None:
         """p50/p95/p99 of the engine's request-latency histograms (TTFT,
@@ -314,6 +378,8 @@ class FleetRunner:
             snap["prefix_cache"] = result["prefix_cache"]
         if result.get("serving"):
             snap["serving"] = result["serving"]
+        if result.get("speculative"):
+            snap["speculative"] = result["speculative"]
         try:
             os.makedirs(self.results_dir, exist_ok=True)
             path = os.path.join(self.results_dir, "fleet_metrics.json")

@@ -146,12 +146,31 @@ class TPUBackend(InferenceBackend):
     def infer_one(self, prompt: str) -> str:
         return self.infer_many([prompt])[0]
 
+    def set_task_grammar(self, grammar: str | None) -> None:
+        """Constrain subsequent :meth:`infer_many` calls to one answer
+        shape (reval_tpu/decoding/) — the fleet sets this per task and
+        clears it after (``FleetRunner.task_grammar``).  Raises up front
+        when the selected engine has no constrained-decode path (static/
+        pp), so a grammar run can never silently score unconstrained
+        generations."""
+        if grammar and not hasattr(self.engine, "spec_counters"):
+            raise ValueError(
+                "grammar-constrained decoding requires a paged engine "
+                "(engine='paged'); the static/pp engines have no masked "
+                "decode path")
+        self._task_grammar = grammar or None
+
     def infer_many(self, prompts) -> list[str]:
+        kwargs = {}
+        grammar = getattr(self, "_task_grammar", None)
+        if grammar:
+            kwargs["grammar"] = grammar
         return self.engine.generate(
             list(prompts),
             max_new_tokens=self.config.max_new_tokens,
             temperature=self.temp,
             stop=self.config.stop,
+            **kwargs,
         )
 
     def close(self) -> None:

@@ -57,12 +57,14 @@ class DataParallelPagedEngine:
     # not-supported: request_keys — per-replica PRNG keys
     # not-supported: warm_state — snapshot/restore is per-replica (.r<i> suffixes)
     # not-supported: rewarm — restore is per-replica (see warm_state)
+    # not-supported: grammar_state — automaton state ids are replica-local (work stealing resolves per pull)
     def __init__(self, params, cfg, tokenizer, *, dp_size: int,
                  tp_size: int = 1, max_slots: int = 8, page_size: int = 128,
                  max_seq_len: int = 8192, num_pages: int | None = None,
                  seed: int = 0, prefix_sharing: bool = True, devices=None,
                  kv_dtype: str = "",
-                 memory_utilization: float | None = None):
+                 memory_utilization: float | None = None,
+                 speculative: bool | None = None):
         devices = list(devices if devices is not None else jax.devices())
         need = dp_size * tp_size
         if len(devices) < need:
@@ -81,7 +83,8 @@ class DataParallelPagedEngine:
                 page_size=page_size, max_seq_len=max_seq_len,
                 num_pages=num_pages, mesh=mesh, seed=seed + r,
                 prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
-                memory_utilization=memory_utilization))
+                memory_utilization=memory_utilization,
+                speculative=speculative))
         self._pool = ThreadPoolExecutor(max_workers=dp_size,
                                         thread_name_prefix="dp-paged")
 
@@ -163,14 +166,21 @@ class DataParallelPagedEngine:
                 out[k] = out.get(k, 0) + v
         return out
 
+    def spec_counters(self) -> dict:
+        """Speculative-decoding counters aggregated over replicas (the
+        underlying counters ride the merged ``stats`` registry)."""
+        return self.stats.spec_counters()
+
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0,
                  stop: list[str] | None = None,
                  top_k: int = 0, top_p: float = 1.0,
-                 on_progress=None, return_ids: bool = False):
+                 on_progress=None, return_ids: bool = False,
+                 grammar=None):
         if not prompts:
             return ([], []) if return_ids else []
         stop = stop or []
+        grammars = PagedTPUEngine._grammar_list(grammar, len(prompts))
         # latency stamps anchor at CALL time, not queue-pull time: a
         # prompt that waits in the shared work queue must show that wait
         # in queue_wait/ttft/e2e, same clock as the serving session
@@ -215,14 +225,20 @@ class DataParallelPagedEngine:
                         # the replica's persistent radix cache: the first
                         # pull of a template prefills + caches it, every
                         # later pull (this call or the next) rides it
-                        seq, node = eng.submit_request(ids, max_new_tokens)
+                        seq, node = eng.submit_request(ids, max_new_tokens,
+                                                       grammar=grammars[i])
                         reqs[seq] = _Request(
                             index=i, ids=ids, max_new=max_new_tokens,
                             scanner=StopScanner(eng.tokenizer, stop),
                             temp=float(temperature),
                             top_k=int(top_k), top_p=float(top_p),
                             notify=notify, key=keys[i], node=node,
-                            t_submit=t_submit)
+                            t_submit=t_submit,
+                            grammar=grammars[i],
+                            # automaton state ids are REPLICA-local: the
+                            # pulling engine resolves its own start state
+                            gstate=(eng.grammar_state(grammars[i])
+                                    if grammars[i] else 0))
                     if not reqs:
                         break
                     eng._drive_tick(reqs, st)

@@ -253,6 +253,15 @@ _STAT_FIELDS = (
     ("prefix_lookup_tokens", "reval_prefix_lookup_tokens_total", int),
     ("prefix_inserted_pages", "reval_prefix_inserted_pages_total", int),
     ("prefix_evictions", "reval_prefix_evictions_total", int),
+    # speculative + constrained decoding (reval_tpu/decoding/ + the
+    # paged engine's batched verify path):
+    ("spec_rounds", "reval_spec_verify_rounds_total", int),
+    ("spec_drafted_tokens", "reval_spec_drafted_tokens_total", int),
+    ("spec_accepted_tokens", "reval_spec_accepted_tokens_total", int),
+    ("spec_rolled_back_tokens", "reval_spec_rolled_back_tokens_total", int),
+    ("spec_wedges", "reval_spec_wedges_total", int),
+    ("grammar_requests", "reval_grammar_requests_total", int),
+    ("grammar_forced_tokens", "reval_grammar_forced_tokens_total", int),
     # serving lifecycle (serving/session.py + serving/server.py):
     ("sheds", "reval_serving_sheds_total", int),
     ("deadline_expired", "reval_serving_deadline_expired_total", int),
@@ -302,6 +311,25 @@ class EngineStats:
                 "deadline_expired": self.deadline_expired,
                 "watchdog_trips": self.watchdog_trips,
                 "drain_seconds": round(self.drain_seconds, 3)}
+
+    @property
+    def spec_accept_rate(self) -> float:
+        return (self.spec_accepted_tokens / self.spec_drafted_tokens
+                if self.spec_drafted_tokens else 0.0)
+
+    def spec_counters(self) -> dict:
+        """The speculative-decoding counter block — the
+        ``serving_counters``/``prefix_counters`` sibling: bench JSON,
+        the fleet trailer, and the determinism matrix's spec cells all
+        render THIS dict, so the surfaces cannot drift."""
+        return {"rounds": self.spec_rounds,
+                "drafted_tokens": self.spec_drafted_tokens,
+                "accepted_tokens": self.spec_accepted_tokens,
+                "accept_rate": round(self.spec_accept_rate, 4),
+                "rolled_back_tokens": self.spec_rolled_back_tokens,
+                "forced_tokens": self.grammar_forced_tokens,
+                "grammar_requests": self.grammar_requests,
+                "wedges": self.spec_wedges}
 
     def prefix_counters(self) -> dict:
         """The prefix-cache counter block, the ``serving_counters``
@@ -386,6 +414,8 @@ class TPUEngine:
     # not-supported: prefix_cache_counters — no radix prefix cache on the static path
     # not-supported: warm_state — nothing to snapshot without a prefix cache
     # not-supported: rewarm — nothing to replay without a prefix cache
+    # not-supported: spec_counters — no drafter/verify path on the static whole-batch engine
+    # not-supported: grammar_state — constrained decoding rides the paged decode chunk only
     # mesh: axes=(dp)
     def __init__(self, params, cfg: ModelConfig, tokenizer, *, batch_size: int = 8,
                  max_seq_len: int = 8192, mesh=None, seed: int = 0):
@@ -604,14 +634,22 @@ class TPUEngine:
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None,
                  top_k: int = 0, top_p: float = 1.0,
-                 return_ids: bool = False):
+                 return_ids: bool = False, grammar=None):
         """Generate completions for every prompt (any count); order
         preserved.  ``top_k``/``top_p`` filter the sampling distribution
         (0 / 1.0 = off — the defaults compile no filter into the chunk
         program).  ``return_ids``: also return the raw generated token
         streams (``finalize_ids`` semantics — EOS-cut, pre-stop) as a
         second list, for consumers that must see divergence text hides
-        (the determinism matrix)."""
+        (the determinism matrix).  ``grammar`` is rejected loudly: the
+        constraint automaton rides the paged decode chunk only — a
+        silent ignore here would score unconstrained generations as
+        constrained ones."""
+        if grammar:
+            raise ValueError(
+                "grammar-constrained decoding requires the paged engine "
+                "(reval_tpu/decoding/); the static engine has no masked "
+                "decode path — drop grammar= or use engine='paged'")
         if not prompts:
             return ([], []) if return_ids else []
         stop = stop or []

@@ -45,7 +45,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...analysis.jitcheck import deliberate_fetch, drive_guard, tracked_jit
-from ...env import env_flag
+from ...decoding import GrammarSet, NgramIndex
+from ...decoding import propose as propose_drafts
+from ...env import env_flag, env_int
 from ...models import (
     ModelConfig,
     init_kv_cache,
@@ -57,6 +59,7 @@ from ...obs.flightrec import FlightRecorder
 from ...obs.logging import log_event
 from ...models.paged import (
     commit_prefill,
+    commit_verify,
     init_paged_cache,
     paged_decode_step,
     prefill_with_paged_context,
@@ -140,6 +143,17 @@ class _Request:
     key: np.ndarray = None
     #: radix prefix-cache node this request rides (pinned until release)
     node: object = None
+    #: grammar name constraining this request (None = unconstrained) and
+    #: the row's current automaton state in the engine's GrammarSet
+    #: tables (0 = FREE) — engine-local state ids, resolved at submit
+    grammar: str | None = None
+    gstate: int = 0
+    #: prompt-lookup index (decoding/draft.py), built lazily at the
+    #: first speculative round and extended as tokens are accepted
+    ngram: object = None
+    #: the drafter faulted for this request: spec.wedge degrade — the
+    #: row rides plain decode (or bonus-only verify) until it retires
+    spec_wedged: bool = False
 
     @property
     def prefill_ids(self) -> list[int]:
@@ -164,10 +178,11 @@ class _DriveState:
     slot_temp: np.ndarray        # [B] per-slot sampling temperature
     slot_topk: np.ndarray = None  # [B] per-slot top-k (0 = off)
     slot_topp: np.ndarray = None  # [B] per-slot top-p (1 = off)
-    #: packed [B, span+5] int32 device array: block tables first (span
+    #: packed [B, span+6] int32 device array: block tables first (span
     #: columns — patch_state_tables depends on the tables-first layout),
     #: then seq_lens, the pending input token, the per-request PRNG key
-    #: (2 bitcast words), and the generated-token position
+    #: (2 bitcast words), the generated-token position, and the row's
+    #: grammar-automaton state (0 = unconstrained)
     dev_state: object = None
     dev_samp: object = None      # [B, 3] float32 (temp, top_p, top_k)
     dirty: bool = True
@@ -177,6 +192,9 @@ class _DriveState:
     #: (toks device array, steps, ((slot, seq_id), ...) snapshot, t0)
     pending: tuple | None = None
     t_mark: float = 0.0          # last fetch end (decode-wall accounting)
+    #: ticks to skip before re-flushing the pipeline for a speculative
+    #: attempt after a dry one (see the spec gate in ``_tick``)
+    spec_backoff: int = 0
 
 
 class PagedTPUEngine:
@@ -187,7 +205,8 @@ class PagedTPUEngine:
                  mesh=None, seed: int = 0, prefix_sharing: bool = True,
                  kv_dtype: str = "",
                  memory_utilization: float | None = None,
-                 pipeline: bool | None = None):
+                 pipeline: bool | None = None,
+                 speculative: bool | None = None):
         """``memory_utilization``: when set (and ``num_pages`` is not),
         size the page pool from the device's reported HBM — the
         equivalent of the ``gpu_memory_utilization`` the reference
@@ -204,7 +223,16 @@ class PagedTPUEngine:
         download on the tunneled v5e) behind device compute.  Output is
         bit-identical; sequences that hit a stop string may compute one
         discarded extra chunk.  Default on; ``None`` reads
-        ``REVAL_TPU_PIPELINE`` (set ``0`` to disable, e.g. for A/B)."""
+        ``REVAL_TPU_PIPELINE`` (set ``0`` to disable, e.g. for A/B).
+
+        ``speculative``: the self-drafting verify path
+        (reval_tpu/decoding/).  ``None`` (default) reads
+        ``REVAL_TPU_SPEC`` as the master switch but engages only for
+        greedy rows that carry a ``grammar=`` constraint; ``True``
+        additionally enables n-gram prompt-lookup drafting for
+        grammar-less greedy rows (the determinism matrix's spec cells
+        and the bench A/B set this); ``False`` — like
+        ``REVAL_TPU_SPEC=0`` — restores plain decode byte-for-byte."""
         assert max_seq_len % page_size == 0
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -214,6 +242,18 @@ class PagedTPUEngine:
         if pipeline is None:
             pipeline = env_flag("REVAL_TPU_PIPELINE", True)
         self.pipeline = bool(pipeline)
+        # -- speculative + constrained decoding (reval_tpu/decoding/) ------
+        self.spec_enabled = (env_flag("REVAL_TPU_SPEC", True)
+                             if speculative is None else bool(speculative))
+        #: explicit opt-in: draft grammar-less greedy rows too (n-gram)
+        self.spec_eager = speculative is True
+        self.spec_k = max(1, env_int("REVAL_TPU_SPEC_K", 8))
+        self.spec_ngram = max(0, env_int("REVAL_TPU_SPEC_NGRAM", 3))
+        #: per-engine combined token-constraint tables (state 0 = FREE);
+        #: single-owner like the engine (driver thread compiles/walks)
+        self._grammars = GrammarSet(tokenizer, cfg.vocab_size)
+        self._gtab = None               # device (mask, next) upload
+        self._gtab_version = -1         # GrammarSet.version it mirrors
         self.max_pages_per_seq = max_seq_len // page_size
         if memory_utilization is not None and not (0.0 < memory_utilization <= 1.0):
             # a tiny/negative value would silently clamp to the minimum
@@ -317,16 +357,31 @@ class PagedTPUEngine:
                                               watermark=max_slots,
                                               stats=lambda: self.stats)
                              if prefix_sharing else None)
-        # jit-entry: paged.decode_chunk static=(steps, filtered) bucketed=(span) warmup=64
+        # jit-entry: paged.decode_chunk static=(steps, filtered, grammared) bucketed=(span, gstates) warmup=64
         self._jit_chunk = tracked_jit(
             "paged.decode_chunk",
             jax.jit(
                 partial(self._decode_chunk, cfg=cfg, mesh=mesh),
-                static_argnames=("steps", "filtered"),
+                static_argnames=("steps", "filtered", "grammared"),
                 donate_argnames=("cache",),
                 **({"out_shardings": (None, cache_out_shardings, None)}
                    if cache_out_shardings is not None else {})),
             registry=reg, warmup=64)
+        # speculative verify: score a whole draft window (pending token +
+        # K drafts) in ONE forward against per-row gathered pool context,
+        # commit its KV at the exact flat positions plain decode would
+        # write, and emit masked greedy targets + the accepted-prefix
+        # length (decoding/ — the engine half of ROADMAP item 2)
+        # jit-entry: paged.verify_chunk static=(grammared) bucketed=(span, ctx_pages, gstates, window) warmup=24
+        self._jit_verify = tracked_jit(
+            "paged.verify_chunk",
+            jax.jit(
+                partial(self._verify_chunk, cfg=cfg),
+                static_argnames=("grammared",),
+                donate_argnames=("cache",),
+                **({"out_shardings": (None, cache_out_shardings)}
+                   if cache_out_shardings is not None else {})),
+            registry=reg, warmup=24)
         # in-place update of the packed state's table columns (the first
         # ``span`` columns) — lets a page-boundary crossing ride the
         # chunk pipeline instead of flushing it (tables are host-known;
@@ -382,8 +437,13 @@ class PagedTPUEngine:
             self._jit_commit = AotJit(self._jit_commit, self._aot_cache, ctx,
                                       donate=(0,))
             self._jit_chunk = AotJit(self._jit_chunk, self._aot_cache, ctx,
-                                     static=("steps", "filtered"),
+                                     static=("steps", "filtered",
+                                             "grammared"),
                                      canary=chunk_canary, donate=(2,))
+            # the verify forward rides the prefill path (gather + plain
+            # XLA attention) — no Mosaic kernel, so no canary needed
+            self._jit_verify = AotJit(self._jit_verify, self._aot_cache, ctx,
+                                      static=("grammared",), donate=(7,))
             self._jit_patch = AotJit(self._jit_patch, self._aot_cache, ctx)
         # runtime mesh discipline (analysis/shardcheck.py): on a mesh,
         # the chunk/commit entries carry the KV pool — assert its actual
@@ -401,9 +461,13 @@ class PagedTPUEngine:
                 "paged.commit", self._jit_commit, registry=reg,
                 in_checks={0: self._cache_sharding},
                 out_checks={0: self._cache_sharding})
+            self._jit_verify = ShardGuard(
+                "paged.verify_chunk", self._jit_verify, registry=reg,
+                in_checks={7: self._cache_sharding},
+                out_checks={1: self._cache_sharding})
         self._jit_trackers = (self._jit_prefill, self._jit_prefill_pctx,
                               self._jit_commit, self._jit_chunk,
-                              self._jit_patch)
+                              self._jit_verify, self._jit_patch)
 
     @staticmethod
     def _pages_for_budget(params, cfg, mesh, page_size: int, kv_dtype: str,
@@ -488,51 +552,134 @@ class PagedTPUEngine:
 
     # -- jitted pieces -----------------------------------------------------
     @staticmethod
-    def _decode_chunk(params, state, cache, sampling,
+    def _decode_chunk(params, state, cache, sampling, gtables=None,
                       *, cfg: ModelConfig, steps: int, filtered: bool = False,
-                      mesh=None):
+                      grammared: bool = False, mesh=None):
         """``steps`` paged decode iterations for the whole slot batch.
 
         ``state`` packs the whole per-chunk loop state into ONE int32
-        array ``[B, span + 5]`` — block tables, seq_lens, the pending
-        input token, the per-request PRNG key (2 bitcast words), and the
-        generated-token position — so a steady-state chunk needs no
-        host→device uploads at all: the previous chunk's returned state
-        feeds the next call as a device-resident array.  Per-upload RPC
-        latency on the tunneled TPU measured ~100 ms/chunk of avoidable
-        host work (PERF.md), which is why this is packed rather than five
-        arrays.  Sampling keys fold the request key with the generated
-        position (``sample_token_rows``), making every request's sample
-        stream schedule-independent.
+        array ``[B, span + 6]`` — block tables, seq_lens, the pending
+        input token, the per-request PRNG key (2 bitcast words), the
+        generated-token position, and the grammar-automaton state — so a
+        steady-state chunk needs no host→device uploads at all: the
+        previous chunk's returned state feeds the next call as a
+        device-resident array.  Per-upload RPC latency on the tunneled
+        TPU measured ~100 ms/chunk of avoidable host work (PERF.md),
+        which is why this is packed rather than six arrays.  Sampling
+        keys fold the request key with the generated position
+        (``sample_token_rows``), making every request's sample stream
+        schedule-independent.
+
+        ``grammared`` (static) compiles the token-constraint mask into
+        the step: ``gtables`` is ``(mask [S, V] bool, next [S, V]
+        int32)`` (decoding/grammar.py; state 0 = unconstrained rows —
+        its all-True row makes the mask a bit-exact no-op for them),
+        each row's state advances through the table on its own sampled
+        token, so a constrained row can never emit an out-of-grammar
+        token mid-chunk.  The default program carries no tables and is
+        byte-identical to the pre-grammar chunk.
         """
-        span = state.shape[1] - 5
+        span = state.shape[1] - 6
         block_tables = state[:, :span]
         seq_lens = state[:, span]
         first_token = state[:, span + 1:span + 2]
         keys = jax.lax.bitcast_convert_type(state[:, span + 2:span + 4],
                                             jnp.uint32)
         gen_pos = state[:, span + 4]
+        gstate0 = state[:, span + 5]
 
         temperature = sampling[:, 0]
 
         def body(carry, _):
-            token, cache, lens, pos = carry
+            token, cache, lens, pos, gstate = carry
             logits, cache = paged_decode_step(params, cfg, token, block_tables,
                                               lens, cache, mesh=mesh)
+            if grammared:   # static: default chunks carry no mask gather
+                gmask, _ = gtables
+                logits = jnp.where(gmask[gstate], logits, -1e30)
             if filtered:    # static: default chunks carry no [B, V] sort
                 logits = filter_logits(logits, sampling[:, 2].astype(jnp.int32),
                                        sampling[:, 1], temperature)
             row_keys = jax.vmap(jax.random.fold_in)(keys, pos)
             nxt = sample_token_rows(logits, temperature, row_keys)
-            return (nxt[:, None], cache, lens + 1, pos + 1), nxt
+            if grammared:
+                _, gnext = gtables
+                gstate = gnext[gstate, nxt]
+            return (nxt[:, None], cache, lens + 1, pos + 1, gstate), nxt
 
-        (last, cache, lens, pos), toks = jax.lax.scan(
-            body, (first_token, cache, seq_lens, gen_pos), None, length=steps)
+        (last, cache, lens, pos, gstate), toks = jax.lax.scan(
+            body, (first_token, cache, seq_lens, gen_pos, gstate0),
+            None, length=steps)
         new_state = jnp.concatenate(
             [block_tables, lens[:, None], last,
-             jax.lax.bitcast_convert_type(keys, jnp.int32), pos[:, None]],
+             jax.lax.bitcast_convert_type(keys, jnp.int32), pos[:, None],
+             gstate[:, None]],
             axis=1)
         return toks.T, cache, new_state
+
+    @staticmethod
+    def _verify_chunk(params, tables, ctx_tables, lens, tokens, ndraft,
+                      gstate, cache, kvbuf, gmask=None, gnext=None,
+                      *, cfg: ModelConfig, grammared: bool = False):
+        """Score one draft window per slot in ONE forward (the
+        speculative verify step — the engine half of ROADMAP item 2).
+
+        ``tokens`` [B, W]: column 0 is the row's pending input token,
+        columns 1..W-1 its drafts (padded with the pending token past
+        ``ndraft[b]`` — padding can never be accepted because the cap
+        rides the accept rule).  ``lens`` [B] is each row's materialised
+        length: the window occupies absolute positions [len, len+W), its
+        context is the row's own pool pages gathered via ``ctx_tables``
+        (the block tables' leading columns), and its KV commits through
+        :func:`~reval_tpu.models.paged.commit_verify` at exactly the
+        flat positions plain decode would write — which is what makes a
+        later plain chunk read bit-compatible state.
+
+        The accept contract: ``targets[b, j]`` is the grammar-masked
+        greedy argmax after consuming window columns 0..j, computed by
+        the SAME ``jnp.argmax`` over the same f32 logits (and the same
+        ``-1e30`` mask constant) the decode chunk uses; draft ``j+1`` is
+        accepted iff it equals ``targets[b, j]`` and every earlier draft
+        was accepted.  Accepted tokens are therefore the tokens plain
+        greedy decode would have emitted — the bit-identity the
+        determinism observatory's spec cells certify.
+
+        Returns ``(out [B, W+2] int32, cache)``: targets, the accepted
+        draft count, and the row's automaton state after consuming the
+        accepted tokens + bonus (one packed array = one host fetch).
+        """
+        b, w = tokens.shape
+        logits, kv = prefill_with_paged_context(
+            params, cfg, tokens, jnp.zeros(b, jnp.int32), ctx_tables,
+            lens, cache, kvbuf, logits_mode="all")
+        cache = commit_verify(cache, kv, tables, lens)
+        if grammared:
+            # automaton states after consuming window columns 0..j:
+            # column 0 (the pending token) is already folded into
+            # ``gstate``; drafts advance it one table lookup at a time
+            def walk(s, tok_col):
+                ns = gnext[s, tok_col]
+                return ns, ns
+
+            _, tail = jax.lax.scan(walk, gstate, tokens.T[1:])
+            s_after = jnp.concatenate([gstate[None], tail], axis=0).T  # [B,W]
+            logits = jnp.where(gmask[s_after], logits, -1e30)
+        else:
+            s_after = jnp.zeros_like(tokens)
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B,W]
+        pos = jnp.arange(1, w, dtype=jnp.int32)[None, :]
+        ok = (tokens[:, 1:] == targets[:, :-1]) & (pos <= ndraft[:, None])
+        accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+        if grammared:
+            sa = jnp.take_along_axis(s_after, accepted[:, None], axis=1)[:, 0]
+            bonus = jnp.take_along_axis(targets, accepted[:, None],
+                                        axis=1)[:, 0]
+            new_gs = gnext[sa, bonus]
+        else:
+            new_gs = gstate
+        out = jnp.concatenate(
+            [targets, accepted[:, None], new_gs[:, None]], axis=1)
+        return out, cache
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -562,7 +709,8 @@ class PagedTPUEngine:
     def generate(self, prompts: list[str], *, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: list[str] | None = None,
                  top_k: int = 0, top_p: float = 1.0,
-                 on_progress=None, return_ids: bool = False):
+                 on_progress=None, return_ids: bool = False,
+                 grammar=None):
         """``on_progress(index, text)``: streaming hook, called at every
         decode-chunk boundary with the prompt's index and its finalised
         text so far (stop/EOS truncation already applied).  The text
@@ -576,10 +724,18 @@ class PagedTPUEngine:
         (``finalize_ids`` semantics — EOS-cut, pre-stop) as a second
         list; the determinism matrix compares these, because ids outside
         the byte range (EOS, vocab padding) decode to nothing and their
-        divergence is invisible in text."""
+        divergence is invisible in text.
+
+        ``grammar``: a decoding/grammar.py shape name (or a per-prompt
+        list of names/None — the fleet's fused multi-task batches mix
+        shapes): each named prompt decodes under its token-constraint
+        automaton (out-of-grammar tokens masked) and, when speculation
+        is enabled, drafts its forced/looked-up continuations for the
+        batched verify step."""
         if not prompts:
             return ([], []) if return_ids else []
         stop = stop or []
+        grammars = self._grammar_list(grammar, len(prompts))
         encoded = [self.encode_clipped(p, max_new_tokens) for p in prompts]
 
         reqs: dict[int, _Request] = {}
@@ -597,12 +753,16 @@ class PagedTPUEngine:
                 # the list hit pages inserted by earlier ones (that is
                 # what fuses multi-template fleet batches without a
                 # whole-batch LCP)
-                seq_id, node = self.submit_request(ids, max_new_tokens)
+                seq_id, node = self.submit_request(ids, max_new_tokens,
+                                                   grammar=grammars[i])
                 reqs[seq_id] = _Request(index=i, ids=ids, max_new=max_new_tokens,
                                         scanner=StopScanner(self.tokenizer, stop),
                                         temp=float(temperature),
                                         top_k=int(top_k), top_p=float(top_p),
-                                        notify=notify, key=keys[i], node=node)
+                                        notify=notify, key=keys[i], node=node,
+                                        grammar=grammars[i],
+                                        gstate=(self.grammar_state(grammars[i])
+                                                if grammars[i] else 0))
 
             with profile_trace():
                 self._drive(reqs)
@@ -625,8 +785,34 @@ class PagedTPUEngine:
             return out, out_ids
         return out
 
-    def submit_request(self, ids: list[int], max_new_tokens: int
-                       ) -> tuple[int, object]:
+    @staticmethod
+    def _grammar_list(grammar, n: int) -> list:
+        """Normalise a ``grammar=`` argument (None | name | per-prompt
+        list) to one entry per prompt."""
+        if grammar is None or isinstance(grammar, str):
+            return [grammar] * n
+        grammars = list(grammar)
+        if len(grammars) != n:
+            raise ValueError(f"grammar list has {len(grammars)} entries "
+                             f"for {n} prompts")
+        return grammars
+
+    def grammar_state(self, name: str) -> int:
+        """Compile (idempotent) a grammar name into this engine's
+        combined constraint tables and return its start state — the id a
+        request's ``gstate`` begins at.  Raises ``ValueError`` for
+        unknown names (the serving layer maps that to a 400)."""
+        return self._grammars.start_state(name)
+
+    def spec_counters(self) -> dict:
+        """Speculative-decoding counter snapshot (accept rate, drafted/
+        accepted/rolled-back tokens, wedges) — the bench ``speculative``
+        block and the fleet trailer render this dict
+        (:meth:`EngineStats.spec_counters`)."""
+        return self.stats.spec_counters()
+
+    def submit_request(self, ids: list[int], max_new_tokens: int,
+                       grammar: str | None = None) -> tuple[int, object]:
         """Hand one tokenised request to the native scheduler, riding the
         persistent prefix cache.
 
@@ -636,11 +822,21 @@ class PagedTPUEngine:
         prefix, prefill any newly inserted pages once, submit the request
         against the node's refcounted pages.  Returns ``(seq_id, node)``;
         the node is pinned until :meth:`release_request`.
+
+        ``grammar`` (optional) validates + compiles the request's
+        constraint automaton up front — an unknown name fails HERE, in
+        the submitting thread, never in the drive loop — and counts the
+        request into ``reval_grammar_requests_total``.  The caller still
+        stamps the compiled start state onto its ``_Request.gstate``
+        (via :meth:`grammar_state` — state ids are engine-local).
         """
         # per-template accounting: crc32 of the first prompt page's
         # token ids (token-space analog of the router's affinity key,
         # not the same hash) — the warm-state snapshot carries the
         # replica's template mix across a restart
+        if grammar:
+            self.grammar_state(grammar)     # ValueError on unknown names
+            self.stats.grammar_requests += 1
         tag = zlib.crc32(np.asarray(ids[:self.page_size],
                                     np.int32).tobytes())
         bump_template_stats(self._template_stats, tag)
@@ -886,6 +1082,7 @@ class PagedTPUEngine:
                     pc.cached_pages if pc is not None else 0,
                     self._pinned_sample,
                     self.stats.prefix_hit_tokens,
+                    self.stats.spec_accepted_tokens,
                     st.pending[1] if st.pending is not None else 0,
                     dt,
                     time.monotonic() - self.heartbeat,
@@ -939,6 +1136,9 @@ class PagedTPUEngine:
                 # append, not reset: after a preemption the kept tokens
                 # were replayed by the resume prefill and stand
                 req.generated.append(firsts[slot])
+                if req.grammar is not None:
+                    req.gstate = self._grammars.walk(req.gstate,
+                                                     [firsts[slot]])
                 if req.t_first is None:
                     req.t_first = t_first
                 st.slot_token[slot] = firsts[slot]
@@ -961,6 +1161,47 @@ class PagedTPUEngine:
                 raise RuntimeError(
                     "paged scheduler deadlock: nothing running or admissible")
             return
+
+        # ---- speculative verify rounds (decoding/) -------------------
+        # When every active row is greedy and some row can draft, serve
+        # the tick with ONE batched verify forward instead of a decode
+        # chunk.  Any in-flight chunk flushes first: drafting reads the
+        # rows' ground-truth tails, and the verify writes into pages the
+        # chunk may still target.  A round that finds no drafts falls
+        # through to the plain chunk path below (pending already None).
+        #
+        # The flush is only paid when it is likely to buy something: a
+        # chunk-in-flight tick attempts speculation when a row looks
+        # draft-promising (forced automaton state / indexed n-gram hit —
+        # a slightly stale read, it gates scheduling only) or the dry
+        # backoff expired.  Without the gate, a chronically draft-less
+        # constrained workload (e.g. `line` bodies with n-gram lookup
+        # off) would flush the one-deep pipeline EVERY tick and
+        # reintroduce the per-chunk host serialization it exists to
+        # hide; without the backoff retry, repetition arriving inside
+        # the in-flight chunk (invisible to the probe) could starve
+        # speculation forever.
+        if self._spec_candidate(reqs, st):
+            # the tail regime (≤ one steady chunk of budget left) always
+            # attempts: the budget flush gate below is about to quiesce
+            # the pipeline for these rows anyway, and REval's tiny
+            # answers live entirely in this regime
+            attempt = (st.pending is None or st.spec_backoff <= 0
+                       or self._chunk_budget(reqs, st) <= CHUNK
+                       or any(self._spec_eligible(reqs[s])
+                              and self._spec_promising(reqs[s])
+                              for s in st.active.values()))
+            if not attempt:
+                st.spec_backoff -= 1
+            else:
+                if st.pending is not None:
+                    self._process_pending(reqs, st)
+                if not st.active:
+                    return              # the flush retired the last runner
+                if self._spec_round(reqs, st):
+                    st.spec_backoff = 0
+                    return
+                st.spec_backoff = self.SPEC_RETRY_BACKOFF
 
         # ---- one-deep chunk pipeline flush gates ---------------------
         # A steady tick dispatches the NEXT chunk before fetching the
@@ -1038,13 +1279,16 @@ class PagedTPUEngine:
             tables = np.zeros((self.max_slots, st.span), np.int32)
             keyarr = np.zeros((self.max_slots, 2), np.uint32)
             posarr = np.zeros(self.max_slots, np.int32)
+            gstates = np.zeros(self.max_slots, np.int32)
             for slot, seq_id in st.active.items():
                 tables[slot] = self.rt.block_table(seq_id)[:st.span]
                 keyarr[slot] = reqs[seq_id].key
                 posarr[slot] = len(reqs[seq_id].generated)
+                gstates[slot] = reqs[seq_id].gstate
             packed = np.concatenate(
                 [tables, lens[:, None], st.slot_token.astype(np.int32),
-                 keyarr.view(np.int32), posarr[:, None]], axis=1)
+                 keyarr.view(np.int32), posarr[:, None],
+                 gstates[:, None]], axis=1)
             st.dev_state = self._dev(jnp.asarray(packed))
             samp = np.stack([st.slot_temp, st.slot_topp,
                              st.slot_topk.astype(np.float32)], axis=1)
@@ -1059,9 +1303,17 @@ class PagedTPUEngine:
             filtered = bool(((st.slot_topk[rows] > 0)
                              | (st.slot_topp[rows] < 1.0))
                             [st.slot_temp[rows] > 0].any())
+            # grammar masking compiles in only when a constrained row is
+            # live (stable across steady-state chunks: the active set
+            # only changes through st.dirty); the default program stays
+            # byte-identical to the pre-grammar chunk
+            grammared = any(reqs[s].grammar is not None
+                            for s in st.active.values())
+            gtables = self._grammar_tables() if grammared else None
             toks, self.cache, st.dev_state = self._jit_chunk(
                 self.params, st.dev_state, self.cache, st.dev_samp,
-                steps=steps, filtered=filtered)
+                gtables, steps=steps, filtered=filtered,
+                grammared=grammared)
         chunk = (toks, steps, tuple(st.active.items()), t0)
         prev, st.pending = st.pending, None
         if self.pipeline:
@@ -1073,6 +1325,209 @@ class PagedTPUEngine:
                 self._process_chunk(reqs, st, prev)
         else:
             self._process_chunk(reqs, st, chunk)
+
+    # -- speculative verify path (reval_tpu/decoding/; ROADMAP item 2) -----
+    def _grammar_tables(self):
+        """Device upload of the combined constraint tables, rebuilt when
+        the GrammarSet grew (state count pow2-padded so the compiled
+        shape set stays bounded; pad rows behave FREE, unreachable)."""
+        gs = self._grammars
+        if self._gtab is None or self._gtab_version != gs.version:
+            s = pow2_bucket(gs.n_states, 8)
+            mask = np.ones((s, gs.vocab_size), np.bool_)
+            nxt = np.zeros((s, gs.vocab_size), np.int32)
+            mask[:gs.n_states] = gs.mask
+            nxt[:gs.n_states] = gs.next
+            self._gtab = (self._dev(jnp.asarray(mask)),
+                          self._dev(jnp.asarray(nxt)))
+            self._gtab_version = gs.version
+        return self._gtab
+
+    def _spec_eligible(self, req: _Request) -> bool:
+        return (not req.spec_wedged and req.temp == 0
+                and (req.grammar is not None or self.spec_eager))
+
+    #: plain-chunk ticks a dry speculative attempt sits out before the
+    #: next one may flush the pipeline again (see the spec gate in
+    #: ``_tick``): a chronically draft-less workload keeps ~2/3 of its
+    #: chunks pipelined instead of flushing every tick, while a workload
+    #: that BECOMES draftable re-engages within a couple of chunks
+    SPEC_RETRY_BACKOFF = 2
+
+    def _spec_candidate(self, reqs: dict[int, _Request],
+                        st: _DriveState) -> bool:
+        """Cheap per-tick eligibility: speculation on and every active
+        row greedy (the accept contract is a greedy contract — sampled
+        rows ride plain chunks), with at least one row that may draft.
+        Whether a flush is worth attempting rides the gate in ``_tick``
+        (free with no chunk in flight; probe- or backoff-gated with
+        one)."""
+        if not self.spec_enabled or not st.active:
+            return False
+        rows = [reqs[s] for s in st.active.values()]
+        if any(r.temp > 0 for r in rows):
+            return False
+        return any(self._spec_eligible(r) for r in rows)
+
+    def _ngram_index(self, req: _Request):  # hot-path
+        """The row's prompt-lookup index, synced to its PROCESSED tokens
+        (incremental — each token is indexed once; an in-flight chunk's
+        tokens land at the next sync).  None when n-gram drafting is
+        off."""
+        if not self.spec_ngram:
+            return None
+        idx = req.ngram
+        if idx is None:
+            idx = req.ngram = NgramIndex(self.spec_ngram)
+        stream = req.prefill_ids
+        if len(idx.toks) < len(stream):
+            idx.extend(stream[len(idx.toks):])
+        return idx
+
+    def _spec_promising(self, req: _Request) -> bool:  # hot-path
+        """Could this row plausibly draft?  Reads state one in-flight
+        chunk stale at worst (see :meth:`_spec_candidate`)."""
+        if (req.grammar is not None and req.gstate != 0
+                and int(self._grammars.forced[req.gstate]) >= 0):
+            return True
+        idx = self._ngram_index(req)
+        return idx is not None and idx.match(idx.toks) is not None
+
+    def _draft_for(self, req: _Request, k: int) -> list[int]:  # hot-path
+        """Up to ``k`` drafts for one row (grammar forcing + n-gram
+        prompt lookup).  ANY drafter fault wedges only this request —
+        spec.wedge degrade: it rides plain decode from here on, the
+        batch keeps speculating."""
+        if k <= 0 or not self._spec_eligible(req):
+            return []
+        try:
+            gs = self._grammars if req.grammar is not None else None
+            drafts, forced = propose_drafts(self._ngram_index(req), k, gs,
+                                            req.gstate)
+            self.stats.grammar_forced_tokens += forced
+            return drafts
+        except Exception as exc:   # noqa: BLE001 — any drafter fault
+            req.spec_wedged = True
+            self.stats.spec_wedges += 1
+            # lint: allow(hotpath) — the wedge event is the rare
+            # once-per-request degrade path, never the steady state
+            log_event("spec.wedge", level="warning", error=repr(exc),
+                      grammar=req.grammar)
+            return []
+
+    def _spec_round(self, reqs: dict[int, _Request],  # hot-path
+                    st: _DriveState) -> bool:
+        """One speculative verify round over the active slots: draft,
+        reserve pages for the whole window, dispatch ONE batched verify
+        forward, then host-side accept/rollback with exact page
+        bookkeeping.  Returns False (caller falls back to a plain
+        chunk) when no row produced drafts or the window cannot fit the
+        smallest remaining budget; True = this tick is served.
+
+        Every greedy row advances ≥1 token per round (the bonus target
+        IS the plain greedy next token), so draft-less rows ride along
+        rather than stall.  Rejected drafts roll the runtime length
+        back (``PagedRuntime.rollback``) so their reserved pages free —
+        the same exact-bookkeeping contract as the PR-10 rewarm
+        rollback; their stale KV sits past the accepted length, masked
+        by attention and overwritten in place by the next write there.
+        """
+        budget = self._chunk_budget(reqs, st)      # st.pending is None here
+        # pow2-floored window (the _next_chunk_steps idiom): an unpadded
+        # min(K+1, budget) would compile a fresh verify variant for every
+        # shrinking budget tail (w = 9, 8, 7, ... near max_new) — flooring
+        # keeps the compiled window set at {2, 4, 8, ...} and never
+        # reserves past the smallest remaining budget
+        w = _floor_pow2(min(self.spec_k + 1, budget))
+        if w < 2:
+            return False
+        drafts = {slot: self._draft_for(reqs[seq_id], w - 1)
+                  for slot, seq_id in st.active.items()}
+        if not any(drafts.values()):
+            return False
+        before = dict(st.active)
+        self._reserve_chunk(st.active, reqs, w)
+        if st.active != before:
+            st.dirty = True
+        if not st.active:
+            return True                            # everyone got preempted
+        lens, span = self._lens_and_span(reqs, st, w)
+        b = self.max_slots
+        tokens = np.zeros((b, w), np.int32)
+        ndraft = np.zeros(b, np.int32)
+        gstates = np.zeros(b, np.int32)
+        tables = np.zeros((b, span), np.int32)
+        ctx_pages = 1
+        grammared = False
+        for slot, seq_id in st.active.items():
+            req = reqs[seq_id]
+            d = drafts.get(slot) or []
+            pending = int(st.slot_token[slot, 0])
+            tokens[slot, 0] = pending
+            # pad past the drafts with the pending token: padding can
+            # never be accepted (the accept rule caps at ndraft)
+            tokens[slot, 1:] = (d + [pending] * (w - 1 - len(d)))[: w - 1]
+            ndraft[slot] = len(d)
+            gstates[slot] = req.gstate
+            grammared |= req.grammar is not None
+            tables[slot] = self.rt.block_table(seq_id)[:span]
+            ctx_pages = max(ctx_pages,
+                            -(-int(lens[slot]) // self.page_size))
+        ctx_pages = min(pow2_bucket(ctx_pages), self.max_pages_per_seq)
+        kvbuf = init_kv_cache(self.cfg, b, w,
+                              dtype=self.params["embed"].dtype)
+        gmask, gnext = self._grammar_tables() if grammared else (None, None)
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("reval.paged_verify_chunk"):
+            out_dev, self.cache = self._jit_verify(
+                self.params, self._dev(jnp.asarray(tables)),
+                self._dev(jnp.asarray(tables[:, :ctx_pages])),
+                self._dev(jnp.asarray(lens)),
+                self._dev(jnp.asarray(tokens)),
+                self._dev(jnp.asarray(ndraft)),
+                self._dev(jnp.asarray(gstates)),
+                self.cache, kvbuf, gmask, gnext, grammared=grammared)
+        with deliberate_fetch():
+            # host-sync: the verify round's ONE deliberate fetch — the
+            # accept verdicts gate every host decision that follows
+            out = np.asarray(out_dev)
+        self.heartbeat = time.monotonic()
+        now = time.perf_counter()
+        wall = now - max(t0, st.t_mark)
+        st.t_mark = now
+        self.stats.decode_seconds += wall
+        self.stats.registry.histogram(obs_metrics.DECODE_CHUNK).observe(wall)
+        self.stats.decode_steps += 1               # ONE weight pass
+        self.stats.spec_rounds += 1
+        hist = self.stats.registry.histogram(
+            obs_metrics.SPEC_ACCEPTED_PER_ROUND)
+        for slot, seq_id in list(st.active.items()):
+            req = reqs[seq_id]
+            nd = int(ndraft[slot])
+            acc = min(int(out[slot, w]), nd)
+            take = min(acc + 1, req.max_new - len(req.generated))
+            new_toks = [int(t) for t in out[slot, :take]]
+            used = max(0, take - 1)                # drafts that landed
+            self.stats.spec_drafted_tokens += nd
+            self.stats.spec_accepted_tokens += min(acc, used)
+            self.stats.spec_rolled_back_tokens += nd - min(acc, used)
+            self.stats.generated_tokens += take
+            hist.observe(float(acc))
+            req.generated.extend(new_toks)
+            st.slot_token[slot] = new_toks[-1]
+            if req.grammar is not None:
+                req.gstate = self._grammars.walk(req.gstate, new_toks)
+            if take < w:
+                # exact page bookkeeping: return the rejected tail's
+                # reservation (pages past the covering count free)
+                self.rt.rollback(seq_id, int(lens[slot]) + take)
+            if self._finished(req, new_toks):
+                self._retire(req, seq_id, slot, st.active)
+            if req.notify is not None:
+                req.notify(req)
+        st.dirty = True          # lens moved per-row: repack before any
+        #                          plain chunk rides the packed state
+        return True
 
     def _next_chunk_steps(self, reqs: dict[int, _Request],
                           st: _DriveState) -> int:
@@ -1194,6 +1649,10 @@ class PagedTPUEngine:
             req = reqs[seq_id]
             chunk_ids = [int(t) for t in toks_host[slot]]
             req.generated.extend(chunk_ids)
+            if req.grammar is not None:
+                # host mirror of the in-chunk table walk: the drafter
+                # and the next repack read req.gstate
+                req.gstate = self._grammars.walk(req.gstate, chunk_ids)
             st.slot_token[slot] = chunk_ids[-1]
             if self._finished(req, chunk_ids):
                 self._retire(req, seq_id, slot, st.active)
@@ -1357,8 +1816,10 @@ class PagedTPUEngine:
         topps = np.ones(rows, np.float32)
         keys = np.zeros((rows, 2), np.uint32)
         poss = np.zeros(rows, np.int32)
+        gstates = np.zeros(rows, np.int32)          # dummy rows: FREE
         for row, (seq_id, _) in enumerate(group):
             req = reqs[seq_id]
+            gstates[row] = req.gstate
             npre = self.rt.prefix_pages(seq_id)
             skip = npre * self.page_size
             ids = req.prefill_ids[skip:]            # own (suffix) tokens
@@ -1396,6 +1857,14 @@ class PagedTPUEngine:
         row_keys = jax.vmap(jax.random.fold_in)(
             self._dev(jnp.asarray(keys)), self._dev(jnp.asarray(poss)))
         first_logits = logits[:, 0, :]
+        if (gstates != 0).any():
+            # the FIRST sampled token rides prefill logits, not the
+            # chunk: constrained rows must be masked here too or the
+            # answer's opening token could fall outside the grammar
+            # (same -1e30 constant as the chunk/verify masks)
+            gmask, _ = self._grammar_tables()
+            first_logits = jnp.where(gmask[self._dev(jnp.asarray(gstates))],
+                                     first_logits, -1e30)
         if (topks > 0).any() or (topps < 1.0).any():
             first_logits = filter_logits(first_logits,
                                          self._dev(jnp.asarray(topks)),

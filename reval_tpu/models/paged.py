@@ -57,6 +57,7 @@ __all__ = [
     "init_paged_cache",
     "paged_decode_step",
     "commit_prefill",
+    "commit_verify",
     "gather_prefix_context",
     "prefill_with_paged_context",
 ]
@@ -319,6 +320,47 @@ def prefill_with_paged_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
     return prefill_with_batched_context(
         params, cfg, tokens, pad_len, ctx_k, ctx_v, ctx_len, cache,
         logits_mode=logits_mode)
+
+
+def commit_verify(cache: PagedKVCache, kv: "KVCache", tables: jnp.ndarray,
+                  start: jnp.ndarray) -> PagedKVCache:
+    """Scatter a speculative verify window's KV into pages at absolute
+    per-row positions — the mid-page sibling of :func:`commit_prefill`.
+
+    kv: contiguous [L, B, W, H_kv, D] window KV (W = draft window, a
+    handful of tokens — NOT page-aligned); tables: [B, span] block
+    tables; start: [B] the absolute sequence position of window column
+    0 (the row's materialised length).  Column ``j`` lands at
+    ``table[b, (start+j)//P]*P + (start+j)%P`` — the same flat position
+    the plain decode scatter would have written token ``start+j`` to,
+    which is what makes speculative KV bit-compatible with plain
+    decode's.  Rejected draft columns land too: they sit past the
+    row's accepted length, so attention masks them and the next window
+    (or plain decode step) overwrites them in place.  Idle rows point
+    their tables at the trash page, exactly like the decode path.
+    """
+    l, b, w, h_kv, d = kv.k.shape
+    p = cache.page_size
+    pos = start[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]   # [B, W]
+    dest = jnp.take_along_axis(tables, pos // p, axis=1) * p + pos % p
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    for i in range(l):
+        if cache.quantized:
+            kq, ks = _quantize_kv(kv.k[i])
+            vq, vs = _quantize_kv(kv.v[i])
+            new_k.append(cache.k[i].at[dest].set(kq))
+            new_v.append(cache.v[i].at[dest].set(vq))
+            new_ks.append(cache.k_scale[i].at[dest].set(ks))
+            new_vs.append(cache.v_scale[i].at[dest].set(vs))
+        else:
+            new_k.append(cache.k[i].at[dest].set(
+                kv.k[i].astype(cache.dtype)))
+            new_v.append(cache.v[i].at[dest].set(
+                kv.v[i].astype(cache.dtype)))
+    return PagedKVCache(
+        k=tuple(new_k), v=tuple(new_v), page_size=p,
+        k_scale=tuple(new_ks) if cache.quantized else None,
+        v_scale=tuple(new_vs) if cache.quantized else None)
 
 
 def commit_prefill(cache: PagedKVCache, kv: "KVCache", pad_len: jnp.ndarray,

@@ -104,13 +104,15 @@ DEFAULT_REFERENCE = "paged-xla-fp32-b2"
 #: outputs fails this slice with a named cell + first divergent token.
 PARITY_SLICE = ("paged-xla-fp32-b2", "static-fp32-b2",
                 "paged-pallas_seq-fp32-b2", "paged-pallas-fp32-b2",
-                "paged-xla-fp32-dp2-b2", "paged-xla-fp32-b4")
+                "paged-xla-fp32-dp2-b2", "paged-xla-fp32-b4",
+                "spec-paged-xla-fp32-b2", "spec-paged-xla-fp32-b4")
 
 #: the bench garnish slice: cheap cross-backend sanity (reference +
-#: static engine + seq kernel) — the fingerprint is the cross-COMMIT
-#: drift detector, so it must stay affordable every round
+#: static engine + seq kernel + the speculative greedy-accept
+#: contract) — the fingerprint is the cross-COMMIT drift detector, so
+#: it must stay affordable every round
 BENCH_SLICE = ("paged-xla-fp32-b2", "static-fp32-b2",
-               "paged-pallas_seq-fp32-b2")
+               "paged-pallas_seq-fp32-b2", "spec-paged-xla-fp32-b2")
 
 _DTYPE_ARG = {"fp32": "float32", "bf16": "bfloat16", "int8": "int8"}
 
@@ -138,12 +140,18 @@ class CellSpec:
     dtype: str = "fp32"         # fp32 | bf16 | int8 (weights)
     kv_dtype: str = ""          # "" | int8 (paged KV pool)
     batch: int = 2              # max_slots / static batch width
+    #: speculative decoding forced on (self-drafting + batched verify):
+    #: the greedy-accept contract cells — bit-identical to plain decode
+    #: by contract, with the measured accept rate recorded as
+    #: drift-allowed telemetry on the cell row
+    spec: bool = False
     expect: str = "bit_identical"
 
     def axes(self) -> dict:
         return {"engine": self.engine, "kernel": self.kernel,
                 "dp": self.dp, "dtype": self.dtype,
-                "kv_dtype": self.kv_dtype, "batch": self.batch}
+                "kv_dtype": self.kv_dtype, "batch": self.batch,
+                "spec": self.spec}
 
 
 def default_cells() -> list[CellSpec]:
@@ -162,6 +170,12 @@ def default_cells() -> list[CellSpec]:
         CellSpec("paged-xla-fp32-dp2-b2", "dp_paged", "xla", dp=2),
         # batch-width axis: wider slot count must not change greedy
         CellSpec("paged-xla-fp32-b4", "paged", "xla", batch=4),
+        # speculative axis: the greedy-accept CONTRACT — self-drafted +
+        # batch-verified decode must emit exactly the reference stream
+        # (accept rate rides the row as drift-allowed telemetry)
+        CellSpec("spec-paged-xla-fp32-b2", "paged", "xla", spec=True),
+        CellSpec("spec-paged-xla-fp32-b4", "paged", "xla", batch=4,
+                 spec=True),
         # dtype axis: numeric drift is expected; its SIZE is telemetry
         CellSpec("paged-xla-bf16-b2", "paged", "xla", dtype="bf16",
                  expect="drift_allowed"),
@@ -291,7 +305,11 @@ class _MatrixRunner:
 
         return PagedTPUEngine(params, self.cfg, self.tokenizer,
                               max_slots=spec.batch, page_size=128,
-                              max_seq_len=256, kv_dtype=spec.kv_dtype)
+                              max_seq_len=256, kv_dtype=spec.kv_dtype,
+                              # spec cells FORCE speculation on (n-gram
+                              # drafting engages without a grammar);
+                              # None keeps the engine's default gating
+                              speculative=True if spec.spec else None)
 
     def _logits_topk(self, spec: CellSpec, k: int) -> list[dict]:
         """Top-k ids + quantized logit values at the last prompt
@@ -330,6 +348,7 @@ class _MatrixRunner:
         carrying the error — a broken backend is a report finding, not
         a crash."""
         try:
+            spec_row = None
             with _cell_env(spec):
                 eng = self._build(spec)
                 try:
@@ -340,13 +359,21 @@ class _MatrixRunner:
                     answers, tokens = eng.generate(
                         list(self.probes), max_new_tokens=self.max_new,
                         temperature=0.0, return_ids=True)
+                    if spec.spec:
+                        # drift-ALLOWED telemetry riding a bit-identical
+                        # contract cell: the accept rate may move round
+                        # to round; the token stream may not
+                        spec_row = eng.spec_counters()
                 finally:
                     if hasattr(eng, "close"):
                         eng.close()
-            return {"axes": spec.axes(), "expect": spec.expect,
-                    "status": "run", "answers": answers, "tokens": tokens,
-                    "fingerprint": _fingerprint(tokens),
-                    "logits_topk": self._logits_topk(spec, topk)}
+            row = {"axes": spec.axes(), "expect": spec.expect,
+                   "status": "run", "answers": answers, "tokens": tokens,
+                   "fingerprint": _fingerprint(tokens),
+                   "logits_topk": self._logits_topk(spec, topk)}
+            if spec_row is not None:
+                row["spec_counters"] = spec_row
+            return row
         except Exception as e:  # noqa: BLE001 — per-cell isolation is
             # the contract: discovery is static, load failures land here
             return {"axes": spec.axes(), "expect": spec.expect,
@@ -565,17 +592,25 @@ def bench_block(select=BENCH_SLICE) -> dict:
     --determinism`` diffs over BENCH history) plus the slice's
     divergence counts."""
     m = run_matrix(select=list(select))
-    return {"schema": m["schema"],
-            "reference": m["reference"],
-            "fingerprint": reference_fingerprint(m),
-            "probes_digest": m["probes"]["digest"],
-            "cells_run": m["summary"]["cells_run"],
-            "cells_diverged": m["summary"]["cells_diverged"],
-            "gate_failures": m["summary"]["gate_failures"],
-            # a leftover REVAL_TPU_DETERMINISM_PERTURB must be traceable
-            # in BENCH history, or its fingerprint change reads as a
-            # phantom cross-commit numerics drift
-            "perturb": m["perturb"]}
+    block = {"schema": m["schema"],
+             "reference": m["reference"],
+             "fingerprint": reference_fingerprint(m),
+             "probes_digest": m["probes"]["digest"],
+             "cells_run": m["summary"]["cells_run"],
+             "cells_diverged": m["summary"]["cells_diverged"],
+             "gate_failures": m["summary"]["gate_failures"],
+             # a leftover REVAL_TPU_DETERMINISM_PERTURB must be traceable
+             # in BENCH history, or its fingerprint change reads as a
+             # phantom cross-commit numerics drift
+             "perturb": m["perturb"]}
+    for name, row in m["cells"].items():
+        if row.get("spec_counters"):
+            # accept-rate telemetry riding the certified contract cell —
+            # obs_report --speculative reads it across rounds
+            block.setdefault("spec_cells", {})[name] = {
+                "accept_rate": row["spec_counters"]["accept_rate"],
+                "rounds": row["spec_counters"]["rounds"]}
+    return block
 
 
 def render_table(matrix: dict) -> str:
@@ -592,9 +627,9 @@ def render_table(matrix: dict) -> str:
         f"{matrix['probes']['max_new_tokens']} new tokens · schema "
         f"`{matrix['schema']}`",
         "",
-        "| cell | engine | kernel | dp | dtype | kv | batch | expect | "
-        "verdict | first divergence | logit drift |",
-        "|---|---|---|---|---|---|---|---|---|---|---|",
+        "| cell | engine | kernel | dp | dtype | kv | batch | spec | "
+        "expect | verdict | first divergence | logit drift |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for name, row in sorted(matrix["cells"].items(),
                             key=lambda kv: (kv[1]["status"] != "ref",
@@ -612,10 +647,14 @@ def render_table(matrix: dict) -> str:
             first = (f"probe {fd['probe']} token {fd['token']}"
                      if fd else "—")
             drift = f"{row['diff']['logit_drift']:g}"
+        sc = row.get("spec_counters")
+        spec_col = (f"on ({sc['accept_rate']:.0%} acc)" if sc
+                    else ("on" if ax.get("spec") else "—"))
         lines.append(
             f"| `{name}` | {ax['engine']} | {ax['kernel']} | {ax['dp']} "
             f"| {ax['dtype']} | {ax['kv_dtype'] or '—'} | {ax['batch']} "
-            f"| {row['expect']} | {verdict} | {first} | {drift} |")
+            f"| {spec_col} | {row['expect']} | {verdict} | {first} "
+            f"| {drift} |")
     s = matrix["summary"]
     lines += ["",
               f"{s['cells_run']} run · {s['cells_agree']} agree · "

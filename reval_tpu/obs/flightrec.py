@@ -66,6 +66,7 @@ FIELDS = (
     "cached_pages",      # pages held by the radix prefix cache
     "pinned_pages",      # cache pages pinned by riders (decimated sample)
     "prefix_hit_tokens",  # cumulative cache-hit tokens (delta = per-step)
+    "spec_accepted",     # cumulative accepted draft tokens (speculative)
     "chunk_steps",       # decode steps of the in-flight/last chunk
     "step_ms",           # this drive tick's wall time
     "hb_age_ms",         # watchdog heartbeat age when the tick ended
@@ -89,8 +90,8 @@ class FlightRecorder:
 
     def record(self, running: int, queued: int, free_pages: int,  # hot-path
                cached_pages: int, pinned_pages: int, prefix_hit_tokens: int,
-               chunk_steps: int, step_s: float, hb_age: float,
-               seq_ids: tuple) -> None:
+               spec_accepted: int, chunk_steps: int, step_s: float,
+               hb_age: float, seq_ids: tuple) -> None:
         """One drive tick's state.  Single tuple store; no locking (one
         writer — the engine's driver thread)."""
         if not self.enabled:
@@ -98,7 +99,7 @@ class FlightRecorder:
         n = self.total
         self._buf[n % self.capacity] = (
             n, time.time(), running, queued, free_pages, cached_pages,
-            pinned_pages, prefix_hit_tokens, chunk_steps,
+            pinned_pages, prefix_hit_tokens, spec_accepted, chunk_steps,
             step_s * 1e3, hb_age * 1e3, seq_ids)
         self.total = n + 1
 

@@ -81,6 +81,8 @@ EVENTS: dict[str, str] = {
                        "jax.export)",
     "aot.gc": "the AOT cache evicted LRU entries past its size bound",
     # serving session (serving/session.py)
+    "spec.wedge": "a request's speculative drafter faulted; the row "
+                  "degrades to plain decode for the rest of the request",
     "session.watchdog_trip": "no engine progress past watchdog_s; "
                              "pending submissions failed typed",
     "session.driver_error": "the driver tick raised; in-flight submissions "

@@ -54,6 +54,9 @@ __all__ = [
     "AOT_SAVED_SECONDS", "AOT_ENTRIES", "AOT_BYTES",
     "RESTART_TO_READY", "RESTART_WARM_PREFIXES",
     "RESTART_DEATHS", "RESTART_RESPAWNS",
+    "SPEC_ROUNDS", "SPEC_DRAFTED", "SPEC_ACCEPTED", "SPEC_ROLLED_BACK",
+    "SPEC_WEDGES", "SPEC_ACCEPTED_PER_ROUND", "SPEC_BUCKETS",
+    "GRAMMAR_REQUESTS", "GRAMMAR_FORCED",
 ]
 
 # Log-spaced seconds buckets spanning sub-ms host paths (mock engine,
@@ -66,6 +69,11 @@ LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 # Engine-step / chunk timings sit in the 0.1 ms – 10 s band.
 STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+# Draft tokens accepted per speculative verify round (0 = every draft
+# rejected, the dispatch still yielded its bonus token).  Upper bounds
+# inclusive; REVAL_TPU_SPEC_K caps rounds at the high buckets.
+SPEC_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
 
 # Logit-drift magnitudes (obs/determinism.py, the weight-dtype
 # observable): same-dtype cells read exactly 0, bf16 weights sit near
@@ -109,6 +117,14 @@ RESTART_TO_READY = "reval_restart_to_ready_seconds"
 RESTART_WARM_PREFIXES = "reval_restart_warm_prefixes_total"
 RESTART_DEATHS = "reval_restart_deaths_total"
 RESTART_RESPAWNS = "reval_restart_respawns_total"
+SPEC_ROUNDS = "reval_spec_verify_rounds_total"
+SPEC_DRAFTED = "reval_spec_drafted_tokens_total"
+SPEC_ACCEPTED = "reval_spec_accepted_tokens_total"
+SPEC_ROLLED_BACK = "reval_spec_rolled_back_tokens_total"
+SPEC_WEDGES = "reval_spec_wedges_total"
+SPEC_ACCEPTED_PER_ROUND = "reval_spec_accepted_per_round"
+GRAMMAR_REQUESTS = "reval_grammar_requests_total"
+GRAMMAR_FORCED = "reval_grammar_forced_tokens_total"
 DET_CELLS = "reval_determinism_cells_total"
 DET_AGREE = "reval_determinism_cells_agree_total"
 DET_DIVERGED = "reval_determinism_cells_diverged_total"
@@ -286,6 +302,39 @@ METRICS: dict[str, dict] = {
                                "process registry: rides its postmortem "
                                "bundles and logs, not the child's "
                                "/metrics)"},
+    # speculative + constrained decoding (reval_tpu/decoding/ + the
+    # paged engine's batched verify path)
+    SPEC_ROUNDS: {"type": "counter",
+                  "help": "Batched speculative verify dispatches (one "
+                          "forward scoring a whole draft window)"},
+    SPEC_DRAFTED: {"type": "counter",
+                   "help": "Draft tokens proposed to verify windows "
+                           "(grammar-forced + n-gram prompt lookup)"},
+    SPEC_ACCEPTED: {"type": "counter",
+                    "help": "Draft tokens accepted by the verify step "
+                            "(equal to its masked greedy argmax; bonus "
+                            "tokens excluded)"},
+    SPEC_ROLLED_BACK: {"type": "counter",
+                       "help": "Rejected draft tokens rolled back "
+                               "(their reserved KV pages returned via "
+                               "the runtime rollback)"},
+    SPEC_WEDGES: {"type": "counter",
+                  "help": "Requests whose drafter faulted and degraded "
+                          "to plain decode for the rest of the request "
+                          "(each also logs spec.wedge)"},
+    SPEC_ACCEPTED_PER_ROUND: {"type": "histogram", "buckets": SPEC_BUCKETS,
+                              "help": "Draft tokens accepted per verify "
+                                      "round (the accept-rate "
+                                      "distribution)"},
+    GRAMMAR_REQUESTS: {"type": "counter",
+                       "help": "Requests submitted with a grammar= "
+                               "constraint (token-level logit masking "
+                               "active)"},
+    GRAMMAR_FORCED: {"type": "counter",
+                     "help": "Draft tokens proposed by grammar forcing "
+                             "(single-legal states, or the canonical "
+                             "token along a state's deterministic "
+                             "character chain)"},
     # determinism observatory (obs/determinism.py) — one matrix run
     # increments the counters once per cell; the snapshot rides the
     # determinism-<ts>.json artifact and merges into any registry

@@ -78,6 +78,7 @@ def load_native() -> ctypes.CDLL:
         "reval_rt_seq_len": ([ptr, i64], i32),
         "reval_rt_slot_of": ([ptr, i64], i32),
         "reval_rt_advance": ([ptr, i64, i32], i32),
+        "reval_rt_rollback": ([ptr, i64, i32], i32),
         "reval_rt_fork": ([ptr, i64, p32], i64),
         "reval_rt_preempt": ([ptr, i64, i32], i32),
         "reval_rt_preempt_last": ([ptr], i64),
@@ -202,6 +203,16 @@ class PagedRuntime:
         """Extend by ``n`` tokens; None signals OOM (caller preempts)."""
         res = self._lib.reval_rt_advance(self._h, seq_id, n)
         return None if res == -1 else res
+
+    def rollback(self, seq_id: int, new_len: int) -> None:
+        """Shrink a running sequence to ``new_len`` materialised tokens,
+        freeing owned tail pages the shrink uncovers — the speculative
+        verify's reject path (``advance`` reserved the whole draft
+        window up front; rejected drafts must not stay accounted)."""
+        if self._lib.reval_rt_rollback(self._h, seq_id, new_len) != 0:
+            raise ValueError(
+                f"cannot roll seq {seq_id} back to len {new_len}: not "
+                f"running, or length outside [prompt_len, len]")
 
     def fork(self, seq_id: int) -> tuple[int, int]:
         """Prefix-sharing fork → (child_id, fresh_tail_page).  The caller

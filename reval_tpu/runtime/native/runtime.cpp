@@ -292,6 +292,31 @@ int32_t reval_rt_advance(void* h, int64_t seq_id, int32_t n) {
   return target;
 }
 
+// Shrink a RUNNING sequence's materialised length to new_len, freeing
+// owned tail pages past the covering count — the speculative-decoding
+// reject path: reval_rt_advance reserved pages for the whole draft
+// window before the verify dispatch, and the rejected tail must not
+// stay accounted to the sequence (the drift would inflate its length
+// every round until it spuriously hits max_pages_per_seq).  Never
+// frees shared prefix pages and never shrinks below prompt_len.
+// Returns 0, or -1 (not running, or new_len outside [prompt_len, len]).
+int32_t reval_rt_rollback(void* h, int64_t seq_id, int32_t new_len) {
+  auto* rt = as_rt(h);
+  auto it = rt->seqs.find(seq_id);
+  if (it == rt->seqs.end() || it->second.state != SeqState::kRunning)
+    return -1;
+  Seq& seq = it->second;
+  if (new_len < seq.prompt_len || new_len > seq.len) return -1;
+  int32_t keep = std::max(rt->pages_needed(new_len), seq.prefix_pages);
+  keep = std::max(keep, 1);  // a live sequence always keeps one page
+  while (static_cast<int32_t>(seq.pages.size()) > keep) {
+    rt->drop_page(seq.pages.back());
+    seq.pages.pop_back();
+  }
+  seq.len = new_len;
+  return 0;
+}
+
 // Fork for prefix sharing: the child shares every *full* page of the
 // parent by refcount and gets a fresh page for the partial tail (the
 // engine must copy the tail page's contents device-side).  The child is

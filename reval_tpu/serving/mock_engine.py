@@ -178,7 +178,27 @@ class MockStepEngine:
     def request_keys(self, n: int) -> np.ndarray:
         return np.zeros((n, 2), np.uint32)
 
-    def submit_request(self, ids: list[int], max_new_tokens: int):
+    def grammar_state(self, name: str):
+        """Validate-only grammar support (the serve --mock smoke passes
+        ``grammar=`` end-to-end): unknown names raise the same
+        ``ValueError`` the paged engine raises — the server maps it to
+        400 — and every valid name starts at the FREE state (the mock's
+        canned response is not actually masked)."""
+        from ..decoding import validate_grammar
+
+        validate_grammar(name)
+        return 0
+
+    def spec_counters(self) -> dict:
+        """Same shape as :meth:`PagedTPUEngine.spec_counters` (all-zero
+        unless a grammar rode through — the mock never drafts)."""
+        return self.stats.spec_counters()
+
+    def submit_request(self, ids: list[int], max_new_tokens: int,
+                       grammar: str | None = None):
+        if grammar:
+            self.grammar_state(grammar)     # ValueError on unknown names
+            self.stats.grammar_requests += 1
         self._next_seq += 1
         self.live += 1
         self.stats.prefill_tokens += len(ids)
@@ -261,5 +281,5 @@ class MockStepEngine:
         if self.flightrec.enabled:
             self.flightrec.record(
                 sum(1 for r in reqs.values() if not r.done), 0, 0, 0, 0, 0,
-                self.tokens_per_step, dt,
+                0, self.tokens_per_step, dt,
                 time.monotonic() - self.heartbeat, tuple(reqs))

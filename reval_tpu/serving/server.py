@@ -185,10 +185,21 @@ def _validate_request(req: dict, max_tokens_cap: int | None) -> dict:
     if deadline_s is not None and (not _finite(deadline_s) or deadline_s <= 0):
         raise ValueError(f"'deadline_s' must be a finite number > 0, "
                          f"got {deadline_s!r}")
+    grammar = req.get("grammar")
+    if grammar is not None:
+        if not isinstance(grammar, str) or not grammar:
+            raise ValueError(f"'grammar' must be a non-empty string "
+                             f"(an answer-shape name), got {grammar!r}")
+        from ..decoding import validate_grammar
+
+        # an unknown shape name is the request's fault: 400 here, never
+        # a driver-side fault after admission
+        validate_grammar(grammar)
     return {"prompts": prompts, "single": single, "stop": stop,
             "max_tokens": max_tokens, "temperature": float(temperature),
             "top_k": int(top_k), "top_p": float(top_p),
             "stream": bool(req.get("stream", False)),
+            "grammar": grammar,
             "deadline_s": float(deadline_s) if deadline_s is not None else None}
 
 
@@ -230,6 +241,7 @@ class EngineServer:
         self._streams = "on_progress" in params
         self._deadlines = "deadline_s" in params
         self._req_ids = "request_id" in params
+        self._grammars = "grammar" in params
         self._lock = (threading.Lock() if serialize
                       else contextlib.nullcontext())
         self.ready_fn = ready_fn
@@ -429,6 +441,17 @@ class EngineServer:
                             and p["temperature"] > 0 else {})
                 if outer._deadlines and p["deadline_s"] is not None:
                     sampling["deadline_s"] = p["deadline_s"]
+                if p["grammar"] is not None:
+                    if not outer._grammars:
+                        # a silently-dropped constraint would score
+                        # unconstrained generations as constrained ones
+                        self._send(400, _err(
+                            "invalid_request",
+                            "this engine does not support "
+                            "grammar-constrained decoding", rid),
+                            request_id=rid)
+                        return
+                    sampling["grammar"] = p["grammar"]
                 if outer._req_ids:
                     # sessions thread the id into spans + engine logs
                     sampling["request_id"] = rid
@@ -762,10 +785,27 @@ class EngineServer:
 def _engine_generate_fn(engine):
     import inspect
 
-    streams = "on_progress" in inspect.signature(engine.generate).parameters
+    params = inspect.signature(engine.generate).parameters
+    streams = "on_progress" in params
+    grammars = "grammar" in params
+
+    if grammars:
+        def generate(prompts, *, max_tokens, temperature, stop,
+                     top_k=0, top_p=1.0, on_progress=None, grammar=None):
+            kwargs = {"grammar": grammar} if grammar is not None else {}
+            if on_progress is not None and streams:
+                kwargs["on_progress"] = on_progress
+            if top_k > 0 or top_p < 1.0:
+                kwargs.update(top_k=top_k, top_p=top_p)
+            return engine.generate(prompts, max_new_tokens=max_tokens,
+                                   temperature=temperature, stop=stop,
+                                   **kwargs)
+        return generate
 
     def generate(prompts, *, max_tokens, temperature, stop,
                  top_k=0, top_p=1.0, on_progress=None):
+        # no grammar kwarg on purpose: the server then 400s grammar=
+        # requests instead of silently decoding unconstrained
         kwargs = {}
         if on_progress is not None and streams:
             # engines without the hook (static) fall back to a buffered

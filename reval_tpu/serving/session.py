@@ -147,13 +147,14 @@ def _generate_fn_for(submitter):
     owner (single session or replica set) — pass ``serialize=False``."""
     def generate(prompts, *, max_tokens, temperature, stop,
                  top_k=0, top_p=1.0, on_progress=None, deadline_s=None,
-                 request_id=None):
+                 request_id=None, grammar=None):
         return submitter.submit(prompts, max_new_tokens=max_tokens,
                                 temperature=temperature, stop=stop,
                                 top_k=top_k, top_p=top_p,
                                 on_progress=on_progress,
                                 deadline_s=deadline_s,
-                                request_id=request_id).result()
+                                request_id=request_id,
+                                grammar=grammar).result()
     return generate
 
 
@@ -166,6 +167,9 @@ class _Submission:
     on_progress: object
     top_k: int = 0
     top_p: float = 1.0
+    #: grammar name constraining every prompt of this submission (the
+    #: wire ``grammar`` field; None = unconstrained)
+    grammar: str | None = None
     #: the wire request id (``X-Request-Id``) this submission serves —
     #: span tracing and server/client logs name requests by it
     request_id: str | None = None
@@ -279,7 +283,8 @@ class ContinuousSession:
                temperature: float = 0.0, stop: list[str] | None = None,
                top_k: int = 0, top_p: float = 1.0,
                on_progress=None, deadline_s: float | None = None,
-               request_id: str | None = None) -> _Pending:
+               request_id: str | None = None,
+               grammar: str | None = None) -> _Pending:
         """Enqueue a prompt batch; returns a handle whose ``result()``
         blocks until all its prompts finish.  ``on_progress(index, text)``
         streams finalised-so-far text at decode-chunk granularity (same
@@ -288,16 +293,25 @@ class ContinuousSession:
         submission engine-side and the handle raises
         :class:`DeadlineExceeded`.  ``request_id`` is the wire id the
         server received (``X-Request-Id``): spans and logs carry it.
+        ``grammar`` constrains every prompt of the submission to the
+        named answer shape (reval_tpu/decoding/).
 
         Raises :class:`Overloaded` when the pending-token queue is above
         the watermark, :class:`Draining` after :meth:`close`,
         :class:`EngineWedged` after a watchdog trip, and ``ValueError``
-        for a token budget no prompt could ever fit (a client error — the
-        server maps it to 400)."""
+        for a token budget no prompt could ever fit OR an unknown
+        grammar name (client errors — the server maps both to 400)."""
+        if grammar:
+            from ..decoding import validate_grammar
+
+            # fail unknown names HERE, in the caller's thread (a 400),
+            # never in the driver loop (which would fail the handle as a
+            # 500-shaped engine fault)
+            validate_grammar(grammar)
         sub = _Submission(list(prompts), max_new_tokens, float(temperature),
                           list(stop or []), on_progress,
                           top_k=int(top_k), top_p=float(top_p),
-                          request_id=request_id)
+                          grammar=grammar, request_id=request_id)
         if not sub.prompts:
             sub.pending._fire()
             return sub.pending
@@ -840,12 +854,16 @@ class ContinuousSession:
             # ride the engine's persistent prefix cache: a template seen
             # on ANY earlier request (this submission, a previous POST, a
             # fleet call before the session attached) prefills only once
-            seq_id, node = eng.submit_request(ids, sub.max_new)
+            seq_id, node = eng.submit_request(ids, sub.max_new,
+                                              grammar=sub.grammar)
             reqs[seq_id] = _Request(
                 index=pos, ids=ids, max_new=sub.max_new,
                 scanner=StopScanner(eng.tokenizer, sub.stop),
                 temp=sub.temperature, top_k=sub.top_k, top_p=sub.top_p,
                 notify=notify, key=keys[pos], node=node,
+                grammar=sub.grammar,
+                gstate=(eng.grammar_state(sub.grammar)
+                        if sub.grammar else 0),
                 # latency counts from the HTTP submit, inbox wait included
                 t_submit=sub.t_submit)
             origin[seq_id] = (sub, pos)
@@ -921,7 +939,8 @@ class MultiSession:
                temperature: float = 0.0, stop: list[str] | None = None,
                top_k: int = 0, top_p: float = 1.0,
                on_progress=None, deadline_s: float | None = None,
-               request_id: str | None = None) -> _Pending:
+               request_id: str | None = None,
+               grammar: str | None = None) -> _Pending:
         n = len(prompts)
         with self._lock:
             accepting = [i for i, s in enumerate(self.sessions)
@@ -950,7 +969,7 @@ class MultiSession:
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, stop=stop, top_k=top_k, top_p=top_p,
                 on_progress=on_progress, deadline_s=deadline_s,
-                request_id=request_id)
+                request_id=request_id, grammar=grammar)
         except Exception:
             release()                   # closed/shedding session etc.: no leak
             raise

@@ -1,0 +1,559 @@
+"""Speculative + constrained decoding (reval_tpu/decoding/ + the paged
+engine's batched verify path).
+
+The load-bearing assertions:
+
+- **grammar bite** — per-task answer shapes compile to token automata
+  under which an out-of-grammar token is IMPOSSIBLE (every raw generated
+  id walks the mask), for greedy and sampled rows alike;
+- **the greedy-accept contract** — speculation on/off is bit-identical
+  over REval-shaped probes (raw id streams, not text), with ≥2× fewer
+  engine decode steps on grammar-constrained coverage-shaped prompts;
+- **exact page bookkeeping** — rejected drafts roll the runtime length
+  back (pages free; no drift toward max_pages_per_seq), and the contract
+  survives preemption on a tiny pool × a warm prefix cache;
+- **spec.wedge degrade** — a faulting drafter downgrades ONLY its
+  request to plain decode, mid-request, bit-identically;
+- **dp work-stealing parity** and the serving path (session submit +
+  HTTP ``grammar=`` end-to-end over the mock engine, unknown names 400).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from reval_tpu.decoding import (GrammarSet, NgramIndex, TASK_GRAMMARS,
+                                propose, validate_grammar)
+from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+from reval_tpu.models import ModelConfig, init_random_params
+
+PAGE = 128
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,  # 320
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    return cfg, params
+
+
+def mk_engine(tiny, *, spec=None, slots=4, max_seq=256, pages=None,
+              prefix=True, page=PAGE):
+    cfg, params = tiny
+    return PagedTPUEngine(params, cfg, ByteTokenizer(), max_slots=slots,
+                          page_size=page, max_seq_len=max_seq,
+                          num_pages=pages, prefix_sharing=prefix,
+                          speculative=spec)
+
+
+PROBES = [
+    "Is line 2 executed when f(3) is called?\n[ANSWER]",
+    "def add(a, b):\n    return a + b\nIs line 2 executed?\n[ANSWER]",
+    "x = 1\nwhile x < 9:\n    x *= 2\nWhat is x?\n[ANSWER]",
+]
+
+
+# -- grammar compilation bites --------------------------------------------
+class TestGrammar:
+    def _walk_legal(self, gs, start, ids):
+        state = start
+        for t in ids:
+            assert gs.allowed(state, t), \
+                f"token {t!r} ({chr(t) if t < 256 else t}) emitted in " \
+                f"state {state} where the mask forbids it"
+            state = int(gs.next[state, t])
+        return state
+
+    def test_per_task_shapes_compile_and_accept_canonical_answers(self):
+        gs = GrammarSet(ByteTokenizer(), 320)
+        canonical = {"coverage": "NO", "path": "    return x*2",
+                     "state": "4; int", "output":
+                     "assertEqual(a.f(4), 7)"}
+        for task, shape in TASK_GRAMMARS.items():
+            start = gs.start_state(shape)
+            text = "\n" + canonical[task] + "\n[/ANSWER]"
+            end = self._walk_legal(gs, start, [ord(c) for c in text])
+            assert end == 0, f"{shape}: canonical answer did not close"
+            # the cot variant accepts the same answer after free thought
+            cstart = gs.start_state(f"cot-{shape}")
+            cot = "because...\n[/THOUGHT]\n[ANSWER]" + text
+            assert gs.walk(cstart, [ord(c) for c in cot]) == 0
+
+    def test_yesno_forbids_everything_but_the_alternatives(self):
+        gs = GrammarSet(ByteTokenizer(), 320)
+        s = gs.start_state("yesno")
+        allowed = {t for t in range(320) if gs.allowed(s, t)}
+        assert allowed == {ord("\n"), ord("Y"), ord("N")}
+        # mid-literal: after 'YE' exactly one continuation, and it is
+        # what the drafter force-proposes
+        st = gs.walk(s, [ord("Y"), ord("E")])
+        assert int(gs.forced[st]) == ord("S")
+        # EOS is impossible mid-answer, legal once the tag closed (FREE)
+        assert not gs.allowed(st, ByteTokenizer().eos_id)
+        done = gs.walk(s, [ord(c) for c in "YES\n[/ANSWER]"])
+        assert done == 0 and gs.allowed(done, ByteTokenizer().eos_id)
+
+    def test_state_shape_requires_semicolon_before_close(self):
+        gs = GrammarSet(ByteTokenizer(), 320)
+        s = gs.start_state("state")
+        mid = gs.walk(s, [ord(c) for c in "42"])
+        assert not gs.allowed(mid, ord("\n"))   # no close without a ';'
+        assert gs.walk(mid, [ord(c) for c in "; int\n[/ANSWER]"]) == 0
+        assert gs.walk(s, [ord(c) for c in "Nil\n[/ANSWER]"]) == 0
+
+    def test_unknown_grammar_rejected_everywhere(self, tiny):
+        with pytest.raises(ValueError):
+            validate_grammar("bogus-shape")
+        eng = mk_engine(tiny)
+        try:
+            with pytest.raises(ValueError):
+                eng.generate(["x"], max_new_tokens=4, grammar="bogus-shape")
+            with pytest.raises(ValueError):
+                eng.submit_request([1, 2, 3], 4, grammar="bogus-shape")
+        finally:
+            eng.close()
+        from reval_tpu.serving.server import _validate_request
+        with pytest.raises(ValueError):
+            _validate_request({"prompt": "x", "grammar": "bogus"}, None)
+        assert _validate_request({"prompt": "x", "grammar": "yesno"},
+                                 None)["grammar"] == "yesno"
+
+    def test_out_of_grammar_token_impossible_in_generation(self, tiny):
+        """The tentpole bite: walk every RAW generated id through the
+        mask — at no point may the engine have emitted a token the
+        automaton forbids (greedy AND sampled, spec on AND off)."""
+        for spec in (False, None):
+            eng = mk_engine(tiny, spec=spec)
+            gs = eng._grammars
+            try:
+                for temp in (0.0, 0.9):
+                    _, ids = eng.generate(
+                        PROBES, max_new_tokens=20, temperature=temp,
+                        grammar="yesno", return_ids=True)
+                    for row in ids:
+                        TestGrammar()._walk_legal(
+                            gs, gs.start_state("yesno"), row)
+            finally:
+                eng.close()
+
+    def test_static_engine_rejects_grammar_loudly(self, tiny):
+        from reval_tpu.inference.tpu.engine import TPUEngine
+
+        cfg, params = tiny
+        eng = TPUEngine(params, cfg, ByteTokenizer(), batch_size=2,
+                        max_seq_len=256)
+        with pytest.raises(ValueError, match="paged"):
+            eng.generate(["x"], max_new_tokens=4, grammar="yesno")
+
+
+# -- drafting --------------------------------------------------------------
+class TestDraft:
+    def test_ngram_index_never_matches_its_own_tail(self):
+        idx = NgramIndex(3, [1, 2, 3, 4])
+        assert idx.match([2, 3, 4]) is None     # the tail IS the stream end
+        idx.extend([1, 2, 3, 9])
+        # stream [1,2,3,4,1,2,3,9]: the LATEST completed occurrence of
+        # (1,2,3) ends before index 7 — recency wins, continuation 9
+        assert idx.match([1, 2, 3]) == 7
+        drafts, forced = propose(idx, 4)
+        # the tail itself is (2,3,9): no completed earlier occurrence
+        assert drafts == [] and forced == 0
+        idx2 = NgramIndex(2, [5, 6, 7, 5, 6])
+        drafts2, _ = propose(idx2, 4)
+        assert drafts2[:1] == [7]               # (5,6) continues with 7
+
+    def test_grammar_forced_chain_is_free(self):
+        gs = GrammarSet(ByteTokenizer(), 320)
+        st = gs.walk(gs.start_state("yesno"), [ord("N")])
+        drafts, forced = propose(None, 16, gs, st)
+        assert "".join(chr(t) for t in drafts) == "O\n[/ANSWER]"
+        assert forced == len(drafts)
+
+    def test_span_stops_at_out_of_grammar_token(self):
+        gs = GrammarSet(ByteTokenizer(), 320)
+        # history continues "YX" after the tail; 'X' is out of grammar
+        idx = NgramIndex(2, [ord(c) for c in "abYXab"])
+        st = gs.start_state("yesno")        # allows only \n, Y, N
+        drafts, _ = propose(idx, 8, gs, st)
+        assert ord("X") not in drafts
+
+
+# -- the greedy-accept contract -------------------------------------------
+class TestAcceptContract:
+    def test_spec_on_off_bit_identical_and_2x_fewer_steps(self, tiny):
+        """The acceptance criterion: byte-identical greedy outputs with
+        ≥2× fewer engine decode steps on coverage-shaped constrained
+        probes, accept-rate surfaced in the counters."""
+        runs = {}
+        for name, spec in (("off", False), ("on", None)):
+            eng = mk_engine(tiny, spec=spec)
+            try:
+                out, _ = eng.generate(
+                    PROBES, max_new_tokens=24, temperature=0.0,
+                    stop=["[/ANSWER]"], grammar="yesno", return_ids=True)
+                # raw streams compare WITHOUT a stop string: post-stop
+                # chunk overrun differs by chunking schedule by design
+                # (finalize cuts it), so the raw contract is budget-run
+                _, ids = eng.generate(
+                    PROBES, max_new_tokens=16, temperature=0.0,
+                    grammar="yesno", return_ids=True)
+                runs[name] = (out, ids, eng.stats.decode_steps,
+                              eng.spec_counters())
+            finally:
+                eng.close()
+        assert runs["on"][0] == runs["off"][0]
+        assert runs["on"][1] == runs["off"][1]
+        steps_off, steps_on = runs["off"][2], runs["on"][2]
+        assert steps_on * 2 <= steps_off, (steps_on, steps_off)
+        sc = runs["on"][3]
+        assert sc["rounds"] > 0 and sc["accepted_tokens"] > 0
+        assert 0 < sc["accept_rate"] <= 1.0
+        assert sc["forced_tokens"] > 0          # grammar forcing engaged
+        off = runs["off"][3]
+        assert off["rounds"] == 0 and off["drafted_tokens"] == 0
+
+    def test_ngram_only_speculation_bit_identical(self, tiny):
+        """speculative=True drafts grammar-less greedy rows from their
+        own context (prompt lookup) — same stream as plain decode."""
+        prompts = ["def f(a, b):\n    return a + b\ndef g(a, b):\n    ret",
+                   "x = 1\nwhile x < 9:\n    x *= 2\nwhile x < 9:\n"]
+        base_eng = mk_engine(tiny, spec=False)
+        base = base_eng.generate(prompts, max_new_tokens=16,
+                                 temperature=0.0, return_ids=True)
+        base_eng.close()
+        eng = mk_engine(tiny, spec=True)
+        try:
+            got = eng.generate(prompts, max_new_tokens=16,
+                               temperature=0.0, return_ids=True)
+            assert got == base
+            assert eng.stats.spec_rounds > 0
+        finally:
+            eng.close()
+
+    def test_kill_switch_env(self, tiny, monkeypatch):
+        monkeypatch.setenv("REVAL_TPU_SPEC", "0")
+        eng = mk_engine(tiny)       # speculative=None reads the env
+        try:
+            out = eng.generate(PROBES[:1], max_new_tokens=12,
+                               temperature=0.0, grammar="yesno")
+            assert eng.stats.spec_rounds == 0
+            assert eng.stats.grammar_requests == 1   # masking still on
+            assert "YES" in out[0] or "NO" in out[0]
+        finally:
+            eng.close()
+
+    def test_mixed_grammar_batch_masks_only_named_rows(self, tiny):
+        """Per-prompt grammar lists (the fleet's fused shape): the named
+        row obeys its shape, the unconstrained row decodes exactly as a
+        grammar-less run would."""
+        eng = mk_engine(tiny)
+        try:
+            out, ids = eng.generate(
+                PROBES[:2], max_new_tokens=12, temperature=0.0,
+                grammar=["yesno", None], return_ids=True)
+        finally:
+            eng.close()
+        base_eng = mk_engine(tiny, spec=False)
+        try:
+            _, base_ids = base_eng.generate(
+                PROBES[:2], max_new_tokens=12, temperature=0.0,
+                return_ids=True)
+        finally:
+            base_eng.close()
+        assert ids[1] == base_ids[1]            # unconstrained row untouched
+        gs = GrammarSet(ByteTokenizer(), 320)
+        TestGrammar()._walk_legal(gs, gs.start_state("yesno"), ids[0])
+
+
+# -- page bookkeeping ------------------------------------------------------
+class TestPageBookkeeping:
+    def test_runtime_rollback_frees_rejected_tail_pages(self):
+        from reval_tpu.runtime import PagedRuntime
+
+        rt = PagedRuntime(num_pages=16, page_size=8, max_slots=2,
+                          max_pages_per_seq=8)
+        sid = rt.submit(10, 30)
+        assert rt.admit()
+        free0 = rt.free_pages
+        assert rt.advance(sid, 9) == 19         # window reserve: +1 page
+        assert rt.free_pages == free0 - 1
+        rt.rollback(sid, 11)                    # 8 of 9 rejected
+        assert rt.seq_len(sid) == 11 and rt.free_pages == free0
+        with pytest.raises(ValueError):
+            rt.rollback(sid, 9)                 # below prompt_len
+        with pytest.raises(ValueError):
+            rt.rollback(sid, 12)                # above len
+        # prefix pages are never rolled away
+        pid = rt.alloc_prefix(2)
+        rid = rt.submit_prefixed(pid, 17, 8)
+        rt.admit()
+        rt.advance(rid, 4)
+        rt.rollback(rid, 17)
+        assert rt.prefix_pages(rid) == 2
+        rt.release(rid)
+        rt.release(pid)
+        rt.release(sid)
+        rt.close()
+
+    def test_no_length_drift_across_many_rounds(self, tiny):
+        """Rejected drafts must not inflate the runtime length round
+        over round (un-rolled-back reservations would creep toward
+        max_pages_per_seq and spuriously OOM/preempt)."""
+        eng = mk_engine(tiny, spec=None, max_seq=512)
+        try:
+            out = eng.generate(
+                PROBES, max_new_tokens=48, temperature=0.0,
+                grammar="line", return_ids=True)[1]
+            sc = eng.spec_counters()
+            assert sc["rounds"] >= 2
+            # every sequence released; all non-cache pages back
+            assert eng.rt.num_running == 0 and eng.rt.num_waiting == 0
+            cached = (eng.prefix_cache.cached_pages
+                      if eng.prefix_cache else 0)
+            assert eng.rt.free_pages == eng.num_pages - 1 - cached
+            assert all(len(r) <= 48 for r in out)
+        finally:
+            eng.close()
+
+    def test_preemption_x_prefix_cache_bit_identical(self, tiny):
+        """The hard satellite: a pool too small for the batch (forced
+        preemption) plus a warm radix prefix cache, speculating — the
+        streams still match the unconstrained-resources plain run.
+        Small pages (16) so the verify windows straddle page boundaries
+        and the shared template spans many cached pages."""
+        shared = ("You are given a Python function and a question. "
+                  "Answer with YES or NO only. " * 2)
+        prompts = [shared + p for p in PROBES]
+        big = mk_engine(tiny, spec=False, slots=2, max_seq=512, page=16)
+        try:
+            big.generate(prompts, max_new_tokens=40, temperature=0.0,
+                         grammar="yesno")     # warm its cache like below
+            want = big.generate(prompts, max_new_tokens=40,
+                                temperature=0.0, grammar="yesno",
+                                return_ids=True)
+        finally:
+            big.close()
+        # template ~10 cached pages + 2 riders' tails + decode growth on
+        # a tight pool: advance() must hit OOM mid-run and preempt
+        small = mk_engine(tiny, spec=None, slots=2, max_seq=512, page=16,
+                          pages=24)
+        preempts = []
+        orig = small.rt.preempt
+        small.rt.preempt = lambda s, n: (preempts.append(s), orig(s, n))[1]
+        try:
+            small.generate(prompts, max_new_tokens=40, temperature=0.0,
+                           grammar="yesno")
+            got = small.generate(prompts, max_new_tokens=40,
+                                 temperature=0.0, grammar="yesno",
+                                 return_ids=True)
+            sc = small.spec_counters()
+        finally:
+            small.close()
+        assert got == want
+        assert sc["rounds"] > 0
+        assert preempts, "pool was large enough — shrink pages to keep " \
+                         "this test biting"
+
+
+# -- spec.wedge degrade ----------------------------------------------------
+class TestWedge:
+    def test_drafter_fault_degrades_mid_request(self, tiny, monkeypatch):
+        calls = {"n": 0}
+        import reval_tpu.inference.tpu.paged_engine as pe
+
+        real = pe.propose_drafts
+
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise RuntimeError("drafter exploded")
+            return real(*a, **k)
+
+        base_eng = mk_engine(tiny, spec=False)
+        want = base_eng.generate(PROBES, max_new_tokens=24,
+                                 temperature=0.0, grammar="yesno",
+                                 return_ids=True)
+        base_eng.close()
+        monkeypatch.setattr(pe, "propose_drafts", flaky)
+        eng = mk_engine(tiny, spec=None)
+        try:
+            got = eng.generate(PROBES, max_new_tokens=24, temperature=0.0,
+                               grammar="yesno", return_ids=True)
+            sc = eng.spec_counters()
+        finally:
+            eng.close()
+        assert got == want                       # bit-identical through it
+        assert sc["wedges"] >= 1                 # rows degraded, counted
+        assert sc["rounds"] >= 1                 # speculation DID start
+
+    def test_wedge_event_logged(self, tiny, monkeypatch):
+        import reval_tpu.inference.tpu.paged_engine as pe
+        from reval_tpu.obs.logging import recent
+
+        monkeypatch.setattr(pe, "propose_drafts",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("boom")))
+        eng = mk_engine(tiny, spec=None)
+        try:
+            eng.generate(PROBES[:1], max_new_tokens=8, temperature=0.0,
+                         grammar="yesno")
+        finally:
+            eng.close()
+        assert any(e.get("event") == "spec.wedge" for e in recent(64))
+
+
+# -- dp work-stealing parity ----------------------------------------------
+class TestDpParity:
+    def test_dp2_matches_single_engine_with_grammar_and_spec(self, tiny):
+        from reval_tpu.inference.tpu.dp_paged import DataParallelPagedEngine
+
+        cfg, params = tiny
+        single = mk_engine(tiny, spec=None, slots=2)
+        try:
+            want = single.generate(PROBES * 2, max_new_tokens=12,
+                                   temperature=0.0, grammar="yesno",
+                                   return_ids=True)
+        finally:
+            single.close()
+        dp = DataParallelPagedEngine(params, cfg, ByteTokenizer(),
+                                     dp_size=2, max_slots=2, page_size=PAGE,
+                                     max_seq_len=256, speculative=None)
+        try:
+            got = dp.generate(PROBES * 2, max_new_tokens=12,
+                              temperature=0.0, grammar="yesno",
+                              return_ids=True)
+            sc = dp.spec_counters()
+        finally:
+            dp.close()
+        assert got == want
+        assert sc["grammar_requests"] == len(PROBES) * 2
+        assert sc["rounds"] > 0
+
+
+# -- serving path ----------------------------------------------------------
+class TestServing:
+    def test_session_submit_grammar_over_paged_engine(self, tiny):
+        from reval_tpu.serving.session import ContinuousSession
+
+        base_eng = mk_engine(tiny, spec=False)
+        want = base_eng.generate(PROBES, max_new_tokens=16,
+                                 temperature=0.0, grammar="yesno")
+        base_eng.close()
+        eng = mk_engine(tiny, spec=None)
+        session = ContinuousSession(eng, watchdog_s=0)
+        try:
+            got = session.submit(PROBES, max_new_tokens=16,
+                                 grammar="yesno").result(timeout=120)
+            with pytest.raises(ValueError):
+                session.submit(["x"], max_new_tokens=4, grammar="nope")
+        finally:
+            session.close()
+            eng.close()
+        assert got == want
+        assert eng.stats.grammar_requests == len(PROBES)
+
+    def test_serve_mock_grammar_end_to_end(self):
+        """The serve --mock smoke shape: HTTP grammar= flows through the
+        session into the mock engine (counted), unknown names 400."""
+        import urllib.error
+        import urllib.request
+
+        from reval_tpu.serving.mock_engine import MockStepEngine
+        from reval_tpu.serving.server import EngineServer
+        from reval_tpu.serving.session import ContinuousSession
+
+        eng = MockStepEngine()
+        session = ContinuousSession(eng, watchdog_s=0)
+        server = EngineServer(session.generate_fn(), "mock", port=0,
+                              serialize=False,
+                              ready_fn=session.readiness)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}/v1/completions"
+
+        def post(body):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        try:
+            status, doc = post({"prompt": "hello", "max_tokens": 16,
+                                "grammar": "yesno"})
+            assert status == 200
+            assert doc["choices"][0]["text"]
+            assert eng.stats.grammar_requests == 1
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                post({"prompt": "hello", "max_tokens": 16,
+                      "grammar": "not-a-shape"})
+            assert exc_info.value.code == 400
+            body = json.loads(exc_info.value.read())
+            assert body["error"]["code"] == "invalid_request"
+        finally:
+            server.shutdown()
+            session.close()
+
+
+# -- reporting -------------------------------------------------------------
+class TestReporting:
+    def test_obs_report_speculative_across_rounds(self, tmp_path, capsys):
+        import tools.obs_report as obs_report
+
+        rounds = []
+        for i, rate in enumerate((0.4, 0.75)):
+            p = tmp_path / f"BENCH_r0{i + 1}.json"
+            p.write_text(json.dumps({"speculative": {
+                "accept_rate": rate, "drafted_tokens": 100,
+                "accepted_tokens": int(rate * 100),
+                "steps_saved_ratio": 1.0 + rate, "wedges": 0}}))
+            rounds.append(str(p))
+        noblock = tmp_path / "BENCH_r00.json"
+        noblock.write_text(json.dumps({"metric": "x"}))
+        rc = obs_report.main(["--speculative", str(noblock)] + rounds)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no speculative block" in out
+        assert "+0.350" in out                   # the round-over-round delta
+
+    def test_fleet_grammar_selection_map(self):
+        from reval_tpu.fleet import FleetRunner
+
+        fr = FleetRunner(dataset="humaneval", mock=True, grammar=True,
+                         progress=False)
+        assert fr.task_grammar("coverage") == "yesno"
+        assert fr.task_grammar("output") == "assert"
+        assert fr.task_grammar("unknown-task") is None
+        cot = FleetRunner(dataset="humaneval", mock=True, grammar=True,
+                          prompt_type="cot", progress=False)
+        assert cot.task_grammar("path") == "cot-line"
+        off = FleetRunner(dataset="humaneval", mock=True, progress=False)
+        assert off.task_grammar("coverage") is None
+
+    def test_fleet_rejects_grammar_without_capable_backend(self):
+        from reval_tpu.fleet import FleetRunner
+
+        class Dumb:
+            info = "dumb_direct_temp0.0"
+
+        with pytest.raises(ValueError, match="grammar"):
+            FleetRunner(dataset="humaneval", backend=Dumb(), grammar=True,
+                        progress=False, resilience=False)
+
+    def test_spec_counters_shape_everywhere(self, tiny):
+        from reval_tpu.serving.mock_engine import MockStepEngine
+
+        eng = mk_engine(tiny)
+        mock = MockStepEngine()
+        try:
+            keys = set(eng.spec_counters())
+            assert keys == set(mock.spec_counters())
+            assert {"rounds", "accept_rate", "drafted_tokens",
+                    "accepted_tokens", "rolled_back_tokens",
+                    "wedges"} <= keys
+        finally:
+            eng.close()

@@ -154,11 +154,11 @@ def test_decode_chunk_program_lowers(tiny_engine_parts, monkeypatch,
     cache = init_paged_cache(cfg, num_pages=20, page_size=16,
                              dtype=jnp.bfloat16, kv_dtype=kv_dtype)
     span, b = 6, 4
-    state = jnp.zeros((b, span + 5), jnp.int32).at[:, span].set(1)
+    state = jnp.zeros((b, span + 6), jnp.int32).at[:, span].set(1)
     sampling = jnp.zeros((b, 3), jnp.float32)
     fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=4,
                  filtered=filtered)
-    _export_tpu(fn, params, state, cache, sampling)
+    _export_tpu(fn, params, state, cache, sampling, None)
 
 
 def test_table_patch_program_lowers():
@@ -169,6 +169,6 @@ def test_table_patch_program_lowers():
     from reval_tpu.inference.tpu.paged_engine import patch_state_tables
 
     span, b = 6, 4
-    state = jnp.zeros((b, span + 5), jnp.int32)
+    state = jnp.zeros((b, span + 6), jnp.int32)
     tables = jnp.zeros((b, span), jnp.int32)
     _export_tpu(patch_state_tables, state, tables)

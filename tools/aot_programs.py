@@ -12,7 +12,7 @@ chip-free host via ``jax.experimental.topologies``.
 Shape contracts mirrored from the engine/bench:
 - the engine pow2-buckets the block-table span (paged_engine.pow2_bucket);
   bench prompts (~500 tok) + 256 new land in bucket 8 (direct) and
-  + 1024 new in bucket 16 (cot) — packed state rows are ``span + 5``;
+  + 1024 new in bucket 16 (cot) — packed state rows are ``span + 6``;
 - bench.py sizes the page pool as ``1 + slots * per_seq + 16`` with
   per_seq 7 (direct) / 13 (cot);
 - prefill row groups bucket to pow2 under the 768 MB byte budget
@@ -121,7 +121,7 @@ def compile_flagship_chunk(*, steps=32, slots=32, kv_dtype="",
     cfg, params, cache = flagship_model_parts(
         mesh, num_pages=bench_pool(slots, per_seq), kv_dtype=kv_dtype,
         weights=weights)
-    state = jax.ShapeDtypeStruct((slots, span + 5), jnp.int32, sharding=rep)
+    state = jax.ShapeDtypeStruct((slots, span + 6), jnp.int32, sharding=rep)
     samp = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
     fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,
                  filtered=False)
@@ -160,7 +160,7 @@ def _compile_tp8_chunk(cfg, param_shapes, *, steps, slots, num_pages):
             sharding=cache_sharding if len(s.shape) == 3 else rep),
         jax.eval_shape(lambda: init_paged_cache(
             cfg, num_pages=num_pages, page_size=128, dtype=jnp.bfloat16)))
-    state = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT + 5), jnp.int32,
+    state = jax.ShapeDtypeStruct((slots, BENCH_SPAN_DIRECT + 6), jnp.int32,
                                  sharding=rep)
     samp = jax.ShapeDtypeStruct((slots, 3), jnp.float32, sharding=rep)
     fn = partial(PagedTPUEngine._decode_chunk, cfg=cfg, steps=steps,

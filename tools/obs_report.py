@@ -187,19 +187,69 @@ def render_determinism(paths: list[str]) -> str:
     return "\n".join(lines)
 
 
+def speculative_block(path: str) -> dict | None:
+    """One artifact's ``speculative`` block: a BENCH round's embedded
+    dict (bench.py A/B garnish) or a fleet_metrics.json trailer."""
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("not a JSON object")
+    block = obj.get("speculative")
+    return block if isinstance(block, dict) else None
+
+
+def render_speculative(paths: list[str]) -> str:
+    """Accept-rate (and steps-saved) trajectory across rounds: one row
+    per artifact, per-round deltas against the previous round — how the
+    drafting economics move commit to commit."""
+    lines = ["== speculative decoding across rounds ==", "",
+             f"{'round':<28} {'accept':>7} {'Δ':>7} {'drafted':>8} "
+             f"{'accepted':>8} {'steps_saved':>11} {'wedges':>6}"]
+    prev: float | None = None
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            block = speculative_block(path)
+        except (OSError, ValueError) as e:
+            lines.append(f"{name:<28} (unreadable: {type(e).__name__})")
+            continue
+        if block is None:
+            lines.append(f"{name:<28} (no speculative block)")
+            continue
+        rate = float(block.get("accept_rate") or 0.0)
+        delta = "" if prev is None else f"{rate - prev:+.3f}"
+        ratio = block.get("steps_saved_ratio")
+        lines.append(
+            f"{name:<28} {rate:>7.3f} {delta:>7} "
+            f"{block.get('drafted_tokens', '?'):>8} "
+            f"{block.get('accepted_tokens', '?'):>8} "
+            f"{(f'{ratio:.2f}x' if ratio is not None else '?'):>11} "
+            f"{block.get('wedges', 0):>6}")
+        prev = rate
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("snapshot", nargs="+",
                     help="metrics snapshot JSON (registry snapshot, "
                          "fleet_metrics.json, or a /statusz body); with "
-                         "--determinism: BENCH/matrix artifacts in "
+                         "--determinism/--speculative: BENCH artifacts in "
                          "chronological order")
     ap.add_argument("--determinism", action="store_true",
                     help="report reference-cell fingerprint drift across "
                          "BENCH rounds instead of metric snapshots")
+    ap.add_argument("--speculative", action="store_true",
+                    help="report speculative-decoding accept-rate deltas "
+                         "across BENCH rounds instead of metric snapshots")
     args = ap.parse_args(argv)
+    if args.determinism and args.speculative:
+        ap.error("--determinism and --speculative are mutually exclusive")
     if args.determinism:
         print(render_determinism(args.snapshot))
+        return 0
+    if args.speculative:
+        print(render_speculative(args.snapshot))
         return 0
     if len(args.snapshot) > 2:
         ap.error("snapshot mode takes one file (render) or two (delta)")

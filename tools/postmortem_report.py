@@ -28,6 +28,7 @@ import sys
 _COLS = (("step", "step", 8), ("running", "running", 7),
          ("queued", "queued", 6), ("free_pg", "free_pages", 7),
          ("cached", "cached_pages", 6), ("pinned", "pinned_pages", 6),
+         ("spec", "spec_accepted", 5),
          ("chunk", "chunk_steps", 5), ("step_ms", "step_ms", 9),
          ("hb_ms", "hb_age_ms", 8))
 
