@@ -825,6 +825,14 @@ def run_router(argv: list[str]) -> int:
                         help="ejection cooldown before a half-open probe")
     parser.add_argument("--health-interval-s", type=float, default=None,
                         help="/readyz poll interval per replica")
+    parser.add_argument("--max-inflight", type=int, default=None,
+                        help="fleet concurrency ceiling for weighted "
+                             "per-tenant admission (default env "
+                             "REVAL_TPU_ROUTER_MAX_INFLIGHT; 0 = off)")
+    parser.add_argument("--tenant-weights", default=None, metavar="SPEC",
+                        help="per-tenant admission weights: "
+                             "'alpha:3,beta:1' or a JSON object "
+                             "(unlisted tenants weigh 1.0)")
     parser.add_argument("--mock", type=int, default=None, metavar="N",
                         help="spawn N in-process mock replicas (host-only "
                              "fleet; the smoke/drill target)")
@@ -850,6 +858,15 @@ def run_router(argv: list[str]) -> int:
     if not replicas:
         print("Error: no replicas (--replicas and/or --mock N)")
         return 1
+    tenant_weights = None
+    if args.tenant_weights:
+        from .serving.router import parse_tenant_weights
+
+        try:
+            tenant_weights = parse_tenant_weights(args.tenant_weights)
+        except ValueError as exc:
+            print(f"Error: {exc}")
+            return 1
     router = FleetRouter(
         replicas, port=args.port if args.smoke is None else 0,
         window_chars=args.window_chars, eject_fails=args.eject_fails,
@@ -857,14 +874,16 @@ def run_router(argv: list[str]) -> int:
         health_interval_s=(args.health_interval_s
                            if args.health_interval_s is not None
                            else (0.1 if args.smoke is not None else None)),
-        affinity_table=args.affinity_table)
+        affinity_table=args.affinity_table,
+        tenant_weights=tenant_weights, max_inflight=args.max_inflight)
     router.start()
     if args.smoke is not None:
         return _router_smoke(router, servers, args.smoke,
                              kill_one=not args.no_kill)
     print(f"routing {len(replicas)} replicas on :{router.port} "
           f"(POST /v1/completions; GET /healthz /readyz /metrics /statusz; "
-          f"POST /admin/drain /admin/rejoin)")
+          f"POST /admin/drain /admin/rejoin /admin/add_replica "
+          f"/admin/remove_replica)")
     try:
         router.serve_forever()
     except KeyboardInterrupt:

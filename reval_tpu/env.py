@@ -168,6 +168,41 @@ ENV: dict[str, dict] = {
     "REVAL_TPU_ROUTER_HEALTH_INTERVAL_S": {
         "default": "1",
         "help": "router /readyz poll interval per replica, in seconds"},
+    "REVAL_TPU_ROUTER_MAX_INFLIGHT": {
+        "default": "0",
+        "help": "fleet-wide concurrent-forward ceiling for weighted "
+                "per-tenant admission (0 disables; above it a tenant "
+                "over its weight share sheds first)"},
+    # -- open-loop load generator (tools/loadgen.py) -----------------------
+    "REVAL_TPU_LOADGEN_SEED": {
+        "default": "0",
+        "help": "seed for the loadgen arrival processes and workload "
+                "sampling (same seed = bit-identical schedule)"},
+    "REVAL_TPU_LOADGEN_CONCURRENCY": {
+        "default": "256",
+        "help": "loadgen in-flight request ceiling; arrivals past it "
+                "queue client-side with their lateness counted against "
+                "the SLO, never re-timed (open-loop)"},
+    # -- SLO-driven autoscaler (serving/autoscaler.py) ---------------------
+    "REVAL_TPU_AUTOSCALE_INTERVAL_S": {
+        "default": "2",
+        "help": "autoscaler observation cadence: one router /metrics "
+                "scrape + policy decision per interval"},
+    "REVAL_TPU_AUTOSCALE_COOLDOWN_S": {
+        "default": "15",
+        "help": "seconds after any scaling action during which further "
+                "actions are suppressed (anti-flap, with the "
+                "consecutive-observation hysteresis)"},
+    "REVAL_TPU_AUTOSCALE_MIN_REPLICAS": {
+        "default": "1",
+        "help": "floor the autoscaler never drains below"},
+    "REVAL_TPU_AUTOSCALE_MAX_REPLICAS": {
+        "default": "4",
+        "help": "ceiling the autoscaler never spawns past"},
+    "REVAL_TPU_AUTOSCALE_TTFT_P99_S": {
+        "default": "0.5",
+        "help": "scale-up SLO target: federated p99 TTFT (per "
+                "observation interval) above this breaches"},
     # -- determinism observatory (obs/determinism.py) ----------------------
     "REVAL_TPU_DETERMINISM_REF": {
         "default": "paged-xla-fp32-b2",

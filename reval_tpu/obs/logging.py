@@ -116,6 +116,24 @@ EVENTS: dict[str, str] = {
     "router.shed": "the router shed a request fleet-wide (no replica "
                    "could take it)",
     "router.drain": "an operator drained or rejoined a replica",
+    "router.resize": "the replica membership changed at runtime "
+                     "(admin add_replica/remove_replica rebuilt the "
+                     "hash ring)",
+    # SLO-driven autoscaler (serving/autoscaler.py)
+    "autoscale.up": "the autoscaler spawned a replica and added it to "
+                    "the router ring",
+    "autoscale.down": "the autoscaler drained a replica, removed it "
+                      "from the ring, and stopped it",
+    "autoscale.blocked": "an indicated scaling action was suppressed "
+                         "(cooldown, min/max replica bound, or a "
+                         "sticky-failed spawn)",
+    # open-loop load generator (tools/loadgen.py)
+    "loadgen.start": "an open-loop load run started (arrival schedule "
+                     "fixed up front)",
+    "loadgen.done": "an open-loop load run finished; the artifact "
+                    "carries goodput/SLO attainment",
+    "loadgen.lost": "a generated request exhausted its retry/deadline "
+                    "budget without completing",
     # fleet (fleet.py)
     "fleet.resume_skip": "a journaled (repeat, task) chunk was skipped",
     "fleet.lost_prompts": "prompts exhausted retries and took the sentinel",

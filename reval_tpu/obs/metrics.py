@@ -33,12 +33,15 @@ snapshots, ``tools/obs_report.py``).
 
 from __future__ import annotations
 
+import math as _math
 import re as _re
 import threading
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "parse_prometheus", "percentile_from_buckets", "snapshot_percentile",
+    "snapshot_fraction_le", "labeled", "series_base",
+    "scrape_delta_histogram",
     "LATENCY_BUCKETS", "STEP_BUCKETS",
     "REQUESTS", "QUEUE_WAIT", "TTFT", "TPOT", "E2E",
     "ENGINE_STEP", "DECODE_CHUNK", "PREFILL_BATCH",
@@ -57,6 +60,10 @@ __all__ = [
     "SPEC_ROUNDS", "SPEC_DRAFTED", "SPEC_ACCEPTED", "SPEC_ROLLED_BACK",
     "SPEC_WEDGES", "SPEC_ACCEPTED_PER_ROUND", "SPEC_BUCKETS",
     "GRAMMAR_REQUESTS", "GRAMMAR_FORCED",
+    "TENANT_REQUESTS", "TENANT_SHEDS", "TENANT_E2E",
+    "ROUTER_GOODPUT", "ROUTER_SLO_MISS",
+    "AUTOSCALE_UP", "AUTOSCALE_DOWN", "AUTOSCALE_BLOCKED",
+    "AUTOSCALE_REPLICAS",
 ]
 
 # Log-spaced seconds buckets spanning sub-ms host paths (mock engine,
@@ -125,6 +132,15 @@ SPEC_WEDGES = "reval_spec_wedges_total"
 SPEC_ACCEPTED_PER_ROUND = "reval_spec_accepted_per_round"
 GRAMMAR_REQUESTS = "reval_grammar_requests_total"
 GRAMMAR_FORCED = "reval_grammar_forced_tokens_total"
+TENANT_REQUESTS = "reval_tenant_requests_total"
+TENANT_SHEDS = "reval_tenant_sheds_total"
+TENANT_E2E = "reval_tenant_e2e_seconds"
+ROUTER_GOODPUT = "reval_router_goodput_total"
+ROUTER_SLO_MISS = "reval_router_slo_miss_total"
+AUTOSCALE_UP = "reval_autoscale_up_total"
+AUTOSCALE_DOWN = "reval_autoscale_down_total"
+AUTOSCALE_BLOCKED = "reval_autoscale_blocked_total"
+AUTOSCALE_REPLICAS = "reval_autoscale_replicas"
 DET_CELLS = "reval_determinism_cells_total"
 DET_AGREE = "reval_determinism_cells_agree_total"
 DET_DIVERGED = "reval_determinism_cells_diverged_total"
@@ -230,6 +246,48 @@ METRICS: dict[str, dict] = {
                             "help": "Replicas currently healthy and "
                                     "passing /readyz (router poller "
                                     "view)"},
+    ROUTER_GOODPUT: {"type": "counter",
+                     "help": "Forwards that completed within their "
+                             "declared deadline_s (requests without a "
+                             "deadline count on any 2xx) — the goodput "
+                             "numerator the loadgen/SLO reports read"},
+    ROUTER_SLO_MISS: {"type": "counter",
+                      "help": "Forwards that completed but blew their "
+                              "declared deadline_s, plus 504 "
+                              "deadline_exceeded pass-throughs"},
+    # per-tenant QoS (serving/router.py) — the ONLY labeled series in
+    # the registry (label: tenant=, sanitized wire value); weighted
+    # admission sheds a noisy tenant before it starves the others
+    TENANT_REQUESTS: {"type": "counter",
+                      "help": "Completion POSTs received per tenant "
+                              "(label tenant=; any outcome)"},
+    TENANT_SHEDS: {"type": "counter",
+                   "help": "Requests shed per tenant (label tenant=): "
+                           "weighted admission over-share sheds plus "
+                           "fleet-wide sheds attributed to the tenant"},
+    TENANT_E2E: {"type": "histogram", "buckets": LATENCY_BUCKETS,
+                 "help": "Router-side end-to-end forward latency per "
+                         "tenant (label tenant=), completed forwards "
+                         "only"},
+    # SLO-driven autoscaler (serving/autoscaler.py) — the control
+    # loop's own registry (not federated; the drill and `reval_tpu
+    # watch` read its actions from the router admin log)
+    AUTOSCALE_UP: {"type": "counter",
+                   "help": "Scale-up actions taken (replica spawned "
+                           "and added to the router ring)"},
+    AUTOSCALE_DOWN: {"type": "counter",
+                     "help": "Scale-down actions taken (replica "
+                             "drained, removed from the ring, and "
+                             "stopped)"},
+    AUTOSCALE_BLOCKED: {"type": "counter",
+                        "help": "Indicated scaling actions suppressed "
+                                "by cooldown or the min/max replica "
+                                "bounds (each also logs "
+                                "autoscale.blocked)"},
+    AUTOSCALE_REPLICAS: {"type": "gauge",
+                         "help": "Replicas the autoscaler currently "
+                                 "targets (its own view; the router "
+                                 "gauge counts ready ones)"},
     # jit-discipline (analysis/jitcheck.py) — compile-variant tracking
     # over the engines' declared jit entry points
     JIT_COMPILES: {"type": "counter",
@@ -360,6 +418,45 @@ METRICS: dict[str, dict] = {
                         "rank-aligned), one observation per compared "
                         "cell"},
 }
+
+
+# -- labeled series ----------------------------------------------------------
+
+_LABEL_KEY_RE = _re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_LABEL_VALUE_RE = _re.compile(r"^[A-Za-z0-9._:\- ]*$")
+
+
+def labeled(name: str, **labels) -> str:
+    """The exposition series name for ``name`` with ``labels`` attached:
+    ``reval_tenant_requests_total{tenant="alpha"}``.  Labels are sorted
+    (one dict, one series) and validated — the registry is the LAST stop
+    before the wire, so a label value that could smuggle a quote or
+    newline into the exposition is rejected here, not escaped into
+    ambiguity.  Callers sanitize wire-derived values first (the router's
+    tenant parser does)."""
+    if not labels:
+        return name
+    parts = []
+    for key in sorted(labels):
+        value = str(labels[key])
+        if not _LABEL_KEY_RE.match(key):
+            raise ValueError(f"bad label key {key!r}")
+        if not _LABEL_VALUE_RE.match(value):
+            raise ValueError(f"bad label value {value!r} for {key!r}")
+        parts.append(f'{key}="{value}"')
+    return f"{name}{{{','.join(parts)}}}"
+
+
+def series_base(series: str) -> str:
+    """The declaring metric name of a (possibly labeled) series."""
+    return series.split("{", 1)[0]
+
+
+def _series_labels(series: str) -> str:
+    """The label body (without braces) of a series; '' when unlabeled."""
+    if "{" in series:
+        return series.split("{", 1)[1].rstrip("}")
+    return ""
 
 
 class Counter:
@@ -501,6 +598,76 @@ def percentile_from_buckets(bounds: tuple[float, ...], counts,
     return bounds[-1]
 
 
+_SCRAPE_LE_RE = _re.compile(r'le="([^"]+)"')
+
+
+def _scrape_buckets(samples: dict, name: str) -> dict[float, float]:
+    """``{upper_bound: cumulative_count}`` for one histogram's bucket
+    samples in a :func:`parse_prometheus` result (labels beyond ``le``
+    are summed — callers want the fleet distribution, not per-label)."""
+    out: dict[float, float] = {}
+    prefix = f"{name}_bucket{{"
+    for series, value in samples.items():
+        if not series.startswith(prefix):
+            continue
+        m = _SCRAPE_LE_RE.search(series)
+        if m is None:
+            continue
+        bound = _math.inf if m.group(1) == "+Inf" else float(m.group(1))
+        out[bound] = out.get(bound, 0.0) + value
+    return out
+
+
+def scrape_delta_histogram(samples: dict, prev: dict | None,
+                           name: str) -> dict | None:
+    """The snapshot-encoded histogram of ``name``'s observations BETWEEN
+    two parsed expositions (cumulative bucket counts subtract) — THE one
+    cumulative→delta assembly: the autoscaler's interval percentiles and
+    loadgen's attainment both build on it, so their delta math cannot
+    diverge.  None when the scrape carries no such histogram; with
+    ``prev`` None the deltas are the lifetime totals."""
+    cur = _scrape_buckets(samples, name)
+    if not cur:
+        return None
+    old = _scrape_buckets(prev or {}, name)
+    bounds = sorted(b for b in cur if b != _math.inf)
+    rows: list[list[float]] = []
+    last = 0.0
+    for b in bounds:
+        cum = cur.get(b, 0.0) - old.get(b, 0.0)
+        rows.append([b, max(0.0, cum - last)])
+        last = cum
+    total = cur.get(_math.inf, 0.0) - old.get(_math.inf, 0.0)
+    return {"buckets": rows, "inf": max(0.0, total - last), "sum": 0.0,
+            "count": total}
+
+
+def snapshot_fraction_le(hist: dict, threshold: float) -> float:
+    """Fraction of a snapshot histogram's observations at or below
+    ``threshold`` — the SLO-attainment estimator (linear interpolation
+    inside the landing bucket, the same model the percentile estimator
+    uses, so attainment and percentiles cannot disagree).  Shared by
+    ``tools/loadgen.py``, ``tools/obs_report.py --slo``, and the
+    ``reval_tpu watch`` fleet-load view.  1.0 on an empty histogram
+    (no observations = nothing violated)."""
+    count = hist.get("count", 0)
+    if count <= 0:
+        return 1.0
+    below = 0.0
+    lo = 0.0
+    for bound, c in hist["buckets"]:
+        if threshold >= bound:
+            below += c
+        elif threshold > lo and c:
+            below += c * (threshold - lo) / (bound - lo)
+            break
+        else:
+            break
+        lo = bound
+    # the +Inf bucket never counts below a finite threshold
+    return min(1.0, below / count)
+
+
 def snapshot_percentile(hist: dict, q: float) -> float:
     """:func:`percentile_from_buckets` applied to the SNAPSHOT encoding
     (``{"buckets": [[bound, count], ...], "inf": n, "count": n}`` — what
@@ -551,7 +718,9 @@ class MetricsRegistry:
 
     # -- registration ------------------------------------------------------
     def _get(self, name: str, cls, factory):
-        spec = METRICS.get(name)
+        # a labeled series (see :func:`labeled`) is declared by its base
+        # name; the full series string is the storage/exposition key
+        spec = METRICS.get(series_base(name))
         if spec is None and self.strict:
             raise KeyError(
                 f"metric {name!r} is not declared in obs.metrics.METRICS — "
@@ -574,7 +743,7 @@ class MetricsRegistry:
 
     def histogram(self, name: str,
                   buckets: tuple[float, ...] | None = None) -> Histogram:
-        spec = METRICS.get(name) or {}
+        spec = METRICS.get(series_base(name)) or {}
         bounds = tuple(buckets if buckets is not None
                        else spec.get("buckets", LATENCY_BUCKETS))
         if not self.enabled:
@@ -628,33 +797,48 @@ class MetricsRegistry:
                 "histograms": histograms}
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4 (no client library)."""
+        """Prometheus text exposition format 0.0.4 (no client library).
+        Labeled series render under their base metric's single
+        HELP/TYPE header (sorting keeps one base's label variants
+        adjacent — ``{`` collates after every name character)."""
         lines: list[str] = []
+        emitted: set[str] = set()
         with self._lock:
             items = sorted(self._metrics.items())
         for name, m in items:
-            spec = METRICS.get(name, {})
+            base = series_base(name)
+            labels = _series_labels(name)
+            spec = METRICS.get(base, {})
             help_text = spec.get("help", "")
             if isinstance(m, Counter):
-                lines.append(f"# HELP {name} {help_text}")
-                lines.append(f"# TYPE {name} counter")
+                if base not in emitted:
+                    emitted.add(base)
+                    lines.append(f"# HELP {base} {help_text}")
+                    lines.append(f"# TYPE {base} counter")
                 lines.append(f"{name} {_fmt(m.value)}")
             elif isinstance(m, Gauge):
-                lines.append(f"# HELP {name} {help_text}")
-                lines.append(f"# TYPE {name} gauge")
+                if base not in emitted:
+                    emitted.add(base)
+                    lines.append(f"# HELP {base} {help_text}")
+                    lines.append(f"# TYPE {base} gauge")
                 lines.append(f"{name} {_fmt(m.value)}")
             elif isinstance(m, Histogram):
-                lines.append(f"# HELP {name} {help_text}")
-                lines.append(f"# TYPE {name} histogram")
+                if base not in emitted:
+                    emitted.add(base)
+                    lines.append(f"# HELP {base} {help_text}")
+                    lines.append(f"# TYPE {base} histogram")
+                pre = f"{labels}," if labels else ""
+                suffix = f"{{{labels}}}" if labels else ""
                 counts, h_sum, h_count = m._read()
                 cum = 0
                 for bound, c in zip(m.buckets, counts):
                     cum += c
-                    lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+                    lines.append(
+                        f'{base}_bucket{{{pre}le="{_fmt(bound)}"}} {cum}')
                 cum += counts[-1]
-                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-                lines.append(f"{name}_sum {_fmt(h_sum)}")
-                lines.append(f"{name}_count {h_count}")
+                lines.append(f'{base}_bucket{{{pre}le="+Inf"}} {cum}')
+                lines.append(f"{base}_sum{suffix} {_fmt(h_sum)}")
+                lines.append(f"{base}_count{suffix} {h_count}")
         return "\n".join(lines) + "\n"
 
 

@@ -16,6 +16,7 @@ deadlines, a no-progress watchdog, a readiness (``/readyz``) vs liveness
 the zero-TPU smoke target behind ``serve --mock``.
 """
 
+from .autoscaler import Autoscaler, LocalReplicaProcess, ScalingPolicy
 from .errors import (
     DeadlineExceeded,
     Draining,
@@ -28,10 +29,12 @@ from .mock_engine import MockStepEngine
 from .router import FleetRouter
 from .server import EngineServer, serve_config, warmup_engine
 from .session import ContinuousSession, MultiSession
-from .supervisor import Supervisor
+from .supervisor import ReplicaPool, SupervisedReplica, Supervisor
 
 __all__ = ["EngineServer", "serve_config", "warmup_engine",
            "ContinuousSession", "MultiSession", "MockStepEngine",
-           "FleetRouter", "Supervisor", "ServingError", "Overloaded",
+           "FleetRouter", "Supervisor", "SupervisedReplica", "ReplicaPool",
+           "Autoscaler", "ScalingPolicy", "LocalReplicaProcess",
+           "ServingError", "Overloaded",
            "Draining", "EngineWedged", "DeadlineExceeded",
            "FleetUnavailable"]

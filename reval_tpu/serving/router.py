@@ -41,6 +41,27 @@ names each template's key for ``/statusz`` placement inspection.
 - Drain/rejoin: ``POST /admin/drain`` takes a replica out of rotation
   without touching its in-flight forwards (they complete; ``/statusz``
   shows the count draining to zero); ``POST /admin/rejoin`` restores it.
+- Runtime resize: ``POST /admin/add_replica`` / ``POST
+  /admin/remove_replica`` change the MEMBERSHIP itself — the autoscaler's
+  surface.  The hash ring is rebuilt and swapped atomically (consistent
+  hashing keeps every surviving replica's keys in place); in-flight
+  forwards hold their replica objects and complete regardless.  Every
+  admin action (drain/rejoin/resize) lands in a bounded action log that
+  ``/statusz`` exposes — the ``reval_tpu watch`` fleet view renders it
+  as the live autoscaler story.
+
+**Per-tenant QoS.**  Completion requests may carry a ``tenant`` field
+(the serving schema validates it).  With a fleet concurrency ceiling
+configured (``max_inflight`` / env ``REVAL_TPU_ROUTER_MAX_INFLIGHT``),
+admission is WEIGHTED: each tenant owns a quota proportional to its
+configured weight, spare capacity is borrowable, but the last
+``headroom`` slots below the ceiling are reserved for tenants still
+under quota — so a noisy tenant sheds (429, typed ``Overloaded``)
+before it starves the others (:func:`weighted_admission` is the pure
+math).  Per-tenant request/shed counters and a router-side e2e latency
+histogram ride the registry as ``tenant=``-labeled series; completed
+forwards also feed the goodput counters (completion within the
+request's declared ``deadline_s``).
 
 **Federation.**  ``GET /metrics`` scrapes every replica's exposition,
 merges by the registry rule (counters and histogram buckets SUM, gauges
@@ -66,17 +87,19 @@ import time
 import urllib.error
 import urllib.request
 import zlib
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..env import env_float, env_int
 from ..obs import metrics as obs_metrics
 from ..obs.logging import log_event
-from ..obs.metrics import MetricsRegistry, parse_prometheus
+from ..obs.metrics import MetricsRegistry, labeled, parse_prometheus
 from ..resilience.retry import retry_after_from_headers
 from .errors import FleetUnavailable, Overloaded, ServingError
 
 __all__ = ["FleetRouter", "HashRing", "affinity_key", "federate_metrics",
-           "load_affinity_table"]
+           "load_affinity_table", "parse_tenant_weights", "sanitize_tenant",
+           "weighted_admission"]
 
 #: statuses a *different* replica may be able to serve: shed (429),
 #: internal fault (500), bad gateway (502), draining/wedged (503).
@@ -155,6 +178,113 @@ def load_affinity_table(source) -> dict:
         raise ValueError(f"affinity table window_chars must be a positive "
                          f"integer, got {window!r}")
     return table
+
+
+# -- per-tenant QoS ----------------------------------------------------------
+
+_TENANT_RE = re.compile(r"[^A-Za-z0-9._-]")
+
+#: the tenant every request without (or with a garbage) ``tenant`` field
+#: accounts under — one shared bucket, never a dropped sample
+DEFAULT_TENANT = "default"
+
+#: distinct wire-minted tenant identities the router will track (metric
+#: label series are PERMANENT — a client minting a fresh tenant name per
+#: request must not grow the registry or the /metrics body without
+#: bound); configured-weight tenants always count, and everyone past the
+#: cap folds into one shared bucket — which also pools their admission
+#: quota, so minting tenants cannot dodge the weighted shed either
+TENANT_LABEL_CAP = 32
+OVERFLOW_TENANT = "other"
+
+
+def sanitize_tenant(value) -> str:
+    """The registry-safe tenant label for a wire ``tenant`` field: the
+    allowed charset only, capped, :data:`DEFAULT_TENANT` when empty or
+    not a string (wire values flow into metric label names and logs)."""
+    if not isinstance(value, str):
+        return DEFAULT_TENANT
+    return _TENANT_RE.sub("", value)[:32] or DEFAULT_TENANT
+
+
+def parse_tenant_weights(spec) -> dict[str, float]:
+    """``"alpha:3,beta:1"``, a JSON-object string, or an already-parsed
+    dict → ``{name: weight}``.  THE one parse of the tenant-weights
+    surface (the router CLI and ``tools/loadgen.py`` both call it);
+    every malformed shape — non-numeric, non-positive, or non-finite
+    weight, empty name, empty spec — raises ``ValueError`` with a
+    usage-shaped message, never a traceback mid-flag-parse."""
+    if isinstance(spec, dict):
+        items = list(spec.items())
+    else:
+        text = str(spec).strip()
+        if text.startswith(("{", "[")):     # JSON-shaped: object or bust
+            try:
+                obj = json.loads(text)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"bad tenant-weights JSON: {exc}") from None
+            if not isinstance(obj, dict):
+                raise ValueError("tenant-weights JSON must be an object")
+            items = list(obj.items())
+        else:
+            items = []
+            for part in text.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, weight = part.partition(":")
+                items.append((name.strip(), weight if weight else 1.0))
+    out: dict[str, float] = {}
+    for name, weight in items:
+        if not str(name):
+            raise ValueError("tenant-weights: empty tenant name")
+        try:
+            w = float(weight)
+        except (TypeError, ValueError):
+            raise ValueError(f"tenant-weights: weight for {name!r} must "
+                             f"be a number, got {weight!r}") from None
+        if not math.isfinite(w) or w <= 0:
+            raise ValueError(f"tenant-weights: weight for {name!r} must "
+                             f"be a finite number > 0, got {w!r}")
+        out[str(name)] = w
+    if not out:
+        raise ValueError(f"tenant-weights: no tenants in {spec!r}")
+    return out
+
+
+def weighted_admission(tenant: str, inflight: dict, weights: dict,
+                       max_inflight: int, headroom: int | None = None) -> str:
+    """The weighted-admission verdict for ONE arriving request —
+    ``"admit"``, ``"shed_tenant"`` (the tenant is past its weighted
+    share while the fleet is under pressure), or ``"shed_fleet"`` (the
+    concurrency ceiling itself is spent).  Pure math over a snapshot,
+    so the policy is unit-testable without a fleet:
+
+    - each tenant's quota is its weight share of ``max_inflight``
+      (unlisted tenants weigh 1.0), floored at one slot;
+    - spare capacity is borrowable — an over-quota tenant keeps
+      admitting while the fleet has room — EXCEPT the last ``headroom``
+      slots (default ``max(1, max_inflight // 8)``), which stay
+      reserved for tenants still under quota.  That reserve is what
+      makes a noisy tenant shed *before* it starves a quiet one.
+
+    ``max_inflight <= 0`` disables the ceiling entirely."""
+    if max_inflight <= 0:
+        return "admit"
+    if headroom is None:
+        headroom = max(1, max_inflight // 8)
+    total = sum(inflight.values())
+    if total >= max_inflight:
+        return "shed_fleet"
+    total_weight = sum(weights.values()) if weights else 0.0
+    weight = weights.get(tenant, 1.0)
+    if tenant not in weights:
+        total_weight += 1.0
+    share = weight / total_weight if total_weight > 0 else 1.0
+    quota = max(1, math.ceil(share * max_inflight))
+    if inflight.get(tenant, 0) >= quota and total >= max_inflight - headroom:
+        return "shed_tenant"
+    return "admit"
 
 
 class _Replica:
@@ -392,7 +522,9 @@ class FleetRouter:
                  window_chars: int | None = None,
                  health_interval_s: float | None = None,
                  affinity_table=None, forward_timeout_s: float = 600.0,
-                 max_body_bytes: int = 64 << 20, clock=time.monotonic):
+                 max_body_bytes: int = 64 << 20, clock=time.monotonic,
+                 tenant_weights: dict | None = None,
+                 max_inflight: int | None = None):
         self.model_id = model_id
         vnodes = vnodes if vnodes is not None else \
             env_int("REVAL_TPU_ROUTER_VNODES", 64)
@@ -407,19 +539,46 @@ class FleetRouter:
             else env_float("REVAL_TPU_ROUTER_HEALTH_INTERVAL_S", 1.0))
         self.forward_timeout_s = float(forward_timeout_s)
         self.max_body_bytes = int(max_body_bytes)
-        self.affinity: dict = {}
+        self.affinity: dict = {}    # unguarded: built once here, read-only thereafter
         if affinity_table is not None:
             table = load_affinity_table(affinity_table)
             self.window_chars = int(table["window_chars"])
             self.affinity = table
-        # unguarded: built once here, read-only thereafter (membership is
-        # fixed; per-replica mutable state lives behind each _Replica's lock)
+        # -- per-tenant QoS ------------------------------------------------
+        #: tenant -> weight for weighted admission (unlisted tenants
+        #: weigh 1.0); unguarded: built once here, read-only thereafter
+        self.tenant_weights = {sanitize_tenant(k): float(v)  # unguarded: built once here, read-only thereafter
+                               for k, v in (tenant_weights or {}).items()}
+        self.max_inflight = (max_inflight if max_inflight is not None
+                             else env_int("REVAL_TPU_ROUTER_MAX_INFLIGHT", 0))
+        self._adm_lock = threading.Lock()
+        self._tenant_inflight: dict = {}    # guarded-by: _adm_lock
+        #: tenant identities granted their own label series (weights
+        #: pre-seed it; past TENANT_LABEL_CAP → OVERFLOW_TENANT)
+        self._tenant_seen: set = set(self.tenant_weights)   # guarded-by: _adm_lock
+        #: the last 64 admin actions (drain/rejoin/resize, with the
+        #: caller's reason — the autoscaler names itself here), newest
+        #: last; the `reval_tpu watch` fleet view renders the tail
+        self._admin_log: deque = deque(maxlen=64)   # guarded-by: _adm_lock
+        # membership knobs kept for runtime resize (admin add_replica)
+        self._eject_fails = eject_fails
+        self._cooldown_s = cooldown_s
+        self._clock = clock
+        self._vnodes = vnodes
+        #: serialises membership changes; READERS never take it — they
+        #: snapshot the _replicas/_ring references, which are replaced
+        #: wholesale (never mutated in place) under this lock
+        self._resize_lock = threading.Lock()
+        # unguarded: reference swapped wholesale under _resize_lock;
+        # readers snapshot the reference (per-replica mutable state lives
+        # behind each _Replica's lock)
         self._replicas: dict[str, _Replica] = {}
         for rep in replicas:
             rid = str(rep) if ":" in str(rep) else f"127.0.0.1:{rep}"
             self._replicas[rid] = _Replica(
                 rid, f"http://{rid}", eject_fails=eject_fails,
                 cooldown_s=cooldown_s, clock=clock)
+        # unguarded: reference swapped wholesale under _resize_lock
         self._ring = HashRing(list(self._replicas), vnodes=vnodes)
         #: router-level counters/gauges, merged into the federation
         self._obs = MetricsRegistry()
@@ -516,6 +675,9 @@ class FleetRouter:
                 if path == "/admin/rejoin":
                     self._admin(rid, draining=False)
                     return
+                if path in ("/admin/add_replica", "/admin/remove_replica"):
+                    self._admin_resize(rid, add=path.endswith("add_replica"))
+                    return
                 if path != "/v1/completions":
                     self._send(404, {"error": {
                         "code": "not_found",
@@ -546,13 +708,17 @@ class FleetRouter:
                         **({"request_id": rid} if rid else {})}},
                         headers, request_id=rid)
 
-            def _admin(self, rid, *, draining: bool) -> None:
+            def _admin_body(self) -> dict:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(max(0, length)) or b"{}")
-                    target = str(req.get("replica", ""))
+                    return req if isinstance(req, dict) else {}
                 except Exception:
-                    target = ""
+                    return {}
+
+            def _admin(self, rid, *, draining: bool) -> None:
+                req = self._admin_body()
+                target = str(req.get("replica", ""))
                 rep = outer._replicas.get(target)
                 if rep is None:
                     self._send(404, {"error": {
@@ -563,7 +729,25 @@ class FleetRouter:
                 rep.set_draining(draining)
                 log_event("router.drain", replica=rep.id,
                           draining=draining)
+                outer._admin_record("drain" if draining else "rejoin",
+                                    rep.id, req.get("reason"))
                 self._send(200, {"replica": rep.snapshot()}, request_id=rid)
+
+            def _admin_resize(self, rid, *, add: bool) -> None:
+                req = self._admin_body()
+                target = str(req.get("replica", ""))
+                reason = req.get("reason")
+                try:
+                    if add:
+                        out = outer.add_replica(target, reason=reason)
+                    else:
+                        out = outer.remove_replica(target, reason=reason)
+                except ValueError as exc:
+                    self._send(400, {"error": {
+                        "code": "invalid_request", "message": str(exc)}},
+                        request_id=rid)
+                    return
+                self._send(200, out, request_id=rid)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
@@ -574,7 +758,13 @@ class FleetRouter:
         ring's clockwise walk, with READY replicas ahead of merely-alive
         ones (an unready replica would only shed or stall a request a
         ready sibling has room for)."""
-        ordered = [self._replicas[rid] for rid in self._ring.order(key)]
+        # snapshot both references; a resize may swap them between the
+        # two reads, so a ring member absent from the dict is skipped
+        # (next lookup sees the settled membership)
+        replicas = self._replicas
+        ordered = [rep for rep in (replicas.get(rid)
+                                   for rid in self._ring.order(key))
+                   if rep is not None]
         ready, rest = [], []
         for rep in ordered:
             # ONE is_ready() per replica: a readiness flip between two
@@ -600,6 +790,76 @@ class FleetRouter:
         self._obs.gauge(obs_metrics.ROUTER_REPLICAS_READY).set(
             sum(1 for r in self._replicas.values() if r.is_ready()))
 
+    # -- runtime membership (the autoscaler's surface) ----------------------
+    def _admin_record(self, action: str, replica: str,
+                      reason=None) -> None:
+        """Append one admin action to the bounded log ``/statusz``
+        exposes (the `watch` fleet view's autoscaler story)."""
+        with self._adm_lock:
+            self._admin_log.append(
+                {"ts": round(time.time(), 3), "action": action,
+                 "replica": replica,
+                 "reason": str(reason) if reason is not None else None})
+
+    def add_replica(self, endpoint: str, *, reason=None) -> dict:
+        """Join ``endpoint`` (``host:port`` or a bare port) to the ring
+        at runtime.  The membership dict and ring are REBUILT and the
+        references swapped (readers snapshot them; in-flight forwards
+        hold their replica objects either way) — consistent hashing
+        keeps every existing replica's keys in place.  Raises
+        ``ValueError`` on a malformed or duplicate endpoint."""
+        rid = str(endpoint).strip()
+        if not rid:
+            raise ValueError("add_replica needs a replica endpoint")
+        if ":" not in rid:
+            rid = f"127.0.0.1:{rid}"
+        with self._resize_lock:
+            if rid in self._replicas:
+                raise ValueError(f"replica {rid!r} is already a member")
+            replicas = dict(self._replicas)
+            replicas[rid] = _Replica(
+                rid, f"http://{rid}", eject_fails=self._eject_fails,
+                cooldown_s=self._cooldown_s, clock=self._clock)
+            # dict first, ring second: a reader holding the NEW ring must
+            # always find every member in the dict it reads next
+            self._replicas = replicas
+            self._ring = HashRing(list(replicas), vnodes=self._vnodes)
+            members = list(replicas)
+        log_event("router.resize", action="add", replica=rid,
+                  reason=reason, members=len(members))
+        self._admin_record("add_replica", rid, reason)
+        self._set_ready_gauge()
+        return {"added": rid, "members": members}
+
+    def remove_replica(self, endpoint: str, *, reason=None) -> dict:
+        """Remove ``endpoint`` from the ring at runtime.  In-flight
+        forwards to it complete (they hold the replica object); it just
+        stops being a candidate.  Refuses to remove the LAST member
+        (an empty ring routes nothing — drain the fleet instead) and
+        unknown endpoints, both ``ValueError``."""
+        rid = str(endpoint).strip()
+        if ":" not in rid and rid:
+            rid = f"127.0.0.1:{rid}"
+        with self._resize_lock:
+            if rid not in self._replicas:
+                raise ValueError(f"no such replica {rid!r}")
+            if len(self._replicas) == 1:
+                raise ValueError(
+                    "refusing to remove the last replica (an empty ring "
+                    "cannot route; drain it instead)")
+            replicas = {k: v for k, v in self._replicas.items() if k != rid}
+            # ring first, dict second: a reader holding the OLD dict may
+            # still serve the removed member this instant (harmless); a
+            # reader holding the new ring never names it
+            self._ring = HashRing(list(replicas), vnodes=self._vnodes)
+            self._replicas = replicas
+            members = list(replicas)
+        log_event("router.resize", action="remove", replica=rid,
+                  reason=reason, members=len(members))
+        self._admin_record("remove_replica", rid, reason)
+        self._set_ready_gauge()
+        return {"removed": rid, "members": members}
+
     # -- the forward path ---------------------------------------------------
     def _route_completion(self, handler, body: bytes, rid: str | None) -> None:
         self._obs.counter(obs_metrics.ROUTER_REQUESTS).add(1)
@@ -607,13 +867,76 @@ class FleetRouter:
             req = json.loads(body or b"{}")
         except Exception:
             req = {}
-        prompts = req.get("prompt", "") if isinstance(req, dict) else ""
+        if not isinstance(req, dict):
+            req = {}
+        tenant = sanitize_tenant(req.get("tenant"))
+        with self._adm_lock:
+            # cardinality bound: a fresh identity past the cap folds
+            # into the shared overflow bucket for BOTH accounting and
+            # admission (pooling its quota with every other late-comer)
+            if (tenant in self._tenant_seen
+                    or len(self._tenant_seen) < TENANT_LABEL_CAP):
+                self._tenant_seen.add(tenant)
+            else:
+                tenant = OVERFLOW_TENANT
+            verdict = weighted_admission(
+                tenant, self._tenant_inflight, self.tenant_weights,
+                self.max_inflight)
+            if verdict == "admit":
+                self._tenant_inflight[tenant] = \
+                    self._tenant_inflight.get(tenant, 0) + 1
+        self._obs.counter(labeled(obs_metrics.TENANT_REQUESTS,
+                                  tenant=tenant)).add(1)
+        if verdict != "admit":
+            self._count_shed(tenant)
+            log_event("router.shed", level="warning", request_id=rid,
+                      attempted=0, tenant=tenant,
+                      reason=f"weighted admission: {verdict}")
+            if verdict == "shed_tenant":
+                raise Overloaded(
+                    f"tenant {tenant!r} is over its weighted share of "
+                    f"the fleet's {self.max_inflight} in-flight slots")
+            raise Overloaded(
+                f"fleet concurrency ceiling of {self.max_inflight} "
+                f"in-flight forwards reached")
+        try:
+            self._forward_completion(handler, body, rid, req, tenant)
+        finally:
+            with self._adm_lock:
+                n = self._tenant_inflight.get(tenant, 1) - 1
+                if n > 0:
+                    self._tenant_inflight[tenant] = n
+                else:
+                    self._tenant_inflight.pop(tenant, None)
+
+    def _count_shed(self, tenant: str) -> None:
+        self._obs.counter(obs_metrics.ROUTER_SHEDS).add(1)
+        self._obs.counter(labeled(obs_metrics.TENANT_SHEDS,
+                                  tenant=tenant)).add(1)
+
+    def _count_completed(self, tenant: str, elapsed_s: float,
+                         deadline_s) -> None:
+        """Goodput accounting for one DELIVERED forward: within the
+        request's declared deadline (or no deadline at all) is goodput;
+        a late delivery is an SLO miss.  Sheds never reach here."""
+        self._obs.histogram(labeled(obs_metrics.TENANT_E2E,
+                                    tenant=tenant)).observe(elapsed_s)
+        if (isinstance(deadline_s, (int, float)) and deadline_s > 0
+                and elapsed_s > float(deadline_s)):
+            self._obs.counter(obs_metrics.ROUTER_SLO_MISS).add(1)
+        else:
+            self._obs.counter(obs_metrics.ROUTER_GOODPUT).add(1)
+
+    def _forward_completion(self, handler, body: bytes, rid: str | None,
+                            req: dict, tenant: str) -> None:
+        t0 = time.perf_counter()
+        prompts = req.get("prompt", "")
         first = prompts if isinstance(prompts, str) else \
             (prompts[0] if isinstance(prompts, list) and prompts
              and isinstance(prompts[0], str) else "")
         key = affinity_key(first, self.window_chars)
-        stream = bool(isinstance(req, dict) and req.get("stream"))
-        deadline_s = req.get("deadline_s") if isinstance(req, dict) else None
+        stream = bool(req.get("stream"))
+        deadline_s = req.get("deadline_s")
         timeout = (min(float(deadline_s) + 30.0, self.forward_timeout_s)
                    if isinstance(deadline_s, (int, float)) and deadline_s > 0
                    else self.forward_timeout_s)
@@ -657,6 +980,10 @@ class FleetRouter:
                 # client-shaped response (400/404/413/504): the verdict
                 # stands wherever it runs — pass it through verbatim
                 self._note(rep.release(grant, "ok"), rep)
+                if exc.code == 504:
+                    # the replica spent the request's own deadline: an
+                    # SLO miss, not a shed (the request WAS attempted)
+                    self._obs.counter(obs_metrics.ROUTER_SLO_MISS).add(1)
                 pass_headers = {}
                 if hint is not None:
                     pass_headers["Retry-After"] = str(int(math.ceil(hint)))
@@ -700,9 +1027,11 @@ class FleetRouter:
                 self._note(rep.release(grant, "fail", upstream_err), rep)
             else:
                 self._note(rep.release(grant, "ok"), rep)
+                self._count_completed(tenant, time.perf_counter() - t0,
+                                      deadline_s)
             return
         # every candidate was unavailable, saturated, or failed
-        self._obs.counter(obs_metrics.ROUTER_SHEDS).add(1)
+        self._count_shed(tenant)
         log_event("router.shed", level="warning", request_id=rid,
                   attempted=attempted, reason=last_error)
         if attempted and all_busy:
@@ -802,11 +1131,18 @@ class FleetRouter:
                 "replicas": reps}
 
     def statusz(self) -> dict:
+        with self._adm_lock:
+            admin_log = list(self._admin_log)
+            tenant_inflight = dict(self._tenant_inflight)
         out = {"router": True, "model": self.model_id,
                "window_chars": self.window_chars,
                "ring": {"members": self._ring.members,
                         "vnodes": self._ring.vnodes},
                "replicas": [r.snapshot() for r in self._replicas.values()],
+               "admin_log": admin_log,
+               "tenants": {"weights": self.tenant_weights,
+                           "max_inflight": self.max_inflight,
+                           "inflight": tenant_inflight},
                "metrics": self._obs.snapshot()}
         if self.affinity:
             placement = {}

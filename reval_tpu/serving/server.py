@@ -28,7 +28,9 @@ bodies and SSE error events carry it too.
 - ``POST /v1/completions``     → prompt (string or list), ``max_tokens``,
   ``temperature``, ``stop``, optional ``deadline_s`` (the client's
   remaining budget — the server cancels the request engine-side when it
-  expires) → ``{"choices": [{"index", "text"}]}``;
+  expires), optional ``tenant`` (accounting identity: the fleet
+  router's weighted admission and per-tenant counters key on it)
+  → ``{"choices": [{"index", "text"}]}``;
   with ``"stream": true`` → Server-Sent Events, one
   ``data: {"choices": [{"index", "text": <delta>}]}`` event per decode
   chunk and a final ``data: [DONE]`` — the protocol the reference's
@@ -185,6 +187,11 @@ def _validate_request(req: dict, max_tokens_cap: int | None) -> dict:
     if deadline_s is not None and (not _finite(deadline_s) or deadline_s <= 0):
         raise ValueError(f"'deadline_s' must be a finite number > 0, "
                          f"got {deadline_s!r}")
+    tenant = req.get("tenant")
+    if tenant is not None and not isinstance(tenant, str):
+        # accounting identity only (the router's weighted admission and
+        # per-tenant counters key on it); the engine never sees it
+        raise ValueError(f"'tenant' must be a string, got {tenant!r}")
     grammar = req.get("grammar")
     if grammar is not None:
         if not isinstance(grammar, str) or not grammar:
@@ -199,7 +206,7 @@ def _validate_request(req: dict, max_tokens_cap: int | None) -> dict:
             "max_tokens": max_tokens, "temperature": float(temperature),
             "top_k": int(top_k), "top_p": float(top_p),
             "stream": bool(req.get("stream", False)),
-            "grammar": grammar,
+            "grammar": grammar, "tenant": tenant,
             "deadline_s": float(deadline_s) if deadline_s is not None else None}
 
 

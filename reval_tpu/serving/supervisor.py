@@ -27,10 +27,22 @@ must stay stopped.
 Everything process-shaped is injectable (``spawn`` returns any object
 with ``wait() -> returncode``; clock/sleep likewise), so the whole
 state machine is unit-testable without real subprocesses.
+
+**Programmatic lifecycles.**  ``serve --supervise`` is the CLI-loop
+shape; the SLO-driven autoscaler needs to OWN replica lifecycles
+instead.  :class:`SupervisedReplica` runs one supervisor loop on a
+background thread (same sticky-failed/backoff semantics, same
+postmortem-per-death), and :class:`ReplicaPool` manages N of them
+behind a ``spawn() -> endpoint`` / ``stop(endpoint)`` API — each pool
+slot keeps its endpoint stable across respawns (the router's ring
+membership must not churn when a child crashes), and a sticky-failed
+slot is never reused: the next ``spawn()`` opens a FRESH slot, so a
+poisoned config/port cannot be re-targeted.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 
@@ -41,7 +53,7 @@ from ..obs.logging import log_event
 from ..obs.metrics import MetricsRegistry
 from ..resilience import RetryPolicy
 
-__all__ = ["Supervisor"]
+__all__ = ["Supervisor", "SupervisedReplica", "ReplicaPool"]
 
 
 class Supervisor:
@@ -134,3 +146,179 @@ class Supervisor:
                           max_deaths=self.max_deaths)
                 return 1
             self._sleep(self._retry.delay_for(rapid - 1))
+
+
+class SupervisedReplica:
+    """One :class:`Supervisor` loop on a background thread — the
+    programmatic sibling of ``serve --supervise``.
+
+    ``factory(endpoint_hint)`` returns a child handle (``wait() ->
+    returncode``, ``terminate()``, ideally ``poll()``; an ``endpoint``
+    attribute names where it serves).  The hint is the PREVIOUS spawn's
+    resolved endpoint, so a respawned child can re-bind the same port —
+    the router's ring membership stays stable across crashes.  All
+    sticky-failed/backoff/postmortem semantics are the supervisor's,
+    unchanged."""
+
+    def __init__(self, factory, *, name: str = "replica", **supervisor_kw):
+        self.name = name
+        # unguarded: written only inside the supervisor thread's spawn
+        # wrapper; stable after the first spawn (readers wait on _spawned)
+        self.endpoint: str | None = None
+        self._spawned = threading.Event()
+
+        def spawn():
+            child = factory(self.endpoint)
+            ep = getattr(child, "endpoint", None)
+            if ep:
+                self.endpoint = str(ep)
+            self._spawned.set()
+            return child
+
+        self.supervisor = Supervisor(spawn, **supervisor_kw)
+        self._thread: threading.Thread | None = None
+        self.rc: int | None = None
+
+    def start(self, timeout_s: float = 30.0) -> "SupervisedReplica":
+        """Run the supervisor loop on a daemon thread and block until
+        the FIRST child spawned (its endpoint is then known).  Raises
+        ``TimeoutError`` when the factory never produces a child."""
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=f"supervise-{self.name}")
+            self._thread.start()
+        if not self._spawned.wait(timeout_s):
+            raise TimeoutError(
+                f"{self.name}: first spawn did not complete in "
+                f"{timeout_s:.0f}s")
+        return self
+
+    def _run(self) -> None:
+        self.rc = self.supervisor.run()
+
+    @property
+    def state(self) -> str:
+        return self.supervisor.state
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, timeout_s: float = 30.0) -> int | None:
+        """Graceful stop: flag the supervisor, terminate the live child
+        (its exit-0 drain IS the stop), and join the loop.  Loops the
+        terminate because a stop can land inside a respawn-backoff
+        window — the freshly respawned child must be terminated too."""
+        self.supervisor.stop()
+        deadline = time.monotonic() + timeout_s
+        while self.alive() and time.monotonic() < deadline:
+            child = self.supervisor.child
+            if child is not None:
+                poll = getattr(child, "poll", None)
+                if poll is None or poll() is None:
+                    try:
+                        child.terminate()
+                    except OSError:
+                        pass        # already gone
+            self._thread.join(timeout=0.05)
+        if self._thread is not None and not self._thread.is_alive():
+            self._thread = None
+        return self.rc
+
+
+class ReplicaPool:
+    """N supervised replicas behind ``spawn() -> endpoint`` /
+    ``stop(endpoint)`` — the autoscaler's replica-lifecycle API.
+
+    ``factory(slot, endpoint_hint)`` builds one child for pool slot
+    ``slot`` (see :class:`SupervisedReplica` for the hint contract).
+    Slots are never reused: a sticky-failed replica keeps its slot (and
+    its postmortem trail) and the next ``spawn()`` opens a fresh one,
+    so a poisoned port/config is never re-targeted."""
+
+    def __init__(self, factory, *, postmortem_dir: str | None = None,
+                 max_deaths: int | None = None, window_s: float | None = None,
+                 base_backoff_s: float | None = None,
+                 max_backoff_s: float = 30.0, rng=None):
+        self.factory = factory
+        # unguarded: built once here, read-only thereafter
+        self._supervisor_kw = {
+            "postmortem_dir": postmortem_dir, "max_deaths": max_deaths,
+            "window_s": window_s, "base_backoff_s": base_backoff_s,
+            "max_backoff_s": max_backoff_s, "rng": rng}
+        self._lock = threading.Lock()
+        self._slots: dict = {}      # guarded-by: _lock — slot -> replica
+        self._next_slot = 0         # guarded-by: _lock
+
+    def spawn(self, timeout_s: float = 30.0) -> str:
+        """Open a fresh slot, supervise a child in it, return the
+        child's endpoint once it resolved."""
+        with self._lock:
+            slot = self._next_slot
+            self._next_slot += 1
+        rep = SupervisedReplica(
+            lambda hint, _slot=slot: self.factory(_slot, hint),
+            name=f"replica-{slot}", **self._supervisor_kw)
+        try:
+            rep.start(timeout_s)
+        except TimeoutError:
+            # the factory overran the budget, but its supervisor thread
+            # is LIVE and will finish the spawn eventually — stop it
+            # before raising, or the replica it births is invisible to
+            # endpoints()/close() forever
+            rep.stop(timeout_s)
+            raise
+        if rep.endpoint is None:
+            # an endpoint-less child is unreachable through every
+            # endpoint-keyed API here — stop it instead of leaving a
+            # supervisor thread respawning an unaddressable replica
+            rep.stop(timeout_s)
+            raise ValueError(
+                f"replica-{slot}: factory child exposes no endpoint")
+        with self._lock:
+            self._slots[slot] = rep
+        return rep.endpoint
+
+    def _by_endpoint(self, endpoint: str):
+        with self._lock:
+            for rep in self._slots.values():
+                if rep.endpoint == endpoint:
+                    return rep
+        return None
+
+    def replica(self, endpoint: str) -> SupervisedReplica | None:
+        """The supervised replica at ``endpoint`` (tests and drills
+        reach through it to the child)."""
+        return self._by_endpoint(endpoint)
+
+    def stop(self, endpoint: str, timeout_s: float = 30.0) -> None:
+        """Gracefully stop the replica at ``endpoint`` (drain-shaped:
+        terminate → exit 0 → the supervisor stays stopped)."""
+        rep = self._by_endpoint(endpoint)
+        if rep is None:
+            raise ValueError(f"no pool replica at {endpoint!r}")
+        rep.stop(timeout_s)
+
+    def endpoints(self) -> list[str]:
+        """Live (supervised, not sticky-failed, not stopped) endpoints."""
+        with self._lock:
+            reps = list(self._slots.values())
+        return [r.endpoint for r in reps
+                if r.endpoint and r.alive() and r.state == "running"]
+
+    def sticky_failed(self) -> list[str]:
+        with self._lock:
+            reps = list(self._slots.values())
+        return [r.endpoint for r in reps
+                if r.endpoint and r.state == "sticky_failed"]
+
+    def states(self) -> dict:
+        with self._lock:
+            reps = list(self._slots.values())
+        return {r.endpoint: r.state for r in reps if r.endpoint}
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        with self._lock:
+            reps = list(self._slots.values())
+        for rep in reps:
+            if rep.alive():
+                rep.stop(timeout_s)

@@ -24,10 +24,19 @@ error), fleet request rate and routing counters from the router's own
 registry, and the hash-ring/affinity placement.  The router serves no
 ``/debugz`` (it owns no engine), so that fetch is skipped.
 
+The router view is also the **fleet-load console**: a goodput row
+(arrival rate, deadline-met vs SLO-miss counters, e2e attainment
+against ``--slo-e2e`` via the shared CDF estimator), one row per tenant
+(requests, shed rate per interval, e2e p95 from the router's labeled
+histograms), and the tail of the router's admin action log — which is
+where a live autoscaler's add/drain/remove story shows up, each entry
+carrying the reason the autoscaler sent.
+
 Usage::
 
     python -m reval_tpu watch [--host H] [--port P] [--interval S]
                               [--iterations N] [--no-clear]
+                              [--slo-e2e S]
 
 ``--iterations`` bounds the refresh count (smoke tests; default:
 forever, Ctrl-C exits cleanly).
@@ -37,12 +46,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import time
 import urllib.error
 import urllib.request
 
 from .obs import metrics as obs_metrics
-from .obs.metrics import snapshot_percentile
+from .obs.metrics import snapshot_fraction_le, snapshot_percentile
+
+_TENANT_LABEL_RE = re.compile(r'\{tenant="([^"]+)"\}')
 
 __all__ = ["run_watch", "render_screen", "render_router_screen"]
 
@@ -174,12 +186,46 @@ _ROUTER_COUNTERS = (("routed", obs_metrics.ROUTER_ROUTED),
                     ("sheds", obs_metrics.ROUTER_SHEDS))
 
 
+def _tenant_names(counters: dict) -> list[str]:
+    names = set()
+    for key in counters:
+        if key.startswith(obs_metrics.TENANT_REQUESTS + "{"):
+            m = _TENANT_LABEL_RE.search(key)
+            if m:
+                names.add(m.group(1))
+    return sorted(names)
+
+
+def _merged_tenant_e2e(hists: dict) -> dict | None:
+    """All tenants' router-side e2e histograms folded into one snapshot
+    (same bounds by construction) — the fleet attainment/percentile
+    source."""
+    merged: dict | None = None
+    for key, h in hists.items():
+        if not key.startswith(obs_metrics.TENANT_E2E + "{") or not h:
+            continue
+        if merged is None:
+            merged = {"buckets": [[b, c] for b, c in h["buckets"]],
+                      "inf": h.get("inf", 0), "sum": h.get("sum", 0.0),
+                      "count": h.get("count", 0)}
+        else:
+            for row, (_, c) in zip(merged["buckets"], h["buckets"]):
+                row[1] += c
+            merged["inf"] += h.get("inf", 0)
+            merged["sum"] += h.get("sum", 0.0)
+            merged["count"] += h.get("count", 0)
+    return merged
+
+
 def render_router_screen(status: dict, prev_counters: dict | None,
-                         dt: float, target: str) -> str:
+                         dt: float, target: str,
+                         slo_e2e_s: float | None = None) -> str:
     """The federated fleet view from a router's /statusz body: the
-    router's own counters headline, one row per replica underneath."""
+    router's own counters headline, fleet-load + per-tenant + admin
+    (autoscaler) rows, one row per replica underneath."""
     metrics = status.get("metrics", {})
     counters = metrics.get("counters", {})
+    hists = metrics.get("histograms", {})
     replicas = status.get("replicas") or []
     ready_n = sum(1 for r in replicas
                   if r.get("ready") and r.get("state") == "healthy")
@@ -203,6 +249,57 @@ def render_router_screen(status: dict, prev_counters: dict | None,
                  f"  affinity_window {status.get('window_chars', '?')} chars"
                  + (f"  pinned_templates {len(affinity.get('placement') or ())}"
                     if affinity else ""))
+
+    # fleet load: goodput counters + e2e attainment from the router's
+    # labeled tenant histograms (THE shared CDF estimator)
+    goodput = int(counters.get(obs_metrics.ROUTER_GOODPUT, 0))
+    miss = int(counters.get(obs_metrics.ROUTER_SLO_MISS, 0))
+    served = goodput + miss
+    load = (f"load         goodput {goodput}  slo_miss {miss}  "
+            f"ratio {goodput / served:.3f}" if served
+            else "load         goodput 0  slo_miss 0  ratio —")
+    merged = _merged_tenant_e2e(hists)
+    if merged and merged["count"]:
+        load += (f"  e2e p95 {_fmt_s(snapshot_percentile(merged, .95))}"
+                 f"/p99 {_fmt_s(snapshot_percentile(merged, .99))}")
+        if slo_e2e_s:
+            load += (f"  attainment(e2e≤{slo_e2e_s:g}s) "
+                     f"{snapshot_fraction_le(merged, slo_e2e_s) * 100:.1f}%")
+    lines.append(load)
+
+    # per-tenant QoS: requests, shed rate over the refresh interval,
+    # router-side e2e p95
+    tenants = _tenant_names(counters)
+    for tenant in tenants:
+        req_key = f'{obs_metrics.TENANT_REQUESTS}{{tenant="{tenant}"}}'
+        shed_key = f'{obs_metrics.TENANT_SHEDS}{{tenant="{tenant}"}}'
+        e2e_key = f'{obs_metrics.TENANT_E2E}{{tenant="{tenant}"}}'
+        reqs = int(counters.get(req_key, 0))
+        sheds = int(counters.get(shed_key, 0))
+        if prev_counters is not None and dt > 0:
+            shed_rate = max(0.0, (counters.get(shed_key, 0)
+                                  - prev_counters.get(shed_key, 0)) / dt)
+            shed_txt = f"{shed_rate:.1f}/s"
+        else:
+            shed_txt = "—"
+        h = hists.get(e2e_key)
+        p95 = (_fmt_s(snapshot_percentile(h, .95))
+               if h and h.get("count") else "—")
+        lines.append(f"tenant       {tenant:<16} requests {reqs:>6}  "
+                     f"sheds {sheds:>5} ({shed_txt})  e2e p95 {p95}")
+    if not tenants:
+        lines.append("tenant       (no tenant traffic observed)")
+
+    # the admin action log tail: drains/rejoins/resizes with the
+    # caller's reason — a live autoscaler's story reads right here
+    admin_log = status.get("admin_log") or []
+    lines.append("autoscaler " + ("  (no admin actions)"
+                                  if not admin_log else ""))
+    for entry in admin_log[-4:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(entry.get("ts", 0)))
+        reason = entry.get("reason") or ""
+        lines.append(f"  {ts} {entry.get('action', '?'):<16} "
+                     f"{entry.get('replica', '?'):<18} {reason}"[:100])
 
     lines.append(f"replicas     {'id':<18} {'state':<10} {'ready':<6} "
                  f"{'inflight':>8} {'strikes':>8}  last_error")
@@ -232,6 +329,9 @@ def run_watch(argv: list[str] | None = None) -> int:
     parser.add_argument("--no-clear", action="store_true",
                         help="append screens instead of clearing (pipes, "
                              "logs, tests)")
+    parser.add_argument("--slo-e2e", type=float, default=None,
+                        help="router view: e2e SLO target seconds — the "
+                             "fleet-load row reports attainment against it")
     args = parser.parse_args(argv)
     base = f"http://{args.host}:{args.port}"
     target = f"{args.host}:{args.port}"
@@ -260,7 +360,8 @@ def run_watch(argv: list[str] | None = None) -> int:
             now = time.monotonic()
             if status.get("router"):
                 screen = render_router_screen(status, prev_counters,
-                                              now - prev_t, target)
+                                              now - prev_t, target,
+                                              slo_e2e_s=args.slo_e2e)
             else:
                 screen = render_screen(status, debug, prev_counters,
                                        now - prev_t, target)

@@ -1,0 +1,722 @@
+"""SLO-driven autoscaling + per-tenant QoS: the policy state machine,
+the weighted-admission math, the programmatic supervisor pool, runtime
+ring resize, and the headline tier-1 chaos drill — diurnal peak load ×
+replica hard-kill × live autoscaler → zero lost prompts, warming→ready
+scale-up, bounded recovery window, then 1→N→1.
+
+Host-only throughout: mock replicas behind a real router over real
+HTTP; the autoscaler is driven ONLY by the router's federated
+``/metrics`` (the acceptance contract).
+"""
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.obs.metrics import parse_prometheus
+from reval_tpu.serving import FleetRouter, serve_config
+from reval_tpu.serving.autoscaler import (Autoscaler, LocalReplicaProcess,
+                                          ScalingPolicy,
+                                          mock_replica_factory)
+from reval_tpu.serving.router import (OVERFLOW_TENANT, TENANT_LABEL_CAP,
+                                      parse_tenant_weights, sanitize_tenant,
+                                      weighted_admission)
+from reval_tpu.serving.snapshot import write_snapshot
+from reval_tpu.serving.supervisor import ReplicaPool
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+def wait_ready(router, timeout=10.0, n=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ready = router.readiness()
+        if ready["ready"] and (n is None or ready["replicas_ready"] >= n):
+            return
+        time.sleep(0.02)
+    raise AssertionError("router replicas never became ready")
+
+
+def post(port, prompt, tenant=None, max_tokens=32, deadline_s=None,
+         timeout=30):
+    body = {"prompt": prompt, "max_tokens": max_tokens}
+    if tenant is not None:
+        body["tenant"] = tenant
+    if deadline_s is not None:
+        body["deadline_s"] = deadline_s
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def samples_of(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        return parse_prometheus(r.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# ScalingPolicy: hysteresis + cooldown, pure and clock-injected
+# ---------------------------------------------------------------------------
+
+def test_policy_boundary_oscillating_signal_never_flaps():
+    clock = {"t": 0.0}
+    pol = ScalingPolicy(up_consecutive=2, down_consecutive=3,
+                        cooldown_s=10.0, clock=lambda: clock["t"])
+    # a signal bouncing across the threshold every observation: breach,
+    # deadband, breach, deadband … — neither streak ever completes
+    for i in range(20):
+        clock["t"] += 1.0
+        action, indicated, _ = pol.observe(breach=(i % 2 == 0), idle=False)
+        assert action is None and indicated is None
+    # oscillating breach/idle resets BOTH streaks the same way
+    for i in range(20):
+        clock["t"] += 1.0
+        action, indicated, _ = pol.observe(breach=(i % 2 == 0),
+                                           idle=(i % 2 == 1))
+        assert action is None and indicated is None
+
+
+def test_policy_sustained_breach_scales_and_cooldown_holds():
+    clock = {"t": 0.0}
+    pol = ScalingPolicy(up_consecutive=2, down_consecutive=3,
+                        cooldown_s=10.0, clock=lambda: clock["t"])
+    assert pol.observe(True, False)[0] is None
+    action, _, reason = pol.observe(True, False)
+    assert action == "up" and "sustained 2" in reason
+    pol.acted()
+    # acting reset the streak: the persisting breach first rebuilds it…
+    clock["t"] += 1.0
+    assert pol.observe(True, False) == (None, None, "steady")
+    # …and then the cooldown suppresses the indicated action, SAYING so
+    # (the caller counts it blocked)
+    for _ in range(4):
+        clock["t"] += 1.0
+        action, indicated, reason = pol.observe(True, False)
+        assert action is None and indicated == "up"
+        assert "cooldown" in reason
+    clock["t"] += 10.0      # cooldown lapses; streak is already deep
+    action, _, _ = pol.observe(True, False)
+    assert action == "up"
+    pol.acted()
+    # idle path mirrors: three consecutive idles → down (post cooldown)
+    clock["t"] += 100.0
+    for _ in range(2):
+        assert pol.observe(False, True)[0] is None
+    assert pol.observe(False, True)[0] == "down"
+
+
+# ---------------------------------------------------------------------------
+# Weighted admission: the pure per-tenant shed math
+# ---------------------------------------------------------------------------
+
+def test_weighted_admission_math():
+    weights = {"alpha": 3.0, "beta": 1.0}
+    # ceiling off → always admit
+    assert weighted_admission("beta", {"beta": 99}, weights, 0) == "admit"
+    # fleet full → shed regardless of share
+    assert weighted_admission("alpha", {"alpha": 6, "beta": 2},
+                              weights, 8) == "shed_fleet"
+    # quota(beta) = ceil(1/4 × 8) = 2; with the fleet past the reserved
+    # headroom (8 - 1 = 7), an over-quota tenant sheds FIRST
+    assert weighted_admission("beta", {"alpha": 5, "beta": 2},
+                              weights, 8) == "shed_tenant"
+    # …while an under-quota tenant still admits into the headroom
+    assert weighted_admission("alpha", {"alpha": 5, "beta": 2},
+                              weights, 8) == "admit"
+    # over quota but the fleet has slack → borrowable capacity
+    assert weighted_admission("beta", {"beta": 3}, weights, 8) == "admit"
+    # unknown tenants weigh 1.0: quota(ghost) = ceil(1/5 × 8) = 2, and at
+    # total 7 (past the 8−1 reserve) an over-quota unknown sheds
+    assert weighted_admission("ghost", {"alpha": 4, "beta": 1, "ghost": 2},
+                              weights, 8) == "shed_tenant"
+    # tenant label sanitation: wire garbage folds to the default bucket
+    assert sanitize_tenant('we"ird\nname!') == "weirdname"
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant(123) == "default"
+
+
+def test_parse_tenant_weights_shapes_and_errors():
+    assert parse_tenant_weights("alpha:3,beta:1") == \
+        {"alpha": 3.0, "beta": 1.0}
+    assert parse_tenant_weights("solo") == {"solo": 1.0}
+    assert parse_tenant_weights('{"alpha": 2}') == {"alpha": 2.0}
+    assert parse_tenant_weights({"alpha": 2}) == {"alpha": 2.0}
+    for bad in ("alpha:abc", '{"alpha": null}', '{"alpha": [1]}',
+                ":3", "", "alpha:0", "alpha:-1", '{"alpha"', "[1,2]"):
+        with pytest.raises(ValueError):
+            parse_tenant_weights(bad)
+    # the CLI surfaces the ValueError as a usage error, not a traceback
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, "-m", "reval_tpu", "router", "--mock", "1",
+         "--smoke", "1", "--tenant-weights", "alpha:abc"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 1
+    assert "tenant-weights" in r.stdout and "Traceback" not in r.stderr
+
+
+def test_tenant_label_cardinality_is_bounded():
+    """A client minting a fresh tenant per request must not grow the
+    registry without bound: past the cap, identities fold into the
+    shared overflow bucket (metrics AND admission quota)."""
+    srv = serve_config({"mock": True}, port=0).start()
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         health_interval_s=0.05).start()
+    try:
+        wait_ready(router)
+        n = TENANT_LABEL_CAP + 8
+        for i in range(n):
+            post(router.port, f"mint {i}", tenant=f"minted-{i:03d}",
+                 max_tokens=8)
+        counters = router.statusz()["metrics"]["counters"]
+        labels = {k for k in counters
+                  if k.startswith(obs_metrics.TENANT_REQUESTS + "{")}
+        assert len(labels) == TENANT_LABEL_CAP + 1      # cap + overflow
+        overflow_key = (f'{obs_metrics.TENANT_REQUESTS}'
+                        f'{{tenant="{OVERFLOW_TENANT}"}}')
+        assert counters[overflow_key] == n - TENANT_LABEL_CAP
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+def test_tenant_weighted_shed_end_to_end():
+    """A noisy tenant floods a ceilinged fleet: it sheds (typed 429,
+    per-tenant counter) while the quiet tenant keeps serving."""
+    srv = serve_config({"mock": True, "mock_echo": True,
+                        "mock_step_s": 0.05}, port=0).start()
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         health_interval_s=0.05, max_inflight=4,
+                         tenant_weights={"alpha": 3, "beta": 1}).start()
+    try:
+        wait_ready(router)
+        outcomes = {"beta_shed": 0, "beta_ok": 0, "alpha_ok": 0,
+                    "alpha_shed": 0}
+        lock = threading.Lock()
+
+        def flood(i):
+            try:
+                post(router.port, f"beta flood {i} " + "pad " * 40,
+                     tenant="beta", max_tokens=64)
+                with lock:
+                    outcomes["beta_ok"] += 1
+            except urllib.error.HTTPError as exc:
+                body = json.loads(exc.read())
+                assert exc.code == 429, body
+                assert body["error"]["code"] == "overloaded"
+                with lock:
+                    outcomes["beta_shed"] += 1
+
+        threads = [threading.Thread(target=flood, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)        # the flood is in flight; alpha arrives
+        try:
+            post(router.port, "alpha quiet " + "pad " * 40,
+                 tenant="alpha", max_tokens=64)
+            outcomes["alpha_ok"] += 1
+        except urllib.error.HTTPError:
+            outcomes["alpha_shed"] += 1
+        for t in threads:
+            t.join(timeout=30)
+        assert outcomes["beta_shed"] >= 1, outcomes
+        assert outcomes["alpha_ok"] == 1 and not outcomes["alpha_shed"], \
+            outcomes
+        samples = samples_of(router.port)
+        assert samples['reval_tenant_sheds_total{tenant="beta"}'] >= 1
+        assert samples['reval_tenant_requests_total{tenant="alpha"}'] == 1
+        assert samples.get('reval_tenant_sheds_total{tenant="alpha"}',
+                           0) == 0
+        # completed forwards fed the labeled e2e histogram + goodput
+        assert samples['reval_tenant_e2e_seconds_count{tenant="alpha"}'] \
+            == 1
+        assert samples[obs_metrics.ROUTER_GOODPUT] >= 1
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Runtime ring resize (admin add/remove) — in-flight forwards survive
+# ---------------------------------------------------------------------------
+
+def admin(port, route, replica, reason=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        data=json.dumps({"replica": replica, "reason": reason}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_resize_preserves_inflight_forwards_and_shifts_traffic():
+    slow = serve_config({"mock": True, "mock_echo": True,
+                         "mock_step_s": 0.1}, port=0).start()
+    fast = serve_config({"mock": True, "mock_echo": True}, port=0).start()
+    slow_id = f"127.0.0.1:{slow.port}"
+    fast_id = f"127.0.0.1:{fast.port}"
+    router = FleetRouter([slow_id], port=0, health_interval_s=0.05).start()
+    try:
+        wait_ready(router)
+        result = {}
+
+        def inflight():
+            result["out"] = post(router.port, "survive the resize",
+                                 max_tokens=64, timeout=60)
+
+        th = threading.Thread(target=inflight)
+        th.start()
+        time.sleep(0.12)        # the forward is mid-decode on `slow`
+        out = admin(router.port, "/admin/add_replica", fast_id,
+                    reason="autoscaler: test scale-up")
+        assert sorted(out["members"]) == sorted([slow_id, fast_id])
+        out = admin(router.port, "/admin/remove_replica", slow_id,
+                    reason="autoscaler: test scale-down")
+        assert out["members"] == [fast_id]
+        th.join(timeout=60)
+        # the in-flight forward to the REMOVED replica completed intact
+        assert result["out"]["choices"][0]["text"]
+        # new traffic lands on the surviving member only
+        wait_ready(router)
+        before = fast._session.engine.stats.prompts
+        post(router.port, "after the resize")
+        assert fast._session.engine.stats.prompts == before + 1
+        status = router.statusz()
+        assert status["ring"]["members"] == [fast_id]
+        actions = [(e["action"], e["replica"]) for e in status["admin_log"]]
+        assert ("add_replica", fast_id) in actions
+        assert ("remove_replica", slow_id) in actions
+    finally:
+        router.shutdown()
+        slow.shutdown()
+        fast.shutdown()
+
+
+def test_resize_rejects_duplicates_unknowns_and_last_member():
+    srv = serve_config({"mock": True}, port=0).start()
+    rid = f"127.0.0.1:{srv.port}"
+    router = FleetRouter([rid], port=0, health_interval_s=0.05).start()
+    try:
+        for route, replica in (("/admin/add_replica", rid),
+                               ("/admin/remove_replica", "127.0.0.1:59998"),
+                               ("/admin/remove_replica", rid),
+                               ("/admin/add_replica", "")):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                admin(router.port, route, replica)
+            assert err.value.code == 400
+            body = json.loads(err.value.read())
+            assert body["error"]["code"] == "invalid_request"
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ReplicaPool: programmatic spawn/stop, sticky-failed, postmortems
+# ---------------------------------------------------------------------------
+
+class FakeChild:
+    """A pool child that dies ``rc`` after ``ttl_s`` unless terminated
+    first (terminate = clean exit 0)."""
+
+    def __init__(self, endpoint, rc=1, ttl_s=0.01):
+        self.endpoint = endpoint
+        self._rc = rc
+        self._ttl = ttl_s
+        self._stop = threading.Event()
+
+    def wait(self):
+        if self._stop.wait(self._ttl):
+            return 0
+        return self._rc
+
+    def poll(self):
+        return 0 if self._stop.is_set() else None
+
+    def terminate(self):
+        self._stop.set()
+
+
+def test_pool_keeps_endpoint_across_respawns_then_goes_sticky(tmp_path):
+    spawns = []
+
+    def factory(slot, hint):
+        # the endpoint survives respawn via the hint — ring membership
+        # must not churn when a child crashes
+        endpoint = hint or f"127.0.0.1:{41000 + slot}"
+        spawns.append((slot, endpoint))
+        return FakeChild(endpoint, rc=9, ttl_s=0.01)
+
+    pool = ReplicaPool(factory, postmortem_dir=str(tmp_path),
+                       max_deaths=3, window_s=60.0, base_backoff_s=0.01)
+    endpoint = pool.spawn()
+    rep = pool.replica(endpoint)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and rep.state != "sticky_failed":
+        time.sleep(0.02)
+    assert rep.state == "sticky_failed"
+    assert rep.rc == 1
+    # every respawn re-bound the SAME endpoint
+    assert {ep for _, ep in spawns} == {endpoint}
+    assert len(spawns) == 3                     # max_deaths spawns
+    assert pool.sticky_failed() == [endpoint]
+    assert pool.endpoints() == []               # not a live target
+    # postmortem-per-death landed on disk
+    bundles = [f for f in os.listdir(tmp_path)
+               if f.startswith("postmortem-")]
+    assert bundles
+    with open(tmp_path / bundles[0]) as f:
+        assert json.load(f)["reason"] == "supervisor_child_death"
+    # a new spawn opens a FRESH slot — the sticky endpoint is never
+    # re-targeted
+    new_endpoint = pool.spawn()
+    assert new_endpoint != endpoint
+    assert spawns[-1][0] == 1                   # slot advanced
+    pool.close()
+
+
+def test_pool_graceful_stop_and_real_mock_replica_lifecycle(tmp_path):
+    pool = ReplicaPool(mock_replica_factory(), base_backoff_s=0.05,
+                       postmortem_dir=str(tmp_path))
+    endpoint = pool.spawn()
+    assert endpoint in pool.endpoints()
+    # the replica actually serves
+    port = int(endpoint.rsplit(":", 1)[1])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(f"http://{endpoint}/readyz", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.05)
+    out = post(port, "pool replica serves")
+    assert out["choices"][0]["text"]
+    # a hard kill respawns it at the SAME endpoint
+    rep = pool.replica(endpoint)
+    rep.supervisor.child.kill()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and rep.supervisor.respawns < 2:
+        time.sleep(0.02)
+    assert rep.supervisor.respawns >= 2
+    assert rep.endpoint == endpoint
+    # graceful stop: exit 0, supervisor stays stopped, endpoint retires
+    pool.stop(endpoint)
+    assert rep.state == "stopped" and rep.rc == 0
+    assert pool.endpoints() == []
+
+
+# ---------------------------------------------------------------------------
+# The autoscaler against a live mock fleet
+# ---------------------------------------------------------------------------
+
+def saturate(port, n, prompt_pad=60, max_tokens=48):
+    def one(i):
+        try:
+            post(port, f"pressure {i} " + "pad " * prompt_pad,
+                 max_tokens=max_tokens, timeout=30)
+        except urllib.error.HTTPError as exc:
+            exc.read()      # sheds are the signal, not a failure
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+
+def test_autoscaler_scales_1_to_n_to_1_driven_by_metrics_only(tmp_path):
+    pool = ReplicaPool(
+        mock_replica_factory({"max_queued_tokens": 400,
+                              "mock_step_s": 0.01}),
+        postmortem_dir=str(tmp_path), base_backoff_s=0.05)
+    ep0 = pool.spawn()
+    router = FleetRouter([ep0], port=0, health_interval_s=0.05).start()
+    asc = Autoscaler(f"127.0.0.1:{router.port}", pool, ttft_p99_s=0.05,
+                     interval_s=0.1, cooldown_s=0.5, min_replicas=1,
+                     max_replicas=2, up_consecutive=2, down_consecutive=4,
+                     drain_wait_s=5.0)
+    try:
+        wait_ready(router)
+        for _ in range(20):
+            saturate(router.port, 12)
+            if asc.step() == "up":
+                break
+        assert asc.counters()["up"] == 1, asc.counters()
+        members = router.statusz()["ring"]["members"]
+        assert len(members) == 2 and ep0 in members
+        added = next(m for m in members if m != ep0)
+        assert added in pool.endpoints()
+        # idle → (after down_consecutive quiet observations + cooldown)
+        # drain back to 1; min_replicas then pins it there
+        time.sleep(0.6)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and asc.counters()["down"] < 1:
+            asc.step()
+            time.sleep(0.05)
+        assert asc.counters()["down"] == 1, asc.counters()
+        assert router.statusz()["ring"]["members"] == [ep0]
+        assert added not in pool.endpoints()    # stopped, gracefully
+        assert pool.replica(added).rc == 0
+        # the scale-down took the graceful path: drain BEFORE remove
+        log = [e for e in router.statusz()["admin_log"]
+               if e["replica"] == added]
+        actions = [e["action"] for e in log]
+        assert actions.index("drain") < actions.index("remove_replica")
+        assert all("autoscaler" in (e["reason"] or "") for e in log)
+        # continued idling never flaps: down is indicated but blocked at
+        # min_replicas, never acted
+        for _ in range(8):
+            assert asc.step() is None
+            time.sleep(0.02)
+        assert asc.counters()["down"] == 1
+        assert len(router.statusz()["ring"]["members"]) == 1
+    finally:
+        asc.stop()
+        router.shutdown()
+        pool.close()
+
+
+def test_autoscaler_removes_sticky_failed_and_never_retargets(tmp_path):
+    """A sticky-failed pool replica leaves the ring via the reconcile
+    step, and scale-up spawns a FRESH replica instead of reusing it."""
+    live_cfg = {"mock": True, "mock_echo": True}
+    base = mock_replica_factory()
+
+    def factory(slot, hint):
+        if slot == 0:
+            return LocalReplicaProcess(live_cfg,
+                                       port=int(hint.rsplit(":", 1)[1])
+                                       if hint else 0)
+        if slot == 1:
+            return FakeChild(hint or "127.0.0.1:41999", rc=7, ttl_s=0.01)
+        return base(slot, hint)
+
+    pool = ReplicaPool(factory, postmortem_dir=str(tmp_path),
+                       max_deaths=2, window_s=60.0, base_backoff_s=0.01)
+    ep0 = pool.spawn()
+    bad = pool.spawn()      # dies into sticky_failed almost immediately
+    rep = pool.replica(bad)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and rep.state != "sticky_failed":
+        time.sleep(0.02)
+    assert rep.state == "sticky_failed"
+    router = FleetRouter([ep0, bad], port=0, health_interval_s=0.05).start()
+    asc = Autoscaler(f"127.0.0.1:{router.port}", pool, interval_s=0.1,
+                     # any observed TTFT breaches: the next saturate
+                     # round forces a deterministic scale-up
+                     ttft_p99_s=0.0001,
+                     cooldown_s=0.2, min_replicas=1, max_replicas=3,
+                     up_consecutive=1, down_consecutive=50)
+    try:
+        wait_ready(router)
+        asc.step()
+        assert bad not in router.statusz()["ring"]["members"]
+        assert any(a["action"] == "remove_sticky" for a in asc.actions)
+        # force a scale-up: the spawned replica is a fresh slot, never
+        # the sticky endpoint
+        saturate(router.port, 8, prompt_pad=200)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and asc.counters()["up"] < 1:
+            saturate(router.port, 8, prompt_pad=200)
+            asc.step()
+        members = router.statusz()["ring"]["members"]
+        assert bad not in members
+        assert len(members) == 2
+    finally:
+        asc.stop()
+        router.shutdown()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos drill: diurnal peak × hard-kill × autoscaler
+# ---------------------------------------------------------------------------
+
+def test_chaos_drill_diurnal_peak_hard_kill_autoscaler(tmp_path,
+                                                       monkeypatch):
+    """The ISSUE 14 acceptance scenario, host-only: diurnal load peaking
+    mid-run against a 1-replica mock fleet with the autoscaler live;
+    the original replica is HARD-killed mid-peak (after scale-up).
+    Asserts: zero lost prompts (complete ledger), the scale-up replica
+    booted warming→ready with zero fresh AOT compiles, the recovery
+    window is bounded, the kill really respawned through the
+    supervisor, and the fleet later drains back to one replica."""
+    from loadgen import OpenLoopRunner, build_workload, diurnal_arrivals, \
+        synthetic_tenants
+
+    aot_dir = tmp_path / "aot"
+    snap_dir = tmp_path / "snap"
+    snap_dir.mkdir()
+    monkeypatch.setenv("REVAL_TPU_AOT_CACHE_DIR", str(aot_dir))
+
+    # pre-warm the AOT cache (one throwaway engine compiles + stores the
+    # two mock programs) and pre-seed slot 1's warm-state snapshot, so
+    # the SCALE-UP replica boots the full PR-10 warm path
+    from reval_tpu.serving.mock_engine import MockStepEngine
+
+    warm = MockStepEngine()
+    assert warm.fresh_compiles == 2
+    chains = [[(17 * (i + 1) + j) % 251 for j in range(128)]
+              for i in range(3)]
+    assert write_snapshot(str(snap_dir / "r1.json"),
+                          {"prefix_chains": chains, "template_stats": {}})
+
+    made: dict[int, list] = {}
+    base = mock_replica_factory(
+        {"max_queued_tokens": 1200, "mock_step_s": 0.01},
+        per_slot={0: {"snapshot_path": str(snap_dir / "r0.json")},
+                  1: {"snapshot_path": str(snap_dir / "r1.json"),
+                      "mock_rewarm_s": 0.02}})
+
+    def factory(slot, hint):
+        proc = base(slot, hint)
+        made.setdefault(slot, []).append(proc)
+        return proc
+
+    pool = ReplicaPool(factory, postmortem_dir=str(tmp_path / "pm"),
+                       base_backoff_s=0.05, max_deaths=5, window_s=60.0)
+    ep0 = pool.spawn()
+    router = FleetRouter([ep0], port=0, health_interval_s=0.05,
+                         eject_fails=2, cooldown_s=0.3).start()
+    asc = Autoscaler(f"127.0.0.1:{router.port}", pool, ttft_p99_s=0.08,
+                     interval_s=0.15, cooldown_s=1.0, min_replicas=1,
+                     max_replicas=2, up_consecutive=2, down_consecutive=6,
+                     drain_wait_s=5.0).start()
+    killed = {}
+
+    def assassin():
+        # strike mid-peak, once the autoscaler has brought the second
+        # replica in (the fleet must absorb the loss, not just retry it)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if len(router.statusz()["ring"]["members"]) == 2:
+                proc = pool.replica(ep0).supervisor.child
+                proc.kill()
+                killed["at"] = time.monotonic()
+                return
+            time.sleep(0.02)
+
+    try:
+        wait_ready(router)
+        arrivals = diurnal_arrivals(10.0, 90.0, 3.0, random.Random(14))
+        assert len(arrivals) >= 80
+        tenants = synthetic_tenants({"alpha": 3, "beta": 1},
+                                    deadline_s=8.0, template_chars=500)
+        requests = build_workload(arrivals, tenants, random.Random(14))
+        hit = threading.Thread(target=assassin)
+        hit.start()
+        runner = OpenLoopRunner(f"127.0.0.1:{router.port}", requests,
+                                concurrency=64, slo_e2e_s=5.0,
+                                timeline_bucket_s=0.5)
+        art = runner.run()
+        hit.join(timeout=30)
+
+        # -- zero lost prompts, ledger complete ---------------------------
+        assert art["ledger_complete"] is True
+        assert art["counts"]["lost"] == 0, art["counts"]
+        assert art["goodput"]["completed"] == len(requests)
+        assert killed, "the assassin never fired — drill exercised nothing"
+
+        # -- the kill went through the supervisor: respawn at the same
+        #    endpoint, postmortem on disk --------------------------------
+        assert pool.replica(ep0).supervisor.respawns >= 2
+        assert pool.replica(ep0).endpoint == ep0
+        assert any(f.startswith("postmortem-")
+                   for f in os.listdir(tmp_path / "pm"))
+
+        # -- the autoscaler acted, from /metrics only --------------------
+        assert asc.counters()["up"] >= 1, asc.counters()
+        log = router.statusz()["admin_log"]
+        adds = [e for e in log if e["action"] == "add_replica"]
+        assert adds and all("autoscaler" in (e["reason"] or "")
+                            for e in adds)
+
+        # -- the scale-up replica served via warming→ready with ZERO
+        #    fresh AOT compiles ------------------------------------------
+        assert 1 in made, "no scale-up replica was ever spawned"
+        scale_up = made[1][0]
+        eng = scale_up.server._session.engine
+        assert eng.fresh_compiles == 0          # AOT cache hits only
+        counters = eng.stats.registry.snapshot()["counters"]
+        assert counters.get(obs_metrics.RESTART_WARM_PREFIXES, 0) \
+            == len(chains)                      # snapshot replayed
+        hists = eng.stats.registry.snapshot()["histograms"]
+        assert hists[obs_metrics.RESTART_TO_READY]["count"] >= 1
+        assert eng.stats.prompts > 0            # and it actually served
+
+        # -- SLOs hold outside a bounded recovery window ------------------
+        assert art["recovery"]["worst_bad_window_s"] <= 2.0, \
+            art["recovery"]
+        assert art["slo"]["attainment"]["e2e"] >= 0.9, art["slo"]
+        assert art["goodput"]["ratio"] >= 0.9
+
+        # -- the artifact proves the traffic was real: both tenants,
+        #    per-minute(-bucket) timeline covered -------------------------
+        assert set(art["tenants"]) == {"alpha", "beta"}
+        assert sum(r["arrivals"] for r in art["timeline"]) == len(requests)
+
+        # -- and the fleet drains back to 1 (N→1), gracefully -------------
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and asc.counters()["down"] < 1:
+            time.sleep(0.1)
+        assert asc.counters()["down"] >= 1, asc.counters()
+        assert len(router.statusz()["ring"]["members"]) == 1
+        drained = [e["action"] for e in router.statusz()["admin_log"]
+                   if e["replica"] != ep0]
+        assert drained.index("drain") < drained.index("remove_replica")
+
+        # the federated exposition still parses end to end
+        samples = samples_of(router.port)
+        assert samples[obs_metrics.ROUTER_REQUESTS] >= len(requests)
+    finally:
+        asc.stop()
+        router.shutdown()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# watch: the fleet-load view renders tenants + autoscaler actions
+# ---------------------------------------------------------------------------
+
+def test_watch_fleet_load_view_renders_tenants_and_autoscaler(capsys):
+    from reval_tpu.watch import run_watch
+
+    srv = serve_config({"mock": True, "mock_echo": True}, port=0).start()
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         health_interval_s=0.05,
+                         tenant_weights={"alpha": 3}).start()
+    try:
+        wait_ready(router)
+        post(router.port, "watch alpha " + "pad " * 30, tenant="alpha",
+             deadline_s=20)
+        post(router.port, "watch beta " + "pad " * 30, tenant="beta",
+             deadline_s=20)
+        router.add_replica("127.0.0.1:59997",
+                           reason="autoscaler: breach sustained")
+        rc = run_watch(["--port", str(router.port), "--interval", "0.01",
+                        "--iterations", "2", "--no-clear",
+                        "--slo-e2e", "5.0"])
+    finally:
+        router.shutdown()
+        srv.shutdown()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "load" in out and "goodput 2" in out
+    assert "attainment(e2e≤5s)" in out
+    assert "tenant       alpha" in out and "tenant       beta" in out
+    assert "autoscaler" in out
+    assert "add_replica" in out and "breach sustained" in out

@@ -1,0 +1,260 @@
+"""Open-loop load generator: seeded arrival processes, per-tenant
+workload mixes, the runner's complete ledger + artifact schema, and the
+``obs_report --slo`` cross-round diff.
+
+Everything host-only: the runner fires at mock ``serve --mock`` fleets
+(directly or through a router); the arrival/workload pieces are pure
+and seeded, so reproducibility is asserted bit-for-bit.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from loadgen import (  # noqa: E402
+    OpenLoopRunner, build_workload, diurnal_arrivals, diurnal_rate,
+    parse_tenant_weights, poisson_arrivals, reval_tenants,
+    synthetic_tenants)
+from reval_tpu.obs.metrics import snapshot_fraction_le  # noqa: E402
+from reval_tpu.serving import FleetRouter, serve_config  # noqa: E402
+
+
+def make_replica(port=0, **cfg):
+    base = {"mock": True, "mock_echo": True}
+    base.update(cfg)
+    return serve_config(base, port=port).start()
+
+
+def wait_ready(router, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.readiness()["ready"]:
+            return
+        time.sleep(0.02)
+    raise AssertionError("router never became ready")
+
+
+# ---------------------------------------------------------------------------
+# Arrival processes: seeded, bit-reproducible, the right shapes
+# ---------------------------------------------------------------------------
+
+def test_poisson_arrivals_bit_reproducible_and_rate_shaped():
+    a = poisson_arrivals(50.0, 4.0, random.Random(7))
+    b = poisson_arrivals(50.0, 4.0, random.Random(7))
+    assert a == b                           # bit-identical under one seed
+    assert a != poisson_arrivals(50.0, 4.0, random.Random(8))
+    assert all(0.0 <= t < 4.0 for t in a)
+    assert a == sorted(a)
+    # ~200 expected; 4 sigma ≈ 57
+    assert 120 <= len(a) <= 280, len(a)
+
+
+def test_diurnal_arrivals_bit_reproducible_with_peak_mid_run():
+    a = diurnal_arrivals(2.0, 60.0, 4.0, random.Random(3))
+    b = diurnal_arrivals(2.0, 60.0, 4.0, random.Random(3))
+    assert a == b
+    trough = sum(1 for t in a if t < 1.0)
+    peak = sum(1 for t in a if 1.5 <= t < 2.5)
+    assert peak > 2 * trough, (trough, peak)
+    # the rate curve itself: trough at 0, peak at period/2
+    assert diurnal_rate(0.0, 2.0, 60.0, 4.0) == pytest.approx(2.0)
+    assert diurnal_rate(2.0, 2.0, 60.0, 4.0) == pytest.approx(60.0)
+
+
+def test_workload_is_seeded_weighted_and_template_prefixed():
+    arrivals = poisson_arrivals(40.0, 4.0, random.Random(1))
+    tenants = synthetic_tenants(parse_tenant_weights("alpha:3,beta:1"),
+                                deadline_s=9.0, template_chars=500)
+    reqs = build_workload(arrivals, tenants, random.Random(5))
+    reqs2 = build_workload(
+        arrivals, synthetic_tenants({"alpha": 3, "beta": 1},
+                                    deadline_s=9.0, template_chars=500),
+        random.Random(5))
+    assert [(r.tenant, r.prompt) for r in reqs] == \
+        [(r.tenant, r.prompt) for r in reqs2]
+    by_tenant = {"alpha": 0, "beta": 0}
+    for r in reqs:
+        by_tenant[r.tenant] += 1
+        assert r.deadline_s == 9.0
+        # the synthetic template prefix is long enough to carry a router
+        # affinity key, and the probe suffix keeps prompts distinct
+        assert len(r.prompt) >= 500
+        assert f"probe {r.seq}" in r.prompt
+    # 3:1 mix, loosely (seeded, so this is stable for THIS seed)
+    assert by_tenant["alpha"] > 2 * by_tenant["beta"], by_tenant
+    # distinct prompts share their (tenant, task) template prefix
+    alpha_cov = [r.prompt for r in reqs
+                 if r.tenant == "alpha" and "[coverage::alpha]" in r.prompt]
+    assert len(alpha_cov) >= 2
+    assert alpha_cov[0][:400] == alpha_cov[1][:400]
+
+
+def test_reval_workload_samples_genuine_planned_prompts():
+    tenants = reval_tenants({"solo": 1.0}, dataset="humaneval",
+                            prompt_type="direct", per_task=2)
+    pools = tenants[0].pools
+    assert set(pools) == {"coverage", "path", "state", "output"}
+    for task, prompts in pools.items():
+        assert prompts and all(isinstance(p, str) and p for p in prompts)
+    reqs = build_workload([0.0, 0.1, 0.2, 0.3], tenants, random.Random(2))
+    # genuine prompts pass through verbatim (no probe suffix): replays
+    # of the same pools are exact REval request shapes
+    all_prompts = {p for prompts in pools.values() for p in prompts}
+    assert all(r.prompt in all_prompts for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# The runner: complete ledger, artifact schema, open-loop property
+# ---------------------------------------------------------------------------
+
+def test_runner_artifact_schema_and_complete_ledger():
+    srv = make_replica()
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         health_interval_s=0.05).start()
+    try:
+        wait_ready(router)
+        arrivals = poisson_arrivals(40.0, 1.0, random.Random(11))
+        tenants = synthetic_tenants({"alpha": 3, "beta": 1},
+                                    deadline_s=10.0)
+        reqs = build_workload(arrivals, tenants, random.Random(11))
+        runner = OpenLoopRunner(f"127.0.0.1:{router.port}", reqs,
+                                concurrency=32, slo_e2e_s=5.0,
+                                timeline_bucket_s=0.5)
+        art = runner.run()
+    finally:
+        router.shutdown()
+        srv.shutdown()
+    assert art["format"] == "reval-loadgen-v1"
+    assert art["ledger_complete"] is True
+    assert art["requests"] == len(reqs)
+    assert art["counts"]["lost"] == 0
+    assert art["goodput"]["good"] == len(reqs)
+    assert art["goodput"]["ratio"] == 1.0
+    assert art["slo"]["attainment"]["e2e"] == 1.0
+    assert art["slo"]["latency"]["e2e"]["p99"] >= \
+        art["slo"]["latency"]["e2e"]["p50"]
+    # fleet-side blocks came from the federated /metrics diff
+    assert art["counts"]["goodput_total"] == len(reqs)
+    assert "ttft" in art["slo"]["latency"]
+    # timeline accounting: every arrival and completion landed in a bucket
+    assert sum(row["arrivals"] for row in art["timeline"]) == len(reqs)
+    assert sum(row["completions"] for row in art["timeline"]) == len(reqs)
+    assert art["recovery"]["worst_bad_window_s"] == 0.0
+    per_tenant = art["tenants"]
+    assert set(per_tenant) == {"alpha", "beta"}
+    assert sum(t["requests"] for t in per_tenant.values()) == len(reqs)
+
+
+def test_runner_is_open_loop_under_a_slow_fleet():
+    """A fleet too slow for the offered load must yield misses/losses in
+    the artifact — never a stretched run: the arrival schedule is fixed
+    up front and the wall clock stays bounded by schedule + deadline."""
+    srv = make_replica(mock_step_s=0.2, max_queued_tokens=1)
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         health_interval_s=0.05).start()
+    try:
+        wait_ready(router)
+        arrivals = [i * 0.05 for i in range(12)]    # 20/s vs ~3/s capacity
+        tenants = synthetic_tenants({"solo": 1.0}, deadline_s=1.0,
+                                    template_chars=120)
+        reqs = build_workload(arrivals, tenants, random.Random(4))
+        runner = OpenLoopRunner(f"127.0.0.1:{router.port}", reqs,
+                                concurrency=32, timeline_bucket_s=0.5)
+        t0 = time.monotonic()
+        art = runner.run()
+        wall = time.monotonic() - t0
+    finally:
+        router.shutdown()
+        srv.shutdown()
+    # open loop: the whole run is schedule (0.55s) + deadline (1s) + slack,
+    # NOT 12 × 0.6s of serialized service time
+    assert wall < 6.0, wall
+    assert art["ledger_complete"] is True
+    assert art["requests"] == 12
+    # the slow fleet is VISIBLE: losses (deadline) and/or sheds happened,
+    # and the recovery block flags bad buckets
+    assert art["counts"]["lost"] > 0 or art["counts"]["shed_429"] > 0
+    if art["counts"]["lost"]:
+        assert art["recovery"]["bad_buckets"] > 0
+        assert art["recovery"]["worst_bad_window_s"] > 0
+
+
+def test_loadgen_cli_end_to_end(tmp_path):
+    srv = make_replica()
+    try:
+        out_path = tmp_path / "loadgen.json"
+        r = subprocess.run(
+            [sys.executable, "tools/loadgen.py",
+             "--target", f"127.0.0.1:{srv.port}",
+             "--workload", "synthetic", "--process", "diurnal",
+             "--trough-rate", "5", "--peak-rate", "30",
+             "--duration", "1.5", "--seed", "9",
+             "--tenants", "alpha:2,beta:1", "--deadline", "10",
+             "--slo-e2e", "5.0", "--timeline-bucket-s", "0.5",
+             "--out", str(out_path)],
+            capture_output=True, text=True, timeout=150, cwd=ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+        stdout_art = json.loads(r.stdout.strip().splitlines()[-1])
+        file_art = json.loads(out_path.read_text())
+        assert file_art["format"] == "reval-loadgen-v1"
+        assert file_art["seed"] == 9
+        assert file_art["process"] == "diurnal"
+        assert stdout_art["goodput"] == file_art["goodput"]
+        assert file_art["counts"]["lost"] == 0
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# obs_report --slo: cross-round diff, first regression named
+# ---------------------------------------------------------------------------
+
+def _write_round(path, ratio, e2e_att, lost=0, window=0.0):
+    art = {"format": "reval-loadgen-v1",
+           "goodput": {"ratio": ratio},
+           "slo": {"attainment": {"e2e": e2e_att}},
+           "counts": {"lost": lost},
+           "recovery": {"worst_bad_window_s": window}}
+    with open(path, "w") as f:
+        json.dump(art, f)
+
+
+def test_obs_report_slo_names_first_regressed_round(tmp_path):
+    paths = [str(tmp_path / f"r{i}.json") for i in range(4)]
+    _write_round(paths[0], 0.99, 0.99)
+    _write_round(paths[1], 0.995, 1.0)
+    _write_round(paths[2], 0.90, 0.93, lost=3, window=2.5)   # regression
+    _write_round(paths[3], 0.91, 0.94)
+    r = subprocess.run(
+        [sys.executable, "tools/obs_report.py", "--slo", *paths],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "first regression: r2.json" in r.stdout
+    assert "goodput" in r.stdout and "e2e" in r.stdout
+    assert "r3.json" in r.stdout
+    # clean trajectory: no regression named
+    r2 = subprocess.run(
+        [sys.executable, "tools/obs_report.py", "--slo",
+         paths[0], paths[1]],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r2.returncode == 0
+    assert "no goodput/attainment regression" in r2.stdout
+
+
+def test_snapshot_fraction_le_matches_bucket_model():
+    hist = {"buckets": [[0.1, 2], [0.5, 2], [1.0, 0]], "inf": 1,
+            "count": 5}
+    assert snapshot_fraction_le(hist, 0.1) == pytest.approx(0.4)
+    assert snapshot_fraction_le(hist, 0.5) == pytest.approx(0.8)
+    # interpolated inside the (0.1, 0.5] bucket
+    assert snapshot_fraction_le(hist, 0.3) == pytest.approx(0.6)
+    assert snapshot_fraction_le(hist, 100.0) == pytest.approx(0.8)
+    assert snapshot_fraction_le({"buckets": [], "count": 0}, 1.0) == 1.0

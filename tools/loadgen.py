@@ -1,0 +1,625 @@
+#!/usr/bin/env python3
+"""Open-loop fleet load generator: realistic traffic against the router.
+
+Every chaos drill before this one fed the fleet a handful of hand-fed
+prompts; this tool replays REval-shaped traffic at
+thousands-of-users scale the way the serving studies measure it
+(PAPERS.md, arxiv 2511.17593): **open loop**.  Arrival times are drawn
+up front from a seeded process — Poisson, or a diurnal curve with the
+peak mid-run — and every request fires AT its arrival time regardless
+of how the fleet is doing.  A slow fleet therefore shows up as missed
+deadlines and shed requests (the honest signal), never as a generator
+that politely slowed down (the closed-loop lie).  The concurrency
+ceiling (``REVAL_TPU_LOADGEN_CONCURRENCY``) bounds client sockets;
+arrivals past it queue client-side with their wait counted against
+their own latency, never re-timed.
+
+**Workload.**  Requests carry per-tenant mixes: each tenant has a
+weight (its share of arrivals), a deadline, and a per-task prompt pool.
+``--workload reval`` samples GENUINE planned prompts per REval
+dataset×prompt_type task (``tools/prefix_stats.py``'s mock planning —
+the same few-shot templates the scoring pipeline sends), so requests
+ride the router's prefix-affinity keys and exercise cache-warm routing;
+``--workload synthetic`` builds long per-(tenant, task) template
+prefixes with unique probe suffixes — same routing shape, zero planning
+cost (the tier-1 drills use it).  Same seed → bit-identical schedule
+AND prompt stream.
+
+**Artifact** (``reval-loadgen-v1``, one JSON object; ``--out`` writes
+it, stdout always carries it):
+
+- ``goodput``: completions that met their own deadline, as counts and a
+  ratio over ALL generated requests (a lost prompt is goodput's
+  denominator too);
+- ``slo``: declared targets, attainment (fraction of completions within
+  each target), and client-side e2e percentiles next to the fleet-side
+  TTFT/TPOT percentiles diffed from the router's federated ``/metrics``
+  over exactly this run;
+- ``counts``: shed (429) observations, failovers/ejections (router
+  counter deltas), transport retries, lost prompts (retry/deadline
+  budget exhausted — each also logs ``loadgen.lost``);
+- ``timeline``: per-bucket arrivals/completions/good/sheds/lost plus
+  ``worst_bad_window_s`` — the longest consecutive stretch of buckets
+  containing a miss or loss, i.e. the recovery window the chaos drill
+  bounds;
+- ``tenants``: the same accounting per tenant.
+
+Usage::
+
+    python tools/loadgen.py --target 127.0.0.1:3100 --process diurnal \
+        --trough-rate 5 --peak-rate 50 --duration 60 --seed 7 \
+        --tenants alpha:3,beta:1 --slo-e2e 2.0 --out loadgen.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reval_tpu.env import env_int  # noqa: E402
+from reval_tpu.obs import metrics as obs_metrics  # noqa: E402
+from reval_tpu.obs.logging import log_event  # noqa: E402
+from reval_tpu.obs.metrics import (  # noqa: E402
+    parse_prometheus, scrape_delta_histogram, snapshot_fraction_le)
+from reval_tpu.resilience.retry import (  # noqa: E402
+    RetryPolicy, retryable_error)
+from reval_tpu.serving.autoscaler import p99_from_scrapes  # noqa: E402
+from reval_tpu.serving.router import parse_tenant_weights  # noqa: E402,F401
+# (re-exported: the tenant-weights grammar is THE router's, parsed once)
+
+FORMAT = "reval-loadgen-v1"
+
+TASKS = ("coverage", "path", "state", "output")
+
+
+# ---------------------------------------------------------------------------
+# Arrival processes (seeded, bit-reproducible)
+# ---------------------------------------------------------------------------
+
+def poisson_arrivals(rate_per_s: float, duration_s: float,
+                     rng: random.Random) -> list[float]:
+    """Homogeneous Poisson arrival offsets in ``[0, duration_s)`` —
+    exponential inter-arrivals, exactly as many as the process yields."""
+    out: list[float] = []
+    t = 0.0
+    if rate_per_s <= 0:
+        return out
+    while True:
+        t += rng.expovariate(rate_per_s)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def diurnal_rate(t: float, trough_per_s: float, peak_per_s: float,
+                 period_s: float) -> float:
+    """The instantaneous diurnal rate: a raised-cosine day with the
+    trough at t=0 and the peak at ``period_s / 2`` (one default period
+    = one run = the peak lands mid-run, where the drill strikes)."""
+    phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+    return trough_per_s + (peak_per_s - trough_per_s) * phase
+
+
+def diurnal_arrivals(trough_per_s: float, peak_per_s: float,
+                     duration_s: float, rng: random.Random,
+                     period_s: float | None = None) -> list[float]:
+    """Inhomogeneous Poisson arrivals under :func:`diurnal_rate`, by
+    thinning against the peak envelope — seeded and bit-reproducible."""
+    period = period_s if period_s else duration_s
+    peak = max(peak_per_s, trough_per_s, 1e-9)
+    out: list[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            return out
+        if rng.random() * peak <= diurnal_rate(t, trough_per_s,
+                                               peak_per_s, period):
+            out.append(t)
+
+
+# ---------------------------------------------------------------------------
+# Workload: per-tenant request mixes
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TenantSpec:
+    """One tenant's mix: a weight (share of arrivals), an SLO deadline,
+    and a per-task prompt pool.  ``probe_suffix`` appends a unique
+    probe tail per request (synthetic pools are single templates — the
+    suffix keeps prompts distinct while the template prefix still
+    carries the router affinity key)."""
+
+    name: str
+    weight: float = 1.0
+    deadline_s: float = 30.0
+    max_tokens: int = 48
+    pools: dict = field(default_factory=dict)   # task -> [prompt, ...]
+    probe_suffix: bool = True
+
+
+@dataclass
+class PlannedRequest:
+    at_s: float
+    tenant: str
+    prompt: str
+    deadline_s: float
+    max_tokens: int
+    seq: int
+
+
+def synthetic_tenants(weights: dict[str, float], *,
+                      deadline_s: float = 30.0, max_tokens: int = 48,
+                      template_chars: int = 600) -> list[TenantSpec]:
+    """Synthetic per-(tenant, task) few-shot templates: long shared
+    prefixes (well past any affinity window) so consistent-hash routing
+    and replica prefix caches are exercised without REval planning."""
+    tenants = []
+    for name, weight in weights.items():
+        pools = {}
+        for task in TASKS:
+            unit = f"[{task}::{name}] few-shot exemplar | "
+            reps = max(1, math.ceil(template_chars / len(unit)))
+            pools[task] = [unit * reps]
+        tenants.append(TenantSpec(name=name, weight=float(weight),
+                                  deadline_s=deadline_s,
+                                  max_tokens=max_tokens, pools=pools))
+    return tenants
+
+
+def reval_tenants(weights: dict[str, float], *, dataset: str = "humaneval",
+                  prompt_type: str = "direct", per_task: int = 4,
+                  deadline_s: float = 30.0,
+                  max_tokens: int = 48) -> list[TenantSpec]:
+    """GENUINE REval dataset×prompt_type request shapes: every tenant
+    samples the same mock-planned prompt pools ``tools/prefix_stats.py``
+    measures (and whose affinity table seeds the router), so loadgen
+    traffic rides the exact template prefixes production scoring
+    sends."""
+    from prefix_stats import task_prompts
+
+    pools = {task: task_prompts(task, per_task, dataset, prompt_type)
+             for task in TASKS}
+    return [TenantSpec(name=name, weight=float(weight),
+                       deadline_s=deadline_s, max_tokens=max_tokens,
+                       pools=dict(pools), probe_suffix=False)
+            for name, weight in weights.items()]
+
+
+def build_workload(arrivals: list[float], tenants: list[TenantSpec],
+                   rng: random.Random) -> list[PlannedRequest]:
+    """Assign each arrival a tenant (weighted), a task, and a prompt —
+    all drawn from ``rng``, so one seed fixes the whole request
+    stream."""
+    if not tenants:
+        raise ValueError("at least one tenant is required")
+    total_w = sum(t.weight for t in tenants)
+    out: list[PlannedRequest] = []
+    for seq, at_s in enumerate(arrivals):
+        pick = rng.random() * total_w
+        acc = 0.0
+        tenant = tenants[-1]
+        for t in tenants:
+            acc += t.weight
+            if pick <= acc:
+                tenant = t
+                break
+        task = rng.choice(sorted(tenant.pools))
+        prompt = rng.choice(tenant.pools[task])
+        if tenant.probe_suffix:
+            prompt = f"{prompt}probe {seq} of {tenant.name}"
+        out.append(PlannedRequest(at_s=at_s, tenant=tenant.name,
+                                  prompt=prompt,
+                                  deadline_s=tenant.deadline_s,
+                                  max_tokens=tenant.max_tokens, seq=seq))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The open-loop runner
+# ---------------------------------------------------------------------------
+
+class OpenLoopRunner:
+    """Fire a planned request stream at its arrival times against one
+    ``/v1/completions`` endpoint (router or single server) and account
+    every request to a terminal outcome — ``completed`` (with its
+    deadline verdict) or ``lost`` (retry/deadline budget exhausted).
+    The ledger is complete by construction: the artifact refuses to
+    render until every scheduled arrival has an outcome."""
+
+    def __init__(self, target: str, requests: list[PlannedRequest], *,
+                 concurrency: int | None = None,
+                 slo_e2e_s: float | None = None,
+                 slo_ttft_s: float | None = None,
+                 slo_tpot_s: float | None = None,
+                 timeline_bucket_s: float = 1.0,
+                 retry: RetryPolicy | None = None):
+        self.target = target if ":" in str(target) else f"127.0.0.1:{target}"
+        self.base_url = f"http://{self.target}"
+        self.requests = sorted(requests, key=lambda r: (r.at_s, r.seq))
+        concurrency = (concurrency if concurrency is not None
+                       else env_int("REVAL_TPU_LOADGEN_CONCURRENCY", 256))
+        self._gate = threading.Semaphore(max(1, int(concurrency)))
+        self.concurrency = max(1, int(concurrency))
+        self.slo = {"e2e_s": slo_e2e_s, "ttft_s": slo_ttft_s,
+                    "tpot_s": slo_tpot_s}
+        self.timeline_bucket_s = float(timeline_bucket_s)
+        self._retry = retry or RetryPolicy(max_attempts=64, base_delay=0.05,
+                                           max_delay=1.0, jitter=0.25)
+        self._lock = threading.Lock()
+        self._records: list[dict] = []      # guarded-by: _lock
+        self._sheds = 0                     # guarded-by: _lock
+        self._retries = 0                   # guarded-by: _lock
+
+    # -- one request's lifecycle -------------------------------------------
+    def _post_once(self, req: PlannedRequest, remaining_s: float) -> str:
+        body = json.dumps({
+            "prompt": req.prompt, "max_tokens": req.max_tokens,
+            "temperature": 0.0, "tenant": req.tenant,
+            "deadline_s": round(max(0.05, remaining_s), 3)}).encode()
+        http_req = urllib.request.Request(
+            self.base_url + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": f"loadgen-{req.seq}"})
+        with urllib.request.urlopen(http_req,
+                                    timeout=max(1.0, remaining_s + 5)) as r:
+            json.loads(r.read())
+        return "ok"
+
+    def _fire(self, req: PlannedRequest, t0: float) -> None:
+        sched = t0 + req.at_s
+        deadline = sched + req.deadline_s
+        attempts = 0
+        sheds = 0
+        outcome = "lost"
+        reason = None
+        with self._gate:
+            while True:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    reason = reason or "deadline before attempt"
+                    break
+                attempts += 1
+                try:
+                    self._post_once(req, remaining)
+                    outcome = "completed"
+                    break
+                except urllib.error.HTTPError as exc:
+                    exc.read()
+                    if exc.code == 429:
+                        sheds += 1
+                    if exc.code == 504:
+                        reason = "deadline_exceeded (504)"
+                        break
+                    if not retryable_error(exc):
+                        reason = f"HTTP {exc.code}"
+                        break
+                    delay = self._retry.delay_for(min(attempts - 1, 8), exc)
+                except Exception as exc:   # noqa: BLE001 — transport death
+                    # during a replica kill is the drill's normal weather
+                    if not retryable_error(exc):
+                        reason = repr(exc)
+                        break
+                    delay = self._retry.delay_for(min(attempts - 1, 8))
+                time.sleep(min(delay, max(0.0, deadline
+                                          - time.perf_counter())))
+        done = time.perf_counter()
+        e2e = done - sched
+        rec = {"seq": req.seq, "tenant": req.tenant, "at_s": req.at_s,
+               "outcome": outcome, "e2e_s": round(e2e, 4),
+               "good": outcome == "completed" and e2e <= req.deadline_s,
+               "attempts": attempts, "sheds": sheds,
+               "done_at_s": round(done - t0, 4)}
+        if outcome != "completed":
+            rec["reason"] = reason
+            log_event("loadgen.lost", level="warning", seq=req.seq,
+                      tenant=req.tenant, attempts=attempts, reason=reason)
+        with self._lock:
+            self._records.append(rec)
+            self._sheds += sheds
+            self._retries += max(0, attempts - 1)
+
+    # -- the run ------------------------------------------------------------
+    def _scrape(self) -> dict | None:
+        try:
+            with urllib.request.urlopen(self.base_url + "/metrics",
+                                        timeout=10) as r:
+                return parse_prometheus(r.read().decode())
+        except Exception:   # noqa: BLE001 — a single server without
+            # /metrics federation still gets the client-side artifact
+            return None
+
+    def run(self) -> dict:
+        log_event("loadgen.start", target=self.target,
+                  requests=len(self.requests),
+                  concurrency=self.concurrency)
+        before = self._scrape()
+        t0 = time.perf_counter()
+        threads = []
+        for req in self.requests:
+            wait = t0 + req.at_s - time.perf_counter()
+            if wait > 0:
+                # the dispatcher sleeps to the ARRIVAL schedule only —
+                # completions never push arrivals (open loop)
+                time.sleep(wait)
+            th = threading.Thread(target=self._fire, args=(req, t0),
+                                  daemon=True, name=f"loadgen-{req.seq}")
+            th.start()
+            threads.append(th)
+        # every worker self-terminates at its own deadline; the join
+        # bound derives from the LATEST one (+ slack for a final retry
+        # sleep/socket timeout), never a fixed constant a user-supplied
+        # --deadline could legitimately exceed
+        join_until = t0 + max(r.at_s + r.deadline_s
+                              for r in self.requests) + 60.0
+        for th in threads:
+            th.join(timeout=max(0.1, join_until - time.perf_counter()))
+        after = self._scrape()
+        artifact = self._artifact(before, after,
+                                  time.perf_counter() - t0)
+        log_event("loadgen.done", target=self.target,
+                  requests=len(self.requests),
+                  lost=artifact["counts"]["lost"],
+                  goodput_ratio=artifact["goodput"]["ratio"])
+        return artifact
+
+    # -- artifact assembly --------------------------------------------------
+    @staticmethod
+    def _pctl(sorted_vals: list[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1,
+                max(0, math.ceil(q * len(sorted_vals)) - 1))
+        return sorted_vals[i]
+
+    def _fleet_block(self, before: dict | None,
+                     after: dict | None) -> dict | None:
+        if not after:
+            return None
+
+        def delta(name: str) -> float:
+            return max(0.0, after.get(name, 0.0)
+                       - (before or {}).get(name, 0.0))
+
+        def pct(name: str) -> dict:
+            return {f"p{int(q * 100)}":
+                    round(p99_from_scrapes(after, before, name, q), 4)
+                    for q in (0.50, 0.95, 0.99)}
+
+        def frac_le(name: str, threshold: float | None) -> float | None:
+            if threshold is None:
+                return None
+            # THE shared cumulative→delta assembly + attainment estimator
+            hist = scrape_delta_histogram(after, before, name)
+            if hist is None:
+                return None
+            return round(snapshot_fraction_le(hist, threshold), 4)
+
+        return {"ttft": pct(obs_metrics.TTFT),
+                "tpot": pct(obs_metrics.TPOT),
+                "ttft_attainment": frac_le(obs_metrics.TTFT,
+                                           self.slo["ttft_s"]),
+                "tpot_attainment": frac_le(obs_metrics.TPOT,
+                                           self.slo["tpot_s"]),
+                "failovers": int(delta(obs_metrics.ROUTER_FAILOVERS)),
+                "ejections": int(delta(obs_metrics.ROUTER_EJECTIONS)),
+                "router_sheds": int(delta(obs_metrics.ROUTER_SHEDS)),
+                "goodput_total": int(delta(obs_metrics.ROUTER_GOODPUT)),
+                "slo_miss_total": int(delta(obs_metrics.ROUTER_SLO_MISS))}
+
+    def _artifact(self, before: dict | None, after: dict | None,
+                  wall_s: float) -> dict:
+        with self._lock:
+            records = sorted(self._records, key=lambda r: r["seq"])
+            sheds, retries = self._sheds, self._retries
+        if len(records) != len(self.requests):
+            # the ledger-complete invariant: every scheduled arrival gets
+            # a terminal outcome.  A worker outliving the derived join
+            # bound (a hung socket past every deadline) is recorded as
+            # LOST with an explicit reason — degrading to a truthful
+            # artifact, never a crash that discards the collected run
+            seen = {r["seq"] for r in records}
+            for req in self.requests:
+                if req.seq in seen:
+                    continue
+                log_event("loadgen.lost", level="warning", seq=req.seq,
+                          tenant=req.tenant, attempts=0,
+                          reason="worker outlived the join bound")
+                records.append({
+                    "seq": req.seq, "tenant": req.tenant,
+                    "at_s": req.at_s, "outcome": "lost",
+                    "e2e_s": round(wall_s - req.at_s, 4), "good": False,
+                    "attempts": 0, "sheds": 0,
+                    "done_at_s": round(wall_s, 4),
+                    "reason": "worker outlived the join bound"})
+            records.sort(key=lambda r: r["seq"])
+        completed = [r for r in records if r["outcome"] == "completed"]
+        good = [r for r in completed if r["good"]]
+        lost = [r for r in records if r["outcome"] != "completed"]
+        e2e_sorted = sorted(r["e2e_s"] for r in completed)
+        n = len(records)
+
+        bucket = self.timeline_bucket_s
+        n_buckets = max(1, math.ceil((max((r["done_at_s"]
+                                           for r in records), default=1.0)
+                                      + 1e-9) / bucket))
+        timeline = [{"t": round(i * bucket, 3), "arrivals": 0,
+                     "completions": 0, "good": 0, "sheds": 0, "lost": 0}
+                    for i in range(n_buckets)]
+        for r in records:
+            arr = min(n_buckets - 1, int(r["at_s"] / bucket))
+            timeline[arr]["arrivals"] += 1
+            timeline[arr]["sheds"] += r["sheds"]
+            if r["outcome"] == "completed":
+                done_b = min(n_buckets - 1, int(r["done_at_s"] / bucket))
+                timeline[done_b]["completions"] += 1
+                if r["good"]:
+                    timeline[done_b]["good"] += 1
+            else:
+                timeline[arr]["lost"] += 1
+        # a "bad" bucket saw a late completion or a lost arrival
+        bad = [(row["completions"] - row["good"]) + row["lost"] > 0
+               for row in timeline]
+        worst = cur = 0
+        for flag in bad:
+            cur = cur + 1 if flag else 0
+            worst = max(worst, cur)
+
+        per_tenant: dict[str, dict] = {}
+        for r in records:
+            row = per_tenant.setdefault(
+                r["tenant"], {"requests": 0, "completed": 0, "good": 0,
+                              "lost": 0, "sheds": 0, "e2e": []})
+            row["requests"] += 1
+            row["sheds"] += r["sheds"]
+            if r["outcome"] == "completed":
+                row["completed"] += 1
+                row["good"] += int(r["good"])
+                row["e2e"].append(r["e2e_s"])
+            else:
+                row["lost"] += 1
+        tenants_out = {}
+        for name, row in sorted(per_tenant.items()):
+            e2e = sorted(row.pop("e2e"))
+            row["e2e_p95_s"] = round(self._pctl(e2e, 0.95), 4)
+            row["goodput_ratio"] = round(row["good"]
+                                         / max(1, row["requests"]), 4)
+            row["shed_rate"] = round(row["sheds"]
+                                     / max(1, row["requests"]), 4)
+            tenants_out[name] = row
+
+        e2e_target = self.slo["e2e_s"]
+        slo_block = {
+            "targets": {k: v for k, v in self.slo.items() if v is not None},
+            "attainment": {},
+            "latency": {"e2e": {
+                "p50": round(self._pctl(e2e_sorted, 0.50), 4),
+                "p95": round(self._pctl(e2e_sorted, 0.95), 4),
+                "p99": round(self._pctl(e2e_sorted, 0.99), 4)}}}
+        if e2e_target is not None and completed:
+            slo_block["attainment"]["e2e"] = round(
+                sum(1 for r in completed if r["e2e_s"] <= e2e_target)
+                / len(completed), 4)
+        fleet = self._fleet_block(before, after)
+        if fleet:
+            slo_block["latency"]["ttft"] = fleet.pop("ttft")
+            slo_block["latency"]["tpot"] = fleet.pop("tpot")
+            for key in ("ttft", "tpot"):
+                att = fleet.pop(f"{key}_attainment")
+                if att is not None:
+                    slo_block["attainment"][key] = att
+        return {
+            "format": FORMAT, "target": self.target,
+            "requests": n, "wall_s": round(wall_s, 3),
+            "concurrency": self.concurrency,
+            "timeline_bucket_s": bucket,
+            "goodput": {"completed": len(completed), "good": len(good),
+                        "lost": len(lost),
+                        "ratio": round(len(good) / max(1, n), 4)},
+            "slo": slo_block,
+            "counts": {"shed_429": sheds, "retries": retries,
+                       "lost": len(lost), **(fleet or {})},
+            "tenants": tenants_out,
+            "timeline": timeline,
+            "recovery": {"worst_bad_window_s": round(worst * bucket, 3),
+                         "bad_buckets": sum(bad)},
+            "ledger_complete": True,
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--target", default="127.0.0.1:3100",
+                    help="router (or single server) host:port")
+    ap.add_argument("--process", choices=["poisson", "diurnal"],
+                    default="poisson")
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="poisson arrival rate, req/s")
+    ap.add_argument("--trough-rate", type=float, default=2.0,
+                    help="diurnal trough rate, req/s")
+    ap.add_argument("--peak-rate", type=float, default=20.0,
+                    help="diurnal peak rate, req/s (peak lands mid-run)")
+    ap.add_argument("--period", type=float, default=None,
+                    help="diurnal period seconds (default: the run "
+                         "duration — one cycle)")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="default env REVAL_TPU_LOADGEN_SEED or 0")
+    ap.add_argument("--tenants", default="alpha:3,beta:1",
+                    help="name:weight,... tenant mix")
+    ap.add_argument("--workload", choices=["synthetic", "reval"],
+                    default="reval",
+                    help="reval = genuine mock-planned prompts per "
+                         "dataset×prompt_type task; synthetic = long "
+                         "template prefixes, zero planning cost")
+    ap.add_argument("--dataset", default="humaneval")
+    ap.add_argument("--prompt-type", choices=["direct", "cot"],
+                    default="direct")
+    ap.add_argument("--per-task", type=int, default=4,
+                    help="reval workload: prompts sampled per task")
+    ap.add_argument("--deadline", type=float, default=30.0,
+                    help="per-request deadline seconds (the goodput bar)")
+    ap.add_argument("--max-tokens", type=int, default=48)
+    ap.add_argument("--concurrency", type=int, default=None,
+                    help="in-flight ceiling (default env "
+                         "REVAL_TPU_LOADGEN_CONCURRENCY or 256)")
+    ap.add_argument("--slo-e2e", type=float, default=None,
+                    help="e2e SLO target seconds (attainment reported)")
+    ap.add_argument("--slo-ttft", type=float, default=None)
+    ap.add_argument("--slo-tpot", type=float, default=None)
+    ap.add_argument("--timeline-bucket-s", type=float, default=1.0,
+                    help="timeline bucket width (60 = per-minute)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    seed = (args.seed if args.seed is not None
+            else env_int("REVAL_TPU_LOADGEN_SEED", 0))
+    rng = random.Random(seed)
+    if args.process == "poisson":
+        arrivals = poisson_arrivals(args.rate, args.duration, rng)
+    else:
+        arrivals = diurnal_arrivals(args.trough_rate, args.peak_rate,
+                                    args.duration, rng,
+                                    period_s=args.period)
+    weights = parse_tenant_weights(args.tenants)
+    if args.workload == "reval":
+        tenants = reval_tenants(weights, dataset=args.dataset,
+                                prompt_type=args.prompt_type,
+                                per_task=args.per_task,
+                                deadline_s=args.deadline,
+                                max_tokens=args.max_tokens)
+    else:
+        tenants = synthetic_tenants(weights, deadline_s=args.deadline,
+                                    max_tokens=args.max_tokens)
+    requests = build_workload(arrivals, tenants, rng)
+    runner = OpenLoopRunner(args.target, requests,
+                            concurrency=args.concurrency,
+                            slo_e2e_s=args.slo_e2e,
+                            slo_ttft_s=args.slo_ttft,
+                            slo_tpot_s=args.slo_tpot,
+                            timeline_bucket_s=args.timeline_bucket_s)
+    artifact = runner.run()
+    artifact["seed"] = seed
+    artifact["process"] = args.process
+    artifact["workload"] = args.workload
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+    print(json.dumps(artifact))
+    return 0 if artifact["counts"]["lost"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

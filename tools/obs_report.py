@@ -229,12 +229,96 @@ def render_speculative(paths: list[str]) -> str:
     return "\n".join(lines)
 
 
+def slo_block(path: str) -> dict | None:
+    """One artifact's goodput/SLO-attainment block: a ``tools/loadgen.py``
+    artifact (``reval-loadgen-v1`` — goodput + slo sections), or any
+    artifact (a BENCH round, say) embedding an ``"slo"`` dict with
+    ``goodput_ratio``/``attainment`` keys."""
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("not a JSON object")
+    if obj.get("format") == "reval-loadgen-v1":
+        return {"goodput_ratio": obj.get("goodput", {}).get("ratio"),
+                "attainment": obj.get("slo", {}).get("attainment", {}),
+                "lost": obj.get("counts", {}).get("lost"),
+                "worst_bad_window_s":
+                    obj.get("recovery", {}).get("worst_bad_window_s")}
+    block = obj.get("slo")
+    if isinstance(block, dict) and ("goodput_ratio" in block
+                                    or "attainment" in block):
+        return {"goodput_ratio": block.get("goodput_ratio"),
+                "attainment": block.get("attainment", {}),
+                "lost": block.get("lost"),
+                "worst_bad_window_s": block.get("worst_bad_window_s")}
+    return None
+
+
+def render_slo(paths: list[str]) -> str:
+    """Goodput / SLO-attainment trajectory across loadgen artifacts or
+    BENCH rounds (chronological order): one row per artifact, and the
+    FIRST round whose goodput ratio or any attainment metric regressed
+    named loudly — the same first-change contract as --determinism."""
+    lines = ["== goodput / SLO attainment across rounds ==", "",
+             f"{'round':<28} {'goodput':>8} {'Δ':>8} {'attainment':<28} "
+             f"{'lost':>5} {'worst_window':>12}"]
+    prev: tuple[str, dict] | None = None
+    first_regress: str | None = None
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            block = slo_block(path)
+        except (OSError, ValueError) as e:
+            lines.append(f"{name:<28} (unreadable: {type(e).__name__})")
+            continue
+        if block is None:
+            lines.append(f"{name:<28} (no slo block)")
+            continue
+        ratio = block.get("goodput_ratio")
+        att = block.get("attainment") or {}
+        att_txt = " ".join(f"{k}={v:.3f}" for k, v in sorted(att.items())
+                           if isinstance(v, (int, float))) or "—"
+        delta = ""
+        regressed = []
+        if prev is not None:
+            p = prev[1]
+            if isinstance(ratio, (int, float)) \
+                    and isinstance(p.get("goodput_ratio"), (int, float)):
+                delta = f"{ratio - p['goodput_ratio']:+.3f}"
+                if ratio < p["goodput_ratio"] - 1e-9:
+                    regressed.append("goodput")
+            for key, value in sorted((p.get("attainment") or {}).items()):
+                now = att.get(key)
+                if (isinstance(now, (int, float))
+                        and isinstance(value, (int, float))
+                        and now < value - 1e-9):
+                    regressed.append(key)
+        mark = f"  <-- regressed: {', '.join(regressed)}" if regressed else ""
+        if regressed and first_regress is None:
+            first_regress = (f"first regression: {name} "
+                             f"({', '.join(regressed)} vs "
+                             f"{os.path.basename(prev[0])})")
+        window = block.get("worst_bad_window_s")
+        lines.append(
+            f"{name:<28} "
+            f"{(f'{ratio:.3f}' if isinstance(ratio, (int, float)) else '?'):>8} "
+            f"{delta:>8} {att_txt:<28} "
+            f"{(block.get('lost') if block.get('lost') is not None else '?'):>5} "
+            f"{(f'{window:g}s' if isinstance(window, (int, float)) else '?'):>12}"
+            f"{mark}")
+        prev = (path, block)
+    lines.append("")
+    lines.append(first_regress if first_regress
+                 else "no goodput/attainment regression across these rounds")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("snapshot", nargs="+",
                     help="metrics snapshot JSON (registry snapshot, "
                          "fleet_metrics.json, or a /statusz body); with "
-                         "--determinism/--speculative: BENCH artifacts in "
+                         "--determinism/--speculative/--slo: artifacts in "
                          "chronological order")
     ap.add_argument("--determinism", action="store_true",
                     help="report reference-cell fingerprint drift across "
@@ -242,14 +326,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--speculative", action="store_true",
                     help="report speculative-decoding accept-rate deltas "
                          "across BENCH rounds instead of metric snapshots")
+    ap.add_argument("--slo", action="store_true",
+                    help="report goodput/SLO-attainment deltas across "
+                         "loadgen artifacts (or BENCH rounds embedding an "
+                         "slo block), naming the first regression")
     args = ap.parse_args(argv)
-    if args.determinism and args.speculative:
-        ap.error("--determinism and --speculative are mutually exclusive")
+    if sum((args.determinism, args.speculative, args.slo)) > 1:
+        ap.error("--determinism, --speculative, and --slo are mutually "
+                 "exclusive")
     if args.determinism:
         print(render_determinism(args.snapshot))
         return 0
     if args.speculative:
         print(render_speculative(args.snapshot))
+        return 0
+    if args.slo:
+        print(render_slo(args.snapshot))
         return 0
     if len(args.snapshot) > 2:
         ap.error("snapshot mode takes one file (render) or two (delta)")
