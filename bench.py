@@ -308,92 +308,11 @@ def acquire_chip_lock(max_wait_s: float = 1200.0, skip: bool = False):
         return None
 
 
-class StallWatchdog:
-    """Fast-exit a wedged bench (learned from the kv8s64 pass, PERF.md
-    round-5 session 2: the tunnel died 8 minutes into warmup and the
-    step burned its full 40-minute timeout against a dead chip).
-
-    Trips only when BOTH hold: zero progress for ``stall_s`` AND
-    ``probe_fails`` consecutive failed device probes (killable
-    subprocesses ``probe_gap_s`` apart — a healthy chip mid-compile
-    answers them, and a successful probe resets the failure count).
-    The caller exits promptly so the runbook's wedge-abort fires
-    minutes, not tens of minutes, later; the last inflight snapshot
-    survives as the step's .partial.json."""
-
-    def __init__(self, stall_s: float = 420.0, probe_gap_s: float = 120.0,
-                 probe_fails: int = 3, prober=None):
-        self.stall_s, self.probe_gap_s = stall_s, probe_gap_s
-        self.probe_fails = probe_fails
-        self._probe = prober if prober is not None else self._probe_device
-        self._progress = None
-        self._changed = time.monotonic()
-        self._probed = 0.0
-        self._fails = 0
-
-    @staticmethod
-    def _probe_device() -> bool:
-        from reval_tpu.env import env_str
-
-        root = os.path.dirname(os.path.abspath(__file__))
-        alive = os.path.join(root, "tpu_watch", "ALIVE")
-        probe_log = os.path.join(root, "tpu_watch", "probe.log")
-        mode = (env_str("REVAL_TPU_EXCLUSIVE_DEVICE") or "auto").lower()
-
-        def _fresh(path: str) -> bool:
-            try:
-                return time.time() - os.path.getmtime(path) < 1800.0
-            except OSError:
-                return False
-
-        # A watcher verdict only counts while the watcher is demonstrably
-        # RUNNING — freshness, not mere existence, of its marker files.
-        # probe.log accumulates forever and ALIVE is removed on a wedge,
-        # so a leftover stale probe.log from a long-dead watcher must not
-        # flip a process-exclusive setup into "watcher says wedged" and
-        # resurrect the false _exit(3) this logic exists to prevent.
-        alive_fresh = _fresh(alive)
-        watcher = alive_fresh or _fresh(probe_log)
-        if mode in ("1", "true", "on") or (mode not in ("0", "false", "off")
-                                           and not watcher):
-            # Process-exclusive device ownership (plain TPU VM libtpu
-            # lock, unlike the tunneled setup): a second jax-initializing
-            # process fails against a HEALTHY chip, so a subprocess probe
-            # would read any long zero-stat-progress window (a first
-            # compile, say) as a dead device and falsely _exit(3)
-            # (ADVICE r5).  No out-of-process health signal exists here;
-            # report healthy and leave wedge-abort to the runbook timeout.
-            return True
-        if watcher:
-            # Tunneled setup with tools/tpu_watch.sh running: its loop
-            # touches tpu_watch/ALIVE on every good probe and removes it
-            # when the tunnel wedges — that heartbeat IS the tunnel
-            # health endpoint, no second jax process needed.  A fresh
-            # probe.log with ALIVE gone/stale is the live watcher's
-            # wedged verdict.
-            return alive_fresh
-        # explicit tunneled/shared mode with no live watcher: the
-        # tunneled runtime tolerates a second client — subprocess probe
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
-                capture_output=True, timeout=45)
-            return r.returncode == 0
-        except subprocess.TimeoutExpired:
-            return False
-
-    def stalled_and_dead(self, progress) -> bool:
-        now = time.monotonic()
-        if progress != self._progress:
-            self._progress, self._changed, self._fails = progress, now, 0
-            return False
-        if (now - self._changed < self.stall_s
-                or now - self._probed < self.probe_gap_s):
-            return False
-        self._probed = now
-        self._fails = 0 if self._probe() else self._fails + 1
-        return self._fails >= self.probe_fails
+# StallWatchdog moved to the resilience layer so the kernel-CI harness
+# (reval_tpu/kernelbench.py) arms one PER CELL while the bench keeps its
+# per-round instance — one implementation, re-exported here for the
+# historical bench.StallWatchdog callers (tests, tools).
+from reval_tpu.resilience.watchdog import StallWatchdog  # noqa: E402
 
 
 def fail(metric: str, error: str, detail: str = "") -> None:
@@ -796,6 +715,12 @@ def main() -> None:
                          "greedy fingerprint recorded so BENCH history "
                          "detects silent cross-commit drift — "
                          "obs/determinism.py)")
+    ap.add_argument("--no-aot-cache", action="store_true",
+                    help="leave REVAL_TPU_AOT_CACHE_DIR unset instead of "
+                         "defaulting it to tpu_watch/aot_cache on chip "
+                         "runs — the default makes every chip round's "
+                         "'restart' block record the real cold->warm "
+                         "compile collapse (ROADMAP item 4 remainder)")
     ap.add_argument("--no-autotune", action="store_true",
                     help="ignore tpu_watch/autotune.json — REQUIRED for "
                          "A/B candidate runs, which must measure exactly "
@@ -806,6 +731,16 @@ def main() -> None:
     if args.no_obs:
         # before any engine construction: EngineStats reads it once
         os.environ["REVAL_TPU_OBS"] = "0"
+
+    # Chip rounds persist AOT executables by default so the "restart"
+    # block measures the real cold->warm compile collapse round over
+    # round (a --tiny smoke must not seed the chip's cache with toy
+    # programs; an operator's explicit dir always wins).
+    if (not args.tiny and not args.no_aot_cache
+            and not os.environ.get("REVAL_TPU_AOT_CACHE_DIR")):
+        os.environ["REVAL_TPU_AOT_CACHE_DIR"] = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tpu_watch",
+            "aot_cache")
 
     chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
 

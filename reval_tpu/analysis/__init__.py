@@ -38,6 +38,10 @@ cannot see (acquisition ORDER, cross-thread writes at test time):
 - :mod:`.envreg`      — every ``REVAL_TPU_*`` read goes through the
   declared ``reval_tpu/env.py::ENV`` spec, round-tripped against the
   README table;
+- :mod:`.kernelbench`  — kernel-CI leaderboard artifacts
+  (``kernelbench-<ts>.json`` / ``KERNELBENCH_r*.json``) conform to the
+  ``reval-kernelbench-v1`` schema: complete cell matrix, stale entries
+  carry last-known value + commit, never a 0.0;
 - :mod:`.metrics_events` — the METRICS/EVENTS namespace checks that
   previously lived in ``tools/check_metrics.py``, migrated into the
   same pass framework (one driver, one report format);

@@ -40,7 +40,7 @@ import time
 from dataclasses import dataclass, field
 
 from . import (detmatrix, enginezoo, envreg, errboundary, hostsync, hotpath,
-               jitreg, locks, meshreg, reshard, tilecontract)
+               jitreg, kernelbench, locks, meshreg, reshard, tilecontract)
 from .core import Suppression, Violation, collect_sources
 from .metrics_events import run_events, run_metrics
 
@@ -61,6 +61,7 @@ PASSES = {
     "metrics": run_metrics,
     "events": run_events,
     "detmatrix": detmatrix.run,
+    "kernelbench": kernelbench.run,
 }
 
 
@@ -281,7 +282,8 @@ def main(argv: list[str] | None = None) -> int:
                     "discipline, Pallas tile contracts, mesh/sharding "
                     "contracts, reshard reasoning, engine-surface "
                     "conformance, typed-error boundary, env registry, "
-                    "metric/event namespaces, determinism-matrix schema. "
+                    "metric/event namespaces, determinism-matrix schema, "
+                    "kernel-CI leaderboard schema. "
                     "Exit codes: 0 clean, 1 violations, 2 unrunnable.")
     parser.add_argument("passes", nargs="*", metavar="PASS",
                         help=f"passes to run (default: all of "

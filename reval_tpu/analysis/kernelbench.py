@@ -1,0 +1,61 @@
+"""``kernelbench`` pass: kernel-CI leaderboard artifacts conform to schema.
+
+The self-healing kernel CI's whole value is *instrument honesty*: a cell
+silently missing from a ``kernelbench-<ts>.json`` leaderboard reads as
+"nothing regressed" when it means "nobody measured", and a stale cell
+rendered as a bare number reads as a fresh measurement.  This pass
+validates every leaderboard artifact on disk against the declared schema
+(``reval_tpu/kernelbench.py::validate_leaderboard`` — ONE checker shared
+with the CLI's pre-write self-check and the tests):
+
+- the schema version is the one this tree writes;
+- the cell matrix is COMPLETE for its tier (tiny/full): every taxonomy
+  cell appears as ``run``, ``stale``, or ``skipped`` WITH a reason —
+  never vanished, and never a 0.0 measurement;
+- stale entries carry their last-known value + the commit it was
+  measured at;
+- a declared winner is a fresh run cell and emits a loadable
+  serving-config pick.
+
+Artifacts are scanned in ``tpu_watch/`` (generated, untracked scratch)
+AND as committed ``KERNELBENCH_r*.json`` driver records at the repo root
+(which may nest the artifact under ``"parsed"``).  None on disk =
+nothing to lint (clean); an unreadable/truncated artifact IS a violation
+— a half-written leaderboard must never pass for a clean round.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from .core import Violation
+
+__all__ = ["run"]
+
+
+def run(sources, root: str) -> list[Violation]:
+    from ..kernelbench import SCHEMA, validate_leaderboard
+
+    out: list[Violation] = []
+    paths = (sorted(glob.glob(os.path.join(root, "tpu_watch",
+                                           "kernelbench-*.json")))
+             + sorted(glob.glob(os.path.join(root, "KERNELBENCH_r*.json"))))
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append(Violation("kernelbench", rel, 0,
+                                 f"unreadable leaderboard artifact: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        # driver records nest the harness's artifact under "parsed"
+        if (isinstance(obj, dict) and obj.get("schema") != SCHEMA
+                and isinstance(obj.get("parsed"), dict)):
+            obj = obj["parsed"]
+        for err in validate_leaderboard(obj):
+            out.append(Violation("kernelbench", rel, 0, err))
+    return out

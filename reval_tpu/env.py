@@ -203,6 +203,26 @@ ENV: dict[str, dict] = {
         "default": "0.5",
         "help": "scale-up SLO target: federated p99 TTFT (per "
                 "observation interval) above this breaches"},
+    # -- kernel CI / autotune leaderboard (reval_tpu/kernelbench.py) -------
+    "REVAL_TPU_KERNELBENCH_DIR": {
+        "default": "tpu_watch",
+        "help": "where kernelbench-<ts>.json leaderboard artifacts land"},
+    "REVAL_TPU_KERNELBENCH_PERTURB": {
+        "default": "",
+        "help": "chaos hook: '<cell>=<factor>' multiplies the named "
+                "cell's measured ms/step so the regression gate's exit-1 "
+                "path is drillable (tests only; the artifact is marked "
+                "perturbed and never counts as evidence)"},
+    "REVAL_TPU_KERNELBENCH_NOISE": {
+        "default": "0.15",
+        "help": "regression-gate noise band: HEAD slower than the "
+                "incumbent winner cell by more than this fraction fails "
+                "the round (exit 1, named cell)"},
+    "REVAL_TPU_DECODE_CHUNK": {
+        "default": "32",
+        "help": "paged-engine decode steps per host sync (read once at "
+                "import; the kernelbench autotune pick exports the "
+                "measured-best cadence via decided_env.sh)"},
     # -- determinism observatory (obs/determinism.py) ----------------------
     "REVAL_TPU_DETERMINISM_REF": {
         "default": "paged-xla-fp32-b2",

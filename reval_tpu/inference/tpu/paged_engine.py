@@ -83,7 +83,12 @@ __all__ = ["PagedTPUEngine"]
 
 PAGE_SIZE = 128  # KV pool page size (tokens); the engine's default
 
-CHUNK = 32  # decode steps per host sync (stop-string check cadence)
+# Decode steps per host sync (stop-string check cadence).  Historically a
+# constant 32; now an autotunable knob the kernel-CI leaderboard's chunk
+# axis measures (tools/kernelbench.py) and its serving-config pick exports
+# — read ONCE at import so every jitted chunk program binds one cadence
+# per process (a mid-run flip would recompile every decode variant).
+CHUNK = max(1, env_int("REVAL_TPU_DECODE_CHUNK", 32))
 
 # First chunk after an admission wave is short: freshly admitted DREval
 # probes often answer in a handful of tokens ([ANSWER] NO [/ANSWER]), and a
@@ -91,7 +96,7 @@ CHUNK = 32  # decode steps per host sync (stop-string check cadence)
 # run at full CHUNK — per-chunk host work (RPC dispatch + the token
 # download) measured ~100 ms on the tunneled v5e, so fine-grained chunks
 # halve decode throughput (PERF.md).
-FIRST_CHUNK = 8
+FIRST_CHUNK = min(8, CHUNK)
 
 
 def _floor_pow2(n: int) -> int:

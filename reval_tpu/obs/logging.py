@@ -80,6 +80,18 @@ EVENTS: dict[str, str] = {
                        "cannot export the program (Mosaic canary/"
                        "jax.export)",
     "aot.gc": "the AOT cache evicted LRU entries past its size bound",
+    # kernel CI harness (reval_tpu/kernelbench.py)
+    "kernelbench.cell_retry": "a kernel-CI cell attempt failed transient "
+                              "(wedge kill / timeout / device loss) and "
+                              "was retried under backoff",
+    "kernelbench.cell_stale": "a kernel-CI cell exhausted its attempts "
+                              "and degraded to a stale-marked entry "
+                              "carrying its last-known value + commit",
+    "kernelbench.regression": "the kernel-CI gate found HEAD slower than "
+                              "the incumbent winner cell beyond the "
+                              "noise band (round exits 1)",
+    "kernelbench.pick": "the kernel-CI leaderboard emitted an autotune "
+                        "serving-config pick for the winning cell",
     # serving session (serving/session.py)
     "spec.wedge": "a request's speculative drafter faulted; the row "
                   "degrades to plain decode for the rest of the request",

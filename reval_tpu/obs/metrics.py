@@ -141,6 +141,12 @@ AUTOSCALE_UP = "reval_autoscale_up_total"
 AUTOSCALE_DOWN = "reval_autoscale_down_total"
 AUTOSCALE_BLOCKED = "reval_autoscale_blocked_total"
 AUTOSCALE_REPLICAS = "reval_autoscale_replicas"
+KB_CELLS = "reval_kernelbench_cells_total"
+KB_STALE = "reval_kernelbench_cells_stale_total"
+KB_SKIPPED = "reval_kernelbench_cells_skipped_total"
+KB_RETRIES = "reval_kernelbench_cell_retries_total"
+KB_REGRESSIONS = "reval_kernelbench_regressions_total"
+KB_BEST_MS = "reval_kernelbench_best_ms"
 DET_CELLS = "reval_determinism_cells_total"
 DET_AGREE = "reval_determinism_cells_agree_total"
 DET_DIVERGED = "reval_determinism_cells_diverged_total"
@@ -393,6 +399,36 @@ METRICS: dict[str, dict] = {
                              "(single-legal states, or the canonical "
                              "token along a state's deterministic "
                              "character chain)"},
+    # kernel CI harness (reval_tpu/kernelbench.py) — one leaderboard
+    # round increments the counters once per cell; the registry
+    # snapshot rides the kernelbench-<ts>.json artifact, so instrument
+    # health (stale cells, retries, regressions) reads like any other
+    # subsystem in obs_report
+    KB_CELLS: {"type": "counter",
+               "help": "Kernel-CI cells measured fresh (a supervised "
+                       "subprocess completed and returned a positive "
+                       "ms/step)"},
+    KB_STALE: {"type": "counter",
+               "help": "Kernel-CI cells degraded to stale-marked "
+                       "entries (every attempt failed; last-known value "
+                       "+ commit carried, never a blind 0.0)"},
+    KB_SKIPPED: {"type": "counter",
+                 "help": "Kernel-CI cells skipped with a reason "
+                         "(unselected, or failed with no last-known "
+                         "value to carry)"},
+    KB_RETRIES: {"type": "counter",
+                 "help": "Kernel-CI cell attempts retried under backoff "
+                         "after a transient failure (wedge kill, "
+                         "timeout, device loss)"},
+    KB_REGRESSIONS: {"type": "counter",
+                     "help": "Kernel-CI rounds whose regression gate "
+                             "fired: HEAD slower than the incumbent "
+                             "winner cell beyond the noise band (each "
+                             "also logs kernelbench.regression and "
+                             "exits 1)"},
+    KB_BEST_MS: {"type": "gauge",
+                 "help": "Winning cell's measured ms/step, newest "
+                         "kernel-CI round (this process's view)"},
     # determinism observatory (obs/determinism.py) — one matrix run
     # increments the counters once per cell; the snapshot rides the
     # determinism-<ts>.json artifact and merges into any registry

@@ -23,20 +23,31 @@ everything with nothing written.  The pieces compose:
 - :class:`EngineStepChaos` — the server-side counterpart: deterministic
   *engine-step* faults (stalled step, mid-batch exception) injected into
   the serving session's drive loop, so the watchdog/drain/shed paths are
-  testable in the fast tier without a TPU.
+  testable in the fast tier without a TPU;
+- :class:`KernelCellChaos` — targeted per-cell faults (wedge / timeout /
+  flaky-device) for the kernel-CI harness's supervised benchmark cells,
+  so every degradation path of the perf instrument is drillable on CPU;
+- :class:`StallWatchdog` — the no-progress + failed-device-probe trip
+  wire ``bench.py`` arms per round and ``reval_tpu/kernelbench.py`` arms
+  per cell.
 """
 
-from .chaos import CHAOS_MODES, ENGINE_STEP_MODES, ChaosBackend, EngineStepChaos
+from .chaos import (CHAOS_MODES, ENGINE_STEP_MODES, KERNEL_CELL_MODES,
+                    ChaosBackend, EngineStepChaos, KernelCellChaos)
 from .checkpoint import FleetCheckpoint
 from .resilient import INFER_FAILED, ResilientBackend
 from .retry import (RetryPolicy, retry_after_from_headers, retry_after_hint,
                     retryable_error, wait_for_server)
+from .watchdog import StallWatchdog
 
 __all__ = [
     "CHAOS_MODES",
     "ENGINE_STEP_MODES",
+    "KERNEL_CELL_MODES",
     "ChaosBackend",
     "EngineStepChaos",
+    "KernelCellChaos",
+    "StallWatchdog",
     "FleetCheckpoint",
     "INFER_FAILED",
     "ResilientBackend",

@@ -32,12 +32,114 @@ import time
 import urllib.error
 import zlib
 
-__all__ = ["CHAOS_MODES", "ENGINE_STEP_MODES", "ChaosBackend",
-           "EngineStepChaos"]
+__all__ = ["CHAOS_MODES", "ENGINE_STEP_MODES", "KERNEL_CELL_MODES",
+           "ChaosBackend", "EngineStepChaos", "KernelCellChaos"]
 
 CHAOS_MODES = ("timeout", "http_500", "bad_json", "latency")
 
 ENGINE_STEP_MODES = ("stall", "error")
+
+KERNEL_CELL_MODES = ("wedge", "timeout", "flaky-device")
+
+
+class KernelCellChaos:
+    """Targeted fault injection for the kernel-CI harness
+    (``reval_tpu/kernelbench.py``) — the ``EngineStepChaos`` sibling for
+    supervised benchmark cells.  Faults are keyed on the CELL NAME (not
+    a seeded rate): a degradation drill wedges exactly the cell it
+    names, so tier-1 can assert "this cell went stale, those survived"
+    deterministically on CPU.
+
+    Modes (``--chaos-cell MODE:CELL``):
+
+    - ``wedge``: the cell child hangs before any device work and ignores
+      SIGTERM (a dead tunnel mid-dispatch); the parent's per-cell
+      StallWatchdog sees a frozen heartbeat AND failed device probes
+      (:meth:`device_probe_override` simulates the dead tunnel) and
+      kills it early — the watchdog kill path.
+    - ``timeout``: the cell keeps heart-beating but never finishes (a
+      live device running pathologically slow); only the hard per-cell
+      deadline cuts it — the budget kill path.
+    - ``flaky-device``: the first ``flaky_failures`` attempts die with a
+      transient device-loss error, later attempts run clean — the
+      RetryPolicy recovery path (cell ends ``run`` WITH retries
+      recorded).
+    """
+
+    def __init__(self, rules: dict[str, str] | None = None,
+                 flaky_failures: int = 1, sleep=time.sleep):
+        rules = dict(rules or {})
+        unknown = set(rules.values()) - set(KERNEL_CELL_MODES)
+        assert not unknown, f"unknown kernel-cell chaos modes: {sorted(unknown)}"
+        self.rules = rules
+        self.flaky_failures = int(flaky_failures)
+        self.sleep = sleep
+
+    @classmethod
+    def parse(cls, specs: list[str]) -> "KernelCellChaos":
+        """From repeated ``MODE:CELL`` CLI values; raises ``ValueError``
+        on a malformed spec (a typo'd mode must not silently run the
+        cell clean under a chaos label)."""
+        rules: dict[str, str] = {}
+        for spec in specs:
+            mode, sep, cell = spec.partition(":")
+            if not sep or not cell or mode not in KERNEL_CELL_MODES:
+                raise ValueError(
+                    f"bad --chaos-cell {spec!r}: expected MODE:CELL with "
+                    f"MODE in {KERNEL_CELL_MODES}")
+            rules[cell] = mode
+        return cls(rules)
+
+    def to_argv(self) -> list[str]:
+        """The CLI args that reproduce this schedule in a cell child."""
+        out: list[str] = []
+        for cell, mode in sorted(self.rules.items()):
+            out += ["--chaos-cell", f"{mode}:{cell}"]
+        return out
+
+    def mode_for(self, cell_name: str) -> str | None:
+        return self.rules.get(cell_name)
+
+    def device_probe_override(self, cell_name: str):
+        """A prober for the parent's per-cell StallWatchdog: a wedged
+        tunnel fails its device probes, so the wedge drill exercises the
+        real stall-AND-dead kill path; other modes keep the genuine
+        probe (None)."""
+        if self.rules.get(cell_name) == "wedge":
+            return lambda: False
+        return None
+
+    def apply_in_child(self, cell_name: str, attempt: int,
+                       heartbeat=None) -> None:
+        """Run inside the cell child BEFORE any device work.  Returns
+        normally when the cell is not targeted (or a flaky cell's retry
+        attempt); hangs forever for wedge/timeout (the parent kills);
+        raises ``ConnectionError`` for a flaky attempt."""
+        mode = self.rules.get(cell_name)
+        if mode is None:
+            return
+        if mode == "flaky-device":
+            if attempt < self.flaky_failures:
+                raise ConnectionError(
+                    f"chaos: injected transient device loss "
+                    f"(attempt {attempt})")
+            return
+        if mode == "wedge":
+            try:
+                import signal
+
+                signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            except (ValueError, OSError):
+                pass
+            while True:             # frozen heartbeat: the watchdog's food
+                self.sleep(3600.0)
+        # timeout: keep making visible progress, just never finish
+        rep = 0
+        while True:
+            if heartbeat is not None:
+                heartbeat("chaos-timeout", rep)
+            rep += 1
+            self.sleep(0.2)
 
 
 class EngineStepChaos:

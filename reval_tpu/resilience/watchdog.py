@@ -1,0 +1,107 @@
+"""StallWatchdog: fast-exit a wedged accelerator measurement.
+
+Historically lived in ``bench.py`` (learned from the kv8s64 pass,
+PERF.md round-5 session 2: the tunnel died 8 minutes into warmup and the
+step burned its full 40-minute timeout against a dead chip); now in the
+resilience layer so the kernel-CI harness (``reval_tpu/kernelbench.py``)
+can arm one PER CELL and ``bench.py`` keeps its per-round instance —
+one implementation, two cadences.  ``bench.StallWatchdog`` remains an
+alias for existing callers.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+__all__ = ["StallWatchdog"]
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+class StallWatchdog:
+    """Trips only when BOTH hold: zero progress for ``stall_s`` AND
+    ``probe_fails`` consecutive failed device probes (killable
+    subprocesses ``probe_gap_s`` apart — a healthy chip mid-compile
+    answers them, and a successful probe resets the failure count).
+    The caller exits (or kills its supervised cell) promptly so the
+    runbook's wedge-abort fires minutes, not tens of minutes, later."""
+
+    def __init__(self, stall_s: float = 420.0, probe_gap_s: float = 120.0,
+                 probe_fails: int = 3, prober=None):
+        self.stall_s, self.probe_gap_s = stall_s, probe_gap_s
+        self.probe_fails = probe_fails
+        self._probe = prober if prober is not None else self._probe_device
+        self._progress = None
+        self._changed = time.monotonic()
+        self._probed = 0.0
+        self._fails = 0
+
+    @staticmethod
+    def _probe_device() -> bool:
+        from ..env import env_str
+
+        root = _repo_root()
+        alive = os.path.join(root, "tpu_watch", "ALIVE")
+        probe_log = os.path.join(root, "tpu_watch", "probe.log")
+        mode = (env_str("REVAL_TPU_EXCLUSIVE_DEVICE") or "auto").lower()
+
+        def _fresh(path: str) -> bool:
+            try:
+                return time.time() - os.path.getmtime(path) < 1800.0
+            except OSError:
+                return False
+
+        # A watcher verdict only counts while the watcher is demonstrably
+        # RUNNING — freshness, not mere existence, of its marker files.
+        # probe.log accumulates forever and ALIVE is removed on a wedge,
+        # so a leftover stale probe.log from a long-dead watcher must not
+        # flip a process-exclusive setup into "watcher says wedged" and
+        # resurrect the false _exit(3) this logic exists to prevent.
+        alive_fresh = _fresh(alive)
+        watcher = alive_fresh or _fresh(probe_log)
+        if mode in ("1", "true", "on") or (mode not in ("0", "false", "off")
+                                           and not watcher):
+            # Process-exclusive device ownership (plain TPU VM libtpu
+            # lock, unlike the tunneled setup): a second jax-initializing
+            # process fails against a HEALTHY chip, so a subprocess probe
+            # would read any long zero-stat-progress window (a first
+            # compile, say) as a dead device and falsely _exit(3)
+            # (ADVICE r5).  No out-of-process health signal exists here;
+            # report healthy and leave wedge-abort to the runbook timeout.
+            return True
+        if watcher:
+            # Tunneled setup with tools/tpu_watch.sh running: its loop
+            # touches tpu_watch/ALIVE on every good probe and removes it
+            # when the tunnel wedges — that heartbeat IS the tunnel
+            # health endpoint, no second jax process needed.  A fresh
+            # probe.log with ALIVE gone/stale is the live watcher's
+            # wedged verdict.
+            return alive_fresh
+        # explicit tunneled/shared mode with no live watcher: the
+        # tunneled runtime tolerates a second client — subprocess probe
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
+                capture_output=True, timeout=45)
+            return r.returncode == 0
+        except subprocess.TimeoutExpired:
+            return False
+
+    def stalled_and_dead(self, progress) -> bool:
+        now = time.monotonic()
+        if progress != self._progress:
+            self._progress, self._changed, self._fails = progress, now, 0
+            return False
+        if (now - self._changed < self.stall_s
+                or now - self._probed < self.probe_gap_s):
+            return False
+        self._probed = now
+        self._fails = 0 if self._probe() else self._fails + 1
+        return self._fails >= self.probe_fails

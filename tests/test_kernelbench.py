@@ -1,0 +1,650 @@
+"""Self-healing kernel CI: the tier-1 chaos drill + unit coverage.
+
+The fast tier runs the REAL harness three times on CPU (one shared
+module fixture): a clean full-matrix round, the degradation drill
+(wedge + timeout + flaky-device), and a perturbed regression-gate
+round.  The drill pins the instrument's core promises:
+
+- a wedged or timed-out cell degrades to a stale-marked entry carrying
+  its last-known value + commit, with retries recorded — never a blind
+  0.0 and never an aborted round;
+- surviving cells still produce a valid leaderboard whose winner emits
+  a loadable ``decide_defaults``-compatible serving-config pick;
+- a seeded perturbation makes the regression gate exit 1 naming the
+  cell with the incumbent-vs-HEAD delta.
+
+Everything else (retry/stale/skip supervision, gate verdicts, schema
+bites, chaos parsing, obs_report rendering, decide tiers) is unit-level
+over injectable runners and synthesized artifacts — no subprocesses.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from reval_tpu.analysis import kernelbench as kb_lint
+from reval_tpu.kernelbench import (SCHEMA, BenchShape, KernelCell,
+                                   default_cells, incumbent_leaderboard,
+                                   last_known_cell, main, regression_gate,
+                                   run_round, supervise_cell,
+                                   validate_leaderboard, write_leaderboard)
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.obs.metrics import MetricsRegistry
+from reval_tpu.resilience import KERNEL_CELL_MODES, KernelCellChaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WEDGE_CELL = "pallas-swap-bf16-c2"
+TIMEOUT_CELL = "xla-bf16-c4"
+FLAKY_CELL = "pallas_seq-swap-bf16-c4"
+
+
+def _load_tool(name: str):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"{name}_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifacts(out: str) -> list[str]:
+    import glob
+
+    return sorted(glob.glob(os.path.join(out, "kernelbench-*.json")))
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 drill — THREE real CLI rounds shared by the module
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def drill(tmp_path_factory):
+    """clean round -> chaos round (wedge + timeout + flaky) -> perturbed
+    gate round; returns the three artifacts + exit codes + out dir."""
+    out = str(tmp_path_factory.mktemp("kernelbench"))
+    rc_clean = main(["--tiny", "--out-dir", out])
+    arts = _artifacts(out)
+    assert len(arts) == 1, "clean round wrote no artifact"
+    clean = _load(arts[0])
+
+    # wide noise band: CPU timing jitter must not flip THIS run's gate —
+    # the exit-1 drill below uses a seeded 6x perturbation instead
+    rc_chaos = main(["--tiny", "--out-dir", out, "--noise", "0.5",
+                     "--cell-timeout", "8",
+                     "--chaos-cell", f"wedge:{WEDGE_CELL}",
+                     "--chaos-cell", f"timeout:{TIMEOUT_CELL}",
+                     "--chaos-cell", f"flaky-device:{FLAKY_CELL}"])
+    arts = _artifacts(out)
+    assert len(arts) == 2, "chaos round wrote no artifact"
+    chaos = _load(sorted(arts, key=os.path.getmtime)[-1])
+
+    # the gate defends the newest NON-drill artifact (chaos rounds are
+    # excluded as incumbents), so the regression is seeded into the
+    # CLEAN round's winner cell
+    victim = clean["summary"]["winner"]
+    os.environ["REVAL_TPU_KERNELBENCH_PERTURB"] = f"{victim}=6.0"
+    try:
+        rc_gate = main(["--tiny", "--out-dir", out, "--cells", victim])
+    finally:
+        del os.environ["REVAL_TPU_KERNELBENCH_PERTURB"]
+    arts = _artifacts(out)
+    assert len(arts) == 3, "gate round wrote no artifact"
+    gate = _load(sorted(arts, key=os.path.getmtime)[-1])
+    return {"out": out, "clean": clean, "chaos": chaos, "gate": gate,
+            "victim": victim, "rc": (rc_clean, rc_chaos, rc_gate)}
+
+
+class TestDrill:
+    def test_clean_round_runs_the_full_matrix(self, drill):
+        art = drill["clean"]
+        assert drill["rc"][0] == 0
+        assert art["schema"] == SCHEMA and art["tiny"] is True
+        names = {c.name for c in default_cells(tiny=True)}
+        assert set(art["cells"]) == names
+        for name, row in art["cells"].items():
+            assert row["status"] == "run", (name, row)
+            assert row["ms_per_step"] > 0
+            assert row["retries"] == 0 and row["attempts"] == 1
+        s = art["summary"]
+        assert s["cells_run"] == len(names) and s["cells_stale"] == 0
+        assert s["winner"] in names
+        assert s["gate"]["status"] == "no-incumbent"
+        # instrument-health telemetry rides the embedded registry snapshot
+        assert (art["metrics"]["counters"][obs_metrics.KB_CELLS]
+                == len(names))
+
+    def test_wedged_and_timed_out_cells_degrade_to_stale(self, drill):
+        art = drill["chaos"]
+        assert drill["rc"][1] == 0, "a chaos round must never abort"
+        clean_src = None
+        for name, kill in ((WEDGE_CELL, "stall watchdog"),
+                           (TIMEOUT_CELL, "budget")):
+            row = art["cells"][name]
+            assert row["status"] == "stale", (name, row)
+            assert kill in row["error"]
+            assert row["retries"] >= 1 and row["attempts"] >= 2
+            lk = row["last_known"]
+            assert lk["ms_per_step"] == \
+                drill["clean"]["cells"][name]["ms_per_step"]
+            assert lk["commit"] == drill["clean"]["commit"]
+            clean_src = lk["source"]
+            # the cardinal rule: a degraded cell is NEVER a 0.0
+            assert "ms_per_step" not in row or row.get("ms_per_step")
+        assert clean_src and clean_src.startswith("kernelbench-")
+        assert art["chaos"][WEDGE_CELL] == "wedge"
+
+    def test_flaky_device_recovers_with_retries_recorded(self, drill):
+        row = drill["chaos"]["cells"][FLAKY_CELL]
+        assert row["status"] == "run"
+        assert row["ms_per_step"] > 0
+        assert row["retries"] == 1 and row["attempts"] == 2
+
+    def test_surviving_cells_produce_a_valid_leaderboard(self, drill):
+        art = drill["chaos"]
+        assert validate_leaderboard(art) == []
+        s = art["summary"]
+        assert s["cells_run"] >= 3 and s["cells_stale"] == 2
+        assert s["winner"] is not None
+        assert s["retries"] >= 3
+        assert art["metrics"]["counters"][obs_metrics.KB_STALE] == 2
+        assert art["metrics"]["counters"][obs_metrics.KB_RETRIES] >= 3
+
+    def test_autotune_pick_roundtrips_through_decide_defaults(self, drill,
+                                                              tmp_path):
+        """The winner's pick is a loadable serving config: a (non-tiny)
+        leaderboard in the watch dir makes decide_defaults persist
+        autotune.json + decided_env.sh with the picked backend/dot/chunk
+        — exactly what the dispatcher and runbook consume."""
+        art = copy.deepcopy(drill["chaos"])
+        pick = art["pick"]
+        spec = art["cells"][art["summary"]["winner"]]["spec"]
+        assert pick["REVAL_TPU_PAGED_BACKEND"] == spec["backend"]
+        assert pick["env"]["REVAL_TPU_DECODE_CHUNK"] == str(spec["chunk"])
+        assert pick["evidence"]["tier"] == "kernelbench"
+
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        # simulate the chip round this pick would come from: same schema,
+        # not tiny, no chaos (drill debris never decides — tested below)
+        art["tiny"] = False
+        art["chaos"] = None
+        with open(watch / "kernelbench-20990101-000000.json", "w") as f:
+            json.dump(art, f)
+        dd = _load_tool("decide_defaults")
+        assert dd.main(["--watch", str(watch)]) == 0
+        with open(watch / "autotune.json") as f:
+            decision = json.load(f)
+        assert decision["REVAL_TPU_PAGED_BACKEND"] == spec["backend"]
+        assert decision["evidence"]["tier"] == "kernelbench"
+        env_sh = (watch / "decided_env.sh").read_text()
+        assert (f"export REVAL_TPU_DECODE_CHUNK={spec['chunk']}"
+                in env_sh)
+        assert (f"export REVAL_TPU_PAGED_BACKEND={spec['backend']}"
+                in env_sh)
+
+    def test_tiny_chaos_and_perturbed_artifacts_never_decide(self, drill,
+                                                             tmp_path):
+        dd = _load_tool("decide_defaults")
+        for label, mutate in (
+                ("tiny", lambda a: None),                      # stays tiny
+                ("chaos", lambda a: a.update(tiny=False)),     # keeps chaos
+                ("perturb", lambda a: a.update(
+                    tiny=False, chaos=None,
+                    perturb={"xla-bf16-c2": 6.0}))):
+            watch = tmp_path / f"watch-{label}"
+            watch.mkdir()
+            art = copy.deepcopy(drill["chaos"])
+            mutate(art)
+            with open(watch / "kernelbench-20990101-000000.json", "w") as f:
+                json.dump(art, f)
+            assert dd.main(["--watch", str(watch)]) == 1, \
+                f"{label} artifact must never become the serving default"
+
+    def test_seeded_perturbation_trips_the_gate_exit_1(self, drill):
+        assert drill["rc"][2] == 1
+        gate = drill["gate"]["summary"]["gate"]
+        assert gate["status"] == "regressed"
+        assert gate["cell"] == drill["victim"]      # the gate NAMES the cell
+        assert gate["incumbent_ms"] > 0 and gate["head_ms"] > 0
+        assert gate["delta"] > gate["noise_band"]
+        # a chaos drill never becomes the bar: the incumbent is round 1
+        assert gate["incumbent_ms"] == \
+            drill["clean"]["cells"][drill["victim"]]["ms_per_step"]
+        assert gate["incumbent_commit"] == drill["clean"]["commit"]
+        assert drill["gate"]["perturb"] == {drill["victim"]: 6.0}
+        assert (drill["gate"]["metrics"]["counters"]
+                [obs_metrics.KB_REGRESSIONS] == 1)
+
+    def test_filtered_run_reports_unselected_as_skipped(self, drill):
+        art = drill["gate"]
+        assert validate_leaderboard(art) == []
+        skipped = [n for n, r in art["cells"].items()
+                   if r["status"] == "skipped"]
+        assert len(skipped) == len(default_cells(tiny=True)) - 1
+        for name in skipped:
+            assert "not selected" in art["cells"][name]["reason"]
+
+    def test_lint_pass_accepts_the_drill_artifacts(self, drill, tmp_path):
+        root = tmp_path / "repo"
+        (root / "tpu_watch").mkdir(parents=True)
+        for i, path in enumerate(_artifacts(drill["out"])):
+            with open(path) as f:
+                data = f.read()
+            (root / "tpu_watch" / f"kernelbench-0{i}.json").write_text(data)
+        assert kb_lint.run({}, str(root)) == []
+
+    def test_lint_pass_bites(self, drill, tmp_path):
+        root = tmp_path / "repo"
+        (root / "tpu_watch").mkdir(parents=True)
+        bad = copy.deepcopy(drill["chaos"])
+        vanished = drill["chaos"]["summary"]["winner"]
+        del bad["cells"][vanished]
+        bad["summary"]["winner"] = None
+        bad.pop("pick", None)
+        (root / "tpu_watch" / "kernelbench-00.json").write_text(
+            json.dumps(bad))
+        (root / "tpu_watch" / "kernelbench-01.json").write_text("{trunc")
+        messages = [v.message for v in kb_lint.run({}, str(root))]
+        assert any(vanished in m and "never dropped" in m for m in messages)
+        assert any("unreadable" in m for m in messages)
+
+    def test_obs_report_kernels_flags_stale_and_names_regression(
+            self, drill, tmp_path):
+        obs = _load_tool("obs_report")
+        paths = _artifacts(drill["out"])
+        text = obs.render_kernels(sorted(paths, key=os.path.getmtime))
+        # stale cells render explicitly with provenance, never as fresh
+        assert f"STALE {WEDGE_CELL}" in text
+        assert drill["clean"]["commit"] in text
+        assert "[CHAOS DRILL]" in text and "[PERTURBED" in text
+
+        # a genuine cross-round per-cell regression is named FIRST:
+        # synthesize round B = round A with one cell 2x slower
+        a = copy.deepcopy(drill["clean"])
+        b = copy.deepcopy(drill["clean"])
+        slow = sorted(b["cells"])[0]
+        b["cells"][slow]["ms_per_step"] *= 2
+        pa, pb = tmp_path / "kb-a.json", tmp_path / "kb-b.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        text = obs.render_kernels([str(pa), str(pb)])
+        assert f"first regression: kb-b.json ({slow}" in text
+
+        # a tiny smoke interleaved between two chip rounds must not eat
+        # the chip baseline (per-tier comparison state)
+        a2, b2 = copy.deepcopy(a), copy.deepcopy(b)
+        a2["tiny"] = b2["tiny"] = False
+        pt = tmp_path / "kb-smoke.json"
+        p2a, p2b = tmp_path / "kb-chip-a.json", tmp_path / "kb-chip-b.json"
+        pt.write_text(json.dumps(a))
+        p2a.write_text(json.dumps(a2))
+        p2b.write_text(json.dumps(b2))
+        text = obs.render_kernels([str(p2a), str(pt), str(p2b)])
+        assert f"first regression: kb-chip-b.json ({slow}" in text
+
+    def test_cli_emits_runbook_json_line(self, drill):
+        """The runbook contract: ONE parseable JSON line on stdout with
+        a nonzero value and no error key on a healthy round (subprocess
+        shape — what `run kernelbench.json ... json` greps)."""
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "tools/kernelbench.py", "--tiny",
+             "--out-dir", drill["out"], "--cells", drill["victim"],
+             "--noise", "100"], cwd=REPO, capture_output=True, text=True,
+            timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr[-2000:]
+        lines = [l for l in r.stdout.splitlines() if l.strip()]
+        assert len(lines) == 1, f"stdout must be ONE json line, got {lines}"
+        d = json.loads(lines[0])
+        assert d["value"] > 0 and "error" not in d
+        assert d["winner"] == drill["victim"]
+
+
+# ---------------------------------------------------------------------------
+# units — no subprocesses
+# ---------------------------------------------------------------------------
+
+class TestTaxonomy:
+    def test_names_unique_and_axes_covered(self):
+        for tiny in (True, False):
+            cells = default_cells(tiny)
+            names = [c.name for c in cells]
+            assert len(names) == len(set(names))
+        full = default_cells(False)
+        assert len(full) == 20
+        assert {c.backend for c in full} == {"xla", "pallas", "pallas_seq"}
+        assert {c.pool for c in full} == {"bf16", "int8"}
+        assert {c.chunk for c in full} == {8, 32}
+        assert {c.dot for c in full if c.backend != "xla"} == {"swap",
+                                                               "wide"}
+        tiny = default_cells(True)
+        assert len(tiny) == 6
+        assert {WEDGE_CELL, TIMEOUT_CELL, FLAKY_CELL} <= {c.name
+                                                          for c in tiny}
+
+    def test_cell_roundtrip(self):
+        cell = KernelCell("pallas_seq", "wide", "int8", 32)
+        assert KernelCell.from_dict(cell.to_dict()) == cell
+        assert cell.name == "pallas_seq-wide-int8-c32"
+        assert KernelCell("xla", None, "bf16", 8).name == "xla-bf16-c8"
+
+
+class TestChaos:
+    def test_parse_roundtrip_and_rejects_typos(self):
+        chaos = KernelCellChaos.parse(["wedge:a", "flaky-device:b"])
+        assert chaos.rules == {"a": "wedge", "b": "flaky-device"}
+        argv = chaos.to_argv()
+        assert argv[::2] == ["--chaos-cell"] * 2     # child-CLI flag pairs
+        assert KernelCellChaos.parse(argv[1::2]).rules == chaos.rules
+        for bad in ("wedgd:a", "wedge", "wedge:", ":a"):
+            with pytest.raises(ValueError):
+                KernelCellChaos.parse([bad])
+        assert set(KERNEL_CELL_MODES) == {"wedge", "timeout",
+                                          "flaky-device"}
+
+    def test_flaky_device_fails_then_recovers(self):
+        chaos = KernelCellChaos({"c": "flaky-device"})
+        with pytest.raises(ConnectionError):
+            chaos.apply_in_child("c", attempt=0)
+        chaos.apply_in_child("c", attempt=1)        # returns clean
+        chaos.apply_in_child("other", attempt=0)    # untargeted: no-op
+
+    def test_probe_override_only_simulates_dead_tunnel_for_wedge(self):
+        chaos = KernelCellChaos({"w": "wedge", "t": "timeout"})
+        assert chaos.device_probe_override("w")() is False
+        assert chaos.device_probe_override("t") is None
+        assert chaos.device_probe_override("other") is None
+
+
+def _cell() -> KernelCell:
+    return KernelCell("xla", None, "bf16", 2)
+
+
+def _supervise(runner, out_dir, registry=None, attempts=2):
+    return supervise_cell(
+        _cell(), BenchShape.tiny(), tiny=True, out_dir=str(out_dir),
+        hb_dir=str(out_dir), timeout_s=5.0, attempts=attempts,
+        stall_s=1.0, probe_gap_s=0.1, probe_fails=2, poll_s=0.01,
+        retry_delay_s=0.0, chaos=None,
+        registry=registry if registry is not None else MetricsRegistry(),
+        runner=runner, sleep=lambda s: None)
+
+
+def _history_artifact(out_dir, ms=4.2, commit="abc1234", tiny=True):
+    """A minimal prior leaderboard supplying last-known history."""
+    art = {"schema": SCHEMA, "created_unix": time.time() - 60,
+           "ts": "2026-08-03T00:00:00", "commit": commit, "tiny": tiny,
+           "shape": BenchShape.tiny().to_dict(),
+           "cells": {_cell().name: {
+               "spec": _cell().to_dict(), "status": "run",
+               "ms_per_step": ms, "gbps": 1.0, "attempts": 1,
+               "retries": 0}},
+           "summary": {"cells_run": 1, "cells_stale": 0,
+                       "cells_skipped": 0, "retries": 0,
+                       "winner": None, "gate": {"status": "no-incumbent"}}}
+    return write_leaderboard(art, str(out_dir))
+
+
+class TestSupervision:
+    def test_transient_failure_retries_then_runs(self, tmp_path):
+        calls = {"n": 0}
+
+        def runner(cell, shape, **kw):
+            calls["n"] += 1
+            if kw["attempt"] == 0:
+                raise TimeoutError("wedged once")
+            return {"ms_per_step": 1.5, "gbps": 2.0}
+
+        reg = MetricsRegistry()
+        row = _supervise(runner, tmp_path, reg)
+        assert row["status"] == "run" and row["ms_per_step"] == 1.5
+        assert row["attempts"] == 2 and row["retries"] == 1
+        assert reg.counter(obs_metrics.KB_RETRIES).value == 1
+        assert calls["n"] == 2
+
+    def test_exhausted_cell_with_history_goes_stale(self, tmp_path):
+        src = _history_artifact(tmp_path, ms=4.2, commit="abc1234")
+
+        def runner(cell, shape, **kw):
+            raise TimeoutError("tunnel dead")
+
+        reg = MetricsRegistry()
+        row = _supervise(runner, tmp_path, reg)
+        assert row["status"] == "stale"
+        assert row["last_known"]["ms_per_step"] == 4.2
+        assert row["last_known"]["commit"] == "abc1234"
+        assert row["last_known"]["source"] == os.path.basename(src)
+        assert row["retries"] == 1 and "tunnel dead" in row["error"]
+        assert reg.counter(obs_metrics.KB_STALE).value == 1
+
+    def test_exhausted_cell_without_history_skips_with_reason(self,
+                                                              tmp_path):
+        def runner(cell, shape, **kw):
+            raise ConnectionError("no such device")
+
+        row = _supervise(runner, tmp_path)
+        assert row["status"] == "skipped"
+        assert "no last-known value" in row["reason"]
+        assert "no such device" in row["reason"]
+
+    def test_application_errors_do_not_retry(self, tmp_path):
+        calls = {"n": 0}
+
+        def runner(cell, shape, **kw):
+            calls["n"] += 1
+            raise ValueError("a bug, not a wedge")
+
+        row = _supervise(runner, tmp_path, attempts=3)
+        assert calls["n"] == 1, "non-transport errors must not burn retries"
+        assert row["status"] == "skipped"
+
+
+class TestHistory:
+    def test_last_known_never_crosses_tiers_or_reads_perturbed(self,
+                                                               tmp_path):
+        _history_artifact(tmp_path / "full", ms=9.9, tiny=False)
+        assert last_known_cell(_cell().name, str(tmp_path / "full"),
+                               tiny=True) is None
+        p = _history_artifact(tmp_path / "pert", ms=9.9, tiny=True)
+        obj = _load(p)
+        obj["perturb"] = {_cell().name: 6.0}
+        with open(p, "w") as f:
+            json.dump(obj, f)
+        assert last_known_cell(_cell().name, str(tmp_path / "pert"),
+                               tiny=True) is None
+
+    def test_stale_rows_chain_their_last_known_forward(self, tmp_path):
+        _history_artifact(tmp_path, ms=4.2, commit="abc1234")
+        mid = {"schema": SCHEMA, "created_unix": time.time() - 30,
+               "ts": "t", "commit": "def5678", "tiny": True,
+               "shape": BenchShape.tiny().to_dict(),
+               "cells": {_cell().name: {
+                   "spec": _cell().to_dict(), "status": "stale",
+                   "error": "TimeoutError: wedged", "attempts": 2,
+                   "retries": 1,
+                   "last_known": {"ms_per_step": 4.2, "gbps": 1.0,
+                                  "commit": "abc1234", "ts": "t0",
+                                  "source": "kernelbench-old.json"}}},
+               "summary": {"cells_run": 0, "cells_stale": 1,
+                           "cells_skipped": 0, "retries": 1,
+                           "winner": None,
+                           "gate": {"status": "no-incumbent"}}}
+        write_leaderboard(mid, str(tmp_path))
+        lk = last_known_cell(_cell().name, str(tmp_path), tiny=True)
+        # the chain carries the ORIGINAL measurement's commit forward
+        assert lk["commit"] == "abc1234" and lk["ms_per_step"] == 4.2
+
+
+class TestGate:
+    def _incumbent(self, tmp_path, ms=4.0):
+        path = _history_artifact(tmp_path, ms=ms, commit="inc0001")
+        obj = _load(path)
+        obj["summary"]["winner"] = _cell().name
+        with open(path, "w") as f:
+            json.dump(obj, f)
+        return incumbent_leaderboard(str(tmp_path), tiny=True)
+
+    def _head(self, status="run", ms=4.1):
+        row = {"spec": _cell().to_dict(), "status": status, "attempts": 1,
+               "retries": 0}
+        if status == "run":
+            row["ms_per_step"] = ms
+        return {_cell().name: row}
+
+    def test_within_noise_ok_beyond_noise_regressed(self, tmp_path):
+        inc = self._incumbent(tmp_path)
+        assert regression_gate(inc, self._head(ms=4.4), 0.15)["status"] \
+            == "ok"
+        gate = regression_gate(inc, self._head(ms=5.0), 0.15)
+        assert gate["status"] == "regressed"
+        assert gate["cell"] == _cell().name
+        assert gate["incumbent_commit"] == "inc0001"
+        assert gate["delta"] == pytest.approx(0.25)
+
+    def test_chaos_and_perturbed_rounds_are_never_the_incumbent(
+            self, tmp_path):
+        path = _history_artifact(tmp_path, ms=4.0)
+        obj = _load(path)
+        obj["summary"]["winner"] = _cell().name
+        obj["chaos"] = {_cell().name: "wedge"}
+        with open(path, "w") as f:
+            json.dump(obj, f)
+        assert incumbent_leaderboard(str(tmp_path), tiny=True) is None
+        obj["chaos"] = None
+        obj["perturb"] = {_cell().name: 6.0}
+        with open(path, "w") as f:
+            json.dump(obj, f)
+        assert incumbent_leaderboard(str(tmp_path), tiny=True) is None
+
+    def test_blind_instrument_is_not_a_verdict(self, tmp_path):
+        inc = self._incumbent(tmp_path)
+        gate = regression_gate(inc, self._head(status="stale"), 0.15)
+        assert gate["status"] == "instrument-blind"
+        assert regression_gate(None, self._head(), 0.15)["status"] \
+            == "no-incumbent"
+
+    def test_faster_head_is_ok(self, tmp_path):
+        inc = self._incumbent(tmp_path)
+        assert regression_gate(inc, self._head(ms=2.0), 0.15)["status"] \
+            == "ok"
+
+
+class TestValidate:
+    def _valid(self) -> dict:
+        cells = {}
+        for c in default_cells(tiny=True):
+            cells[c.name] = {"spec": c.to_dict(), "status": "run",
+                             "ms_per_step": 3.0, "gbps": 1.0,
+                             "attempts": 1, "retries": 0}
+        winner = default_cells(tiny=True)[0].name
+        return {"schema": SCHEMA, "tiny": True,
+                "shape": BenchShape.tiny().to_dict(), "cells": cells,
+                "summary": {"cells_run": 6, "cells_stale": 0,
+                            "cells_skipped": 0, "retries": 0,
+                            "winner": winner, "gate": {"status": "ok"}},
+                "pick": {"REVAL_TPU_PAGED_BACKEND": "xla",
+                         "REVAL_TPU_KERNEL_DOT": "swap",
+                         "env": {"REVAL_TPU_DECODE_CHUNK": "2"},
+                         "bench_args": {}, "scope": {},
+                         "evidence": {}}}
+
+    def test_valid_artifact_passes(self):
+        assert validate_leaderboard(self._valid()) == []
+
+    def test_zero_measurement_bites(self):
+        art = self._valid()
+        name = art["summary"]["winner"]
+        art["cells"][name]["ms_per_step"] = 0.0
+        assert any("blind 0.0" in e for e in validate_leaderboard(art))
+
+    def test_stale_without_commit_or_value_bites(self):
+        art = self._valid()
+        name = sorted(art["cells"])[1]
+        art["cells"][name] = {"spec": art["cells"][name]["spec"],
+                              "status": "stale", "error": "x",
+                              "attempts": 2, "retries": 1,
+                              "last_known": {"ms_per_step": 2.0}}
+        assert any("carries no commit" in e
+                   for e in validate_leaderboard(art))
+        art["cells"][name]["last_known"] = {}
+        assert any("last-known ms_per_step" in e
+                   for e in validate_leaderboard(art))
+
+    def test_vanished_cell_and_reasonless_skip_bite(self):
+        art = self._valid()
+        gone = sorted(n for n in art["cells"]
+                      if n != art["summary"]["winner"])[0]
+        del art["cells"][gone]
+        assert any(gone in e and "never dropped" in e
+                   for e in validate_leaderboard(art))
+        art = self._valid()
+        name = sorted(n for n in art["cells"]
+                      if n != art["summary"]["winner"])[0]
+        art["cells"][name] = {"spec": art["cells"][name]["spec"],
+                              "status": "skipped"}
+        assert any("without a reason" in e
+                   for e in validate_leaderboard(art))
+
+    def test_winner_and_pick_consistency_bite(self):
+        art = self._valid()
+        art["cells"][art["summary"]["winner"]]["status"] = "stale"
+        assert any("not a fresh run cell" in e
+                   for e in validate_leaderboard(art))
+        art = self._valid()
+        art["pick"]["REVAL_TPU_PAGED_BACKEND"] = "pallas"   # winner is xla
+        assert any("does not match winner" in e
+                   for e in validate_leaderboard(art))
+        art = self._valid()
+        del art["pick"]
+        assert any("no serving-config pick" in e
+                   for e in validate_leaderboard(art))
+
+    def test_wrong_schema_is_terminal(self):
+        assert validate_leaderboard({"schema": "nope"}) \
+            == ["schema 'nope' != expected 'reval-kernelbench-v1'"]
+
+
+class TestRunRoundUnits:
+    def test_unknown_cell_selection_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown cell"):
+            run_round(tiny=True, select=["no-such-cell"],
+                      out_dir=str(tmp_path),
+                      runner=lambda *a, **k: {"ms_per_step": 1.0})
+
+    def test_typoed_chaos_cell_raises_instead_of_running_clean(self,
+                                                               tmp_path):
+        with pytest.raises(ValueError, match="unknown cell"):
+            run_round(tiny=True, out_dir=str(tmp_path),
+                      chaos=KernelCellChaos({"xla-bf16-c3": "wedge"}),
+                      runner=lambda *a, **k: {"ms_per_step": 1.0})
+
+    def test_round_with_injected_runner_never_spawns(self, tmp_path):
+        """The whole matrix through an in-process runner: artifact shape,
+        ordering, winner, pick — no subprocesses, no jax."""
+        ms = {c.name: 10.0 - i for i, c in
+              enumerate(default_cells(tiny=True))}
+
+        def runner(cell, shape, **kw):
+            return {"ms_per_step": ms[cell.name], "gbps": 1.0,
+                    "device": "cpu", "platform": "cpu"}
+
+        art = run_round(tiny=True, out_dir=str(tmp_path), runner=runner,
+                        sleep=lambda s: None)
+        assert validate_leaderboard(art) == []
+        assert list(art["cells"]) == [c.name
+                                      for c in default_cells(tiny=True)]
+        assert art["summary"]["winner"] == min(ms, key=ms.get)
+        assert art["pick"]["evidence"]["cell"] == art["summary"]["winner"]

@@ -1475,9 +1475,9 @@ def test_cli_changed_only_scopes_to_git_diff(tmp_path):
     assert doc["violations"][0]["path"].endswith("fresh.py")
 
 
-def test_thirteen_passes_registered():
-    assert len(PASSES) == 13
-    assert {"mesh", "reshard", "enginezoo"} <= set(PASSES)
+def test_fourteen_passes_registered():
+    assert len(PASSES) == 14
+    assert {"mesh", "reshard", "enginezoo", "kernelbench"} <= set(PASSES)
 
 
 def test_mesh_collective_via_lax_import_alias(tmp_path):

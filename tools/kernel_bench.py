@@ -10,16 +10,20 @@ backend (``REVAL_TPU_PAGED_BACKEND``) from data.
 
 ``--layers`` repeats the kernel per timed iteration to amortise
 dispatch the way a real decode step does (one call per layer).
+
+This CLI is a THIN front over the kernel-CI harness's variant provider
+(``reval_tpu/kernelbench.py``): the historical row labels map onto
+matrix cells and the timing core is shared, so the quick A/B and the
+supervised leaderboard (``tools/kernelbench.py``) can never drift.  The
+output line format is unchanged — ``tools/decide_defaults.py`` still
+parses ``kernel_ab.txt`` rows verbatim.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-import statistics
 import sys
-import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -53,119 +57,53 @@ def main() -> None:
     chip_lock = acquire_chip_lock(skip=args.tiny)  # held until exit
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
+
+    from reval_tpu.kernelbench import (LEGACY_LABELS, BenchShape, KernelCell,
+                                       build_inputs, time_cell)
 
     if args.tiny:
         jax.config.update("jax_platforms", "cpu")
         args.slots, args.ctx, args.layers, args.span = 2, 96, 2, 3
 
-    from reval_tpu.ops import pallas_attention as pa
-
-    b, h, h_kv, d, p = (args.slots, args.heads, args.kv_heads,
-                        args.head_dim, args.page)
-    need = (args.ctx + p - 1) // p + 1
-    # the table must span every live page or the kernels read garbage ids
-    args.span = max(args.span, need)
-    n_pages = 1 + b * need
-    rng = np.random.default_rng(0)
-
-    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((n_pages * p, h_kv, d)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((n_pages * p, h_kv, d)), jnp.bfloat16)
-    kp8 = vp8 = ks = None
-    if not args.no_int8:
-        kp8 = (kp * 16).astype(jnp.int8)
-        vp8 = (vp * 16).astype(jnp.int8)
-        ks = jnp.full((n_pages * p, h_kv), 1 / 16, jnp.float32)
-    tables = np.zeros((b, args.span), np.int32)
-    for s in range(b):
-        for j in range(need):
-            tables[s, j] = 1 + s * need + j
-    tables = jnp.asarray(tables)
-    lens = jnp.full((b,), args.ctx, jnp.int32)
-
+    shape = BenchShape(slots=args.slots, ctx=args.ctx, heads=args.heads,
+                       kv_heads=args.kv_heads, head_dim=args.head_dim,
+                       page=args.page, span=args.span, layers=args.layers,
+                       reps=args.reps)
     dev = jax.devices()[0]
-    print(f"device: {dev.device_kind} | B={b} H={h}/{h_kv} D={d} "
-          f"ctx={args.ctx} page={p} span={args.span} layers={args.layers}")
+    print(f"device: {dev.device_kind} | B={shape.slots} "
+          f"H={shape.heads}/{shape.kv_heads} D={shape.head_dim} "
+          f"ctx={shape.ctx} page={shape.page} span={shape.span} "
+          f"layers={shape.layers}")
 
-    interp = jax.default_backend() != "tpu"
+    # operand sets are shared across same-pool rows (one build per dtype)
+    inputs = {"bf16": None, "int8": None}
 
     ok_count = 0
 
-    def variant(label, fn, k, v, scales=False):
+    def variant(label: str) -> None:
         nonlocal ok_count
-        kw = dict(page_size=p)
-        if scales:
-            kw.update(k_scales=ks, v_scales=ks)
-        if fn is not pa.paged_decode_attention_xla:
-            kw["interpret"] = interp
-
-        # Timing MUST end on a host fetch: through the axon tunnel
-        # ``block_until_ready`` returns before the device has executed
-        # (measured: a 100-call loop "completed" in 30 µs, then took >2
-        # minutes to materialise), so only np.asarray of the result is a
-        # sync point.  The fetch+RTT overhead is cancelled by timing an
-        # N-layer in-jit loop against a 1-layer one: per-call =
-        # (T_N - T_1) / (N - 1).
-        def make_loop(n):
-            @jax.jit
-            def loop(q, k, v, tables, lens):
-                def body(_, acc):
-                    o = fn(acc.astype(q.dtype), k, v, tables, lens, **kw)
-                    return o.astype(jnp.float32)
-                return jax.lax.fori_loop(0, n, body, q.astype(jnp.float32))
-            return loop
-
-        def fetch_time(loop):
-            t0 = time.perf_counter()
-            np.asarray(loop(q, k, v, tables, lens))
-            return time.perf_counter() - t0
-
+        backend, dot, pool = LEGACY_LABELS[label]
+        # chunk=1 preserves the historical timing exactly: the long loop
+        # is ``layers`` kernel calls vs one, per-step = per_call * layers
+        cell = KernelCell(backend=backend, dot=dot, pool=pool, chunk=1)
+        if inputs[pool] is None:
+            inputs[pool] = build_inputs(shape, pool)
         try:
-            loop_n, loop_1 = make_loop(args.layers), make_loop(1)
-            fetch_time(loop_n)          # compile
-            fetch_time(loop_1)          # compile
-            t_n = [fetch_time(loop_n) for _ in range(args.reps)]
-            if args.layers > 1:
-                t_1 = [fetch_time(loop_1) for _ in range(args.reps)]
-                per_call = ((statistics.median(t_n) - statistics.median(t_1))
-                            / (args.layers - 1))
-            else:       # single layer: overhead can't be cancelled
-                per_call = statistics.median(t_n)
-            # RTT jitter can swallow a sub-resolution kernel: floor at 1 µs
-            # so the GB/s print stays finite and the row reads as "fast",
-            # not FAILED
-            ms = max(per_call * args.layers, 1e-6) * 1000
-            # bytes actually touched: live pages (K+V) per sequence per layer
-            live_pages = (args.ctx + p - 1) // p
-            elt = 1 if scales else 2
-            gb = (2 * b * live_pages * p * h_kv * d * elt * args.layers) / 1e9
-            if scales:
-                # the f32 K/V scale arrays are real traffic too — without
-                # them the int8 rows understate their GB/s in the very
-                # artifact that decides the default backend
-                gb += (2 * b * live_pages * p * h_kv * 4 * args.layers) / 1e9
-            print(f"{label:14s} {ms:8.3f} ms/step   {gb / (ms / 1000):6.1f} GB/s "
-                  f"effective")
+            row = time_cell(cell, shape, inputs=inputs[pool])
+            print(f"{label:14s} {row['ms_per_step']:8.3f} ms/step   "
+                  f"{row['gbps']:6.1f} GB/s effective")
             ok_count += 1
         except Exception as e:
             print(f"{label:14s} FAILED: {type(e).__name__}: {str(e)[:120]}")
 
     if not args.only_int8:
-        variant("grid", pa.paged_decode_attention_pallas, kp, vp)
-        variant("seq", pa.paged_decode_attention_pallas_seq, kp, vp)
-        variant("grid-wide", partial(pa.paged_decode_attention_pallas,
-                                     dot_mode="wide"), kp, vp)
-        variant("seq-wide", partial(pa.paged_decode_attention_pallas_seq,
-                                    dot_mode="wide"), kp, vp)
+        for label in ("grid", "seq", "grid-wide", "seq-wide"):
+            variant(label)
     if not args.no_int8:
-        variant("grid-int8", pa.paged_decode_attention_pallas, kp8, vp8,
-                scales=True)
-        variant("seq-int8", pa.paged_decode_attention_pallas_seq, kp8, vp8,
-                scales=True)
+        for label in ("grid-int8", "seq-int8"):
+            variant(label)
     if not args.tiny and not args.only_int8:
-        variant("xla", pa.paged_decode_attention_xla, kp, vp)
+        variant("xla")
 
     if ok_count == 0:
         # nothing measured (wedged tunnel / driver fault): exit nonzero so

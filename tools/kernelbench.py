@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""Self-healing kernel CI: supervised per-cell benchmarking + autotune
+leaderboard — the ``tools/`` entry point over
+``reval_tpu/kernelbench.py`` (one implementation; ``python -m
+reval_tpu.kernelbench`` is the same program, and is what the harness
+spawns per cell).
+
+    python tools/kernelbench.py                 # chip round, full matrix
+    python tools/kernelbench.py --tiny          # CPU harness certification
+    python tools/kernelbench.py --tiny \\
+        --chaos-cell wedge:pallas-swap-bf16-c2  # degradation drill
+
+Each cell (kernel backend × dot tile formulation × KV pool dtype ×
+decode chunk cadence) runs as a timeout-bounded subprocess under the
+bench StallWatchdog and RetryPolicy backoff; a wedged cell degrades to a
+stale-marked entry carrying its last-known value + commit, never a 0.0
+and never an aborted round.  The surviving cells write an atomic
+``reval-kernelbench-v1`` leaderboard artifact, the winner emits a
+``tools/decide_defaults.py``-compatible serving-config pick, and the
+regression gate exits 1 (named cell, incumbent-vs-HEAD delta) when HEAD
+regresses the incumbent winner beyond the noise band.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reval_tpu.kernelbench import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -229,6 +229,105 @@ def render_speculative(paths: list[str]) -> str:
     return "\n".join(lines)
 
 
+def kernels_block(path: str) -> dict | None:
+    """One kernel-CI leaderboard artifact (``reval-kernelbench-v1``,
+    possibly nested under a driver record's ``"parsed"``)."""
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("not a JSON object")
+    if (obj.get("schema") != "reval-kernelbench-v1"
+            and isinstance(obj.get("parsed"), dict)):
+        obj = obj["parsed"]
+    if obj.get("schema") != "reval-kernelbench-v1":
+        return None
+    return obj
+
+
+def render_kernels(paths: list[str], noise: float = 0.05) -> str:
+    """The kernel-CI trajectory across leaderboard rounds (chronological
+    order): one row per artifact, per-cell regressions vs the previous
+    round's FRESH values, and the first regressed cell named loudly —
+    the same first-change contract as --determinism.  Stale cells are
+    flagged explicitly with their provenance: a stale cell must never
+    render as a fresh measurement, and fresh-vs-stale pairs are never
+    compared (a blind instrument is not a perf delta)."""
+    lines = ["== kernel-CI leaderboard across rounds ==", "",
+             f"{'round':<30} {'winner':<26} {'ms/step':>9} "
+             f"{'run':>4} {'stale':>5} {'skip':>4} {'rty':>4}  gate"]
+    # one baseline PER TIER: a --tiny smoke interleaved between two chip
+    # rounds must not silently eat the chip baseline (the tier check
+    # would skip the comparison and a real chip regression would read
+    # as "no regression")
+    prevs: dict[bool, tuple[str, dict]] = {}
+    first_regress: str | None = None
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            obj = kernels_block(path)
+        except (OSError, ValueError) as e:
+            lines.append(f"{name:<30} (unreadable: {type(e).__name__})")
+            continue
+        if obj is None:
+            lines.append(f"{name:<30} (no kernelbench leaderboard)")
+            continue
+        s = obj.get("summary", {})
+        cells = obj.get("cells", {})
+        winner = s.get("winner")
+        winner_ms = (cells.get(winner, {}).get("ms_per_step")
+                     if winner else None)
+        marks = []
+        if obj.get("tiny"):
+            marks.append("[TINY]")
+        # drill rounds (injected faults / seeded regressions) are marked
+        # and never compared: chaos debris must not read as a perf move
+        drill = bool(obj.get("perturb") or obj.get("chaos"))
+        if obj.get("perturb"):
+            marks.append(f"[PERTURBED: {', '.join(sorted(obj['perturb']))}]")
+        if obj.get("chaos"):
+            marks.append("[CHAOS DRILL]")
+        regressed = []
+        prev = prevs.get(bool(obj.get("tiny")))
+        if prev is not None and not drill:
+            pcells = prev[1].get("cells", {})
+            for cname in sorted(cells):
+                now, was = cells[cname], pcells.get(cname, {})
+                if (now.get("status") == "run" and was.get("status") == "run"
+                        and was.get("ms_per_step")
+                        and now["ms_per_step"]
+                        > was["ms_per_step"] * (1 + noise)):
+                    regressed.append(cname)
+        gate = (s.get("gate") or {}).get("status", "?")
+        lines.append(
+            f"{name:<30} {(winner or '—'):<26} "
+            f"{(f'{winner_ms:.3f}' if winner_ms else '—'):>9} "
+            f"{s.get('cells_run', '?'):>4} {s.get('cells_stale', '?'):>5} "
+            f"{s.get('cells_skipped', '?'):>4} {s.get('retries', '?'):>4}  "
+            f"{gate}"
+            + (" " + " ".join(marks) if marks else "")
+            + (f"  <-- regressed: {', '.join(regressed)}" if regressed
+               else ""))
+        if regressed and first_regress is None:
+            first_regress = (f"first regression: {name} "
+                             f"({', '.join(regressed)} vs "
+                             f"{os.path.basename(prev[0])})")
+        for cname, row in sorted(cells.items()):
+            if row.get("status") == "stale":
+                lk = row.get("last_known") or {}
+                lines.append(
+                    f"{'':<30}   STALE {cname}: last known "
+                    f"{lk.get('ms_per_step', '?')} ms/step @ "
+                    f"{lk.get('commit', '?')} ({lk.get('source', '?')}) — "
+                    f"{row.get('retries', 0)} retries, "
+                    f"{row.get('error', '?')}")
+        if not drill:       # drill rounds never become the comparison bar
+            prevs[bool(obj.get("tiny"))] = (path, obj)
+    lines.append("")
+    lines.append(first_regress if first_regress
+                 else "no per-cell regression across these rounds")
+    return "\n".join(lines)
+
+
 def slo_block(path: str) -> dict | None:
     """One artifact's goodput/SLO-attainment block: a ``tools/loadgen.py``
     artifact (``reval-loadgen-v1`` — goodput + slo sections), or any
@@ -330,10 +429,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="report goodput/SLO-attainment deltas across "
                          "loadgen artifacts (or BENCH rounds embedding an "
                          "slo block), naming the first regression")
+    ap.add_argument("--kernels", action="store_true",
+                    help="report the kernel-CI leaderboard trajectory "
+                         "across kernelbench artifacts: per-cell "
+                         "regressions (first one named), stale cells "
+                         "flagged with provenance")
     args = ap.parse_args(argv)
-    if sum((args.determinism, args.speculative, args.slo)) > 1:
-        ap.error("--determinism, --speculative, and --slo are mutually "
-                 "exclusive")
+    if sum((args.determinism, args.speculative, args.slo,
+            args.kernels)) > 1:
+        ap.error("--determinism, --speculative, --slo, and --kernels are "
+                 "mutually exclusive")
+    if args.kernels:
+        print(render_kernels(args.snapshot))
+        return 0
     if args.determinism:
         print(render_determinism(args.snapshot))
         return 0
