@@ -472,7 +472,8 @@ def decode_flops_per_token(cfg, n_matmul: int, avg_ctx: float) -> float:
 
 def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
               max_slots=32, max_seq_len=2048, num_pages=None, kv_dtype="",
-              progress_path=None, metric="", grammar=None, speculative=None):
+              progress_path=None, metric="", grammar=None, speculative=None,
+              kv_tiering=None):
     from reval_tpu.inference.tpu.engine import EngineStats
     from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
 
@@ -480,7 +481,7 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     eng = PagedTPUEngine(params, cfg, tok, max_slots=max_slots,
                          max_seq_len=max_seq_len, num_pages=num_pages,
                          prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
-                         speculative=speculative)
+                         speculative=speculative, kv_tiering=kv_tiering)
     build_wall = time.perf_counter() - t_build0
     # warmup = one full identical run: prefill buckets, decode span buckets,
     # and the prefix-LCP shapes all depend on the (prompt set, max_new)
@@ -629,8 +630,12 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     restart_row = eng.aot_counters()
     if restart_row.get("enabled"):
         restart_row["restart_to_ready_s"] = round(build_wall + warmup_wall, 2)
+    # KV-tier traffic over both passes (inference/tpu/kv_tiers.py):
+    # spills/promotions/recompute fallbacks + promotion latency — {} when
+    # tiering is off (--no-kv-tier A/B)
+    tier_row = eng.kv_tier_counters()
     eng.close()
-    return wall, stats, prefix_cache, jit_row, restart_row
+    return wall, stats, prefix_cache, jit_row, restart_row, tier_row
 
 
 def run_serial(params, cfg, tok, prompts, max_new, *, max_seq_len=4096):
@@ -669,6 +674,10 @@ def main() -> None:
                     help="skip the serial baseline (quick iteration)")
     ap.add_argument("--skip-ab", action="store_true",
                     help="skip the prefix-sharing off run")
+    ap.add_argument("--no-kv-tier", action="store_true",
+                    help="disable hierarchical KV tiering (host-DRAM "
+                         "spill of evicted prefix pages) for the A/B — "
+                         "the headline keeps tiering at its default")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable the persistent radix prefix cache for "
                          "the headline run (A/B candidate pinning); the "
@@ -869,12 +878,13 @@ def main() -> None:
         progress = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "tpu_watch", "bench_inflight.json")
         os.makedirs(os.path.dirname(progress), exist_ok=True)
-        wall, stats, cache_row, jit_row, restart_row = run_paged(
+        wall, stats, cache_row, jit_row, restart_row, tier_row = run_paged(
             params, cfg, tok, prompts, max_new,
             prefix_sharing=not args.no_prefix_cache, max_slots=args.slots,
             max_seq_len=args.max_seq_len,
             num_pages=num_pages, kv_dtype=args.kv_dtype,
-            progress_path=progress, metric=metric)
+            progress_path=progress, metric=metric,
+            kv_tiering=not args.no_kv_tier)
         probes_per_sec = len(prompts) / wall / chips_used
         tok_per_sec = (stats.generated_tokens / stats.decode_seconds
                        if stats.decode_seconds else 0.0)
@@ -947,6 +957,11 @@ def main() -> None:
             extras["obs_disabled"] = True
         if cache_row is not None:
             extras["prefix_cache"] = cache_row
+        if tier_row:
+            # host/disk page counts, spill + promotion counters, the
+            # promote hit-rate, recompute fallbacks, and promotion
+            # p50/p95 latency (kv_tiers.py; absent under --no-kv-tier)
+            extras["kv_tier"] = tier_row
 
         # The headline number is already measured; the A/B and serial
         # phases are garnish.  Persist it to disk NOW: a wedge in a
@@ -977,8 +992,8 @@ def main() -> None:
             note(f'paged run done ({round(len(prompts)/wall,2)} probes/s); '
                  'prefix-cache-off A/B')
             try:
-                wall_nopre, _, _, _, _ = run_paged(params, cfg, tok, prompts,
-                                                   max_new,
+                wall_nopre, _, _, _, _, _ = run_paged(params, cfg, tok,
+                                                      prompts, max_new,
                                                 prefix_sharing=False,
                                                 max_slots=args.slots,
                                                 max_seq_len=args.max_seq_len,
@@ -1006,13 +1021,13 @@ def main() -> None:
             try:
                 sg = "yesno" if args.mode == "direct" else "cot-yesno"
                 sp_prompts = prompts[: min(len(prompts), 16)]
-                w_on, st_on, _, _, _ = run_paged(
+                w_on, st_on, _, _, _, _ = run_paged(
                     params, cfg, tok, sp_prompts, max_new,
                     prefix_sharing=not args.no_prefix_cache,
                     max_slots=args.slots, max_seq_len=args.max_seq_len,
                     num_pages=num_pages, kv_dtype=args.kv_dtype,
                     grammar=sg, speculative=True)
-                w_off, st_off, _, _, _ = run_paged(
+                w_off, st_off, _, _, _, _ = run_paged(
                     params, cfg, tok, sp_prompts, max_new,
                     prefix_sharing=not args.no_prefix_cache,
                     max_slots=args.slots, max_seq_len=args.max_seq_len,

@@ -253,6 +253,9 @@ AUDIT_MODULES = (
     "reval_tpu.obs.metrics",
     "reval_tpu.obs.trace",
     "reval_tpu.resilience.chaos",
+    # the KV-tier store's copier thread (jax-free by design, so the
+    # import is as safe as the others)
+    "reval_tpu.inference.tpu.kv_tiers",
 )
 
 _installed: dict | None = None

@@ -570,6 +570,22 @@ def run_serve(argv: list[str]) -> int:
                         help="stall duration for injected stalled steps")
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="seed for the engine-step fault schedule")
+    parser.add_argument("--tier-chaos", type=float, default=None,
+                        metavar="RATE",
+                        help="inject deterministic KV-tier promotion faults "
+                             "(corrupt page, stalled fetch, failed tier) at "
+                             "this per-promotion rate — every fault must "
+                             "degrade to a recompute, never a wrong token")
+    parser.add_argument("--tier-chaos-modes", default=None,
+                        metavar="M1,M2",
+                        help="comma list of tier fault modes to draw from "
+                             "(corrupt,stall,fail; default all)")
+    parser.add_argument("--tier-chaos-seed", type=int, default=0,
+                        help="seed for the tier fault schedule")
+    parser.add_argument("--snapshot-fallback", default=None, metavar="PATH",
+                        help="a SIBLING replica's warm-state snapshot to "
+                             "boot from when --snapshot-path has none yet "
+                             "(autoscaler scale-up warm boot; read-only)")
     parser.add_argument("--smoke", type=int, default=None, metavar="N",
                         help="self-test: serve N concurrent prompts through "
                              "the resilient client, verify /metrics covers "
@@ -610,6 +626,15 @@ def run_serve(argv: list[str]) -> int:
         cfg["mock"] = True
     if args.snapshot_path:
         cfg["snapshot_path"] = args.snapshot_path
+    if args.snapshot_fallback:
+        cfg["snapshot_fallback"] = args.snapshot_fallback
+    if args.tier_chaos:
+        cfg["tier_chaos"] = args.tier_chaos
+        cfg["tier_chaos_seed"] = args.tier_chaos_seed
+        if args.tier_chaos_modes:
+            cfg["tier_chaos_modes"] = args.tier_chaos_modes
+        print(f"[chaos] KV-tier promotion faults at rate {args.tier_chaos} "
+              f"(seed {args.tier_chaos_seed})")
     if args.supervise:
         # parent process: never builds an engine — it spawns `serve`
         # children (same argv minus --supervise) and respawns them per

@@ -136,6 +136,27 @@ ENV: dict[str, dict] = {
         "default": "0.5",
         "help": "base respawn backoff in seconds (doubles per rapid "
                 "death, jittered, capped at 30 s — RetryPolicy schedule)"},
+    # -- hierarchical KV tiering (inference/tpu/kv_tiers.py) ---------------
+    "REVAL_TPU_KVTIER": {
+        "default": "1",
+        "help": "hierarchical KV page tiering (0 disables: evicted "
+                "prefix-cache pages are simply lost; spill/promote only "
+                "run at eviction and insert, the resident hot path is "
+                "unchanged either way)"},
+    "REVAL_TPU_KVTIER_HOST_MB": {
+        "default": "256",
+        "help": "host-DRAM tier byte bound in MB; LRU payloads past it "
+                "are dropped (disk-backed entries demote to path-only)"},
+    "REVAL_TPU_KVTIER_QUEUE": {
+        "default": "64",
+        "help": "spill handoff queue bound in pages; a full queue drops "
+                "the spill (counted) so a slow host path never wedges "
+                "the drive tick"},
+    "REVAL_TPU_KVTIER_TIMEOUT_S": {
+        "default": "5.0",
+        "help": "promotion deadline in seconds; a fetch past it raises "
+                "the timeout rung of the degrade ladder and the page "
+                "recomputes from its token chain"},
     # -- serving lifecycle (serving/session.py) ----------------------------
     "REVAL_TPU_MAX_QUEUED_TOKENS": {
         "default": "0",

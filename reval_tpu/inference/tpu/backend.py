@@ -19,6 +19,7 @@ class TPUBackend(InferenceBackend):
                  max_seq_len: int = 8192, local_devices_only: bool = False,
                  engine: str | None = None, kv_dtype: str = "",
                  memory_utilization: float | None = None,
+                 kv_tiering: bool | None = None, tier_chaos=None,
                  **kwargs):
         """``engine``: "paged" (continuous batching over the paged KV
         cache + native scheduler) or "static" (rectangular batches; the
@@ -50,7 +51,13 @@ class TPUBackend(InferenceBackend):
         reported HBM (pool = util × HBM − weights − workspace) — the
         reference's ``gpu_memory_utilization`` vLLM kwarg (reference
         inference.py:93).  None (default) reserves max_seq_len per slot;
-        paged engines only."""
+        paged engines only.
+
+        ``kv_tiering``: hierarchical KV page tiering behind the paged
+        prefix cache (inference/tpu/kv_tiers.py; default None reads
+        ``REVAL_TPU_KVTIER``); ``tier_chaos`` a seeded
+        :class:`~reval_tpu.resilience.TierChaos` promotion-fault
+        injector (paged engines only — loud error otherwise)."""
         super().__init__(model_id, temp=temp, prompt_type=prompt_type)
         if not model_path:
             raise ValueError(
@@ -82,6 +89,11 @@ class TPUBackend(InferenceBackend):
         if engine is None:
             engine = ("static" if (sp_size > 1 or pp_size > 1 or cross_process)
                       else "paged")
+        if tier_chaos is not None and engine != "paged":
+            raise ValueError(
+                "tier_chaos injects KV-tier promotion faults, a paged-"
+                "pool feature (inference/tpu/kv_tiers.py) — drop "
+                "tier_chaos or use engine='paged'")
         if pp_size > 1:
             # pipeline parallelism implies the static engine (the paged
             # scheduler has no pp path); kv_dtype is a paged-pool feature
@@ -108,6 +120,7 @@ class TPUBackend(InferenceBackend):
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
                 memory_utilization=memory_utilization,
+                kv_tiering=kv_tiering, tier_chaos=tier_chaos,
             )
         elif engine == "paged":
             # dp>1 with continuous batching: one paged replica per device
@@ -121,6 +134,7 @@ class TPUBackend(InferenceBackend):
                 max_slots=batch_size, max_seq_len=max_seq_len,
                 local_devices_only=local_devices_only, kv_dtype=kv_dtype,
                 memory_utilization=memory_utilization,
+                kv_tiering=kv_tiering, tier_chaos=tier_chaos,
             )
         else:
             # the static engine shards one rectangular batch over a

@@ -64,7 +64,8 @@ class DataParallelPagedEngine:
                  seed: int = 0, prefix_sharing: bool = True, devices=None,
                  kv_dtype: str = "",
                  memory_utilization: float | None = None,
-                 speculative: bool | None = None):
+                 speculative: bool | None = None,
+                 kv_tiering: bool | None = None, tier_chaos=None):
         devices = list(devices if devices is not None else jax.devices())
         need = dp_size * tp_size
         if len(devices) < need:
@@ -84,7 +85,11 @@ class DataParallelPagedEngine:
                 num_pages=num_pages, mesh=mesh, seed=seed + r,
                 prefix_sharing=prefix_sharing, kv_dtype=kv_dtype,
                 memory_utilization=memory_utilization,
-                speculative=speculative))
+                speculative=speculative,
+                # one store per replica (its own copier, its own bound);
+                # the chaos schedule is shared — it keys on chain hashes,
+                # so placement does not move the faults
+                kv_tiering=kv_tiering, tier_chaos=tier_chaos))
         self._pool = ThreadPoolExecutor(max_workers=dp_size,
                                         thread_name_prefix="dp-paged")
 
@@ -96,6 +101,8 @@ class DataParallelPagedEngine:
                         tokenizer=None, seed: int = 0, kv_dtype: str = "",
                         local_devices_only: bool = False,
                         memory_utilization: float | None = None,
+                        kv_tiering: bool | None = None,
+                        tier_chaos=None,
                         ) -> "DataParallelPagedEngine":
         params, cfg = load_checkpoint(model_path, dtype=dtype)
         if tokenizer is None:
@@ -105,7 +112,8 @@ class DataParallelPagedEngine:
                    max_slots=max_slots, page_size=page_size,
                    max_seq_len=max_seq_len, num_pages=num_pages, seed=seed,
                    devices=devices, kv_dtype=kv_dtype,
-                   memory_utilization=memory_utilization)
+                   memory_utilization=memory_utilization,
+                   kv_tiering=kv_tiering, tier_chaos=tier_chaos)
 
     @property
     def stats(self) -> EngineStats:

@@ -262,6 +262,16 @@ _STAT_FIELDS = (
     ("spec_wedges", "reval_spec_wedges_total", int),
     ("grammar_requests", "reval_grammar_requests_total", int),
     ("grammar_forced_tokens", "reval_grammar_forced_tokens_total", int),
+    # hierarchical KV tiering (paged engine; kv_tiers.py):
+    ("kvtier_spills", "reval_kvtier_spills_total", int),
+    ("kvtier_spill_drops", "reval_kvtier_spill_drops_total", int),
+    ("kvtier_spill_errors", "reval_kvtier_spill_errors_total", int),
+    ("kvtier_promotions", "reval_kvtier_promotions_total", int),
+    ("kvtier_disk_promotions", "reval_kvtier_disk_promotions_total", int),
+    ("kvtier_recomputes", "reval_kvtier_recomputes_total", int),
+    ("kvtier_integrity_failures",
+     "reval_kvtier_integrity_failures_total", int),
+    ("kvtier_host_evictions", "reval_kvtier_host_evictions_total", int),
     # serving lifecycle (serving/session.py + serving/server.py):
     ("sheds", "reval_serving_sheds_total", int),
     ("deadline_expired", "reval_serving_deadline_expired_total", int),
@@ -339,6 +349,31 @@ class EngineStats:
                 "hit_rate": round(self.prefix_hit_rate, 4),
                 "evictions": self.prefix_evictions,
                 "inserted_pages": self.prefix_inserted_pages}
+
+    def kvtier_counters(self) -> dict:
+        """The KV-tier counter block (``serving_counters`` sibling):
+        bench's ``kv_tier`` output, the loadgen artifact, and `watch`
+        render THIS dict.  ``promote_hit_rate`` is promotions over
+        promotion attempts (promotions + degraded recomputes)."""
+        attempts = self.kvtier_promotions + self.kvtier_recomputes
+        from ...obs import metrics as m
+
+        h = self.registry.histogram(m.KVTIER_PROMOTE_SECONDS)
+        out = {"spills": self.kvtier_spills,
+               "spill_drops": self.kvtier_spill_drops,
+               "spill_errors": self.kvtier_spill_errors,
+               "promotions": self.kvtier_promotions,
+               "disk_promotions": self.kvtier_disk_promotions,
+               "recomputes": self.kvtier_recomputes,
+               "integrity_failures": self.kvtier_integrity_failures,
+               "host_evictions": self.kvtier_host_evictions,
+               "promote_hit_rate": round(
+                   self.kvtier_promotions / attempts, 4) if attempts
+               else 0.0}
+        if h.count:
+            out["promote_p50_ms"] = round(h.percentile(0.50) * 1e3, 3)
+            out["promote_p95_ms"] = round(h.percentile(0.95) * 1e3, 3)
+        return out
 
     # -- latency histograms ------------------------------------------------
     def observe_request(self, req) -> None:

@@ -1,0 +1,513 @@
+"""Hierarchical KV page tiering: HBM → host DRAM → disk.
+
+The radix prefix cache (prefix_cache.py) lives entirely in HBM, so
+under fleet pressure eviction is the common case and the hit rate
+collapses exactly when sharing matters most.  This module adds two
+colder tiers BEHIND the cache without touching its hot path:
+
+- **host-DRAM spill**: when the cache evicts a rider-free leaf, the
+  engine gathers that page's KV rows out of the pool (one jitted
+  ``dynamic_slice`` — the result aliases nothing, so the pool page is
+  released immediately) and hands the device blocks to this store.  A
+  dedicated COPIER THREAD performs the device→host download off the
+  drive tick, stamps a sha256 over the payload, and parks it in a
+  byte-bounded LRU dict.  The handoff queue is bounded: a slow host
+  path drops spills (counted) instead of wedging the tick.
+- **disk**: at graceful drain the session dumps every warm page (still
+  resident or already spilled) into a sidecar directory next to the
+  warm-state snapshot; the v2 snapshot carries per-page refs (key,
+  file, sha256), so a restart — or an autoscaler scale-up booting from
+  a sibling's snapshot — promotes real KV bytes instead of replaying
+  prefill per chain.
+
+**Promotion** happens in ``submit_request``/``rewarm``: after the radix
+cache inserts new pages for a prompt, the engine asks this store for
+the longest promotable run of them, verifies each payload's sha256,
+and scatters it back into the pool (one jitted ``dynamic_update_slice``
+per page).  Promotion is pure byte movement — a promoted page serves
+EXACTLY what the resident page would have — which is the whole
+eval-harness contract: a tier must never change an answer.
+
+**Degrade ladder** (typed, counted, evented — never a crash, never
+wrong KV): checksum mismatch → :class:`TierIntegrityError`; tier I/O
+error → :class:`TierIOError` (disk reads retry under a small
+``RetryPolicy`` first); promotion past the deadline →
+:class:`TierTimeoutError`.  Every rung drops the tier entry and the
+engine recomputes the page from its token chain via the existing
+prefill path (``reval_kvtier_recomputes_total``).
+
+Keys are sha256 over the ENTIRE root→page token chain, not the page's
+own tokens: a page's KV depends on its full attention prefix, so two
+pages with identical tokens under different prefixes must never alias.
+
+Single-owner on the driver side (lookup/fetch/promote run on the
+engine's driver thread, like the runtime); the copier thread is the one
+concurrent writer, and every shared field is guarded by ``_cv``
+(audited — analysis/lockcheck.py).  This module stays jax-free: device
+blocks pass through opaquely and the only device→host transfer is the
+copier's marked download (the hostsync pass keeps it honest).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+
+import numpy as np
+
+try:                # registers "bfloat16"/"float8_*" with np.dtype —
+    import ml_dtypes  # noqa: F401 — disk entries round-trip raw bytes
+except ImportError:  # pragma: no cover — jax always ships ml_dtypes
+    pass
+
+from ...env import env_flag, env_float, env_int
+from ...obs import metrics as obs_metrics
+from ...obs.logging import log_event
+from ...resilience.retry import RetryPolicy
+
+__all__ = ["TieredPageStore", "TierEntry", "TierError",
+           "TierIntegrityError", "TierIOError", "TierTimeoutError",
+           "chain_key"]
+
+
+class TierError(Exception):
+    """Base of the typed degrade ladder; ``reason`` names the rung in
+    ``kvtier.degrade`` events and per-rung counters."""
+
+    reason = "error"
+
+
+class TierIntegrityError(TierError):
+    """The payload's sha256 no longer matches the checksum stamped at
+    spill — bit rot, a torn write, or injected corruption.  Serving it
+    would be WRONG KV; the only correct move is recompute."""
+
+    reason = "integrity"
+
+
+class TierIOError(TierError):
+    """The tier could not produce the payload at all (dead disk file,
+    exhausted host mapping, injected fail-tier fault)."""
+
+    reason = "io"
+
+
+class TierTimeoutError(TierError):
+    """The fetch outlived the promotion deadline — recompute is faster
+    than waiting on a wedged host path."""
+
+    reason = "timeout"
+
+
+def chain_key(tokens) -> str:
+    """sha256 over the full root→page token chain (int32 bytes).  The
+    chain — not the page's own tokens — is the identity: KV rows encode
+    attention over the ENTIRE prefix."""
+    return hashlib.sha256(
+        np.array(list(tokens), np.int32).tobytes()).hexdigest()
+
+
+def _payload_checksum(payload) -> str:
+    h = hashlib.sha256()
+    for arr in payload:
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+@dataclass
+class TierEntry:
+    """One spilled page.  ``payload`` is the host copy (a list of numpy
+    blocks in pool order: k per layer, v per layer, then scales for an
+    int8 pool) or None for a disk-only entry hydrated from a snapshot;
+    ``checksum`` is sha256 over the concatenated payload bytes, stamped
+    at spill and verified at every promotion."""
+
+    key: str
+    checksum: str
+    nbytes: int
+    payload: list | None = None
+    path: str | None = None
+    tier: str = "host"                 # "host" | "disk"
+
+
+class TieredPageStore:
+    """See module docstring.  ``stats`` is a zero-arg callable returning
+    the engine's live ``EngineStats`` (engines swap their stats object
+    between bench passes — same convention as the prefix cache);
+    ``chaos`` an optional :class:`~reval_tpu.resilience.TierChaos`."""
+
+    def __init__(self, page_size: int, *, host_mb: int | None = None,
+                 queue_cap: int | None = None,
+                 timeout_s: float | None = None, stats=None, chaos=None,
+                 start_copier: bool = True):
+        self.page = int(page_size)
+        self.host_bound = (env_int("REVAL_TPU_KVTIER_HOST_MB", 256)
+                           if host_mb is None else int(host_mb)) << 20
+        self.queue_cap = (env_int("REVAL_TPU_KVTIER_QUEUE", 64)
+                          if queue_cap is None else int(queue_cap))
+        self.timeout_s = (env_float("REVAL_TPU_KVTIER_TIMEOUT_S", 5.0)
+                          if timeout_s is None else float(timeout_s))
+        self._stats = stats if stats is not None else lambda: None
+        self.chaos = chaos
+        #: disk reads get a second chance before the I/O rung fires —
+        #: transient NFS/page-cache hiccups are not a reason to recompute
+        self._disk_retry = RetryPolicy(max_attempts=2, base_delay=0.02,
+                                       max_delay=0.1,
+                                       retryable=lambda e: isinstance(
+                                           e, OSError))
+        # ONE lock for the whole store: the Condition doubles as the
+        # mutex (the copier waits on it, the driver notifies through it)
+        self._cv = threading.Condition()
+        # key → entry, LRU order (move_to_end on touch); the copier
+        # inserts, the driver looks up/fetches/drops
+        self._entries: OrderedDict[str, TierEntry] = OrderedDict()  # guarded-by: _cv
+        self._queue: deque = deque()    # guarded-by: _cv
+        self._stop = False              # guarded-by: _cv
+        # gauges: single-writer-under-lock, lock-free scalar reads are
+        # deliberate (counters()/_publish_gauges read a point value)
+        self.host_bytes = 0             # guarded-by: _cv (writes)
+        self.host_pages = 0             # guarded-by: _cv (writes)
+        self.disk_pages = 0             # guarded-by: _cv (writes)
+        self.queue_depth = 0            # guarded-by: _cv (writes)
+        self._copier: threading.Thread | None = None
+        if start_copier:
+            self._copier = threading.Thread(target=self._copy_loop,
+                                            daemon=True,
+                                            name="kvtier-copier")
+            self._copier.start()
+
+    # -- spill (driver side: enqueue only, never block) ---------------------
+    def spill(self, tokens, blocks) -> bool:
+        """Hand one evicted page's device blocks to the copier.  Bounded
+        backpressure: a full queue DROPS the spill (counted) — the drive
+        tick must never wait on the host path."""
+        key = chain_key(tokens)
+        stats = self._stats()
+        with self._cv:
+            if self._stop:
+                return False
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return False            # already warm in a colder tier
+            if len(self._queue) >= self.queue_cap:
+                if stats is not None:
+                    stats.kvtier_spill_drops += 1
+                return False
+            self._queue.append((key, blocks))
+            depth = self.queue_depth = len(self._queue)
+            self._cv.notify()
+        if stats is not None:
+            stats.registry.gauge(obs_metrics.KVTIER_QUEUE_DEPTH).set(depth)
+        return True
+
+    # -- the copier thread --------------------------------------------------
+    def _download(self, blocks) -> list[np.ndarray]:  # hot-path
+        """The ONE device→host transfer of the spill path — on the
+        copier thread, never the drive tick."""
+        # host-sync: the copier's deliberate page download; this thread
+        # exists so the drive tick never pays this transfer
+        return [np.asarray(b) for b in blocks]
+
+    def _copy_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(0.2)
+                if not self._queue:
+                    if self._stop:
+                        return
+                    continue
+                key, blocks = self._queue.popleft()
+                depth = self.queue_depth = len(self._queue)
+            stats = self._stats()
+            try:
+                payload = self._download(blocks)
+                entry = TierEntry(key=key,
+                                  checksum=_payload_checksum(payload),
+                                  nbytes=sum(a.nbytes for a in payload),
+                                  payload=payload, tier="host")
+            except Exception as exc:    # noqa: BLE001 — a failed copy
+                # loses warmth, never correctness (the page was evicted
+                # either way); counted + evented, the loop keeps draining
+                log_event("kvtier.spill_error", level="warning",
+                          key=key[:12], exc=exc)
+                if stats is not None:
+                    stats.kvtier_spill_errors += 1
+                continue
+            with self._cv:
+                if self._stop:
+                    return
+                self._entries[key] = entry
+                self._entries.move_to_end(key)
+                self.host_bytes += entry.nbytes
+                self.host_pages += 1
+                evicted = self._enforce_host_bound_locked()
+            if stats is not None:
+                stats.kvtier_spills += 1
+                if evicted:
+                    stats.kvtier_host_evictions += evicted
+                reg = stats.registry
+                reg.gauge(obs_metrics.KVTIER_QUEUE_DEPTH).set(depth)
+                self._publish_gauges(reg)
+
+    def _enforce_host_bound_locked(self) -> int:  # lock-held: _cv
+        """LRU-drop host payloads past the byte bound; disk-backed
+        entries demote to path-only (their bytes live on disk), bare
+        host entries drop entirely.  Returns payloads evicted."""
+        evicted = 0
+        while self.host_bytes > self.host_bound:
+            victim = None
+            for key, entry in self._entries.items():
+                if entry.payload is not None:
+                    victim = (key, entry)
+                    break
+            if victim is None:
+                break
+            key, entry = victim
+            self.host_bytes -= entry.nbytes
+            self.host_pages -= 1
+            evicted += 1
+            if entry.path is not None:
+                entry.payload = None
+                entry.tier = "disk"
+                self._entries.move_to_end(key)
+            else:
+                del self._entries[key]
+        return evicted
+
+    def _publish_gauges(self, reg) -> None:
+        reg.gauge(obs_metrics.KVTIER_HOST_PAGES).set(self.host_pages)
+        reg.gauge(obs_metrics.KVTIER_HOST_BYTES).set(self.host_bytes)
+        reg.gauge(obs_metrics.KVTIER_DISK_PAGES).set(self.disk_pages)
+
+    # -- promotion (driver side) --------------------------------------------
+    def lookup(self, tokens) -> TierEntry | None:
+        """The tier entry covering ``tokens`` (a full root→page chain),
+        or None.  Touches LRU; never blocks on the copier beyond the
+        dict lock."""
+        key = chain_key(tokens)
+        with self._cv:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        return entry
+
+    def fetch(self, entry: TierEntry) -> list[np.ndarray]:
+        """The verified payload for one promotion, or a typed
+        :class:`TierError`.  Applies the chaos schedule, enforces the
+        promotion deadline, and ALWAYS re-verifies the sha256 stamped at
+        spill — a tier must never serve bytes it cannot prove."""
+        t0 = time.monotonic()
+        mode = self.chaos.draw(entry.key) if self.chaos is not None else None
+        if mode == "fail":
+            raise TierIOError(f"chaos: injected {entry.tier}-tier I/O "
+                              f"failure for page {entry.key[:12]}")
+        if mode == "stall":
+            self.chaos.sleep(self.chaos.stall_s)
+        payload = entry.payload
+        if payload is None:
+            if entry.path is None:
+                raise TierIOError(f"page {entry.key[:12]} has neither a "
+                                  f"host payload nor a disk file")
+            try:
+                payload = self._disk_retry.call(
+                    lambda: _read_page_file(entry.path),
+                    label=f"kvtier:{entry.key[:12]}")
+            except Exception as exc:
+                raise TierIOError(f"disk tier read failed for page "
+                                  f"{entry.key[:12]}: {exc}") from exc
+        if mode == "corrupt":
+            payload = [a.copy() for a in payload]
+            flat = payload[0].view(np.uint8).reshape(-1)
+            flat[0] ^= 0xFF
+        if _payload_checksum(payload) != entry.checksum:
+            raise TierIntegrityError(f"checksum mismatch on page "
+                                     f"{entry.key[:12]} ({entry.tier} tier)")
+        if time.monotonic() - t0 > self.timeout_s:
+            raise TierTimeoutError(f"promotion of page {entry.key[:12]} "
+                                   f"outlived the {self.timeout_s}s deadline")
+        return payload
+
+    def drop(self, key: str) -> None:
+        """Degrade-ladder removal: the entry failed its promotion, so it
+        must never be offered again (recompute re-spills a good copy on
+        the next eviction)."""
+        with self._cv:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return
+            if entry.payload is not None:
+                self.host_bytes -= entry.nbytes
+                self.host_pages -= 1
+            else:
+                self.disk_pages -= 1
+
+    # -- disk tier (snapshot v2 sidecar) ------------------------------------
+    def put_host(self, tokens, payload: list[np.ndarray]) -> TierEntry:
+        """Driver-side synchronous insert (the drain path dumps resident
+        pages through here — no copier race on a quiescent engine)."""
+        key = chain_key(tokens)
+        entry = TierEntry(key=key, checksum=_payload_checksum(payload),
+                          nbytes=sum(a.nbytes for a in payload),
+                          payload=payload, tier="host")
+        with self._cv:
+            old = self._entries.pop(key, None)
+            if old is not None and old.payload is not None:
+                self.host_bytes -= old.nbytes
+                self.host_pages -= 1
+            elif old is not None:
+                self.disk_pages -= 1
+            self._entries[key] = entry
+            self.host_bytes += entry.nbytes
+            self.host_pages += 1
+            self._enforce_host_bound_locked()
+        return entry
+
+    def write_disk(self, dir_path: str) -> list[dict]:
+        """Write every host-resident payload as one page file under
+        ``dir_path`` and return snapshot refs (key/file/sha256/bytes).
+        A page that fails to write is skipped with a ``kvtier.disk_error``
+        warning — the drain finishes regardless."""
+        os.makedirs(dir_path, exist_ok=True)
+        with self._cv:
+            entries = [e for e in self._entries.values()
+                       if e.payload is not None]
+        refs: list[dict] = []
+        for entry in entries:
+            fname = f"{entry.key}.kvpage"
+            try:
+                _write_page_file(os.path.join(dir_path, fname),
+                                 entry.payload, entry.checksum)
+            except OSError as exc:
+                log_event("kvtier.disk_error", level="warning",
+                          where="write", key=entry.key[:12], exc=exc)
+                continue
+            entry.path = os.path.join(dir_path, fname)
+            refs.append({"key": entry.key, "file": fname,
+                         "sha256": entry.checksum,
+                         "nbytes": entry.nbytes})
+        return refs
+
+    def attach_disk(self, refs: list[dict], dir_path: str) -> int:
+        """Hydrate disk-tier entries from snapshot refs (payload stays
+        on disk until promotion).  Garbage refs are skipped — a bad
+        snapshot degrades to the chain-replay path, never a wedged
+        boot.  Returns entries attached."""
+        attached = 0
+        for ref in refs or []:
+            if not isinstance(ref, dict):
+                continue
+            key, fname = ref.get("key"), ref.get("file")
+            sha, nbytes = ref.get("sha256"), ref.get("nbytes")
+            if not (isinstance(key, str) and isinstance(fname, str)
+                    and isinstance(sha, str)):
+                continue
+            path = os.path.join(dir_path, os.path.basename(fname))
+            entry = TierEntry(key=key, checksum=sha,
+                              nbytes=int(nbytes or 0), payload=None,
+                              path=path, tier="disk")
+            with self._cv:
+                if key in self._entries:
+                    continue
+                self._entries[key] = entry
+                self.disk_pages += 1
+            attached += 1
+        stats = self._stats()
+        if stats is not None:
+            self._publish_gauges(stats.registry)
+        return attached
+
+    # -- lifecycle / gauges --------------------------------------------------
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Wait for the copier to finish the queued spills (tests and
+        the drain path); True when the queue emptied in time."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._queue:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._copier is not None:
+            self._copier.join(timeout=5)
+            self._copier = None
+        with self._cv:
+            self._entries.clear()
+            self._queue.clear()
+            self.host_bytes = self.host_pages = self.disk_pages = 0
+            self.queue_depth = 0
+
+    def counters(self) -> dict:
+        """Gauge snapshot (counters live on the engine's EngineStats —
+        same split as the prefix cache)."""
+        with self._cv:
+            return {"host_pages": self.host_pages,
+                    "host_bytes": self.host_bytes,
+                    "disk_pages": self.disk_pages,
+                    "queue_depth": self.queue_depth}
+
+
+def default_tiering_enabled(flag: bool | None) -> bool:
+    """The master switch: an explicit ctor value wins, else
+    ``REVAL_TPU_KVTIER`` (default on — spill/promote only ever run at
+    eviction and insert, so the resident hot path is unchanged)."""
+    return env_flag("REVAL_TPU_KVTIER", True) if flag is None else bool(flag)
+
+
+# -- page files (the disk tier's on-disk shape) ------------------------------
+#
+# One page per file: a JSON header (block shapes/dtypes + the spill-time
+# sha256) length-prefixed before the concatenated raw array bytes.  Raw
+# bytes, not npz: bfloat16 round-trips exactly (ml_dtypes names the
+# dtype) and verification hashes the SAME bytes the host tier hashed.
+
+_PAGE_MAGIC = b"RVKV"
+
+
+def _write_page_file(path: str, payload: list[np.ndarray],
+                     checksum: str) -> None:
+    header = json.dumps({
+        "sha256": checksum,
+        "blocks": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                   for a in payload]}).encode()
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(_PAGE_MAGIC)
+        f.write(len(header).to_bytes(4, "little"))
+        f.write(header)
+        for arr in payload:
+            f.write(arr.tobytes())
+    os.replace(tmp, path)
+
+
+def _read_page_file(path: str) -> list[np.ndarray]:
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        if magic != _PAGE_MAGIC:
+            raise OSError(f"{path}: not a kv page file")
+        n = int.from_bytes(f.read(4), "little")
+        try:
+            header = json.loads(f.read(n))
+            blocks = header["blocks"]
+        except Exception as exc:
+            raise OSError(f"{path}: corrupt page header: {exc}") from exc
+        out = []
+        for spec in blocks:
+            dtype = np.dtype(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+            want = dtype.itemsize * int(np.prod(shape))
+            raw = f.read(want)
+            if len(raw) != want:
+                raise OSError(f"{path}: truncated page payload")
+            out.append(np.frombuffer(raw, dtype=dtype).reshape(shape))
+    return out

@@ -60,9 +60,11 @@ from ...obs.logging import log_event
 from ...models.paged import (
     commit_prefill,
     commit_verify,
+    gather_tier_page,
     init_paged_cache,
     paged_decode_step,
     prefill_with_paged_context,
+    promote_tier_page,
 )
 from ...runtime import PagedRuntime
 from .engine import (
@@ -75,6 +77,7 @@ from .engine import (
     profile_trace,
     restore_template_stats,
 )
+from .kv_tiers import TierError, TieredPageStore, default_tiering_enabled
 from .prefix_cache import RadixPrefixCache
 from .sampling import filter_logits, sample_token_rows
 from .tokenizer import HFTokenizer
@@ -211,7 +214,9 @@ class PagedTPUEngine:
                  kv_dtype: str = "",
                  memory_utilization: float | None = None,
                  pipeline: bool | None = None,
-                 speculative: bool | None = None):
+                 speculative: bool | None = None,
+                 kv_tiering: bool | None = None,
+                 tier_chaos=None):
         """``memory_utilization``: when set (and ``num_pages`` is not),
         size the page pool from the device's reported HBM — the
         equivalent of the ``gpu_memory_utilization`` the reference
@@ -237,7 +242,17 @@ class PagedTPUEngine:
         additionally enables n-gram prompt-lookup drafting for
         grammar-less greedy rows (the determinism matrix's spec cells
         and the bench A/B set this); ``False`` — like
-        ``REVAL_TPU_SPEC=0`` — restores plain decode byte-for-byte."""
+        ``REVAL_TPU_SPEC=0`` — restores plain decode byte-for-byte.
+
+        ``kv_tiering``: hierarchical KV page tiers behind the radix
+        prefix cache (kv_tiers.py) — evicted pages spill to host DRAM
+        off the drive tick and promote back bit-identically instead of
+        being recomputed; the warm snapshot's disk sidecar rides the
+        same store.  ``None`` reads ``REVAL_TPU_KVTIER`` (default on);
+        only meaningful with ``prefix_sharing``.  ``tier_chaos``: an
+        optional :class:`~reval_tpu.resilience.TierChaos` fault
+        schedule applied at promotion (``serve --tier-chaos`` wires
+        it)."""
         assert max_seq_len % page_size == 0
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -358,9 +373,20 @@ class PagedTPUEngine:
         # keeps one free page per slot so cached-but-idle prefixes never
         # starve decode admission; under deeper pressure the engine
         # evicts LRU nodes before preempting running sequences.
-        self.prefix_cache = (RadixPrefixCache(self.rt, page_size,
-                                              watermark=max_slots,
-                                              stats=lambda: self.stats)
+        # hierarchical KV tiering (kv_tiers.py): evicted prefix-cache
+        # pages spill to host DRAM (copier thread, off the drive tick)
+        # and promote back into the pool bit-identically at the next
+        # acquire; the warm snapshot's disk sidecar attaches here too
+        self.kv_tiering = (default_tiering_enabled(kv_tiering)
+                           and prefix_sharing)
+        self.kv_tiers = (TieredPageStore(page_size,
+                                         stats=lambda: self.stats,
+                                         chaos=tier_chaos)
+                         if self.kv_tiering else None)
+        self.prefix_cache = (RadixPrefixCache(
+            self.rt, page_size, watermark=max_slots,
+            stats=lambda: self.stats,
+            spill=self._spill_node if self.kv_tiers is not None else None)
                              if prefix_sharing else None)
         # jit-entry: paged.decode_chunk static=(steps, filtered, grammared) bucketed=(span, gstates) warmup=64
         self._jit_chunk = tracked_jit(
@@ -395,6 +421,23 @@ class PagedTPUEngine:
         self._jit_patch = tracked_jit(
             "paged.patch_tables", jax.jit(patch_state_tables),
             registry=reg, warmup=16)
+        # KV-tier page movement (kv_tiers.py): one page's rows out of
+        # the pool (spill read — a non-aliasing slice, so the pool page
+        # is releasable the moment dispatch returns) and back in
+        # (promotion write — leading-dim in-place scatter on the donated
+        # pool).  Fixed shapes per engine: one variant each, plus one
+        # spare for a resharded pool.
+        # jit-entry: paged.kvtier_gather warmup=2
+        self._jit_tier_gather = tracked_jit(
+            "paged.kvtier_gather", jax.jit(gather_tier_page),
+            registry=reg, warmup=2)
+        # jit-entry: paged.kvtier_promote warmup=2
+        self._jit_tier_promote = tracked_jit(
+            "paged.kvtier_promote",
+            jax.jit(promote_tier_page, donate_argnums=(0,),
+                    **({"out_shardings": cache_out_shardings}
+                       if cache_out_shardings is not None else {})),
+            registry=reg, warmup=2)
         #: per-template request counts: crc32 of the first prompt PAGE's
         #: token ids — the token-space analog of the router's char-window
         #: affinity key (same intent, DIFFERENT domain: the two hashes
@@ -450,6 +493,11 @@ class PagedTPUEngine:
             self._jit_verify = AotJit(self._jit_verify, self._aot_cache, ctx,
                                       static=("grammared",), donate=(7,))
             self._jit_patch = AotJit(self._jit_patch, self._aot_cache, ctx)
+            self._jit_tier_gather = AotJit(self._jit_tier_gather,
+                                           self._aot_cache, ctx)
+            self._jit_tier_promote = AotJit(self._jit_tier_promote,
+                                            self._aot_cache, ctx,
+                                            donate=(0,))
         # runtime mesh discipline (analysis/shardcheck.py): on a mesh,
         # the chunk/commit entries carry the KV pool — assert its actual
         # sharding still matches paged_cache_spec after every dispatch
@@ -470,9 +518,15 @@ class PagedTPUEngine:
                 "paged.verify_chunk", self._jit_verify, registry=reg,
                 in_checks={7: self._cache_sharding},
                 out_checks={1: self._cache_sharding})
+            self._jit_tier_promote = ShardGuard(
+                "paged.kvtier_promote", self._jit_tier_promote,
+                registry=reg, in_checks={0: self._cache_sharding},
+                out_checks={0: self._cache_sharding})
         self._jit_trackers = (self._jit_prefill, self._jit_prefill_pctx,
                               self._jit_commit, self._jit_chunk,
-                              self._jit_verify, self._jit_patch)
+                              self._jit_verify, self._jit_patch,
+                              self._jit_tier_gather,
+                              self._jit_tier_promote)
 
     @staticmethod
     def _pages_for_budget(params, cfg, mesh, page_size: int, kv_dtype: str,
@@ -518,6 +572,8 @@ class PagedTPUEngine:
                         local_devices_only: bool = False,
                         memory_utilization: float | None = None,
                         pipeline: bool | None = None,
+                        kv_tiering: bool | None = None,
+                        tier_chaos=None,
                         ) -> "PagedTPUEngine":
         mesh = None
         if tp_size > 1:
@@ -541,9 +597,13 @@ class PagedTPUEngine:
                    page_size=page_size, max_seq_len=max_seq_len,
                    num_pages=num_pages, mesh=mesh, seed=seed,
                    kv_dtype=kv_dtype, pipeline=pipeline,
-                   memory_utilization=memory_utilization)
+                   memory_utilization=memory_utilization,
+                   kv_tiering=kv_tiering, tier_chaos=tier_chaos)
 
     def close(self) -> None:
+        if self.kv_tiers is not None:
+            self.kv_tiers.close()
+            self.kv_tiers = None
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
             self.prefix_cache = None
@@ -850,7 +910,12 @@ class PagedTPUEngine:
             node, new_from = self.prefix_cache.acquire(ids)
             if node is not None and new_from < node.tok_len:
                 try:
-                    self._prefill_prefix_pages(ids, node, new_from)
+                    # colder tiers first: promote any spilled pages of
+                    # the chain bit-identically; whatever they don't
+                    # cover recomputes through prefill as before
+                    start = self._promote_from_tier(ids, node, new_from)
+                    if start < node.tok_len:
+                        self._prefill_prefix_pages(ids, node, start)
                 except Exception:
                     # the new nodes hold uncommitted (garbage) KV: they
                     # must not survive to serve a later rider — and the
@@ -930,6 +995,137 @@ class PagedTPUEngine:
         self.stats.prefill_seconds += time.perf_counter() - t0
         self.stats.prefill_tokens += len(own)
 
+    # -- hierarchical KV tiering (kv_tiers.py) -----------------------------
+    def _chain_tokens(self, node) -> list[int]:
+        """The full root→node token chain — the tier store's page
+        identity (a page's KV depends on its entire attention prefix)."""
+        keys = []
+        while node is not None:
+            keys.append(node.key)
+            node = node.parent
+        return [t for key in reversed(keys) for t in key]
+
+    def _spill_node(self, node) -> None:
+        """Prefix-cache eviction hook: dispatch the page's device-side
+        gather (non-aliasing — the pool page is free to be reused the
+        moment this returns) and hand the blocks to the copier.  Runs
+        on the driver thread mid-eviction, so it must never raise and
+        never block: a failed spill loses tier warmth, not the
+        eviction."""
+        try:
+            tables = self.rt.block_table(node.prefix_id)
+            page = int(tables[node.depth_pages - 1])
+            blocks = self._jit_tier_gather(
+                self.cache,
+                self._dev(jnp.asarray([page], jnp.int32)))
+            self.kv_tiers.spill(self._chain_tokens(node), blocks)
+        except Exception as exc:  # noqa: BLE001 — see docstring
+            self.stats.kvtier_spill_errors += 1
+            log_event("kvtier.spill_error", level="warning", exc=exc)
+
+    def _promote_from_tier(self, ids: list[int], node, new_from: int
+                           ) -> int:
+        """Promote the longest run of the chain's newly inserted pages
+        (tokens ``[new_from, node.tok_len)``) available in a colder
+        tier, sha256-verified, back into the pool.  Returns the token
+        offset prefill must still cover from — every rung of the
+        degrade ladder lands here as a counted + evented fallback to
+        recompute, never a crash, never wrong KV."""
+        if self.kv_tiers is None or new_from >= node.tok_len:
+            return new_from
+        p = self.page_size
+        tables_all = self.rt.block_table(node.prefix_id)
+        start = new_from
+        for i in range(new_from // p, node.tok_len // p):
+            entry = self.kv_tiers.lookup(ids[:(i + 1) * p])
+            if entry is None:
+                break
+            from_disk = entry.payload is None
+            t0 = time.perf_counter()
+            try:
+                blocks = self.kv_tiers.fetch(entry)
+                self.cache = self._jit_tier_promote(
+                    self.cache,
+                    self._dev(jnp.asarray([int(tables_all[i])], jnp.int32)),
+                    tuple(blocks))
+            except Exception as exc:  # noqa: BLE001 — ladder floor:
+                # anything a tier throws degrades to recompute
+                reason = (exc.reason if isinstance(exc, TierError)
+                          else "error")
+                self.kv_tiers.drop(entry.key)
+                self.stats.kvtier_recomputes += 1
+                if reason == "integrity":
+                    self.stats.kvtier_integrity_failures += 1
+                    log_event("kvtier.integrity_failure", level="warning",
+                              key=entry.key[:12], tier=entry.tier)
+                log_event("kvtier.degrade", level="warning",
+                          reason=reason, key=entry.key[:12],
+                          tier=entry.tier, exc=exc)
+                break
+            self.stats.kvtier_promotions += 1
+            if from_disk:
+                self.stats.kvtier_disk_promotions += 1
+            self.stats.registry.histogram(
+                obs_metrics.KVTIER_PROMOTE_SECONDS).observe(
+                time.perf_counter() - t0)
+            start = (i + 1) * p
+        return start
+
+    # engine-local: the KV tier store is paged-pool machinery (page
+    # granular spill/promote) — the session/bench probe it via hasattr
+    def kv_tier_counters(self) -> dict:
+        """The bench/watch ``kv_tier`` block: the EngineStats counter
+        side plus the store's live gauges."""
+        if self.kv_tiers is None:
+            return {}
+        return {**self.stats.kvtier_counters(),
+                **self.kv_tiers.counters()}
+
+    # engine-local: disk-tier drain hook (snapshot v2 sidecar) — only a
+    # paged pool has pages to dump; the session probes it via hasattr
+    def dump_tier_pages(self, dir_path: str) -> list[dict]:
+        """Write every warm page — still resident in the pool or
+        already spilled to host DRAM — into the snapshot sidecar
+        directory; returns the per-page refs the v2 snapshot carries.
+        Resident pages are read out synchronously (the engine is
+        draining: no copier race, no tick to protect)."""
+        if self.kv_tiers is None:
+            return []
+        if self.prefix_cache is not None:
+            stack = list(self.prefix_cache.children.values())
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                try:
+                    tables = self.rt.block_table(node.prefix_id)
+                    page = int(tables[node.depth_pages - 1])
+                    blocks = self._jit_tier_gather(
+                        self.cache,
+                        self._dev(jnp.asarray([page], jnp.int32)))
+                    # host-sync: drain-path download of resident pages —
+                    # the engine is quiescing, there is no tick to stall
+                    payload = [np.asarray(b) for b in blocks]
+                    self.kv_tiers.put_host(self._chain_tokens(node),
+                                           payload)
+                except Exception as exc:  # noqa: BLE001 — a page that
+                    # won't read still has its token chain in the v2
+                    # doc; the restart recomputes it
+                    self.stats.kvtier_spill_errors += 1
+                    log_event("kvtier.spill_error", level="warning",
+                              where="drain", exc=exc)
+        self.kv_tiers.drain(timeout_s=5.0)
+        return self.kv_tiers.write_disk(dir_path)
+
+    # engine-local: disk-tier boot hook (snapshot v2 sidecar) — pairs
+    # with dump_tier_pages; the session probes it via hasattr
+    def attach_tier_refs(self, refs: list[dict], dir_path: str) -> int:
+        """Hydrate disk-tier entries from a v2 snapshot's page refs so
+        the following :meth:`rewarm` promotes real KV bytes instead of
+        replaying prefill per chain.  Returns entries attached."""
+        if self.kv_tiers is None:
+            return 0
+        return self.kv_tiers.attach_disk(refs, dir_path)
+
     def prefix_cache_counters(self) -> dict:
         """Prefix-cache gauge snapshot (hit/eviction COUNTERS live on
         ``stats``; same shape as the dp engine's aggregate)."""
@@ -1006,7 +1202,12 @@ class PagedTPUEngine:
                     continue
                 if new_from < node.tok_len:
                     try:
-                        self._prefill_prefix_pages(ids, node, new_from)
+                        # the disk tier attached at boot serves real KV
+                        # bytes here; only uncovered pages re-prefill
+                        start = self._promote_from_tier(ids, node,
+                                                        new_from)
+                        if start < node.tok_len:
+                            self._prefill_prefix_pages(ids, node, start)
                     except Exception:
                         # same rollback as submit_request: the new nodes
                         # hold uncommitted (garbage) KV — left alive they
@@ -1086,6 +1287,8 @@ class PagedTPUEngine:
                     free,
                     pc.cached_pages if pc is not None else 0,
                     self._pinned_sample,
+                    self.kv_tiers.queue_depth
+                    if self.kv_tiers is not None else 0,
                     self.stats.prefix_hit_tokens,
                     self.stats.spec_accepted_tokens,
                     st.pending[1] if st.pending is not None else 0,

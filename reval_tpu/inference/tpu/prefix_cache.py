@@ -66,11 +66,17 @@ class RadixPrefixCache:
     it per update rather than hold a reference)."""
 
     def __init__(self, rt, page_size: int, *, watermark: int = 0,
-                 stats=None):
+                 stats=None, spill=None):
         self.rt = rt
         self.page = page_size
         self.watermark = watermark
         self._stats = stats if stats is not None else lambda: None
+        # KV-tier spill hook (kv_tiers.py): called with the victim node
+        # just BEFORE eviction releases its page — the pages are still
+        # refcounted, so the engine can read them out.  LRU eviction
+        # only: drop_tail rollbacks hold uncommitted garbage KV and
+        # clear() is teardown — neither must ever reach a colder tier.
+        self._spill = spill
         self.children: dict = {}       # root level: first page tuple → node
         self._tick = 0
         self.nodes = 0
@@ -190,6 +196,11 @@ class RadixPrefixCache:
             if not leaves:
                 break
             victim = min(leaves, key=lambda n: n.tick)
+            if self._spill is not None:
+                # while the page is still held — the hook dispatches a
+                # device-side copy; a failed spill loses tier warmth,
+                # never the eviction (the engine counts it)
+                self._spill(victim)
             self._drop(victim)
             freed += 1
             if stats is not None:

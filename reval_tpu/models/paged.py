@@ -60,6 +60,8 @@ __all__ = [
     "commit_verify",
     "gather_prefix_context",
     "prefill_with_paged_context",
+    "gather_tier_page",
+    "promote_tier_page",
 ]
 
 
@@ -320,6 +322,65 @@ def prefill_with_paged_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
     return prefill_with_batched_context(
         params, cfg, tokens, pad_len, ctx_k, ctx_v, ctx_len, cache,
         logits_mode=logits_mode)
+
+
+def gather_tier_page(cache: PagedKVCache, page: jnp.ndarray) -> tuple:
+    """Slice ONE page's rows out of every pool array — the KV-tier spill
+    read (kv_tiers.py).  ``page`` is a [1] int32 page id; returns a flat
+    tuple of per-layer ``[P, H_kv, D]`` k then v blocks (then ``[P,
+    H_kv]`` k/v scales for an int8 pool) in the tier store's canonical
+    block order.
+
+    A dynamic slice on the leading (token-major) dim — the same
+    whole-page-contiguous property the gather path rides.  The result
+    aliases nothing (a slice is a copy), so the engine releases the pool
+    page immediately after dispatch: the later donated pool write cannot
+    clobber an in-flight spill because XLA orders both on the device
+    stream.
+    """
+    p = cache.page_size
+    start = page[0] * p
+
+    def rows(pool):
+        return jax.lax.dynamic_slice_in_dim(pool, start, p, axis=0)
+
+    out = [rows(cache.k[i]) for i in range(len(cache.k))]
+    out += [rows(cache.v[i]) for i in range(len(cache.v))]
+    if cache.quantized:
+        out += [rows(cache.k_scale[i]) for i in range(len(cache.k_scale))]
+        out += [rows(cache.v_scale[i]) for i in range(len(cache.v_scale))]
+    return tuple(out)
+
+
+def promote_tier_page(cache: PagedKVCache, page: jnp.ndarray,
+                      blocks: tuple) -> PagedKVCache:
+    """Scatter one spilled page's blocks back into the pool at ``page``
+    (a [1] int32 page id) — the KV-tier promotion write, the exact
+    inverse of :func:`gather_tier_page` (same flat block order).
+
+    A leading-dim ``dynamic_update_slice`` on the donated pool — in
+    place, like the decode scatter.  The blocks are raw bytes hashed at
+    spill time, so a promoted page is bit-identical to what the resident
+    page held: promotion can never change an answer.
+    """
+    p = cache.page_size
+    start = page[0] * p
+    nl = len(cache.k)
+
+    def put(pool, block):
+        return jax.lax.dynamic_update_slice_in_dim(
+            pool, block.astype(pool.dtype), start, axis=0)
+
+    new_k = tuple(put(cache.k[i], blocks[i]) for i in range(nl))
+    new_v = tuple(put(cache.v[i], blocks[nl + i]) for i in range(nl))
+    new_ks = new_vs = None
+    if cache.quantized:
+        new_ks = tuple(put(cache.k_scale[i], blocks[2 * nl + i])
+                       for i in range(nl))
+        new_vs = tuple(put(cache.v_scale[i], blocks[3 * nl + i])
+                       for i in range(nl))
+    return PagedKVCache(k=new_k, v=new_v, page_size=p,
+                        k_scale=new_ks, v_scale=new_vs)
 
 
 def commit_verify(cache: PagedKVCache, kv: "KVCache", tables: jnp.ndarray,

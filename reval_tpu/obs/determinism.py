@@ -105,7 +105,8 @@ DEFAULT_REFERENCE = "paged-xla-fp32-b2"
 PARITY_SLICE = ("paged-xla-fp32-b2", "static-fp32-b2",
                 "paged-pallas_seq-fp32-b2", "paged-pallas-fp32-b2",
                 "paged-xla-fp32-dp2-b2", "paged-xla-fp32-b4",
-                "spec-paged-xla-fp32-b2", "spec-paged-xla-fp32-b4")
+                "spec-paged-xla-fp32-b2", "spec-paged-xla-fp32-b4",
+                "kvtier-paged-xla-fp32-b2")
 
 #: the bench garnish slice: cheap cross-backend sanity (reference +
 #: static engine + seq kernel + the speculative greedy-accept
@@ -145,13 +146,18 @@ class CellSpec:
     #: by contract, with the measured accept rate recorded as
     #: drift-allowed telemetry on the cell row
     spec: bool = False
+    #: KV tiering exercised (inference/tpu/kv_tiers.py): the cell's
+    #: measured generation promotes every cached prefix page back out of
+    #: the host-DRAM tier (a priming pass spills them first) — the
+    #: spilled-and-promoted stream must be bit-identical to resident
+    kvtier: bool = False
     expect: str = "bit_identical"
 
     def axes(self) -> dict:
         return {"engine": self.engine, "kernel": self.kernel,
                 "dp": self.dp, "dtype": self.dtype,
                 "kv_dtype": self.kv_dtype, "batch": self.batch,
-                "spec": self.spec}
+                "spec": self.spec, "kvtier": self.kvtier}
 
 
 def default_cells() -> list[CellSpec]:
@@ -176,6 +182,9 @@ def default_cells() -> list[CellSpec]:
         CellSpec("spec-paged-xla-fp32-b2", "paged", "xla", spec=True),
         CellSpec("spec-paged-xla-fp32-b4", "paged", "xla", batch=4,
                  spec=True),
+        # KV-tier axis: the spill→promote round trip (host-DRAM tier)
+        # must serve byte-for-byte what the resident pages would have
+        CellSpec("kvtier-paged-xla-fp32-b2", "paged", "xla", kvtier=True),
         # dtype axis: numeric drift is expected; its SIZE is telemetry
         CellSpec("paged-xla-bf16-b2", "paged", "xla", dtype="bf16",
                  expect="drift_allowed"),
@@ -304,12 +313,19 @@ class _MatrixRunner:
         from ..inference.tpu.paged_engine import PagedTPUEngine
 
         return PagedTPUEngine(params, self.cfg, self.tokenizer,
-                              max_slots=spec.batch, page_size=128,
+                              max_slots=spec.batch,
+                              # kvtier cells shrink pages so the ~66-token
+                              # probes span FULL cacheable pages (only full
+                              # pages spill); page geometry is a memory
+                              # layout, not a numeric axis, so the stream
+                              # must still match the 128-page reference
+                              page_size=32 if spec.kvtier else 128,
                               max_seq_len=256, kv_dtype=spec.kv_dtype,
                               # spec cells FORCE speculation on (n-gram
                               # drafting engages without a grammar);
                               # None keeps the engine's default gating
-                              speculative=True if spec.spec else None)
+                              speculative=True if spec.spec else None,
+                              kv_tiering=True if spec.kvtier else None)
 
     def _logits_topk(self, spec: CellSpec, k: int) -> list[dict]:
         """Top-k ids + quantized logit values at the last prompt
@@ -348,10 +364,21 @@ class _MatrixRunner:
         carrying the error — a broken backend is a report finding, not
         a crash."""
         try:
-            spec_row = None
+            spec_row = tier_row = None
             with _cell_env(spec):
                 eng = self._build(spec)
                 try:
+                    if spec.kvtier:
+                        # prime the prefix cache, force-evict it so
+                        # every page spills to the host tier, then let
+                        # the copier drain: the measured generate below
+                        # is served from PROMOTED pages, and must match
+                        # the resident streams of the reference cell
+                        eng.generate(list(self.probes),
+                                     max_new_tokens=self.max_new,
+                                     temperature=0.0)
+                        eng.prefix_cache.evict_lru(10**6)
+                        eng.kv_tiers.drain(5.0)
                     # raw id streams, not re-encoded text: EOS and
                     # vocab-padding ids are invisible in text, and an
                     # argmax flip between two of them is exactly the
@@ -364,6 +391,10 @@ class _MatrixRunner:
                         # contract cell: the accept rate may move round
                         # to round; the token stream may not
                         spec_row = eng.spec_counters()
+                    if spec.kvtier:
+                        # telemetry proving the tier round trip really
+                        # ran (promotions > 0) — drift-allowed counts
+                        tier_row = eng.kv_tier_counters()
                 finally:
                     if hasattr(eng, "close"):
                         eng.close()
@@ -373,6 +404,8 @@ class _MatrixRunner:
                    "logits_topk": self._logits_topk(spec, topk)}
             if spec_row is not None:
                 row["spec_counters"] = spec_row
+            if tier_row is not None:
+                row["kv_tier_counters"] = tier_row
             return row
         except Exception as e:  # noqa: BLE001 — per-cell isolation is
             # the contract: discovery is static, load failures land here
@@ -628,8 +661,8 @@ def render_table(matrix: dict) -> str:
         f"`{matrix['schema']}`",
         "",
         "| cell | engine | kernel | dp | dtype | kv | batch | spec | "
-        "expect | verdict | first divergence | logit drift |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "tier | expect | verdict | first divergence | logit drift |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for name, row in sorted(matrix["cells"].items(),
                             key=lambda kv: (kv[1]["status"] != "ref",
@@ -650,11 +683,15 @@ def render_table(matrix: dict) -> str:
         sc = row.get("spec_counters")
         spec_col = (f"on ({sc['accept_rate']:.0%} acc)" if sc
                     else ("on" if ax.get("spec") else "—"))
+        tc = row.get("kv_tier_counters")
+        tier_col = (f"on ({tc['promotions']} promo, "
+                    f"{tc['promote_hit_rate']:.0%} hit)" if tc
+                    else ("on" if ax.get("kvtier") else "—"))
         lines.append(
             f"| `{name}` | {ax['engine']} | {ax['kernel']} | {ax['dp']} "
             f"| {ax['dtype']} | {ax['kv_dtype'] or '—'} | {ax['batch']} "
-            f"| {spec_col} | {row['expect']} | {verdict} | {first} "
-            f"| {drift} |")
+            f"| {spec_col} | {tier_col} | {row['expect']} | {verdict} "
+            f"| {first} | {drift} |")
     s = matrix["summary"]
     lines += ["",
               f"{s['cells_run']} run · {s['cells_agree']} agree · "

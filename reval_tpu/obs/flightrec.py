@@ -65,6 +65,7 @@ FIELDS = (
     "free_pages",        # KV pool pages free
     "cached_pages",      # pages held by the radix prefix cache
     "pinned_pages",      # cache pages pinned by riders (decimated sample)
+    "tier_queue",        # KV-tier spill queue depth (kv_tiers.py; 0 = off)
     "prefix_hit_tokens",  # cumulative cache-hit tokens (delta = per-step)
     "spec_accepted",     # cumulative accepted draft tokens (speculative)
     "chunk_steps",       # decode steps of the in-flight/last chunk
@@ -89,9 +90,9 @@ class FlightRecorder:
         self._buf: list = [None] * self.capacity
 
     def record(self, running: int, queued: int, free_pages: int,  # hot-path
-               cached_pages: int, pinned_pages: int, prefix_hit_tokens: int,
-               spec_accepted: int, chunk_steps: int, step_s: float,
-               hb_age: float, seq_ids: tuple) -> None:
+               cached_pages: int, pinned_pages: int, tier_queue: int,
+               prefix_hit_tokens: int, spec_accepted: int, chunk_steps: int,
+               step_s: float, hb_age: float, seq_ids: tuple) -> None:
         """One drive tick's state.  Single tuple store; no locking (one
         writer — the engine's driver thread)."""
         if not self.enabled:
@@ -99,8 +100,8 @@ class FlightRecorder:
         n = self.total
         self._buf[n % self.capacity] = (
             n, time.time(), running, queued, free_pages, cached_pages,
-            pinned_pages, prefix_hit_tokens, spec_accepted, chunk_steps,
-            step_s * 1e3, hb_age * 1e3, seq_ids)
+            pinned_pages, tier_queue, prefix_hit_tokens, spec_accepted,
+            chunk_steps, step_s * 1e3, hb_age * 1e3, seq_ids)
         self.total = n + 1
 
     def records(self, last: int | None = None) -> list[tuple]:

@@ -109,6 +109,18 @@ EVENTS: dict[str, str] = {
     "session.snapshot_error": "a warm-state snapshot could not be "
                               "written or read (corrupt/unwritable); "
                               "the engine boots cold",
+    # hierarchical KV tiering (inference/tpu/kv_tiers.py)
+    "kvtier.degrade": "a tier fault (integrity/io/timeout rung) dropped "
+                      "the page; it recomputes from its token chain via "
+                      "prefill — never wrong KV",
+    "kvtier.integrity_failure": "a promotion's payload failed its "
+                                "spill-time sha256 (bit rot, torn "
+                                "write, or injected corruption)",
+    "kvtier.spill_error": "a spill copy faulted on the copier thread; "
+                          "the page loses tier warmth, never "
+                          "correctness",
+    "kvtier.disk_error": "a disk-tier page file could not be written "
+                         "or read; the drain/boot degrades gracefully",
     # crash-loop supervisor (serving/supervisor.py)
     "supervisor.spawn": "the supervisor (re)spawned the child server",
     "supervisor.death": "the supervised child server died; a postmortem "

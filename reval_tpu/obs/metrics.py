@@ -53,6 +53,11 @@ __all__ = [
     "SHARD_CHECKS", "SHARD_RESPECS",
     "DET_CELLS", "DET_AGREE", "DET_DIVERGED", "DET_SKIPPED",
     "DET_DEPTH", "DET_DRIFT", "DRIFT_BUCKETS",
+    "KVTIER_SPILLS", "KVTIER_SPILL_DROPS", "KVTIER_SPILL_ERRORS",
+    "KVTIER_PROMOTIONS", "KVTIER_DISK_PROMOTIONS", "KVTIER_RECOMPUTES",
+    "KVTIER_INTEGRITY_FAILURES", "KVTIER_HOST_EVICTIONS",
+    "KVTIER_HOST_PAGES", "KVTIER_HOST_BYTES", "KVTIER_DISK_PAGES",
+    "KVTIER_QUEUE_DEPTH", "KVTIER_PROMOTE_SECONDS",
     "AOT_HITS", "AOT_MISSES", "AOT_ERRORS", "AOT_UNSUPPORTED",
     "AOT_SAVED_SECONDS", "AOT_ENTRIES", "AOT_BYTES",
     "RESTART_TO_READY", "RESTART_WARM_PREFIXES",
@@ -153,6 +158,19 @@ DET_DIVERGED = "reval_determinism_cells_diverged_total"
 DET_SKIPPED = "reval_determinism_cells_skipped_total"
 DET_DEPTH = "reval_determinism_divergence_depth"
 DET_DRIFT = "reval_determinism_logit_drift"
+KVTIER_SPILLS = "reval_kvtier_spills_total"
+KVTIER_SPILL_DROPS = "reval_kvtier_spill_drops_total"
+KVTIER_SPILL_ERRORS = "reval_kvtier_spill_errors_total"
+KVTIER_PROMOTIONS = "reval_kvtier_promotions_total"
+KVTIER_DISK_PROMOTIONS = "reval_kvtier_disk_promotions_total"
+KVTIER_RECOMPUTES = "reval_kvtier_recomputes_total"
+KVTIER_INTEGRITY_FAILURES = "reval_kvtier_integrity_failures_total"
+KVTIER_HOST_EVICTIONS = "reval_kvtier_host_evictions_total"
+KVTIER_HOST_PAGES = "reval_kvtier_host_pages"
+KVTIER_HOST_BYTES = "reval_kvtier_host_bytes"
+KVTIER_DISK_PAGES = "reval_kvtier_disk_pages"
+KVTIER_QUEUE_DEPTH = "reval_kvtier_queue_depth"
+KVTIER_PROMOTE_SECONDS = "reval_kvtier_promote_seconds"
 
 #: The canonical metric namespace: name -> (type, help[, buckets]).
 #: ``tools/check_metrics.py`` lints this dict against the README table.
@@ -453,6 +471,63 @@ METRICS: dict[str, dict] = {
                         "cell (weight-dtype observable; shared-id + "
                         "rank-aligned), one observation per compared "
                         "cell"},
+    # hierarchical KV tiering (inference/tpu/kv_tiers.py) — HBM →
+    # host-DRAM → disk page store behind the radix prefix cache; every
+    # degrade-ladder rung is a counter, promotion correctness is the
+    # bit-identity contract
+    KVTIER_SPILLS: {"type": "counter",
+                    "help": "Evicted prefix-cache pages copied down to "
+                            "the host-DRAM tier (copier thread; sha256 "
+                            "stamped at spill)"},
+    KVTIER_SPILL_DROPS: {"type": "counter",
+                         "help": "Spills dropped at the bounded handoff "
+                                 "queue (backpressure: the drive tick "
+                                 "never waits on the host path)"},
+    KVTIER_SPILL_ERRORS: {"type": "counter",
+                          "help": "Spill copies that faulted on the "
+                                  "copier thread (warmth lost, never "
+                                  "correctness; each also logs "
+                                  "kvtier.spill_error)"},
+    KVTIER_PROMOTIONS: {"type": "counter",
+                        "help": "Pages promoted back into the HBM pool "
+                                "from a colder tier (sha256 verified; "
+                                "byte-identical to the resident page)"},
+    KVTIER_DISK_PROMOTIONS: {"type": "counter",
+                             "help": "Promotions whose payload came off "
+                                     "the disk tier (snapshot sidecar) "
+                                     "rather than host DRAM"},
+    KVTIER_RECOMPUTES: {"type": "counter",
+                        "help": "Degrade-ladder fallbacks: pages "
+                                "recomputed from their token chain via "
+                                "prefill after a tier fault (each also "
+                                "logs kvtier.degrade with the rung)"},
+    KVTIER_INTEGRITY_FAILURES: {"type": "counter",
+                                "help": "Promotions rejected on sha256 "
+                                        "mismatch (bit rot, torn write, "
+                                        "or injected corruption) — the "
+                                        "never-wrong-KV gate"},
+    KVTIER_HOST_EVICTIONS: {"type": "counter",
+                            "help": "Host-tier payloads LRU-dropped "
+                                    "past REVAL_TPU_KVTIER_HOST_MB "
+                                    "(disk-backed entries demote to "
+                                    "path-only instead)"},
+    KVTIER_HOST_PAGES: {"type": "gauge",
+                        "help": "Pages resident in the host-DRAM tier "
+                                "(copier's view, last touch)"},
+    KVTIER_HOST_BYTES: {"type": "gauge",
+                        "help": "Payload bytes resident in the "
+                                "host-DRAM tier (last touch)"},
+    KVTIER_DISK_PAGES: {"type": "gauge",
+                        "help": "Disk-tier entries attached from a "
+                                "snapshot sidecar and not yet promoted "
+                                "or dropped (last touch)"},
+    KVTIER_QUEUE_DEPTH: {"type": "gauge",
+                         "help": "Spill handoff queue depth (bounded by "
+                                 "REVAL_TPU_KVTIER_QUEUE; last touch)"},
+    KVTIER_PROMOTE_SECONDS: {"type": "histogram", "buckets": STEP_BUCKETS,
+                             "help": "One page promotion: tier fetch + "
+                                     "verify + jitted scatter into the "
+                                     "pool"},
 }
 
 

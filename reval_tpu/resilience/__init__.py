@@ -27,13 +27,17 @@ everything with nothing written.  The pieces compose:
 - :class:`KernelCellChaos` — targeted per-cell faults (wedge / timeout /
   flaky-device) for the kernel-CI harness's supervised benchmark cells,
   so every degradation path of the perf instrument is drillable on CPU;
+- :class:`TierChaos` — seeded faults (corrupt / stall / fail) on KV tier
+  promotions (``inference/tpu/kv_tiers.py``), proving every rung of the
+  tier degrade ladder recomputes instead of serving wrong KV;
 - :class:`StallWatchdog` — the no-progress + failed-device-probe trip
   wire ``bench.py`` arms per round and ``reval_tpu/kernelbench.py`` arms
   per cell.
 """
 
 from .chaos import (CHAOS_MODES, ENGINE_STEP_MODES, KERNEL_CELL_MODES,
-                    ChaosBackend, EngineStepChaos, KernelCellChaos)
+                    TIER_MODES, ChaosBackend, EngineStepChaos,
+                    KernelCellChaos, TierChaos)
 from .checkpoint import FleetCheckpoint
 from .resilient import INFER_FAILED, ResilientBackend
 from .retry import (RetryPolicy, retry_after_from_headers, retry_after_hint,
@@ -44,9 +48,11 @@ __all__ = [
     "CHAOS_MODES",
     "ENGINE_STEP_MODES",
     "KERNEL_CELL_MODES",
+    "TIER_MODES",
     "ChaosBackend",
     "EngineStepChaos",
     "KernelCellChaos",
+    "TierChaos",
     "StallWatchdog",
     "FleetCheckpoint",
     "INFER_FAILED",

@@ -33,13 +33,76 @@ import urllib.error
 import zlib
 
 __all__ = ["CHAOS_MODES", "ENGINE_STEP_MODES", "KERNEL_CELL_MODES",
-           "ChaosBackend", "EngineStepChaos", "KernelCellChaos"]
+           "TIER_MODES", "ChaosBackend", "EngineStepChaos",
+           "KernelCellChaos", "TierChaos"]
 
 CHAOS_MODES = ("timeout", "http_500", "bad_json", "latency")
 
 ENGINE_STEP_MODES = ("stall", "error")
 
 KERNEL_CELL_MODES = ("wedge", "timeout", "flaky-device")
+
+TIER_MODES = ("corrupt", "stall", "fail")
+
+
+class TierChaos:
+    """Seeded fault injection for the hierarchical KV tier store
+    (``inference/tpu/kv_tiers.py``) — the ``EngineStepChaos`` sibling
+    for page promotions.  Faults fire when the driver fetches a spilled
+    page back out of the host-DRAM or disk tier, exercising every rung
+    of the typed degrade ladder:
+
+    - ``corrupt``: the fetched payload comes back with one byte flipped;
+      the sha256 stamped at spill then fails verification
+      (``TierIntegrityError`` → drop the entry, recompute from tokens);
+    - ``stall``: the fetch hangs for ``stall_s`` (a slow/contended host
+      path); with ``stall_s`` past the store's promotion deadline this
+      is the deterministic way to trip the timeout rung;
+    - ``fail``: the fetch raises ``OSError`` (a dead disk / exhausted
+      host mapping) — the tier I/O rung.
+
+    The schedule is keyed on the page's CHAIN KEY alone (crc32 ^ seed,
+    never Python's salted ``hash``), so a run injects the same faults on
+    the same pages regardless of eviction order or timing.
+    ``max_faults`` bounds the total, i.e. chaos is transient: with the
+    recompute fallback underneath, a drill loses zero prompts.
+    """
+
+    def __init__(self, rate: float = 0.2, seed: int = 0,
+                 modes: tuple[str, ...] = TIER_MODES,
+                 stall_s: float = 0.05, max_faults: int | None = None,
+                 sleep=time.sleep):
+        assert 0.0 <= rate <= 1.0, f"chaos rate must be in [0, 1], got {rate}"
+        unknown = set(modes) - set(TIER_MODES)
+        assert not unknown, f"unknown tier chaos modes: {sorted(unknown)}"
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.modes = tuple(modes)
+        self.stall_s = float(stall_s)
+        self.max_faults = max_faults
+        self.sleep = sleep
+        # guarded-by: _lock (writes) — callers read the ledger after the
+        # run; the driver and a rewarming boot thread may both promote
+        self.injected: list[tuple[str, str]] = []   # (mode, key prefix)
+        self._lock = _threading.Lock()
+
+    def draw(self, key: str) -> str | None:
+        """The fault (or None) for one promotion fetch of ``key``.
+        Deterministic per (key, seed); consumes fault budget when armed.
+        The stall itself happens in the tier store (OUTSIDE the lock) so
+        one stalled promotion never blocks a sibling's schedule."""
+        with self._lock:
+            if (self.max_faults is not None
+                    and len(self.injected) >= self.max_faults):
+                return None
+            rng = random.Random(
+                (zlib.crc32(key.encode("utf-8", "replace")) << 32)
+                ^ self.seed)
+            if rng.random() >= self.rate:
+                return None
+            mode = self.modes[rng.randrange(len(self.modes))]
+            self.injected.append((mode, key[:12]))
+        return mode
 
 
 class KernelCellChaos:

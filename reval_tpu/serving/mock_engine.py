@@ -281,5 +281,5 @@ class MockStepEngine:
         if self.flightrec.enabled:
             self.flightrec.record(
                 sum(1 for r in reqs.values() if not r.done), 0, 0, 0, 0, 0,
-                0, self.tokens_per_step, dt,
+                0, 0, self.tokens_per_step, dt,
                 time.monotonic() - self.heartbeat, tuple(reqs))

@@ -900,12 +900,35 @@ def serve_config(cfg: dict, *, port: int | None = None,
                  "watchdog_s": cfg.get("watchdog_s"), "tracer": tracer,
                  "postmortem_dir": cfg.get("postmortem_dir"),
                  # warm restarts: drain writes the snapshot here, boot
-                 # replays it (default env REVAL_TPU_SNAPSHOT_PATH)
-                 "snapshot_path": cfg.get("snapshot_path")}
+                 # replays it (default env REVAL_TPU_SNAPSHOT_PATH);
+                 # the fallback is a SIBLING's snapshot an autoscaler
+                 # scale-up inherits (read-only)
+                 "snapshot_path": cfg.get("snapshot_path"),
+                 "snapshot_fallback": cfg.get("snapshot_fallback")}
+    # KV-tier fault injection (inference/tpu/kv_tiers.py): deterministic
+    # corrupt/stall/fail faults on tier promotions — every one must
+    # degrade to a recompute, never a wrong token (hardening drills)
+    tier_chaos = None
+    if cfg.get("tier_chaos"):
+        from ..resilience import TierChaos
+
+        modes = cfg.get("tier_chaos_modes")
+        mode_kw = ({"modes": tuple(m for m in str(modes).split(",") if m)}
+                   if modes else {})
+        tier_chaos = TierChaos(
+            rate=float(cfg["tier_chaos"]),
+            seed=int(cfg.get("tier_chaos_seed", 0)),
+            stall_s=float(cfg.get("tier_chaos_stall_s", 0.05)), **mode_kw)
     body_cap = int(cfg.get("max_body_bytes", MAX_BODY_BYTES))
     obs_kw = {"tracer": tracer, "trace_out": trace_out,
               "postmortem_dir": cfg.get("postmortem_dir")}
     if cfg.get("mock"):
+        if tier_chaos is not None:
+            # no KV pool to tier — a drill that silently tests nothing
+            # is worse than a loud error (same rule as step chaos on
+            # sessionless engines)
+            raise ValueError("tier_chaos requires a paged TPU engine — "
+                             "the mock engine has no KV pool to tier")
         from .mock_engine import MockStepEngine
 
         engine = MockStepEngine(
@@ -927,13 +950,18 @@ def serve_config(cfg: dict, *, port: int | None = None,
     from ..inference.tpu.dp_paged import DataParallelPagedEngine
     from ..inference.tpu.paged_engine import PagedTPUEngine
 
-    backend = TPUBackend(**{k: v for k, v in cfg.items()
+    backend = TPUBackend(tier_chaos=tier_chaos,
+                         **{k: v for k, v in cfg.items()
                             if k not in ("task", "backend", "port", "mock",
                                          "max_queued_tokens", "watchdog_s",
                                          "max_body_bytes", "trace_out",
                                          "postmortem_dir", "mock_response",
                                          "mock_step_s", "mock_echo",
-                                         "mock_rewarm_s", "snapshot_path")})
+                                         "mock_rewarm_s", "snapshot_path",
+                                         "snapshot_fallback", "tier_chaos",
+                                         "tier_chaos_seed",
+                                         "tier_chaos_modes",
+                                         "tier_chaos_stall_s")})
     if warmup:
         secs = warmup_engine(backend.engine)
         print(f"warmup: generation programs compiled in {secs:.1f}s")

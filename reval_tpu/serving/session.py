@@ -212,7 +212,8 @@ class ContinuousSession:
                  max_queued_tokens: int | None = None,
                  watchdog_s: float | None = None, step_chaos=None,
                  tracer=None, postmortem_dir: str | None = None,
-                 snapshot_path: str | None = None):
+                 snapshot_path: str | None = None,
+                 snapshot_fallback: str | None = None):
         self.engine = engine
         # -- warm restarts (serving/snapshot.py) -----------------------------
         #: where the graceful drain lands its warm-state snapshot and
@@ -222,14 +223,20 @@ class ContinuousSession:
                               if snapshot_path is not None
                               else (env_str("REVAL_TPU_SNAPSHOT_PATH", "")
                                     or None))
+        #: autoscaler warm scale-ups: a replica with no snapshot of its
+        #: own boots from a SIBLING's (token tree + v2 disk-tier pages)
+        #: — read-only, never written to
+        self.snapshot_fallback = snapshot_fallback or None
         self._t_boot = time.perf_counter()
         self._snapshot_once = threading.Event()     # drain writes ONE snapshot
         #: boot is replaying a warm-state snapshot through prefill:
         #: /readyz answers 503 "warming" (+ Retry-After, distinct from
         #: draining) until the driver finishes the restore
         self._warming = threading.Event()
-        if self.snapshot_path and os.path.exists(self.snapshot_path) \
-                and hasattr(engine, "rewarm"):
+        if hasattr(engine, "rewarm") and (
+                (self.snapshot_path and os.path.exists(self.snapshot_path))
+                or (self.snapshot_fallback
+                    and os.path.exists(self.snapshot_fallback))):
             self._warming.set()
         #: crash-dump sink: watchdog trips, driver faults, and deadline
         #: storms dump a bundle here (obs/flightrec.py; default
@@ -600,8 +607,21 @@ class ContinuousSession:
         from ..obs import metrics as obs_metrics
 
         try:
-            doc = read_snapshot(self.snapshot_path)
+            src = self.snapshot_path
+            doc = read_snapshot(src) if src else None
+            if doc is None and self.snapshot_fallback:
+                # warm scale-up: no snapshot of our own — inherit a
+                # sibling's (its .pages sidecar rides along below)
+                src = self.snapshot_fallback
+                doc = read_snapshot(src)
             if doc is not None:
+                refs = doc.get("kv_pages")
+                if refs and hasattr(self.engine, "attach_tier_refs"):
+                    # BEFORE rewarm: the replayed chains then promote
+                    # real disk-tier KV bytes instead of re-running
+                    # prefill (kv_tiers.py; garbage refs degrade to the
+                    # v1 replay path inside the engine)
+                    self.engine.attach_tier_refs(refs, f"{src}.pages")
                 warmed = self.engine.rewarm(doc.get("engine") or {})
                 reg = self.engine.stats.registry
                 if warmed:
@@ -610,7 +630,7 @@ class ContinuousSession:
                 reg.histogram(obs_metrics.RESTART_TO_READY).observe(
                     time.perf_counter() - self._t_boot)
                 log_event("session.snapshot_restored",
-                          path=self.snapshot_path, prefix_chains=warmed,
+                          path=src, prefix_chains=warmed,
                           unfinished=len(doc.get("unfinished_request_ids")
                                          or []),
                           restore_s=round(
@@ -638,11 +658,23 @@ class ContinuousSession:
             log_event("session.snapshot_error", level="warning",
                       path=self.snapshot_path, where="warm_state", exc=exc)
             return
+        kv_pages = None
+        if hasattr(self.engine, "dump_tier_pages"):
+            try:
+                # v2 disk tier: warm pages land in the sidecar dir, their
+                # refs in the snapshot doc (kv_tiers.py); a failed dump
+                # still writes the v1-equivalent token-tree snapshot
+                kv_pages = self.engine.dump_tier_pages(
+                    f"{self.snapshot_path}.pages") or None
+            except Exception as exc:   # noqa: BLE001
+                log_event("kvtier.disk_error", level="warning",
+                          where="drain", path=self.snapshot_path, exc=exc)
         with self._acct_lock:
             unfinished = [sub.request_id for sub in self._inflight
                           if not sub.pending.done()]
         write_snapshot(self.snapshot_path, state,
-                       unfinished_request_ids=unfinished)
+                       unfinished_request_ids=unfinished,
+                       kv_pages=kv_pages)
 
     def _run(self) -> None:
         eng = self.engine
@@ -900,7 +932,8 @@ class MultiSession:
                  max_queued_tokens: int | None = None,
                  watchdog_s: float | None = None, step_chaos=None,
                  tracer=None, postmortem_dir: str | None = None,
-                 snapshot_path: str | None = None):
+                 snapshot_path: str | None = None,
+                 snapshot_fallback: str | None = None):
         if snapshot_path is None:
             # resolve the env default HERE so replicas get distinct
             # files — each falling back independently would collide on
@@ -920,7 +953,11 @@ class MultiSession:
                                            # engine's warm state
                                            snapshot_path=(
                                                f"{snapshot_path}.r{i}"
-                                               if snapshot_path else ""))
+                                               if snapshot_path else ""),
+                                           # every replica may inherit
+                                           # the same sibling snapshot
+                                           # (scale-up warm boot)
+                                           snapshot_fallback=snapshot_fallback)
                          for i, e in enumerate(engines)]
         #: the server's SIGUSR1/SIGTERM dumps use this writer, so a dp
         #: set honors the configured directory exactly like a single

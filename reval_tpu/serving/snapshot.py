@@ -14,6 +14,13 @@ before ``/readyz`` flips, surfacing the interval as the distinct
 space — crc32 of the first prompt page's ids, the engine-side analog
 of the router's char-window affinity key, not the same hash.)
 
+Format **v2** additionally carries disk-tier KV page refs
+(``kv_pages``: key/file/sha256 per page, files in a ``<path>.pages``
+sidecar directory — inference/tpu/kv_tiers.py): the next boot promotes
+the actual KV bytes instead of replaying prefill per chain.  v1
+documents stay readable — they simply have no pages to promote, so
+rewarm falls back to the v1 prefill-replay path.
+
 Degradation contract (mirrors the AOT cache): a truncated, garbage, or
 wrong-format snapshot file boots a COLD engine with one
 ``session.snapshot_error`` warning event — never a wedged startup; a
@@ -31,22 +38,30 @@ import time
 
 from ..obs.logging import log_event
 
-__all__ = ["read_snapshot", "write_snapshot", "FORMAT"]
+__all__ = ["read_snapshot", "write_snapshot", "FORMAT", "ACCEPTED_FORMATS"]
 
-FORMAT = "reval-warm-snapshot-v1"
+FORMAT = "reval-warm-snapshot-v2"
+
+#: formats read_snapshot admits: v1 docs (pre-KV-tiering) rewarm the
+#: token tree exactly as before, just without disk-tier pages
+ACCEPTED_FORMATS = ("reval-warm-snapshot-v1", FORMAT)
 
 
 def write_snapshot(path: str, engine_state: dict,
-                   unfinished_request_ids: list | None = None) -> bool:
+                   unfinished_request_ids: list | None = None,
+                   kv_pages: list | None = None) -> bool:
     """Atomically land one warm-state snapshot; True on success.  Every
     failure shape (unwritable dir, full disk) degrades to a
     ``session.snapshot_error`` warning — a drain must finish whether or
-    not its snapshot lands."""
+    not its snapshot lands.  ``kv_pages``: disk-tier page refs from
+    :meth:`TieredPageStore.write_disk` (absent = no disk tier)."""
     doc = {"format": FORMAT,
            "created_ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
            "pid": os.getpid(),
            "engine": engine_state or {},
            "unfinished_request_ids": list(unfinished_request_ids or [])}
+    if kv_pages:
+        doc["kv_pages"] = list(kv_pages)
     tmp = f"{path}.tmp"
     try:
         parent = os.path.dirname(os.path.abspath(path))
@@ -64,6 +79,7 @@ def write_snapshot(path: str, engine_state: dict,
         return False
     chains = len((engine_state or {}).get("prefix_chains") or [])
     log_event("session.snapshot_written", path=path, prefix_chains=chains,
+              kv_pages=len(doc.get("kv_pages") or []),
               unfinished=len(doc["unfinished_request_ids"]))
     return True
 
@@ -77,7 +93,7 @@ def read_snapshot(path: str) -> dict | None:
     try:
         with open(path) as f:
             doc = json.load(f)
-        if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+        if not isinstance(doc, dict) or doc.get("format") not in ACCEPTED_FORMATS:
             raise ValueError(f"not a {FORMAT} document")
         if not isinstance(doc.get("engine"), dict):
             raise ValueError("snapshot carries no engine state object")

@@ -168,6 +168,12 @@ def render_screen(status: dict, debug: dict, prev_counters: dict | None,
             f"  warm_prefixes {int(warm)}"
             f"  cache_entries {int(gauges.get(obs_metrics.AOT_ENTRIES, 0))}")
 
+    # KV-tier row: only once the tier store has seen traffic (tiering
+    # off or idle keeps the screen short)
+    tier = _kvtier_row(counters, gauges)
+    if tier:
+        lines.append(tier)
+
     faults = [e for e in (debug.get("recent_logs") or ())
               if e.get("level") in ("error", "warning")][-4:]
     lines.append("last faults" + ("  (none)" if not faults else ""))
@@ -176,6 +182,26 @@ def render_screen(status: dict, debug: dict, prev_counters: dict | None,
         lines.append(f"  {e.get('ts', '')} [{e.get('level')}] "
                      f"{e.get('event')} {extra}"[:100])
     return "\n".join(lines) + "\n"
+
+
+def _kvtier_row(counters: dict, gauges: dict) -> str | None:
+    """The hierarchical-KV-tier line (inference/tpu/kv_tiers.py), or
+    None while the store has no story to tell.  Works off whatever
+    registry the screen's /statusz carried — the engine's own for a
+    single server, the replica-merged one for a dp set."""
+    spills = counters.get(obs_metrics.KVTIER_SPILLS, 0)
+    promos = counters.get(obs_metrics.KVTIER_PROMOTIONS, 0)
+    recomputes = counters.get(obs_metrics.KVTIER_RECOMPUTES, 0)
+    integrity = counters.get(obs_metrics.KVTIER_INTEGRITY_FAILURES, 0)
+    host = gauges.get(obs_metrics.KVTIER_HOST_PAGES, 0)
+    disk = gauges.get(obs_metrics.KVTIER_DISK_PAGES, 0)
+    queue = gauges.get(obs_metrics.KVTIER_QUEUE_DEPTH, 0)
+    if not (spills or promos or recomputes or host or disk):
+        return None
+    return (f"kv tiers     host {int(host)}p  disk {int(disk)}p"
+            f"  queue {int(queue)}  spills {int(spills)}"
+            f"  promotions {int(promos)}  recomputes {int(recomputes)}"
+            f"  integrity_fail {int(integrity)}")
 
 
 #: router counters whose running totals headline the fleet view
@@ -225,6 +251,7 @@ def render_router_screen(status: dict, prev_counters: dict | None,
     (autoscaler) rows, one row per replica underneath."""
     metrics = status.get("metrics", {})
     counters = metrics.get("counters", {})
+    gauges = metrics.get("gauges", {})
     hists = metrics.get("histograms", {})
     replicas = status.get("replicas") or []
     ready_n = sum(1 for r in replicas
@@ -289,6 +316,12 @@ def render_router_screen(status: dict, prev_counters: dict | None,
                      f"sheds {sheds:>5} ({shed_txt})  e2e p95 {p95}")
     if not tenants:
         lines.append("tenant       (no tenant traffic observed)")
+
+    # fleet-wide KV tiers (counters arrive pre-merged when the statusz
+    # body federates replica registries)
+    tier = _kvtier_row(counters, gauges)
+    if tier:
+        lines.append(tier)
 
     # the admin action log tail: drains/rejoins/resizes with the
     # caller's reason — a live autoscaler's story reads right here

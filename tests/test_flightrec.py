@@ -72,7 +72,7 @@ class TestFlightRecorder:
     def test_ring_wraps_and_keeps_newest(self):
         fr = FlightRecorder(capacity=8, enabled=True)
         for i in range(20):
-            fr.record(i, 0, 100 - i, 0, 0, 0, 0, 32, 0.001, 0.0, (i,))
+            fr.record(i, 0, 100 - i, 0, 0, 0, 0, 0, 32, 0.001, 0.0, (i,))
         assert fr.total == 20
         recs = fr.records()
         assert len(recs) == 8
@@ -87,7 +87,7 @@ class TestFlightRecorder:
         monkeypatch.setenv("REVAL_TPU_FLIGHTREC", "0")
         fr = FlightRecorder(capacity=8)
         assert fr.enabled is False
-        fr.record(1, 0, 0, 0, 0, 0, 0, 0, 0.0, 0.0, ())
+        fr.record(1, 0, 0, 0, 0, 0, 0, 0, 0, 0.0, 0.0, ())
         assert fr.total == 0 and fr.records() == []
 
     def test_record_cost_stays_sub_20us(self):
@@ -99,14 +99,14 @@ class TestFlightRecorder:
         ids = (1, 2, 3, 4)
         t0 = time.perf_counter()
         for i in range(n):
-            fr.record(4, 2, 100, 8, 4, 1024, 0, 32, 0.001, 0.0005, ids)
+            fr.record(4, 2, 100, 8, 4, 0, 1024, 0, 32, 0.001, 0.0005, ids)
         per = (time.perf_counter() - t0) / n
         assert per < 20e-6, f"record() cost {per * 1e6:.2f}µs"
         assert fr.total == n
 
     def test_partial_snapshot_before_wrap(self):
         fr = FlightRecorder(capacity=16, enabled=True)
-        fr.record(1, 0, 0, 0, 0, 0, 0, 0, 0.002, 0.0, ())
+        fr.record(1, 0, 0, 0, 0, 0, 0, 0, 0, 0.002, 0.0, ())
         snap = fr.snapshot()
         assert len(snap) == 1 and snap[0]["step"] == 0
         assert snap[0]["step_ms"] == pytest.approx(2.0)

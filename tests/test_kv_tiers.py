@@ -1,0 +1,504 @@
+"""Hierarchical KV page tiering (inference/tpu/kv_tiers.py).
+
+Store units run jax-free: spill/promote round trips, chain-key
+semantics, backpressure, the host-byte LRU bound, the on-disk page file
+format, and every rung of the typed degrade ladder under seeded
+``TierChaos``.  Engine tests pin the eval-harness contract on a tiny
+CPU model: greedy token streams byte-identical across the resident,
+spilled-and-promoted, and recomputed paths; the disk tier (snapshot v2
+sidecar) promoting real bytes on a fresh engine; and the tier-1 chaos
+drill — a diurnal multi-tenant loadgen workload over an
+HBM-overflowing pool with corrupt+fail faults, zero lost prompts,
+outputs byte-identical to the no-tier baseline.
+"""
+
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from reval_tpu.inference.tpu.engine import EngineStats
+from reval_tpu.inference.tpu.kv_tiers import (
+    TierEntry,
+    TieredPageStore,
+    TierIntegrityError,
+    TierIOError,
+    TierTimeoutError,
+    _read_page_file,
+    _write_page_file,
+    chain_key,
+)
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.obs.logging import recent
+from reval_tpu.resilience import TierChaos
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+def payload_for(seed: int, kb: int = 4) -> list[np.ndarray]:
+    """A deterministic fake page payload (a few pool blocks)."""
+    rng = np.random.default_rng(seed)
+    n = (kb << 10) // 4 // 4
+    return [rng.standard_normal(n).astype(np.float32) for _ in range(4)]
+
+
+def make_store(**kw):
+    kw.setdefault("host_mb", 64)
+    kw.setdefault("queue_cap", 8)
+    kw.setdefault("timeout_s", 5.0)
+    return TieredPageStore(32, **kw)
+
+
+def payloads_equal(a: list[np.ndarray], b: list[np.ndarray]) -> bool:
+    return (len(a) == len(b)
+            and all(x.tobytes() == y.tobytes() for x, y in zip(a, b)))
+
+
+# ---------------------------------------------------------------------------
+# Store units: spill → promote round trip, keys, backpressure, bounds
+# ---------------------------------------------------------------------------
+
+def test_spill_copier_promote_round_trip_bit_identical():
+    stats = EngineStats()
+    store = make_store(stats=lambda: stats)
+    try:
+        tokens = list(range(64))
+        pay = payload_for(1)
+        assert store.spill(tokens, pay) is True
+        assert store.drain(5.0)
+        entry = store.lookup(tokens)
+        assert entry is not None and entry.tier == "host"
+        assert payloads_equal(store.fetch(entry), pay)
+        assert stats.kvtier_spills == 1
+        got = store.counters()
+        assert got["host_pages"] == 1
+        assert got["host_bytes"] == sum(a.nbytes for a in pay)
+        assert got["queue_depth"] == 0
+    finally:
+        store.close()
+
+
+def test_chain_key_is_the_full_prefix_not_the_page():
+    # identical page tokens under different prefixes must never alias:
+    # KV rows encode attention over the ENTIRE root→page chain
+    page = list(range(32, 64))
+    assert chain_key([0] * 32 + page) != chain_key([1] * 32 + page)
+    store = make_store(start_copier=False)
+    try:
+        store.put_host([0] * 32 + page, payload_for(2))
+        assert store.lookup([1] * 32 + page) is None
+        assert store.lookup([0] * 32 + page) is not None
+    finally:
+        store.close()
+
+
+def test_spill_queue_backpressure_drops_never_blocks():
+    stats = EngineStats()
+    store = make_store(stats=lambda: stats, queue_cap=1,
+                       start_copier=False)   # nobody drains the queue
+    try:
+        assert store.spill([1, 2], payload_for(3)) is True
+        assert store.spill([3, 4], payload_for(4)) is False
+        assert stats.kvtier_spill_drops == 1
+        assert store.counters()["queue_depth"] == 1
+    finally:
+        store.close()
+
+
+def test_duplicate_spill_is_refused():
+    store = make_store(start_copier=False)
+    try:
+        store.put_host([7] * 32, payload_for(5))
+        assert store.spill([7] * 32, payload_for(5)) is False
+    finally:
+        store.close()
+
+
+def test_host_bound_lru_drops_bare_and_demotes_disk_backed(tmp_path):
+    store = make_store(host_mb=1, start_copier=False)
+    try:
+        chains = [[i] * 32 for i in range(4)]
+        for i, chain in enumerate(chains):
+            store.put_host(chain, payload_for(i, kb=256))
+        assert store.counters()["host_pages"] == 4      # exactly at bound
+        # the oldest page now has a disk file: crossing the bound must
+        # DEMOTE it (bytes live on disk), not lose it
+        refs = store.write_disk(str(tmp_path / "pages"))
+        assert len(refs) == 4
+        store.put_host([9] * 32, payload_for(9, kb=256))
+        got = store.counters()
+        assert got["host_pages"] == 4
+        demoted = store.lookup(chains[0])
+        assert demoted is not None and demoted.tier == "disk"
+        assert demoted.payload is None
+        # the disk copy still serves the original bytes
+        assert payloads_equal(store.fetch(demoted), payload_for(0, kb=256))
+    finally:
+        store.close()
+
+
+def test_drop_adjusts_gauges_for_both_tiers(tmp_path):
+    store = make_store(start_copier=False)
+    try:
+        store.put_host([1] * 32, payload_for(1))
+        store.write_disk(str(tmp_path / "pages"))
+        ref_store = make_store(start_copier=False)
+        refs = [{"key": chain_key([1] * 32), "file": f"{chain_key([1]*32)}.kvpage",
+                 "sha256": "0" * 64, "nbytes": 1}]
+        assert ref_store.attach_disk(refs, str(tmp_path / "pages")) == 1
+        assert ref_store.counters()["disk_pages"] == 1
+        ref_store.drop(chain_key([1] * 32))
+        assert ref_store.counters()["disk_pages"] == 0
+        ref_store.close()
+        store.drop(chain_key([1] * 32))
+        got = store.counters()
+        assert got["host_pages"] == 0 and got["host_bytes"] == 0
+        store.drop("not-a-key")         # idempotent, never raises
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# The typed degrade ladder: every rung raises its own TierError
+# ---------------------------------------------------------------------------
+
+def test_integrity_rung_fires_on_tampered_payload():
+    store = make_store(start_copier=False)
+    try:
+        entry = store.put_host([5] * 32, payload_for(6))
+        entry.payload[0][0] += 1.0      # bit rot
+        with pytest.raises(TierIntegrityError) as err:
+            store.fetch(entry)
+        assert err.value.reason == "integrity"
+    finally:
+        store.close()
+
+
+def test_io_rung_fires_on_missing_disk_file_after_retry(tmp_path):
+    store = make_store(start_copier=False)
+    try:
+        entry = TierEntry(key="k" * 64, checksum="0" * 64, nbytes=1,
+                          payload=None,
+                          path=str(tmp_path / "gone.kvpage"), tier="disk")
+        with pytest.raises(TierIOError) as err:
+            store.fetch(entry)
+        assert err.value.reason == "io"
+    finally:
+        store.close()
+
+
+@pytest.mark.parametrize("mode,exc", [
+    ("fail", TierIOError),
+    ("corrupt", TierIntegrityError),
+    ("stall", TierTimeoutError),
+])
+def test_chaos_modes_map_to_typed_rungs(mode, exc):
+    chaos = TierChaos(rate=1.0, seed=3, modes=(mode,), stall_s=0.05)
+    store = make_store(start_copier=False, chaos=chaos,
+                       timeout_s=0.01 if mode == "stall" else 5.0)
+    try:
+        entry = store.put_host([8] * 32, payload_for(8))
+        with pytest.raises(exc):
+            store.fetch(entry)
+        assert chaos.injected and chaos.injected[0][0] == mode
+        # chaos corrupts a COPY: the host payload itself stays good, so
+        # dropping + re-spilling is recovery, not contagion
+        if mode == "corrupt":
+            assert payloads_equal(entry.payload, payload_for(8))
+    finally:
+        store.close()
+
+
+def test_chaos_schedule_is_seeded_and_fault_bounded():
+    a = TierChaos(rate=0.5, seed=11)
+    b = TierChaos(rate=0.5, seed=11)
+    keys = [chain_key([i] * 32) for i in range(40)]
+    assert [a.draw(k) for k in keys] == [b.draw(k) for k in keys]
+    assert any(m for m in (a.draw(k) for k in keys))    # some faults fired
+    capped = TierChaos(rate=1.0, seed=0, max_faults=3)
+    drawn = [capped.draw(k) for k in keys]
+    assert sum(1 for m in drawn if m) == 3
+    assert len(capped.injected) == 3
+
+
+# ---------------------------------------------------------------------------
+# The disk tier's on-disk shape: page files + snapshot refs
+# ---------------------------------------------------------------------------
+
+def test_page_file_round_trip_mixed_dtypes(tmp_path):
+    path = str(tmp_path / "p.kvpage")
+    pay = [np.arange(12, dtype=np.float32).reshape(3, 4),
+           np.arange(8, dtype=np.int8)]
+    _write_page_file(path, pay, "c" * 64)
+    got = _read_page_file(path)
+    assert payloads_equal(got, pay)
+    assert [a.dtype for a in got] == [a.dtype for a in pay]
+    assert [a.shape for a in got] == [a.shape for a in pay]
+
+
+@pytest.mark.parametrize("mangle", ["magic", "header", "truncate"])
+def test_page_file_corruption_raises_oserror(tmp_path, mangle):
+    path = str(tmp_path / "p.kvpage")
+    _write_page_file(path, payload_for(1), "c" * 64)
+    raw = open(path, "rb").read()
+    if mangle == "magic":
+        raw = b"XXXX" + raw[4:]
+    elif mangle == "header":
+        raw = raw[:8] + b"{" * (len(raw) - 8)
+    else:
+        raw = raw[:-10]
+    open(path, "wb").write(raw)
+    with pytest.raises(OSError):
+        _read_page_file(path)
+
+
+def test_write_disk_attach_disk_round_trip_and_garbage_refs(tmp_path):
+    side = str(tmp_path / "snap.pages")
+    src = make_store(start_copier=False)
+    chains = [[i] * 32 + [i + 1] * 32 for i in range(3)]
+    for i, chain in enumerate(chains):
+        src.put_host(chain, payload_for(i + 20))
+    refs = src.write_disk(side)
+    src.close()
+    assert len(refs) == 3
+    assert all(set(r) == {"key", "file", "sha256", "nbytes"} for r in refs)
+
+    dst = make_store(start_copier=False)
+    try:
+        garbage = [None, 17, {"key": 1, "file": 2, "sha256": 3},
+                   {"file": "x.kvpage", "sha256": "0" * 64}]
+        assert dst.attach_disk(refs + garbage, side) == 3
+        assert dst.counters()["disk_pages"] == 3
+        for i, chain in enumerate(chains):
+            entry = dst.lookup(chain)
+            assert entry is not None and entry.tier == "disk"
+            assert payloads_equal(dst.fetch(entry), payload_for(i + 20))
+        # refs are idempotent: a second attach of the same keys is a no-op
+        assert dst.attach_disk(refs, side) == 0
+    finally:
+        dst.close()
+
+
+def test_close_is_idempotent_and_clears_everything():
+    store = make_store()
+    store.put_host([3] * 32, payload_for(3))
+    store.close()
+    store.close()
+    assert store.counters() == {"host_pages": 0, "host_bytes": 0,
+                                "disk_pages": 0, "queue_depth": 0}
+    assert store.spill([4] * 32, payload_for(4)) is False   # stopped
+
+
+# ---------------------------------------------------------------------------
+# Engine contract: byte-identical across resident / promoted / recomputed
+# ---------------------------------------------------------------------------
+
+PAGE = 32                 # small pages so short prompts span FULL pages
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,  # 320
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    return cfg, params
+
+
+def make_engine(tiny, **kw):
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+
+    cfg, params = tiny
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("max_seq_len", 256)
+    return PagedTPUEngine(params, cfg, ByteTokenizer(), **kw)
+
+
+PROMPTS = [
+    "def add(a, b):\n    return a + b\n# [QUESTION] is line 2 executed? ",
+    "x = 1\nwhile x < 9:\n    x *= 2\n# [STATE] x = ",
+    "y = [k * k for k in range(5)]\nassert y[3] == ",
+]
+
+
+def spill_all(eng) -> None:
+    """Force every cached chain down to the host tier."""
+    eng.prefix_cache.evict_lru(10 ** 6)
+    assert eng.kv_tiers.drain(5.0)
+
+
+def test_bit_identity_resident_promoted_recomputed(tiny):
+    resident = make_engine(tiny, kv_tiering=False)
+    want = resident.generate(PROMPTS, max_new_tokens=12, temperature=0.0)
+    resident.close()
+
+    eng = make_engine(tiny, kv_tiering=True)
+    try:
+        assert eng.generate(PROMPTS, max_new_tokens=12,
+                            temperature=0.0) == want       # resident path
+        spill_all(eng)
+        promoted = eng.generate(PROMPTS, max_new_tokens=12, temperature=0.0)
+        got = eng.kv_tier_counters()
+        assert promoted == want
+        assert got["promotions"] >= 1 and got["recomputes"] == 0
+
+        # now every fetch fails: the SAME prompts must recompute from
+        # their token chains and still produce the identical stream
+        eng.kv_tiers.chaos = TierChaos(rate=1.0, seed=0, modes=("fail",))
+        spill_all(eng)
+        before = len(recent())
+        recomputed = eng.generate(PROMPTS, max_new_tokens=12,
+                                  temperature=0.0)
+        got = eng.kv_tier_counters()
+        assert recomputed == want
+        assert got["recomputes"] >= 1
+        degrades = [e for e in recent()[before:]
+                    if e["event"] == "kvtier.degrade"]
+        assert degrades and all(e["fields"]["reason"] == "io"
+                                for e in degrades)
+    finally:
+        eng.close()
+
+
+def test_corrupt_promotion_counts_integrity_and_stays_correct(tiny):
+    eng = make_engine(tiny, kv_tiering=True)
+    try:
+        want = eng.generate(PROMPTS, max_new_tokens=8, temperature=0.0)
+        eng.kv_tiers.chaos = TierChaos(rate=1.0, seed=1, modes=("corrupt",))
+        spill_all(eng)
+        before = len(recent())
+        assert eng.generate(PROMPTS, max_new_tokens=8,
+                            temperature=0.0) == want
+        got = eng.kv_tier_counters()
+        assert got["integrity_failures"] >= 1
+        assert got["recomputes"] >= got["integrity_failures"]
+        events = {e["event"] for e in recent()[before:]}
+        assert "kvtier.integrity_failure" in events
+        assert "kvtier.degrade" in events
+    finally:
+        eng.close()
+
+
+def test_disk_tier_round_trip_promotes_real_bytes(tiny, tmp_path):
+    side = str(tmp_path / "snap.pages")
+    src = make_engine(tiny, kv_tiering=True)
+    want = src.generate(PROMPTS, max_new_tokens=8, temperature=0.0)
+    refs = src.dump_tier_pages(side)
+    src.close()
+    assert refs, "a drained engine with warm chains must dump page refs"
+
+    dst = make_engine(tiny, kv_tiering=True)
+    try:
+        assert dst.attach_tier_refs(refs, side) == len(refs)
+        got = dst.generate(PROMPTS, max_new_tokens=8, temperature=0.0)
+        counters = dst.kv_tier_counters()
+        assert got == want
+        assert counters["disk_promotions"] >= 1
+    finally:
+        dst.close()
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 chaos drill: diurnal multi-tenant load over an
+# HBM-overflowing pool × corrupt+fail faults → zero lost prompts,
+# outputs byte-identical to the no-tier baseline
+# ---------------------------------------------------------------------------
+
+def drill_workload():
+    from loadgen import build_workload, diurnal_arrivals, synthetic_tenants
+
+    arrivals = diurnal_arrivals(6.0, 30.0, 1.6, random.Random(16))
+    tenants = synthetic_tenants({"alpha": 3, "beta": 1},
+                                template_chars=96, max_tokens=8)
+    return build_workload(arrivals, tenants, random.Random(16))
+
+
+def run_drill(eng, reqs) -> list[str]:
+    # the diurnal schedule fixes arrival ORDER; the trough between the
+    # two peak waves reclaims the whole HBM pool (eviction pressure at
+    # scale), so with tiering on every tenant template spills to host
+    # DRAM and the second peak promotes it back — under chaos faults
+    half = len(reqs) // 2
+    outs = eng.generate([r.prompt for r in reqs[:half]],
+                        max_new_tokens=8, temperature=0.0)
+    eng.prefix_cache.evict_lru(10 ** 6)
+    if eng.kv_tiers is not None:
+        assert eng.kv_tiers.drain(5.0)
+    outs.extend(eng.generate([r.prompt for r in reqs[half:]],
+                             max_new_tokens=8, temperature=0.0))
+    return outs
+
+
+def test_kvtier_chaos_drill_zero_lost_byte_identical(tiny):
+    reqs = drill_workload()
+    assert len(reqs) >= 12, "diurnal schedule too thin for a drill"
+
+    baseline = make_engine(tiny, kv_tiering=False, num_pages=28)
+    want = run_drill(baseline, reqs)
+    assert baseline.stats.prefix_evictions >= 1, \
+        "pool must overflow HBM for the drill to mean anything"
+    baseline.close()
+
+    chaos = TierChaos(rate=0.5, seed=16, modes=("corrupt", "fail"))
+    eng = make_engine(tiny, kv_tiering=True, num_pages=28,
+                      tier_chaos=chaos)
+    try:
+        got = run_drill(eng, reqs)
+        counters = eng.kv_tier_counters()
+    finally:
+        eng.close()
+
+    assert len(got) == len(reqs)                    # zero lost prompts
+    assert got == want                              # byte-identical logs
+    assert counters["spills"] >= 1
+    assert counters["recomputes"] >= 1              # faults really landed
+    assert chaos.injected, "chaos schedule never fired — drill is vacuous"
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: watch row, loadgen artifact block
+# ---------------------------------------------------------------------------
+
+def test_watch_kvtier_row_renders_and_hides_when_idle():
+    from reval_tpu.watch import _kvtier_row
+
+    assert _kvtier_row({}, {}) is None
+    counters = {obs_metrics.KVTIER_SPILLS: 4,
+                obs_metrics.KVTIER_PROMOTIONS: 3,
+                obs_metrics.KVTIER_RECOMPUTES: 1,
+                obs_metrics.KVTIER_INTEGRITY_FAILURES: 1}
+    gauges = {obs_metrics.KVTIER_HOST_PAGES: 5,
+              obs_metrics.KVTIER_DISK_PAGES: 2,
+              obs_metrics.KVTIER_QUEUE_DEPTH: 1}
+    row = _kvtier_row(counters, gauges)
+    assert "host 5p" in row and "disk 2p" in row and "queue 1" in row
+    assert "spills 4" in row and "promotions 3" in row
+    assert "recomputes 1" in row and "integrity_fail 1" in row
+
+
+def test_loadgen_kvtier_block_deltas_and_hit_rate():
+    from loadgen import OpenLoopRunner
+
+    before = {obs_metrics.KVTIER_SPILLS: 10.0,
+              obs_metrics.KVTIER_PROMOTIONS: 6.0,
+              obs_metrics.KVTIER_RECOMPUTES: 2.0}
+    after = {obs_metrics.KVTIER_SPILLS: 16.0,
+             obs_metrics.KVTIER_PROMOTIONS: 12.0,
+             obs_metrics.KVTIER_RECOMPUTES: 4.0,
+             obs_metrics.KVTIER_INTEGRITY_FAILURES: 1.0}
+    block = OpenLoopRunner._kvtier_block(before, after)
+    assert block["spills"] == 6 and block["promotions"] == 6
+    assert block["recomputes"] == 2 and block["integrity_failures"] == 1
+    assert block["promote_hit_rate"] == 0.75
+    # None when the target has no tier traffic (mock fleet) or no scrape
+    assert OpenLoopRunner._kvtier_block(None, None) is None
+    assert OpenLoopRunner._kvtier_block(before, dict(before)) is None

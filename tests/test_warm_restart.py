@@ -447,6 +447,72 @@ def test_snapshot_corrupt_and_garbage_read_cold(tmp_path):
     assert read_snapshot(str(path)) is None
 
 
+def test_snapshot_v2_kv_page_refs_round_trip_v1_accepted_v3_cold(tmp_path):
+    """The v2 schema adds disk-tier page refs (kv_tiers.py sidecar).
+    Old v1 docs must still warm-boot (chain replay only); an UNKNOWN
+    future version must boot cold — never guess at a schema."""
+    path = str(tmp_path / "snap.json")
+    refs = [{"key": "a" * 64, "file": "a.kvpage",
+             "sha256": "b" * 64, "nbytes": 4096}]
+    assert write_snapshot(path, {"prefix_chains": [[1, 2]]}, kv_pages=refs)
+    doc = read_snapshot(path)
+    assert doc["format"] == SNAP_FORMAT == "reval-warm-snapshot-v2"
+    assert doc["kv_pages"] == refs
+    # no tier store → no kv_pages key at all (v1-shaped doc, v2 format)
+    bare = str(tmp_path / "bare.json")
+    assert write_snapshot(bare, {"prefix_chains": []})
+    assert "kv_pages" not in read_snapshot(bare)
+
+    v1 = {"format": "reval-warm-snapshot-v1",
+          "engine": {"prefix_chains": [[7] * 8], "template_stats": {}}}
+    (tmp_path / "v1.json").write_text(json.dumps(v1))
+    got = read_snapshot(str(tmp_path / "v1.json"))
+    assert got is not None and got["engine"] == v1["engine"]
+
+    (tmp_path / "v3.json").write_text(
+        json.dumps(dict(v1, format="reval-warm-snapshot-v3")))
+    assert read_snapshot(str(tmp_path / "v3.json")) is None
+
+
+def test_session_fallback_boots_sibling_snapshot_with_tier_refs(tmp_path):
+    """Autoscaler warm scale-up: a replica with no (or a corrupt)
+    snapshot of its own inherits a SIBLING's — including the v2 disk
+    tier refs, attached before rewarm so replayed chains promote real
+    KV bytes."""
+    from reval_tpu.serving import ContinuousSession, MockStepEngine
+
+    sib = str(tmp_path / "sibling.json")
+    refs = [{"key": "a" * 64, "file": "a.kvpage",
+             "sha256": "b" * 64, "nbytes": 4096}]
+    chain = [3] * 8
+    assert write_snapshot(sib, {"prefix_chains": [chain],
+                                "template_stats": {}}, kv_pages=refs)
+    own = tmp_path / "own.json"
+    own.write_text('{"format": "reval-warm-sn')       # corrupt → fallback
+
+    class TierMock(MockStepEngine):
+        def __init__(self):
+            super().__init__()
+            self.attached = None
+
+        def attach_tier_refs(self, refs, dir_path):
+            self.attached = (refs, dir_path)
+            return len(refs)
+
+    eng = TierMock()
+    session = ContinuousSession(eng, snapshot_path=str(own),
+                                snapshot_fallback=sib)
+    try:
+        deadline = time.monotonic() + 10
+        while session._warming.is_set() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not session._warming.is_set()
+        assert chain in eng._warm_chains            # sibling chains warm
+        assert eng.attached == (refs, f"{sib}.pages")
+    finally:
+        session.close()
+
+
 def test_rewarm_failed_prefill_rolls_back_chain(monkeypatch):
     """A chain whose replay prefill dies mid-boot must not survive as
     uncommitted (garbage) KV — a later rider would decode against it

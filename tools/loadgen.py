@@ -418,6 +418,34 @@ class OpenLoopRunner:
                 "goodput_total": int(delta(obs_metrics.ROUTER_GOODPUT)),
                 "slo_miss_total": int(delta(obs_metrics.ROUTER_SLO_MISS))}
 
+    @staticmethod
+    def _kvtier_block(before: dict | None, after: dict | None) -> dict | None:
+        """KV-tier traffic over the run from scraped ``reval_kvtier_*``
+        deltas (inference/tpu/kv_tiers.py); None when the target has no
+        tier store (mock engine, tiering off, no /metrics)."""
+        if not after:
+            return None
+
+        def delta(name: str) -> int:
+            return int(max(0.0, after.get(name, 0.0)
+                           - (before or {}).get(name, 0.0)))
+
+        spills = delta(obs_metrics.KVTIER_SPILLS)
+        promotions = delta(obs_metrics.KVTIER_PROMOTIONS)
+        recomputes = delta(obs_metrics.KVTIER_RECOMPUTES)
+        if not (spills or promotions or recomputes):
+            return None
+        attempts = promotions + recomputes
+        return {"spills": spills,
+                "spill_drops": delta(obs_metrics.KVTIER_SPILL_DROPS),
+                "promotions": promotions,
+                "disk_promotions": delta(obs_metrics.KVTIER_DISK_PROMOTIONS),
+                "recomputes": recomputes,
+                "integrity_failures": delta(
+                    obs_metrics.KVTIER_INTEGRITY_FAILURES),
+                "promote_hit_rate": round(promotions / attempts, 4)
+                if attempts else 0.0}
+
     def _artifact(self, before: dict | None, after: dict | None,
                   wall_s: float) -> dict:
         with self._lock:
@@ -489,6 +517,8 @@ class OpenLoopRunner:
                 row["e2e"].append(r["e2e_s"])
             else:
                 row["lost"] += 1
+        kv_tier = self._kvtier_block(before, after)
+        total_completed = max(1, len(completed))
         tenants_out = {}
         for name, row in sorted(per_tenant.items()):
             e2e = sorted(row.pop("e2e"))
@@ -497,6 +527,16 @@ class OpenLoopRunner:
                                          / max(1, row["requests"]), 4)
             row["shed_rate"] = round(row["sheds"]
                                      / max(1, row["requests"]), 4)
+            if kv_tier:
+                # engine-side tier counters carry no tenant label (page
+                # chains are shared state), so the per-tenant split is an
+                # ESTIMATE weighted by completed-request share — marked
+                # _est so nobody reads it as an exact attribution
+                share = row["completed"] / total_completed
+                row["kv_tier_est"] = {
+                    "promotions_est": round(kv_tier["promotions"] * share, 1),
+                    "recomputes_est": round(kv_tier["recomputes"] * share, 1),
+                    "promote_hit_rate": kv_tier["promote_hit_rate"]}
             tenants_out[name] = row
 
         e2e_target = self.slo["e2e_s"]
@@ -530,6 +570,7 @@ class OpenLoopRunner:
             "slo": slo_block,
             "counts": {"shed_429": sheds, "retries": retries,
                        "lost": len(lost), **(fleet or {})},
+            **({"kv_tier": kv_tier} if kv_tier else {}),
             "tenants": tenants_out,
             "timeline": timeline,
             "recovery": {"worst_bad_window_s": round(worst * bucket, 3),
