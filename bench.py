@@ -565,6 +565,11 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
         # from both token counts
         cold_prefill_tokens = eng.stats.prefill_tokens
         eng.stats = EngineStats()
+        # per-entry dispatch counts at the warmup/timed boundary: the
+        # timed-pass delta is the ragged block's dispatches-per-tick
+        # numerator (TrackedJit.calls survives the stats swap, so the
+        # raw totals include warmup by design)
+        calls0 = dict(eng.jit_counters().get("calls") or {})
         note(f"  paged timed pass (warmup took {warmup_wall:.1f}s)")
         phase.update(name="timed-pass", t0=time.perf_counter(),
                      warmup_wall=warmup_wall)
@@ -621,6 +626,10 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     # the bench "jit" block, and the per-path baseline PERF.md pins —
     # cache_misses > 0 means a post-warmup recompile happened in-run
     jit_row = eng.jit_counters()
+    jit_row["timed_calls"] = {
+        k: v - calls0.get(k, 0)
+        for k, v in (jit_row.get("calls") or {}).items()
+        if v - calls0.get(k, 0) > 0}
     # warm-restart economics (inference/tpu/aot_cache.py): cache
     # hits/misses + compile seconds the cache skipped this boot, and —
     # when the cache is on — engine-build+warmup wall as the measured
@@ -716,6 +725,11 @@ def main() -> None:
                          "(REVAL_TPU_OBS=0) — the A/B that prices the "
                          "observability layer's hot-path cost (PERF.md); "
                          "counters stay on (engine accounting needs them)")
+    ap.add_argument("--no-ragged", action="store_true",
+                    help="skip the ragged continuous-batching A/B (one "
+                         "wave per tick vs the chunked incumbent: tok/s "
+                         "delta, dispatches/tick, padded-vs-useful wave "
+                         "occupancy)")
     ap.add_argument("--no-spec", action="store_true",
                     help="skip the speculative-decoding A/B garnish "
                          "(grammar-constrained probes, spec on vs off)")
@@ -1008,6 +1022,74 @@ def main() -> None:
             except Exception as e:
                 extras["ab_error"] = type(e).__name__
                 note(f'prefix-cache A/B failed ({type(e).__name__}); '
+                     'keeping the measured headline')
+
+        # Ragged continuous-batching garnish: the identical workload
+        # through the other engine mode — when the headline ran the
+        # chunked incumbent, the A/B leg pins the ragged one-wave
+        # engine (and vice versa when autotune already decided ragged).
+        # The block carries the tok/s delta plus the two observables
+        # only the ragged engine has: dispatches-per-tick (must be 1.0
+        # — the contract the tier-1 test asserts) and padded-vs-useful
+        # wave occupancy.  Garnish rules apply.
+        if not args.no_ragged:
+            note('ragged A/B (one-wave continuous batching vs chunked)')
+            try:
+                from reval_tpu.ops.pallas_attention import \
+                    resolved_paged_backend
+
+                prev = os.environ.get("REVAL_TPU_PAGED_BACKEND")
+                flip = resolved_paged_backend() not in ("ragged",
+                                                        "ragged_xla")
+                if flip:        # headline was the incumbent: pin ragged
+                    ab_backend = ("ragged" if platform == "tpu"
+                                  else "ragged_xla")
+                else:           # headline was ragged: pin the incumbent
+                    ab_backend = "pallas" if platform == "tpu" else "xla"
+                os.environ["REVAL_TPU_PAGED_BACKEND"] = ab_backend
+                try:
+                    w_ab, st_ab, _, jit_ab, _, _ = run_paged(
+                        params, cfg, tok, prompts, max_new,
+                        prefix_sharing=not args.no_prefix_cache,
+                        max_slots=args.slots,
+                        max_seq_len=args.max_seq_len,
+                        num_pages=num_pages, kv_dtype=args.kv_dtype)
+                finally:
+                    if prev is None:
+                        os.environ.pop("REVAL_TPU_PAGED_BACKEND", None)
+                    else:
+                        os.environ["REVAL_TPU_PAGED_BACKEND"] = prev
+                w_r, st_r, jit_r = ((w_ab, st_ab, jit_ab) if flip
+                                    else (wall, stats, jit_row))
+                w_i, st_i = ((wall, stats) if flip else (w_ab, st_ab))
+                ticks = st_r.ragged_ticks
+                disp = (jit_r.get("timed_calls") or {}).get(
+                    "paged.ragged_step", 0)
+                tok_r = (st_r.generated_tokens / st_r.decode_seconds
+                         if st_r.decode_seconds else 0.0)
+                tok_i = (st_i.generated_tokens / st_i.decode_seconds
+                         if st_i.decode_seconds else 0.0)
+                extras["ragged"] = {
+                    "backend": (ab_backend if flip
+                                else resolved_paged_backend()),
+                    "ticks": ticks,
+                    "dispatches_per_tick": (round(disp / ticks, 3)
+                                            if ticks else 0.0),
+                    "wave_occupancy": (round(
+                        st_r.ragged_useful_tokens
+                        / st_r.ragged_padded_tokens, 4)
+                        if st_r.ragged_padded_tokens else 0.0),
+                    "useful_tokens": st_r.ragged_useful_tokens,
+                    "padded_tokens": st_r.ragged_padded_tokens,
+                    "tokens_per_sec": round(tok_r, 1),
+                    "tokens_per_sec_incumbent": round(tok_i, 1),
+                    "tok_s_delta": (round(tok_r / tok_i, 3)
+                                    if tok_i else 0.0),
+                    "speedup": round(w_i / w_r, 3) if w_r else 0.0,
+                }
+            except Exception as e:
+                extras["ragged_error"] = type(e).__name__
+                note(f'ragged A/B failed ({type(e).__name__}); '
                      'keeping the measured headline')
 
         # Speculative garnish: the same probes decoded under their answer

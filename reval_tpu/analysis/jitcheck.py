@@ -288,13 +288,17 @@ class TrackedJit:
     delegate to the wrapped function."""
 
     __slots__ = ("_fn", "name", "warmup", "_sigs", "_misses", "_registry",
-                 "_san", "_lock")
+                 "_san", "_lock", "calls")
 
     def __init__(self, name: str, fn, registry=None,
                  warmup: int | None = None, sanitizer=None):
         self._fn = fn
         self.name = name
         self.warmup = warmup
+        #: total dispatches through this entry (every call, not just new
+        #: variants) — the bench ``ragged`` block's dispatches-per-tick
+        #: denominator and the one-dispatch-per-tick test observable
+        self.calls = 0
         # guarded-by: _lock (writes)
         # (the pre-lock membership read is a benign double-checked
         # fast path: a miss re-checks under the lock before adding)
@@ -331,6 +335,7 @@ class TrackedJit:
         deserialized executables — it must keep the ``reval_jit_*``
         counting identical without paying the underlying jit a second
         compile."""
+        self.calls += 1     # single-owner drive threads; diagnostic only
         key = _signature(args, kwargs)
         if key not in self._sigs:
             is_new = miss = False

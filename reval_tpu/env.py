@@ -49,9 +49,16 @@ ENV: dict[str, dict] = {
     # -- kernel / backend selection (ops/pallas_attention.py) -------------
     "REVAL_TPU_PAGED_BACKEND": {
         "default": "autotune",
-        "help": "decode-attention kernel: pallas | pallas_seq | xla "
-                "(default: the persisted autotune decision, else pallas "
-                "on TPU / xla elsewhere)"},
+        "help": "decode-attention kernel: pallas | pallas_seq | xla | "
+                "ragged | ragged_xla (ragged* also switches the engine "
+                "to one-dispatch-per-tick continuous batching; default: "
+                "the persisted autotune decision, else pallas on TPU / "
+                "xla elsewhere)"},
+    "REVAL_TPU_RAGGED_FEED": {
+        "default": "256",
+        "help": "ragged continuous batching: prompt tokens one drive "
+                "tick feeds per still-prefilling row (the per-tick "
+                "prefill quantum riding the same wave as decode rows)"},
     "REVAL_TPU_KERNEL_DOT": {
         "default": "swap",
         "help": "Pallas decode-kernel dot mode: swap | wide"},

@@ -272,6 +272,13 @@ _STAT_FIELDS = (
     ("kvtier_integrity_failures",
      "reval_kvtier_integrity_failures_total", int),
     ("kvtier_host_evictions", "reval_kvtier_host_evictions_total", int),
+    # ragged continuous batching (paged engine `_tick_ragged`): wave
+    # occupancy — useful counts the real (ctx, q) work rows asked for,
+    # padded the full b*w rectangle the single dispatch covered; their
+    # ratio is the bench ragged block's padded-vs-useful lens
+    ("ragged_ticks", "reval_ragged_ticks_total", int),
+    ("ragged_useful_tokens", "reval_ragged_useful_tokens_total", int),
+    ("ragged_padded_tokens", "reval_ragged_padded_tokens_total", int),
     # serving lifecycle (serving/session.py + serving/server.py):
     ("sheds", "reval_serving_sheds_total", int),
     ("deadline_expired", "reval_serving_deadline_expired_total", int),

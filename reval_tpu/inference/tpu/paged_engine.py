@@ -8,12 +8,19 @@ decode slots fed from an admission queue:
 - the **native scheduler** (reval_tpu.runtime, C++) owns pages and slots:
   FCFS admission with a one-page decode watermark, lazy page allocation as
   sequences grow, recompute-style preemption on pool exhaustion;
-- **prefill** runs per admitted sequence through the contiguous
-  left-padded path (already MXU-shaped), bucketed to a power-of-two page
-  count, then commits its KV into the allocated pages (models/paged.py);
-- **decode** runs all slots every step through the Pallas paged-attention
-  kernel, a jitted ``lax.scan`` chunk at a time; finished sequences free
-  their slot at the next chunk boundary and a waiting request takes it.
+- under a ``ragged``/``ragged_xla`` backend the drive loop is **true
+  continuous batching** (``_tick_ragged``): every tick dispatches ONE
+  jitted program (``paged.ragged_step``) over the whole slot set, each
+  row carrying its own ``(ctx_len, q_len)`` — still-prefilling rows feed
+  a prompt window, decoding rows a single query, spec-verify rows a
+  draft window — so a long prefill admits mid-decode without stalling
+  anyone and nothing waits at a wave boundary;
+- the incumbent split-dispatch mode remains the default elsewhere:
+  **prefill** per admitted wave through the contiguous left-padded path
+  (bucketed to a power-of-two page count, then KV commit), **decode**
+  for all slots through the Pallas paged-attention kernel, a jitted
+  ``lax.scan`` chunk at a time; finished sequences free their slot at
+  the next chunk boundary and a waiting request takes it.
 
 The result: short answers ([ANSWER] NO, 2 tokens) stop occupying a slot
 the moment they finish instead of padding out to the batch's longest
@@ -63,6 +70,7 @@ from ...models.paged import (
     gather_tier_page,
     init_paged_cache,
     paged_decode_step,
+    paged_ragged_step,
     prefill_with_paged_context,
     promote_tier_page,
 )
@@ -114,6 +122,15 @@ def patch_state_tables(state, tables):
     (tests/test_tpu_lowering.py)."""
     return state.at[:, :tables.shape[1]].set(tables)
 
+# Prompt tokens one ragged drive tick feeds per row (the continuous-
+# batching path's per-tick prefill quantum).  Bounds the [B, W] window
+# forward's activation footprint the same way PREFILL_BYTE_BUDGET bounds
+# the incumbent wave, and — because a long prompt feeds across ticks —
+# keeps already-decoding rows stepping while a long prefill admits
+# mid-decode (they ride the same wave, one token per tick, instead of
+# stalling behind a monolithic prefill dispatch).
+RAGGED_FEED = max(1, env_int("REVAL_TPU_RAGGED_FEED", 256))
+
 # Cap on the transient KV block a prefill call materialises ([L, rows, T,
 # H_kv, D] before committing to pages) — large admissions prefill in
 # sub-batches instead.  A BYTE budget, not a token count: per-token KV is
@@ -162,6 +179,15 @@ class _Request:
     #: the drafter faulted for this request: spec.wedge degrade — the
     #: row rides plain decode (or bonus-only verify) until it retires
     spec_wedged: bool = False
+    #: ragged continuous batching only: prompt tokens already committed
+    #: by feed windows, and the coverage the row's CURRENT admission must
+    #: reach before it decodes.  ``fed_target`` snapshots
+    #: ``len(prefill_ids)`` at (re-)admission — the live value grows with
+    #: every generated token, and chasing it would keep the row feeding
+    #: one token per tick forever; ``fed`` starts at the cached-prefix
+    #: coverage
+    fed: int = 0
+    fed_target: int = 0
 
     @property
     def prefill_ids(self) -> list[int]:
@@ -262,6 +288,22 @@ class PagedTPUEngine:
         if pipeline is None:
             pipeline = env_flag("REVAL_TPU_PIPELINE", True)
         self.pipeline = bool(pipeline)
+        # -- ragged continuous batching (ops/pallas_attention.py) ----------
+        # One ragged wave per drive tick serves any mix of prefill-feed,
+        # decode, and spec-verify rows through ONE jit dispatch
+        # (paged.ragged_step) instead of the incumbent prefill-wave /
+        # decode-chunk / verify-chunk split.  Opt-in via
+        # REVAL_TPU_PAGED_BACKEND=ragged (Pallas kernel) or ragged_xla
+        # (gather-free XLA reference — exportable, bit-compatible).
+        from ...ops.pallas_attention import resolved_paged_backend
+
+        self.ragged = resolved_paged_backend() in ("ragged", "ragged_xla")
+        if self.ragged and mesh is not None:
+            # the ragged kernel has no shard_map wrapper yet — a
+            # tp-sharded mesh rides the incumbent split dispatch
+            self.ragged = False
+            log_event("engine.ragged_fallback", level="warning",
+                      reason="tp_mesh", mesh=str(mesh))
         # -- speculative + constrained decoding (reval_tpu/decoding/) ------
         self.spec_enabled = (env_flag("REVAL_TPU_SPEC", True)
                              if speculative is None else bool(speculative))
@@ -421,6 +463,24 @@ class PagedTPUEngine:
         self._jit_patch = tracked_jit(
             "paged.patch_tables", jax.jit(patch_state_tables),
             registry=reg, warmup=16)
+        # ragged unified step: ONE dispatch per drive tick computes a
+        # whole mixed wave — per-row (ctx_len, q_len) descriptors ride
+        # the packed state, the window tokens commit + attend through
+        # the ragged paged-attention kernel, and an optional plain-decode
+        # scan tail (steps > 1) amortises host cadence exactly like the
+        # incumbent chunk.  Only dispatched when the resolved backend is
+        # ragged/ragged_xla; registered unconditionally so the jit/AOT
+        # registries see one stable entry set.
+        # jit-entry: paged.ragged_step static=(steps, filtered, grammared) bucketed=(span, window, gstates) warmup=64
+        self._jit_ragged = tracked_jit(
+            "paged.ragged_step",
+            jax.jit(
+                partial(self._ragged_step, cfg=cfg, mesh=mesh),
+                static_argnames=("steps", "filtered", "grammared"),
+                donate_argnames=("cache",),
+                **({"out_shardings": (None, cache_out_shardings)}
+                   if cache_out_shardings is not None else {})),
+            registry=reg, warmup=64)
         # KV-tier page movement (kv_tiers.py): one page's rows out of
         # the pool (spill read — a non-aliasing slice, so the pool page
         # is releasable the moment dispatch returns) and back in
@@ -474,7 +534,8 @@ class PagedTPUEngine:
             # the canary names the environment gap (unsupported, counted)
             # instead of raising a doomed export per variant
             chunk_canary = (kernel_export_skip
-                            if kernel_backend != "xla" else None)
+                            if kernel_backend not in ("xla", "ragged_xla")
+                            else None)
             # donate= re-applies the original jits' buffer donation to
             # deserialized executables (serialization drops it; the
             # commit/chunk programs update the KV pool in place through
@@ -492,6 +553,13 @@ class PagedTPUEngine:
             # XLA attention) — no Mosaic kernel, so no canary needed
             self._jit_verify = AotJit(self._jit_verify, self._aot_cache, ctx,
                                       static=("grammared",), donate=(7,))
+            # the ragged step embeds the ragged attention kernel: the
+            # Pallas form needs Mosaic export support (canary), the
+            # ragged_xla reference exports anywhere
+            self._jit_ragged = AotJit(self._jit_ragged, self._aot_cache, ctx,
+                                      static=("steps", "filtered",
+                                              "grammared"),
+                                      canary=chunk_canary, donate=(3,))
             self._jit_patch = AotJit(self._jit_patch, self._aot_cache, ctx)
             self._jit_tier_gather = AotJit(self._jit_tier_gather,
                                            self._aot_cache, ctx)
@@ -524,7 +592,8 @@ class PagedTPUEngine:
                 out_checks={0: self._cache_sharding})
         self._jit_trackers = (self._jit_prefill, self._jit_prefill_pctx,
                               self._jit_commit, self._jit_chunk,
-                              self._jit_verify, self._jit_patch,
+                              self._jit_verify, self._jit_ragged,
+                              self._jit_patch,
                               self._jit_tier_gather,
                               self._jit_tier_promote)
 
@@ -744,6 +813,127 @@ class PagedTPUEngine:
             new_gs = gstate
         out = jnp.concatenate(
             [targets, accepted[:, None], new_gs[:, None]], axis=1)
+        return out, cache
+
+    @staticmethod
+    def _ragged_step(params, state, tokens, cache, sampling, gtables=None,
+                     *, cfg: ModelConfig, steps: int, filtered: bool = False,
+                     grammared: bool = False, mesh=None):
+        """ONE ragged wave over the whole slot batch: a mixed window
+        forward (prefill-feed, decode, and spec-verify rows together)
+        followed by an optional plain-decode scan tail.
+
+        ``state`` packs the per-row ragged descriptors into one int32
+        array ``[B, span + 7]`` — block tables (``span`` columns), the
+        committed context length ``ctx``, the window length ``q_len``,
+        the draft count ``ndraft``, the per-request PRNG key (2 bitcast
+        words), the generated-token position, and the grammar-automaton
+        state.  ``tokens`` [B, W] is row ``b``'s window: a decode row is
+        its pending token (``q_len=1``), a verify row pending + drafts
+        (``q_len = 1 + ndraft``), a feed row the next ``q_len`` prompt
+        tokens; columns past ``q_len`` are padding (their KV lands in
+        the trash page, their logits are never read).
+
+        The per-column greedy targets use the SAME masked ``jnp.argmax``
+        contract as :meth:`_verify_chunk` (same f32 logits, same
+        ``-1e30`` mask constant), the accept rule is identical, and the
+        emission column generalises the verify bonus: column ``q_len - 1
+        - ndraft + accepted`` is the row's next-token position whether
+        the row decoded (col 0), fed its final prompt chunk (its last
+        real column — the first-token sample the incumbent prefill
+        emits), or verified a draft window (the bonus column).  Sampled
+        rows sample that column with ``fold_in(key, pos)`` exactly like
+        the decode chunk, so greedy streams stay schedule-independent.
+
+        ``steps > 1`` (pure-decode ticks only) appends ``steps - 1``
+        plain decode iterations — the exact :meth:`_decode_chunk` body,
+        whose attention rides the ragged kernel at ``q_len = 1`` under
+        the ragged backends.
+
+        Returns ``(out [B, W + steps + 1] int32, cache)``: the window
+        targets, the accepted draft count, the phase-A emission, and the
+        scan-tail tokens — one packed array, one host fetch per tick.
+        """
+        span = state.shape[1] - 7
+        block_tables = state[:, :span]
+        ctx = state[:, span]
+        qlen = state[:, span + 1]
+        ndraft = state[:, span + 2]
+        keys = jax.lax.bitcast_convert_type(state[:, span + 3:span + 5],
+                                            jnp.uint32)
+        pos = state[:, span + 5]
+        gstate0 = state[:, span + 6]
+        b, w = tokens.shape
+        temperature = sampling[:, 0]
+
+        logits, cache = paged_ragged_step(params, cfg, tokens, block_tables,
+                                          ctx, qlen, cache, mesh=mesh)
+        if grammared:
+            gmask, gnext = gtables
+            # automaton states after consuming window columns 0..j:
+            # column 0 (pending/prompt) is already folded into
+            # ``gstate0``; only DRAFT columns (1..ndraft) advance — feed
+            # rows' prompt tokens never walk the automaton (the grammar
+            # constrains the answer, not the prompt)
+            def walk(s, col):
+                tok, j = col
+                ns = jnp.where(j <= ndraft, gnext[s, tok], s)
+                return ns, ns
+
+            _, tail = jax.lax.scan(
+                walk, gstate0,
+                (tokens.T[1:], jnp.arange(1, w, dtype=jnp.int32)))
+            s_after = jnp.concatenate([gstate0[None], tail], axis=0).T
+            logits = jnp.where(gmask[s_after], logits, -1e30)
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B,W]
+        j = jnp.arange(1, w, dtype=jnp.int32)[None, :]
+        ok = (tokens[:, 1:] == targets[:, :-1]) & (j <= ndraft[:, None])
+        accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+        # the row's next-token column: 0 for decode, the last real
+        # column for a feed, the bonus column for a verify window
+        base = jnp.clip(qlen - 1 - ndraft + accepted, 0, w - 1)
+        emit = jnp.take_along_axis(logits, base[:, None, None],
+                                   axis=1)[:, 0]                      # [B,V]
+        if filtered:    # static: default waves carry no [B, V] sort
+            emit = filter_logits(emit, sampling[:, 2].astype(jnp.int32),
+                                 sampling[:, 1], temperature)
+        row_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+        nxt = sample_token_rows(emit, temperature, row_keys)
+        if grammared:
+            s_base = jnp.take_along_axis(s_after, base[:, None], axis=1)[:, 0]
+            gstate = gnext[s_base, nxt]
+        else:
+            gstate = gstate0
+        # tokens that stick this wave: q_len for a feed, 1 for decode,
+        # 1 + accepted for verify (the host rolls rejected tails back)
+        lens = ctx + qlen - ndraft + accepted
+
+        def body(carry, _):
+            token, cache, lens_c, pos_c, gs = carry
+            logits2, cache = paged_decode_step(params, cfg, token,
+                                               block_tables, lens_c, cache,
+                                               mesh=mesh)
+            if grammared:   # static: default waves carry no mask gather
+                logits2 = jnp.where(gmask[gs], logits2, -1e30)
+            if filtered:
+                logits2 = filter_logits(logits2,
+                                        sampling[:, 2].astype(jnp.int32),
+                                        sampling[:, 1], temperature)
+            rk = jax.vmap(jax.random.fold_in)(keys, pos_c)
+            nxt2 = sample_token_rows(logits2, temperature, rk)
+            if grammared:
+                gs = gnext[gs, nxt2]
+            return (nxt2[:, None], cache, lens_c + 1, pos_c + 1, gs), nxt2
+
+        if steps > 1:   # static: feed/verify ticks compile no scan tail
+            (_, cache, _, _, _), toks = jax.lax.scan(
+                body, (nxt[:, None], cache, lens, pos + 1, gstate),
+                None, length=steps - 1)
+            tail = toks.T
+        else:
+            tail = jnp.zeros((b, 0), jnp.int32)
+        out = jnp.concatenate(
+            [targets, accepted[:, None], nxt[:, None], tail], axis=1)
         return out, cache
 
     def _next_key(self) -> jax.Array:
@@ -1141,7 +1331,11 @@ class PagedTPUEngine:
         ``reval_jit_cache_misses_total``."""
         return {"compiles": sum(t.variants for t in self._jit_trackers),
                 "cache_misses": sum(t.misses for t in self._jit_trackers),
-                "entries": {t.name: t.variants for t in self._jit_trackers}}
+                "entries": {t.name: t.variants for t in self._jit_trackers},
+                # total dispatches per entry (warmup included) — the
+                # bench ragged block's dispatches-per-tick numerator and
+                # the one-dispatch-per-tick contract's observable
+                "calls": {t.name: t.calls for t in self._jit_trackers}}
 
     def aot_counters(self) -> dict:
         """AOT executable-cache snapshot — the bench ``restart`` block
@@ -1268,7 +1462,10 @@ class PagedTPUEngine:
             # _process_chunk.  A free nullcontext when the sanitizer is
             # off.
             with drive_guard():
-                self._tick(reqs, st)
+                if self.ragged:
+                    self._tick_ragged(reqs, st)
+                else:
+                    self._tick(reqs, st)
         finally:
             dt = time.perf_counter() - t0
             free = self.rt.free_pages if self.rt is not None else 0
@@ -1297,7 +1494,9 @@ class PagedTPUEngine:
                     tuple(st.active.values()))
 
     def _tick(self, reqs: dict[int, _Request], st: _DriveState) -> None:  # hot-path
-        """ONE admission + prefill + decode-chunk round over ``reqs``.
+        """ONE admission + prefill + decode-chunk round over ``reqs`` —
+        the split-dispatch drive tick (``_tick_ragged`` replaces it
+        whenever the resolved backend is ``ragged``/``ragged_xla``).
 
         Loop state (tables, lens, pending token, per-slot temperature)
         lives ON DEVICE between chunks as the packed array `_decode_chunk`
@@ -1533,6 +1732,277 @@ class PagedTPUEngine:
                 self._process_chunk(reqs, st, prev)
         else:
             self._process_chunk(reqs, st, chunk)
+
+    # -- ragged continuous batching (one wave, one dispatch) ---------------
+    def _tick_ragged(self, reqs: dict[int, _Request],  # hot-path
+                     st: _DriveState) -> None:
+        """ONE continuous-batching round: admission, then a single
+        ``paged.ragged_step`` dispatch serving every active row — rows
+        still feeding their prompt ride the same wave as rows decoding
+        and rows verifying draft windows, so a long prefill admits
+        mid-decode without stalling running rows (it feeds
+        ``RAGGED_FEED`` tokens per tick while they keep stepping).
+
+        No prefill-wave/decode-chunk split, no pow2 context bucketing,
+        no one-deep chunk pipeline: ``st.pending`` stays ``None`` and
+        every tick fetches its own packed output (the flight recorder's
+        in-flight field is therefore always 0 in ragged mode — the
+        step-cadence contract the mock engine mirrors).  Compile
+        variants stay bounded by the pow2 (span, window) buckets plus
+        the static (steps, filtered, grammared) axes.
+
+        Raises RuntimeError on scheduler deadlock, same contract as
+        :meth:`_tick`.
+        """
+        self.heartbeat = time.monotonic()
+        admitted = self.rt.admit()
+        if (not admitted and self.rt.num_waiting
+                and self.rt.num_running < self.max_slots
+                and self.prefix_cache is not None):
+            # same admission-starvation valve as _tick: cached-but-idle
+            # prefixes yield before decode starves
+            while self.prefix_cache.evict_lru(1):
+                admitted = self.rt.admit()
+                if admitted:
+                    break
+        if admitted:
+            st.since_admit = 0
+            t_admit = time.perf_counter()
+            for seq_id, slot in admitted:
+                req = reqs[seq_id]
+                # first admission only: a preemption resume keeps the
+                # original stamps (the request's latency, not the slot's)
+                if req.t_admit is None:
+                    req.t_admit = t_admit
+                # feed resumes past the cached-prefix pages (their KV is
+                # committed); a preemption resume re-feeds
+                # prompt+generated the same way the incumbent re-prefills.
+                # Clamped below the full prompt: even a fully-cached
+                # prompt must feed ≥1 token — the wave has no other
+                # source of first-token logits
+                req.fed_target = len(req.prefill_ids)
+                req.fed = min(self.rt.prefix_pages(seq_id) * self.page_size,
+                              req.fed_target - 1)
+                st.slot_temp[slot] = req.temp
+                st.slot_topk[slot] = req.top_k
+                st.slot_topp[slot] = req.top_p
+                st.active[slot] = seq_id
+        if not st.active:
+            if any(not r.done for r in reqs.values()):
+                # lint: allow(hotpath) — terminal path, never steady state
+                log_event("engine.deadlock", level="error",
+                          waiting=self.rt.num_waiting,
+                          free_pages=self.rt.free_pages)
+                raise RuntimeError(
+                    "paged scheduler deadlock: nothing running or admissible")
+            return
+
+        # ---- plan the wave: per-row (kind, q_len, drafts) ------------
+        plan: dict[int, tuple[str, int, list | None]] = {}
+        feeding = verifying = False
+        for slot, seq_id in st.active.items():
+            req = reqs[seq_id]
+            if req.fed < req.fed_target:
+                plan[slot] = ("feed",
+                              min(req.fed_target - req.fed, RAGGED_FEED),
+                              None)
+                feeding = True
+            else:
+                plan[slot] = ("decode", 1, None)
+        if self.spec_enabled and all(reqs[s].temp == 0
+                                     for s in st.active.values()):
+            # greedy batches only (the accept contract is a greedy
+            # contract — same eligibility as _spec_round); feed rows
+            # keep feeding, draftable decode rows widen to a verify
+            # window on the SAME wave
+            for slot, seq_id in st.active.items():
+                if plan[slot][0] != "decode":
+                    continue
+                req = reqs[seq_id]
+                k = min(self.spec_k, req.max_new - len(req.generated) - 1)
+                d = self._draft_for(req, k)
+                if d:
+                    plan[slot] = ("verify", 1 + len(d), d)
+                    verifying = True
+        steps = (self._next_chunk_steps(reqs, st)
+                 if not (feeding or verifying) else 1)
+        st.since_admit += 1
+        w = pow2_bucket(max(q for _, q, _ in plan.values()))
+
+        # ---- page reservation (may preempt; exact bookkeeping) -------
+        for slot, seq_id in list(st.active.items()):
+            if plan[slot][0] == "feed":
+                # feed KV lands in pages the admission already allocated
+                # for the prompt; the emitted token (final window only)
+                # stays pending — nothing to advance
+                continue
+            need = plan[slot][1] + steps - 1
+            while slot in st.active:     # we may become a victim ourselves
+                if self.rt.advance(seq_id, need) is not None:
+                    break
+                if (self.prefix_cache is not None
+                        and self.prefix_cache.evict_lru(1)):
+                    continue
+                victim = max(st.active.values())
+                vreq = reqs[victim]
+                # mid-feed victims land on prompt_len-1 (no pending
+                # sampled token yet) — the runtime's valid lower bound
+                kept = len(vreq.ids) + len(vreq.generated) - 1
+                # lint: allow(hotpath) — preemption is the rare
+                # pool-exhaustion path, never the steady-state tick
+                log_event("engine.preempt", level="warning", seq_id=victim,
+                          kept_tokens=kept, free_pages=self.rt.free_pages)
+                self.rt.preempt(victim, kept)
+                vslot = next(s for s, q in st.active.items() if q == victim)
+                st.active.pop(vslot)
+        plan = {s: p for s, p in plan.items() if s in st.active}
+        if not st.active:
+            return                          # everyone got preempted
+
+        # ---- pack the wave ------------------------------------------
+        b = self.max_slots
+        lens = np.ones(b, np.int32)          # idle slots: trash pos 1
+        for slot, seq_id in st.active.items():
+            req = reqs[seq_id]
+            lens[slot] = (req.fed if plan[slot][0] == "feed"
+                          else len(req.ids) + len(req.generated) - 1)
+        span = min(pow2_bucket(int((lens.max() + w + steps
+                                    + self.page_size - 1) // self.page_size)),
+                   self.max_pages_per_seq)
+        tokens = np.zeros((b, w), np.int32)
+        state = np.zeros((b, span + 7), np.int32)
+        keyarr = np.zeros((b, 2), np.uint32)
+        grammared = False
+        for slot, seq_id in st.active.items():
+            req = reqs[seq_id]
+            kind, qlen, drafts = plan[slot]
+            state[slot, :span] = self.rt.block_table(seq_id)[:span]
+            state[slot, span] = lens[slot]
+            state[slot, span + 1] = qlen
+            state[slot, span + 5] = len(req.generated)
+            state[slot, span + 6] = req.gstate
+            keyarr[slot] = req.key
+            grammared |= req.grammar is not None
+            if kind == "feed":
+                tokens[slot, :qlen] = req.prefill_ids[req.fed:req.fed + qlen]
+            else:
+                pending = int(st.slot_token[slot, 0])
+                tokens[slot, 0] = pending
+                if drafts:
+                    state[slot, span + 2] = len(drafts)
+                    # pad past the drafts with the pending token: padding
+                    # can never be accepted (the accept rule caps at ndraft)
+                    tokens[slot, 1:] = (drafts
+                                        + [pending] * (w - 1 - len(drafts))
+                                        )[:w - 1]
+        state[:, span + 3:span + 5] = keyarr.view(np.int32)
+        rows = list(st.active)
+        filtered = bool(((st.slot_topk[rows] > 0)
+                         | (st.slot_topp[rows] < 1.0))
+                        [st.slot_temp[rows] > 0].any())
+        gtables = self._grammar_tables() if grammared else None
+        samp = np.stack([st.slot_temp, st.slot_topp,
+                         st.slot_topk.astype(np.float32)], axis=1)
+
+        # ---- the tick's ONE dispatch --------------------------------
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("reval.paged_ragged_step"):
+            out_dev, self.cache = self._jit_ragged(
+                self.params, self._dev(jnp.asarray(state)),
+                self._dev(jnp.asarray(tokens)), self.cache,
+                self._dev(jnp.asarray(samp)), gtables,
+                steps=steps, filtered=filtered, grammared=grammared)
+        with deliberate_fetch():
+            # host-sync: the ragged tick's ONE deliberate fetch — the
+            # packed wave output gates every host decision that follows
+            out = np.asarray(out_dev)
+        self.heartbeat = time.monotonic()
+        now = time.perf_counter()
+        wall = now - max(t0, st.t_mark)
+        st.t_mark = now
+        if all(k == "feed" for k, _, _ in plan.values()):
+            self.stats.prefill_seconds += wall
+            self.stats.registry.histogram(
+                obs_metrics.PREFILL_BATCH).observe(wall)
+        else:
+            self.stats.decode_seconds += wall
+            self.stats.registry.histogram(
+                obs_metrics.DECODE_CHUNK).observe(wall)
+            self.stats.decode_chunks += 1
+            self.stats.decode_steps += steps
+        if verifying:
+            self.stats.spec_rounds += 1
+        # wave occupancy: useful = the real (q_len + trailing chunk
+        # steps) work each row asked for; padded = the b*(w+steps-1)
+        # rectangle the one dispatch actually computed
+        self.stats.ragged_ticks += 1
+        self.stats.ragged_useful_tokens += sum(
+            qlen + steps - 1 for _, qlen, _ in plan.values())
+        self.stats.ragged_padded_tokens += len(plan) * (w + steps - 1)
+
+        # ---- host half: accept, append, retire, notify ---------------
+        for slot, seq_id in list(st.active.items()):
+            req = reqs[seq_id]
+            kind, qlen, drafts = plan[slot]
+            if kind == "feed":
+                req.fed += qlen
+                self.stats.prefill_tokens += qlen
+                if req.fed < req.fed_target:
+                    continue                # mid-feed: nothing emitted yet
+                first = int(out[slot, w + 1])
+                # append, not reset: after a preemption the kept tokens
+                # were re-fed and stand
+                req.generated.append(first)
+                if req.grammar is not None:
+                    req.gstate = self._grammars.walk(req.gstate, [first])
+                if req.t_first is None:
+                    req.t_first = time.perf_counter()
+                st.slot_token[slot] = first
+                self.stats.generated_tokens += 1
+                if self._finished(req, [first]):
+                    self._retire(req, seq_id, slot, st.active)
+                if req.notify is not None:
+                    req.notify(req)
+                continue
+            if kind == "verify":
+                nd = len(drafts)
+                acc = min(int(out[slot, w]), nd)
+                take = min(acc + 1, req.max_new - len(req.generated))
+                new_toks = [int(t) for t in out[slot, :take]]
+                used = max(0, take - 1)     # drafts that landed
+                self.stats.spec_drafted_tokens += nd
+                self.stats.spec_accepted_tokens += min(acc, used)
+                self.stats.spec_rolled_back_tokens += nd - min(acc, used)
+                self.stats.generated_tokens += take
+                self.stats.registry.histogram(
+                    obs_metrics.SPEC_ACCEPTED_PER_ROUND).observe(float(acc))
+                req.generated.extend(new_toks)
+                st.slot_token[slot] = new_toks[-1]
+                if req.grammar is not None:
+                    req.gstate = self._grammars.walk(req.gstate, new_toks)
+                if take < qlen:
+                    # exact page bookkeeping: return the rejected tail's
+                    # reservation (pages past the covering count free)
+                    self.rt.rollback(seq_id, int(lens[slot]) + take)
+                if self._finished(req, new_toks):
+                    self._retire(req, seq_id, slot, st.active)
+                if req.notify is not None:
+                    req.notify(req)
+                continue
+            chunk_ids = [int(t) for t in out[slot, w + 1:w + 1 + steps]]
+            self.stats.generated_tokens += steps
+            req.generated.extend(chunk_ids)
+            if req.grammar is not None:
+                req.gstate = self._grammars.walk(req.gstate, chunk_ids)
+            st.slot_token[slot] = chunk_ids[-1]
+            if self._finished(req, chunk_ids):
+                self._retire(req, seq_id, slot, st.active)
+            if req.notify is not None:
+                req.notify(req)
+        if feeding:
+            # the first pure-decode tick after a feed completes keeps the
+            # short-first-chunk admission semantics
+            st.since_admit = 0
 
     # -- speculative verify path (reval_tpu/decoding/; ROADMAP item 2) -----
     def _grammar_tables(self):
@@ -1935,6 +2405,11 @@ class PagedTPUEngine:
     def _prefill_admitted(self, admitted: list[tuple[int, int]],
                           reqs: dict[int, _Request]) -> dict[int, int]:
         """Prefill all just-admitted sequences, batched by prompt bucket.
+
+        Split-dispatch mode only — a ``ragged``/``ragged_xla`` backend
+        never calls this: ``_tick_ragged`` feeds admitted prompts as
+        ragged windows of the shared wave instead, with no pow2 prompt
+        bucketing and no separate prefill program.
 
         Sequences sharing a page bucket prefill as ONE left-padded batch
         (padded to a power-of-two row count to bound compile variants;

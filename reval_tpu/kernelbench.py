@@ -97,7 +97,7 @@ LEGACY_LABELS = {
 class KernelCell:
     """One leaderboard cell: a fully pinned kernel configuration."""
 
-    backend: str            # xla | pallas | pallas_seq
+    backend: str            # xla | pallas | pallas_seq | ragged
     dot: str | None         # swap | wide (None for xla: no dot knob)
     pool: str               # bf16 | int8 KV pool dtype
     chunk: int              # decode chunk size (steps per host fetch)
@@ -150,12 +150,12 @@ def default_cells(tiny: bool = False) -> list[KernelCell]:
     — not the chip numbers — are what tier-1 certifies."""
     cells: list[KernelCell] = []
     if tiny:
-        for backend in ("xla", "pallas", "pallas_seq"):
+        for backend in ("xla", "pallas", "pallas_seq", "ragged"):
             for chunk in (2, 4):
                 dot = None if backend == "xla" else "swap"
                 cells.append(KernelCell(backend, dot, "bf16", chunk))
         return cells
-    for backend in ("xla", "pallas", "pallas_seq"):
+    for backend in ("xla", "pallas", "pallas_seq", "ragged"):
         dots = (None,) if backend == "xla" else ("swap", "wide")
         for dot in dots:
             for pool in ("bf16", "int8"):
@@ -213,10 +213,22 @@ def _cell_fn(backend: str, dot: str | None):
 
     if backend == "xla":
         return pa.paged_decode_attention_xla, {}
-    fn = (pa.paged_decode_attention_pallas_seq if backend == "pallas_seq"
-          else pa.paged_decode_attention_pallas)
     kw = {"dot_mode": dot or "swap",
           "interpret": jax.default_backend() != "tpu"}
+    if backend == "ragged":
+        import jax.numpy as jnp
+
+        def ragged_decode(q, k, v, tables, lens, **kwargs):
+            # the ragged wave kernel at its decode point (W=1): same
+            # operand shapes as every other cell, so the leaderboard
+            # prices it head-to-head on the one shape all cells share
+            out = pa.ragged_paged_attention_pallas(
+                q[:, None], k, v, tables, jnp.maximum(lens, 1) - 1,
+                jnp.ones_like(lens), **kwargs)
+            return out[:, 0]
+        return ragged_decode, kw
+    fn = (pa.paged_decode_attention_pallas_seq if backend == "pallas_seq"
+          else pa.paged_decode_attention_pallas)
     return fn, kw
 
 

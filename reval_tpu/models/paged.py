@@ -47,7 +47,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import rope_angles
-from ..ops.pallas_attention import paged_decode_attention
+from ..ops.pallas_attention import (paged_decode_attention,
+                                    ragged_paged_attention)
 from .configs import ModelConfig
 from .model import (_block, _embed, _norm, _unembed,
                     prefill_with_batched_context)
@@ -56,6 +57,7 @@ __all__ = [
     "PagedKVCache",
     "init_paged_cache",
     "paged_decode_step",
+    "paged_ragged_step",
     "commit_prefill",
     "commit_verify",
     "gather_prefix_context",
@@ -270,6 +272,80 @@ def paged_decode_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _unembed(params, cfg, h)[:, 0, :], out_cache
 
 
+def paged_ragged_step(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                      block_tables: jnp.ndarray, ctx_lens: jnp.ndarray,
+                      q_lens: jnp.ndarray, cache: PagedKVCache,
+                      mesh=None) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One ragged window forward over a MIXED batch: the unified shape
+    that replaces per-row gathered-context prefill, the decode step, and
+    the spec-verify window (ops/pallas_attention.py ragged kernel).
+
+    tokens: [B, W] — row ``b``'s window, left-aligned; column ``j`` is
+    the token at absolute position ``ctx_lens[b] + j`` and columns
+    ``j >= q_lens[b]`` are padding (their KV lands in the trash page,
+    their logits are unspecified).  A decode row is ``q_lens=1``, a
+    verify window ``1+ndraft``, a prefill chunk up to ``W``.  Each
+    layer scatters the window's KV into the pool FIRST (the same flat
+    positions plain decode would write, which keeps ragged KV
+    bit-compatible with the incumbent paths), then attends through the
+    page table — no dense per-row context gather, no pow2 context
+    bucketing.  Returns (logits [B, W, V], updated cache).
+
+    ``mesh`` must be tp=1 (the engine falls back to the incumbent split
+    dispatch on tp-sharded meshes — the ragged kernel has no shard_map
+    wrapper yet).
+    """
+    page = cache.page_size
+    b, w = tokens.shape
+    h = _embed(params, cfg, tokens)
+    positions = ctx_lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    # window column j lands at the flat position decode would have
+    # written token ctx+j to; padding cols land in the trash page rows
+    pidx = jnp.clip(positions // page, 0, block_tables.shape[1] - 1)
+    dest = jnp.take_along_axis(block_tables, pidx, axis=1) * page \
+        + positions % page                                      # [B, W]
+    col_valid = jnp.arange(w, dtype=jnp.int32)[None, :] < q_lens[:, None]
+    flat_idx = jnp.where(col_valid, dest, positions % page)
+
+    layers = params["layers"]
+    new_k, new_v = [], []
+    new_ks, new_vs = [], []
+    for i in range(cfg.num_layers):
+        layer = jax.tree.map(lambda x: x[i], layers)
+
+        def attend(q, k, v, i=i):
+            ks_i, vs_i = _layer_scales(cache, i)
+            if cache.quantized:
+                kq, ks_new = _quantize_kv(k)
+                vq, vs_new = _quantize_kv(v)
+                ki = cache.k[i].at[flat_idx].set(kq)
+                vi = cache.v[i].at[flat_idx].set(vq)
+                ks_i = ks_i.at[flat_idx].set(ks_new)
+                vs_i = vs_i.at[flat_idx].set(vs_new)
+                new_ks.append(ks_i)
+                new_vs.append(vs_i)
+            else:
+                # leading-dim scatter → in-place on the donated buffer
+                ki = cache.k[i].at[flat_idx].set(k.astype(cache.dtype))
+                vi = cache.v[i].at[flat_idx].set(v.astype(cache.dtype))
+            new_k.append(ki)
+            new_v.append(vi)
+            return ragged_paged_attention(
+                q, ki, vi, block_tables, ctx_lens, q_lens,
+                page_size=page, scale=cfg.attn_scale,
+                window=cfg.window_for_layer(i),
+                softcap=cfg.attn_softcap, k_scales=ks_i, v_scales=vs_i)
+
+        h = _block(h, layer, cfg, cos, sin, attend)
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    out_cache = PagedKVCache(
+        k=tuple(new_k), v=tuple(new_v), page_size=page,
+        k_scale=tuple(new_ks) if cache.quantized else None,
+        v_scale=tuple(new_vs) if cache.quantized else None)
+    return _unembed(params, cfg, h), out_cache
+
+
 def gather_prefix_context(cache: PagedKVCache, ctx_tables: jnp.ndarray
                           ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Gather per-row prefix KV out of the page pool into contiguous
@@ -277,6 +353,12 @@ def gather_prefix_context(cache: PagedKVCache, ctx_tables: jnp.ndarray
     past each row's real prefix) → ``(k, v)`` each ``[L, B, N_pre * P,
     H_kv, D]`` — the ``ctx_k``/``ctx_v`` operands of
     :func:`~reval_tpu.models.model.prefill_with_batched_context`.
+
+    DEPRECATED as a serving path: :func:`paged_ragged_step` attends
+    pool pages directly with no dense gather and owns prefill whenever
+    the engine runs the ragged backend.  This stays as the incumbent
+    fallback (split-dispatch mode, tp-sharded meshes) and as the
+    prefix-insert batch-1 path.
 
     The gather hits the pool's *leading* (token-major) dim — the
     XLA-friendly whole-page gather form this layout was chosen for (see
@@ -317,6 +399,11 @@ def prefill_with_paged_context(params, cfg: ModelConfig, tokens: jnp.ndarray,
     prefixes ever exists, and different rows ride different prefixes in
     one call.  ``paged`` is read-only here (commit of the suffix KV is a
     separate donated step, as for plain prefill).
+
+    DEPRECATED as a serving path (see :func:`gather_prefix_context`):
+    ragged-backend prefill feeds windows through
+    :func:`paged_ragged_step` instead.  Kept as the incumbent fallback
+    and the spec-verify forward of the split-dispatch mode.
     """
     ctx_k, ctx_v = gather_prefix_context(paged, ctx_tables)
     return prefill_with_batched_context(

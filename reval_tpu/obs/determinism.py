@@ -104,9 +104,10 @@ DEFAULT_REFERENCE = "paged-xla-fp32-b2"
 #: outputs fails this slice with a named cell + first divergent token.
 PARITY_SLICE = ("paged-xla-fp32-b2", "static-fp32-b2",
                 "paged-pallas_seq-fp32-b2", "paged-pallas-fp32-b2",
+                "paged-ragged-fp32-b2", "paged-ragged-fp32-b4",
                 "paged-xla-fp32-dp2-b2", "paged-xla-fp32-b4",
                 "spec-paged-xla-fp32-b2", "spec-paged-xla-fp32-b4",
-                "kvtier-paged-xla-fp32-b2")
+                "spec-paged-ragged-fp32-b2", "kvtier-paged-xla-fp32-b2")
 
 #: the bench garnish slice: cheap cross-backend sanity (reference +
 #: static engine + seq kernel + the speculative greedy-accept
@@ -172,6 +173,12 @@ def default_cells() -> list[CellSpec]:
         # kernel axis: the two Pallas formulations vs the XLA oracle
         CellSpec("paged-pallas_seq-fp32-b2", "paged", "pallas_seq"),
         CellSpec("paged-pallas-fp32-b2", "paged", "pallas"),
+        # ragged axis: the one-dispatch-per-tick continuous-batching
+        # engine (ragged paged attention serves prefill, decode, and
+        # verify windows in a single wave) must emit exactly the
+        # reference stream — the PR-17 kernel's parity contract
+        CellSpec("paged-ragged-fp32-b2", "paged", "ragged"),
+        CellSpec("paged-ragged-fp32-b4", "paged", "ragged", batch=4),
         # parallelism axis: dp=2 replicas vs dp=1
         CellSpec("paged-xla-fp32-dp2-b2", "dp_paged", "xla", dp=2),
         # batch-width axis: wider slot count must not change greedy
@@ -181,6 +188,11 @@ def default_cells() -> list[CellSpec]:
         # (accept rate rides the row as drift-allowed telemetry)
         CellSpec("spec-paged-xla-fp32-b2", "paged", "xla", spec=True),
         CellSpec("spec-paged-xla-fp32-b4", "paged", "xla", batch=4,
+                 spec=True),
+        # speculative × ragged: draft windows verified INSIDE the ragged
+        # wave (no separate verify dispatch) keep the greedy-accept
+        # contract
+        CellSpec("spec-paged-ragged-fp32-b2", "paged", "ragged",
                  spec=True),
         # KV-tier axis: the spill→promote round trip (host-DRAM tier)
         # must serve byte-for-byte what the resident pages would have

@@ -171,7 +171,7 @@ def build_bundle(reason: str, envelope: bool = True, **sections) -> dict:
 
 
 class PostmortemWriter:
-    """Land bundles on disk: ``<dir>/postmortem-<ts>-<pid>.json``.
+    """Land bundles on disk: ``<dir>/postmortem-<ts>-<seq>-<pid>.json``.
 
     - **atomic**: written to a ``.tmp`` sibling and renamed, so a
       concurrent reader (or a crash mid-write) never sees a torn file;
@@ -197,6 +197,7 @@ class PostmortemWriter:
         self.keep = int(keep)
         self.min_interval_s = float(min_interval_s)
         self._last_dump: dict[str, float] = {}   # reason -> last success
+        self._seq = 0                            # per-writer write counter
 
     def dump(self, bundle: dict) -> str | None:
         """Write one bundle; returns the path, or None (rate-limited or
@@ -214,7 +215,12 @@ class PostmortemWriter:
             os.makedirs(self.directory, exist_ok=True)
             stamp = time.strftime("%Y%m%d-%H%M%S")
             ms = int(time.time() * 1000) % 1000
-            name = f"postmortem-{stamp}-{ms:03d}-{os.getpid()}.json"
+            # fixed-width per-writer sequence: two dumps in the same
+            # millisecond must not collide (retention prunes by name
+            # sort, so the disambiguator has to sort in write order)
+            self._seq += 1
+            name = (f"postmortem-{stamp}-{ms:03d}"
+                    f"-{self._seq:04d}-{os.getpid()}.json")
             path = os.path.join(self.directory, name)
             with open(path + ".tmp", "w") as f:
                 json.dump(bundle, f, default=str)

@@ -61,6 +61,8 @@ EVENTS: dict[str, str] = {
     # engine (inference/tpu/paged_engine.py)
     "engine.preempt": "a running sequence was preempted on pool exhaustion",
     "engine.deadlock": "nothing running or admissible while work remains",
+    "engine.ragged_fallback": "a ragged backend was requested but the "
+                              "engine fell back to split dispatch",
     # jit-discipline tracker (analysis/jitcheck.py)
     "jit.recompile": "a tracked jit entry compiled a new variant past "
                      "its declared warmup budget",

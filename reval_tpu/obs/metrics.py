@@ -223,6 +223,15 @@ METRICS: dict[str, dict] = {
         "type": "counter", "help": "Pages prefilled into the prefix cache"},
     "reval_prefix_evictions_total": {
         "type": "counter", "help": "LRU cache nodes evicted under pressure"},
+    "reval_ragged_ticks_total": {
+        "type": "counter",
+        "help": "Ragged continuous-batching drive ticks (one dispatch each)"},
+    "reval_ragged_useful_tokens_total": {
+        "type": "counter",
+        "help": "Real query+chunk positions the ragged waves asked for"},
+    "reval_ragged_padded_tokens_total": {
+        "type": "counter",
+        "help": "Padded b*w rectangle positions the ragged waves computed"},
     "reval_serving_sheds_total": {
         "type": "counter", "help": "Submissions shed by admission control"},
     "reval_serving_deadline_expired_total": {

@@ -60,6 +60,9 @@ __all__ = [
     "paged_decode_attention_xla",
     "paged_decode_attention_pallas",
     "paged_decode_attention_pallas_seq",
+    "ragged_paged_attention",
+    "ragged_paged_attention_xla",
+    "ragged_paged_attention_pallas",
     "resolved_paged_backend",
 ]
 
@@ -601,6 +604,301 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
     return out.reshape(b, h, d).astype(q.dtype)
 
 
+# -- ragged paged attention: one kernel for prefill, decode, and verify -----
+#
+# The ragged formulation (PAPERS.md, arxiv 2604.15464) serves a MIXED batch
+# in one wave: every row carries a ``(ctx_len, q_len)`` descriptor — a
+# decode row is ``q_len=1``, a draft-verify window ``q_len=1+ndraft``, a
+# prefill(-chunk) row ``q_len=w`` — and query column ``j`` of row ``b``
+# attends kv positions ``< ctx_len[b] + j + 1`` through the page table.
+# No per-row gather of pool pages into a dense context buffer, no pow2
+# context bucketing: the window's KV is scattered into the pool FIRST
+# (models/paged.py ``paged_ragged_step``) and the kernel reads pages.
+#
+# Columns ``j >= q_len[b]`` are PADDING: their output is unspecified
+# (finite, never NaN — ``_NEG_INF`` is a finite sentinel, so an all-masked
+# row degrades to a uniform average, not 0/0) and must not be read.
+# ``q_lens`` bounds page liveness so a decode row in a wide-window batch
+# streams only its own ``ctx+1`` tokens' pages.
+
+def _ragged_fold_q(q, h_kv: int, g: int):
+    """[W, H, D] → [W*H, D] virtual heads in KV-HEAD-MAJOR order
+    (``vh = kv*(W*g) + w*g + h_in_group``), so the existing swap/wide dot
+    helpers see a plain ``(h_kv, W*g)`` head grouping.  The transpose
+    keeps the lane-aligned D minor dim (a sublane shuffle, Mosaic-safe)."""
+    w, h, d = q.shape
+    return q.reshape(w, h_kv, g, d).transpose(1, 0, 2, 3).reshape(w * h, d)
+
+
+def _ragged_unfold(acc, w: int, h_kv: int, g: int):
+    """[W*H, D] kv-head-major virtual heads → [W, H, D]."""
+    d = acc.shape[-1]
+    return acc.reshape(h_kv, w, g, d).transpose(1, 0, 2, 3).reshape(
+        w, h_kv * g, d)
+
+
+def _ragged_col_iota(w: int, h_kv: int, g: int, page_size: int):
+    """[W*H, P] int32: the query COLUMN each virtual-head row belongs to
+    (``(vh // g) % w`` under the kv-head-major fold) — the per-row piece
+    of the ragged causal mask."""
+    vh = jax.lax.broadcasted_iota(jnp.int32, (w * h_kv * g, page_size), 0)
+    return (vh // g) % w
+
+
+def _ragged_kernel(block_tables_ref, ctx_lens_ref, q_lens_ref, q_ref,
+                   k_ref, v_ref, *rest, page_size: int, scale: float,
+                   max_pages: int, w: int, window: int | None,
+                   softcap: float | None, h_kv: int, g: int,
+                   quantized: bool, wide: bool):
+    """Grid ``(B, max_pages)``, page-innermost-arbitrary like
+    ``_decode_kernel`` — but the W query columns of the row's ragged
+    window fold into W*H VIRTUAL heads (kv-head-major, see
+    ``_ragged_fold_q``), so every per-page dot/flash helper is reused
+    verbatim with ``g -> W*g`` and the causal mask varying per virtual
+    head instead of per row."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx_len = ctx_lens_ref[b]
+    q_len = q_lens_ref[b]
+    # the row's attended span ends at ctx + q_len (its last real query
+    # column sees kv positions < ctx + q_len); clamp at 1 so an idle
+    # padding row still owns one (masked) live page — l stays > 0
+    attn_max = ctx_len + jnp.maximum(jnp.minimum(q_len, w), 1)
+    live = p * page_size < attn_max
+    if window is not None:
+        # the EARLIEST query column's window lower bound is
+        # ctx + 1 - window; pages wholly before it are dead for every col
+        live = live & ((p + 1) * page_size > ctx_len + 1 - window)
+
+    @pl.when(live)
+    def _compute():
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (w * h_kv * g, page_size), 1)
+        pos = p * page_size + cols                    # [W*H, P] kv pos
+        qcol = _ragged_col_iota(w, h_kv, g, page_size)
+        attn_len = ctx_len + qcol + 1                 # ragged causal edge
+        valid = pos < attn_len
+        if window is not None:
+            valid = valid & (pos >= attn_len - window)
+        q = _ragged_fold_q(q_ref[0].astype(jnp.float32), h_kv, g)
+        if wide:
+            q = _widen_q(q, h_kv, w * g)              # [W*H, H_kv*D]
+        k = k_ref[0].astype(jnp.float32)              # [P, H_kv, D]
+        v = v_ref[0].astype(jnp.float32)
+        ks_hp = vs_hp = None
+        if ks_ref is not None:
+            ks_hp = _scale_rows(ks_ref[0], w * g)
+            vs_hp = _scale_rows(vs_ref[0], w * g)
+        s = _page_scores(q, k, scale, softcap, valid, h_kv, w * g, ks_hp,
+                         wide)
+        _flash_update(s, v, m_ref, l_ref, acc_ref, h_kv, w * g, vs_hp,
+                      wide)
+
+    @pl.when(p == max_pages - 1)
+    def _finalize():
+        o_ref[0] = _ragged_unfold(
+            acc_ref[:] / l_ref[:, :1], w, h_kv, g).astype(o_ref.dtype)
+
+
+# jit-entry: ops.ragged_attn_pallas static=(page_size, scale, interpret, window, softcap, dot_mode) bucketed=(batch, q_window, pages)
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "scale", "interpret", "window",
+                              "softcap", "dot_mode"))
+def ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                  ctx_lens, q_lens, *, page_size: int,
+                                  scale: float | None = None,
+                                  interpret: bool = False,
+                                  window: int | None = None,
+                                  softcap: float | None = None,
+                                  k_scales=None, v_scales=None,
+                                  dot_mode: str = "swap"):
+    """Ragged paged attention (Pallas TPU kernel): one wave over a mixed
+    prefill / decode / verify batch.
+
+    q: [B, W, H, D] — W query columns per row, left-aligned; column j of
+    row b is the token at absolute position ``ctx_lens[b] + j`` and
+    attends kv positions ``< ctx_lens[b] + j + 1`` through the page
+    table.  k_pages/v_pages: [N_pages * P, H_kv, D] token-major flat
+    (the window's KV already scattered in — see models/paged.py
+    ``paged_ragged_step``); block_tables: [B, max_pages] int32;
+    ctx_lens/q_lens: [B] int32 ragged descriptors.  Columns
+    ``j >= q_lens[b]`` produce unspecified (finite) output.  Returns
+    [B, W, H, D].
+    """
+    if dot_mode not in ("swap", "wide"):
+        # a typo would silently bench swap under the wide label
+        raise ValueError(f"unknown dot_mode {dot_mode!r}; expected swap | wide")
+    b, w, h, d = q.shape
+    h_kv = k_pages.shape[1]
+    g = h // h_kv
+    max_pages = block_tables.shape[1]
+    quantized = k_scales is not None
+    scale = float(scale if scale is not None else d ** -0.5)
+    kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
+    vp = v_pages.reshape(-1, page_size, h_kv, d)
+
+    def page_index(b_, p_, bt, cl, ql):
+        # dead pages (beyond the row's ragged span) redirect to page 0:
+        # consecutive identical indices skip the HBM→VMEM re-DMA
+        amax = cl[b_] + jnp.maximum(jnp.minimum(ql[b_], w), 1)
+        alive = p_ * page_size < amax
+        if window is not None:
+            alive = alive & ((p_ + 1) * page_size > cl[b_] + 1 - window)
+        return jnp.where(alive, bt[b_, p_], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, w, h, d), lambda b_, p_, bt, cl, ql: (b_, 0, 0, 0)),
+        pl.BlockSpec((1, page_size, h_kv, d),
+                     lambda b_, p_, bt, cl, ql: (
+                         page_index(b_, p_, bt, cl, ql), 0, 0, 0)),
+        pl.BlockSpec((1, page_size, h_kv, d),
+                     lambda b_, p_, bt, cl, ql: (
+                         page_index(b_, p_, bt, cl, ql), 0, 0, 0)),
+    ]
+    operands = [q, kp, vp]
+    if quantized:
+        ksp = k_scales.reshape(-1, page_size, h_kv)
+        vsp = v_scales.reshape(-1, page_size, h_kv)
+        spec_s = pl.BlockSpec(
+            (1, page_size, h_kv),
+            lambda b_, p_, bt, cl, ql: (
+                page_index(b_, p_, bt, cl, ql), 0, 0))
+        in_specs += [spec_s, spec_s]
+        operands += [ksp, vsp]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, w, h, d),
+                               lambda b_, p_, bt, cl, ql: (b_, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((w * h, 128), jnp.float32),  # running max (lane-rep)
+            pltpu.VMEM((w * h, 128), jnp.float32),  # running denominator
+            pltpu.VMEM((w * h, d), jnp.float32),    # output accumulator
+        ],
+    )
+    kernel = functools.partial(_ragged_kernel, page_size=page_size,
+                               scale=scale, max_pages=max_pages, w=w,
+                               window=window, softcap=softcap, h_kv=h_kv,
+                               g=g, quantized=quantized,
+                               wide=dot_mode == "wide")
+    # tile: (8, 128) — f32 native VMEM tiling; head_dim rides the lane
+    # dim (the 128-wide scratch rows), W*H virtual heads ride the sublane
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, w, h, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables, ctx_lens, q_lens, *operands)
+
+
+def ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
+                               ctx_lens, q_lens, *, page_size: int,
+                               scale: float | None = None,
+                               window: int | None = None,
+                               softcap: float | None = None,
+                               k_scales=None, v_scales=None):
+    """Portable XLA reference for :func:`ragged_paged_attention_pallas`.
+
+    Whole-page gather into [B, S, H_kv, D] plus a dense [B, W, S] ragged
+    causal mask (``pos < ctx + j + 1``); the unit-test oracle and the
+    CPU/export execution path.  Same padding-column contract: output at
+    ``j >= q_lens[b]`` is unspecified but finite (``q_lens`` is accepted
+    for signature parity; the mask needs only ``ctx_lens``).
+    """
+    del q_lens      # padding cols share the valid-col mask rule; never read
+    b, w, h, d = q.shape
+    h_kv = k_pages.shape[1]
+    g = h // h_kv
+    s_max = block_tables.shape[1] * page_size
+    scale = scale if scale is not None else d ** -0.5
+
+    kp = k_pages.reshape(-1, page_size, h_kv, d)   # [N, P, H_kv, D] view
+    vp = v_pages.reshape(-1, page_size, h_kv, d)
+    k_seq = kp[block_tables].reshape(b, s_max, h_kv, d).astype(jnp.float32)
+    v_seq = vp[block_tables].reshape(b, s_max, h_kv, d).astype(jnp.float32)
+    if k_scales is not None:
+        ksp = k_scales.reshape(-1, page_size, h_kv)
+        vsp = v_scales.reshape(-1, page_size, h_kv)
+        k_seq = k_seq * ksp[block_tables].reshape(b, s_max, h_kv)[..., None]
+        v_seq = v_seq * vsp[block_tables].reshape(b, s_max, h_kv)[..., None]
+
+    qg = q.reshape(b, w, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bwngd,bsnd->bwngs", qg, k_seq) * scale
+    scores = _softcap(scores, softcap)
+    pos = jnp.arange(s_max)[None, None, :]                     # [1, 1, S]
+    attn_len = ctx_lens[:, None] + jnp.arange(w)[None, :] + 1  # [B, W]
+    valid = pos < attn_len[:, :, None]
+    if window is not None:
+        valid = valid & (pos >= attn_len[:, :, None] - window)
+    scores = jnp.where(valid[:, :, None, None, :], scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bwngs,bsnd->bwngd", probs, v_seq)
+    return out.reshape(b, w, h, d).astype(q.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, ctx_lens,
+                           q_lens, *, page_size: int,
+                           scale: float | None = None,
+                           window: int | None = None,
+                           softcap: float | None = None,
+                           k_scales=None, v_scales=None):
+    """Backend-dispatching ragged paged attention.
+
+    ``REVAL_TPU_PAGED_BACKEND=ragged`` selects the Pallas kernel
+    (interpret mode off-TPU, same ``REVAL_TPU_FORCE_MOSAIC`` escape as
+    the decode dispatch); ``ragged_xla`` pins the gather reference —
+    the exportable formulation deviceless AOT uses.  Any other resolved
+    backend (the engine only calls this when it runs in ragged mode)
+    defaults to Pallas-on-TPU / XLA-elsewhere, mirroring
+    :func:`paged_decode_attention`'s fallback rule.
+    """
+    from ..env import env_str
+
+    choice = (env_str("REVAL_TPU_PAGED_BACKEND")
+              or _autotune_defaults().get("REVAL_TPU_PAGED_BACKEND"))
+    if choice == "ragged_xla":
+        use_pallas = False
+    elif choice == "ragged":
+        use_pallas = True
+    else:
+        use_pallas = jax.default_backend() == "tpu"
+    if not use_pallas:
+        return ragged_paged_attention_xla(
+            q, k_pages, v_pages, block_tables, ctx_lens, q_lens,
+            page_size=page_size, scale=scale, window=window,
+            softcap=softcap, k_scales=k_scales, v_scales=v_scales)
+    force = (env_str("REVAL_TPU_FORCE_MOSAIC") or "").lower()
+    interpret = (jax.default_backend() != "tpu"
+                 and force not in ("1", "true"))
+    dot = (env_str("REVAL_TPU_KERNEL_DOT")
+           or _autotune_defaults().get("REVAL_TPU_KERNEL_DOT") or "swap")
+    if dot not in ("swap", "wide"):
+        raise ValueError(f"unknown REVAL_TPU_KERNEL_DOT {dot!r}; "
+                         "expected swap | wide")
+    return ragged_paged_attention_pallas(
+        q, k_pages, v_pages, block_tables, ctx_lens, q_lens,
+        page_size=page_size, scale=scale, interpret=interpret,
+        window=window, softcap=softcap, k_scales=k_scales,
+        v_scales=v_scales, dot_mode=dot)
+
+
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            *, page_size: int, scale: float | None = None,
                            window: int | None = None,
@@ -634,11 +932,24 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
 
     choice = (env_str("REVAL_TPU_PAGED_BACKEND")
               or _autotune_defaults().get("REVAL_TPU_PAGED_BACKEND"))
-    if choice not in (None, "", "pallas", "pallas_seq", "xla"):
+    if choice not in (None, "", "pallas", "pallas_seq", "xla",
+                      "ragged", "ragged_xla"):
         # a typo here would silently bench the wrong backend under the
         # right label — fail loudly instead
         raise ValueError(f"unknown REVAL_TPU_PAGED_BACKEND {choice!r}; "
-                         "expected pallas | pallas_seq | xla")
+                         "expected pallas | pallas_seq | xla | ragged | "
+                         "ragged_xla")
+    if choice in ("ragged", "ragged_xla"):
+        # ragged mode: ONE kernel owns every attention shape, including
+        # the plain decode step (a W=1 ragged window).  The engine passes
+        # attn_lens (= seq_lens + 1 past the freshly written token), so
+        # the ragged descriptor is ctx = attn_len - 1 with one query col.
+        out = ragged_paged_attention(
+            q[:, None], k_pages, v_pages, block_tables,
+            jnp.maximum(seq_lens, 1) - 1, jnp.ones_like(seq_lens),
+            page_size=page_size, scale=scale, window=window,
+            softcap=softcap, k_scales=k_scales, v_scales=v_scales)
+        return out[:, 0]
     if choice == "pallas_seq":
         fn = paged_decode_attention_pallas_seq
     else:
@@ -675,7 +986,7 @@ def resolved_paged_backend() -> str:
 
     choice = (env_str("REVAL_TPU_PAGED_BACKEND")
               or _autotune_defaults().get("REVAL_TPU_PAGED_BACKEND"))
-    if choice in ("pallas", "pallas_seq", "xla"):
+    if choice in ("pallas", "pallas_seq", "xla", "ragged", "ragged_xla"):
         return choice
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
@@ -691,7 +1002,7 @@ def resolved_kernel_knobs() -> dict:
     constants, so xla-resolved programs cache across knob changes."""
     from ..env import env_str
 
-    if resolved_paged_backend() == "xla":
+    if resolved_paged_backend() in ("xla", "ragged_xla"):
         return {"dot_mode": "n/a", "interpret": "n/a"}
     force = (env_str("REVAL_TPU_FORCE_MOSAIC") or "").lower()
     return {"dot_mode": (env_str("REVAL_TPU_KERNEL_DOT")

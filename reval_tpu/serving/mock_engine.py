@@ -279,7 +279,11 @@ class MockStepEngine:
         dt = time.perf_counter() - t0
         self.stats.registry.histogram(obs_metrics.ENGINE_STEP).observe(dt)
         if self.flightrec.enabled:
+            # in-flight steps field is 0: the mock mirrors the ragged
+            # engine's one-dispatch-per-tick contract (every tick fetches
+            # its own output; nothing is ever parked in flight), so the
+            # step-cadence fields postmortems read stay meaningful
             self.flightrec.record(
                 sum(1 for r in reqs.values() if not r.done), 0, 0, 0, 0, 0,
-                0, 0, self.tokens_per_step, dt,
+                0, 0, 0, dt,
                 time.monotonic() - self.heartbeat, tuple(reqs))

@@ -324,14 +324,15 @@ class TestTaxonomy:
             names = [c.name for c in cells]
             assert len(names) == len(set(names))
         full = default_cells(False)
-        assert len(full) == 20
-        assert {c.backend for c in full} == {"xla", "pallas", "pallas_seq"}
+        assert len(full) == 28
+        assert {c.backend for c in full} == {"xla", "pallas", "pallas_seq",
+                                             "ragged"}
         assert {c.pool for c in full} == {"bf16", "int8"}
         assert {c.chunk for c in full} == {8, 32}
         assert {c.dot for c in full if c.backend != "xla"} == {"swap",
                                                                "wide"}
         tiny = default_cells(True)
-        assert len(tiny) == 6
+        assert len(tiny) == 8
         assert {WEDGE_CELL, TIMEOUT_CELL, FLAKY_CELL} <= {c.name
                                                           for c in tiny}
 

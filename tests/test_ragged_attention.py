@@ -1,0 +1,427 @@
+"""Ragged paged attention + true continuous batching (PR-17).
+
+Three layers under test:
+
+- the **kernel**: ``ragged_paged_attention_pallas`` (interpret mode) and
+  the ``ragged_paged_attention_xla`` gather reference against an
+  independent per-row numpy oracle, across every ragged shape the engine
+  dispatches — pure decode, pure prefill, mixed waves, verify windows,
+  single rows, page-straddling contexts, padding rows/columns;
+- the **engine**: ``_tick_ragged`` greedy output must be bit-identical
+  to the incumbent split-dispatch engine, speculative verify included,
+  and a long prefill must admit mid-decode without stalling the rows
+  already decoding (the continuous-batching drill);
+- the **contract**: one jit dispatch per drive tick (tier-1 — asserted
+  via the ``paged.ragged_step`` call counter against ``ragged_ticks``),
+  and a second boot under the AOT executable cache paying zero fresh
+  compiles on the ragged entry.
+
+Cache layout matches models/paged.py: token-major flat pool
+``[N * P, H_kv, D]``; page ``n`` is rows ``[n * P, (n + 1) * P)``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from reval_tpu.ops.pallas_attention import (
+    ragged_paged_attention_pallas,
+    ragged_paged_attention_xla,
+)
+
+PAGE = 128
+
+
+def ragged_reference(q, k_pages, v_pages, tables, ctx_lens, q_lens, *,
+                     page_size=PAGE, window=None, softcap=None):
+    """Independent oracle: per-(row, column, head) dense attention in
+    f64 numpy.  Column ``j`` of row ``b`` attends kv positions
+    ``< ctx_lens[b] + j + 1``; padding columns are returned as zeros
+    (the caller compares valid columns only)."""
+    q = np.asarray(q, np.float64)
+    b, w, h, d = q.shape
+    h_kv = k_pages.shape[1]
+    g = h // h_kv
+    scale = d ** -0.5
+    kp = np.asarray(k_pages, np.float64).reshape(-1, page_size, h_kv, d)
+    vp = np.asarray(v_pages, np.float64).reshape(-1, page_size, h_kv, d)
+    tables = np.asarray(tables)
+    out = np.zeros_like(q)
+    for bi in range(b):
+        s_max = tables.shape[1] * page_size
+        k_seq = kp[tables[bi]].reshape(s_max, h_kv, d)
+        v_seq = vp[tables[bi]].reshape(s_max, h_kv, d)
+        for j in range(int(q_lens[bi])):
+            alen = int(ctx_lens[bi]) + j + 1
+            lo = max(0, alen - window) if window is not None else 0
+            for hh in range(h):
+                kvh = hh // g
+                s = k_seq[lo:alen, kvh] @ q[bi, j, hh] * scale
+                if softcap is not None:
+                    s = softcap * np.tanh(s / softcap)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[bi, j, hh] = p @ v_seq[lo:alen, kvh]
+    return out
+
+
+def make_wave(ctx_lens, q_lens, *, w=4, h=4, h_kv=2, d=128, max_pages=3,
+              seed=0, dtype=jnp.float32):
+    """Random q + pool for one ragged wave with the given descriptors.
+    Distinct per-row page ids so a wrong table lookup changes numbers."""
+    b = len(ctx_lens)
+    rng = np.random.default_rng(seed)
+    n_pages = 1 + b * max_pages
+    q = jnp.asarray(rng.standard_normal((b, w, h, d)), dtype)
+    kp = jnp.asarray(rng.standard_normal((n_pages * PAGE, h_kv, d)), dtype)
+    vp = jnp.asarray(rng.standard_normal((n_pages * PAGE, h_kv, d)), dtype)
+    perm = rng.permutation(np.arange(1, n_pages))
+    tables = jnp.asarray(perm[: b * max_pages].reshape(b, max_pages),
+                         jnp.int32)
+    return (q, kp, vp, tables, jnp.asarray(ctx_lens, jnp.int32),
+            jnp.asarray(q_lens, jnp.int32))
+
+
+# every ragged shape the engine dispatches, by (ctx_lens, q_lens):
+WAVES = {
+    # all rows a single query over a real context
+    "pure-decode": ([57, 130, 1, 250], [1, 1, 1, 1]),
+    # all rows prefill-from-zero windows of varying width
+    "pure-prefill": ([0, 0, 0], [4, 1, 3]),
+    # one wave mixing decode, prefill, spec-verify, and a feed window
+    "mixed": ([57, 0, 40, 130], [1, 4, 3, 4]),
+    # draft-verify windows mid-sequence (q_len = 1 + ndraft)
+    "verify-window": ([33, 97, 260], [3, 4, 2]),
+    "single-row": ([PAGE * 2 - 2], [4]),
+    # contexts at/around page edges; windows straddling a boundary
+    "page-straddle": ([PAGE - 1, PAGE, PAGE + 1, PAGE * 2 - 2],
+                      [4, 4, 4, 4]),
+    # idle/padding row (ctx 0, one masked-to-first-token query) riding
+    # next to real work
+    "padding-rows": ([0, 200, 0], [1, 1, 4]),
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(WAVES))
+@pytest.mark.parametrize("dot_mode", ["swap", "wide"])
+def test_ragged_kernel_matches_oracle(name, dot_mode):
+    ctx, ql = WAVES[name]
+    q, kp, vp, tables, ctx_lens, q_lens = make_wave(ctx, ql)
+    ref = ragged_reference(q, kp, vp, tables, ctx_lens, q_lens)
+    xla = ragged_paged_attention_xla(q, kp, vp, tables, ctx_lens, q_lens,
+                                     page_size=PAGE)
+    pal = ragged_paged_attention_pallas(q, kp, vp, tables, ctx_lens,
+                                        q_lens, page_size=PAGE,
+                                        interpret=True, dot_mode=dot_mode)
+    for b, n in enumerate(np.asarray(q_lens)):
+        np.testing.assert_allclose(np.asarray(xla)[b, :n], ref[b, :n],
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+        np.testing.assert_allclose(np.asarray(pal)[b, :n], ref[b, :n],
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+    # padding columns are unspecified but must stay finite (never NaN —
+    # a downstream reduction over the full rectangle would poison it)
+    assert np.isfinite(np.asarray(pal)).all()
+    assert np.isfinite(np.asarray(xla)).all()
+
+
+@pytest.mark.slow
+def test_ragged_kernel_gqa_and_mha_groupings():
+    ctx, ql = WAVES["mixed"]
+    for h, h_kv in ((4, 4), (8, 2)):        # G == 1 and G == 4
+        q, kp, vp, tables, cl, qls = make_wave(ctx, ql, h=h, h_kv=h_kv,
+                                               seed=h)
+        ref = ragged_reference(q, kp, vp, tables, cl, qls)
+        pal = ragged_paged_attention_pallas(q, kp, vp, tables, cl, qls,
+                                            page_size=PAGE, interpret=True)
+        for b, n in enumerate(np.asarray(qls)):
+            np.testing.assert_allclose(np.asarray(pal)[b, :n], ref[b, :n],
+                                       rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("window,softcap", [(64, None), (None, 30.0),
+                                            (64, 30.0)])
+def test_ragged_kernel_window_and_softcap(window, softcap):
+    ctx, ql = WAVES["mixed"]
+    q, kp, vp, tables, cl, qls = make_wave(ctx, ql, seed=7)
+    ref = ragged_reference(q, kp, vp, tables, cl, qls, window=window,
+                           softcap=softcap)
+    xla = ragged_paged_attention_xla(q, kp, vp, tables, cl, qls,
+                                     page_size=PAGE, window=window,
+                                     softcap=softcap)
+    pal = ragged_paged_attention_pallas(q, kp, vp, tables, cl, qls,
+                                        page_size=PAGE, interpret=True,
+                                        window=window, softcap=softcap)
+    for b, n in enumerate(np.asarray(qls)):
+        np.testing.assert_allclose(np.asarray(xla)[b, :n], ref[b, :n],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(pal)[b, :n], ref[b, :n],
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_ragged_kernel_int8_pool():
+    ctx, ql = WAVES["mixed"]
+    q, kp, vp, tables, cl, qls = make_wave(ctx, ql, seed=11)
+    rng = np.random.default_rng(11)
+    n_tok, h_kv, _ = kp.shape
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, (n_tok, h_kv)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, (n_tok, h_kv)), jnp.float32)
+    kq = jnp.asarray(rng.integers(-127, 128, kp.shape), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, vp.shape), jnp.int8)
+    deq_k = kq.astype(jnp.float32) * ks[..., None]
+    deq_v = vq.astype(jnp.float32) * vs[..., None]
+    ref = ragged_reference(q, deq_k, deq_v, tables, cl, qls)
+    pal = ragged_paged_attention_pallas(q, kq, vq, tables, cl, qls,
+                                        page_size=PAGE, interpret=True,
+                                        k_scales=ks, v_scales=vs)
+    for b, n in enumerate(np.asarray(qls)):
+        np.testing.assert_allclose(np.asarray(pal)[b, :n], ref[b, :n],
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_ragged_dead_pages_never_leak():
+    """Pages past a row's ragged span are redirected/masked; poisoning
+    them must not change any valid output column."""
+    ctx, ql = ([40, 3], [2, 1])             # both rows fit in page 0
+    q, kp, vp, tables, cl, qls = make_wave(ctx, ql, seed=13)
+    base = ragged_paged_attention_pallas(q, kp, vp, tables, cl, qls,
+                                         page_size=PAGE, interpret=True)
+    poisoned = kp
+    for page in np.asarray(tables[:, 1:]).ravel():
+        poisoned = poisoned.at[int(page) * PAGE:(int(page) + 1) * PAGE].set(
+            1e9)
+    out = ragged_paged_attention_pallas(q, poisoned, vp, tables, cl, qls,
+                                        page_size=PAGE, interpret=True)
+    for b, n in enumerate(np.asarray(qls)):
+        np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                   np.asarray(base)[b, :n],
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Engine layer: the ragged drive loop against the incumbent
+# ---------------------------------------------------------------------------
+
+PROMPTS = [
+    "def add(a, b):\n    return a + b\n\nprint(add(2, 3))",
+    "x = 1",
+    "for i in range(10):\n    print(i)",
+    "def fib(n):\n    return n if n < 2 else fib(n-1) + fib(n-2)",
+    "s = 'hello world'\nprint(s.upper())",
+]
+
+
+def tiny_engine(monkeypatch, backend, **kw):
+    monkeypatch.setenv("REVAL_TPU_PAGED_BACKEND", backend)
+    from reval_tpu.inference.tpu.paged_engine import PagedTPUEngine
+    from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+    from reval_tpu.models import ModelConfig, init_random_params
+
+    cfg = ModelConfig(vocab_size=ByteTokenizer.vocab_size + 62,
+                      hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      head_dim=128)
+    params = init_random_params(cfg, seed=0, dtype="float32")
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 512)
+    return PagedTPUEngine(params, cfg, ByteTokenizer(), page_size=128, **kw)
+
+
+@pytest.mark.slow
+def test_ragged_engine_greedy_bit_identical_to_incumbent(monkeypatch):
+    """The PR-17 parity contract at engine level: the one-wave ragged
+    drive loop emits exactly the incumbent split-dispatch engine's
+    greedy stream, mixed admission/preemption effects included."""
+    eng = tiny_engine(monkeypatch, "xla", max_slots=3)
+    try:
+        ref = eng.generate(PROMPTS, max_new_tokens=12, temperature=0.0)
+        assert eng.stats.ragged_ticks == 0      # incumbent path ran
+    finally:
+        eng.close()
+    eng = tiny_engine(monkeypatch, "ragged_xla", max_slots=3)
+    try:
+        out = eng.generate(PROMPTS, max_new_tokens=12, temperature=0.0)
+        assert eng.stats.ragged_ticks > 0       # ragged path ran
+    finally:
+        eng.close()
+    assert out == ref
+
+
+@pytest.mark.slow
+def test_ragged_engine_speculative_parity(monkeypatch):
+    """Greedy + self-drafting: the ragged verify windows must accept
+    exactly what the incumbent accepts — same final streams — while
+    actually drafting (repeated prompts feed the n-gram index)."""
+    prompts = ["for i in range(10):\n    print(i)"] * 3
+    eng = tiny_engine(monkeypatch, "xla", max_slots=3, speculative=True)
+    try:
+        ref = eng.generate(prompts, max_new_tokens=16, temperature=0.0)
+    finally:
+        eng.close()
+    eng = tiny_engine(monkeypatch, "ragged_xla", max_slots=3,
+                      speculative=True)
+    try:
+        out = eng.generate(prompts, max_new_tokens=16, temperature=0.0)
+        assert eng.stats.spec_rounds > 0        # verify windows rode waves
+        assert eng.stats.spec_drafted_tokens > 0
+    finally:
+        eng.close()
+    assert out == ref
+
+
+@pytest.mark.slow
+def test_ragged_engine_preemption_parity(monkeypatch):
+    """A pool too small for all rows forces preemption mid-stream; the
+    ragged loop's reserve/rollback bookkeeping must still land on the
+    incumbent's exact greedy output."""
+    kw = dict(max_slots=3, num_pages=5, max_seq_len=512)
+    eng = tiny_engine(monkeypatch, "xla", **kw)
+    try:
+        ref = eng.generate(PROMPTS[:4], max_new_tokens=10, temperature=0.0)
+    finally:
+        eng.close()
+    eng = tiny_engine(monkeypatch, "ragged_xla", **kw)
+    try:
+        out = eng.generate(PROMPTS[:4], max_new_tokens=10, temperature=0.0)
+    finally:
+        eng.close()
+    assert out == ref
+
+
+@pytest.mark.slow
+def test_long_prefill_admits_mid_decode_without_stalling(monkeypatch):
+    """The continuous-batching drill: while a long prompt is still
+    feeding its prefill windows (RAGGED_FEED shrunk so the feed spans
+    many ticks), the row already decoding must keep producing tokens
+    EVERY tick — no prefill-wave stall — and both rows must finish with
+    the incumbent engine's exact greedy output."""
+    import reval_tpu.inference.tpu.paged_engine as pe
+    from reval_tpu.inference.tpu.engine import StopScanner
+    from reval_tpu.inference.tpu.paged_engine import _Request
+
+    long_prompt = PROMPTS[3] * 6            # ~350 tokens
+    short_prompt = PROMPTS[1]
+    refs = {}
+    eng = tiny_engine(monkeypatch, "xla", max_slots=2)
+    try:
+        refs[short_prompt] = eng.generate([short_prompt],
+                                          max_new_tokens=24,
+                                          temperature=0.0)[0]
+        refs[long_prompt] = eng.generate([long_prompt], max_new_tokens=24,
+                                         temperature=0.0)[0]
+    finally:
+        eng.close()
+
+    monkeypatch.setattr(pe, "RAGGED_FEED", 32)
+    # prefix sharing off: a cached prefix would pre-cover most of the
+    # long prompt and collapse the multi-tick feed this drill needs
+    eng = tiny_engine(monkeypatch, "ragged_xla", max_slots=2,
+                      prefix_sharing=False)
+    try:
+        def submit(prompt, index):
+            ids = eng.encode_clipped(prompt, 24)
+            seq_id, node = eng.submit_request(ids, 24)
+            return seq_id, _Request(
+                index=index, ids=ids, max_new=24,
+                scanner=StopScanner(eng.tokenizer, []), temp=0.0,
+                key=eng.request_keys(1)[0], node=node)
+
+        st = eng.new_drive_state()
+        reqs = {}
+        seq_a, req_a = submit(short_prompt, 0)
+        reqs[seq_a] = req_a
+        while len(req_a.generated) < 4:     # A is decoding steady-state
+            eng._drive_tick(reqs, st)
+
+        seq_b, req_b = submit(long_prompt, 1)   # admits mid-decode
+        reqs[seq_b] = req_b
+        feed_ticks = 0
+        # fed_target is stamped AT admission (first tick below), so the
+        # loop runs until B's prefill windows are all committed
+        while not req_b.done and (req_b.fed_target == 0
+                                  or req_b.fed < req_b.fed_target):
+            before = len(req_a.generated)
+            eng._drive_tick(reqs, st)
+            feed_ticks += 1
+            if not req_a.done:
+                # the drill's point: every feed tick also advanced the
+                # decoding row — the long prefill stalled nobody
+                assert len(req_a.generated) > before
+        assert feed_ticks >= 5              # the feed really spanned ticks
+        while any(not r.done for r in reqs.values()):
+            eng._drive_tick(reqs, st)
+        for seq_id, req in reqs.items():
+            eng.release_request(seq_id, req)
+
+        from reval_tpu.inference.tpu.engine import finalize_text
+        assert finalize_text(eng.tokenizer, req_a.generated,
+                             []) == refs[short_prompt]
+        assert finalize_text(eng.tokenizer, req_b.generated,
+                             []) == refs[long_prompt]
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_ragged_second_boot_pays_zero_fresh_compiles(tmp_path,
+                                                     monkeypatch):
+    """Warm-restart economics for the new entry: a second boot under
+    the AOT executable cache must deserialize ``paged.ragged_step``
+    (ragged_xla is the exportable formulation) instead of compiling —
+    zero fresh compiles, bit-identical greedy output."""
+    monkeypatch.setenv("REVAL_TPU_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    eng = tiny_engine(monkeypatch, "ragged_xla")
+    try:
+        out1 = eng.generate(PROMPTS[:2], max_new_tokens=8, temperature=0.0)
+        aot1 = eng.aot_counters()
+        assert aot1["fresh_compiles"] >= 1 and aot1["unsupported"] == 0
+    finally:
+        eng.close()
+    eng = tiny_engine(monkeypatch, "ragged_xla")
+    try:
+        out2 = eng.generate(PROMPTS[:2], max_new_tokens=8, temperature=0.0)
+        assert eng.aot_counters()["fresh_compiles"] == 0
+        assert eng.stats.ragged_ticks > 0
+    finally:
+        eng.close()
+    assert out2 == out1
+
+
+# ---------------------------------------------------------------------------
+# The one-dispatch-per-tick contract (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_one_dispatch_per_tick_on_mixed_batch(monkeypatch):
+    """PR-17's acceptance observable: over a workload that mixes
+    still-feeding prefill rows, steady decode rows, and admission
+    churn, the ragged engine dispatches EXACTLY one jitted program per
+    drive tick (``paged.ragged_step`` calls == ``ragged_ticks``) and
+    never touches the split-dispatch programs."""
+    import reval_tpu.inference.tpu.paged_engine as pe
+
+    monkeypatch.setattr(pe, "RAGGED_FEED", 16)
+    # prefix sharing off: the cache's insert path legitimately runs the
+    # prefill program at SUBMIT time, which would blur the per-tick count
+    eng = tiny_engine(monkeypatch, "ragged_xla", max_slots=2,
+                      prefix_sharing=False)
+    try:
+        prompts = [PROMPTS[3], PROMPTS[1], PROMPTS[4]]   # feed + decode mix
+        eng.generate(prompts, max_new_tokens=6, temperature=0.0)
+        calls = eng.jit_counters()["calls"]
+        ticks = eng.stats.ragged_ticks
+        assert ticks > 0
+        assert calls.get("paged.ragged_step", 0) == ticks
+        for entry in ("paged.prefill", "paged.prefill_pctx",
+                      "paged.commit", "paged.decode_chunk",
+                      "paged.verify_chunk"):
+            assert calls.get(entry, 0) == 0, entry
+        # the wave rectangle is never smaller than the real work in it
+        assert eng.stats.ragged_useful_tokens > 0
+        assert (eng.stats.ragged_padded_tokens
+                >= eng.stats.ragged_useful_tokens)
+    finally:
+        eng.close()
