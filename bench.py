@@ -643,6 +643,15 @@ def run_paged(params, cfg, tok, prompts, max_new, *, prefix_sharing,
     # spills/promotions/recompute fallbacks + promotion latency — {} when
     # tiering is off (--no-kv-tier A/B)
     tier_row = eng.kv_tier_counters()
+    # serving provenance (obs/receipts.py): the engine's receipt config
+    # fingerprint — the same value every response served by this config
+    # would carry — rides the stats object so the bench round's
+    # determinism block can pin it (tools/obs_report.py --receipts diffs
+    # it across BENCH rounds)
+    ctx_fn = getattr(eng, "receipt_context", None)
+    if callable(ctx_fn):
+        from reval_tpu.obs.receipts import config_fingerprint
+        stats.receipt_fingerprint = config_fingerprint(ctx_fn())
     eng.close()
     return wall, stats, prefix_cache, jit_row, restart_row, tier_row
 
@@ -1145,6 +1154,11 @@ def main() -> None:
                 from reval_tpu.obs.determinism import bench_block
 
                 extras["determinism"] = bench_block()
+                # the headline engine's serving receipt fingerprint
+                # (run_paged attached it): obs_report --receipts diffs
+                # this across rounds and names the first drifted one
+                extras["determinism"]["receipt_fingerprint"] = getattr(
+                    stats, "receipt_fingerprint", None)
                 if extras["determinism"]["gate_failures"]:
                     note('determinism slice DIVERGED: '
                          + '; '.join(extras["determinism"]["gate_failures"]))

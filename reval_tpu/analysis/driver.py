@@ -39,8 +39,9 @@ import subprocess
 import time
 from dataclasses import dataclass, field
 
-from . import (detmatrix, enginezoo, envreg, errboundary, hostsync, hotpath,
-               jitreg, kernelbench, locks, meshreg, reshard, tilecontract)
+from . import (detmatrix, enginezoo, envreg, errboundary, goldenstreams,
+               hostsync, hotpath, jitreg, kernelbench, locks, meshreg,
+               reshard, tilecontract)
 from .core import Suppression, Violation, collect_sources
 from .metrics_events import run_events, run_metrics
 
@@ -62,6 +63,7 @@ PASSES = {
     "events": run_events,
     "detmatrix": detmatrix.run,
     "kernelbench": kernelbench.run,
+    "goldenstreams": goldenstreams.run,
 }
 
 
@@ -283,7 +285,8 @@ def main(argv: list[str] | None = None) -> int:
                     "contracts, reshard reasoning, engine-surface "
                     "conformance, typed-error boundary, env registry, "
                     "metric/event namespaces, determinism-matrix schema, "
-                    "kernel-CI leaderboard schema. "
+                    "kernel-CI leaderboard schema, golden-stream "
+                    "registry schema. "
                     "Exit codes: 0 clean, 1 violations, 2 unrunnable.")
     parser.add_argument("passes", nargs="*", metavar="PASS",
                         help=f"passes to run (default: all of "

@@ -64,6 +64,8 @@ SURFACE: dict[str, str] = {
     "spec_counters": "speculative-decoding accept/draft counter snapshot",
     "grammar_state": "compile a grammar name into the engine's "
                      "constraint tables, returning its start state",
+    "receipt_context": "serving-config fingerprint input for "
+                       "reproducibility receipts (obs/receipts.py)",
 }
 
 _NOT_SUPPORTED_RE = re.compile(

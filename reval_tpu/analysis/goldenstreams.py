@@ -1,0 +1,45 @@
+"""``goldenstreams`` pass: the committed golden-stream registry is sound.
+
+``GOLDEN_STREAMS.json`` (written by ``tools/golden_streams.py
+--record``) is the cross-commit upgrade gate for greedy token streams:
+a registry that quietly rotted — truncated JSON, digests that no longer
+recompute from the stored streams, or a recording poisoned by a
+leftover ``REVAL_TPU_DETERMINISM_PERTURB`` drill — would either gate
+every clean run red or wave a real divergence through.  This pass
+validates the committed file against the declared schema
+(``obs/determinism.py::validate_golden`` — ONE checker shared with the
+tool's pre-write self-check and the tests) WITHOUT running the model,
+so it fits the <10 s lint bar; the full re-run-and-diff gate is the
+tool's ``--check`` mode.
+
+No registry at the repo root = nothing to lint (clean): a tree that has
+never blessed a stream set has no gate to corrupt.  An unreadable or
+invalid registry IS a violation — a broken gate must never read as a
+passing one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .core import Violation
+
+__all__ = ["run"]
+
+
+def run(sources, root: str) -> list[Violation]:
+    from ..obs.determinism import GOLDEN_FILE, validate_golden
+
+    path = os.path.join(root, GOLDEN_FILE)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Violation("goldenstreams", GOLDEN_FILE, 0,
+                          f"unreadable golden-stream registry: "
+                          f"{type(e).__name__}: {e}")]
+    return [Violation("goldenstreams", GOLDEN_FILE, 0, err)
+            for err in validate_golden(obj)]

@@ -481,6 +481,27 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
     scrape_stop.set()
     for t in scrapers:
         t.join(timeout=10)
+    # receipt self-verification (tier-1 canary for the receipt path):
+    # one sequential probe so the receipt↔text pairing is unambiguous,
+    # then check the verified receipt surfaced (the client only keeps a
+    # receipt whose X-Reval-Receipt header parsed AND agreed with the
+    # body) and that its digest certifies the returned text's ids
+    receipts = {"receipted": False, "digest_ok": False, "fingerprints": 0}
+    try:
+        from .obs.receipts import digest_matches_text
+
+        probe_text = client.infer_one("receipt probe")
+        receipt = client.last_receipt
+        tok = getattr(getattr(getattr(server, "_session", None),
+                              "engine", None), "tokenizer", None)
+        if receipt is not None:
+            receipts["receipted"] = True
+            receipts["fingerprints"] = len(client.receipt_fingerprints)
+            if tok is not None:
+                receipts["digest_ok"] = digest_matches_text(
+                    receipt, [probe_text], tok)
+    except Exception as exc:  # noqa: BLE001 — summarised below
+        errors.append(f"receipt probe: {exc!r}")
     # scrape BEFORE the drain (the listener closes during shutdown) and
     # self-verify: the smoke is the tier-1 canary for /metrics too
     obs = {"metrics_ok": False, "requests_total": 0,
@@ -521,6 +542,7 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
         "served": len(outs), "errors": len(errors), **counters, **obs,
         "chaos_injected": len(step_chaos.injected) if step_chaos else 0,
         "debugz_scrapes": debugz["scrapes"], "postmortems": postmortems,
+        "receipt": receipts,
     }
     if server.trace_out:
         summary["trace_out"] = server.trace_out
@@ -534,11 +556,20 @@ def _serve_smoke(server, cfg: dict, n: int, step_chaos) -> int:
                                 and obs["e2e_count"] >= n)))
     debugz_bad = debugz["bad"] > 0 or debugz["scrapes"] == 0
     postmortem_bad = bool(pm_dir) and chaos_errors > 0 and postmortems == 0
-    if errors or len(outs) != n or metrics_bad or debugz_bad or postmortem_bad:
+    # the mock engine supports receipts and its ByteTokenizer round-trips
+    # text↔ids exactly, so on the --mock path a receipt-less smoke, an
+    # unverifiable digest, or >1 fingerprint from ONE server is a break;
+    # a real checkpoint's tokenizer may be lossy — report, don't gate
+    receipts_bad = bool(cfg.get("mock")) and not (
+        receipts["receipted"] and receipts["digest_ok"]
+        and receipts["fingerprints"] == 1)
+    if (errors or len(outs) != n or metrics_bad or debugz_bad
+            or postmortem_bad or receipts_bad):
         print(f"[smoke] failures: {errors[:3]}"
               + (" [metrics check failed]" if metrics_bad else "")
               + (" [debugz check failed]" if debugz_bad else "")
-              + (" [postmortem check failed]" if postmortem_bad else ""))
+              + (" [postmortem check failed]" if postmortem_bad else "")
+              + (" [receipt check failed]" if receipts_bad else ""))
         return 1
     return 0
 

@@ -201,6 +201,12 @@ ENV: dict[str, dict] = {
         "help": "fleet-wide concurrent-forward ceiling for weighted "
                 "per-tenant admission (0 disables; above it a tenant "
                 "over its weight share sheds first)"},
+    "REVAL_TPU_ROUTER_PIN_TENANTS": {
+        "default": "",
+        "help": "comma-separated tenants pinned to one receipt config "
+                "fingerprint: forwards skip divergent replicas and "
+                "shed typed-429 when only those remain (empty "
+                "disables)"},
     # -- open-loop load generator (tools/loadgen.py) -----------------------
     "REVAL_TPU_LOADGEN_SEED": {
         "default": "0",

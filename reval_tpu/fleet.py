@@ -90,6 +90,12 @@ class FleetRunner:
                 "grammar-constrained fleet runs need a backend with "
                 "per-task grammar support (the paged-engine TPU backend)")
         self.task_kwargs = task_kwargs
+        #: per-(repeat, task) reproducibility-receipt journal rows
+        #: (obs/receipts.py), collected when the backend surfaces
+        #: ``last_receipt`` (the HTTP client backend verifies + keeps
+        #: the most recent one); rendered as the ``receipts`` trailer
+        #: and persisted in fleet_metrics.json
+        self._receipts: list[dict] = []
 
     def _model_info(self) -> str:
         return ("mock_model_" + self.prompt_type if self.mock
@@ -154,6 +160,7 @@ class FleetRunner:
                 chunk = responses[cursor:cursor + len(jobs)]
                 cursor += len(jobs)
                 metrics[task.name] = task.score_and_write(records, jobs, chunk)
+                self._note_task_receipt(rep, task.name)
                 if checkpoint is not None:
                     checkpoint.record(rep, task.name, metrics[task.name])
         else:
@@ -170,6 +177,7 @@ class FleetRunner:
                         setter(None)    # never leak a task's constraint
                 self._check_aligned(len(responses), [(task, records, jobs)])
                 metrics[task.name] = task.score_and_write(records, jobs, responses)
+                self._note_task_receipt(rep, task.name)
                 if checkpoint is not None and self._should_write():
                     checkpoint.record(rep, task.name, metrics[task.name])
         return metrics
@@ -278,6 +286,14 @@ class FleetRunner:
             result["speculative"] = speculative
             if self.progress:
                 print(f"[fleet] speculative decoding: {speculative}")
+        receipts = self._receipt_trailer()
+        if receipts:
+            result["receipts"] = receipts
+            if self.progress:
+                fps = receipts["fingerprints"]
+                print(f"[fleet] receipts: {len(fps)} fingerprint(s) "
+                      f"{fps} — "
+                      f"{'converged' if receipts['converged'] else 'DIVERGENT'}")
         latency = self._latency_trailer()
         if latency:
             result["latency"] = latency
@@ -288,6 +304,31 @@ class FleetRunner:
                           f"p99={row['p99']}s (n={row['count']})")
         self._write_metrics_snapshot(result)
         return result
+
+    def _note_task_receipt(self, rep: int, task_name: str) -> None:
+        """Journal the receipt that covered one task's inference.  The
+        fused-batch path rides one request, so all its tasks share one
+        receipt — the journal still names each task (that is what a
+        reproduction diff greps by)."""
+        receipt = getattr(self.backend, "last_receipt", None)
+        if not isinstance(receipt, dict):
+            return
+        self._receipts.append({
+            "repeat": rep + 1, "task": task_name,
+            "fingerprint": receipt.get("fingerprint"),
+            "engine_id": receipt.get("engine_id"),
+            "digest": receipt.get("digest")})
+
+    def _receipt_trailer(self) -> dict | None:
+        """The run's receipt story: every fingerprint observed (one =
+        the whole run served under one config; more = the fleet failed
+        over across divergent replicas mid-run) + the per-task journal."""
+        if not self._receipts:
+            return None
+        fps = sorted({r["fingerprint"] for r in self._receipts
+                      if r["fingerprint"]})
+        return {"fingerprints": fps, "converged": len(fps) <= 1,
+                "tasks": list(self._receipts)}
 
     def _prefix_cache_trailer(self) -> dict | None:
         """Engine prefix-cache counters for the run summary, when the
@@ -380,6 +421,8 @@ class FleetRunner:
             snap["serving"] = result["serving"]
         if result.get("speculative"):
             snap["speculative"] = result["speculative"]
+        if result.get("receipts"):
+            snap["receipts"] = result["receipts"]
         try:
             os.makedirs(self.results_dir, exist_ok=True)
             path = os.path.join(self.results_dir, "fleet_metrics.json")

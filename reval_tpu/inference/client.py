@@ -72,6 +72,13 @@ class HTTPClientBackend(InferenceBackend):
         #: has already given up
         self.request_timeout = float(request_timeout)
         self._server_model = model_id
+        #: the most recent verified reproducibility receipt (obs/
+        #: receipts.py) — None until a receipted completion lands.  The
+        #: fleet journals this per task; ``receipt_fingerprints`` is the
+        #: set observed across the backend's lifetime (a fleet run that
+        #: failed over between divergent replicas shows >1 entry).
+        self.last_receipt: dict | None = None
+        self.receipt_fingerprints: set[str] = set()
         if not mock:
             # Launchers start client and server concurrently; block here
             # until the server is READY instead of crashing on the eager
@@ -104,7 +111,34 @@ class HTTPClientBackend(InferenceBackend):
             method="POST" if data is not None else "GET",
         )
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.load(resp)
+            header = resp.headers.get("X-Reval-Receipt")
+            out = json.load(resp)
+        if header is not None:
+            self._note_receipt(header, out)
+        return out
+
+    def _note_receipt(self, header: str, body) -> None:
+        """Verify + surface a response's reproducibility receipt: the
+        header must parse as a valid ``reval-receipt-v1`` AND agree with
+        the body's ``receipt`` field (one generation, two exposures — a
+        proxy that rewrote one of them is exactly what this catches).  A
+        bad receipt is a loud warning, never a failed completion: the
+        text is still the text."""
+        from ..obs.logging import log_event
+        from ..obs.receipts import parse_receipt
+
+        try:
+            receipt = parse_receipt(header)
+            embedded = body.get("receipt") if isinstance(body, dict) else None
+            if embedded is not None and embedded != receipt:
+                raise ValueError("X-Reval-Receipt header disagrees with "
+                                 "the body's receipt field")
+        except ValueError as exc:
+            log_event("client.receipt_invalid", level="warning",
+                      error=str(exc))
+            return
+        self.last_receipt = receipt
+        self.receipt_fingerprints.add(receipt["fingerprint"])
 
     def _get(self, route: str) -> dict:
         rid = uuid.uuid4().hex[:12]

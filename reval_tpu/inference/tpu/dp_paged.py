@@ -127,6 +127,14 @@ class DataParallelPagedEngine:
             agg.merge(rep.stats)
         return agg
 
+    def receipt_context(self) -> dict:
+        """Replica 0's serving-config receipt context with the
+        data-parallel degree folded in.  Replicas are built from one
+        config (only the PRNG seed and device group differ, and neither
+        is a fingerprint axis), so replica 0 speaks for the group."""
+        return dict(self.replicas[0].receipt_context(),
+                    engine="dp_paged", dp_size=self.dp_size)
+
     def jit_counters(self) -> dict:
         """Compile-variant snapshot summed over replicas (same shape as
         :meth:`PagedTPUEngine.jit_counters`; per-entry variant counts add

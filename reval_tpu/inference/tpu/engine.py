@@ -458,6 +458,7 @@ class TPUEngine:
     # not-supported: rewarm — nothing to replay without a prefix cache
     # not-supported: spec_counters — no drafter/verify path on the static whole-batch engine
     # not-supported: grammar_state — constrained decoding rides the paged decode chunk only
+    # not-supported: receipt_context — receipts stamp at continuous-session retire; the static whole-batch path has no per-request retire to stamp
     # mesh: axes=(dp)
     def __init__(self, params, cfg: ModelConfig, tokenizer, *, batch_size: int = 8,
                  max_seq_len: int = 8192, mesh=None, seed: int = 0):

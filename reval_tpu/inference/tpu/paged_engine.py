@@ -512,23 +512,28 @@ class PagedTPUEngine:
         # the trace+lower again.  Off (None) → the trackers above serve
         # calls exactly as before.
         from .aot_cache import AotJit, cache_from_env, kernel_export_skip
+        from ...ops.pallas_attention import resolved_kernel_knobs
 
+        # the receipt/AOT config context: built UNCONDITIONALLY (the
+        # reproducibility receipt on every response needs it whether or
+        # not the executable cache is armed), snapshotted here because
+        # the trace-time knobs bind per process exactly like the
+        # executables they key
+        kernel_backend = resolved_paged_backend()
+        self._receipt_ctx = {
+            "engine": "paged", "model": str(cfg),
+            "weights_dtype": str(dtype), "kv_dtype": kv_dtype or "bf16",
+            "page_size": page_size, "max_slots": max_slots,
+            "max_seq_len": max_seq_len,
+            "mesh": str(mesh) if mesh is not None else "none",
+            "platform": jax.default_backend(),
+            "kernel_backend": kernel_backend,
+            # trace-time kernel knobs (dot formulation, interpret
+            # mode): same backend label, different traced program
+            **resolved_kernel_knobs()}
         self._aot_cache = cache_from_env(registry=reg)
         if self._aot_cache is not None:
-            from ...ops.pallas_attention import (resolved_kernel_knobs,
-                                                resolved_paged_backend)
-
-            kernel_backend = resolved_paged_backend()
-            ctx = {"engine": "paged", "model": str(cfg),
-                   "weights_dtype": str(dtype), "kv_dtype": kv_dtype or "bf16",
-                   "page_size": page_size, "max_slots": max_slots,
-                   "max_seq_len": max_seq_len,
-                   "mesh": str(mesh) if mesh is not None else "none",
-                   "platform": jax.default_backend(),
-                   "kernel_backend": kernel_backend,
-                   # trace-time kernel knobs (dot formulation, interpret
-                   # mode): same backend label, different traced program
-                   **resolved_kernel_knobs()}
+            ctx = self._receipt_ctx
             # the decode chunk embeds the paged-attention kernel: on a
             # pallas backend its export needs Mosaic lowering support —
             # the canary names the environment gap (unsupported, counted)
@@ -1065,6 +1070,19 @@ class PagedTPUEngine:
         block and the fleet trailer render this dict
         (:meth:`EngineStats.spec_counters`)."""
         return self.stats.spec_counters()
+
+    def receipt_context(self) -> dict:
+        """The reproducibility-receipt config context (obs/receipts.py):
+        the AOT cache's fingerprint axes extended with the serving knobs
+        it never needed — speculative decoding on/off + K, KV-tier
+        enablement, and the decode-chunk cadence.  Snapshotted at build
+        like the trace-time knobs it rides with; per-request axes
+        (grammar, sampling) travel on the receipt body instead, so two
+        identically-configured replicas fingerprint identically."""
+        return dict(self._receipt_ctx,
+                    spec=self.spec_enabled, spec_eager=self.spec_eager,
+                    spec_k=self.spec_k, kv_tiering=self.kv_tiering,
+                    ragged=self.ragged, decode_chunk=CHUNK)
 
     def submit_request(self, ids: list[int], max_new_tokens: int,
                        grammar: str | None = None) -> tuple[int, object]:

@@ -76,6 +76,8 @@ __all__ = [
     "default_cells", "discover_cells", "run_matrix", "diff_tokens",
     "gate_failures", "render_table", "write_matrix", "record_matrix",
     "reference_fingerprint", "bench_block", "validate_matrix",
+    "GOLDEN_SCHEMA", "GOLDEN_FILE", "GOLDEN_SLICE",
+    "golden_doc", "validate_golden", "golden_gate",
 ]
 
 #: matrix artifact schema id — bump on breaking layout changes; the
@@ -792,3 +794,126 @@ def _default_dir() -> str:
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     return os.path.join(root, "tpu_watch")
+
+
+# -- golden-stream registry (tools/golden_streams.py) ------------------------
+#
+# The COMMITTED counterpart of the scratch matrix artifacts above: one
+# checked-in file holding the probe set's exact greedy token streams per
+# matrix cell, so an upgrade (new jax pin, new kernel revision, new
+# scheduler) diffs against the last blessed streams instead of only
+# against the same-commit reference cell.  Paired with serving-time
+# receipts (obs/receipts.py): each probe stream carries its
+# ``token_digest``, the same 16-hex digest receipts certify per prompt.
+
+GOLDEN_SCHEMA = "reval-golden-streams-v1"
+
+#: the committed registry's filename at the repo root
+GOLDEN_FILE = "GOLDEN_STREAMS.json"
+
+#: cells recorded by default — the host-runnable BENCH slice, so the
+#: gate runs anywhere tier-1 runs
+GOLDEN_SLICE = BENCH_SLICE
+
+
+def golden_doc(matrix: dict) -> dict:
+    """Build the registry document from one matrix run: every EXECUTED
+    cell's greedy token streams, their per-probe receipt digests, and
+    the cell fingerprint.  Skipped cells stay out — the registry records
+    what was observed, never a placeholder."""
+    from .receipts import token_digest
+
+    cells: dict[str, dict] = {}
+    for name, row in sorted(matrix["cells"].items()):
+        if row["status"] not in ("ref", "agree", "diverged"):
+            continue
+        tokens = [[int(t) for t in probe] for probe in row["tokens"]]
+        cells[name] = {"fingerprint": row["fingerprint"],
+                       "digests": [token_digest(p) for p in tokens],
+                       "tokens": tokens}
+    return {"schema": GOLDEN_SCHEMA,
+            "reference": matrix["reference"],
+            "probes_digest": matrix["probes"]["digest"],
+            "max_new_tokens": matrix["probes"]["max_new_tokens"],
+            # a registry recorded under a perturb drill is poisoned: it
+            # would gate every CLEAN run red.  Recorded so the validator
+            # can refuse it.
+            "perturb": matrix["perturb"],
+            "cells": cells}
+
+
+def validate_golden(obj) -> list[str]:
+    """Schema check shared by the ``goldenstreams`` lint pass, the
+    tool's pre-write self-check, and the tests.  Returns human-readable
+    errors (empty = valid).  Digests are RECOMPUTED from the stored
+    streams — a hand-edited or bit-rotted registry cannot pass."""
+    from .receipts import token_digest
+
+    if not isinstance(obj, dict):
+        return ["golden-stream registry is not a JSON object"]
+    if obj.get("schema") != GOLDEN_SCHEMA:
+        return [f"schema {obj.get('schema')!r} != expected "
+                f"{GOLDEN_SCHEMA!r}"]
+    errors: list[str] = []
+    if obj.get("perturb"):
+        errors.append(
+            f"registry was recorded under REVAL_TPU_DETERMINISM_PERTURB="
+            f"{obj['perturb']!r} — a perturbed golden gates every clean "
+            f"run red; re-record without the drill")
+    if not isinstance(obj.get("probes_digest"), str):
+        errors.append("missing/mistyped probes_digest")
+    cells = obj.get("cells")
+    if not isinstance(cells, dict) or not cells:
+        return errors + ["no cells in registry"]
+    for name, row in sorted(cells.items()):
+        if not isinstance(row, dict):
+            errors.append(f"cell {name}: not an object")
+            continue
+        tokens = row.get("tokens")
+        if not (isinstance(tokens, list) and tokens
+                and all(isinstance(p, list)
+                        and all(isinstance(t, int) for t in p)
+                        for p in tokens)):
+            errors.append(f"cell {name}: tokens is not a non-empty "
+                          f"list of int lists")
+            continue
+        if not isinstance(row.get("fingerprint"), str):
+            errors.append(f"cell {name}: missing/mistyped fingerprint")
+        if row.get("digests") != [token_digest(p) for p in tokens]:
+            errors.append(f"cell {name}: digests do not recompute from "
+                          f"the stored token streams (corrupt or "
+                          f"hand-edited registry)")
+    return errors
+
+
+def golden_gate(golden: dict, matrix: dict) -> list[str]:
+    """Diff one HEAD matrix run against the committed registry.  Every
+    failure names the cell and the FIRST divergent (probe, token) —
+    :func:`diff_tokens`' earliest-token attribution, the same rule the
+    same-commit parity gate uses.  Empty = HEAD matches golden."""
+    if golden["probes_digest"] != matrix["probes"]["digest"]:
+        return [f"probe set changed (digest {matrix['probes']['digest']} "
+                f"!= recorded {golden['probes_digest']}) — the recorded "
+                f"streams answer a different question; re-record "
+                f"{GOLDEN_FILE}"]
+    out: list[str] = []
+    for name, want in sorted(golden["cells"].items()):
+        row = matrix["cells"].get(name)
+        if row is None or row.get("status") == "skipped":
+            reason = ((row or {}).get("reason")
+                      or "cell absent from the taxonomy")
+            out.append(f"cell {name}: recorded in {GOLDEN_FILE} but did "
+                       f"not execute at HEAD ({reason})")
+            continue
+        first = diff_tokens(want["tokens"], row["tokens"])
+        if first is not None:
+            out.append(
+                f"cell {name}: token stream diverges from golden at "
+                f"probe {first['probe']} token {first['token']} "
+                f"(golden {first['ref']!r} != head {first['got']!r})")
+        elif want["fingerprint"] != row["fingerprint"]:
+            out.append(f"cell {name}: fingerprint {row['fingerprint']} "
+                       f"!= golden {want['fingerprint']} while the "
+                       f"streams agree (fingerprint scheme changed — "
+                       f"re-record {GOLDEN_FILE})")
+    return out

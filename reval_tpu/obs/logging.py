@@ -58,6 +58,9 @@ EVENTS: dict[str, str] = {
     # client side (inference/client.py, resilience/retry.py)
     "client.retry": "an HTTP attempt failed and will be retried",
     "client.wait": "waiting for server readiness during the handshake",
+    "client.receipt_invalid": "a response's X-Reval-Receipt failed "
+                              "verification (unparseable, wrong schema, "
+                              "or header/body disagreement)",
     # engine (inference/tpu/paged_engine.py)
     "engine.preempt": "a running sequence was preempted on pool exhaustion",
     "engine.deadlock": "nothing running or admissible while work remains",
@@ -111,6 +114,9 @@ EVENTS: dict[str, str] = {
     "session.snapshot_error": "a warm-state snapshot could not be "
                               "written or read (corrupt/unwritable); "
                               "the engine boots cold",
+    "session.receipt_error": "a completed submission's reproducibility "
+                             "receipt callback raised; the response "
+                             "ships unreceipted, never fails",
     # hierarchical KV tiering (inference/tpu/kv_tiers.py)
     "kvtier.degrade": "a tier fault (integrity/io/timeout rung) dropped "
                       "the page; it recomputes from its token chain via "
@@ -142,6 +148,9 @@ EVENTS: dict[str, str] = {
     "router.shed": "the router shed a request fleet-wide (no replica "
                    "could take it)",
     "router.drain": "an operator drained or rejoined a replica",
+    "router.fingerprint_skew": "ready replicas disagreed on their "
+                               "receipt config fingerprint (half-"
+                               "upgraded fleet; edge-triggered)",
     "router.resize": "the replica membership changed at runtime "
                      "(admin add_replica/remove_replica rebuilt the "
                      "hash ring)",

@@ -66,7 +66,7 @@ __all__ = [
     "SPEC_WEDGES", "SPEC_ACCEPTED_PER_ROUND", "SPEC_BUCKETS",
     "GRAMMAR_REQUESTS", "GRAMMAR_FORCED",
     "TENANT_REQUESTS", "TENANT_SHEDS", "TENANT_E2E",
-    "ROUTER_GOODPUT", "ROUTER_SLO_MISS",
+    "ROUTER_GOODPUT", "ROUTER_SLO_MISS", "RECEIPT_SKEW",
     "AUTOSCALE_UP", "AUTOSCALE_DOWN", "AUTOSCALE_BLOCKED",
     "AUTOSCALE_REPLICAS",
 ]
@@ -142,6 +142,7 @@ TENANT_SHEDS = "reval_tenant_sheds_total"
 TENANT_E2E = "reval_tenant_e2e_seconds"
 ROUTER_GOODPUT = "reval_router_goodput_total"
 ROUTER_SLO_MISS = "reval_router_slo_miss_total"
+RECEIPT_SKEW = "reval_receipt_skew_total"
 AUTOSCALE_UP = "reval_autoscale_up_total"
 AUTOSCALE_DOWN = "reval_autoscale_down_total"
 AUTOSCALE_BLOCKED = "reval_autoscale_blocked_total"
@@ -288,6 +289,11 @@ METRICS: dict[str, dict] = {
                       "help": "Forwards that completed but blew their "
                               "declared deadline_s, plus 504 "
                               "deadline_exceeded pass-throughs"},
+    RECEIPT_SKEW: {"type": "counter",
+                   "help": "Fingerprint-skew episodes: ready replicas "
+                           "disagreed on their receipt config "
+                           "fingerprint (edge-triggered per "
+                           "converged-to-skewed transition)"},
     # per-tenant QoS (serving/router.py) — the ONLY labeled series in
     # the registry (label: tenant=, sanitized wire value); weighted
     # admission sheds a noisy tenant before it starves the others

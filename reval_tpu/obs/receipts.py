@@ -1,0 +1,177 @@
+"""Reproducibility receipts: serving-time provenance on every response.
+
+*The Silent Hyperparameter* (arxiv 2605.19537) measured that the
+inference backend is a hyperparameter — switch the kernel, the dtype, or
+the scheduler and model outputs move, silently.  PR 8's determinism
+observatory catches that drift OFFLINE (a matrix run diffing backend
+cells); this module closes the serving-time half: every response carries
+a verifiable **receipt** naming exactly which configuration produced it,
+so an eval score, a bench round, or a goodput number can be tied to the
+config that emitted its tokens after the fact.
+
+A ``reval-receipt-v1`` receipt has three parts:
+
+- **config fingerprint** — the same canonical sha256 the AOT executable
+  cache keys warm restarts on (:func:`~reval_tpu.inference.tpu.
+  aot_cache.fingerprint` over model config, dtypes, kernel backend +
+  trace-time knobs, mesh, page geometry, jax/jaxlib versions), extended
+  by each engine's :meth:`receipt_context` with the serving axes the AOT
+  key never needed: speculative decoding on/off + K, KV-tier enablement,
+  the decode-chunk cadence.  Engine-level and stable per process — two
+  replicas with byte-identical configs fingerprint identically, which is
+  what makes fingerprint-pinned routing (serving/router.py) possible.
+- **token digest** — a rolling sha256 over the RAW emitted token ids
+  (the per-request stream, EOS included), folded across the request's
+  prompts in order.  The bit-identity observable: two replicas claiming
+  the same fingerprint must also produce the same digest for the same
+  greedy prompt, and the golden-stream gate (tools/golden_streams.py)
+  holds exactly that across commits.
+- **provenance** — the engine/replica id that actually served the
+  request (router failover makes "which replica answered" a real
+  question) plus the per-request serving axes that vary per call and
+  therefore stay OUT of the fingerprint: grammar name and sampling
+  params.
+
+Wire form: compact JSON in the ``X-Reval-Receipt`` response header, a
+``receipt`` field on the JSON body, and a ``reval.receipt`` SSE trailer
+event just before ``[DONE]`` on streams.  ``fleet`` journals one per
+task; ``tools/loadgen.py`` records the fleet's fingerprint set per run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+__all__ = [
+    "SCHEMA", "config_fingerprint", "token_digest", "fold_digests",
+    "build_receipt", "encode_receipt", "parse_receipt", "validate_receipt",
+    "digest_matches_ids", "digest_matches_text",
+]
+
+#: receipt schema id — bump on breaking layout changes; parsers refuse
+#: unknown versions rather than misread them
+SCHEMA = "reval-receipt-v1"
+
+#: hex width of token digests (matches the determinism matrix's
+#: fingerprint width — both are sha256 prefixes over token streams)
+_DIGEST_HEX = 16
+
+
+def config_fingerprint(context: dict) -> str:
+    """The engine-level half of a receipt: the AOT cache's canonical
+    sha256 (sorted-key JSON, stringified values, jax/jaxlib versions
+    folded in) over an engine's :meth:`receipt_context` dict.  Stable
+    per process by construction — trace-time knobs are snapshotted at
+    engine build, exactly like the executables they key."""
+    from ..inference.tpu.aot_cache import fingerprint, runtime_context
+
+    return fingerprint(runtime_context(**context))
+
+
+def token_digest(ids) -> str:
+    """Rolling sha256 over one raw emitted id stream (4-byte LE words,
+    so the digest is a function of the ids alone — not of any text
+    re-encoding, which is blind to EOS/padding id flips)."""
+    h = hashlib.sha256()
+    for t in ids:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return h.hexdigest()[:_DIGEST_HEX]
+
+
+def fold_digests(digests: list[str]) -> str:
+    """One response digest over a request's per-prompt digests (order
+    matters: prompt order is part of what the receipt certifies)."""
+    h = hashlib.sha256()
+    for d in digests:
+        h.update(d.encode())
+    return h.hexdigest()[:_DIGEST_HEX]
+
+
+def build_receipt(fingerprint: str, engine_id: str,
+                  digests: list[str], n_tokens: int, *,
+                  grammar: str | None = None,
+                  sampling: dict | None = None) -> dict:
+    """Assemble one canonical receipt dict (see module doc for the
+    field semantics).  ``digests`` are per-prompt, in prompt order."""
+    return {"schema": SCHEMA,
+            "fingerprint": fingerprint,
+            "engine_id": engine_id,
+            "digest": fold_digests(digests),
+            "digests": list(digests),
+            "n_tokens": int(n_tokens),
+            "grammar": grammar,
+            "sampling": dict(sampling or {})}
+
+
+def encode_receipt(receipt: dict) -> str:
+    """Compact single-line JSON — the ``X-Reval-Receipt`` header value
+    and the SSE trailer payload's ``receipt`` field."""
+    return json.dumps(receipt, separators=(",", ":"), sort_keys=True)
+
+
+def parse_receipt(text: str) -> dict:
+    """Parse + validate a wire-form receipt.  Raises ``ValueError`` on
+    garbage or an unknown schema — a client must not half-trust a
+    receipt it cannot fully read."""
+    try:
+        obj = json.loads(text)
+    except Exception as e:
+        raise ValueError(f"unparseable receipt: {e}") from None
+    errors = validate_receipt(obj)
+    if errors:
+        raise ValueError("invalid receipt: " + "; ".join(errors))
+    return obj
+
+
+def validate_receipt(obj) -> list[str]:
+    """Structural check shared by :func:`parse_receipt`, the serve
+    smoke's self-verification, and the tests.  Returns human-readable
+    errors (empty = valid)."""
+    if not isinstance(obj, dict):
+        return ["receipt is not a JSON object"]
+    errors: list[str] = []
+    if obj.get("schema") != SCHEMA:
+        return [f"schema {obj.get('schema')!r} != expected {SCHEMA!r}"]
+    for key, kind in (("fingerprint", str), ("engine_id", str),
+                      ("digest", str), ("digests", list),
+                      ("n_tokens", int), ("sampling", dict)):
+        if not isinstance(obj.get(key), kind):
+            errors.append(f"missing/mistyped field {key!r}")
+    if not errors:
+        if not all(isinstance(d, str) and len(d) == _DIGEST_HEX
+                   for d in obj["digests"]):
+            errors.append("digests entries are not 16-hex strings")
+        elif obj["digest"] != fold_digests(obj["digests"]):
+            errors.append("digest does not fold from the per-prompt digests")
+    return errors
+
+
+def digest_matches_ids(receipt: dict, streams: list[list[int]]) -> bool:
+    """Does the receipt's digest certify exactly these raw id streams
+    (one per prompt, in order)?  The server-side truth check."""
+    digests = [token_digest(ids) for ids in streams]
+    return (receipt.get("digests") == digests
+            and receipt.get("digest") == fold_digests(digests))
+
+
+def digest_matches_text(receipt: dict, texts: list[str], tokenizer) -> bool:
+    """Client-side digest verification for round-trippable tokenizers
+    (the serve smoke's self-check): re-encode each returned text and
+    accept either the bare stream or stream+EOS — the raw emitted ids
+    include the EOS the finalized text cannot carry.  A lossy tokenizer
+    makes this check inapplicable (return False), never a crash."""
+    try:
+        bos = getattr(tokenizer, "bos_id", None)
+        eos = getattr(tokenizer, "eos_id", None)
+        digests = receipt.get("digests")
+        if not isinstance(digests, list) or len(digests) != len(texts):
+            return False
+        for text, want in zip(texts, digests):
+            ids = [t for t in tokenizer.encode(text) if t != bos]
+            if token_digest(ids) != want and (
+                    eos is None or token_digest(ids + [eos]) != want):
+                return False
+        return receipt.get("digest") == fold_digests(digests)
+    except Exception:
+        return False

@@ -87,6 +87,18 @@ class MockStepEngine:
         #: char-window hash)
         self._warm_chains: list[list[int]] = []
         self._template_stats: dict[int, int] = {}
+        # receipt config axes (obs/receipts.py), snapshotted at build
+        # like the real engine's trace-time knobs: the kernel-dot knob is
+        # meaningless to the mock's canned generation but rides the
+        # fingerprint anyway so the router's fingerprint-skew drill
+        # (flip REVAL_TPU_KERNEL_DOT on ONE replica) is host-only real
+        from ..env import env_str
+
+        self._receipt_ctx = {
+            "engine": "mock", "response": self.response,
+            "echo": self.echo, "tokens_per_step": self.tokens_per_step,
+            "max_slots": self.max_slots,
+            "dot_mode": env_str("REVAL_TPU_KERNEL_DOT", "swap") or "swap"}
         self._boot_aot()
 
     # -- warm restarts ------------------------------------------------------
@@ -193,6 +205,12 @@ class MockStepEngine:
         """Same shape as :meth:`PagedTPUEngine.spec_counters` (all-zero
         unless a grammar rode through — the mock never drafts)."""
         return self.stats.spec_counters()
+
+    def receipt_context(self) -> dict:
+        """Same contract as :meth:`PagedTPUEngine.receipt_context`: the
+        config axes the reproducibility receipt fingerprints, stable per
+        engine instance."""
+        return dict(self._receipt_ctx)
 
     def submit_request(self, ids: list[int], max_new_tokens: int,
                        grammar: str | None = None):

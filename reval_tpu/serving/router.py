@@ -41,6 +41,19 @@ names each template's key for ``/statusz`` placement inspection.
 - Drain/rejoin: ``POST /admin/drain`` takes a replica out of rotation
   without touching its in-flight forwards (they complete; ``/statusz``
   shows the count draining to zero); ``POST /admin/rejoin`` restores it.
+- Fingerprint-pinned placement: every replica's ``/readyz`` detail now
+  carries its reproducibility-receipt config fingerprint
+  (:mod:`~reval_tpu.obs.receipts`), so the health poller sees the
+  fleet's config set for free.  When READY replicas disagree the router
+  raises an edge-triggered ``router.fingerprint_skew`` event and bumps
+  ``reval_receipt_skew_total`` — a half-upgraded fleet is an
+  observability event, not a silent determinism hazard.  Tenants listed
+  in ``pin_tenants`` (env ``REVAL_TPU_ROUTER_PIN_TENANTS``) are PINNED:
+  the first fingerprint that serves such a tenant sticks, and every
+  later forward skips replicas whose fingerprint diverges from the pin —
+  shedding a typed 429 (``Overloaded``) when only divergent replicas
+  remain, because for a reproducibility run a silently different config
+  is worse than a retry.
 - Runtime resize: ``POST /admin/add_replica`` / ``POST
   /admin/remove_replica`` change the MEMBERSHIP itself — the autoscaler's
   surface.  The hash ring is rebuilt and swapped atomically (consistent
@@ -90,7 +103,7 @@ import zlib
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..env import env_float, env_int
+from ..env import env_float, env_int, env_str
 from ..obs import metrics as obs_metrics
 from ..obs.logging import log_event
 from ..obs.metrics import MetricsRegistry, labeled, parse_prometheus
@@ -420,6 +433,14 @@ class _Replica:
         with self._lock:
             return self.ready and self.state == "healthy"
 
+    def fingerprint(self) -> str | None:
+        """The replica's receipt config fingerprint, as its last
+        ``/readyz`` poll reported it (None until a poll lands or when
+        the replica's engine predates receipts)."""
+        with self._lock:
+            fp = self.ready_detail.get("fingerprint")
+            return fp if isinstance(fp, str) else None
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"id": self.id, "url": self.base_url,
@@ -524,7 +545,8 @@ class FleetRouter:
                  affinity_table=None, forward_timeout_s: float = 600.0,
                  max_body_bytes: int = 64 << 20, clock=time.monotonic,
                  tenant_weights: dict | None = None,
-                 max_inflight: int | None = None):
+                 max_inflight: int | None = None,
+                 pin_tenants=None):
         self.model_id = model_id
         vnodes = vnodes if vnodes is not None else \
             env_int("REVAL_TPU_ROUTER_VNODES", 64)
@@ -551,6 +573,17 @@ class FleetRouter:
                                for k, v in (tenant_weights or {}).items()}
         self.max_inflight = (max_inflight if max_inflight is not None
                              else env_int("REVAL_TPU_ROUTER_MAX_INFLIGHT", 0))
+        # -- fingerprint-pinned placement ----------------------------------
+        if pin_tenants is None:
+            pin_tenants = [p.strip() for p in
+                           env_str("REVAL_TPU_ROUTER_PIN_TENANTS", "").split(",")
+                           if p.strip()]
+        #: tenants that must only ever see ONE config fingerprint;
+        #: unguarded: built once here, read-only thereafter
+        self.pin_tenants = frozenset(sanitize_tenant(t) for t in pin_tenants)
+        self._tenant_pins: dict = {}    # guarded-by: _adm_lock — tenant -> pinned fingerprint
+        #: edge-trigger memory for the skew event (poll thread only)
+        self._skewed = False
         self._adm_lock = threading.Lock()
         self._tenant_inflight: dict = {}    # guarded-by: _adm_lock
         #: tenant identities granted their own label series (weights
@@ -946,7 +979,29 @@ class FleetRouter:
         all_busy = True
         retry_hint = 0.0
         last_error = "no eligible replica (ejected/draining/cooldown)"
+        pinned = tenant in self.pin_tenants
+        pin_fp = None
+        pin_skipped = 0
+        if pinned:
+            with self._adm_lock:
+                pin_fp = self._tenant_pins.get(tenant)
         for rep in self._candidates(key):
+            if pinned:
+                fp = rep.fingerprint()
+                if pin_fp is None and fp is not None:
+                    # first fingerprinted replica this tenant would land
+                    # on establishes the pin (setdefault: a concurrent
+                    # request may have pinned first — its pin wins)
+                    with self._adm_lock:
+                        pin_fp = self._tenant_pins.setdefault(tenant, fp)
+                if pin_fp is not None and fp != pin_fp:
+                    # divergent config: for a pinned tenant this replica
+                    # does not exist.  A shed is honest; a silently
+                    # different kernel/dtype/spec config is not.
+                    pin_skipped += 1
+                    last_error = (f"replica {rep.id} fingerprint "
+                                  f"{fp!r} diverges from tenant pin")
+                    continue
             grant = rep.try_acquire()
             if grant is None:
                 continue
@@ -1034,6 +1089,18 @@ class FleetRouter:
         self._count_shed(tenant)
         log_event("router.shed", level="warning", request_id=rid,
                   attempted=attempted, reason=last_error)
+        if pin_skipped:
+            # at least one willing replica was withheld strictly by the
+            # fingerprint pin (dead/saturated candidates may also have
+            # been tried — the pin story still names WHY this request
+            # could not be served honestly): the typed-429 contract
+            # (retryable) — the client's RetryPolicy re-sends once the
+            # fleet converges
+            raise Overloaded(
+                f"tenant {tenant!r} is pinned to config fingerprint "
+                f"{str(pin_fp)[:16]} and {pin_skipped} replica(s) with a "
+                f"divergent fingerprint were withheld",
+                retry_after=max(1.0, retry_hint))
         if attempted and all_busy:
             raise Overloaded(
                 f"all {len(self._replicas)} replicas are saturated",
@@ -1117,6 +1184,32 @@ class FleetRouter:
         while not self._poll_stop.wait(self.health_interval_s):
             self._each_replica(self._poll_one)
             self._set_ready_gauge()
+            self._check_fingerprint_skew()
+
+    # -- receipt fingerprints -----------------------------------------------
+    def fleet_fingerprints(self) -> dict[str, list[str]]:
+        """``{fingerprint: [replica ids]}`` across READY replicas, as
+        the health poller last saw them.  One key = a converged fleet;
+        more = a half-upgraded (or mis-flagged) one."""
+        fps: dict[str, list[str]] = {}
+        for rep in self._replicas.values():
+            fp = rep.fingerprint()
+            if fp is not None and rep.is_ready():
+                fps.setdefault(fp, []).append(rep.id)
+        return fps
+
+    def _check_fingerprint_skew(self) -> None:
+        """Edge-triggered skew alarm: the poll cadence calls this every
+        round, but the event/counter fire once per healthy→skewed
+        transition (a skewed fleet polled at 1 Hz must not melt the
+        event log)."""
+        fps = self.fleet_fingerprints()
+        skewed = len(fps) > 1
+        if skewed and not self._skewed:
+            self._obs.counter(obs_metrics.RECEIPT_SKEW).add(1)
+            log_event("router.fingerprint_skew", level="warning",
+                      fingerprints={fp: ids for fp, ids in fps.items()})
+        self._skewed = skewed
 
     # -- introspection ------------------------------------------------------
     def readiness(self) -> dict:
@@ -1125,15 +1218,22 @@ class FleetRouter:
         "some replicas ready" as ready)."""
         reps = [r.snapshot() for r in self._replicas.values()]
         ready_n = sum(1 for r in reps if r["ready"] and r["state"] == "healthy")
+        fps = sorted(self.fleet_fingerprints())
         return {"status": "ready" if ready_n else "unready",
                 "ready": ready_n > 0, "router": True,
                 "replicas_ready": ready_n, "replicas_total": len(reps),
+                # the fleet-wide receipt story in one field: a single
+                # fingerprint when converged, the full divergent set
+                # otherwise (watch renders this row)
+                "fingerprint": fps[0] if len(fps) == 1 else None,
+                "fingerprints": fps,
                 "replicas": reps}
 
     def statusz(self) -> dict:
         with self._adm_lock:
             admin_log = list(self._admin_log)
             tenant_inflight = dict(self._tenant_inflight)
+            tenant_pins = dict(self._tenant_pins)
         out = {"router": True, "model": self.model_id,
                "window_chars": self.window_chars,
                "ring": {"members": self._ring.members,
@@ -1142,7 +1242,10 @@ class FleetRouter:
                "admin_log": admin_log,
                "tenants": {"weights": self.tenant_weights,
                            "max_inflight": self.max_inflight,
-                           "inflight": tenant_inflight},
+                           "inflight": tenant_inflight,
+                           "pinned": sorted(self.pin_tenants),
+                           "pins": tenant_pins},
+               "fingerprints": self.fleet_fingerprints(),
                "metrics": self._obs.snapshot()}
         if self.affinity:
             placement = {}

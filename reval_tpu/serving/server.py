@@ -249,6 +249,11 @@ class EngineServer:
         self._deadlines = "deadline_s" in params
         self._req_ids = "request_id" in params
         self._grammars = "grammar" in params
+        #: reproducibility receipts (obs/receipts.py): generate_fns that
+        #: accept ``on_receipt`` get their receipt exposed as the
+        #: ``X-Reval-Receipt`` header + ``receipt`` JSON field (and an
+        #: SSE trailer event); session-less engines simply don't
+        self._receipts = "on_receipt" in params
         self._lock = (threading.Lock() if serialize
                       else contextlib.nullcontext())
         self.ready_fn = ready_fn
@@ -466,6 +471,13 @@ class EngineServer:
                     self._stream(p["prompts"], p["max_tokens"],
                                  p["temperature"], p["stop"], rid, **sampling)
                     return
+                receipt_box: list = []
+                if outer._receipts:
+                    # the session driver delivers the receipt BEFORE the
+                    # blocking result() returns, so one element is here
+                    # (or none, on engines that predate receipts) by the
+                    # time generate_fn comes back
+                    sampling["on_receipt"] = receipt_box.append
                 try:
                     with outer._lock:
                         texts = outer.generate_fn(
@@ -492,12 +504,24 @@ class EngineServer:
                                          rid),
                                request_id=rid)
                     return
-                self._send(200, {
+                payload = {
                     "object": "text_completion",
                     "model": outer.model_id,
                     "choices": [{"index": i, "text": t, "finish_reason": "stop"}
                                 for i, t in enumerate(texts)],
-                }, request_id=rid)
+                }
+                headers = None
+                if receipt_box:
+                    from ..obs.receipts import encode_receipt
+
+                    # both exposures carry the SAME receipt: body field
+                    # for JSON consumers, header for anything that only
+                    # sees response metadata (proxies, the client's
+                    # verification cross-checks the two)
+                    payload["receipt"] = receipt_box[0]
+                    headers = {"X-Reval-Receipt":
+                               encode_receipt(receipt_box[0])}
+                self._send(200, payload, headers, request_id=rid)
 
             def _stream(self, prompts, max_tokens, temperature, stop, rid,
                         **sampling) -> None:
@@ -517,6 +541,7 @@ class EngineServer:
                 import queue
 
                 q: queue.Queue = queue.Queue()
+                receipt_box: list = []
 
                 def run() -> None:
                     try:
@@ -524,6 +549,8 @@ class EngineServer:
                         if outer._streams:
                             kwargs["on_progress"] = (
                                 lambda i, t: q.put((i, t, None)))
+                        if outer._receipts:
+                            kwargs["on_receipt"] = receipt_box.append
                         with outer._lock:
                             texts = outer.generate_fn(
                                 prompts, max_tokens=max_tokens,
@@ -595,6 +622,18 @@ class EngineServer:
                                             "finish_reason": reason}]})
                 if not dead:
                     try:
+                        if receipt_box:
+                            # the receipt TRAILER: emitted after every
+                            # delta and terminal event, right before
+                            # [DONE] — a mid-stream disconnect simply
+                            # never sees it (the generation's receipt
+                            # was still stamped engine-side)
+                            self.wfile.write(
+                                b"data: " + json.dumps(
+                                    {"object": "reval.receipt",
+                                     "model": outer.model_id,
+                                     "receipt": receipt_box[0]}).encode()
+                                + b"\n\n")
                         self.wfile.write(b"data: [DONE]\n\n")
                         self.wfile.flush()
                     except OSError:

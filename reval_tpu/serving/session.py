@@ -147,14 +147,15 @@ def _generate_fn_for(submitter):
     owner (single session or replica set) — pass ``serialize=False``."""
     def generate(prompts, *, max_tokens, temperature, stop,
                  top_k=0, top_p=1.0, on_progress=None, deadline_s=None,
-                 request_id=None, grammar=None):
+                 request_id=None, grammar=None, on_receipt=None):
         return submitter.submit(prompts, max_new_tokens=max_tokens,
                                 temperature=temperature, stop=stop,
                                 top_k=top_k, top_p=top_p,
                                 on_progress=on_progress,
                                 deadline_s=deadline_s,
                                 request_id=request_id,
-                                grammar=grammar).result()
+                                grammar=grammar,
+                                on_receipt=on_receipt).result()
     return generate
 
 
@@ -173,7 +174,16 @@ class _Submission:
     #: the wire request id (``X-Request-Id``) this submission serves —
     #: span tracing and server/client logs name requests by it
     request_id: str | None = None
+    #: ``on_receipt(receipt)`` fires once, from the driver, when the
+    #: LAST prompt retires — the reproducibility receipt
+    #: (obs/receipts.py) covering every prompt of this submission
+    on_receipt: object = None
     pending: _Pending = field(init=False)
+    #: per-prompt raw-id-stream digests, filled at retire in prompt
+    #: order (obs/receipts.py token_digest) — single writer: the driver
+    digests: list = field(init=False, default_factory=list)
+    #: raw emitted tokens across the submission (receipt ``n_tokens``)
+    gen_tokens: int = field(init=False, default=0)
     #: token ids per prompt, encoded in the SUBMITTING thread (admission
     #: control needs the counts before the driver ever sees this)
     encoded: list = field(init=False, default_factory=list)
@@ -186,6 +196,7 @@ class _Submission:
 
     def __post_init__(self):
         self.pending = _Pending(len(self.prompts))
+        self.digests = [None] * len(self.prompts)
         self.t_submit = time.perf_counter()
 
 
@@ -215,6 +226,22 @@ class ContinuousSession:
                  snapshot_path: str | None = None,
                  snapshot_fallback: str | None = None):
         self.engine = engine
+        # -- reproducibility receipts (obs/receipts.py) ----------------------
+        #: the engine-level config fingerprint every response's receipt
+        #: carries (None when the engine predates receipt_context);
+        #: snapshotted once — the engine's context is build-time stable
+        self.receipt_fingerprint: str | None = None
+        #: this serving engine's provenance id (router failover makes
+        #: "which replica actually answered" a real question)
+        self.engine_id: str | None = None
+        ctx_fn = getattr(engine, "receipt_context", None)
+        if callable(ctx_fn):
+            import uuid
+
+            from ..obs import receipts
+
+            self.receipt_fingerprint = receipts.config_fingerprint(ctx_fn())
+            self.engine_id = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         # -- warm restarts (serving/snapshot.py) -----------------------------
         #: where the graceful drain lands its warm-state snapshot and
         #: boot looks for the previous process's (default env
@@ -291,7 +318,7 @@ class ContinuousSession:
                top_k: int = 0, top_p: float = 1.0,
                on_progress=None, deadline_s: float | None = None,
                request_id: str | None = None,
-               grammar: str | None = None) -> _Pending:
+               grammar: str | None = None, on_receipt=None) -> _Pending:
         """Enqueue a prompt batch; returns a handle whose ``result()``
         blocks until all its prompts finish.  ``on_progress(index, text)``
         streams finalised-so-far text at decode-chunk granularity (same
@@ -318,7 +345,8 @@ class ContinuousSession:
         sub = _Submission(list(prompts), max_new_tokens, float(temperature),
                           list(stop or []), on_progress,
                           top_k=int(top_k), top_p=float(top_p),
-                          grammar=grammar, request_id=request_id)
+                          grammar=grammar, request_id=request_id,
+                          on_receipt=on_receipt)
         if not sub.prompts:
             sub.pending._fire()
             return sub.pending
@@ -415,7 +443,12 @@ class ContinuousSession:
                 "draining": self._closed.is_set(),
                 "heartbeat_age_s": round(hb_age, 3),
                 "queued_tokens": queued,
-                "max_queued_tokens": self.max_queued_tokens}
+                "max_queued_tokens": self.max_queued_tokens,
+                # receipt provenance rides readiness so it reaches the
+                # router's health poll (and /statusz) with zero extra
+                # endpoints — fingerprint-pinned placement keys on it
+                "fingerprint": self.receipt_fingerprint,
+                "engine_id": self.engine_id}
 
     def engine_stats(self) -> list:
         return [self.engine.stats]
@@ -782,10 +815,44 @@ class ContinuousSession:
                     eng.tokenizer, req.generated, sub.stop)
                 sub.pending._remaining -= 1
                 eng.stats.prompts += 1
+                if self.receipt_fingerprint is not None:
+                    # receipt stamp point: req.generated is the RAW
+                    # emitted id stream (EOS included) — digest it here,
+                    # before finalisation can cut anything
+                    from ..obs import receipts
+
+                    sub.digests[pos] = receipts.token_digest(req.generated)
+                    sub.gen_tokens += len(req.generated)
                 if self._tracer is not None:
                     self._trace_req(sub, pos, req)
                 if sub.pending._remaining == 0:
+                    self._stamp_receipt(sub)
                     sub.pending._fire()
+
+    def _stamp_receipt(self, sub: _Submission) -> None:
+        """Build the submission's reproducibility receipt and deliver it
+        via ``on_receipt`` — BEFORE ``_fire()``, so a blocked ``result()``
+        caller observes it.  Only full successes get one (an errored or
+        partially-cancelled submission resolves through ``_fail``, which
+        never reaches here); a misbehaving callback must not take the
+        driver down."""
+        if (self.receipt_fingerprint is None or sub.on_receipt is None
+                or any(d is None for d in sub.digests)):
+            return
+        from ..obs import receipts
+
+        receipt = receipts.build_receipt(
+            self.receipt_fingerprint, self.engine_id,
+            sub.digests, sub.gen_tokens, grammar=sub.grammar,
+            sampling={"max_tokens": sub.max_new,
+                      "temperature": sub.temperature,
+                      "top_k": sub.top_k, "top_p": sub.top_p})
+        try:
+            sub.on_receipt(receipt)
+        except Exception as exc:   # noqa: BLE001 — observability must
+            # never fail the generation it describes
+            log_event("session.receipt_error", level="warning", exc=exc,
+                      request_id=sub.request_id)
 
     def _trace_req(self, sub: _Submission, pos: int, req,
                    error: str | None = None) -> None:
@@ -977,7 +1044,7 @@ class MultiSession:
                top_k: int = 0, top_p: float = 1.0,
                on_progress=None, deadline_s: float | None = None,
                request_id: str | None = None,
-               grammar: str | None = None) -> _Pending:
+               grammar: str | None = None, on_receipt=None) -> _Pending:
         n = len(prompts)
         with self._lock:
             accepting = [i for i, s in enumerate(self.sessions)
@@ -1006,7 +1073,8 @@ class MultiSession:
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, stop=stop, top_k=top_k, top_p=top_p,
                 on_progress=on_progress, deadline_s=deadline_s,
-                request_id=request_id, grammar=grammar)
+                request_id=request_id, grammar=grammar,
+                on_receipt=on_receipt)
         except Exception:
             release()                   # closed/shedding session etc.: no leak
             raise
@@ -1022,8 +1090,15 @@ class MultiSession:
         """Per-replica readiness; the set is ready while ANY replica is
         (degraded capacity still serves)."""
         reps = [s.readiness() for s in self.sessions]
+        fps = sorted({r.get("fingerprint") for r in reps} - {None})
         return {"ready": any(r["ready"] for r in reps),
                 "warming": any(r.get("warming") for r in reps),
+                # unanimous receipt fingerprint, or None when the dp
+                # replicas disagree (never true in-process — one config
+                # builds them — but the router's skew detector treats
+                # None as "unknown", the safe reading either way)
+                "fingerprint": fps[0] if len(fps) == 1 else None,
+                "fingerprints": fps,
                 "replicas": reps}
 
     def engine_stats(self) -> list:

@@ -174,6 +174,10 @@ def render_screen(status: dict, debug: dict, prev_counters: dict | None,
     if tier:
         lines.append(tier)
 
+    receipt = _receipt_row(status)
+    if receipt:
+        lines.append(receipt)
+
     faults = [e for e in (debug.get("recent_logs") or ())
               if e.get("level") in ("error", "warning")][-4:]
     lines.append("last faults" + ("  (none)" if not faults else ""))
@@ -202,6 +206,33 @@ def _kvtier_row(counters: dict, gauges: dict) -> str | None:
             f"  queue {int(queue)}  spills {int(spills)}"
             f"  promotions {int(promos)}  recomputes {int(recomputes)}"
             f"  integrity_fail {int(integrity)}")
+
+
+def _receipt_row(status: dict) -> str | None:
+    """The reproducibility-receipt line (obs/receipts.py), or None when
+    the endpoint carries no provenance yet.  A router's /statusz brings
+    the fleet fingerprint map (fingerprint -> ready replica ids): one
+    fingerprint renders as converged, more than one names the replicas
+    off the plurality fingerprint — the ones a pinned tenant would be
+    withheld from.  A single server's readiness carries its own
+    fingerprint + engine id."""
+    fps = status.get("fingerprints")
+    if isinstance(fps, dict) and fps:
+        if len(fps) == 1:
+            fp, ids = next(iter(fps.items()))
+            return (f"receipts     fingerprint {str(fp)[:16]}  converged "
+                    f"({len(ids)} replica(s))")
+        groups = sorted(fps.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+        divergent = [str(rid) for _, ids in groups[1:] for rid in ids]
+        return (f"receipts     SKEW: {len(fps)} fleet fingerprints  "
+                f"divergent: {', '.join(divergent) or '?'}")
+    readiness = status.get("readiness") or {}
+    fp = readiness.get("fingerprint")
+    if not fp:
+        return None
+    eng = readiness.get("engine_id")
+    return (f"receipts     fingerprint {str(fp)[:16]}"
+            + (f"  engine {eng}" if eng else ""))
 
 
 #: router counters whose running totals headline the fleet view
@@ -322,6 +353,10 @@ def render_router_screen(status: dict, prev_counters: dict | None,
     tier = _kvtier_row(counters, gauges)
     if tier:
         lines.append(tier)
+
+    receipt = _receipt_row(status)
+    if receipt:
+        lines.append(receipt)
 
     # the admin action log tail: drains/rejoins/resizes with the
     # caller's reason — a live autoscaler's story reads right here

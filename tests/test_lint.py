@@ -1475,9 +1475,10 @@ def test_cli_changed_only_scopes_to_git_diff(tmp_path):
     assert doc["violations"][0]["path"].endswith("fresh.py")
 
 
-def test_fourteen_passes_registered():
-    assert len(PASSES) == 14
-    assert {"mesh", "reshard", "enginezoo", "kernelbench"} <= set(PASSES)
+def test_fifteen_passes_registered():
+    assert len(PASSES) == 15
+    assert {"mesh", "reshard", "enginezoo", "kernelbench",
+            "goldenstreams"} <= set(PASSES)
 
 
 def test_mesh_collective_via_lax_import_alias(tmp_path):

@@ -368,11 +368,14 @@ class TestServingObservability:
         summary = json.loads(out.strip().splitlines()[-1])
         assert summary["served"] == 4 and summary["errors"] == 0
         assert summary["metrics_ok"] is True
-        assert summary["requests_total"] == 4
-        assert summary["ttft_count"] == 4 and summary["e2e_count"] == 4
+        # the smoke's 4 prompts plus its one sequential receipt probe
+        assert summary["requests_total"] == 5
+        assert summary["ttft_count"] == 5 and summary["e2e_count"] == 5
+        assert summary["receipt"] == {"receipted": True, "digest_ok": True,
+                                      "fingerprints": 1}
         payload = json.loads(trace.read_text())
         assert len([e for e in payload["traceEvents"]
-                    if e["name"] == "request"]) == 4
+                    if e["name"] == "request"]) == 5
 
 
 def test_multisession_metrics_merge_across_replicas():

@@ -1,0 +1,534 @@
+"""Reproducibility receipts: provenance on every response, end to end.
+
+Unit layer: the ``reval-receipt-v1`` canonical form (obs/receipts.py)
+round-trips, refuses garbage, and its digests certify exactly the id
+streams they were built from.
+
+Serving layer (host-only: mock engines behind the real session/server
+stack over real HTTP): the receipt rides the ``X-Reval-Receipt`` header,
+the JSON ``receipt`` field, and the SSE ``reval.receipt`` trailer; a
+mid-stream client disconnect neither crashes the server nor corrupts
+the next request's receipt.
+
+Fleet layer: two identical mock replicas fingerprint byte-identically
+and digest byte-identically for the same prompt; after a failover the
+receipt names the replica that ACTUALLY served.  The skew drill flips
+``REVAL_TPU_KERNEL_DOT`` on one replica: the router's health poll sees
+two fingerprints, fires the edge-triggered ``router.fingerprint_skew``
+event + ``reval_receipt_skew_total`` counter, and a pinned tenant sheds
+typed-429 instead of landing on the divergent replica.
+
+Golden-stream gate: ``golden_doc``/``validate_golden``/``golden_gate``
+(obs/determinism.py) on synthetic matrices, the committed
+``GOLDEN_STREAMS.json`` validating at HEAD, and the ``goldenstreams``
+lint pass refusing a corrupted registry.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reval_tpu.inference.client import HTTPClientBackend
+from reval_tpu.inference.tpu.tokenizer import ByteTokenizer
+from reval_tpu.obs import metrics as obs_metrics
+from reval_tpu.obs.metrics import parse_prometheus
+from reval_tpu.obs.receipts import (SCHEMA, build_receipt,
+                                    digest_matches_ids, digest_matches_text,
+                                    encode_receipt, fold_digests,
+                                    parse_receipt, token_digest,
+                                    validate_receipt)
+from reval_tpu.serving import FleetRouter, serve_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# units — the canonical form
+# ---------------------------------------------------------------------------
+
+class TestReceiptUnits:
+    def test_token_digest_is_an_id_function_not_a_text_function(self):
+        assert token_digest([1, 2, 3]) == token_digest([1, 2, 3])
+        assert token_digest([1, 2, 3]) != token_digest([1, 2, 4])
+        assert token_digest([1, 2, 3]) != token_digest([1, 2])
+        # an EOS/padding id flip text rendering cannot show still moves it
+        assert token_digest([65, 257]) != token_digest([65])
+        assert len(token_digest([7])) == 16
+
+    def test_fold_is_order_sensitive(self):
+        a, b = token_digest([1]), token_digest([2])
+        assert fold_digests([a, b]) != fold_digests([b, a])
+
+    def test_build_encode_parse_roundtrip(self):
+        r = build_receipt("f" * 64, "pid-abc", [token_digest([1, 2])], 2,
+                          grammar="yesno", sampling={"temperature": 0.0})
+        assert validate_receipt(r) == []
+        back = parse_receipt(encode_receipt(r))
+        assert back == r
+        assert back["schema"] == SCHEMA
+
+    def test_parse_refuses_garbage_and_unknown_schema(self):
+        with pytest.raises(ValueError):
+            parse_receipt("not json {")
+        bad = build_receipt("f", "e", [], 0)
+        bad["schema"] = "reval-receipt-v999"
+        with pytest.raises(ValueError):
+            parse_receipt(encode_receipt(bad))
+
+    def test_validate_catches_a_digest_that_does_not_fold(self):
+        r = build_receipt("f", "e", [token_digest([1])], 1)
+        r["digest"] = "0" * 16
+        assert any("fold" in e for e in validate_receipt(r))
+
+    def test_digest_matches_ids_and_text(self):
+        tok = ByteTokenizer()
+        text = "YES"
+        ids = [t for t in tok.encode(text) if t != tok.bos_id]
+        r = build_receipt("f", "e", [token_digest(ids + [tok.eos_id])],
+                          len(ids) + 1)
+        assert digest_matches_ids(r, [ids + [tok.eos_id]])
+        assert not digest_matches_ids(r, [ids + [tok.eos_id, 1]])
+        # text path accepts the stream with-or-without the trailing EOS
+        assert digest_matches_text(r, [text], tok)
+        assert not digest_matches_text(r, ["NO"], tok)
+        assert not digest_matches_text(r, [text, "extra"], tok)
+
+
+# ---------------------------------------------------------------------------
+# one mock server — header, body, SSE trailer, disconnect
+# ---------------------------------------------------------------------------
+
+def _post(port, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def _statusz(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/statusz",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture
+def mock_server():
+    server = serve_config({"mock": True, "mock_echo": True}, port=0).start()
+    yield server
+    server.shutdown()
+
+
+class TestMockServerReceipts:
+    def test_header_and_body_carry_the_same_valid_receipt(self, mock_server):
+        body, headers = _post(mock_server.port,
+                              {"prompt": ["alpha", "beta"], "max_tokens": 32})
+        receipt = body["receipt"]
+        assert validate_receipt(receipt) == []
+        assert parse_receipt(headers["X-Reval-Receipt"]) == receipt
+        assert len(receipt["digests"]) == 2     # one per prompt, in order
+        # the fingerprint is the engine-level one readiness advertises
+        ready = _statusz(mock_server.port)["readiness"]
+        assert receipt["fingerprint"] == ready["fingerprint"]
+        assert receipt["engine_id"] == ready["engine_id"]
+        # the mock tokenizer round-trips exactly: the digest certifies
+        # the returned texts
+        tok = mock_server._session.engine.tokenizer
+        texts = [c["text"] for c in body["choices"]]
+        assert digest_matches_text(receipt, texts, tok)
+
+    def test_client_backend_captures_and_verifies_the_receipt(
+            self, mock_server):
+        client = HTTPClientBackend(model_id="m", port=mock_server.port,
+                                   temp=0.0, prompt_type="direct")
+        client.infer_one("receipt probe")
+        assert client.last_receipt is not None
+        assert validate_receipt(client.last_receipt) == []
+        assert len(client.receipt_fingerprints) == 1
+
+    def test_sse_trailer_rides_before_done(self, mock_server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{mock_server.port}/v1/completions",
+            data=json.dumps({"prompt": "stream me", "max_tokens": 16,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        events = []
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            for raw in resp:
+                raw = raw.decode().strip()
+                if raw.startswith("data: "):
+                    events.append(raw[len("data: "):])
+        assert events[-1] == "[DONE]"
+        trailer = json.loads(events[-2])
+        assert trailer["object"] == "reval.receipt"
+        receipt = trailer["receipt"]
+        assert validate_receipt(receipt) == []
+        # the trailer certifies the assembled stream text
+        text = "".join(json.loads(e)["choices"][0]["text"]
+                       for e in events[:-2]
+                       if json.loads(e).get("object") == "text_completion")
+        tok = mock_server._session.engine.tokenizer
+        assert digest_matches_text(receipt, [text], tok)
+
+    def test_mid_stream_disconnect_leaves_the_server_receipting(self):
+        # slow mock steps so the disconnect lands mid-generation
+        server = serve_config({"mock": True, "mock_echo": True,
+                               "mock_step_s": 0.02}, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/completions",
+                data=json.dumps({"prompt": "doomed stream",
+                                 "max_tokens": 64,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = urllib.request.urlopen(req, timeout=30)
+            first = resp.readline()             # at least one delta arrived
+            assert first.startswith(b"data:")
+            resp.close()                        # hang up mid-stream
+            # the worker finishes server-side; the next request's receipt
+            # must be intact — a torn socket must not corrupt provenance
+            time.sleep(0.1)
+            body, headers = _post(server.port, {"prompt": "survivor",
+                                                "max_tokens": 16})
+            receipt = body["receipt"]
+            assert validate_receipt(receipt) == []
+            assert parse_receipt(headers["X-Reval-Receipt"]) == receipt
+            assert _statusz(server.port)["readiness"]["ready"]
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet — provenance under failover, fingerprint convergence + skew
+# ---------------------------------------------------------------------------
+
+def make_replica(**cfg):
+    base = {"mock": True, "mock_echo": True}
+    base.update(cfg)
+    return serve_config(base, port=0).start()
+
+
+def make_router(servers, **kw):
+    kw.setdefault("health_interval_s", 0.05)
+    kw.setdefault("cooldown_s", 0.4)
+    kw.setdefault("eject_fails", 2)
+    router = FleetRouter([f"127.0.0.1:{s.port}" for s in servers],
+                         port=0, **kw)
+    return router.start()
+
+
+def wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def hard_kill(server) -> None:
+    server._httpd.shutdown()
+    server._httpd.server_close()
+
+
+def post_router(router, prompt, max_tokens=32, extra=None):
+    body = {"prompt": prompt, "max_tokens": max_tokens}
+    body.update(extra or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def prompt_targeting(router, replica_id) -> str:
+    from reval_tpu.serving.router import affinity_key
+
+    window = router.window_chars
+    for i in range(4096):
+        p = f"targeted receipt template {i} | " + "pad | " * 40
+        if router._ring.order(affinity_key(p, window))[0] == replica_id:
+            return p
+    raise AssertionError(f"no prompt hashes to {replica_id}")
+
+
+class TestFleetReceipts:
+    def test_identical_configs_fingerprint_and_digest_identically(self):
+        a, b = make_replica(), make_replica()
+        try:
+            body_a, _ = _post(a.port, {"prompt": "same prompt",
+                                       "max_tokens": 16})
+            body_b, _ = _post(b.port, {"prompt": "same prompt",
+                                       "max_tokens": 16})
+            ra, rb = body_a["receipt"], body_b["receipt"]
+            # byte-identical configs → byte-identical fingerprints, and
+            # (echo mode: tokens are a function of the prompt alone)
+            # byte-identical digests — but distinct engine identities
+            assert ra["fingerprint"] == rb["fingerprint"]
+            assert ra["digest"] == rb["digest"]
+            assert ra["engine_id"] != rb["engine_id"]
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_failover_receipt_names_the_replica_that_served(self):
+        a, b = make_replica(), make_replica()
+        router = make_router([a, b])
+        try:
+            wait_for(lambda: router.readiness()["ready"], what="router ready")
+            ids = {s: _statusz(s.port)["readiness"]["engine_id"]
+                   for s in (a, b)}
+            prompt = prompt_targeting(router, f"127.0.0.1:{a.port}")
+            served = post_router(router, prompt)["receipt"]
+            assert served["engine_id"] == ids[a]
+            hard_kill(a)
+            # same prompt, same ring primary — the forward fails over and
+            # the receipt must name the SURVIVOR, not the ring primary
+            failed_over = post_router(router, prompt)["receipt"]
+            assert failed_over["engine_id"] == ids[b]
+            assert failed_over["fingerprint"] == served["fingerprint"]
+        finally:
+            router.shutdown()
+            b.shutdown()
+
+    def test_skew_drill_event_metric_and_pinned_tenant_shed(
+            self, monkeypatch):
+        good = make_replica()
+        # the divergent replica: a different trace-time kernel knob,
+        # snapshotted into the engine's receipt context at construction
+        monkeypatch.setenv("REVAL_TPU_KERNEL_DOT", "dot")
+        bad = make_replica()
+        monkeypatch.delenv("REVAL_TPU_KERNEL_DOT")
+        router = make_router([good, bad], pin_tenants=["alpha"])
+        try:
+            wait_for(lambda: router.readiness()["ready"], what="router ready")
+            wait_for(lambda: len(router.statusz()["fingerprints"]) == 2,
+                     what="both fingerprints polled")
+            fps = router.statusz()["fingerprints"]
+            assert sorted(len(v) for v in fps.values()) == [1, 1]
+            # skew observed on the poll loop: edge-triggered, exactly once
+            wait_for(lambda: parse_prometheus(router.metrics_text()).get(
+                obs_metrics.RECEIPT_SKEW, 0) >= 1, what="skew counter")
+            router._check_fingerprint_skew()    # still skewed: no re-fire
+            samples = parse_prometheus(router.metrics_text())
+            assert samples[obs_metrics.RECEIPT_SKEW] == 1
+
+            # pin tenant alpha to the good replica's fingerprint
+            good_id = f"127.0.0.1:{good.port}"
+            prompt = prompt_targeting(router, good_id)
+            pinned = post_router(router, prompt, extra={"tenant": "alpha"})
+            good_fp = _statusz(good.port)["readiness"]["fingerprint"]
+            assert pinned["receipt"]["fingerprint"] == good_fp
+            assert router.statusz()["tenants"]["pins"] == {"alpha": good_fp}
+
+            # only the divergent replica remains: the pinned tenant sheds
+            # typed-429 rather than landing on a config that would answer
+            # differently
+            hard_kill(good)
+            wait_for(lambda: not any(
+                r["ready"] and r["state"] == "healthy"
+                and r["id"] == good_id
+                for r in router.statusz()["replicas"]),
+                what="good replica ejected")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post_router(router, prompt, extra={"tenant": "alpha"})
+            assert err.value.code == 429
+            assert err.value.headers.get("Retry-After")
+            # an unpinned tenant still gets served by the divergent
+            # replica — the shed is pin-scoped, not fleet-wide
+            unpinned = post_router(router, prompt, extra={"tenant": "beta"})
+            assert validate_receipt(unpinned["receipt"]) == []
+            assert unpinned["receipt"]["fingerprint"] != good_fp
+        finally:
+            router.shutdown()
+            bad.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# golden-stream registry — units + the committed file
+# ---------------------------------------------------------------------------
+
+def _fake_matrix():
+    return {
+        "reference": "cellA",
+        "perturb": None,
+        "probes": {"digest": "abcd" * 4, "max_new_tokens": 12},
+        "cells": {
+            "cellA": {"status": "ref", "fingerprint": "f" * 16,
+                      "tokens": [[1, 2, 3], [4, 5]]},
+            "cellB": {"status": "agree", "fingerprint": "f" * 16,
+                      "tokens": [[1, 2, 3], [4, 5]]},
+            "cellS": {"status": "skipped", "reason": "unloadable here"},
+        },
+    }
+
+
+class TestGoldenStreams:
+    def test_doc_records_executed_cells_with_recomputable_digests(self):
+        from reval_tpu.obs.determinism import golden_doc, validate_golden
+
+        doc = golden_doc(_fake_matrix())
+        assert set(doc["cells"]) == {"cellA", "cellB"}     # skipped stays out
+        assert doc["cells"]["cellA"]["digests"] == [
+            token_digest([1, 2, 3]), token_digest([4, 5])]
+        assert validate_golden(doc) == []
+
+    def test_validator_refuses_perturbed_and_tampered_registries(self):
+        from reval_tpu.obs.determinism import golden_doc, validate_golden
+
+        poisoned = _fake_matrix()
+        poisoned["perturb"] = "cellA"
+        assert any("PERTURB" in e for e in validate_golden(
+            golden_doc(poisoned)))
+        tampered = golden_doc(_fake_matrix())
+        tampered["cells"]["cellA"]["tokens"][0][0] += 1
+        assert any("recompute" in e for e in validate_golden(tampered))
+        assert validate_golden({"schema": "wrong"})
+        assert validate_golden("not a dict")
+
+    def test_gate_names_cell_probe_and_token(self):
+        from reval_tpu.obs.determinism import golden_doc, golden_gate
+
+        golden = golden_doc(_fake_matrix())
+        assert golden_gate(golden, _fake_matrix()) == []
+        # a single flipped token: earliest-token attribution
+        head = _fake_matrix()
+        head["cells"]["cellB"]["tokens"] = [[1, 2, 3], [4, 9]]
+        failures = golden_gate(golden, head)
+        assert len(failures) == 1
+        assert "cellB" in failures[0]
+        assert "probe 1 token 1" in failures[0]
+        # a recorded cell that stopped executing is loud, never silent
+        gone = _fake_matrix()
+        gone["cells"]["cellB"] = {"status": "skipped", "reason": "vanished"}
+        assert any("did not execute" in m
+                   for m in golden_gate(golden, gone))
+        # probe-set change invalidates the whole comparison
+        stale = _fake_matrix()
+        stale["probes"]["digest"] = "ffff" * 4
+        assert any("probe set changed" in m
+                   for m in golden_gate(golden, stale))
+
+    def test_committed_registry_validates_at_head(self):
+        from reval_tpu.obs.determinism import (GOLDEN_FILE, GOLDEN_SLICE,
+                                               validate_golden)
+
+        path = os.path.join(REPO, GOLDEN_FILE)
+        with open(path) as f:
+            golden = json.load(f)
+        assert validate_golden(golden) == []
+        # the committed cells are a subset of the default slice (a
+        # narrowed --record is allowed; unknown cells are not)
+        assert set(golden["cells"]) <= set(GOLDEN_SLICE)
+
+    def test_tool_record_then_perturbed_check_names_the_divergence(
+            self, tmp_path, monkeypatch, capsys):
+        """The full CLI gate on ONE host cell: ``--record`` blesses the
+        stream, a perturbed HEAD (the determinism chaos hook) exits 1
+        naming the cell and the first divergent (probe, token)."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "golden_streams_under_test",
+            os.path.join(REPO, "tools", "golden_streams.py"))
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+        cell = "paged-xla-fp32-b2"
+        path = str(tmp_path / "golden.json")
+        assert tool.main(["--record", "--cells", cell,
+                          "--path", path]) == 0
+        monkeypatch.setenv("REVAL_TPU_DETERMINISM_PERTURB", cell)
+        rc = tool.main(["--check", "--cells", cell, "--path", path])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "GOLDEN-STREAM GATE FAILURE" in err
+        assert f"cell {cell}: token stream diverges from golden at " \
+               "probe" in err
+        assert "token" in err
+
+    def test_goldenstreams_lint_pass_bites_on_corruption(self, tmp_path):
+        from reval_tpu.analysis import goldenstreams
+        from reval_tpu.obs.determinism import GOLDEN_FILE
+
+        assert goldenstreams.run([], str(tmp_path)) == []   # no registry
+        (tmp_path / GOLDEN_FILE).write_text("{ truncated")
+        violations = goldenstreams.run([], str(tmp_path))
+        assert violations and violations[0].pass_name == "goldenstreams"
+
+
+# ---------------------------------------------------------------------------
+# reporting surfaces — watch row, obs_report --receipts
+# ---------------------------------------------------------------------------
+
+class TestReceiptReporting:
+    def test_watch_row_converged_skewed_and_single(self):
+        from reval_tpu.watch import _receipt_row
+
+        fp = "c0374e30" * 8
+        converged = _receipt_row({"fingerprints": {fp: ["r1", "r2"]}})
+        assert "converged" in converged and fp[:16] in converged
+        skewed = _receipt_row({"fingerprints": {fp: ["r1", "r2"],
+                                                "deadbeef" * 8: ["r3"]}})
+        assert "SKEW" in skewed and "r3" in skewed and "r1" not in skewed
+        single = _receipt_row({"readiness": {"fingerprint": fp,
+                                             "engine_id": "e-1"}})
+        assert fp[:16] in single and "e-1" in single
+        assert _receipt_row({"readiness": {"ready": True}}) is None
+
+    def test_obs_report_receipts_names_first_drift(self, tmp_path, capsys):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "obs_report_receipts", os.path.join(REPO, "tools",
+                                                "obs_report.py"))
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+
+        def round_file(name, fp, digest, perturb=None):
+            path = tmp_path / name
+            path.write_text(json.dumps(
+                {"determinism": {"receipt_fingerprint": fp,
+                                 "fingerprint": digest,
+                                 "perturb": perturb}}))
+            return str(path)
+
+        rounds = [round_file("BENCH_r1.json", "aaaa", "d1"),
+                  round_file("BENCH_r2.json", "aaaa", "d1"),
+                  round_file("BENCH_r3.json", "zzzz", "d1", perturb="cell"),
+                  round_file("BENCH_r4.json", "bbbb", "d2")]
+        rc = tool.main(["--receipts"] + rounds)
+        out = capsys.readouterr().out
+        assert rc == 0
+        # the perturbed round is marked and never the comparison bar:
+        # the first REAL drift is r4 vs r2
+        assert "[PERTURBED: cell]" in out
+        assert "first drift: BENCH_r4.json" in out
+        assert "BENCH_r2.json" in out.split("first drift", 1)[1]
+        assert "fingerprint + digest DRIFTED" in out
+
+    def test_obs_report_receipts_reads_fleet_trailers(self, tmp_path,
+                                                      capsys):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "obs_report_receipts2", os.path.join(REPO, "tools",
+                                                 "obs_report.py"))
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+        skewed = tmp_path / "loadgen.json"
+        skewed.write_text(json.dumps(
+            {"receipts": {"fingerprints": ["aaaa", "bbbb"],
+                          "converged": False}}))
+        rc = tool.main(["--receipts", str(skewed)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "SKEW: 2 fleet fingerprints" in out

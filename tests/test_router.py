@@ -491,7 +491,11 @@ def test_streaming_passes_through_the_router():
         deltas = [json.loads(line[len("data: "):])
                   for line in raw.splitlines()
                   if line.startswith("data: ") and "[DONE]" not in line]
-        text = "".join(c["text"] for d in deltas for c in d["choices"])
+        # the stream ends with the receipt trailer, then [DONE]
+        assert deltas[-1]["object"] == "reval.receipt"
+        assert deltas[-1]["receipt"]
+        text = "".join(c["text"] for d in deltas
+                       for c in d.get("choices", ()))
         direct, _ = post_router(router, "stream me", max_tokens=32)
         assert text == direct["choices"][0]["text"]
         assert "data: [DONE]" in raw

@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Golden-stream registry: record + gate the probe set's token streams.
+
+The committed upgrade gate for serving-time reproducibility
+(``reval_tpu/obs/receipts.py`` is the per-response half; this is the
+per-commit half).  ``--record`` runs the determinism probe set over the
+host-runnable matrix slice and writes the exact greedy token streams —
+plus their per-probe receipt digests and each cell's fingerprint — into
+the committed ``GOLDEN_STREAMS.json``.  ``--check`` re-runs the same
+cells at HEAD and diffs against the registry: any divergence exits 1
+naming the cell and the FIRST divergent (probe, token), the same
+earliest-token attribution the determinism matrix's parity gate uses.
+
+So an upgrade PR (jax pin bump, kernel rewrite, scheduler change) that
+moves greedy outputs CANNOT land silently: the gate names exactly where
+the stream broke, and blessing the new behavior is an explicit,
+reviewable ``--record`` commit.
+
+The ``goldenstreams`` reval-lint pass validates the committed registry's
+schema (digests recompute from the stored streams; a perturb-drill
+recording is refused) without running the model, so the <10 s lint bar
+holds; this tool is the full gate.
+
+Exit codes: 0 = recorded / HEAD matches golden; 1 = divergence (or a
+self-check failure on record); 2 = unrunnable (no registry to check,
+bad cells, reference unloadable).
+
+Usage:
+    python tools/golden_streams.py --record            # bless HEAD
+    python tools/golden_streams.py --check             # gate HEAD
+    python tools/golden_streams.py --check --cells paged-xla-fp32-b2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--record", action="store_true",
+                      help="run the slice and (re)write the committed "
+                           "registry — the explicit blessing step")
+    mode.add_argument("--check", action="store_true",
+                      help="re-run the recorded cells and diff against "
+                           "the registry; divergence exits 1 naming the "
+                           "cell and first divergent (probe, token)")
+    ap.add_argument("--path", default=None,
+                    help="registry path (default <repo>/GOLDEN_STREAMS.json)")
+    ap.add_argument("--cells", default=None,
+                    help="comma-separated cell names (record: which "
+                         "cells to bless, default the host-runnable "
+                         "bench slice; check: narrow the re-run — "
+                         "unlisted recorded cells are still required "
+                         "to match when they execute)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the registry (record) or the verdict "
+                         "object (check) to stdout as JSON")
+    args = ap.parse_args(argv)
+
+    from reval_tpu.obs.determinism import (GOLDEN_FILE, GOLDEN_SLICE,
+                                           golden_doc, golden_gate,
+                                           run_matrix, validate_golden)
+
+    path = args.path or os.path.join(_ROOT, GOLDEN_FILE)
+    cells = ([c.strip() for c in args.cells.split(",") if c.strip()]
+             if args.cells else None)
+
+    if args.record:
+        try:
+            matrix = run_matrix(select=cells or list(GOLDEN_SLICE))
+        except (ValueError, RuntimeError) as e:
+            print(f"golden_streams: {e}", file=sys.stderr)
+            return 2
+        doc = golden_doc(matrix)
+        problems = validate_golden(doc)
+        if problems:    # e.g. recorded under a leftover perturb drill
+            for p in problems:
+                print(f"golden_streams: self-check: {p}", file=sys.stderr)
+            return 1
+        with open(path + ".tmp", "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(path + ".tmp", path)
+        if args.json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"golden_streams: recorded {len(doc['cells'])} cell(s) "
+              f"-> {path}")
+        return 0
+
+    try:
+        with open(path) as f:
+            golden = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"golden_streams: cannot read registry {path}: {e} "
+              f"(run --record first)", file=sys.stderr)
+        return 2
+    problems = validate_golden(golden)
+    if problems:
+        for p in problems:
+            print(f"golden_streams: bad registry: {p}", file=sys.stderr)
+        return 2
+    try:
+        matrix = run_matrix(select=cells or list(golden["cells"]))
+    except (ValueError, RuntimeError) as e:
+        print(f"golden_streams: {e}", file=sys.stderr)
+        return 2
+    failures = golden_gate(golden, matrix)
+    if cells:
+        # a narrowed re-run records unselected cells as skipped; those
+        # are this invocation's choice, not HEAD's divergence
+        chosen = set(cells)
+        failures = [msg for msg in failures
+                    if msg.split(":", 1)[0].removeprefix("cell ").strip()
+                    in chosen or not msg.startswith("cell ")]
+    if args.json:
+        print(json.dumps({"ok": not failures, "registry": path,
+                          "cells_checked": sorted(golden["cells"]),
+                          "failures": failures}, indent=1))
+    if failures:
+        print("GOLDEN-STREAM GATE FAILURE:", file=sys.stderr)
+        for msg in failures:
+            print(f"  {msg}", file=sys.stderr)
+        return 1
+    print(f"golden_streams: HEAD matches {path} "
+          f"({len(golden['cells'])} cell(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,10 @@ it, stdout always carries it):
   ``worst_bad_window_s`` — the longest consecutive stretch of buckets
   containing a miss or loss, i.e. the recovery window the chaos drill
   bounds;
-- ``tenants``: the same accounting per tenant.
+- ``tenants``: the same accounting per tenant;
+- ``receipts``: the fleet's receipt config-fingerprint set observed
+  over the run (``/statusz`` at start and end) — ``converged`` false
+  means the traffic spanned divergent serving configs.
 
 Usage::
 
@@ -340,11 +343,34 @@ class OpenLoopRunner:
             # /metrics federation still gets the client-side artifact
             return None
 
+    def _scrape_fingerprints(self) -> dict[str, list[str]]:
+        """The receipt config-fingerprint set visible at /statusz right
+        now (obs/receipts.py): a router body carries the fleet map
+        (fingerprint -> ready replica ids), a single server's readiness
+        carries its own.  {} when the target has no provenance — the
+        artifact simply omits the receipts block."""
+        try:
+            with urllib.request.urlopen(self.base_url + "/statusz",
+                                        timeout=10) as r:
+                status = json.loads(r.read())
+        except Exception:   # noqa: BLE001 — same weather as _scrape
+            return {}
+        fps = status.get("fingerprints")
+        if isinstance(fps, dict) and fps:
+            return {str(fp): sorted(str(x) for x in ids)
+                    for fp, ids in fps.items()}
+        readiness = status.get("readiness") or {}
+        fp = readiness.get("fingerprint")
+        if fp:
+            return {str(fp): [str(readiness.get("engine_id") or "engine")]}
+        return {}
+
     def run(self) -> dict:
         log_event("loadgen.start", target=self.target,
                   requests=len(self.requests),
                   concurrency=self.concurrency)
         before = self._scrape()
+        fps_before = self._scrape_fingerprints()
         t0 = time.perf_counter()
         threads = []
         for req in self.requests:
@@ -368,6 +394,20 @@ class OpenLoopRunner:
         after = self._scrape()
         artifact = self._artifact(before, after,
                                   time.perf_counter() - t0)
+        # serving provenance: the union of fingerprints seen at start
+        # and end of the run.  >1 fingerprint means this run's traffic
+        # spanned divergent serving configs — its numbers are not one
+        # config's numbers (obs_report --receipts flags it as SKEW).
+        fp_map: dict[str, set] = {}
+        for snap in (fps_before, self._scrape_fingerprints()):
+            for fp, ids in snap.items():
+                fp_map.setdefault(fp, set()).update(ids)
+        if fp_map:
+            artifact["receipts"] = {
+                "fingerprints": sorted(fp_map),
+                "converged": len(fp_map) <= 1,
+                "replicas": {fp: sorted(ids)
+                             for fp, ids in sorted(fp_map.items())}}
         log_event("loadgen.done", target=self.target,
                   requests=len(self.requests),
                   lost=artifact["counts"]["lost"],

@@ -187,6 +187,85 @@ def render_determinism(paths: list[str]) -> str:
     return "\n".join(lines)
 
 
+def receipts_block(path: str) -> dict | None:
+    """One artifact's serving-provenance receipt facts: a BENCH round's
+    ``determinism.receipt_fingerprint`` (run_paged attaches the headline
+    engine's receipt config fingerprint; the block's stream fingerprint
+    rides along as the digest column), or a fleet/loadgen artifact's
+    ``receipts`` trailer (fingerprint set observed across the run)."""
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("not a JSON object")
+    det = obj.get("determinism")
+    if isinstance(det, dict) and det.get("receipt_fingerprint"):
+        return {"fingerprint": det["receipt_fingerprint"],
+                "digest": det.get("fingerprint"),
+                "perturb": det.get("perturb")}
+    rec = obj.get("receipts")
+    if isinstance(rec, dict) and rec.get("fingerprints"):
+        fps = [str(f) for f in rec["fingerprints"]]
+        return {"fingerprint": fps[0] if len(fps) == 1 else None,
+                "fingerprints": fps, "digest": None,
+                "perturb": obj.get("perturb") or None}
+    return None
+
+
+def render_receipts(paths: list[str]) -> str:
+    """Receipt provenance across rounds (chronological order): one row
+    per artifact with the serving config fingerprint and the stream
+    digest, the FIRST round either drifted named loudly — the same
+    first-change contract as --determinism, but over the RECEIPT axes
+    (a config fingerprint move means the serving configuration itself
+    changed; a digest move at a stable fingerprint means the numerics
+    moved under an unchanged config)."""
+    lines = ["== receipt provenance across rounds ==", "",
+             f"{'round':<28} {'config fingerprint':<18} {'digest':<18}"]
+    prev: tuple[str, dict] | None = None
+    first_drift: str | None = None
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            block = receipts_block(path)
+        except (OSError, ValueError) as e:
+            lines.append(f"{name:<28} (unreadable: {type(e).__name__})")
+            continue
+        if block is None:
+            lines.append(f"{name:<28} (no receipt block)")
+            continue
+        fp = block.get("fingerprint")
+        fps = block.get("fingerprints")
+        digest = block.get("digest")
+        drifted = []
+        # a perturb-drill round is debris, not evidence: marked, never
+        # compared, and never the next round's comparison bar
+        drill = bool(block.get("perturb"))
+        if prev is not None and not drill:
+            p = prev[1]
+            if fp and p.get("fingerprint") and fp != p["fingerprint"]:
+                drifted.append("fingerprint")
+            if digest and p.get("digest") and digest != p["digest"]:
+                drifted.append("digest")
+        mark = ""
+        if fps and len(fps) > 1:
+            mark += f"  SKEW: {len(fps)} fleet fingerprints"
+        if drifted:
+            mark += f"  <-- {' + '.join(drifted)} DRIFTED"
+        if drill:
+            mark += f"  [PERTURBED: {block['perturb']}]"
+        if drifted and first_drift is None:
+            first_drift = (f"first drift: {name} ({', '.join(drifted)} "
+                           f"moved vs {os.path.basename(prev[0])})")
+        fp_txt = fp or (f"({len(fps)} skewed)" if fps else "?")
+        lines.append(f"{name:<28} {fp_txt:<18} {digest or '—':<18}{mark}")
+        if not drill:
+            prev = (path, block)
+    lines.append("")
+    lines.append(first_drift if first_drift
+                 else "no receipt drift across these rounds")
+    return "\n".join(lines)
+
+
 def speculative_block(path: str) -> dict | None:
     """One artifact's ``speculative`` block: a BENCH round's embedded
     dict (bench.py A/B garnish) or a fleet_metrics.json trailer."""
@@ -434,13 +513,21 @@ def main(argv: list[str] | None = None) -> int:
                          "across kernelbench artifacts: per-cell "
                          "regressions (first one named), stale cells "
                          "flagged with provenance")
+    ap.add_argument("--receipts", action="store_true",
+                    help="report receipt config-fingerprint / stream-"
+                         "digest drift across BENCH rounds (or fleet/"
+                         "loadgen artifacts carrying a receipts "
+                         "trailer), naming the first drifted round")
     args = ap.parse_args(argv)
     if sum((args.determinism, args.speculative, args.slo,
-            args.kernels)) > 1:
-        ap.error("--determinism, --speculative, --slo, and --kernels are "
-                 "mutually exclusive")
+            args.kernels, args.receipts)) > 1:
+        ap.error("--determinism, --speculative, --slo, --kernels, and "
+                 "--receipts are mutually exclusive")
     if args.kernels:
         print(render_kernels(args.snapshot))
+        return 0
+    if args.receipts:
+        print(render_receipts(args.snapshot))
         return 0
     if args.determinism:
         print(render_determinism(args.snapshot))
